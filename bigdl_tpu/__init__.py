@@ -1,0 +1,24 @@
+"""bigdl_tpu — a TPU-native distributed deep-learning framework.
+
+A brand-new JAX/XLA/Pallas framework with the capabilities of Intel BigDL
+(reference: /root/reference, see SURVEY.md): a Torch-style layer/criterion
+library, distributed synchronous-SGD training with sharded optimizer state
+over a `jax.sharding.Mesh`, a composable data pipeline, a full optimizer
+suite, checkpoint/resume, observability, int8 inference, and a model zoo.
+
+The design is TPU-first, not a port:
+  * layers are pure functions over (params, state) pytrees — autodiff
+    replaces the reference's hand-written `updateGradInput`/`accGradParameters`
+    (reference: nn/abstractnn/AbstractModule.scala:306-327);
+  * the reference's BlockManager parameter-server all-reduce
+    (parameters/AllReduceParameter.scala:80) becomes XLA collectives inserted
+    by `jit` over a device mesh, with ZeRO-1-style sharded optimizer state;
+  * MKL/MKL-DNN JNI kernels (SURVEY.md §2.14) become XLA HLO + Pallas kernels.
+"""
+
+__version__ = "0.1.0"
+
+from bigdl_tpu.core.module import Module, Criterion, ParamSpec, StateSpec
+from bigdl_tpu.core import init as initializers
+
+__all__ = ["Module", "Criterion", "ParamSpec", "StateSpec", "initializers", "__version__"]

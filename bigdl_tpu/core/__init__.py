@@ -1,0 +1,7 @@
+from bigdl_tpu.core.module import Module, Criterion, ParamSpec, StateSpec
+from bigdl_tpu.core.container import Sequential, ConcatTable, ParallelTable, Concat, Graph, Input
+
+__all__ = [
+    "Module", "Criterion", "ParamSpec", "StateSpec",
+    "Sequential", "ConcatTable", "ParallelTable", "Concat", "Graph", "Input",
+]

@@ -1,0 +1,189 @@
+"""Containers — TPU-native analogues of the reference's container layers
+(reference: nn/Container.scala, nn/Sequential.scala, nn/Concat.scala,
+nn/ConcatTable.scala, nn/ParallelTable.scala, nn/Graph.scala:72-476).
+
+A "Table" in the reference (int-keyed Torch table, utils/Table.scala) maps to
+a plain Python tuple/list here — JAX treats those as pytrees natively.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import Module, _fold_name
+
+
+class Container(Module):
+    """Base container holding an ordered list of children keyed '0','1',…"""
+
+    def __init__(self, *modules: Module, name: Optional[str] = None):
+        super().__init__(name=name)
+        for m in modules:
+            self.add(m)
+
+    def add(self, module: Module) -> "Container":
+        self.add_child(str(len(self._children)), module)
+        return self
+
+    def __getitem__(self, i: int) -> Module:
+        return self._children[str(i)]
+
+    def __len__(self):
+        return len(self._children)
+
+
+class Sequential(Container):
+    """Feed-forward chain (reference: nn/Sequential.scala)."""
+
+    def _apply(self, params, state, *inputs, training=False, rng=None):
+        out = inputs if len(inputs) > 1 else inputs[0]
+        new_state = {}
+        for cname, child in self.children().items():
+            crng = None if rng is None else _fold_name(rng, cname)
+            ins = out if isinstance(out, tuple) else (out,)
+            out, new_state[cname] = child.apply(
+                params[cname], state[cname], *ins, training=training, rng=crng)
+        return out, new_state
+
+
+class ParallelTable(Container):
+    """Applies i-th child to i-th input, returns tuple
+    (reference: nn/ParallelTable.scala)."""
+
+    def _apply(self, params, state, *inputs, training=False, rng=None):
+        if len(inputs) == 1 and isinstance(inputs[0], (tuple, list)):
+            inputs = tuple(inputs[0])
+        outs, new_state = [], {}
+        for (cname, child), x in zip(self.children().items(), inputs):
+            crng = None if rng is None else _fold_name(rng, cname)
+            o, new_state[cname] = child.apply(
+                params[cname], state[cname], x, training=training, rng=crng)
+            outs.append(o)
+        return tuple(outs), new_state
+
+
+class ConcatTable(Container):
+    """Applies every child to the same input, returns tuple
+    (reference: nn/ConcatTable.scala)."""
+
+    def _apply(self, params, state, *inputs, training=False, rng=None):
+        outs, new_state = [], {}
+        for cname, child in self.children().items():
+            crng = None if rng is None else _fold_name(rng, cname)
+            o, new_state[cname] = child.apply(
+                params[cname], state[cname], *inputs, training=training, rng=crng)
+            outs.append(o)
+        return tuple(outs), new_state
+
+
+class Concat(Container):
+    """Applies every child to the input and concatenates outputs along
+    `dimension` (reference: nn/Concat.scala; reference dims are 1-based NCHW —
+    here `axis` is 0-based and defaults to the channel axis of NHWC)."""
+
+    def __init__(self, *modules: Module, axis: int = -1, name: Optional[str] = None):
+        super().__init__(*modules, name=name)
+        self.axis = axis
+
+    def _apply(self, params, state, *inputs, training=False, rng=None):
+        outs, new_state = [], {}
+        for cname, child in self.children().items():
+            crng = None if rng is None else _fold_name(rng, cname)
+            o, new_state[cname] = child.apply(
+                params[cname], state[cname], *inputs, training=training, rng=crng)
+            outs.append(o)
+        return jnp.concatenate(outs, axis=self.axis), new_state
+
+
+# ----------------------------------------------------------------- DAG graph
+
+class Node:
+    """Symbolic node used at graph-construction time. Created by calling a
+    module on other nodes: ``n = Linear(4, 3)(prev)`` — the analogue of the
+    reference's `layer.inputs(...)` node wiring (reference: nn/Graph.scala)."""
+
+    def __init__(self, module: Optional[Module], parents: Sequence["Node"]):
+        self.module = module
+        self.parents = list(parents)
+
+    @staticmethod
+    def make(module: Module, nodes: Sequence["Node"]) -> "Node":
+        flat: List[Node] = []
+        for n in nodes:
+            if isinstance(n, (tuple, list)):
+                flat.extend(n)
+            else:
+                flat.append(n)
+        if not all(isinstance(n, Node) for n in flat):
+            raise TypeError("Modules must be called on graph Nodes; use "
+                            "module.apply(params, state, x) for eager use")
+        return Node(module, flat)
+
+
+class Input(Node):
+    """Graph input placeholder (reference: nn/Input.scala)."""
+
+    def __init__(self):
+        super().__init__(None, [])
+
+
+class Graph(Module):
+    """Static DAG executor (reference: nn/StaticGraph.scala:56-115; topology
+    sort mirrors utils/DirectedGraph.scala:54). The graph is topo-sorted once
+    at construction; `apply` executes the sorted schedule — under `jit`, XLA
+    sees one flat computation and fuses freely. Dynamic, data-dependent
+    control flow (reference: nn/DynamicGraph.scala) is deliberately expressed
+    with `lax.cond`/`lax.scan` inside individual modules instead."""
+
+    def __init__(self, inputs: Sequence[Node], outputs: Sequence[Node],
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.input_nodes = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+        self.output_nodes = list(outputs) if isinstance(outputs, (list, tuple)) else [outputs]
+        self._order = self._topo_sort()
+        for i, node in enumerate(self._order):
+            if node.module is not None:
+                self.add_child(str(i), node.module)
+        self._node_key = {id(n): str(i) for i, n in enumerate(self._order)}
+
+    def _topo_sort(self) -> List[Node]:
+        seen, order = set(), []
+
+        def visit(n: Node):
+            if id(n) in seen:
+                return
+            seen.add(id(n))
+            for p in n.parents:
+                visit(p)
+            order.append(n)
+
+        for out in self.output_nodes:
+            visit(out)
+        for inp in self.input_nodes:
+            if id(inp) not in seen:
+                raise ValueError("Graph input is not connected to any output")
+        return order
+
+    def _apply(self, params, state, *inputs, training=False, rng=None):
+        if len(inputs) == 1 and isinstance(inputs[0], (tuple, list)) \
+                and len(self.input_nodes) > 1:
+            inputs = tuple(inputs[0])
+        if len(inputs) != len(self.input_nodes):
+            raise ValueError(f"Graph expects {len(self.input_nodes)} inputs, "
+                             f"got {len(inputs)}")
+        values: Dict[int, object] = {id(n): x for n, x in zip(self.input_nodes, inputs)}
+        new_state = dict(state)
+        for node in self._order:
+            if node.module is None:       # Input placeholder
+                continue
+            key = self._node_key[id(node)]
+            args = tuple(values[id(p)] for p in node.parents)
+            crng = None if rng is None else _fold_name(rng, key)
+            out, new_state[key] = node.module.apply(
+                params[key], state[key], *args, training=training, rng=crng)
+            values[id(node)] = out
+        outs = tuple(values[id(n)] for n in self.output_nodes)
+        return (outs[0] if len(outs) == 1 else outs), new_state

@@ -1,0 +1,82 @@
+"""Parameter initialization methods.
+
+TPU-native equivalent of the reference's `InitializationMethod` hierarchy
+(reference: nn/InitializationMethod.scala). Each initializer is a callable
+``(rng, shape, dtype, fan_in, fan_out) -> jnp.ndarray``; fan values are
+computed by the owning layer (which knows its own geometry), mirroring the
+reference's `VariableFormat` mechanism.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+Initializer = Callable[..., jax.Array]
+
+
+def zeros(rng, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+    return jnp.zeros(shape, dtype)
+
+
+def ones(rng, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+    return jnp.ones(shape, dtype)
+
+
+def const(value: float) -> Initializer:
+    def _init(rng, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+        return jnp.full(shape, value, dtype)
+    return _init
+
+
+def random_uniform(lower: float = None, upper: float = None) -> Initializer:
+    """RandomUniform; with no bounds, uses the Torch default 1/sqrt(fan_in)
+    (reference: nn/InitializationMethod.scala RandomUniform)."""
+    if (lower is None) != (upper is None):
+        raise ValueError("random_uniform needs both bounds or neither, got "
+                         f"lower={lower}, upper={upper}")
+
+    def _init(rng, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+        if lower is None:
+            bound = 1.0 / math.sqrt(max(1, fan_in if fan_in else shape[-1]))
+            lo, hi = -bound, bound
+        else:
+            lo, hi = lower, upper
+        return jax.random.uniform(rng, shape, dtype, lo, hi)
+    return _init
+
+
+def random_normal(mean: float = 0.0, stdv: float = 1.0) -> Initializer:
+    def _init(rng, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+        return mean + stdv * jax.random.normal(rng, shape, dtype)
+    return _init
+
+
+def xavier(rng, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+    """Glorot uniform (reference: nn/InitializationMethod.scala Xavier)."""
+    fi = fan_in if fan_in else shape[-1]
+    fo = fan_out if fan_out else shape[0]
+    bound = math.sqrt(6.0 / (fi + fo))
+    return jax.random.uniform(rng, shape, dtype, -bound, bound)
+
+
+def kaiming(rng, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+    """MSRA / He normal (reference: nn/InitializationMethod.scala MsraFiller)."""
+    fi = fan_in if fan_in else shape[-1]
+    std = math.sqrt(2.0 / max(1, fi))
+    return std * jax.random.normal(rng, shape, dtype)
+
+
+def bilinear(rng, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+    """Bilinear upsampling kernel for deconvolution (reference:
+    nn/InitializationMethod.scala BilinearFiller). Expects HWIO conv kernel."""
+    kh, kw = shape[0], shape[1]
+    f_h, f_w = math.ceil(kh / 2.0), math.ceil(kw / 2.0)
+    c_h, c_w = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h), (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+    yy = 1 - jnp.abs(jnp.arange(kh) / f_h - c_h)
+    xx = 1 - jnp.abs(jnp.arange(kw) / f_w - c_w)
+    filt = jnp.outer(yy, xx).astype(dtype)
+    return jnp.broadcast_to(filt[:, :, None, None], shape)

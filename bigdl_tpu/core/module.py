@@ -1,0 +1,206 @@
+"""Functional module system — the TPU-native analogue of the reference's
+`AbstractModule` (reference: nn/abstractnn/AbstractModule.scala:59).
+
+Design (TPU-first, NOT a port):
+  * The reference threads mutable tensors through `updateOutput` /
+    `updateGradInput` / `accGradParameters` per layer. Under XLA everything
+    must be pure, so a Module here is a *declaration* (hyperparameters only)
+    with two pure functions:
+        params, state = module.init(rng)
+        output, new_state = module.apply(params, state, *inputs,
+                                         training=..., rng=...)
+    `params` are trainable leaves, `state` holds non-trainable buffers
+    (e.g. BatchNorm running stats). Both are nested dicts (pytrees) that
+    mirror the module tree, so `jax.grad` / `jit` / sharding annotations
+    compose naturally.
+  * Backward passes come from autodiff instead of hand-written
+    `updateGradInput` (layers whose reference semantics differ from autodiff
+    defaults override with `jax.custom_vjp`).
+  * The reference's `getParameters()` compaction into one flat tensor
+    (AbstractModule.scala:988) is `flatten_params` below.
+  * freeze/unFreeze (AbstractModule.scala:204-253) become a trainable-mask
+    pytree consumed by the optimizer (gradients are zeroed for frozen trees).
+  * Per-module timing (AbstractModule.scala:255-299) maps to
+    `jax.named_scope` so XLA profiles attribute cost per module.
+"""
+
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core import init as initializers
+
+
+@dataclass
+class ParamSpec:
+    """Declaration of one trainable parameter."""
+    shape: Tuple[int, ...]
+    init: Callable = initializers.xavier
+    dtype: Any = jnp.float32
+    fan_in: Optional[int] = None
+    fan_out: Optional[int] = None
+
+
+@dataclass
+class StateSpec:
+    """Declaration of one non-trainable buffer (e.g. running mean)."""
+    shape: Tuple[int, ...]
+    init: Callable = initializers.zeros
+    dtype: Any = jnp.float32
+
+
+def _fold_name(rng: jax.Array, name: str) -> jax.Array:
+    """Deterministic per-child RNG split, stable across processes."""
+    return jax.random.fold_in(rng, zlib.crc32(name.encode()) & 0x7FFFFFFF)
+
+
+class Module:
+    """Base class for all layers and containers.
+
+    Subclasses declare parameters via :meth:`param_specs` / :meth:`state_specs`
+    and implement :meth:`forward` (stateless layers) or :meth:`_apply`
+    (layers needing state/rng/training). Containers register children in
+    ``self._children`` (an ordered name->Module dict).
+    """
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name or type(self).__name__
+        self._children: Dict[str, "Module"] = {}
+        self._frozen = False
+        # Per-parameter learning-rate / weight-decay multipliers
+        # (reference: AbstractModule.setScaleW/setScaleB).
+        self.scale_w = 1.0
+        self.scale_b = 1.0
+
+    # ------------------------------------------------------------- declaration
+    def param_specs(self) -> Dict[str, ParamSpec]:
+        return {}
+
+    def state_specs(self) -> Dict[str, StateSpec]:
+        return {}
+
+    def children(self) -> Dict[str, "Module"]:
+        return self._children
+
+    def add_child(self, name: str, module: "Module") -> "Module":
+        self._children[name] = module
+        return module
+
+    # ------------------------------------------------------------------- init
+    def init(self, rng: jax.Array, dtype=None) -> Tuple[Dict, Dict]:
+        """Build (params, state) pytrees for this module tree."""
+        params: Dict[str, Any] = {}
+        state: Dict[str, Any] = {}
+        for pname, spec in self.param_specs().items():
+            d = dtype if dtype is not None else spec.dtype
+            params[pname] = spec.init(_fold_name(rng, pname), spec.shape, d,
+                                      fan_in=spec.fan_in, fan_out=spec.fan_out)
+        for sname, spec in self.state_specs().items():
+            state[sname] = spec.init(None, spec.shape, spec.dtype)
+        for cname, child in self.children().items():
+            cp, cs = child.init(_fold_name(rng, cname), dtype=dtype)
+            params[cname] = cp
+            state[cname] = cs
+        return params, state
+
+    # ------------------------------------------------------------------ apply
+    def apply(self, params, state, *inputs, training: bool = False,
+              rng: Optional[jax.Array] = None):
+        """Pure forward. Returns ``(output, new_state)``."""
+        with jax.named_scope(self.name):
+            return self._apply(params, state, *inputs, training=training, rng=rng)
+
+    def _apply(self, params, state, *inputs, training: bool = False,
+               rng: Optional[jax.Array] = None):
+        return self.forward(params, *inputs, training=training, rng=rng), state
+
+    def forward(self, params, *inputs, training: bool = False,
+                rng: Optional[jax.Array] = None):
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement forward() or _apply()")
+
+    def __call__(self, *nodes):
+        """Graph-construction sugar: calling a module on Node(s) creates a
+        graph Node (see core.container.Graph)."""
+        from bigdl_tpu.core.container import Node
+        return Node.make(self, nodes)
+
+    # ------------------------------------------------------- freeze machinery
+    def freeze(self) -> "Module":
+        """Mark this subtree non-trainable (reference:
+        AbstractModule.scala:204-253)."""
+        self._frozen = True
+        return self
+
+    def unfreeze(self) -> "Module":
+        self._frozen = False
+        for c in self.children().values():
+            c.unfreeze()
+        return self
+
+    def trainable_mask(self, params) -> Any:
+        """Bool pytree matching `params`: False where frozen."""
+        if self._frozen:
+            return jax.tree.map(lambda _: False, params)
+        mask = {}
+        child_names = set(self.children().keys())
+        for k, v in params.items():
+            if k in child_names:
+                mask[k] = self.children()[k].trainable_mask(v)
+            else:
+                mask[k] = jax.tree.map(lambda _: True, v)
+        return mask
+
+    # --------------------------------------------------------------- utility
+    def modules(self):
+        """Pre-order iterator over the module tree."""
+        yield self
+        for c in self.children().values():
+            yield from c.modules()
+
+    def __repr__(self):
+        kids = "".join(f"\n  ({k}): " + repr(v).replace("\n", "\n  ")
+                       for k, v in self.children().items())
+        return f"{self.name}({kids}\n)" if kids else f"{self.name}()"
+
+
+class Criterion:
+    """Loss contract — analogue of `AbstractCriterion`
+    (reference: nn/abstractnn/AbstractCriterion.scala). Pure:
+    ``loss = criterion.forward(input, target)``; gradients via autodiff
+    replace the reference's hand-written `backward`."""
+
+    size_average: bool = True
+
+    def forward(self, input, target):
+        raise NotImplementedError
+
+    def __call__(self, input, target):
+        return self.forward(input, target)
+
+
+# ------------------------------------------------------------ pytree helpers
+
+def flatten_params(params):
+    """Compact a params pytree into one flat vector + unravel fn — the
+    analogue of `getParameters()` (reference: AbstractModule.scala:988)."""
+    from jax.flatten_util import ravel_pytree
+    return ravel_pytree(params)
+
+
+def count_params(params) -> int:
+    return sum(int(x.size) for x in jax.tree.leaves(params))
+
+
+def cast_floating(tree, dtype):
+    """Cast floating-point leaves of a pytree to `dtype` (bf16 policy)."""
+    def _cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+    return jax.tree.map(_cast, tree)

@@ -1,0 +1,51 @@
+"""bigdl_tpu.nn — the layer & criterion library (reference: nn/, SURVEY.md §2.3)."""
+
+from bigdl_tpu.core.container import (Concat, ConcatTable, Container, Graph,
+                                      Input, Node, ParallelTable, Sequential)
+from bigdl_tpu.core.module import Criterion, Module
+
+from bigdl_tpu.nn.linear import Linear, Bilinear, CMul, CAdd, Add, Mul
+from bigdl_tpu.nn.conv import (SpatialConvolution, SpatialDilatedConvolution,
+                               SpatialFullConvolution, SpatialSeparableConvolution,
+                               TemporalConvolution, VolumetricConvolution)
+from bigdl_tpu.nn.pooling import (SpatialMaxPooling, SpatialAveragePooling,
+                                  TemporalMaxPooling, VolumetricMaxPooling,
+                                  SpatialAdaptiveMaxPooling, GlobalAveragePooling2D)
+from bigdl_tpu.nn.activation import (ReLU, ReLU6, Tanh, Sigmoid, ELU, SELU, GELU,
+                                     Swish, SoftMax, LogSoftMax, SoftMin, SoftPlus,
+                                     SoftSign, HardTanh, Clamp, HardSigmoid,
+                                     LeakyReLU, PReLU, RReLU, SReLU, Threshold)
+from bigdl_tpu.nn.normalization import (BatchNormalization, SpatialBatchNormalization,
+                                        LayerNormalization, RMSNorm, Normalize,
+                                        NormalizeScale, SpatialCrossMapLRN)
+from bigdl_tpu.nn.dropout import (Dropout, GaussianDropout, GaussianNoise,
+                                  SpatialDropout1D, SpatialDropout2D, SpatialDropout3D)
+from bigdl_tpu.nn.embedding import LookupTable, Embedding
+from bigdl_tpu.nn.shape_ops import (Identity, Echo, Reshape, View, Flatten,
+                                    InferReshape, Squeeze, Unsqueeze, Transpose,
+                                    Permute, Select, Narrow, Padding,
+                                    SpatialZeroPadding, JoinTable, SplitTable,
+                                    SelectTable, FlattenTable, Replicate, Masking,
+                                    Index, Gather, Contiguous, UpSampling1D,
+                                    UpSampling2D, UpSampling3D, ResizeBilinear)
+from bigdl_tpu.nn.arithmetic import (CAddTable, CMulTable, CSubTable, CDivTable,
+                                     CMaxTable, CMinTable, MulConstant, AddConstant,
+                                     Power, Sqrt, Square, Abs, Exp, Log, Negative,
+                                     Sum, Mean, Max, Min, Clip, MM, MV, DotProduct,
+                                     CosineDistance, PairwiseDistance, Scale,
+                                     MixtureTable)
+from bigdl_tpu.nn.criterion import (ClassNLLCriterion, CrossEntropyCriterion,
+                                    MSECriterion, AbsCriterion, SmoothL1Criterion,
+                                    SmoothL1CriterionWithWeights, BCECriterion,
+                                    BCECriterionWithLogits, MarginCriterion,
+                                    MarginRankingCriterion, HingeEmbeddingCriterion,
+                                    CosineEmbeddingCriterion, KLDivCriterion,
+                                    DistKLDivCriterion, GaussianCriterion,
+                                    KLDCriterion, L1Cost, SoftMarginCriterion,
+                                    MultiLabelMarginCriterion,
+                                    MultiLabelSoftMarginCriterion, MultiCriterion,
+                                    ParallelCriterion, TimeDistributedCriterion,
+                                    TimeDistributedMaskCriterion,
+                                    DiceCoefficientCriterion, MultiMarginCriterion,
+                                    ClassSimplexCriterion, PGCriterion,
+                                    TransformerCriterion)

@@ -1,0 +1,202 @@
+"""Activation layers (reference: nn/ReLU.scala, nn/Tanh.scala, … — each is a
+one-line XLA elementwise op here; XLA fuses them into adjacent matmuls/convs,
+which is what the reference's MKL-DNN post-op fusion (nn/mkldnn/Fusion.scala)
+achieves by hand)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core import init as initializers
+from bigdl_tpu.core.module import Module, ParamSpec
+
+
+class _Elementwise(Module):
+    fn = staticmethod(lambda x: x)
+
+    def forward(self, params, x, **_):
+        return type(self).fn(x)
+
+
+class ReLU(_Elementwise):
+    fn = staticmethod(jax.nn.relu)
+
+
+class ReLU6(_Elementwise):
+    fn = staticmethod(jax.nn.relu6)
+
+
+class Tanh(_Elementwise):
+    fn = staticmethod(jnp.tanh)
+
+
+class Sigmoid(_Elementwise):
+    fn = staticmethod(jax.nn.sigmoid)
+
+
+class ELU(Module):
+    def __init__(self, alpha: float = 1.0, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.alpha = alpha
+
+    def forward(self, params, x, **_):
+        return jax.nn.elu(x, self.alpha)
+
+
+class SELU(_Elementwise):
+    fn = staticmethod(jax.nn.selu)
+
+
+class GELU(_Elementwise):
+    fn = staticmethod(jax.nn.gelu)
+
+
+class Swish(_Elementwise):
+    fn = staticmethod(jax.nn.silu)
+
+
+class SoftMax(Module):
+    """(reference: nn/SoftMax.scala)."""
+
+    def __init__(self, axis: int = -1, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.axis = axis
+
+    def forward(self, params, x, **_):
+        return jax.nn.softmax(x, axis=self.axis)
+
+
+class LogSoftMax(Module):
+    """(reference: nn/LogSoftMax.scala)."""
+
+    def __init__(self, axis: int = -1, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.axis = axis
+
+    def forward(self, params, x, **_):
+        return jax.nn.log_softmax(x, axis=self.axis)
+
+
+class SoftMin(Module):
+    def forward(self, params, x, **_):
+        return jax.nn.softmax(-x, axis=-1)
+
+
+class SoftPlus(Module):
+    """(reference: nn/SoftPlus.scala; beta-scaled)."""
+
+    def __init__(self, beta: float = 1.0, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.beta = beta
+
+    def forward(self, params, x, **_):
+        return jax.nn.softplus(self.beta * x) / self.beta
+
+
+class SoftSign(_Elementwise):
+    fn = staticmethod(jax.nn.soft_sign)
+
+
+class HardTanh(Module):
+    """(reference: nn/HardTanh.scala)."""
+
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.min_value, self.max_value = min_value, max_value
+
+    def forward(self, params, x, **_):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class Clamp(HardTanh):
+    """(reference: nn/Clamp.scala)."""
+
+
+class HardSigmoid(_Elementwise):
+    fn = staticmethod(jax.nn.hard_sigmoid)
+
+
+class LeakyReLU(Module):
+    """(reference: nn/LeakyReLU.scala)."""
+
+    def __init__(self, negval: float = 0.01, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.negval = negval
+
+    def forward(self, params, x, **_):
+        return jax.nn.leaky_relu(x, self.negval)
+
+
+class PReLU(Module):
+    """Learned per-channel slope (reference: nn/PReLU.scala).
+    `n_output_plane`=0 → one shared slope."""
+
+    def __init__(self, n_output_plane: int = 0, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.nout = n_output_plane
+
+    def param_specs(self):
+        n = max(1, self.nout)
+        return {"weight": ParamSpec((n,), initializers.const(0.25))}
+
+    def forward(self, params, x, **_):
+        w = params["weight"]
+        return jnp.where(x >= 0, x, x * w)
+
+
+class RReLU(Module):
+    """Randomized leaky ReLU: slope ~ U(lower, upper) in training, fixed mean
+    slope in eval (reference: nn/RReLU.scala)."""
+
+    def __init__(self, lower: float = 1 / 8, upper: float = 1 / 3,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.lower, self.upper = lower, upper
+
+    def _apply(self, params, state, x, training=False, rng=None):
+        if training:
+            from bigdl_tpu.nn.dropout import _require_rng
+            rng = _require_rng(rng, self)
+            a = jax.random.uniform(rng, x.shape, x.dtype, self.lower, self.upper)
+        else:
+            a = (self.lower + self.upper) / 2
+        return jnp.where(x >= 0, x, x * a), state
+
+
+class SReLU(Module):
+    """S-shaped ReLU with 4 learned per-channel params
+    (reference: nn/SReLU.scala)."""
+
+    def __init__(self, shape, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.shape = tuple(shape)
+
+    def param_specs(self):
+        return {
+            "t_left": ParamSpec(self.shape, initializers.zeros),
+            "a_left": ParamSpec(self.shape, initializers.ones),
+            "t_right": ParamSpec(self.shape, initializers.ones),
+            "a_right": ParamSpec(self.shape, initializers.ones),
+        }
+
+    def forward(self, params, x, **_):
+        tl, al = params["t_left"], params["a_left"]
+        tr, ar = params["t_right"], params["a_right"]
+        y = jnp.where(x < tl, tl + al * (x - tl), x)
+        return jnp.where(x > tr, tr + ar * (x - tr), y)
+
+
+class Threshold(Module):
+    """(reference: nn/Threshold.scala)."""
+
+    def __init__(self, th: float = 1e-6, v: float = 0.0,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.th, self.v = th, v
+
+    def forward(self, params, x, **_):
+        return jnp.where(x > self.th, x, self.v)

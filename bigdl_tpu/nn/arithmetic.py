@@ -1,0 +1,269 @@
+"""Elementwise & table arithmetic layers (reference: nn/CAddTable.scala,
+nn/CMulTable.scala, nn/CSubTable.scala, nn/CDivTable.scala, nn/CMaxTable.scala,
+nn/CMinTable.scala, nn/MulConstant.scala, nn/AddConstant.scala, nn/Power.scala,
+nn/Sqrt.scala, nn/Square.scala, nn/Abs.scala, nn/Exp.scala, nn/Log.scala,
+nn/Negative.scala, nn/Sum.scala, nn/Mean.scala, nn/Max.scala, nn/Min.scala,
+nn/MM.scala, nn/MV.scala, nn/DotProduct.scala, nn/Cosine.scala,
+nn/CosineDistance.scala, nn/PairwiseDistance.scala, nn/Scale.scala,
+nn/MixtureTable.scala). Pure jnp — XLA fuses all of these."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from bigdl_tpu.core.container import Sequential
+from bigdl_tpu.core.module import Module
+from bigdl_tpu.nn.linear import CAdd, CMul
+
+
+def _table(inputs):
+    if len(inputs) == 1 and isinstance(inputs[0], (tuple, list)):
+        return tuple(inputs[0])
+    return inputs
+
+
+class CAddTable(Module):
+    """Sum a tuple of tensors (reference: nn/CAddTable.scala)."""
+
+    def forward(self, params, *inputs, **_):
+        xs = _table(inputs)
+        out = xs[0]
+        for x in xs[1:]:
+            out = out + x
+        return out
+
+
+class CMulTable(Module):
+    def forward(self, params, *inputs, **_):
+        xs = _table(inputs)
+        out = xs[0]
+        for x in xs[1:]:
+            out = out * x
+        return out
+
+
+class CSubTable(Module):
+    def forward(self, params, *inputs, **_):
+        xs = _table(inputs)
+        return xs[0] - xs[1]
+
+
+class CDivTable(Module):
+    def forward(self, params, *inputs, **_):
+        xs = _table(inputs)
+        return xs[0] / xs[1]
+
+
+class CMaxTable(Module):
+    def forward(self, params, *inputs, **_):
+        xs = _table(inputs)
+        out = xs[0]
+        for x in xs[1:]:
+            out = jnp.maximum(out, x)
+        return out
+
+
+class CMinTable(Module):
+    def forward(self, params, *inputs, **_):
+        xs = _table(inputs)
+        out = xs[0]
+        for x in xs[1:]:
+            out = jnp.minimum(out, x)
+        return out
+
+
+class MulConstant(Module):
+    def __init__(self, constant: float, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.constant = constant
+
+    def forward(self, params, x, **_):
+        return x * self.constant
+
+
+class AddConstant(Module):
+    def __init__(self, constant: float, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.constant = constant
+
+    def forward(self, params, x, **_):
+        return x + self.constant
+
+
+class Power(Module):
+    """(shift + scale*x)^power (reference: nn/Power.scala)."""
+
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.power, self.scale, self.shift = power, scale, shift
+
+    def forward(self, params, x, **_):
+        return (self.shift + self.scale * x) ** self.power
+
+
+class Sqrt(Module):
+    def forward(self, params, x, **_):
+        return jnp.sqrt(x)
+
+
+class Square(Module):
+    def forward(self, params, x, **_):
+        return jnp.square(x)
+
+
+class Abs(Module):
+    def forward(self, params, x, **_):
+        return jnp.abs(x)
+
+
+class Exp(Module):
+    def forward(self, params, x, **_):
+        return jnp.exp(x)
+
+
+class Log(Module):
+    def forward(self, params, x, **_):
+        return jnp.log(x)
+
+
+class Negative(Module):
+    def forward(self, params, x, **_):
+        return -x
+
+
+class Sum(Module):
+    """(reference: nn/Sum.scala)."""
+
+    def __init__(self, axis: int = 0, keepdims: bool = False,
+                 mean: bool = False, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.axis, self.keepdims, self.mean = axis, keepdims, mean
+
+    def forward(self, params, x, **_):
+        fn = jnp.mean if self.mean else jnp.sum
+        return fn(x, axis=self.axis, keepdims=self.keepdims)
+
+
+class Mean(Sum):
+    """(reference: nn/Mean.scala)."""
+
+    def __init__(self, axis: int = 0, keepdims: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(axis=axis, keepdims=keepdims, mean=True, name=name)
+
+
+class Max(Module):
+    def __init__(self, axis: int = 0, keepdims: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.axis, self.keepdims = axis, keepdims
+
+    def forward(self, params, x, **_):
+        return jnp.max(x, axis=self.axis, keepdims=self.keepdims)
+
+
+class Min(Module):
+    def __init__(self, axis: int = 0, keepdims: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.axis, self.keepdims = axis, keepdims
+
+    def forward(self, params, x, **_):
+        return jnp.min(x, axis=self.axis, keepdims=self.keepdims)
+
+
+class Clip(Module):
+    def __init__(self, min_value: float, max_value: float,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.min_value, self.max_value = min_value, max_value
+
+    def forward(self, params, x, **_):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class MM(Module):
+    """Batched matmul of a pair (reference: nn/MM.scala,
+    nn/ops/BatchMatMul.scala)."""
+
+    def __init__(self, trans_a: bool = False, trans_b: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.trans_a, self.trans_b = trans_a, trans_b
+
+    def forward(self, params, *inputs, **_):
+        a, b = _table(inputs)
+        if self.trans_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.trans_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return a @ b
+
+
+class MV(Module):
+    """Batched matrix-vector product (reference: nn/MV.scala)."""
+
+    def __init__(self, trans: bool = False, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.trans = trans
+
+    def forward(self, params, *inputs, **_):
+        m, v = _table(inputs)
+        if self.trans:
+            m = jnp.swapaxes(m, -1, -2)
+        return jnp.einsum("...ij,...j->...i", m, v)
+
+
+class DotProduct(Module):
+    """Row-wise dot of a pair (reference: nn/DotProduct.scala)."""
+
+    def forward(self, params, *inputs, **_):
+        a, b = _table(inputs)
+        return jnp.sum(a * b, axis=-1)
+
+
+class CosineDistance(Module):
+    """Row-wise cosine similarity of a pair (reference: nn/CosineDistance.scala)."""
+
+    def forward(self, params, *inputs, **_):
+        a, b = _table(inputs)
+        na = jnp.maximum(jnp.linalg.norm(a, axis=-1), 1e-12)
+        nb = jnp.maximum(jnp.linalg.norm(b, axis=-1), 1e-12)
+        return jnp.sum(a * b, axis=-1) / (na * nb)
+
+
+class PairwiseDistance(Module):
+    """Row-wise Lp distance of a pair (reference: nn/PairwiseDistance.scala)."""
+
+    def __init__(self, norm: int = 2, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.norm = norm
+
+    def forward(self, params, *inputs, **_):
+        a, b = _table(inputs)
+        d = jnp.abs(a - b)
+        if self.norm == 2:
+            return jnp.sqrt(jnp.sum(jnp.square(d), axis=-1))
+        return jnp.sum(d ** self.norm, axis=-1) ** (1.0 / self.norm)
+
+
+class Scale(Sequential):
+    """CMul then CAdd (reference: nn/Scale.scala)."""
+
+    def __init__(self, size, name: Optional[str] = None):
+        super().__init__(CMul(size), CAdd(size), name=name)
+
+
+class MixtureTable(Module):
+    """Mixture-of-experts blend: (gates, expert_outputs_stacked_or_tuple)
+    (reference: nn/MixtureTable.scala)."""
+
+    def forward(self, params, *inputs, **_):
+        gates, experts = _table(inputs)
+        if isinstance(experts, (tuple, list)):
+            experts = jnp.stack(experts, axis=1)  # (B, E, ...)
+        g = gates.reshape(gates.shape + (1,) * (experts.ndim - gates.ndim))
+        return jnp.sum(g * experts, axis=1)

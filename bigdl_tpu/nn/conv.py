@@ -1,0 +1,223 @@
+"""Convolutions (reference: nn/SpatialConvolution.scala and variants).
+
+TPU notes: all convs lower to a single `lax.conv_general_dilated` in NHWC/HWIO
+— XLA tiles it onto the MXU directly. The reference's im2col+gemm strategy
+(nn/SpatialConvolution.scala:613-647, NNPrimitive.im2col*) and MKL-DNN layout
+negotiation (nn/mkldnn/SpatialConvolution.scala) are both compiler work here;
+we never materialize im2col buffers. Grouped conv uses XLA's
+feature_group_count instead of the reference's per-group gemm loop.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.core import init as initializers
+from bigdl_tpu.core.module import Module, ParamSpec
+
+_DN_2D = ("NHWC", "HWIO", "NHWC")
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _same_or_pad(pad_h, pad_w):
+    """BigDL pad semantics: -1 means TF 'SAME' (nn/SpatialConvolution.scala)."""
+    if pad_h == -1 or pad_w == -1:
+        return "SAME"
+    return [(pad_h, pad_h), (pad_w, pad_w)]
+
+
+class SpatialConvolution(Module):
+    """2D conv over NHWC (reference: nn/SpatialConvolution.scala; the
+    reference is NCHW — this framework is channels-last for TPU tiling).
+
+    Args follow the reference: (n_input_plane, n_output_plane, kernel_w,
+    kernel_h, stride_w, stride_h, pad_w, pad_h, n_group). pad=-1 → SAME.
+    """
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kernel_w: int, kernel_h: int, stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = 0, pad_h: int = 0, n_group: int = 1, bias: bool = True,
+                 w_init=initializers.kaiming, b_init=initializers.zeros,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        assert n_input_plane % n_group == 0 and n_output_plane % n_group == 0
+        self.nin, self.nout = n_input_plane, n_output_plane
+        self.kw, self.kh = kernel_w, kernel_h
+        self.sw, self.sh = stride_w, stride_h
+        self.pw, self.ph = pad_w, pad_h
+        self.groups, self.bias = n_group, bias
+        self._w_init, self._b_init = w_init, b_init
+
+    def param_specs(self):
+        fan_in = self.kh * self.kw * self.nin // self.groups
+        specs = {"weight": ParamSpec(
+            (self.kh, self.kw, self.nin // self.groups, self.nout),
+            self._w_init, fan_in=fan_in, fan_out=self.kh * self.kw * self.nout)}
+        if self.bias:
+            specs["bias"] = ParamSpec((self.nout,), self._b_init, fan_in=fan_in)
+        return specs
+
+    def forward(self, params, x, **_):
+        y = lax.conv_general_dilated(
+            x, params["weight"], window_strides=(self.sh, self.sw),
+            padding=_same_or_pad(self.ph, self.pw),
+            dimension_numbers=_DN_2D, feature_group_count=self.groups)
+        if self.bias:
+            y = y + params["bias"]
+        return y
+
+
+class SpatialDilatedConvolution(SpatialConvolution):
+    """Atrous conv (reference: nn/SpatialDilatedConvolution.scala)."""
+
+    def __init__(self, n_input_plane, n_output_plane, kernel_w, kernel_h,
+                 stride_w=1, stride_h=1, pad_w=0, pad_h=0,
+                 dilation_w: int = 1, dilation_h: int = 1, bias: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(n_input_plane, n_output_plane, kernel_w, kernel_h,
+                         stride_w, stride_h, pad_w, pad_h, 1, bias, name=name)
+        self.dw, self.dh = dilation_w, dilation_h
+
+    def forward(self, params, x, **_):
+        y = lax.conv_general_dilated(
+            x, params["weight"], window_strides=(self.sh, self.sw),
+            padding=_same_or_pad(self.ph, self.pw),
+            rhs_dilation=(self.dh, self.dw), dimension_numbers=_DN_2D)
+        if self.bias:
+            y = y + params["bias"]
+        return y
+
+
+class SpatialFullConvolution(Module):
+    """Transposed conv / deconvolution (reference:
+    nn/SpatialFullConvolution.scala) via lhs dilation (fractional stride)."""
+
+    def __init__(self, n_input_plane, n_output_plane, kernel_w, kernel_h,
+                 stride_w=1, stride_h=1, pad_w=0, pad_h=0,
+                 adj_w: int = 0, adj_h: int = 0, bias: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.nin, self.nout = n_input_plane, n_output_plane
+        self.kw, self.kh, self.sw, self.sh = kernel_w, kernel_h, stride_w, stride_h
+        self.pw, self.ph, self.aw, self.ah, self.bias = pad_w, pad_h, adj_w, adj_h, bias
+
+    def param_specs(self):
+        fan_in = self.kh * self.kw * self.nin
+        specs = {"weight": ParamSpec((self.kh, self.kw, self.nin, self.nout),
+                                     initializers.kaiming, fan_in=fan_in)}
+        if self.bias:
+            specs["bias"] = ParamSpec((self.nout,), initializers.zeros)
+        return specs
+
+    def forward(self, params, x, **_):
+        pad_h = (self.kh - 1 - self.ph, self.kh - 1 - self.ph + self.ah)
+        pad_w = (self.kw - 1 - self.pw, self.kw - 1 - self.pw + self.aw)
+        w = jnp.flip(params["weight"], axis=(0, 1))
+        y = lax.conv_general_dilated(
+            x, w, window_strides=(1, 1), padding=[pad_h, pad_w],
+            lhs_dilation=(self.sh, self.sw), dimension_numbers=_DN_2D)
+        if self.bias:
+            y = y + params["bias"]
+        return y
+
+
+class SpatialSeparableConvolution(Module):
+    """Depthwise + pointwise conv (reference:
+    nn/SpatialSeparableConvolution.scala)."""
+
+    def __init__(self, n_input_channel, n_output_channel, depth_multiplier,
+                 kernel_w, kernel_h, stride_w=1, stride_h=1, pad_w=0, pad_h=0,
+                 bias: bool = True, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.nin, self.nout, self.mult = n_input_channel, n_output_channel, depth_multiplier
+        self.kw, self.kh, self.sw, self.sh = kernel_w, kernel_h, stride_w, stride_h
+        self.pw, self.ph, self.bias = pad_w, pad_h, bias
+
+    def param_specs(self):
+        specs = {
+            "depth_weight": ParamSpec((self.kh, self.kw, 1, self.nin * self.mult),
+                                      initializers.kaiming, fan_in=self.kh * self.kw),
+            "point_weight": ParamSpec((1, 1, self.nin * self.mult, self.nout),
+                                      initializers.kaiming,
+                                      fan_in=self.nin * self.mult),
+        }
+        if self.bias:
+            specs["bias"] = ParamSpec((self.nout,), initializers.zeros)
+        return specs
+
+    def forward(self, params, x, **_):
+        y = lax.conv_general_dilated(
+            x, params["depth_weight"], window_strides=(self.sh, self.sw),
+            padding=_same_or_pad(self.ph, self.pw), dimension_numbers=_DN_2D,
+            feature_group_count=self.nin)
+        y = lax.conv_general_dilated(
+            y, params["point_weight"], window_strides=(1, 1), padding="VALID",
+            dimension_numbers=_DN_2D)
+        if self.bias:
+            y = y + params["bias"]
+        return y
+
+
+class TemporalConvolution(Module):
+    """1D conv over (N, T, C) (reference: nn/TemporalConvolution.scala)."""
+
+    def __init__(self, input_frame_size, output_frame_size, kernel_w,
+                 stride_w: int = 1, bias: bool = True, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.nin, self.nout, self.kw, self.sw, self.bias = \
+            input_frame_size, output_frame_size, kernel_w, stride_w, bias
+
+    def param_specs(self):
+        fan_in = self.kw * self.nin
+        specs = {"weight": ParamSpec((self.kw, self.nin, self.nout),
+                                     initializers.xavier, fan_in=fan_in,
+                                     fan_out=self.kw * self.nout)}
+        if self.bias:
+            specs["bias"] = ParamSpec((self.nout,), initializers.zeros)
+        return specs
+
+    def forward(self, params, x, **_):
+        y = lax.conv_general_dilated(
+            x, params["weight"], window_strides=(self.sw,), padding="VALID",
+            dimension_numbers=("NWC", "WIO", "NWC"))
+        if self.bias:
+            y = y + params["bias"]
+        return y
+
+
+class VolumetricConvolution(Module):
+    """3D conv over (N, D, H, W, C) (reference: nn/VolumetricConvolution.scala)."""
+
+    def __init__(self, n_input_plane, n_output_plane, k_t, k_w, k_h,
+                 d_t=1, d_w=1, d_h=1, pad_t=0, pad_w=0, pad_h=0,
+                 bias: bool = True, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.nin, self.nout = n_input_plane, n_output_plane
+        self.k = (k_t, k_h, k_w)
+        self.s = (d_t, d_h, d_w)
+        self.p = (pad_t, pad_h, pad_w)
+        self.bias = bias
+
+    def param_specs(self):
+        fan_in = self.nin * self.k[0] * self.k[1] * self.k[2]
+        specs = {"weight": ParamSpec(self.k + (self.nin, self.nout),
+                                     initializers.kaiming, fan_in=fan_in)}
+        if self.bias:
+            specs["bias"] = ParamSpec((self.nout,), initializers.zeros)
+        return specs
+
+    def forward(self, params, x, **_):
+        y = lax.conv_general_dilated(
+            x, params["weight"], window_strides=self.s,
+            padding=[(p, p) for p in self.p],
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        if self.bias:
+            y = y + params["bias"]
+        return y

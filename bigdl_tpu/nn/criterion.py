@@ -1,0 +1,459 @@
+"""Criterions — loss functions (reference: nn/*Criterion*.scala, ~40 total;
+see SURVEY.md §2.3). Pure `(input, target) -> scalar`; gradients via autodiff
+replace the reference's hand-written `updateGradInput`.
+
+Conventions: class targets are 0-based int arrays (the reference is 1-based
+Torch). `size_average=True` mirrors the reference's sizeAverage default:
+mean over the batch; False → sum."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import Criterion
+
+
+def _reduce(x, size_average: bool):
+    return jnp.mean(x) if size_average else jnp.sum(x)
+
+
+class ClassNLLCriterion(Criterion):
+    """Negative log-likelihood over log-probabilities
+    (reference: nn/ClassNLLCriterion.scala). Input: log-probs (B, C) —
+    pair with LogSoftMax. Optional per-class `weights`. Targets with value
+    `ignore_index` contribute 0 (reference uses paddingValue)."""
+
+    def __init__(self, weights=None, size_average: bool = True,
+                 logits: bool = False, ignore_index: Optional[int] = None):
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+        self.logits = logits
+        self.ignore_index = ignore_index
+
+    def forward(self, input, target):
+        logp = jax.nn.log_softmax(input, axis=-1) if self.logits else input
+        t = target.astype(jnp.int32)
+        safe_t = jnp.where(t < 0, 0, t)
+        nll = -jnp.take_along_axis(logp, safe_t[..., None], axis=-1)[..., 0]
+        w = jnp.ones_like(nll)
+        if self.weights is not None:
+            w = self.weights[safe_t]
+        if self.ignore_index is not None:
+            w = jnp.where(t == self.ignore_index, 0.0, w)
+        total_w = jnp.maximum(jnp.sum(w), 1e-8)
+        return jnp.sum(nll * w) / total_w if self.size_average else jnp.sum(nll * w)
+
+
+class CrossEntropyCriterion(ClassNLLCriterion):
+    """LogSoftMax + ClassNLL fused (reference: nn/CrossEntropyCriterion.scala)."""
+
+    def __init__(self, weights=None, size_average: bool = True,
+                 ignore_index: Optional[int] = None):
+        super().__init__(weights, size_average, logits=True,
+                         ignore_index=ignore_index)
+
+
+class MSECriterion(Criterion):
+    """(reference: nn/MSECriterion.scala)."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        return _reduce(jnp.square(input - target), self.size_average)
+
+
+class AbsCriterion(Criterion):
+    """(reference: nn/AbsCriterion.scala)."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        return _reduce(jnp.abs(input - target), self.size_average)
+
+
+class SmoothL1Criterion(Criterion):
+    """Huber at delta=1 (reference: nn/SmoothL1Criterion.scala)."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        d = jnp.abs(input - target)
+        loss = jnp.where(d < 1.0, 0.5 * jnp.square(d), d - 0.5)
+        return _reduce(loss, self.size_average)
+
+
+class SmoothL1CriterionWithWeights(Criterion):
+    """(reference: nn/SmoothL1CriterionWithWeights.scala — Fast-RCNN bbox loss).
+    Input tuple target: (target, in_weights, out_weights)."""
+
+    def __init__(self, sigma: float = 1.0, num: int = 0):
+        self.sigma2 = sigma * sigma
+        self.num = num
+
+    def forward(self, input, target):
+        t, w_in, w_out = target
+        d = (input - t) * w_in
+        ad = jnp.abs(d)
+        loss = jnp.where(ad < 1.0 / self.sigma2,
+                         0.5 * self.sigma2 * jnp.square(d),
+                         ad - 0.5 / self.sigma2)
+        loss = jnp.sum(loss * w_out)
+        return loss / self.num if self.num > 0 else loss
+
+
+class BCECriterion(Criterion):
+    """Binary cross-entropy on probabilities
+    (reference: nn/BCECriterion.scala); optional per-element weights."""
+
+    def __init__(self, weights=None, size_average: bool = True):
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        eps = 1e-12
+        x = jnp.clip(input, eps, 1 - eps)
+        loss = -(target * jnp.log(x) + (1 - target) * jnp.log(1 - x))
+        if self.weights is not None:
+            loss = loss * self.weights
+        return _reduce(loss, self.size_average)
+
+
+class BCECriterionWithLogits(Criterion):
+    """Numerically-stable sigmoid+BCE."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        loss = jnp.maximum(input, 0) - input * target + jnp.log1p(jnp.exp(-jnp.abs(input)))
+        return _reduce(loss, self.size_average)
+
+
+class MarginCriterion(Criterion):
+    """Hinge / squared-hinge (reference: nn/MarginCriterion.scala).
+    Targets in {-1, 1}."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True,
+                 squared: bool = False):
+        self.margin, self.size_average, self.squared = margin, size_average, squared
+
+    def forward(self, input, target):
+        loss = jnp.maximum(0.0, self.margin - input * target)
+        if self.squared:
+            loss = jnp.square(loss)
+        return _reduce(loss, self.size_average)
+
+
+class MarginRankingCriterion(Criterion):
+    """(reference: nn/MarginRankingCriterion.scala). Input: (x1, x2),
+    target y in {-1,1}."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        self.margin, self.size_average = margin, size_average
+
+    def forward(self, input, target):
+        x1, x2 = input
+        loss = jnp.maximum(0.0, -target * (x1 - x2) + self.margin)
+        return _reduce(loss, self.size_average)
+
+
+class HingeEmbeddingCriterion(Criterion):
+    """(reference: nn/HingeEmbeddingCriterion.scala). Target in {-1,1}."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        self.margin, self.size_average = margin, size_average
+
+    def forward(self, input, target):
+        loss = jnp.where(target == 1, input,
+                         jnp.maximum(0.0, self.margin - input))
+        return _reduce(loss, self.size_average)
+
+
+class CosineEmbeddingCriterion(Criterion):
+    """(reference: nn/CosineEmbeddingCriterion.scala). Input: (x1, x2),
+    target in {-1,1}."""
+
+    def __init__(self, margin: float = 0.0, size_average: bool = True):
+        self.margin, self.size_average = margin, size_average
+
+    def forward(self, input, target):
+        x1, x2 = input
+        cos = jnp.sum(x1 * x2, -1) / jnp.maximum(
+            jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1), 1e-12)
+        loss = jnp.where(target == 1, 1 - cos,
+                         jnp.maximum(0.0, cos - self.margin))
+        return _reduce(loss, self.size_average)
+
+
+class KLDivCriterion(Criterion):
+    """KL(target || input) with log-prob input
+    (reference: nn/DistKLDivCriterion.scala). `size_average` divides by the
+    total element count, matching DistKLDivCriterion.scala:51."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        safe_t = jnp.maximum(target, 1e-12)
+        point = target * (jnp.log(safe_t) - input)
+        point = jnp.where(target > 0, point, 0.0)
+        if self.size_average:
+            return jnp.sum(point) / input.size
+        return jnp.sum(point)
+
+
+DistKLDivCriterion = KLDivCriterion
+
+
+class GaussianCriterion(Criterion):
+    """Negative log-likelihood of a diagonal Gaussian: input (mean, log_var)
+    (reference: nn/GaussianCriterion.scala — VAE)."""
+
+    def forward(self, input, target):
+        mean, log_var = input
+        return jnp.sum(0.5 * (jnp.log(2 * jnp.pi) + log_var)
+                       + 0.5 * jnp.square(target - mean) / jnp.exp(log_var))
+
+
+class KLDCriterion(Criterion):
+    """KL(q||N(0,1)) for VAE latents: input (mean, log_var)
+    (reference: nn/KLDCriterion.scala)."""
+
+    def forward(self, input, target):
+        mean, log_var = input
+        return 0.5 * jnp.sum(jnp.exp(log_var) + jnp.square(mean) - 1 - log_var)
+
+
+class L1Cost(Criterion):
+    """(reference: nn/L1Cost.scala)."""
+
+    def forward(self, input, target=None):
+        return jnp.sum(jnp.abs(input))
+
+
+class SoftMarginCriterion(Criterion):
+    """(reference: nn/SoftMarginCriterion.scala). Target in {-1,1}."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        return _reduce(jnp.log1p(jnp.exp(-input * target)), self.size_average)
+
+
+class MultiLabelMarginCriterion(Criterion):
+    """Multi-label hinge (reference: nn/MultiLabelMarginCriterion.scala).
+    Simplified: target is a multi-hot (B, C) mask."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        pos = jnp.where(target > 0, input, jnp.inf)
+        min_pos = jnp.min(pos, axis=-1, keepdims=True)
+        loss = jnp.maximum(0.0, 1.0 - (min_pos - input)) * (target <= 0)
+        per_sample = jnp.sum(loss, axis=-1) / input.shape[-1]
+        return _reduce(per_sample, self.size_average)
+
+
+class MultiLabelSoftMarginCriterion(Criterion):
+    """(reference: nn/MultiLabelSoftMarginCriterion.scala)."""
+
+    def __init__(self, weights=None, size_average: bool = True):
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        loss = jnp.maximum(input, 0) - input * target + jnp.log1p(jnp.exp(-jnp.abs(input)))
+        if self.weights is not None:
+            loss = loss * self.weights
+        per_sample = jnp.mean(loss, axis=-1)
+        return _reduce(per_sample, self.size_average)
+
+
+class MultiCriterion(Criterion):
+    """Weighted sum of criterions on the same (input, target)
+    (reference: nn/MultiCriterion.scala)."""
+
+    def __init__(self):
+        self.criterions = []
+        self.weights = []
+
+    def add(self, criterion: Criterion, weight: float = 1.0):
+        self.criterions.append(criterion)
+        self.weights.append(weight)
+        return self
+
+    def forward(self, input, target):
+        return sum(w * c.forward(input, target)
+                   for c, w in zip(self.criterions, self.weights))
+
+
+class ParallelCriterion(Criterion):
+    """Weighted sum of criterions applied to zipped (inputs, targets) tuples
+    (reference: nn/ParallelCriterion.scala)."""
+
+    def __init__(self, repeat_target: bool = False):
+        self.criterions = []
+        self.weights = []
+        self.repeat_target = repeat_target
+
+    def add(self, criterion: Criterion, weight: float = 1.0):
+        self.criterions.append(criterion)
+        self.weights.append(weight)
+        return self
+
+    def forward(self, input, target):
+        total = 0.0
+        for i, (c, w) in enumerate(zip(self.criterions, self.weights)):
+            t = target if self.repeat_target else target[i]
+            total = total + w * c.forward(input[i], t)
+        return total
+
+
+class TimeDistributedCriterion(Criterion):
+    """Applies a criterion per step along `dimension`
+    (reference: nn/TimeDistributedCriterion.scala)."""
+
+    def __init__(self, criterion: Criterion, size_average: bool = False,
+                 dimension: int = 1):
+        self.criterion = criterion
+        self.size_average = size_average
+        self.dimension = dimension
+
+    def forward(self, input, target):
+        t_steps = input.shape[self.dimension]
+        total = 0.0
+        for t in range(t_steps):  # unrolled; prefer flattened criterions for long T
+            total = total + self.criterion.forward(
+                jnp.take(input, t, axis=self.dimension),
+                jnp.take(target, t, axis=self.dimension))
+        return total / t_steps if self.size_average else total
+
+
+class TimeDistributedMaskCriterion(Criterion):
+    """Masked per-timestep criterion via padding value
+    (reference: nn/TimeDistributedMaskCriterion.scala). Flattens (B,T,C) and
+    relies on the inner criterion's ignore_index."""
+
+    def __init__(self, criterion: Criterion, padding_value: int = 0):
+        self.criterion = criterion
+        self.criterion.ignore_index = padding_value
+
+    def forward(self, input, target):
+        c = input.shape[-1]
+        return self.criterion.forward(input.reshape(-1, c), target.reshape(-1))
+
+
+class DiceCoefficientCriterion(Criterion):
+    """1 - Dice overlap (reference: nn/DiceCoefficientCriterion.scala)."""
+
+    def __init__(self, size_average: bool = True, epsilon: float = 1.0):
+        self.size_average = size_average
+        self.epsilon = epsilon
+
+    def forward(self, input, target):
+        x = input.reshape(input.shape[0], -1)
+        t = target.reshape(target.shape[0], -1)
+        inter = jnp.sum(x * t, axis=-1)
+        denom = jnp.sum(x, axis=-1) + jnp.sum(t, axis=-1)
+        dice = 1.0 - 2.0 * (inter + self.epsilon) / (denom + 2 * self.epsilon)
+        return _reduce(dice, self.size_average)
+
+
+class MultiMarginCriterion(Criterion):
+    """Multi-class hinge (reference: nn/MultiMarginCriterion.scala).
+    0-based int targets."""
+
+    def __init__(self, p: int = 1, weights=None, margin: float = 1.0,
+                 size_average: bool = True):
+        self.p, self.margin, self.size_average = p, margin, size_average
+        self.weights = None if weights is None else jnp.asarray(weights)
+
+    def forward(self, input, target):
+        t = target.astype(jnp.int32)
+        x_t = jnp.take_along_axis(input, t[:, None], axis=-1)
+        loss = jnp.maximum(0.0, self.margin - x_t + input)
+        if self.p == 2:
+            loss = jnp.square(loss)
+        if self.weights is not None:
+            loss = loss * self.weights[t][:, None]
+        n_cls = input.shape[-1]
+        onehot = jax.nn.one_hot(t, n_cls)
+        per_sample = jnp.sum(loss * (1 - onehot), axis=-1) / n_cls
+        return _reduce(per_sample, self.size_average)
+
+
+class ClassSimplexCriterion(Criterion):
+    """MSE against regular-simplex-embedded targets
+    (reference: nn/ClassSimplexCriterion.scala — same iterative regular
+    simplex construction as Torch)."""
+
+    def __init__(self, n_classes: int):
+        self.n_classes = n_classes
+        self.simplex = jnp.asarray(self._build(n_classes))
+
+    @staticmethod
+    def _build(n):
+        import numpy as np
+        a = np.zeros((n, n - 1), dtype=np.float64)
+        for k in range(n - 1):
+            # a[k][k] makes the vertex unit-norm given the prior coordinates
+            a[k, k] = np.sqrt(1.0 - np.sum(a[k, :k] ** 2))
+            # remaining vertices share the coordinate that keeps pairwise
+            # dot products at -1/(n-1)
+            c = (-1.0 / (n - 1) - np.dot(a[k + 1:, :k], a[k, :k])) / a[k, k]
+            a[k + 1:, k] = c
+        # embed in R^n with a zero last coordinate (reference pads to nClasses)
+        out = np.zeros((n, n), dtype=np.float32)
+        out[:, :n - 1] = a
+        return out
+
+    def forward(self, input, target):
+        t = self.simplex[target.astype(jnp.int32)]
+        return jnp.mean(jnp.square(input - t))
+
+
+class MSEWithL2(Criterion):
+    """MSE + L2 of input (used by autoencoder examples)."""
+
+    def __init__(self, l2: float = 0.0):
+        self.l2 = l2
+
+    def forward(self, input, target):
+        return jnp.mean(jnp.square(input - target)) + self.l2 * jnp.sum(jnp.square(input))
+
+
+class PGCriterion(Criterion):
+    """Policy-gradient criterion (reference: nn/PGCriterion.scala):
+    -sum(log(prob_taken) * reward). Input log-probs, target (actions, rewards)."""
+
+    def forward(self, input, target):
+        actions, rewards = target
+        logp = jnp.take_along_axis(input, actions.astype(jnp.int32)[..., None],
+                                   axis=-1)[..., 0]
+        return -jnp.sum(logp * rewards)
+
+
+class TransformerCriterion(Criterion):
+    """Applies transform modules to input/target before an inner criterion
+    (reference: nn/TransformerCriterion.scala). Transforms are pure fns."""
+
+    def __init__(self, criterion: Criterion, input_transform=None,
+                 target_transform=None):
+        self.criterion = criterion
+        self.input_transform = input_transform
+        self.target_transform = target_transform
+
+    def forward(self, input, target):
+        if self.input_transform is not None:
+            input = self.input_transform(input)
+        if self.target_transform is not None:
+            target = self.target_transform(target)
+        return self.criterion.forward(input, target)

@@ -1,0 +1,118 @@
+"""Stochastic regularization layers (reference: nn/Dropout.scala,
+nn/GaussianDropout.scala, nn/GaussianNoise.scala, nn/SpatialDropout*.scala).
+
+RNG is threaded explicitly (functional) — each layer folds the step rng with
+its tree path, so replicated data-parallel replicas can derive per-shard keys
+deterministically (the reference clones layers per thread instead). Calling a
+stochastic layer with training=True but no rng raises — silently skipping
+regularization would be an untraceable bug."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import Module
+
+
+def _require_rng(rng, layer):
+    if rng is None:
+        raise ValueError(
+            f"{layer.name} needs an rng in training mode: pass rng= to apply()")
+    return rng
+
+
+class Dropout(Module):
+    """Inverted dropout: zeroes with prob `init_p`, scales by 1/(1-p) in
+    training (reference: nn/Dropout.scala — same scale-in-train default)."""
+
+    def __init__(self, init_p: float = 0.5, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.p = init_p
+
+    def _apply(self, params, state, x, training=False, rng=None):
+        if not training or self.p == 0.0:
+            return x, state
+        rng = _require_rng(rng, self)
+        keep = jax.random.bernoulli(rng, 1.0 - self.p, x.shape)
+        return jnp.where(keep, x / (1.0 - self.p), 0.0), state
+
+
+class GaussianDropout(Module):
+    """Multiplicative N(1, p/(1-p)) noise (reference: nn/GaussianDropout.scala)."""
+
+    def __init__(self, rate: float, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.rate = rate
+
+    def _apply(self, params, state, x, training=False, rng=None):
+        if not training:
+            return x, state
+        rng = _require_rng(rng, self)
+        stddev = (self.rate / (1.0 - self.rate)) ** 0.5
+        noise = 1.0 + stddev * jax.random.normal(rng, x.shape, x.dtype)
+        return x * noise, state
+
+
+class GaussianNoise(Module):
+    """Additive N(0, stddev) noise (reference: nn/GaussianNoise.scala)."""
+
+    def __init__(self, stddev: float, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.stddev = stddev
+
+    def _apply(self, params, state, x, training=False, rng=None):
+        if not training:
+            return x, state
+        rng = _require_rng(rng, self)
+        return x + self.stddev * jax.random.normal(rng, x.shape, x.dtype), state
+
+
+class SpatialDropout2D(Module):
+    """Drops whole channels of NHWC maps (reference: nn/SpatialDropout2D.scala)."""
+
+    def __init__(self, init_p: float = 0.5, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.p = init_p
+
+    def _apply(self, params, state, x, training=False, rng=None):
+        if not training or self.p == 0.0:
+            return x, state
+        rng = _require_rng(rng, self)
+        mask_shape = (x.shape[0], 1, 1, x.shape[-1])
+        keep = jax.random.bernoulli(rng, 1.0 - self.p, mask_shape)
+        return jnp.where(keep, x / (1.0 - self.p), 0.0), state
+
+
+class SpatialDropout1D(Module):
+    """Drops whole channels of (N, T, C) (reference: nn/SpatialDropout1D.scala)."""
+
+    def __init__(self, init_p: float = 0.5, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.p = init_p
+
+    def _apply(self, params, state, x, training=False, rng=None):
+        if not training or self.p == 0.0:
+            return x, state
+        rng = _require_rng(rng, self)
+        mask_shape = (x.shape[0], 1, x.shape[-1])
+        keep = jax.random.bernoulli(rng, 1.0 - self.p, mask_shape)
+        return jnp.where(keep, x / (1.0 - self.p), 0.0), state
+
+
+class SpatialDropout3D(Module):
+    """Drops whole channels of (N, D, H, W, C) (reference: nn/SpatialDropout3D.scala)."""
+
+    def __init__(self, init_p: float = 0.5, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.p = init_p
+
+    def _apply(self, params, state, x, training=False, rng=None):
+        if not training or self.p == 0.0:
+            return x, state
+        rng = _require_rng(rng, self)
+        mask_shape = (x.shape[0], 1, 1, 1, x.shape[-1])
+        keep = jax.random.bernoulli(rng, 1.0 - self.p, mask_shape)
+        return jnp.where(keep, x / (1.0 - self.p), 0.0), state

@@ -1,0 +1,60 @@
+"""Embedding layers (reference: nn/LookupTable.scala,
+nn/LookupTableSparse.scala).
+
+TPU notes: a lookup is `jnp.take` — XLA lowers it to a dynamic-gather that is
+sharding-aware (with the table sharded over a 'tp' mesh axis the gather
+becomes an all-gather-free distributed lookup). The reference's max-norm
+renorm-on-forward is implemented as a pure renorm of the used rows."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from bigdl_tpu.core import init as initializers
+from bigdl_tpu.core.module import Module, ParamSpec
+
+
+class LookupTable(Module):
+    """Index → row lookup (reference: nn/LookupTable.scala).
+
+    Indices are 0-based (the reference is 1-based Torch convention).
+    `padding_value` marks an index whose embedding is pinned to zeros.
+    """
+
+    def __init__(self, n_index: int, n_output: int,
+                 padding_value: Optional[int] = None,
+                 max_norm: Optional[float] = None,
+                 norm_type: float = 2.0,
+                 w_init=initializers.random_normal(),
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.n_index, self.n_output = n_index, n_output
+        self.padding_value, self.max_norm, self.norm_type = \
+            padding_value, max_norm, norm_type
+        self._w_init = w_init
+
+    def param_specs(self):
+        return {"weight": ParamSpec((self.n_index, self.n_output),
+                                    self._w_init, fan_in=self.n_index,
+                                    fan_out=self.n_output)}
+
+    def forward(self, params, indices, **_):
+        w = params["weight"]
+        if self.max_norm is not None:
+            if self.norm_type == 2.0:
+                norms = jnp.sqrt(jnp.sum(jnp.square(w), axis=-1, keepdims=True))
+            else:
+                norms = jnp.sum(jnp.abs(w) ** self.norm_type, axis=-1,
+                                keepdims=True) ** (1.0 / self.norm_type)
+            w = w * jnp.minimum(1.0, self.max_norm / jnp.maximum(norms, 1e-7))
+        out = jnp.take(w, indices.astype(jnp.int32), axis=0)
+        if self.padding_value is not None:
+            mask = (indices != self.padding_value)[..., None]
+            out = jnp.where(mask, out, 0.0)
+        return out
+
+
+class Embedding(LookupTable):
+    """Keras-style alias."""

@@ -1,0 +1,120 @@
+"""Dense layers (reference: nn/Linear.scala, nn/Bilinear.scala).
+
+TPU notes: weights are stored (in_features, out_features) so the forward is
+``x @ W`` — a single MXU `dot_general` with no transpose (the reference stores
+Torch-style (out, in) and calls MKL gemm with transpose flags,
+nn/Linear.scala via TensorNumeric.gemm). Keep matmuls large and batched.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from bigdl_tpu.core import init as initializers
+from bigdl_tpu.core.module import Module, ParamSpec
+
+
+class Linear(Module):
+    """y = x @ W + b  (reference: nn/Linear.scala)."""
+
+    def __init__(self, in_features: int, out_features: int, bias: bool = True,
+                 w_init=initializers.xavier, b_init=initializers.zeros,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.in_features, self.out_features, self.bias = in_features, out_features, bias
+        self._w_init, self._b_init = w_init, b_init
+
+    def param_specs(self):
+        specs = {"weight": ParamSpec((self.in_features, self.out_features),
+                                     self._w_init, fan_in=self.in_features,
+                                     fan_out=self.out_features)}
+        if self.bias:
+            specs["bias"] = ParamSpec((self.out_features,), self._b_init,
+                                      fan_in=self.in_features, fan_out=self.out_features)
+        return specs
+
+    def forward(self, params, x, **_):
+        y = x @ params["weight"]
+        if self.bias:
+            y = y + params["bias"]
+        return y
+
+
+class Bilinear(Module):
+    """y_k = x1 @ W_k @ x2 + b_k (reference: nn/Bilinear.scala)."""
+
+    def __init__(self, in1: int, in2: int, out: int, bias: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.in1, self.in2, self.out, self.bias = in1, in2, out, bias
+
+    def param_specs(self):
+        specs = {"weight": ParamSpec((self.out, self.in1, self.in2),
+                                     initializers.xavier, fan_in=self.in1 * self.in2,
+                                     fan_out=self.out)}
+        if self.bias:
+            specs["bias"] = ParamSpec((self.out,), initializers.zeros)
+        return specs
+
+    def forward(self, params, inputs, *rest, **_):
+        x1, x2 = (inputs, rest[0]) if rest else inputs
+        y = jnp.einsum("bi,oij,bj->bo", x1, params["weight"], x2)
+        if self.bias:
+            y = y + params["bias"]
+        return y
+
+
+class CMul(Module):
+    """Learned elementwise scale, broadcast over `shape`
+    (reference: nn/CMul.scala)."""
+
+    def __init__(self, shape, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.shape = tuple(shape)
+
+    def param_specs(self):
+        return {"weight": ParamSpec(self.shape, initializers.ones)}
+
+    def forward(self, params, x, **_):
+        return x * params["weight"]
+
+
+class CAdd(Module):
+    """Learned elementwise bias, broadcast over `shape`
+    (reference: nn/CAdd.scala)."""
+
+    def __init__(self, shape, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.shape = tuple(shape)
+
+    def param_specs(self):
+        return {"bias": ParamSpec(self.shape, initializers.zeros)}
+
+    def forward(self, params, x, **_):
+        return x + params["bias"]
+
+
+class Add(Module):
+    """Learned per-feature bias over the last dim (reference: nn/Add.scala)."""
+
+    def __init__(self, size: int, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.size = size
+
+    def param_specs(self):
+        return {"bias": ParamSpec((self.size,), initializers.zeros)}
+
+    def forward(self, params, x, **_):
+        return x + params["bias"]
+
+
+class Mul(Module):
+    """Single learned scalar gain (reference: nn/Mul.scala)."""
+
+    def param_specs(self):
+        return {"weight": ParamSpec((1,), initializers.random_uniform())}
+
+    def forward(self, params, x, **_):
+        return x * params["weight"][0]

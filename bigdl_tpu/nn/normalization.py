@@ -1,0 +1,159 @@
+"""Normalization layers (reference: nn/BatchNormalization.scala,
+nn/SpatialBatchNormalization.scala, nn/LayerNormalization.scala,
+nn/Normalize.scala, nn/SpatialCrossMapLRN.scala).
+
+TPU notes: batch-norm statistics are plain `jnp.mean/var` reductions that XLA
+fuses with the surrounding conv; running stats live in the module `state`
+pytree (the framework's analogue of the reference's runningMean/runningVar
+tensors). Under data parallelism the mean/var become cross-replica
+automatically when the batch axis is sharded (XLA inserts the psum), matching
+what the reference could never do across Spark workers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from bigdl_tpu.core import init as initializers
+from bigdl_tpu.core.module import Module, ParamSpec, StateSpec
+
+
+class BatchNormalization(Module):
+    """Normalizes over all axes except the last (channel) axis.
+    Works for (N,C) and (N,H,W,C). `momentum` follows the reference
+    (nn/BatchNormalization.scala): new = (1-m)*old + m*batch.
+    """
+
+    def __init__(self, n_output: int, eps: float = 1e-5, momentum: float = 0.1,
+                 affine: bool = True, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.n_output, self.eps, self.momentum, self.affine = \
+            n_output, eps, momentum, affine
+
+    def param_specs(self):
+        if not self.affine:
+            return {}
+        return {"weight": ParamSpec((self.n_output,), initializers.ones),
+                "bias": ParamSpec((self.n_output,), initializers.zeros)}
+
+    def state_specs(self):
+        return {"running_mean": StateSpec((self.n_output,), initializers.zeros),
+                "running_var": StateSpec((self.n_output,), initializers.ones)}
+
+    def _apply(self, params, state, x, training=False, rng=None):
+        axes = tuple(range(x.ndim - 1))
+        if training:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            m = self.momentum
+            n = x.size // x.shape[-1]
+            unbiased = var * n / max(1, n - 1)
+            new_state = {
+                "running_mean": (1 - m) * state["running_mean"] + m * mean,
+                "running_var": (1 - m) * state["running_var"] + m * unbiased,
+            }
+        else:
+            mean, var = state["running_mean"], state["running_var"]
+            new_state = state
+        inv = jnp.reciprocal(jnp.sqrt(var + self.eps))
+        y = (x - mean) * inv
+        if self.affine:
+            y = y * params["weight"] + params["bias"]
+        return y, new_state
+
+
+class SpatialBatchNormalization(BatchNormalization):
+    """BN over NHWC (reference: nn/SpatialBatchNormalization.scala)."""
+
+
+class LayerNormalization(Module):
+    """LayerNorm over the last axis (reference: nn/LayerNormalization.scala)."""
+
+    def __init__(self, hidden_size: int, eps: float = 1e-6,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.hidden_size, self.eps = hidden_size, eps
+
+    def param_specs(self):
+        return {"weight": ParamSpec((self.hidden_size,), initializers.ones),
+                "bias": ParamSpec((self.hidden_size,), initializers.zeros)}
+
+    def forward(self, params, x, **_):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+        y = (x - mean) * jnp.reciprocal(jnp.sqrt(var + self.eps))
+        return y * params["weight"] + params["bias"]
+
+
+class RMSNorm(Module):
+    """RMS normalization (no reference analogue; standard for modern LMs —
+    included because the flagship Transformer uses it as an option)."""
+
+    def __init__(self, hidden_size: int, eps: float = 1e-6,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.hidden_size, self.eps = hidden_size, eps
+
+    def param_specs(self):
+        return {"weight": ParamSpec((self.hidden_size,), initializers.ones)}
+
+    def forward(self, params, x, **_):
+        var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+        return x * jnp.reciprocal(jnp.sqrt(var + self.eps)) * params["weight"]
+
+
+class Normalize(Module):
+    """Lp-normalize over the last axis (reference: nn/Normalize.scala)."""
+
+    def __init__(self, p: float = 2.0, eps: float = 1e-10,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.p, self.eps = p, eps
+
+    def forward(self, params, x, **_):
+        if self.p == 2.0:
+            norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+        else:
+            norm = jnp.sum(jnp.abs(x) ** self.p, axis=-1, keepdims=True) ** (1 / self.p)
+        return x / jnp.maximum(norm, self.eps)
+
+
+class NormalizeScale(Module):
+    """Normalize + learned per-channel scale (reference:
+    nn/NormalizeScale.scala, used by SSD)."""
+
+    def __init__(self, p: float, scale: float, size: Sequence[int],
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.p, self.scale, self.size = p, scale, tuple(size)
+
+    def param_specs(self):
+        return {"weight": ParamSpec(self.size, initializers.const(self.scale))}
+
+    def forward(self, params, x, **_):
+        norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+        return x / jnp.maximum(norm, 1e-10) * params["weight"]
+
+
+class SpatialCrossMapLRN(Module):
+    """Local response normalization across channels
+    (reference: nn/SpatialCrossMapLRN.scala). NHWC."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75,
+                 k: float = 1.0, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+
+    def forward(self, params, x, **_):
+        sq = jnp.square(x)
+        half = self.size // 2
+        pad = [(0, 0)] * (x.ndim - 1) + [(half, self.size - half - 1)]
+        sq = jnp.pad(sq, pad)
+        win = jnp.cumsum(sq, axis=-1)
+        win = jnp.concatenate(
+            [win[..., self.size - 1:self.size],
+             win[..., self.size:] - win[..., :-self.size]], axis=-1)
+        denom = (self.k + self.alpha / self.size * win) ** self.beta
+        return x / denom

@@ -1,0 +1,170 @@
+"""Pooling layers (reference: nn/SpatialMaxPooling.scala,
+nn/SpatialAveragePooling.scala, nn/TemporalMaxPooling.scala,
+nn/VolumetricMaxPooling.scala, nn/SpatialAdaptive*.scala).
+
+All lower to `lax.reduce_window` — XLA's native windowed reduction; no
+explicit index bookkeeping for the backward pass (autodiff of reduce_window
+gives the max-unpooling gradient the reference computes by hand).
+Layout is NHWC.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.core.module import Module
+
+
+def _pad2d(ph, pw):
+    if ph == -1 or pw == -1:
+        return "SAME"
+    return [(0, 0), (ph, ph), (pw, pw), (0, 0)]
+
+
+class SpatialMaxPooling(Module):
+    """(reference: nn/SpatialMaxPooling.scala). `ceil_mode` mirrors the
+    reference's `.ceil()` toggle."""
+
+    def __init__(self, kw: int, kh: int, dw: Optional[int] = None,
+                 dh: Optional[int] = None, pad_w: int = 0, pad_h: int = 0,
+                 ceil_mode: bool = False, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.kw, self.kh = kw, kh
+        self.dw, self.dh = dw or kw, dh or kh
+        self.pw, self.ph, self.ceil_mode = pad_w, pad_h, ceil_mode
+
+    def _padding(self, x):
+        if self.pw == -1 or self.ph == -1:
+            return "SAME"
+        ph, pw = self.ph, self.pw
+        if self.ceil_mode:
+            h, w = x.shape[1], x.shape[2]
+            extra_h = _ceil_extra(h, self.kh, self.dh, ph)
+            extra_w = _ceil_extra(w, self.kw, self.dw, pw)
+            return [(0, 0), (ph, ph + extra_h), (pw, pw + extra_w), (0, 0)]
+        return [(0, 0), (ph, ph), (pw, pw), (0, 0)]
+
+    def forward(self, params, x, **_):
+        return lax.reduce_window(
+            x, -jnp.inf, lax.max, (1, self.kh, self.kw, 1),
+            (1, self.dh, self.dw, 1), self._padding(x))
+
+
+def _ceil_extra(size, k, d, p):
+    """Extra one-sided pad so output size matches ceil division."""
+    import math
+    out_ceil = math.ceil((size + 2 * p - k) / d) + 1
+    needed = (out_ceil - 1) * d + k - 2 * p
+    return max(0, needed - size)
+
+
+class SpatialAveragePooling(Module):
+    """(reference: nn/SpatialAveragePooling.scala). `count_include_pad`
+    mirrors the reference's divisor semantics."""
+
+    def __init__(self, kw: int, kh: int, dw: Optional[int] = None,
+                 dh: Optional[int] = None, pad_w: int = 0, pad_h: int = 0,
+                 ceil_mode: bool = False, count_include_pad: bool = True,
+                 global_pooling: bool = False, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.kw, self.kh = kw, kh
+        self.dw, self.dh = dw or kw, dh or kh
+        self.pw, self.ph = pad_w, pad_h
+        self.ceil_mode, self.include_pad = ceil_mode, count_include_pad
+        self.global_pooling = global_pooling
+
+    def forward(self, params, x, **_):
+        if self.global_pooling:
+            return jnp.mean(x, axis=(1, 2), keepdims=True)
+        kh, kw, dh, dw = self.kh, self.kw, self.dh, self.dw
+        window = (1, kh, kw, 1)
+        strides = (1, dh, dw, 1)
+        if self.ph == -1 or self.pw == -1:
+            summed = lax.reduce_window(x, 0.0, lax.add, window, strides, "SAME")
+            counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window,
+                                       strides, "SAME")
+            return summed / jnp.maximum(counts, 1.0)
+        ph, pw = self.ph, self.pw
+        eh = _ceil_extra(x.shape[1], kh, dh, ph) if self.ceil_mode else 0
+        ew = _ceil_extra(x.shape[2], kw, dw, pw) if self.ceil_mode else 0
+        pad = [(0, 0), (ph, ph + eh), (pw, pw + ew), (0, 0)]
+        summed = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+        # Divisor (torch/reference semantics): explicit padding counts only
+        # when count_include_pad; ceil-mode overflow cells never count.
+        ones = jnp.ones_like(x)
+        if self.include_pad:
+            ones = jnp.pad(ones, [(0, 0), (ph, ph), (pw, pw), (0, 0)],
+                           constant_values=1.0)
+            cpad = [(0, 0), (0, eh), (0, ew), (0, 0)]
+        else:
+            cpad = pad
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, cpad)
+        return summed / jnp.maximum(counts, 1.0)
+
+
+class TemporalMaxPooling(Module):
+    """1D max pool over (N, T, C) (reference: nn/TemporalMaxPooling.scala)."""
+
+    def __init__(self, k_w: int, d_w: Optional[int] = None,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.kw, self.dw = k_w, d_w or k_w
+
+    def forward(self, params, x, **_):
+        return lax.reduce_window(x, -jnp.inf, lax.max, (1, self.kw, 1),
+                                 (1, self.dw, 1), "VALID")
+
+
+class VolumetricMaxPooling(Module):
+    """3D max pool over (N, D, H, W, C) (reference:
+    nn/VolumetricMaxPooling.scala)."""
+
+    def __init__(self, k_t, k_w, k_h, d_t=None, d_w=None, d_h=None,
+                 pad_t=0, pad_w=0, pad_h=0, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.k = (k_t, k_h, k_w)
+        self.s = (d_t or k_t, d_h or k_h, d_w or k_w)
+        self.p = (pad_t, pad_h, pad_w)
+
+    def forward(self, params, x, **_):
+        pad = [(0, 0)] + [(p, p) for p in self.p] + [(0, 0)]
+        return lax.reduce_window(x, -jnp.inf, lax.max, (1,) + self.k + (1,),
+                                 (1,) + self.s + (1,), pad)
+
+
+class SpatialAdaptiveMaxPooling(Module):
+    """Output-size-targeted max pool (reference:
+    nn/SpatialAdaptiveMaxPooling.scala). Torch adaptive windows:
+    row i covers [floor(i*h/out), ceil((i+1)*h/out)). Shapes are static so
+    the (small) output grid is unrolled at trace time."""
+
+    def __init__(self, out_h: int, out_w: int, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.out_h, self.out_w = out_h, out_w
+
+    def forward(self, params, x, **_):
+        h, w = x.shape[1], x.shape[2]
+        if h % self.out_h == 0 and w % self.out_w == 0:
+            kh, kw = h // self.out_h, w // self.out_w
+            return lax.reduce_window(x, -jnp.inf, lax.max, (1, kh, kw, 1),
+                                     (1, kh, kw, 1), "VALID")
+        import math
+        rows = []
+        for i in range(self.out_h):
+            h0, h1 = (i * h) // self.out_h, math.ceil((i + 1) * h / self.out_h)
+            cols = []
+            for j in range(self.out_w):
+                w0, w1 = (j * w) // self.out_w, math.ceil((j + 1) * w / self.out_w)
+                cols.append(jnp.max(x[:, h0:h1, w0:w1, :], axis=(1, 2)))
+            rows.append(jnp.stack(cols, axis=1))
+        return jnp.stack(rows, axis=1)
+
+
+class GlobalAveragePooling2D(Module):
+    """Keras-style global average pool NHWC→NC."""
+
+    def forward(self, params, x, **_):
+        return jnp.mean(x, axis=(1, 2))

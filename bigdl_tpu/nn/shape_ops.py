@@ -1,0 +1,333 @@
+"""Shape / indexing / structural layers (reference: nn/Reshape.scala,
+nn/View.scala, nn/Squeeze.scala, nn/Unsqueeze.scala, nn/Transpose.scala,
+nn/Select.scala, nn/Narrow.scala, nn/Padding.scala, nn/JoinTable.scala,
+nn/SplitTable.scala, nn/Replicate.scala, nn/Identity.scala, nn/Echo.scala,
+nn/Index.scala, nn/Masking.scala, nn/InferReshape.scala).
+
+All axes are 0-based (the reference uses 1-based Torch dims); negative axes
+follow numpy convention. These are metadata-only ops for XLA — free at
+runtime after fusion."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import Module
+
+
+class Identity(Module):
+    def forward(self, params, *inputs, **_):
+        return inputs[0] if len(inputs) == 1 else tuple(inputs)
+
+
+class Echo(Module):
+    """Prints shape/dtype at trace time then passes through
+    (reference: nn/Echo.scala)."""
+
+    def forward(self, params, x, **_):
+        print(f"[Echo {self.name}] shape={x.shape} dtype={x.dtype}")
+        return x
+
+
+class Reshape(Module):
+    """Reshape non-batch dims to `size`; batch dim preserved when
+    `batch_mode` (reference: nn/Reshape.scala)."""
+
+    def __init__(self, size: Sequence[int], batch_mode: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.size, self.batch_mode = tuple(size), batch_mode
+
+    def forward(self, params, x, **_):
+        if self.batch_mode:
+            return jnp.reshape(x, (x.shape[0],) + self.size)
+        return jnp.reshape(x, self.size)
+
+
+class View(Reshape):
+    """(reference: nn/View.scala) — alias of Reshape with batch preserved;
+    size entries may contain -1."""
+
+
+class Flatten(Module):
+    """Flatten all non-batch dims."""
+
+    def forward(self, params, x, **_):
+        return jnp.reshape(x, (x.shape[0], -1))
+
+
+class InferReshape(Module):
+    """Reshape where 0 copies the input dim and -1 infers
+    (reference: nn/InferReshape.scala)."""
+
+    def __init__(self, size: Sequence[int], batch_mode: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.size, self.batch_mode = tuple(size), batch_mode
+
+    def forward(self, params, x, **_):
+        in_shape = x.shape[1:] if self.batch_mode else x.shape
+        out = [in_shape[i] if s == 0 else s for i, s in enumerate(self.size)]
+        if self.batch_mode:
+            return jnp.reshape(x, (x.shape[0],) + tuple(out))
+        return jnp.reshape(x, tuple(out))
+
+
+class Squeeze(Module):
+    def __init__(self, axis: Optional[int] = None, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.axis = axis
+
+    def forward(self, params, x, **_):
+        return jnp.squeeze(x, self.axis)
+
+
+class Unsqueeze(Module):
+    def __init__(self, axis: int, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.axis = axis
+
+    def forward(self, params, x, **_):
+        return jnp.expand_dims(x, self.axis)
+
+
+class Transpose(Module):
+    """Swap listed axis pairs in order (reference: nn/Transpose.scala)."""
+
+    def __init__(self, permutations: Sequence[Tuple[int, int]],
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.permutations = list(permutations)
+
+    def forward(self, params, x, **_):
+        perm = list(range(x.ndim))
+        for a, b in self.permutations:
+            perm[a], perm[b] = perm[b], perm[a]
+        return jnp.transpose(x, perm)
+
+
+class Permute(Module):
+    """Full permutation of non-batch dims (keras-style)."""
+
+    def __init__(self, dims: Sequence[int], name: Optional[str] = None):
+        super().__init__(name=name)
+        self.dims = tuple(dims)
+
+    def forward(self, params, x, **_):
+        return jnp.transpose(x, (0,) + tuple(d + 1 for d in self.dims))
+
+
+class Select(Module):
+    """Select index along axis, removing it (reference: nn/Select.scala)."""
+
+    def __init__(self, axis: int, index: int, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.axis, self.index = axis, index
+
+    def forward(self, params, x, **_):
+        return jnp.take(x, self.index, axis=self.axis)
+
+
+class Narrow(Module):
+    """Slice `length` elements from `offset` along axis
+    (reference: nn/Narrow.scala). length=-1 → to the end."""
+
+    def __init__(self, axis: int, offset: int, length: int = 1,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.axis, self.offset, self.length = axis, offset, length
+
+    def forward(self, params, x, **_):
+        n = x.shape[self.axis] - self.offset if self.length == -1 else self.length
+        idx = [slice(None)] * x.ndim
+        idx[self.axis] = slice(self.offset, self.offset + n)
+        return x[tuple(idx)]
+
+
+class Padding(Module):
+    """Pad `pad` entries (negative → before, positive → after) along axis
+    with `value` (reference: nn/Padding.scala)."""
+
+    def __init__(self, axis: int, pad: int, value: float = 0.0,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.axis, self.pad, self.value = axis, pad, value
+
+    def forward(self, params, x, **_):
+        widths = [(0, 0)] * x.ndim
+        widths[self.axis] = (-self.pad, 0) if self.pad < 0 else (0, self.pad)
+        return jnp.pad(x, widths, constant_values=self.value)
+
+
+class SpatialZeroPadding(Module):
+    """(reference: nn/SpatialZeroPadding.scala). NHWC."""
+
+    def __init__(self, pad_left: int, pad_right: int = None,
+                 pad_top: int = None, pad_bottom: int = None,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.pl = pad_left
+        self.pr = pad_left if pad_right is None else pad_right
+        self.pt = pad_left if pad_top is None else pad_top
+        self.pb = pad_left if pad_bottom is None else pad_bottom
+
+    def forward(self, params, x, **_):
+        return jnp.pad(x, [(0, 0), (self.pt, self.pb), (self.pl, self.pr), (0, 0)])
+
+
+class JoinTable(Module):
+    """Concatenate a tuple of tensors along axis (reference: nn/JoinTable.scala)."""
+
+    def __init__(self, axis: int, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.axis = axis
+
+    def forward(self, params, *inputs, **_):
+        xs = inputs[0] if len(inputs) == 1 and isinstance(inputs[0], (tuple, list)) else inputs
+        return jnp.concatenate(xs, axis=self.axis)
+
+
+class SplitTable(Module):
+    """Split along axis into a tuple (reference: nn/SplitTable.scala)."""
+
+    def __init__(self, axis: int, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.axis = axis
+
+    def forward(self, params, x, **_):
+        parts = jnp.split(x, x.shape[self.axis], axis=self.axis)
+        return tuple(jnp.squeeze(p, self.axis) for p in parts)
+
+
+class SelectTable(Module):
+    """Pick the i-th element of a tuple input (reference: nn/SelectTable.scala)."""
+
+    def __init__(self, index: int, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.index = index
+
+    def forward(self, params, *inputs, **_):
+        xs = inputs[0] if len(inputs) == 1 and isinstance(inputs[0], (tuple, list)) else inputs
+        return xs[self.index]
+
+
+class FlattenTable(Module):
+    """Flatten nested tuples (reference: nn/FlattenTable.scala)."""
+
+    def forward(self, params, *inputs, **_):
+        out = []
+
+        def rec(t):
+            if isinstance(t, (tuple, list)):
+                for e in t:
+                    rec(e)
+            else:
+                out.append(t)
+        rec(inputs[0] if len(inputs) == 1 else inputs)
+        return tuple(out)
+
+
+class Replicate(Module):
+    """Insert new axis of size n (reference: nn/Replicate.scala)."""
+
+    def __init__(self, n_features: int, axis: int = 0, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.n, self.axis = n_features, axis
+
+    def forward(self, params, x, **_):
+        return jnp.repeat(jnp.expand_dims(x, self.axis), self.n, axis=self.axis)
+
+
+class Masking(Module):
+    """Zero timesteps equal to mask_value (reference: nn/Masking.scala)."""
+
+    def __init__(self, mask_value: float = 0.0, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.mask_value = mask_value
+
+    def forward(self, params, x, **_):
+        keep = jnp.any(x != self.mask_value, axis=-1, keepdims=True)
+        return jnp.where(keep, x, 0.0)
+
+
+class Index(Module):
+    """Gather rows of tensor t by index tensor along axis
+    (reference: nn/Index.scala). Input: (tensor, indices)."""
+
+    def __init__(self, axis: int, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.axis = axis
+
+    def forward(self, params, *inputs, **_):
+        t, idx = inputs[0] if len(inputs) == 1 else inputs
+        return jnp.take(t, idx.astype(jnp.int32), axis=self.axis)
+
+
+class Gather(Module):
+    """TF-style gather (reference: nn/ops/Gather.scala)."""
+
+    def __init__(self, axis: int = 0, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.axis = axis
+
+    def forward(self, params, *inputs, **_):
+        t, idx = inputs[0] if len(inputs) == 1 else inputs
+        return jnp.take(t, idx.astype(jnp.int32), axis=self.axis)
+
+
+class Contiguous(Identity):
+    """No-op under XLA (reference: nn/Contiguous.scala)."""
+
+
+class UpSampling2D(Module):
+    """Nearest-neighbor upsampling NHWC (reference: nn/UpSampling2D.scala)."""
+
+    def __init__(self, size: Tuple[int, int] = (2, 2), name: Optional[str] = None):
+        super().__init__(name=name)
+        self.size = tuple(size)
+
+    def forward(self, params, x, **_):
+        y = jnp.repeat(x, self.size[0], axis=1)
+        return jnp.repeat(y, self.size[1], axis=2)
+
+
+class UpSampling1D(Module):
+    """(reference: nn/UpSampling1D.scala)."""
+
+    def __init__(self, length: int = 2, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.length = length
+
+    def forward(self, params, x, **_):
+        return jnp.repeat(x, self.length, axis=1)
+
+
+class UpSampling3D(Module):
+    """(reference: nn/UpSampling3D.scala)."""
+
+    def __init__(self, size: Tuple[int, int, int] = (2, 2, 2),
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.size = tuple(size)
+
+    def forward(self, params, x, **_):
+        y = jnp.repeat(x, self.size[0], axis=1)
+        y = jnp.repeat(y, self.size[1], axis=2)
+        return jnp.repeat(y, self.size[2], axis=3)
+
+
+class ResizeBilinear(Module):
+    """Bilinear resize NHWC (reference: nn/ResizeBilinear.scala) via
+    jax.image.resize."""
+
+    def __init__(self, out_height: int, out_width: int,
+                 align_corners: bool = False, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.out_h, self.out_w, self.align = out_height, out_width, align_corners
+
+    def forward(self, params, x, **_):
+        import jax.image
+        return jax.image.resize(
+            x, (x.shape[0], self.out_h, self.out_w, x.shape[3]), "bilinear")

@@ -1,0 +1,25 @@
+"""Test config: run everything on a virtual 8-device CPU mesh so distributed
+code paths (sharding, collectives) are exercised without TPU hardware — the
+analogue of the reference's fake-multi-node trick (Engine.init(nodeNumber=4)
+with local[1] Spark, test/.../optim/DistriOptimizerSpec.scala:46)."""
+
+import os
+
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(0)
+
+
+@pytest.fixture
+def np_rng():
+    return np.random.RandomState(0)

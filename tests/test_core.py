@@ -1,0 +1,109 @@
+"""Core module system tests (analogue of reference container/graph specs:
+test/.../nn/SequentialSpec, GraphSpec)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.module import count_params, flatten_params
+
+
+def test_linear_shapes(rng):
+    m = nn.Linear(4, 3)
+    params, state = m.init(rng)
+    assert params["weight"].shape == (4, 3)
+    assert params["bias"].shape == (3,)
+    x = jnp.ones((2, 4))
+    y, _ = m.apply(params, state, x)
+    assert y.shape == (2, 3)
+
+
+def test_sequential_forward(rng):
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    params, state = m.init(rng)
+    x = jnp.ones((5, 4))
+    y, _ = m.apply(params, state, x)
+    assert y.shape == (5, 2)
+    assert count_params(params) == 4 * 8 + 8 + 8 * 2 + 2
+
+
+def test_concat_table_and_caddtable(rng):
+    m = nn.Sequential(
+        nn.ConcatTable(nn.Linear(4, 4), nn.Identity()),
+        nn.CAddTable())
+    params, state = m.init(rng)
+    x = jnp.ones((3, 4))
+    y, _ = m.apply(params, state, x)
+    assert y.shape == (3, 4)
+
+
+def test_parallel_table(rng):
+    m = nn.ParallelTable(nn.Linear(4, 2), nn.Linear(3, 2))
+    params, state = m.init(rng)
+    out, _ = m.apply(params, state, jnp.ones((2, 4)), jnp.ones((2, 3)))
+    assert len(out) == 2 and out[0].shape == (2, 2)
+
+
+def test_graph_dag(rng):
+    inp = nn.Input()
+    h = nn.Linear(4, 8)(inp)
+    a = nn.ReLU()(h)
+    b = nn.Tanh()(h)
+    merged = nn.CAddTable()(a, b)
+    out = nn.Linear(8, 2)(merged)
+    g = nn.Graph([inp], [out])
+    params, state = g.init(rng)
+    y, _ = g.apply(params, state, jnp.ones((3, 4)))
+    assert y.shape == (3, 2)
+
+
+def test_graph_multi_io(rng):
+    i1, i2 = nn.Input(), nn.Input()
+    s = nn.CAddTable()(i1, i2)
+    o2 = nn.ReLU()(s)
+    g = nn.Graph([i1, i2], [s, o2])
+    params, state = g.init(rng)
+    (y1, y2), _ = g.apply(params, state, jnp.ones((2, 3)), 2 * jnp.ones((2, 3)))
+    np.testing.assert_allclose(y1, 3.0)
+    np.testing.assert_allclose(y2, 3.0)
+
+
+def test_freeze_mask(rng):
+    m = nn.Sequential(nn.Linear(4, 4), nn.Linear(4, 2))
+    m[0].freeze()
+    params, _ = m.init(rng)
+    mask = m.trainable_mask(params)
+    assert mask["0"]["weight"] is False or mask["0"]["weight"] == False  # noqa: E712
+    assert mask["1"]["weight"] in (True,)
+
+
+def test_flatten_params(rng):
+    m = nn.Linear(3, 2)
+    params, _ = m.init(rng)
+    flat, unravel = flatten_params(params)
+    assert flat.shape == (3 * 2 + 2,)
+    rt = unravel(flat)
+    np.testing.assert_allclose(rt["weight"], params["weight"])
+
+
+def test_init_deterministic(rng):
+    m = nn.Linear(4, 3)
+    p1, _ = m.init(rng)
+    p2, _ = m.init(rng)
+    np.testing.assert_allclose(p1["weight"], p2["weight"])
+
+
+def test_jit_and_grad_compose(rng):
+    m = nn.Sequential(nn.Linear(4, 4), nn.Tanh(), nn.Linear(4, 1))
+    params, state = m.init(rng)
+
+    @jax.jit
+    def loss_fn(p, x):
+        y, _ = m.apply(p, state, x)
+        return jnp.mean(jnp.square(y))
+
+    g = jax.grad(loss_fn)(params, jnp.ones((2, 4)))
+    assert g["0"]["weight"].shape == (4, 4)
+    assert jnp.all(jnp.isfinite(g["0"]["weight"]))

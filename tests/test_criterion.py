@@ -1,0 +1,115 @@
+"""Criterion tests — value checks vs hand-computed/numpy references
+(analogue of test/.../nn/*CriterionSpec.scala)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import bigdl_tpu.nn as nn
+
+
+def test_class_nll():
+    logp = jnp.log(jnp.array([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]]))
+    target = jnp.array([0, 1])
+    loss = nn.ClassNLLCriterion().forward(logp, target)
+    expected = -(np.log(0.7) + np.log(0.8)) / 2
+    np.testing.assert_allclose(loss, expected, rtol=1e-3)
+
+
+def test_cross_entropy_matches_nll_of_logsoftmax():
+    logits = jnp.array([[2.0, 1.0, -1.0], [0.0, 3.0, 0.5]])
+    target = jnp.array([0, 1])
+    ce = nn.CrossEntropyCriterion().forward(logits, target)
+    nll = nn.ClassNLLCriterion().forward(jax.nn.log_softmax(logits), target)
+    np.testing.assert_allclose(ce, nll, rtol=1e-4)
+
+
+def test_ignore_index():
+    logits = jnp.array([[2.0, 1.0], [0.0, 3.0]])
+    target = jnp.array([0, -1])
+    loss = nn.CrossEntropyCriterion(ignore_index=-1).forward(logits, target)
+    only_first = nn.CrossEntropyCriterion().forward(logits[:1], target[:1])
+    np.testing.assert_allclose(loss, only_first, rtol=1e-4)
+
+
+def test_mse_and_abs():
+    x, t = jnp.array([1.0, 2.0]), jnp.array([0.0, 0.0])
+    np.testing.assert_allclose(nn.MSECriterion().forward(x, t), 2.5)
+    np.testing.assert_allclose(nn.MSECriterion(size_average=False).forward(x, t), 5.0)
+    np.testing.assert_allclose(nn.AbsCriterion().forward(x, t), 1.5)
+
+
+def test_bce():
+    x = jnp.array([0.9, 0.1])
+    t = jnp.array([1.0, 0.0])
+    loss = nn.BCECriterion().forward(x, t)
+    np.testing.assert_allclose(loss, -np.log(0.9), rtol=1e-4)
+
+
+def test_bce_logits_stable():
+    x = jnp.array([100.0, -100.0])
+    t = jnp.array([1.0, 0.0])
+    loss = nn.BCECriterionWithLogits().forward(x, t)
+    assert float(loss) < 1e-6
+
+
+def test_smooth_l1():
+    x = jnp.array([0.5, 3.0])
+    t = jnp.zeros(2)
+    loss = nn.SmoothL1Criterion(size_average=False).forward(x, t)
+    np.testing.assert_allclose(loss, 0.125 + 2.5, rtol=1e-4)
+
+
+def test_margin():
+    x = jnp.array([0.9, -0.4])
+    t = jnp.array([1.0, -1.0])
+    loss = nn.MarginCriterion(size_average=False).forward(x, t)
+    np.testing.assert_allclose(loss, 0.1 + 0.6, rtol=1e-4)
+
+
+def test_kldiv():
+    t = jnp.array([[0.5, 0.5]])
+    logq = jnp.log(jnp.array([[0.25, 0.75]]))
+    loss = nn.KLDivCriterion().forward(logq, t)
+    # size_average divides by element count (DistKLDivCriterion.scala:51)
+    expected = (0.5 * np.log(0.5 / 0.25) + 0.5 * np.log(0.5 / 0.75)) / 2
+    np.testing.assert_allclose(loss, expected, rtol=1e-3)
+    loss_sum = nn.KLDivCriterion(size_average=False).forward(logq, t)
+    np.testing.assert_allclose(loss_sum, expected * 2, rtol=1e-3)
+
+
+def test_parallel_criterion():
+    pc = nn.ParallelCriterion()
+    pc.add(nn.MSECriterion(), 0.5).add(nn.AbsCriterion(), 2.0)
+    x = (jnp.array([1.0]), jnp.array([2.0]))
+    t = (jnp.array([0.0]), jnp.array([0.0]))
+    np.testing.assert_allclose(pc.forward(x, t), 0.5 * 1.0 + 2.0 * 2.0)
+
+
+def test_time_distributed_criterion():
+    c = nn.TimeDistributedCriterion(nn.MSECriterion(), size_average=True)
+    x = jnp.ones((2, 3, 4))
+    t = jnp.zeros((2, 3, 4))
+    np.testing.assert_allclose(c.forward(x, t), 1.0, rtol=1e-4)
+
+
+def test_criterions_differentiable():
+    x = jnp.array([[2.0, 1.0, -1.0]])
+    t = jnp.array([0])
+    g = jax.grad(lambda z: nn.CrossEntropyCriterion().forward(z, t))(x)
+    assert g.shape == x.shape and bool(jnp.all(jnp.isfinite(g)))
+
+
+def test_cosine_embedding():
+    x1 = jnp.array([[1.0, 0.0]])
+    x2 = jnp.array([[1.0, 0.0]])
+    t = jnp.array([1.0])
+    loss = nn.CosineEmbeddingCriterion().forward((x1, x2), t)
+    np.testing.assert_allclose(loss, 0.0, atol=1e-6)
+
+
+def test_dice():
+    x = jnp.ones((1, 4))
+    t = jnp.ones((1, 4))
+    loss = nn.DiceCoefficientCriterion().forward(x, t)
+    assert float(loss) < 0.15

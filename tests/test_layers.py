@@ -1,0 +1,242 @@
+"""Layer unit tests — small-tensor forward checks vs numpy references,
+mirroring the reference's layer specs (test/.../nn/*Spec.scala)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+
+
+def test_conv_known_output(rng):
+    # 1x1 conv with identity-ish kernel
+    m = nn.SpatialConvolution(2, 2, 1, 1, bias=False)
+    params, state = m.init(rng)
+    params = {"weight": jnp.eye(2).reshape(1, 1, 2, 2)}
+    x = jnp.arange(2 * 3 * 3 * 2, dtype=jnp.float32).reshape(2, 3, 3, 2)
+    y, _ = m.apply(params, state, x)
+    np.testing.assert_allclose(y, x)
+
+
+def test_conv_shapes(rng):
+    m = nn.SpatialConvolution(3, 8, 3, 3, 2, 2, 1, 1)
+    params, state = m.init(rng)
+    y, _ = m.apply(params, state, jnp.ones((2, 8, 8, 3)))
+    assert y.shape == (2, 4, 4, 8)
+
+
+def test_grouped_conv(rng):
+    m = nn.SpatialConvolution(4, 8, 3, 3, pad_w=1, pad_h=1, n_group=2)
+    params, state = m.init(rng)
+    y, _ = m.apply(params, state, jnp.ones((1, 5, 5, 4)))
+    assert y.shape == (1, 5, 5, 8)
+    assert params["weight"].shape == (3, 3, 2, 8)
+
+
+def test_dilated_conv(rng):
+    m = nn.SpatialDilatedConvolution(2, 4, 3, 3, dilation_w=2, dilation_h=2)
+    params, state = m.init(rng)
+    y, _ = m.apply(params, state, jnp.ones((1, 9, 9, 2)))
+    assert y.shape == (1, 5, 5, 4)
+
+
+def test_full_conv_upsamples(rng):
+    m = nn.SpatialFullConvolution(3, 2, 2, 2, 2, 2)
+    params, state = m.init(rng)
+    y, _ = m.apply(params, state, jnp.ones((1, 4, 4, 3)))
+    assert y.shape == (1, 8, 8, 2)
+
+
+def test_separable_conv(rng):
+    m = nn.SpatialSeparableConvolution(4, 8, 2, 3, 3, pad_w=1, pad_h=1)
+    params, state = m.init(rng)
+    y, _ = m.apply(params, state, jnp.ones((1, 6, 6, 4)))
+    assert y.shape == (1, 6, 6, 8)
+
+
+def test_temporal_conv(rng):
+    m = nn.TemporalConvolution(5, 7, 3)
+    params, state = m.init(rng)
+    y, _ = m.apply(params, state, jnp.ones((2, 10, 5)))
+    assert y.shape == (2, 8, 7)
+
+
+def test_max_pooling_values(rng):
+    m = nn.SpatialMaxPooling(2, 2)
+    x = jnp.arange(16, dtype=jnp.float32).reshape(1, 4, 4, 1)
+    y, _ = m.apply({}, {}, x)
+    np.testing.assert_allclose(y[0, :, :, 0], [[5, 7], [13, 15]])
+
+
+def test_avg_pooling_values(rng):
+    m = nn.SpatialAveragePooling(2, 2)
+    x = jnp.arange(16, dtype=jnp.float32).reshape(1, 4, 4, 1)
+    y, _ = m.apply({}, {}, x)
+    np.testing.assert_allclose(y[0, :, :, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_ceil_mode_pooling(rng):
+    m = nn.SpatialMaxPooling(3, 3, 2, 2, ceil_mode=True)
+    y, _ = m.apply({}, {}, jnp.ones((1, 6, 6, 1)))
+    assert y.shape == (1, 3, 3, 1)
+    m2 = nn.SpatialMaxPooling(3, 3, 2, 2, ceil_mode=False)
+    y2, _ = m2.apply({}, {}, jnp.ones((1, 6, 6, 1)))
+    assert y2.shape == (1, 2, 2, 1)
+
+
+def test_batchnorm_train_eval(rng):
+    m = nn.BatchNormalization(4)
+    params, state = m.init(rng)
+    x = jax.random.normal(rng, (16, 4)) * 3 + 1
+    y, new_state = m.apply(params, state, x, training=True)
+    np.testing.assert_allclose(np.mean(np.asarray(y), axis=0), 0.0, atol=1e-5)
+    np.testing.assert_allclose(np.std(np.asarray(y), axis=0), 1.0, atol=1e-2)
+    assert not np.allclose(new_state["running_mean"], 0.0)
+    # eval path uses running stats
+    y2, s2 = m.apply(params, new_state, x, training=False)
+    assert s2 is new_state or np.allclose(s2["running_mean"], new_state["running_mean"])
+
+
+def test_spatial_batchnorm(rng):
+    m = nn.SpatialBatchNormalization(3)
+    params, state = m.init(rng)
+    y, _ = m.apply(params, state, jnp.ones((2, 4, 4, 3)), training=True)
+    assert y.shape == (2, 4, 4, 3)
+
+
+def test_layernorm(rng):
+    m = nn.LayerNormalization(8)
+    params, state = m.init(rng)
+    x = jax.random.normal(rng, (2, 5, 8))
+    y, _ = m.apply(params, state, x)
+    np.testing.assert_allclose(np.mean(np.asarray(y), axis=-1), 0.0, atol=1e-5)
+
+
+def test_lrn_matches_formula(rng):
+    m = nn.SpatialCrossMapLRN(size=3, alpha=1.0, beta=0.5, k=1.0)
+    x = jnp.ones((1, 2, 2, 4))
+    y, _ = m.apply({}, {}, x)
+    # channel 1..2 have 3 ones in window; edges have 2
+    expected_mid = 1.0 / np.sqrt(1 + 3 / 3)
+    np.testing.assert_allclose(y[0, 0, 0, 1], expected_mid, rtol=1e-5)
+
+
+def test_dropout_train_eval(rng):
+    m = nn.Dropout(0.5)
+    x = jnp.ones((100, 100))
+    y_eval, _ = m.apply({}, {}, x, training=False)
+    np.testing.assert_allclose(y_eval, x)
+    y_train, _ = m.apply({}, {}, x, training=True, rng=rng)
+    frac = float(jnp.mean(y_train == 0))
+    assert 0.4 < frac < 0.6
+    np.testing.assert_allclose(float(jnp.mean(y_train)), 1.0, atol=0.1)
+
+
+def test_lookup_table(rng):
+    m = nn.LookupTable(10, 4)
+    params, state = m.init(rng)
+    idx = jnp.array([[0, 3], [9, 1]])
+    y, _ = m.apply(params, state, idx)
+    assert y.shape == (2, 2, 4)
+    np.testing.assert_allclose(y[0, 1], params["weight"][3])
+
+
+def test_shape_ops(rng):
+    x = jnp.arange(24, dtype=jnp.float32).reshape(2, 3, 4)
+    y, _ = nn.Reshape((12,)).apply({}, {}, x)
+    assert y.shape == (2, 12)
+    y, _ = nn.Transpose([(1, 2)]).apply({}, {}, x)
+    assert y.shape == (2, 4, 3)
+    y, _ = nn.Select(1, 2).apply({}, {}, x)
+    assert y.shape == (2, 4)
+    y, _ = nn.Narrow(2, 1, 2).apply({}, {}, x)
+    assert y.shape == (2, 3, 2)
+    y, _ = nn.Squeeze().apply({}, {}, jnp.ones((2, 1, 3)))
+    assert y.shape == (2, 3)
+    y, _ = nn.Padding(1, 2).apply({}, {}, x)
+    assert y.shape == (2, 5, 4)
+    y, _ = nn.Padding(1, -2).apply({}, {}, x)
+    assert y.shape == (2, 5, 4)
+
+
+def test_join_split_tables(rng):
+    a, b = jnp.ones((2, 3)), 2 * jnp.ones((2, 3))
+    y, _ = nn.JoinTable(1).apply({}, {}, (a, b))
+    assert y.shape == (2, 6)
+    parts, _ = nn.SplitTable(1).apply({}, {}, jnp.stack([a, b], 1))
+    assert len(parts) == 2 and parts[0].shape == (2, 3)
+
+
+def test_arithmetic_tables(rng):
+    a, b = jnp.full((2, 2), 6.0), jnp.full((2, 2), 3.0)
+    assert float(nn.CSubTable().apply({}, {}, (a, b))[0][0, 0]) == 3.0
+    assert float(nn.CDivTable().apply({}, {}, (a, b))[0][0, 0]) == 2.0
+    assert float(nn.CMaxTable().apply({}, {}, (a, b))[0][0, 0]) == 6.0
+    assert float(nn.MulConstant(2.0).apply({}, {}, a)[0][0, 0]) == 12.0
+
+
+def test_mm_mv_dot(rng):
+    a = jnp.ones((2, 3, 4))
+    b = jnp.ones((2, 4, 5))
+    y, _ = nn.MM().apply({}, {}, (a, b))
+    assert y.shape == (2, 3, 5)
+    v = jnp.ones((2, 4))
+    y, _ = nn.MV().apply({}, {}, (a, v))
+    assert y.shape == (2, 3)
+    y, _ = nn.DotProduct().apply({}, {}, (jnp.ones((2, 4)), jnp.ones((2, 4))))
+    np.testing.assert_allclose(y, 4.0)
+
+
+def test_activations_finite(rng):
+    x = jnp.linspace(-3, 3, 32).reshape(4, 8)
+    for cls in [nn.ReLU, nn.ReLU6, nn.Tanh, nn.Sigmoid, nn.SELU, nn.GELU,
+                nn.Swish, nn.SoftPlus, nn.SoftSign, nn.HardSigmoid,
+                nn.SoftMax, nn.LogSoftMax]:
+        y, _ = cls().apply({}, {}, x)
+        assert y.shape == x.shape and bool(jnp.all(jnp.isfinite(y))), cls
+
+
+def test_prelu_learned_slope(rng):
+    m = nn.PReLU(4)
+    params, state = m.init(rng)
+    x = -jnp.ones((2, 4))
+    y, _ = m.apply(params, state, x)
+    np.testing.assert_allclose(y, -0.25)
+
+
+def test_upsampling(rng):
+    y, _ = nn.UpSampling2D((2, 2)).apply({}, {}, jnp.ones((1, 2, 2, 3)))
+    assert y.shape == (1, 4, 4, 3)
+    y, _ = nn.ResizeBilinear(5, 5).apply({}, {}, jnp.ones((1, 3, 3, 2)))
+    assert y.shape == (1, 5, 5, 2)
+
+
+def test_avg_pooling_ceil_mode(rng):
+    m = nn.SpatialAveragePooling(3, 3, 2, 2, ceil_mode=True)
+    y, _ = m.apply({}, {}, jnp.ones((1, 6, 6, 1)))
+    assert y.shape == (1, 3, 3, 1)
+    # ceil-extra cells are padding, divisor counts only real cells
+    np.testing.assert_allclose(y, 1.0)
+
+
+def test_adaptive_max_pool_non_divisible(rng):
+    m = nn.SpatialAdaptiveMaxPooling(4, 4)
+    x = jnp.arange(100, dtype=jnp.float32).reshape(1, 10, 10, 1)
+    y, _ = m.apply({}, {}, x)
+    assert y.shape == (1, 4, 4, 1)
+    # last window covers rows/cols 7..9 -> max = 99
+    assert float(y[0, 3, 3, 0]) == 99.0
+
+
+def test_dropout_requires_rng(rng):
+    with pytest.raises(ValueError, match="rng"):
+        nn.Dropout(0.5).apply({}, {}, jnp.ones((2, 2)), training=True)
+
+
+def test_simplex_criterion_geometry(rng):
+    c = nn.ClassSimplexCriterion(4)
+    s = np.asarray(c.simplex)
+    # vertices are unit norm, pairwise dot -1/(n-1)
+    np.testing.assert_allclose(np.linalg.norm(s, axis=1), 1.0, atol=1e-5)
+    np.testing.assert_allclose(s[0] @ s[1], -1 / 3, atol=1e-5)
