@@ -1,0 +1,5 @@
+"""bigdl_tpu.dataset — data pipeline (reference: dataset/, SURVEY.md §2.7)."""
+
+from bigdl_tpu.dataset.core import (DataSet, ArrayDataSet, Sample, MiniBatch,
+                                    Transformer, SampleToMiniBatch, Identity)
+from bigdl_tpu.dataset import mnist

@@ -1,0 +1,82 @@
+"""MNIST loader (reference: pyspark/bigdl/dataset/mnist.py and
+models/lenet/Train.scala's BytesToGreyImg→GreyImgNormalizer pipeline).
+
+Reads standard IDX files from a local directory when present (this
+environment has no network egress — no downloads); otherwise generates a
+deterministic synthetic digit-like dataset with learnable class structure so
+the end-to-end configs stay runnable."""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+TRAIN_MEAN, TRAIN_STD = 0.13066047740239506, 0.3081078
+
+_FILES = {
+    "train_images": ["train-images-idx3-ubyte", "train-images-idx3-ubyte.gz"],
+    "train_labels": ["train-labels-idx1-ubyte", "train-labels-idx1-ubyte.gz"],
+    "test_images": ["t10k-images-idx3-ubyte", "t10k-images-idx3-ubyte.gz"],
+    "test_labels": ["t10k-labels-idx1-ubyte", "t10k-labels-idx1-ubyte.gz"],
+}
+
+
+def _read_idx(path: str) -> np.ndarray:
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(dims)
+
+
+def _find(folder: str, names) -> Optional[str]:
+    for n in names:
+        p = os.path.join(folder, n)
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def synthetic(n: int, seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic learnable stand-in: each class is a distinct blob
+    pattern + noise. 28x28x1 uint8-range floats, labels 0..9."""
+    rng = np.random.RandomState(seed)
+    yy, xx = np.mgrid[0:28, 0:28]
+    protos = []
+    for c in range(10):
+        cy, cx = 6 + 2 * (c % 4), 6 + 2 * (c // 4)
+        blob = np.exp(-(((yy - cy) ** 2 + (xx - cx) ** 2) / (2.0 * (2 + c % 3) ** 2)))
+        ring = np.exp(-((np.hypot(yy - 14, xx - 14) - (4 + c % 5)) ** 2) / 4.0)
+        protos.append(0.6 * blob + 0.4 * ring)
+    protos = np.stack(protos)
+    labels = rng.randint(0, 10, size=n)
+    imgs = protos[labels] * 255.0
+    imgs = imgs + rng.randn(n, 28, 28) * 25.0
+    return np.clip(imgs, 0, 255).astype(np.float32)[..., None], \
+        labels.astype(np.int32)
+
+
+def load(folder: Optional[str] = None, train: bool = True,
+         n_synthetic: int = 8192) -> Tuple[np.ndarray, np.ndarray]:
+    """Returns (images NHWC float32 raw 0..255, labels int32 0-based)."""
+    if folder:
+        key = "train" if train else "test"
+        ip = _find(folder, _FILES[f"{key}_images"])
+        lp = _find(folder, _FILES[f"{key}_labels"])
+        if ip and lp:
+            images = _read_idx(ip).astype(np.float32)[..., None]
+            labels = _read_idx(lp).astype(np.int32)
+            return images, labels
+    return synthetic(n_synthetic if train else max(1024, n_synthetic // 8),
+                     seed=0 if train else 1)
+
+
+def normalize(images: np.ndarray) -> np.ndarray:
+    """GreyImgNormalizer equivalent (reference: dataset/image/
+    GreyImgNormalizer.scala): (x/255 - mean) / std."""
+    return ((images / 255.0) - TRAIN_MEAN) / TRAIN_STD

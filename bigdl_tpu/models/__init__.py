@@ -1,0 +1,3 @@
+"""bigdl_tpu.models — model zoo (reference: models/, SURVEY.md §2.10)."""
+
+from bigdl_tpu.models import lenet

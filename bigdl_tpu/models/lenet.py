@@ -1,0 +1,41 @@
+"""LeNet-5 (reference: models/lenet/LeNet5.scala:25-108 — apply/graph
+variants; the dnnGraph variant is unnecessary here: one XLA program serves
+both roles)."""
+
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+
+def build(class_num: int = 10) -> nn.Sequential:
+    """Sequential variant (reference: LeNet5.scala `apply`). NHWC 28x28x1."""
+    return nn.Sequential(
+        nn.SpatialConvolution(1, 6, 5, 5, name="conv1_5x5"),
+        nn.Tanh(),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.SpatialConvolution(6, 12, 5, 5, name="conv2_5x5"),
+        nn.Tanh(),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.Flatten(),
+        nn.Linear(12 * 4 * 4, 100, name="fc1"),
+        nn.Tanh(),
+        nn.Linear(100, class_num, name="fc2"),
+        nn.LogSoftMax(),
+        name="LeNet5")
+
+
+def graph(class_num: int = 10) -> nn.Graph:
+    """Graph variant (reference: LeNet5.scala `graph`)."""
+    inp = nn.Input()
+    c1 = nn.SpatialConvolution(1, 6, 5, 5, name="conv1_5x5")(inp)
+    t1 = nn.Tanh()(c1)
+    p1 = nn.SpatialMaxPooling(2, 2, 2, 2)(t1)
+    c2 = nn.SpatialConvolution(6, 12, 5, 5, name="conv2_5x5")(p1)
+    t2 = nn.Tanh()(c2)
+    p2 = nn.SpatialMaxPooling(2, 2, 2, 2)(t2)
+    fl = nn.Flatten()(p2)
+    f1 = nn.Linear(12 * 4 * 4, 100, name="fc1")(fl)
+    t3 = nn.Tanh()(f1)
+    f2 = nn.Linear(100, class_num, name="fc2")(t3)
+    out = nn.LogSoftMax()(f2)
+    return nn.Graph([inp], [out], name="LeNet5")

@@ -1,0 +1,19 @@
+"""bigdl_tpu.optim — optimization methods, schedules, triggers, metrics,
+trainers (reference: optim/, SURVEY.md §2.6)."""
+
+from bigdl_tpu.optim.method import (OptimMethod, SGD, Adam, AdamW, Adamax,
+                                    Adadelta, Adagrad, RMSprop, Ftrl, LarsSGD,
+                                    LBFGS, ParallelAdam)
+from bigdl_tpu.optim.schedule import (LearningRateSchedule, Default, Poly, Step,
+                                      MultiStep, EpochStep, EpochDecay,
+                                      Exponential, NaturalExp, Warmup, Plateau,
+                                      SequentialSchedule, EpochSchedule,
+                                      CosineDecay)
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.optim.metrics import (ValidationMethod, ValidationResult,
+                                     Top1Accuracy, Top5Accuracy, Loss, MAE,
+                                     TreeNNAccuracy, HitRatio, NDCG,
+                                     PrecisionRecallAUC, evaluate)
+from bigdl_tpu.optim.local import (Optimizer, LocalOptimizer,
+                                   GradientProcessor, ConstantClipping,
+                                   L2NormClipping)

@@ -1,0 +1,275 @@
+"""Single-host trainer — the analogue of `LocalOptimizer`
+(reference: optim/LocalOptimizer.scala:45-160) and of the public `Optimizer`
+builder facade (reference: optim/Optimizer.scala:602-686).
+
+TPU-first design: the reference clones the model per core and threads
+mini-batch stacks through a pool (`Engine.default.invokeAndWait2`); here one
+jitted train step owns the whole chip — XLA parallelizes internally. The
+distributed variant (optim/distri.py) shares this class and swaps the step
+builder for a mesh-sharded one.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from functools import partial
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import Criterion, Module
+from bigdl_tpu.optim.method import OptimMethod, SGD
+from bigdl_tpu.optim.metrics import ValidationMethod, ValidationResult
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.utils import checkpoint as ckpt
+
+log = logging.getLogger("bigdl_tpu")
+
+
+# ------------------------------------------------- gradient processors
+class GradientProcessor:
+    """Pluggable gradient transform (reference: parameters/
+    ParameterOperations.scala — ConstantClippingProcessor,
+    L2NormClippingProcessor)."""
+
+    def __call__(self, grads, params):
+        return grads
+
+
+class ConstantClipping(GradientProcessor):
+    def __init__(self, min_value: float, max_value: float):
+        self.min_value, self.max_value = min_value, max_value
+
+    def __call__(self, grads, params):
+        return jax.tree.map(
+            lambda g: jnp.clip(g, self.min_value, self.max_value), grads)
+
+
+class L2NormClipping(GradientProcessor):
+    """Global-norm clip (reference: L2NormClippingProcessor —
+    the cross-node sqsum is free here: grads are already global)."""
+
+    def __init__(self, max_norm: float):
+        self.max_norm = max_norm
+
+    def __call__(self, grads, params):
+        sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads))
+        norm = jnp.sqrt(sq)
+        scale = jnp.minimum(1.0, self.max_norm / (norm + 1e-12))
+        return jax.tree.map(lambda g: g * scale, grads)
+
+
+class Optimizer:
+    """Training facade. Usage mirrors the reference:
+
+        opt = Optimizer(model, dataset, criterion, SGD(0.01))
+        opt \
+           .set_validation(Trigger.every_epoch(), val_dataset, [Top1Accuracy()]) \
+           .set_checkpoint("/tmp/ck", Trigger.every_epoch()) \
+           .set_end_when(Trigger.max_epoch(10))
+        params, model_state = opt.optimize()
+
+    `dataset` is any object with `__iter__` yielding (x, y) numpy/jnp batches
+    per epoch (see bigdl_tpu.dataset). All batches must share one shape —
+    XLA compiles one program (use the pipeline's fixed-size batcher).
+    """
+
+    def __init__(self, model: Module, dataset, criterion: Criterion,
+                 optim_method: Optional[OptimMethod] = None,
+                 seed: int = 1):
+        self.model, self.dataset, self.criterion = model, dataset, criterion
+        self.method = optim_method or SGD(1e-2)
+        self.end_when: Trigger = Trigger.max_epoch(1)
+        self.val_trigger: Optional[Trigger] = None
+        self.val_dataset = None
+        self.val_methods: Sequence[ValidationMethod] = ()
+        self.ckpt_path: Optional[str] = None
+        self.ckpt_trigger: Optional[Trigger] = None
+        self.grad_processors: List[GradientProcessor] = []
+        self.seed = seed
+        self.state: Dict = {"epoch": 0, "neval": 0, "records": 0}
+        self._summary = None
+        self._val_summary = None
+
+    # ------------------------------------------------------------- builders
+    def set_optim_method(self, method: OptimMethod):
+        self.method = method
+        return self
+
+    def set_end_when(self, trigger: Trigger):
+        self.end_when = trigger
+        return self
+
+    def set_validation(self, trigger: Trigger, dataset,
+                       methods: Sequence[ValidationMethod]):
+        self.val_trigger, self.val_dataset, self.val_methods = \
+            trigger, dataset, list(methods)
+        return self
+
+    def set_checkpoint(self, path: str, trigger: Trigger):
+        self.ckpt_path, self.ckpt_trigger = path, trigger
+        return self
+
+    def set_gradient_clipping_by_l2_norm(self, max_norm: float):
+        self.grad_processors.append(L2NormClipping(max_norm))
+        return self
+
+    def set_constant_gradient_clipping(self, min_v: float, max_v: float):
+        self.grad_processors.append(ConstantClipping(min_v, max_v))
+        return self
+
+    def set_train_summary(self, summary):
+        self._summary = summary
+        return self
+
+    def set_val_summary(self, summary):
+        self._val_summary = summary
+        return self
+
+    # ------------------------------------------------------------ step build
+    def _build_step(self) -> Callable:
+        model, criterion, method = self.model, self.criterion, self.method
+        processors = list(self.grad_processors)
+        mask = None
+        if any(m._frozen for m in model.modules()):
+            mask = True  # resolved inside builder below
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def step(params, model_state, slots, x, y, lr, step_num, rng):
+            def loss_fn(p):
+                out, new_ms = model.apply(p, model_state, x,
+                                          training=True, rng=rng)
+                return criterion.forward(out, y), new_ms
+
+            (loss, new_ms), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            for proc in processors:
+                grads = proc(grads, params)
+            if mask is None:
+                new_params, new_slots = method.update(params, grads, slots,
+                                                      lr, step_num)
+            else:
+                # Restore frozen leaves after the update so weight decay /
+                # momentum cannot move them either (freeze must win over
+                # every update rule).
+                tm = model.trainable_mask(params)
+                old_params = params
+                new_params, new_slots = method.update(params, grads, slots,
+                                                      lr, step_num)
+                new_params = jax.tree.map(
+                    lambda trainable, new, old: new if trainable is True
+                    else (old if trainable is False
+                          else jnp.where(trainable, new, old)),
+                    tm, new_params, old_params)
+            return new_params, new_ms, new_slots, loss
+
+        return step
+
+    # --------------------------------------------------------------- resume
+    def resume(self, path: str) -> bool:
+        """Load latest snapshot under `path` (mid-epoch counters included) —
+        reference: DistriOptimizer retry/recovery (:886-963)."""
+        snap = ckpt.latest_checkpoint(path)
+        if snap is None:
+            return False
+        trees, meta = ckpt.load_checkpoint(snap)
+        self._resume_trees = trees
+        meta.pop("epoch_finished", None)  # don't re-fire per-epoch triggers
+        self.state.update(meta)
+        log.info("resumed from %s at %s", snap, meta)
+        return True
+
+    # -------------------------------------------------------------- optimize
+    def optimize(self) -> Tuple[Dict, Dict]:
+        rng = jax.random.PRNGKey(self.seed)
+        if hasattr(self, "_resume_trees"):
+            params = self._resume_trees["params"]
+            model_state = self._resume_trees["model_state"]
+            slots = self._resume_trees.get("slots", self.method.init_slots(params))
+        else:
+            params, model_state = self.model.init(
+                jax.random.fold_in(rng, 0xBD1))
+            slots = self.method.init_slots(params)
+        step = self._build_step()
+        st = self.state
+
+        self._eval_fn = jax.jit(
+            lambda p, s, x: self.model.apply(p, s, x, training=False)[0])
+
+        while not self.end_when(st):
+            epoch_start = time.time()
+            epoch_records = 0
+            ended_mid_epoch = False
+            for x, y in self.dataset:
+                it_start = time.time()
+                lr = self.method.current_lr(st)
+                rng, sub = jax.random.split(rng)
+                params, model_state, slots, loss = step(
+                    params, model_state, slots, jnp.asarray(x), jnp.asarray(y),
+                    jnp.float32(lr), jnp.int32(st["neval"]), sub)
+                loss_f = float(loss)       # sync point, like reference's driver
+                n = x.shape[0]
+                st["neval"] += 1
+                st["records"] += n
+                st["loss"] = loss_f
+                wall = time.time() - it_start
+                epoch_records += n
+                if st["neval"] % 20 == 1:
+                    log.info("epoch %d iter %d loss %.4f lr %.5f %.1f rec/s",
+                             st["epoch"], st["neval"], loss_f, lr, n / max(wall, 1e-9))
+                if self._summary is not None:
+                    self._summary.add_scalar("Loss", loss_f, st["neval"])
+                    self._summary.add_scalar("LearningRate", lr, st["neval"])
+                    self._summary.add_scalar("Throughput", n / max(wall, 1e-9),
+                                             st["neval"])
+                self._maybe_validate(params, model_state, st)
+                self._maybe_checkpoint(params, model_state, slots, st)
+                if self.end_when(st):
+                    ended_mid_epoch = True
+                    break
+            if ended_mid_epoch:
+                # partial epoch: don't advance counters or fire per-epoch
+                # triggers — resume must replay the unfinished epoch
+                break
+            st["epoch"] += 1
+            st["epoch_finished"] = True
+            dur = time.time() - epoch_start
+            log.info("epoch %d done: %d records in %.1fs (%.1f rec/s)",
+                     st["epoch"] - 1, epoch_records, dur, epoch_records / max(dur, 1e-9))
+            self._maybe_validate(params, model_state, st)
+            self._maybe_checkpoint(params, model_state, slots, st)
+            st["epoch_finished"] = False
+
+        self.params, self.model_state, self.slots = params, model_state, slots
+        return params, model_state
+
+    # ------------------------------------------------------------- internals
+    def _maybe_validate(self, params, model_state, st):
+        if self.val_trigger is None or not self.val_trigger(st):
+            return
+        from bigdl_tpu.optim.metrics import evaluate
+        totals = evaluate(self.model, params, model_state, self.val_dataset,
+                          self.val_methods, apply_fn=self._eval_fn)
+        for name, res in totals.items():
+            log.info("validation %s = %s", name, res)
+            st[f"val_{name}"] = res.result
+            if self._val_summary is not None:
+                self._val_summary.add_scalar(name, res.result, st["neval"])
+        if self.val_methods:
+            st["score"] = totals[self.val_methods[0].name].result
+
+    def _maybe_checkpoint(self, params, model_state, slots, st):
+        if self.ckpt_trigger is None or not self.ckpt_trigger(st):
+            return
+        path = f"{self.ckpt_path}/snapshot-{st['neval']}"
+        meta = {k: v for k, v in st.items()
+                if isinstance(v, (int, float, bool, str))}
+        ckpt.save_checkpoint(path, {"params": params,
+                                    "model_state": model_state,
+                                    "slots": slots}, meta)
+        log.info("checkpoint -> %s", path)
+
+
+LocalOptimizer = Optimizer

@@ -1,0 +1,383 @@
+"""Optimization methods (reference: optim/SGD.scala, Adam.scala,
+Adagrad.scala, Adadelta.scala, Adamax.scala, RMSprop.scala, Ftrl.scala,
+LarsSGD.scala, ParallelAdam.scala).
+
+Each method is a pure pair:
+    slots = method.init_slots(params)
+    new_params, new_slots = method.update(params, grads, slots, lr, step)
+`lr` and `step` are traced scalars passed into the jitted train step; the
+schedule that produces `lr` runs host-side (see schedule.py). Slot pytrees
+mirror `params`, so ZeRO-1 sharding of optimizer state is a sharding
+annotation on the slots (the reference shards them across PS partitions,
+optim/DistriOptimizer.scala:358-396).
+
+The reference's ParallelAdam (multi-threaded shard update) needs no analogue:
+the update is elementwise XLA code, already data-parallel on device.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.optim.schedule import Default, LearningRateSchedule
+
+
+def _tmap(f, *trees):
+    return jax.tree.map(f, *trees)
+
+
+class OptimMethod:
+    """Base optimizer. `learning_rate_schedule` runs host-side via
+    `current_lr(state)`; `state` carries neval/epoch counters the way the
+    reference's state Table does (optim/OptimMethod.scala)."""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_schedule: Optional[LearningRateSchedule] = None,
+                 weight_decay: float = 0.0):
+        self.learning_rate = learning_rate
+        self.schedule = learning_rate_schedule or Default()
+        self.weight_decay = weight_decay
+
+    # -------------------------------------------------- host-side utilities
+    def current_lr(self, state: Dict) -> float:
+        return float(self.schedule(self.learning_rate, state))
+
+    # --------------------------------------------------- pure device update
+    def init_slots(self, params) -> Any:
+        return ()
+
+    def update(self, params, grads, slots, lr, step):
+        raise NotImplementedError
+
+    def _decay(self, params, grads):
+        if self.weight_decay == 0.0:
+            return grads
+        wd = self.weight_decay
+        return _tmap(lambda g, p: g + wd * p, grads, params)
+
+
+class SGD(OptimMethod):
+    """SGD with momentum/dampening/nesterov (reference: optim/SGD.scala —
+    Torch update order: decay → momentum buffer → step)."""
+
+    def __init__(self, learning_rate: float = 1e-3, momentum: float = 0.0,
+                 dampening: Optional[float] = None, nesterov: bool = False,
+                 weight_decay: float = 0.0,
+                 learning_rate_schedule: Optional[LearningRateSchedule] = None):
+        super().__init__(learning_rate, learning_rate_schedule, weight_decay)
+        self.momentum = momentum
+        self.dampening = momentum if dampening is None and nesterov else \
+            (dampening if dampening is not None else 0.0)
+        self.nesterov = nesterov
+        if nesterov and (self.momentum <= 0 or self.dampening != 0):
+            # reference requires dampening==0 with nesterov (SGD.scala)
+            self.dampening = 0.0
+
+    def init_slots(self, params):
+        if self.momentum == 0.0:
+            return ()
+        return {"velocity": _tmap(jnp.zeros_like, params)}
+
+    def update(self, params, grads, slots, lr, step):
+        g = self._decay(params, grads)
+        if self.momentum == 0.0:
+            return _tmap(lambda p, gg: p - lr * gg, params, g), slots
+        mu, damp = self.momentum, self.dampening
+        v = _tmap(lambda vv, gg: mu * vv + (1 - damp) * gg,
+                  slots["velocity"], g)
+        if self.nesterov:
+            upd = _tmap(lambda gg, vv: gg + mu * vv, g, v)
+        else:
+            upd = v
+        return _tmap(lambda p, u: p - lr * u, params, upd), {"velocity": v}
+
+
+class Adam(OptimMethod):
+    """(reference: optim/Adam.scala; bias-corrected)."""
+
+    def __init__(self, learning_rate: float = 1e-3, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8,
+                 weight_decay: float = 0.0,
+                 learning_rate_schedule: Optional[LearningRateSchedule] = None):
+        super().__init__(learning_rate, learning_rate_schedule, weight_decay)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_slots(self, params):
+        return {"m": _tmap(jnp.zeros_like, params),
+                "v": _tmap(jnp.zeros_like, params)}
+
+    def update(self, params, grads, slots, lr, step):
+        g = self._decay(params, grads)
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        t = step + 1
+        m = _tmap(lambda mm, gg: b1 * mm + (1 - b1) * gg, slots["m"], g)
+        v = _tmap(lambda vv, gg: b2 * vv + (1 - b2) * jnp.square(gg),
+                  slots["v"], g)
+        bc1 = 1 - b1 ** t
+        bc2 = 1 - b2 ** t
+        new_params = _tmap(
+            lambda p, mm, vv: p - lr * (mm / bc1) / (jnp.sqrt(vv / bc2) + eps),
+            params, m, v)
+        return new_params, {"m": m, "v": v}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (no reference analogue; standard extension)."""
+
+    def update(self, params, grads, slots, lr, step):
+        wd = self.weight_decay
+        self.weight_decay = 0.0
+        try:
+            new_params, new_slots = super().update(params, grads, slots, lr, step)
+        finally:
+            self.weight_decay = wd
+        if wd:
+            new_params = _tmap(lambda np_, p: np_ - lr * wd * p, new_params, params)
+        return new_params, new_slots
+
+
+class Adamax(OptimMethod):
+    """(reference: optim/Adamax.scala)."""
+
+    def __init__(self, learning_rate: float = 2e-3, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-38,
+                 learning_rate_schedule: Optional[LearningRateSchedule] = None):
+        super().__init__(learning_rate, learning_rate_schedule)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_slots(self, params):
+        return {"m": _tmap(jnp.zeros_like, params),
+                "u": _tmap(jnp.zeros_like, params)}
+
+    def update(self, params, grads, slots, lr, step):
+        b1, b2 = self.beta1, self.beta2
+        t = step + 1
+        m = _tmap(lambda mm, gg: b1 * mm + (1 - b1) * gg, slots["m"], grads)
+        u = _tmap(lambda uu, gg: jnp.maximum(b2 * uu, jnp.abs(gg) + self.epsilon),
+                  slots["u"], grads)
+        bc = 1 - b1 ** t
+        new_params = _tmap(lambda p, mm, uu: p - (lr / bc) * mm / uu,
+                           params, m, u)
+        return new_params, {"m": m, "u": u}
+
+
+class Adadelta(OptimMethod):
+    """(reference: optim/Adadelta.scala)."""
+
+    def __init__(self, decay_rate: float = 0.9, epsilon: float = 1e-10):
+        super().__init__(1.0)
+        self.rho, self.epsilon = decay_rate, epsilon
+
+    def init_slots(self, params):
+        return {"sq_grad": _tmap(jnp.zeros_like, params),
+                "sq_delta": _tmap(jnp.zeros_like, params)}
+
+    def update(self, params, grads, slots, lr, step):
+        rho, eps = self.rho, self.epsilon
+        sq_g = _tmap(lambda s, g: rho * s + (1 - rho) * jnp.square(g),
+                     slots["sq_grad"], grads)
+        delta = _tmap(lambda sd, sg, g: jnp.sqrt((sd + eps) / (sg + eps)) * g,
+                      slots["sq_delta"], sq_g, grads)
+        sq_d = _tmap(lambda sd, d: rho * sd + (1 - rho) * jnp.square(d),
+                     slots["sq_delta"], delta)
+        new_params = _tmap(lambda p, d: p - lr * d, params, delta)
+        return new_params, {"sq_grad": sq_g, "sq_delta": sq_d}
+
+
+class Adagrad(OptimMethod):
+    """(reference: optim/Adagrad.scala)."""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_decay: float = 0.0,
+                 weight_decay: float = 0.0):
+        super().__init__(learning_rate, Default(learning_rate_decay), weight_decay)
+
+    def init_slots(self, params):
+        return {"accum": _tmap(jnp.zeros_like, params)}
+
+    def update(self, params, grads, slots, lr, step):
+        g = self._decay(params, grads)
+        accum = _tmap(lambda a, gg: a + jnp.square(gg), slots["accum"], g)
+        new_params = _tmap(lambda p, gg, a: p - lr * gg / (jnp.sqrt(a) + 1e-10),
+                           params, g, accum)
+        return new_params, {"accum": accum}
+
+
+class RMSprop(OptimMethod):
+    """(reference: optim/RMSprop.scala)."""
+
+    def __init__(self, learning_rate: float = 1e-2, decay_rate: float = 0.99,
+                 epsilon: float = 1e-8,
+                 learning_rate_schedule: Optional[LearningRateSchedule] = None):
+        super().__init__(learning_rate, learning_rate_schedule)
+        self.rho, self.epsilon = decay_rate, epsilon
+
+    def init_slots(self, params):
+        return {"sq_avg": _tmap(jnp.zeros_like, params)}
+
+    def update(self, params, grads, slots, lr, step):
+        rho = self.rho
+        sq = _tmap(lambda s, g: rho * s + (1 - rho) * jnp.square(g),
+                   slots["sq_avg"], grads)
+        new_params = _tmap(
+            lambda p, g, s: p - lr * g / (jnp.sqrt(s) + self.epsilon),
+            params, grads, sq)
+        return new_params, {"sq_avg": sq}
+
+
+class Ftrl(OptimMethod):
+    """Follow-the-regularized-leader (reference: optim/Ftrl.scala)."""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_power: float = -0.5,
+                 initial_accumulator_value: float = 0.1,
+                 l1_strength: float = 0.0, l2_strength: float = 0.0,
+                 l2_shrinkage: float = 0.0):
+        super().__init__(learning_rate)
+        self.lr_power = learning_rate_power
+        self.init_accum = initial_accumulator_value
+        self.l1, self.l2, self.l2_shrink = l1_strength, l2_strength, l2_shrinkage
+
+    def init_slots(self, params):
+        return {"accum": _tmap(lambda p: jnp.full_like(p, self.init_accum), params),
+                "linear": _tmap(jnp.zeros_like, params)}
+
+    def update(self, params, grads, slots, lr, step):
+        lp = self.lr_power
+
+        def upd(p, g, a, l):
+            g_shrink = g + 2 * self.l2_shrink * p
+            a_new = a + jnp.square(g)
+            sigma = (a_new ** -lp - a ** -lp) / lr
+            l_new = l + g_shrink - sigma * p
+            quad = a_new ** -lp / lr + 2 * self.l2
+            l1 = self.l1
+            p_new = jnp.where(
+                jnp.abs(l_new) > l1,
+                -(l_new - jnp.sign(l_new) * l1) / quad, 0.0)
+            return p_new, a_new, l_new
+
+        flat_p, treedef = jax.tree.flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_a = treedef.flatten_up_to(slots["accum"])
+        flat_l = treedef.flatten_up_to(slots["linear"])
+        outs = [upd(p, g, a, l) for p, g, a, l in
+                zip(flat_p, flat_g, flat_a, flat_l)]
+        new_params = treedef.unflatten([o[0] for o in outs])
+        accum = treedef.unflatten([o[1] for o in outs])
+        linear = treedef.unflatten([o[2] for o in outs])
+        return new_params, {"accum": accum, "linear": linear}
+
+
+class LarsSGD(OptimMethod):
+    """Layer-wise adaptive rate scaling (reference: optim/LarsSGD.scala +
+    LarsProcessor, parameters/ParameterOperations.scala). The trust ratio is
+    computed per params-pytree leaf — the analogue of the reference's
+    per-layer grouping."""
+
+    def __init__(self, learning_rate: float = 1e-2, momentum: float = 0.9,
+                 weight_decay: float = 5e-4, trust: float = 0.001,
+                 learning_rate_schedule: Optional[LearningRateSchedule] = None):
+        super().__init__(learning_rate, learning_rate_schedule, weight_decay)
+        self.momentum, self.trust = momentum, trust
+
+    def init_slots(self, params):
+        return {"velocity": _tmap(jnp.zeros_like, params)}
+
+    def update(self, params, grads, slots, lr, step):
+        mu, wd, trust = self.momentum, self.weight_decay, self.trust
+
+        def upd(p, g, v):
+            w_norm = jnp.linalg.norm(p.ravel())
+            g_norm = jnp.linalg.norm(g.ravel())
+            local = jnp.where(
+                (w_norm > 0) & (g_norm > 0),
+                trust * w_norm / (g_norm + wd * w_norm + 1e-12), 1.0)
+            v_new = mu * v + lr * local * (g + wd * p)
+            return p - v_new, v_new
+
+        flat_p, treedef = jax.tree.flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_v = treedef.flatten_up_to(slots["velocity"])
+        outs = [upd(p, g, v) for p, g, v in zip(flat_p, flat_g, flat_v)]
+        return (treedef.unflatten([o[0] for o in outs]),
+                {"velocity": treedef.unflatten([o[1] for o in outs])})
+
+
+class LBFGS(OptimMethod):
+    """Limited-memory BFGS with two-loop recursion (reference:
+    optim/LBFGS.scala + LineSearch.scala). Host-driven: `step(feval, x)` runs
+    the jitted loss/grad `feval` repeatedly — the reference similarly drives
+    closures. Intended for full-batch local optimization (e.g. style
+    transfer, classic ML), not the distributed hot path."""
+
+    def __init__(self, max_iter: int = 20, max_eval: Optional[float] = None,
+                 tol_fun: float = 1e-5, tol_x: float = 1e-9,
+                 n_correction: int = 100, learning_rate: float = 1.0):
+        super().__init__(learning_rate)
+        self.max_iter, self.tol_fun, self.tol_x = max_iter, tol_fun, tol_x
+        self.n_correction = n_correction
+        self.max_eval = max_eval or max_iter * 1.25
+
+    def step(self, feval: Callable, x0):
+        """feval(x_flat) -> (loss, grad_flat); returns (x, losses)."""
+        x = x0
+        old_dirs, old_stps = [], []
+        f, g = feval(x)
+        losses = [float(f)]
+        prev_g = g
+        d = -g
+        t = min(1.0, 1.0 / float(jnp.sum(jnp.abs(g)))) * self.learning_rate
+        n_eval = 1
+        for it in range(self.max_iter):
+            x_new = x + t * d
+            f_new, g_new = feval(x_new)
+            n_eval += 1
+            if float(f_new) > float(f) and it > 0:
+                t *= 0.5
+                continue
+            y = g_new - prev_g
+            s = x_new - x
+            ys = float(jnp.dot(y, s))
+            if ys > 1e-10:
+                if len(old_dirs) >= self.n_correction:
+                    old_dirs.pop(0)
+                    old_stps.pop(0)
+                old_dirs.append(y)
+                old_stps.append(s)
+            x, f, prev_g = x_new, f_new, g_new
+            losses.append(float(f))
+            # two-loop recursion
+            q = -g_new
+            alphas = []
+            for y_i, s_i in zip(reversed(old_dirs), reversed(old_stps)):
+                rho = 1.0 / float(jnp.dot(y_i, s_i))
+                alpha = rho * float(jnp.dot(s_i, q))
+                alphas.append((alpha, rho, y_i, s_i))
+                q = q - alpha * y_i
+            if old_dirs:
+                y_l, s_l = old_dirs[-1], old_stps[-1]
+                q = q * (float(jnp.dot(s_l, y_l)) / float(jnp.dot(y_l, y_l)))
+            for alpha, rho, y_i, s_i in reversed(alphas):
+                beta = rho * float(jnp.dot(y_i, q))
+                q = q + (alpha - beta) * s_i
+            d = q
+            t = self.learning_rate
+            if len(losses) > 1 and abs(losses[-1] - losses[-2]) < self.tol_fun:
+                break
+            if float(jnp.max(jnp.abs(t * d))) < self.tol_x:
+                break
+            if n_eval >= self.max_eval:
+                break
+        return x, losses
+
+    def update(self, params, grads, slots, lr, step):
+        raise NotImplementedError(
+            "LBFGS is host-driven; use .step(feval, x_flat) with flattened "
+            "params (see flatten_params)")
+
+
+ParallelAdam = Adam  # reference's thread-parallel variant; see module docstring

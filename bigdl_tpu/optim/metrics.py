@@ -1,0 +1,190 @@
+"""Validation methods (reference: optim/ValidationMethod.scala:173-756 —
+Top1/Top5/Loss/MAE/HitRatio/NDCG/PrecisionRecallAUC families).
+
+Each method computes a per-batch partial result ON DEVICE (a small tuple of
+scalars) and partials combine associatively host-side — the analogue of the
+reference's `ValidationResult.+` aggregation over RDD partitions, which here
+aggregates over data-parallel shards/batches."""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class ValidationResult:
+    """Accumulated (numerator, denominator)-style result."""
+
+    def __init__(self, values: Tuple[float, ...], formatter):
+        self.values = tuple(float(v) for v in values)
+        self._formatter = formatter
+
+    def __add__(self, other: "ValidationResult"):
+        return ValidationResult(
+            tuple(a + b for a, b in zip(self.values, other.values)),
+            self._formatter)
+
+    @property
+    def result(self) -> float:
+        return self._formatter(self.values)
+
+    def __repr__(self):
+        return f"{self.result:.6f} (raw={self.values})"
+
+
+class ValidationMethod:
+    name = "metric"
+    #: larger-is-better; used by best-checkpoint logic
+    maximize = True
+
+    def batch(self, output, target) -> ValidationResult:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        """Called before each evaluation run; stateful methods clear buffers."""
+
+
+class Top1Accuracy(ValidationMethod):
+    """(reference: ValidationMethod.scala:173)."""
+    name = "Top1Accuracy"
+
+    def batch(self, output, target):
+        pred = jnp.argmax(output, axis=-1)
+        correct = float(jnp.sum(pred == target.astype(pred.dtype)))
+        return ValidationResult((correct, target.size),
+                                lambda v: v[0] / max(1, v[1]))
+
+
+class Top5Accuracy(ValidationMethod):
+    """(reference: ValidationMethod.scala:203)."""
+    name = "Top5Accuracy"
+
+    def batch(self, output, target):
+        k = min(5, output.shape[-1])
+        top = jnp.argsort(output, axis=-1)[..., -k:]
+        hit = jnp.any(top == target.astype(top.dtype)[..., None], axis=-1)
+        return ValidationResult((float(jnp.sum(hit)), target.size),
+                                lambda v: v[0] / max(1, v[1]))
+
+
+class Loss(ValidationMethod):
+    """Mean criterion value (reference: ValidationMethod.scala Loss)."""
+    name = "Loss"
+    maximize = False
+
+    def __init__(self, criterion):
+        self.criterion = criterion
+
+    def batch(self, output, target):
+        l = float(self.criterion.forward(output, target))
+        n = output.shape[0] if hasattr(output, "shape") else 1
+        return ValidationResult((l * n, n), lambda v: v[0] / max(1, v[1]))
+
+
+class MAE(ValidationMethod):
+    """(reference: ValidationMethod.scala MAE)."""
+    name = "MAE"
+    maximize = False
+
+    def batch(self, output, target):
+        err = float(jnp.sum(jnp.abs(output - target)))
+        return ValidationResult((err, output.size), lambda v: v[0] / max(1, v[1]))
+
+
+class TreeNNAccuracy(ValidationMethod):
+    """(reference: ValidationMethod.scala:226 — accuracy on the root
+    prediction of a tree output). Output (B, T, C): uses first position."""
+    name = "TreeNNAccuracy"
+
+    def batch(self, output, target):
+        pred = jnp.argmax(output[:, 0, :], axis=-1)
+        correct = float(jnp.sum(pred == target.astype(pred.dtype)))
+        return ValidationResult((correct, target.shape[0]),
+                                lambda v: v[0] / max(1, v[1]))
+
+
+class HitRatio(ValidationMethod):
+    """HR@k for recommendation (reference: ValidationMethod.scala:660).
+    output: (B, n_items) scores; target: (B,) index of the positive item."""
+    name = "HitRatio"
+
+    def __init__(self, k: int = 10, neg_num: int = 100):
+        self.k = k
+
+    def batch(self, output, target):
+        k = min(self.k, output.shape[-1])
+        top = jnp.argsort(output, axis=-1)[..., -k:]
+        hit = jnp.any(top == target.astype(top.dtype)[..., None], axis=-1)
+        return ValidationResult((float(jnp.sum(hit)), target.shape[0]),
+                                lambda v: v[0] / max(1, v[1]))
+
+
+class NDCG(ValidationMethod):
+    """NDCG@k (reference: ValidationMethod.scala:700)."""
+    name = "NDCG"
+
+    def __init__(self, k: int = 10, neg_num: int = 100):
+        self.k = k
+
+    def batch(self, output, target):
+        k = min(self.k, output.shape[-1])
+        order = jnp.argsort(output, axis=-1)[..., ::-1][..., :k]
+        pos = order == target.astype(order.dtype)[..., None]
+        ranks = jnp.argmax(pos, axis=-1)          # rank of positive if present
+        found = jnp.any(pos, axis=-1)
+        gains = jnp.where(found, 1.0 / jnp.log2(ranks + 2.0), 0.0)
+        return ValidationResult((float(jnp.sum(gains)), target.shape[0]),
+                                lambda v: v[0] / max(1, v[1]))
+
+
+class PrecisionRecallAUC(ValidationMethod):
+    """Area under the PR curve for binary scores
+    (reference: ValidationMethod.scala:756 family). Accumulates raw scores
+    host-side (not streamable as two scalars)."""
+    name = "PrecisionRecallAUC"
+
+    def __init__(self):
+        self.scores = []
+        self.labels = []
+
+    def reset(self):
+        self.scores, self.labels = [], []
+
+    def batch(self, output, target):
+        self.scores.append(np.asarray(output).ravel())
+        self.labels.append(np.asarray(target).ravel())
+        return ValidationResult((0.0, 0.0), lambda v: self._auc())
+
+    def _auc(self) -> float:
+        scores = np.concatenate(self.scores)
+        labels = np.concatenate(self.labels)
+        order = np.argsort(-scores)
+        labels = labels[order]
+        tp = np.cumsum(labels)
+        fp = np.cumsum(1 - labels)
+        precision = tp / np.maximum(tp + fp, 1)
+        recall = tp / max(1, labels.sum())
+        return float(np.trapezoid(precision, recall))
+
+
+def evaluate(model, params, state, data_iter, methods, apply_fn=None):
+    """Run validation methods over an iterator of (x, y) batches — the
+    analogue of `Evaluator.test` (reference: optim/Evaluator.scala:51).
+    `apply_fn(params, state, x) -> output` overrides the default eager
+    forward (pass a jitted closure for speed)."""
+    import jax.numpy as jnp
+    totals: Dict[str, ValidationResult] = {}
+    for m in methods:
+        m.reset()
+    for x, y in data_iter:
+        x, y = jnp.asarray(x), jnp.asarray(y)
+        if apply_fn is not None:
+            out = apply_fn(params, state, x)
+        else:
+            out, _ = model.apply(params, state, x, training=False)
+        for m in methods:
+            r = m.batch(out, y)
+            totals[m.name] = totals[m.name] + r if m.name in totals else r
+    return totals

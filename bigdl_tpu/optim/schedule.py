@@ -1,0 +1,213 @@
+"""Learning-rate schedules (reference: optim/SGD.scala:200-565 — all 12
+regimes). Schedules run host-side each iteration and the resulting scalar LR
+is passed INTO the jitted train step as an argument — this mirrors the
+reference's driver-side `updateHyperParameter` (optim/DistriOptimizer.scala:
+404-408) and keeps XLA programs static (no retrace per LR change)."""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class LearningRateSchedule:
+    """Computes current LR from the optim state dict. Keys used:
+    `neval` (iteration, 0-based), `epoch` (0-based), `loss` / `score`
+    (for Plateau)."""
+
+    def __call__(self, base_lr: float, state: Dict) -> float:
+        raise NotImplementedError
+
+
+class Default(LearningRateSchedule):
+    """Torch default: lr / (1 + neval * lr_decay) (reference: SGD.scala Default)."""
+
+    def __init__(self, lr_decay: float = 0.0):
+        self.lr_decay = lr_decay
+
+    def __call__(self, base_lr, state):
+        return base_lr / (1 + state.get("neval", 0) * self.lr_decay)
+
+
+class Poly(LearningRateSchedule):
+    """lr * (1 - iter/max_iter)^power (reference: SGD.scala Poly)."""
+
+    def __init__(self, power: float, max_iteration: int):
+        self.power, self.max_iteration = power, max_iteration
+
+    def __call__(self, base_lr, state):
+        it = min(state.get("neval", 0), self.max_iteration)
+        return base_lr * (1 - it / self.max_iteration) ** self.power
+
+
+class Step(LearningRateSchedule):
+    """lr * gamma^(floor(iter/step_size)) (reference: SGD.scala Step)."""
+
+    def __init__(self, step_size: int, gamma: float):
+        self.step_size, self.gamma = step_size, gamma
+
+    def __call__(self, base_lr, state):
+        return base_lr * self.gamma ** (state.get("neval", 0) // self.step_size)
+
+
+class MultiStep(LearningRateSchedule):
+    """(reference: SGD.scala MultiStep)."""
+
+    def __init__(self, step_sizes: Sequence[int], gamma: float):
+        self.step_sizes, self.gamma = list(step_sizes), gamma
+
+    def __call__(self, base_lr, state):
+        it = state.get("neval", 0)
+        n = sum(1 for s in self.step_sizes if it >= s)
+        return base_lr * self.gamma ** n
+
+
+class EpochStep(LearningRateSchedule):
+    """lr * gamma^(floor(epoch/step)) (reference: SGD.scala EpochStep)."""
+
+    def __init__(self, step_size: int, gamma: float):
+        self.step_size, self.gamma = step_size, gamma
+
+    def __call__(self, base_lr, state):
+        return base_lr * self.gamma ** (state.get("epoch", 0) // self.step_size)
+
+
+class EpochDecay(LearningRateSchedule):
+    """lr * 0.1^decayType(epoch) (reference: SGD.scala EpochDecay)."""
+
+    def __init__(self, decay_fn):
+        self.decay_fn = decay_fn
+
+    def __call__(self, base_lr, state):
+        return base_lr * 0.1 ** self.decay_fn(state.get("epoch", 0))
+
+
+class Exponential(LearningRateSchedule):
+    """lr * gamma^(iter/decay_step), optionally staircased
+    (reference: SGD.scala Exponential)."""
+
+    def __init__(self, decay_step: int, decay_rate: float, staircase: bool = False):
+        self.decay_step, self.decay_rate, self.staircase = \
+            decay_step, decay_rate, staircase
+
+    def __call__(self, base_lr, state):
+        p = state.get("neval", 0) / self.decay_step
+        if self.staircase:
+            p = math.floor(p)
+        return base_lr * self.decay_rate ** p
+
+
+class NaturalExp(LearningRateSchedule):
+    """lr * exp(-gamma * floor(iter/decay_step)) (reference: SGD.scala NaturalExp)."""
+
+    def __init__(self, decay_step: int, gamma: float):
+        self.decay_step, self.gamma = decay_step, gamma
+
+    def __call__(self, base_lr, state):
+        return base_lr * math.exp(-self.gamma * (state.get("neval", 0) // self.decay_step))
+
+
+class Warmup(LearningRateSchedule):
+    """Linear ramp by `delta` per iteration (reference: SGD.scala Warmup);
+    combine inside SequentialSchedule."""
+
+    def __init__(self, delta: float):
+        self.delta = delta
+
+    def __call__(self, base_lr, state):
+        return base_lr + self.delta * state.get("neval", 0)
+
+
+class Plateau(LearningRateSchedule):
+    """Reduce on metric plateau (reference: SGD.scala Plateau). Stateful
+    host-side: call `record(metric)` after each monitored evaluation."""
+
+    def __init__(self, monitor: str = "score", factor: float = 0.1,
+                 patience: int = 10, mode: str = "min", epsilon: float = 1e-4,
+                 cooldown: int = 0, min_lr: float = 0.0):
+        self.monitor, self.factor, self.patience = monitor, factor, patience
+        self.mode, self.epsilon, self.cooldown, self.min_lr = \
+            mode, epsilon, cooldown, min_lr
+        self.best: Optional[float] = None
+        self.wait = 0
+        self.cooldown_counter = 0
+        self.multiplier = 1.0
+
+    def record(self, metric: float):
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        improved = (self.best is None or
+                    (self.mode == "min" and metric < self.best - self.epsilon) or
+                    (self.mode == "max" and metric > self.best + self.epsilon))
+        if improved:
+            self.best, self.wait = metric, 0
+        elif self.cooldown_counter <= 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.multiplier *= self.factor
+                self.cooldown_counter = self.cooldown
+                self.wait = 0
+
+    def __call__(self, base_lr, state):
+        return max(base_lr * self.multiplier, self.min_lr)
+
+
+class SequentialSchedule(LearningRateSchedule):
+    """Chain of (schedule, iterations) segments
+    (reference: SGD.scala SequentialSchedule)."""
+
+    def __init__(self, iteration_per_epoch: int = 1):
+        self.schedules: List[Tuple[LearningRateSchedule, int]] = []
+        self.iteration_per_epoch = iteration_per_epoch
+
+    def add(self, schedule: LearningRateSchedule, max_iteration: int):
+        self.schedules.append((schedule, max_iteration))
+        return self
+
+    def __call__(self, base_lr, state):
+        it = state.get("neval", 0)
+        offset = 0
+        for sched, max_it in self.schedules:
+            if it < offset + max_it or (sched, max_it) == self.schedules[-1]:
+                sub = dict(state)
+                sub["neval"] = it - offset
+                sub["epoch"] = (it - offset) // max(1, self.iteration_per_epoch)
+                return sched(base_lr, sub)
+            offset += max_it
+        return base_lr
+
+
+class EpochSchedule(LearningRateSchedule):
+    """Explicit per-epoch-range regimes (reference: SGD.scala EpochSchedule +
+    Regime)."""
+
+    def __init__(self, regimes: Sequence[Tuple[int, int, float]]):
+        """regimes: (start_epoch, end_epoch, lr) inclusive, 0-based."""
+        self.regimes = list(regimes)
+
+    def __call__(self, base_lr, state):
+        e = state.get("epoch", 0)
+        for start, end, lr in self.regimes:
+            if start <= e <= end:
+                return lr
+        return base_lr
+
+
+class CosineDecay(LearningRateSchedule):
+    """Cosine annealing with optional warmup (TPU-era standard; no direct
+    reference analogue — extension beyond parity)."""
+
+    def __init__(self, total_steps: int, warmup_steps: int = 0,
+                 final_fraction: float = 0.0):
+        self.total_steps, self.warmup_steps = total_steps, warmup_steps
+        self.final_fraction = final_fraction
+
+    def __call__(self, base_lr, state):
+        it = state.get("neval", 0)
+        if it < self.warmup_steps:
+            return base_lr * (it + 1) / self.warmup_steps
+        p = min(1.0, (it - self.warmup_steps) /
+                max(1, self.total_steps - self.warmup_steps))
+        cos = 0.5 * (1 + math.cos(math.pi * p))
+        return base_lr * (self.final_fraction + (1 - self.final_fraction) * cos)

@@ -1,0 +1,100 @@
+"""Checkpoint / resume (reference: optim/Optimizer.scala:548-577 `saveModel`,
+utils/File.scala, and the OptimMethod-state snapshots that enable mid-epoch
+resume, optim/DistriOptimizer.scala:124-134,466-474).
+
+Format: one directory per snapshot containing
+  * `tree.json`  — pytree structure + array metadata + training counters
+  * `arrays.npz` — all leaves, keyed by flat path
+Pure host-side numpy; device arrays are fetched with `jax.device_get` (under
+multi-host each host saves only addressable shards — hook for later rounds).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+_SEP = "/"
+
+
+def _flatten(tree, prefix="") -> Dict[str, Any]:
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{k}{_SEP}"))
+    elif isinstance(tree, (tuple, list)):
+        for i, v in enumerate(tree):
+            out.update(_flatten(v, f"{prefix}{i}{_SEP}"))
+    else:
+        out[prefix.rstrip(_SEP)] = tree
+    return out
+
+
+def _spec(tree) -> Any:
+    if isinstance(tree, dict):
+        return {"__kind__": "dict", "items": {k: _spec(v) for k, v in tree.items()}}
+    if isinstance(tree, tuple):
+        return {"__kind__": "tuple", "items": [_spec(v) for v in tree]}
+    if isinstance(tree, list):
+        return {"__kind__": "list", "items": [_spec(v) for v in tree]}
+    return {"__kind__": "leaf"}
+
+
+def _unflatten(spec, flat: Dict[str, Any], prefix=""):
+    kind = spec["__kind__"]
+    if kind == "dict":
+        return {k: _unflatten(v, flat, f"{prefix}{k}{_SEP}")
+                for k, v in spec["items"].items()}
+    if kind in ("tuple", "list"):
+        seq = [_unflatten(v, flat, f"{prefix}{i}{_SEP}")
+               for i, v in enumerate(spec["items"])]
+        return tuple(seq) if kind == "tuple" else seq
+    return flat[prefix.rstrip(_SEP)]
+
+
+def save_checkpoint(path: str, trees: Dict[str, Any],
+                    meta: Optional[Dict] = None) -> None:
+    """Save named pytrees (e.g. {'params':…, 'state':…, 'optim':…}) + meta."""
+    tmp = path + ".tmp"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp, exist_ok=True)
+    arrays, specs = {}, {}
+    for name, tree in trees.items():
+        specs[name] = _spec(tree)
+        for k, v in _flatten(tree, f"{name}{_SEP}").items():
+            arrays[k] = np.asarray(jax.device_get(v))
+    np.savez(os.path.join(tmp, "arrays.npz"), **arrays)
+    with open(os.path.join(tmp, "tree.json"), "w") as f:
+        json.dump({"specs": specs, "meta": meta or {}}, f)
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    os.replace(tmp, path)
+
+
+def load_checkpoint(path: str) -> Tuple[Dict[str, Any], Dict]:
+    """Returns (trees, meta)."""
+    with open(os.path.join(path, "tree.json")) as f:
+        doc = json.load(f)
+    npz = np.load(os.path.join(path, "arrays.npz"))
+    flat = {k: npz[k] for k in npz.files}
+    trees = {name: _unflatten(spec, flat, f"{name}{_SEP}")
+             for name, spec in doc["specs"].items()}
+    return trees, doc.get("meta", {})
+
+
+def latest_checkpoint(root: str) -> Optional[str]:
+    """Newest snapshot dir under root (named by iteration)."""
+    import re
+    if not os.path.isdir(root):
+        return None
+    snaps = [d for d in os.listdir(root) if re.fullmatch(r"snapshot-\d+", d)]
+    if not snaps:
+        return None
+    snaps.sort(key=lambda d: int(d.split("-")[-1]))
+    return os.path.join(root, snaps[-1])

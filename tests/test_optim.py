@@ -1,0 +1,175 @@
+"""Optim method / schedule / trigger tests (analogue of
+test/.../optim/{SGD,Adam,...}Spec.scala — convergence on synthetic problems)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import optim
+from bigdl_tpu.core.module import flatten_params
+
+
+def quadratic_problem(method, steps=150, lr_state=None):
+    """Minimize ||x - t||^2 from a fixed start; returns final distance."""
+    t = jnp.array([1.0, -2.0, 3.0])
+    params = {"x": jnp.zeros(3)}
+    slots = method.init_slots(params)
+
+    @jax.jit
+    def step(params, slots, lr, i):
+        grads = jax.grad(lambda p: jnp.sum(jnp.square(p["x"] - t)))(params)
+        return method.update(params, grads, slots, lr, i)
+
+    state = {"neval": 0, "epoch": 0}
+    for i in range(steps):
+        lr = method.current_lr(state)
+        params, slots = step(params, slots, jnp.float32(lr), jnp.int32(i))
+        state["neval"] += 1
+    return float(jnp.max(jnp.abs(params["x"] - t)))
+
+
+@pytest.mark.parametrize("method", [
+    optim.SGD(0.1),
+    optim.SGD(0.05, momentum=0.9),
+    optim.SGD(0.05, momentum=0.9, nesterov=True),
+    optim.Adam(0.1),
+    optim.AdamW(0.1, weight_decay=1e-4),
+    optim.Adamax(0.2),
+    optim.Adadelta(0.9, epsilon=1e-2),  # default 1e-10 needs ~1e4 steps here
+    optim.Adagrad(0.5),
+    optim.RMSprop(0.05),
+    optim.Ftrl(0.5),
+    optim.LarsSGD(0.5, trust=0.1),
+], ids=lambda m: type(m).__name__ + str(id(m) % 97))
+def test_methods_converge(method):
+    assert quadratic_problem(method, steps=300) < 0.15
+
+
+def test_lbfgs_rosenbrock():
+    # reference: test/.../optim/LBFGSSpec uses Rosenbrock
+    def rosen(x):
+        return jnp.sum(100.0 * (x[1:] - x[:-1] ** 2) ** 2 + (1 - x[:-1]) ** 2)
+
+    feval = jax.jit(jax.value_and_grad(rosen))
+    lbfgs = optim.LBFGS(max_iter=120, learning_rate=0.5)
+    x, losses = lbfgs.step(lambda x: feval(x), jnp.zeros(4))
+    assert losses[-1] < losses[0] * 0.01
+
+
+def test_schedules():
+    st = {"neval": 0, "epoch": 0}
+    assert optim.Poly(2, 100)(1.0, {"neval": 50}) == pytest.approx(0.25)
+    assert optim.Step(10, 0.5)(1.0, {"neval": 25}) == pytest.approx(0.25)
+    assert optim.MultiStep([10, 20], 0.1)(1.0, {"neval": 15}) == pytest.approx(0.1)
+    assert optim.EpochStep(2, 0.1)(1.0, {"epoch": 4}) == pytest.approx(0.01)
+    assert optim.Exponential(10, 0.5, staircase=True)(1.0, {"neval": 25}) == \
+        pytest.approx(0.25)
+    assert optim.Warmup(0.01)(0.1, {"neval": 10}) == pytest.approx(0.2)
+    w = optim.CosineDecay(100, warmup_steps=10)
+    assert w(1.0, {"neval": 0}) == pytest.approx(0.1)
+    assert w(1.0, {"neval": 100}) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_sequential_schedule():
+    s = optim.SequentialSchedule(10)
+    s.add(optim.Warmup(0.1), 5).add(optim.Default(), 100)
+    assert s(0.5, {"neval": 3}) == pytest.approx(0.8)
+    assert s(0.5, {"neval": 50}) == pytest.approx(0.5)
+
+
+def test_plateau():
+    p = optim.Plateau(factor=0.1, patience=2, mode="min")
+    for v in [1.0, 0.9, 0.9, 0.9]:   # no improvement for 2 after 0.9
+        p.record(v)
+    assert p(1.0, {}) == pytest.approx(0.1)
+
+
+def test_triggers():
+    T = optim.Trigger
+    assert T.max_epoch(3)({"epoch": 3})
+    assert not T.max_epoch(3)({"epoch": 2})
+    assert T.several_iteration(5)({"neval": 10})
+    assert not T.several_iteration(5)({"neval": 11})
+    assert T.min_loss(0.1)({"loss": 0.05})
+    assert T.and_(T.max_epoch(1), T.min_loss(1.0))({"epoch": 1, "loss": 0.5})
+    ev = T.every_epoch()
+    assert not ev({"epoch": 1, "epoch_finished": False})
+    assert ev({"epoch": 1, "epoch_finished": True})
+    assert not ev({"epoch": 1, "epoch_finished": True})  # fires once per epoch
+
+
+def test_validation_methods():
+    out = jnp.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    tgt = jnp.array([1, 0, 0])
+    top1 = optim.Top1Accuracy().batch(out, tgt)
+    assert top1.result == pytest.approx(2 / 3)
+    top5 = optim.Top5Accuracy().batch(out, tgt)
+    assert top5.result == pytest.approx(1.0)
+    r = top1 + optim.Top1Accuracy().batch(out, tgt)
+    assert r.result == pytest.approx(2 / 3)
+    mae = optim.MAE().batch(jnp.ones(4), jnp.zeros(4))
+    assert mae.result == pytest.approx(1.0)
+
+
+def test_hit_ratio_ndcg():
+    scores = jnp.array([[0.1, 0.9, 0.5, 0.2]])
+    hr = optim.HitRatio(k=2).batch(scores, jnp.array([2]))
+    assert hr.result == pytest.approx(1.0)
+    nd = optim.NDCG(k=2).batch(scores, jnp.array([2]))
+    assert nd.result == pytest.approx(1 / np.log2(3), rel=1e-4)
+
+
+def test_clipping():
+    grads = {"a": jnp.array([3.0, 4.0])}
+    clipped = optim.L2NormClipping(1.0)(grads, grads)
+    np.testing.assert_allclose(jnp.linalg.norm(clipped["a"]), 1.0, rtol=1e-5)
+    c2 = optim.ConstantClipping(-0.5, 0.5)(grads, grads)
+    assert float(jnp.max(c2["a"])) == 0.5
+
+
+def test_frozen_layer_immovable_with_weight_decay(rng=None):
+    """freeze() must win over weight decay (regression for masking order)."""
+    import jax
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset.core import ArrayDataSet
+    m = optim  # noqa  (keep namespace clear)
+    model = nn.Sequential(nn.Linear(4, 4), nn.Linear(4, 2))
+    model[0].freeze()
+    x = np.random.RandomState(0).randn(64, 4).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32)
+    ds = ArrayDataSet(x, y, batch_size=32)
+    opt = optim.Optimizer(model, ds, __import__("bigdl_tpu.nn", fromlist=["x"]).CrossEntropyCriterion(),
+                          optim.SGD(0.1, weight_decay=0.1))
+    opt.set_end_when(optim.Trigger.max_epoch(2))
+    params, _ = opt.optimize()
+    # same rng path the Optimizer uses for initialization
+    init_params, _ = model.init(jax.random.fold_in(jax.random.PRNGKey(1), 0xBD1))
+    np.testing.assert_allclose(params["0"]["weight"],
+                               init_params["0"]["weight"], rtol=1e-6)
+    assert not np.allclose(params["1"]["weight"], init_params["1"]["weight"])
+
+
+def test_mid_epoch_stop_does_not_advance_epoch():
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset.core import ArrayDataSet
+    x = np.random.RandomState(0).randn(640, 4).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32)
+    ds = ArrayDataSet(x, y, batch_size=32)  # 20 batches/epoch
+    model = nn.Sequential(nn.Linear(4, 2))
+    opt = optim.Optimizer(model, ds, nn.CrossEntropyCriterion(), optim.SGD(0.1))
+    opt.set_end_when(optim.Trigger.max_iteration(5))
+    opt.optimize()
+    assert opt.state["neval"] == 5
+    assert opt.state["epoch"] == 0  # partial epoch is not counted
+
+
+def test_prauc_resets_between_runs():
+    m = optim.PrecisionRecallAUC()
+    out = jnp.array([0.9, 0.1, 0.8, 0.3])
+    tgt = jnp.array([1, 0, 1, 0])
+    m.batch(out, tgt)
+    auc1 = m.batch(out, tgt).result
+    m.reset()
+    m.batch(out, tgt)
+    assert len(m.scores) == 1
