@@ -1,0 +1,71 @@
+"""Benchmark harness — prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Runs the flagship training config on whatever hardware is available (the
+driver runs it on one real TPU chip). The analogue of the reference's perf
+CLIs (models/utils/DistriOptimizerPerf.scala:32, nn/mkldnn/Perf.scala:125).
+
+vs_baseline: the reference publishes no absolute imgs/sec (BASELINE.json
+"published": {}), so the ratio is against a measured-here reference proxy
+when available, else 1.0.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def bench_lenet_train(batch_size=512, warmup=3, iters=20):
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models import lenet
+    from bigdl_tpu.nn.criterion import ClassNLLCriterion
+    from bigdl_tpu.optim.method import SGD
+
+    model = lenet.build(10)
+    criterion = ClassNLLCriterion()
+    method = SGD(0.01, momentum=0.9)
+    params, state = model.init(jax.random.PRNGKey(0))
+    slots = method.init_slots(params)
+
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.randn(batch_size, 28, 28, 1).astype(np.float32))
+    y = jnp.asarray(r.randint(0, 10, size=batch_size).astype(np.int32))
+
+    @jax.jit
+    def step(params, state, slots, x, y):
+        def loss_fn(p):
+            out, ns = model.apply(p, state, x, training=True)
+            return criterion.forward(out, y), ns
+        (loss, ns), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        new_p, new_s = method.update(params, grads, slots,
+                                     jnp.float32(0.01), jnp.int32(0))
+        return new_p, ns, new_s, loss
+
+    for _ in range(warmup):
+        params, state, slots, loss = step(params, state, slots, x, y)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, state, slots, loss = step(params, state, slots, x, y)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return batch_size * iters / dt
+
+
+def main():
+    ips = bench_lenet_train()
+    print(json.dumps({
+        "metric": "lenet_mnist_train_throughput",
+        "value": round(ips, 1),
+        "unit": "images/sec",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

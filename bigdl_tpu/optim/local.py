@@ -129,25 +129,38 @@ class Optimizer:
         return self
 
     # ------------------------------------------------------------ step build
-    def _build_step(self) -> Callable:
+    def _make_step(self, compute_dtype=None) -> Callable:
+        """The un-jitted train-step body, shared by the local and
+        distributed trainers (parallel.DistriOptimizer only adds mesh
+        shardings around it). `compute_dtype` enables bf16 forward/backward
+        with fp32 master weights — the TPU-native form of the reference's
+        FP16 wire compression (parameters/FP16CompressedTensor.scala)."""
+        from bigdl_tpu.core.module import cast_floating
         model, criterion, method = self.model, self.criterion, self.method
         processors = list(self.grad_processors)
-        mask = None
-        if any(m._frozen for m in model.modules()):
-            mask = True  # resolved inside builder below
+        frozen = any(m._frozen for m in model.modules())
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2))
         def step(params, model_state, slots, x, y, lr, step_num, rng):
             def loss_fn(p):
-                out, new_ms = model.apply(p, model_state, x,
+                pc = cast_floating(p, compute_dtype) if compute_dtype else p
+                xc = (x.astype(compute_dtype)
+                      if compute_dtype and jnp.issubdtype(x.dtype, jnp.floating)
+                      else x)
+                out, new_ms = model.apply(pc, model_state, xc,
                                           training=True, rng=rng)
+                if compute_dtype:
+                    out = jax.tree.map(
+                        lambda o: o.astype(jnp.float32)
+                        if jnp.issubdtype(o.dtype, jnp.floating) else o, out)
                 return criterion.forward(out, y), new_ms
 
             (loss, new_ms), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
+            if compute_dtype:
+                grads = cast_floating(grads, jnp.float32)
             for proc in processors:
                 grads = proc(grads, params)
-            if mask is None:
+            if not frozen:
                 new_params, new_slots = method.update(params, grads, slots,
                                                       lr, step_num)
             else:
@@ -166,6 +179,22 @@ class Optimizer:
             return new_params, new_ms, new_slots, loss
 
         return step
+
+    def _build_step(self) -> Callable:
+        return jax.jit(self._make_step(), donate_argnums=(0, 1, 2))
+
+    # ----------------------------------------------------- placement hooks
+    # Overridden by parallel.DistriOptimizer to lay trees/batches out on the
+    # mesh; the local trainer leaves placement to jit's defaults.
+    def _place_trees(self, params, model_state, slots):
+        return params, model_state, slots
+
+    def _place_batch(self, x, y):
+        return jnp.asarray(x), jnp.asarray(y)
+
+    def _build_eval_fn(self):
+        return jax.jit(
+            lambda p, s, x: self.model.apply(p, s, x, training=False)[0])
 
     # --------------------------------------------------------------- resume
     def resume(self, path: str) -> bool:
@@ -192,11 +221,11 @@ class Optimizer:
             params, model_state = self.model.init(
                 jax.random.fold_in(rng, 0xBD1))
             slots = self.method.init_slots(params)
+        params, model_state, slots = self._place_trees(params, model_state, slots)
         step = self._build_step()
         st = self.state
 
-        self._eval_fn = jax.jit(
-            lambda p, s, x: self.model.apply(p, s, x, training=False)[0])
+        self._eval_fn = self._build_eval_fn()
 
         while not self.end_when(st):
             epoch_start = time.time()
@@ -206,8 +235,9 @@ class Optimizer:
                 it_start = time.time()
                 lr = self.method.current_lr(st)
                 rng, sub = jax.random.split(rng)
+                xd, yd = self._place_batch(x, y)
                 params, model_state, slots, loss = step(
-                    params, model_state, slots, jnp.asarray(x), jnp.asarray(y),
+                    params, model_state, slots, xd, yd,
                     jnp.float32(lr), jnp.int32(st["neval"]), sub)
                 loss_f = float(loss)       # sync point, like reference's driver
                 n = x.shape[0]
@@ -249,6 +279,11 @@ class Optimizer:
     def _maybe_validate(self, params, model_state, st):
         if self.val_trigger is None or not self.val_trigger(st):
             return
+        # a trigger can match both on an epoch's last iteration and again at
+        # epoch end — don't run validation twice for the same step
+        if getattr(self, "_last_val_neval", -1) == st["neval"]:
+            return
+        self._last_val_neval = st["neval"]
         from bigdl_tpu.optim.metrics import evaluate
         totals = evaluate(self.model, params, model_state, self.val_dataset,
                           self.val_methods, apply_fn=self._eval_fn)
@@ -263,6 +298,9 @@ class Optimizer:
     def _maybe_checkpoint(self, params, model_state, slots, st):
         if self.ckpt_trigger is None or not self.ckpt_trigger(st):
             return
+        if getattr(self, "_last_ckpt_neval", -1) == st["neval"]:
+            return
+        self._last_ckpt_neval = st["neval"]
         path = f"{self.ckpt_path}/snapshot-{st['neval']}"
         meta = {k: v for k, v in st.items()
                 if isinstance(v, (int, float, bool, str))}

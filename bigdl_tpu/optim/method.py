@@ -69,12 +69,15 @@ class SGD(OptimMethod):
                  learning_rate_schedule: Optional[LearningRateSchedule] = None):
         super().__init__(learning_rate, learning_rate_schedule, weight_decay)
         self.momentum = momentum
-        self.dampening = momentum if dampening is None and nesterov else \
-            (dampening if dampening is not None else 0.0)
+        # reference: dampening defaults to momentum (SGD.scala:65), and
+        # nesterov requires momentum > 0 with zero dampening (SGD.scala:75)
+        if dampening is None:
+            dampening = 0.0 if nesterov else momentum
+        self.dampening = dampening
         self.nesterov = nesterov
-        if nesterov and (self.momentum <= 0 or self.dampening != 0):
-            # reference requires dampening==0 with nesterov (SGD.scala)
-            self.dampening = 0.0
+        if nesterov and (momentum <= 0 or self.dampening != 0):
+            raise ValueError(
+                "Nesterov momentum requires a momentum and zero dampening")
 
     def init_slots(self, params):
         if self.momentum == 0.0:

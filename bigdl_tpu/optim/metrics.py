@@ -105,19 +105,37 @@ class TreeNNAccuracy(ValidationMethod):
                                 lambda v: v[0] / max(1, v[1]))
 
 
+def _positive_ranks(output, target, neg_num):
+    """Rank of the positive item within its candidate group.
+
+    Two input layouts (reference: ValidationMethod.scala:660 — NCF eval
+    scores groups of 1 positive + `neg_num` negatives):
+      * 2-D (B, n_items) scores + (B,) positive index — groups are rows;
+      * flat pairwise scores + 0/1 positive labels — reshaped into
+        (neg_num+1)-sized groups.
+    Returns (ranks, group_count)."""
+    out, tgt = output, target
+    if out.ndim == 1 or (out.ndim == 2 and out.shape[-1] == 1):
+        out = out.reshape(-1, neg_num + 1)
+        pos = jnp.argmax(tgt.reshape(-1, neg_num + 1), axis=-1)
+    else:
+        pos = target.astype(jnp.int32)
+    pos_score = jnp.take_along_axis(out, pos[..., None], axis=-1)
+    ranks = jnp.sum(out > pos_score, axis=-1)
+    return ranks, ranks.shape[0]
+
+
 class HitRatio(ValidationMethod):
-    """HR@k for recommendation (reference: ValidationMethod.scala:660).
-    output: (B, n_items) scores; target: (B,) index of the positive item."""
+    """HR@k for recommendation (reference: ValidationMethod.scala:660)."""
     name = "HitRatio"
 
     def __init__(self, k: int = 10, neg_num: int = 100):
-        self.k = k
+        self.k, self.neg_num = k, neg_num
 
     def batch(self, output, target):
-        k = min(self.k, output.shape[-1])
-        top = jnp.argsort(output, axis=-1)[..., -k:]
-        hit = jnp.any(top == target.astype(top.dtype)[..., None], axis=-1)
-        return ValidationResult((float(jnp.sum(hit)), target.shape[0]),
+        ranks, n = _positive_ranks(output, target, self.neg_num)
+        hit = ranks < self.k
+        return ValidationResult((float(jnp.sum(hit)), n),
                                 lambda v: v[0] / max(1, v[1]))
 
 
@@ -126,16 +144,13 @@ class NDCG(ValidationMethod):
     name = "NDCG"
 
     def __init__(self, k: int = 10, neg_num: int = 100):
-        self.k = k
+        self.k, self.neg_num = k, neg_num
 
     def batch(self, output, target):
-        k = min(self.k, output.shape[-1])
-        order = jnp.argsort(output, axis=-1)[..., ::-1][..., :k]
-        pos = order == target.astype(order.dtype)[..., None]
-        ranks = jnp.argmax(pos, axis=-1)          # rank of positive if present
-        found = jnp.any(pos, axis=-1)
-        gains = jnp.where(found, 1.0 / jnp.log2(ranks + 2.0), 0.0)
-        return ValidationResult((float(jnp.sum(gains)), target.shape[0]),
+        ranks, n = _positive_ranks(output, target, self.neg_num)
+        gains = jnp.where(ranks < self.k,
+                          jnp.log(2.0) / jnp.log(ranks + 2.0), 0.0)
+        return ValidationResult((float(jnp.sum(gains)), n),
                                 lambda v: v[0] / max(1, v[1]))
 
 

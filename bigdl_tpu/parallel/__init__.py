@@ -1,0 +1,27 @@
+"""Distributed runtime — mesh construction, sharding rules, collectives, and
+the distributed optimizer.
+
+This package is the TPU-native replacement for the reference's entire
+distribution stack: `utils/Engine.scala` (runtime bring-up),
+`parameters/AllReduceParameter.scala` (BlockManager parameter-server
+all-reduce), and `optim/DistriOptimizer.scala` (two-Spark-jobs-per-iteration
+sync SGD). Here a single jitted step over a `jax.sharding.Mesh` subsumes all
+three: XLA's SPMD partitioner inserts the collectives (reduce-scatter /
+all-gather over ICI) that the reference hand-built on Spark block fetches.
+"""
+
+from bigdl_tpu.parallel.mesh import (
+    Engine, create_mesh, mesh_shape_for, DATA_AXIS, MODEL_AXIS, PIPE_AXIS,
+    SEQ_AXIS, EXPERT_AXIS,
+)
+from bigdl_tpu.parallel.sharding import (
+    ShardingRules, batch_spec, replicated_spec, zero1_spec, shard_tree,
+)
+from bigdl_tpu.parallel.distri import DistriOptimizer
+
+__all__ = [
+    "Engine", "create_mesh", "mesh_shape_for",
+    "DATA_AXIS", "MODEL_AXIS", "PIPE_AXIS", "SEQ_AXIS", "EXPERT_AXIS",
+    "ShardingRules", "batch_spec", "replicated_spec", "zero1_spec",
+    "shard_tree", "DistriOptimizer",
+]

@@ -1,0 +1,147 @@
+"""Distributed synchronous-SGD trainer over a device mesh — the analogue of
+the reference's `DistriOptimizer` (reference: optim/DistriOptimizer.scala:
+185-516, 1,016 LoC) and its BlockManager parameter server
+(parameters/AllReduceParameter.scala:80-333).
+
+TPU-first design: the reference runs TWO Spark jobs per iteration —
+(1) forward/backward on every node with a weight pull, (2) per-shard gradient
+aggregation + optimizer update + weight push (SURVEY §3.2). Here the entire
+iteration is ONE jitted SPMD program:
+
+  * batch sharded across the 'data' mesh axis (the reference's co-partitioned
+    data/model RDD zip, optim/DistriOptimizer.scala:204-205);
+  * gradient all-reduce inserted automatically by XLA's partitioner (the
+    reference hand-builds reduce-scatter+all-gather on FP16 block fetches,
+    AllReduceParameter.scala:201-328 — on TPU this rides ICI);
+  * ZeRO-1: optimizer slots sharded across 'data' (the reference's "each
+    node owns 1/N of the flattened parameters and updates only its shard",
+    DistriOptimizer.scala:358-396) — XLA turns the slot-sharded update into
+    reduce-scatter + shard-local update + all-gather;
+  * tensor parallelism via `ShardingRules` on params (parity-plus: the
+    reference has no TP, SURVEY §2.13);
+  * FP16 wire compression (FP16CompressedTensor.scala:43-173) maps to
+    native bf16 gradients via `compute_dtype`.
+
+Straggler dropping (DistriOptimizer.scala:241-283) has no analogue: a TPU
+slice is synchronous by construction. Driver-side failure retry
+(:886-963) is `resume()` + checkpoint-restart on slice reconfiguration.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.core.module import Criterion, Module
+from bigdl_tpu.optim.local import Optimizer
+from bigdl_tpu.optim.method import OptimMethod
+from bigdl_tpu.parallel.mesh import DATA_AXIS, Engine
+from bigdl_tpu.parallel.sharding import (
+    ShardingRules, batch_spec, zero1_spec)
+
+log = logging.getLogger("bigdl_tpu")
+
+
+class DistriOptimizer(Optimizer):
+    """Mesh-parallel trainer. Drop-in for the local `Optimizer`:
+
+        mesh = create_mesh()                       # all chips, DP
+        opt = DistriOptimizer(model, dataset, criterion, Adam(1e-3),
+                              mesh=mesh)
+        params, model_state = opt.optimize()
+
+    `dataset` yields GLOBAL batches (batch dim divisible by the data-axis
+    size). With multi-host JAX, each process feeds its local slice and
+    batches are assembled via `jax.make_array_from_process_local_data`.
+
+    Options:
+      rules          — ShardingRules for tensor-parallel params (default
+                       replicated).
+      zero1          — shard optimizer slots across 'data' (default True).
+      compute_dtype  — bf16 forward/backward with fp32 master weights
+                       (the TPU-native form of the reference's FP16 wire
+                       compression + fp32 master copy).
+    """
+
+    def __init__(self, model: Module, dataset, criterion: Criterion,
+                 optim_method: Optional[OptimMethod] = None, *,
+                 mesh: Optional[Mesh] = None,
+                 rules: Optional[ShardingRules] = None,
+                 zero1: bool = True,
+                 compute_dtype: Any = None,
+                 seed: int = 1):
+        super().__init__(model, dataset, criterion, optim_method, seed=seed)
+        self.mesh = mesh if mesh is not None else Engine.mesh()
+        self.rules = rules or ShardingRules()
+        self.zero1 = zero1
+        self.compute_dtype = compute_dtype
+        self._data_axis_size = (self.mesh.shape[DATA_AXIS]
+                                if DATA_AXIS in self.mesh.axis_names else 1)
+
+    # ------------------------------------------------------------- placement
+    def _param_shardings(self, params):
+        specs = self.rules.tree_specs(params)
+        return jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs,
+                            is_leaf=lambda x: isinstance(x, P))
+
+    def _slot_shardings(self, slots):
+        if self.zero1:
+            spec_of = lambda leaf: NamedSharding(
+                self.mesh, zero1_spec(leaf, self.mesh))
+        else:
+            spec_of = lambda leaf: NamedSharding(self.mesh, P())
+        return jax.tree.map(spec_of, slots)
+
+    def _replicated(self, tree):
+        return jax.tree.map(
+            lambda _: NamedSharding(self.mesh, P()), tree)
+
+    def _place_trees(self, params, model_state, slots):
+        params = jax.tree.map(jax.device_put, params,
+                              self._param_shardings(params))
+        model_state = jax.tree.map(
+            lambda x: jax.device_put(x, NamedSharding(self.mesh, P())),
+            model_state)
+        slots = jax.tree.map(jax.device_put, slots,
+                             self._slot_shardings(slots))
+        return params, model_state, slots
+
+    def _batch_sharding(self, arr):
+        return NamedSharding(self.mesh, batch_spec(self.mesh, arr.ndim))
+
+    def _place_array(self, x):
+        import numpy as np
+        x = np.asarray(x)
+        sh = self._batch_sharding(x)
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(sh, x)
+        return jax.device_put(x, sh)
+
+    def _place_batch(self, x, y):
+        return self._place_array(x), self._place_array(y)
+
+    # ------------------------------------------------------------ step build
+    def _build_step(self):
+        step = self._make_step(self.compute_dtype)
+        # Pin layouts so XLA partitions rather than replicates: params per
+        # TP rules, slots per ZeRO-1, batch over 'data'.
+        params_shape, _ = jax.eval_shape(
+            self.model.init, jax.random.PRNGKey(0))
+        slots_shape = jax.eval_shape(self.method.init_slots, params_shape)
+        p_sh = self._param_shardings(params_shape)
+        s_sh = self._slot_shardings(slots_shape)
+        rep = NamedSharding(self.mesh, P())
+        return jax.jit(
+            step, donate_argnums=(0, 1, 2),
+            # model_state & batches: None = keep the layout _place_* chose
+            in_shardings=(p_sh, None, s_sh, None, None, rep, rep, rep),
+            out_shardings=(p_sh, None, s_sh, rep))
+
+    def _build_eval_fn(self):
+        eval_fn = jax.jit(
+            lambda p, s, x: self.model.apply(p, s, x, training=False)[0])
+        return lambda p, s, x: eval_fn(p, s, self._place_array(x))

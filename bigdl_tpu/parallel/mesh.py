@@ -1,0 +1,137 @@
+"""Runtime bring-up and device-mesh construction — the analogue of the
+reference's `Engine` singleton (reference: utils/Engine.scala:106-242).
+
+The reference discovers nodes/cores from SparkConf per cluster-manager type
+(utils/Engine.scala:485-567) and sizes thread pools; here the "cluster" is a
+`jax.sharding.Mesh` over the device grid, and multi-host bring-up is
+`jax.distributed.initialize` (the analogue of the reference's per-executor
+singleton check + py4j gateway bootstrap, utils/Engine.scala:146-186,266).
+
+Mesh axes (superset of the reference's parallelism inventory, SURVEY §2.13 —
+the reference only has data parallelism; tensor/pipeline/sequence/expert axes
+are the parity-plus TPU extensions):
+  data   — batch sharding (sync data-parallel SGD)
+  model  — tensor parallelism (megatron-style param sharding)
+  pipe   — pipeline stages
+  seq    — sequence/context parallelism (ring attention)
+  expert — MoE expert parallelism
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+log = logging.getLogger("bigdl_tpu")
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+PIPE_AXIS = "pipe"
+SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
+
+# Canonical axis order: data outermost (DCN-friendly), then pipe, then the
+# ICI-heavy axes innermost so tensor/sequence collectives ride the
+# fastest links (scaling-book recipe: keep high-traffic axes on ICI).
+AXIS_ORDER = (DATA_AXIS, PIPE_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
+
+
+def mesh_shape_for(n_devices: int, *, model: int = 1, pipe: int = 1,
+                   seq: int = 1, expert: int = 1,
+                   data: Optional[int] = None) -> Dict[str, int]:
+    """Resolve a full axis->size dict; `data` auto-fills remaining devices."""
+    fixed = model * pipe * seq * expert
+    if n_devices % fixed != 0:
+        raise ValueError(
+            f"{n_devices} devices not divisible by model*pipe*seq*expert={fixed}")
+    if data is None:
+        data = n_devices // fixed
+    if data * fixed != n_devices:
+        raise ValueError(
+            f"mesh {data}x{fixed} != {n_devices} devices")
+    return {DATA_AXIS: data, PIPE_AXIS: pipe, EXPERT_AXIS: expert,
+            SEQ_AXIS: seq, MODEL_AXIS: model}
+
+
+def create_mesh(devices: Optional[Sequence[jax.Device]] = None, *,
+                model: int = 1, pipe: int = 1, seq: int = 1,
+                expert: int = 1, data: Optional[int] = None,
+                drop_trivial_axes: bool = False) -> Mesh:
+    """Build a named mesh over `devices` (default: all).
+
+    With `drop_trivial_axes`, size-1 axes are omitted — useful for tests
+    that want a pure-DP mesh named ('data',).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    shape = mesh_shape_for(len(devices), model=model, pipe=pipe, seq=seq,
+                           expert=expert, data=data)
+    names = tuple(a for a in AXIS_ORDER
+                  if not (drop_trivial_axes and shape[a] == 1))
+    if not names:
+        names = (DATA_AXIS,)
+    dims = tuple(shape[a] for a in names)
+    grid = np.asarray(devices).reshape(dims)
+    return Mesh(grid, names)
+
+
+class Engine:
+    """Process-level runtime singleton (reference: utils/Engine.scala).
+
+    `Engine.init()` is the one call a program makes before training:
+      * multi-host: wires up the JAX distributed runtime (analogue of the
+        reference's executor bootstrap, utils/Engine.scala:146-186);
+      * builds the global mesh from env/config;
+      * enforces the reference's one-Engine-per-process singleton check
+        (utils/Engine.scala:266).
+    """
+
+    _mesh: Optional[Mesh] = None
+    _initialized = False
+
+    @classmethod
+    def init(cls, *, coordinator_address: Optional[str] = None,
+             num_processes: Optional[int] = None,
+             process_id: Optional[int] = None,
+             model: int = 1, pipe: int = 1, seq: int = 1, expert: int = 1,
+             data: Optional[int] = None) -> Mesh:
+        if cls._initialized:
+            raise RuntimeError(
+                "Engine.init called twice in one process (reference enforces "
+                "a per-executor singleton, utils/Engine.scala:266); call "
+                "Engine.reset() first if you really mean it")
+        if coordinator_address is not None:
+            jax.distributed.initialize(coordinator_address=coordinator_address,
+                                       num_processes=num_processes,
+                                       process_id=process_id)
+        cls._mesh = create_mesh(model=model, pipe=pipe, seq=seq,
+                                expert=expert, data=data)
+        cls._initialized = True
+        log.info("Engine: %d devices, mesh %s", len(jax.devices()),
+                 dict(zip(cls._mesh.axis_names,
+                          cls._mesh.devices.shape)))
+        return cls._mesh
+
+    @classmethod
+    def mesh(cls) -> Mesh:
+        if cls._mesh is None:
+            cls._mesh = create_mesh()
+        return cls._mesh
+
+    @classmethod
+    def node_number(cls) -> int:
+        return jax.process_count()
+
+    @classmethod
+    def core_number(cls) -> int:
+        return jax.local_device_count()
+
+    @classmethod
+    def reset(cls):
+        cls._mesh = None
+        cls._initialized = False

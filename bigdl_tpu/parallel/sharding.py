@@ -1,0 +1,108 @@
+"""Sharding rules — how params / optimizer slots / batches are laid out on
+the mesh.
+
+This replaces the reference's parameter-server layout: there, the flattened
+parameter vector is sliced into `partitionNum` chunks, each node owning one
+slice of weights+gradients+optimizer state (reference:
+parameters/AllReduceParameter.scala:80-142, optim/DistriOptimizer.scala:
+358-396). Here:
+
+  * weights are replicated (pure DP) or partitioned by rule (TP);
+  * optimizer slots get a ZeRO-1 spec: each leaf sharded across the 'data'
+    axis on its largest divisible dimension — the exact analogue of the
+    reference's "each node updates only its shard", but XLA inserts the
+    reduce-scatter/all-gather instead of BlockManager block fetches;
+  * batches are sharded across 'data' on dim 0.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.parallel.mesh import DATA_AXIS
+
+
+def batch_spec(mesh: Mesh, ndim: int = 1, axes=(DATA_AXIS,)) -> P:
+    """Shard dim 0 across the data(+expert/pipe if fused) axes."""
+    names = [a for a in axes if a in mesh.axis_names and
+             mesh.shape[a] > 1] or [a for a in axes if a in mesh.axis_names]
+    return P(tuple(names) if len(names) > 1 else (names[0] if names else None),
+             *([None] * (ndim - 1)))
+
+
+def replicated_spec() -> P:
+    return P()
+
+
+def zero1_spec(leaf, mesh: Mesh, axis: str = DATA_AXIS) -> P:
+    """ZeRO-1 layout for one optimizer-slot leaf: shard the largest
+    dimension divisible by the data-axis size; replicate if none divides
+    (small biases/scalars — same as the reference keeping tiny tails on one
+    shard)."""
+    if axis not in mesh.axis_names:
+        return P()
+    n = mesh.shape[axis]
+    if n <= 1 or not hasattr(leaf, "shape") or leaf.ndim == 0:
+        return P()
+    dims = sorted(range(leaf.ndim), key=lambda d: -leaf.shape[d])
+    for d in dims:
+        if leaf.shape[d] % n == 0 and leaf.shape[d] >= n:
+            spec = [None] * leaf.ndim
+            spec[d] = axis
+            return P(*spec)
+    return P()
+
+
+class ShardingRules:
+    """Regex path -> PartitionSpec mapping for tensor parallelism.
+
+    Param pytree paths are '/'-joined key paths (e.g. 'encoder/0/weight').
+    First matching rule wins; default is replicated. Example (megatron MLP):
+
+        rules = ShardingRules([
+            (r".*ffn/up/weight", P(None, "model")),
+            (r".*ffn/down/weight", P("model", None)),
+        ])
+    """
+
+    def __init__(self, rules: Sequence[Tuple[str, P]] = ()):
+        self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def spec_for(self, path: str, leaf) -> P:
+        for pat, spec in self.rules:
+            if pat.fullmatch(path):
+                return spec
+        return P()
+
+    def tree_specs(self, tree) -> Any:
+        paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        specs = []
+        for path, leaf in paths_leaves:
+            key = "/".join(_key_str(k) for k in path)
+            specs.append(self.spec_for(key, leaf))
+        return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def _key_str(k) -> str:
+    if hasattr(k, "key"):
+        return str(k.key)
+    if hasattr(k, "idx"):
+        return str(k.idx)
+    return str(k)
+
+
+def shard_tree(tree, mesh: Mesh, specs) -> Any:
+    """device_put every leaf with its NamedSharding."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        tree, specs)
+
+
+def named_shardings(tree_specs, mesh: Mesh):
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), tree_specs,
+                        is_leaf=lambda x: isinstance(x, P))

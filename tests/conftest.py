@@ -11,6 +11,11 @@ if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
+
+# The axon TPU plugin (this image's tunnel to the real chip) overrides the
+# JAX_PLATFORMS env var; the config knob still wins, so force CPU here before
+# any backend is initialized.
+jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

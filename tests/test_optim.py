@@ -40,7 +40,7 @@ def quadratic_problem(method, steps=150, lr_state=None):
     optim.Adagrad(0.5),
     optim.RMSprop(0.05),
     optim.Ftrl(0.5),
-    optim.LarsSGD(0.5, trust=0.1),
+    optim.LarsSGD(0.2, momentum=0.5, trust=0.1),
 ], ids=lambda m: type(m).__name__ + str(id(m) % 97))
 def test_methods_converge(method):
     assert quadratic_problem(method, steps=300) < 0.15
