@@ -34,6 +34,11 @@ from bigdl_tpu.nn.arithmetic import (CAddTable, CMulTable, CSubTable, CDivTable,
                                      Sum, Mean, Max, Min, Clip, MM, MV, DotProduct,
                                      CosineDistance, PairwiseDistance, Scale,
                                      MixtureTable)
+from bigdl_tpu.nn.recurrent import (Cell, RnnCell, LSTM, LSTMPeephole, GRU,
+                                    ConvLSTMPeephole, MultiRNNCell, Recurrent,
+                                    BiRecurrent, RecurrentDecoder,
+                                    TimeDistributed, SequenceBeamSearch,
+                                    beam_search, tile_beam)
 from bigdl_tpu.nn.criterion import (ClassNLLCriterion, CrossEntropyCriterion,
                                     MSECriterion, AbsCriterion, SmoothL1Criterion,
                                     SmoothL1CriterionWithWeights, BCECriterion,

@@ -1,0 +1,476 @@
+"""Recurrent layer stack — the TPU-native analogue of the reference's
+recurrent machinery (reference: nn/Recurrent.scala:47-243, nn/Cell.scala,
+nn/LSTM.scala:54, nn/LSTMPeephole.scala, nn/GRU.scala, nn/RNN.scala,
+nn/ConvLSTMPeephole.scala, nn/MultiRNNCell.scala, nn/BiRecurrent.scala,
+nn/RecurrentDecoder.scala, nn/TimeDistributed.scala).
+
+TPU-first design: the reference unrolls time in Scala, cloning the cell per
+step and sharing weights (Recurrent.scala:172,243). Under XLA, per-step
+Python unrolling would bloat the program and defeat fusion; instead each
+cell is a pure step function and the `Recurrent` container runs it with
+`jax.lax.scan` — ONE compiled step body, sequential over time on-device,
+weights naturally shared. Gate matmuls are packed (one [in, 4*hidden] gemm
+per step instead of four) to keep the MXU busy.
+
+Shapes: inputs are batch-major (B, T, ...) like the reference's default
+`batchNormParams`-free path; `scan` runs over T via swapaxes, which XLA
+lays out efficiently.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core import init as initializers
+from bigdl_tpu.core.module import Module, ParamSpec, _fold_name
+
+
+class Cell(Module):
+    """One-step recurrent cell contract (reference: nn/Cell.scala).
+
+    Subclasses implement:
+      * `init_hidden(batch, dtype)` -> hidden pytree (zeros);
+      * `step(params, hidden, x)` -> (output, new_hidden).
+    """
+
+    hidden_size: int
+
+    def init_hidden(self, batch: int, dtype=jnp.float32):
+        raise NotImplementedError
+
+    def step(self, params, hidden, x):
+        raise NotImplementedError
+
+    # A bare cell can run as a module on (B, features) input for tests.
+    def _apply(self, params, state, *inputs, training=False, rng=None):
+        x = inputs[0]
+        hidden = inputs[1] if len(inputs) > 1 else self.init_hidden(
+            x.shape[0], x.dtype)
+        out, new_hidden = self.step(params, hidden, x)
+        return (out, new_hidden), state
+
+
+class RnnCell(Cell):
+    """Vanilla RNN cell: h' = act(W_x x + W_h h + b)
+    (reference: nn/RNN.scala RnnCell)."""
+
+    def __init__(self, input_size: int, hidden_size: int, activation=jnp.tanh,
+                 name=None):
+        super().__init__(name)
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+
+    def param_specs(self):
+        i, h = self.input_size, self.hidden_size
+        return {
+            "w_i": ParamSpec((i, h), initializers.xavier, fan_in=i, fan_out=h),
+            "w_h": ParamSpec((h, h), initializers.xavier, fan_in=h, fan_out=h),
+            "bias": ParamSpec((h,), initializers.zeros),
+        }
+
+    def init_hidden(self, batch, dtype=jnp.float32):
+        return jnp.zeros((batch, self.hidden_size), dtype)
+
+    def step(self, params, hidden, x):
+        h = self.activation(x @ params["w_i"] + hidden @ params["w_h"]
+                            + params["bias"])
+        return h, h
+
+
+class LSTM(Cell):
+    """LSTM cell with packed gates (reference: nn/LSTM.scala:54 builds four
+    separate i2g/h2g Linears; here one (in, 4H) and one (H, 4H) matmul feed
+    the MXU). Gate order: input, forget, cell(g), output. `forget_bias`
+    initialises the forget gate bias (common practice; reference default 0)."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 forget_bias: float = 0.0, name=None):
+        super().__init__(name)
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.forget_bias = forget_bias
+
+    def param_specs(self):
+        i, h = self.input_size, self.hidden_size
+        return {
+            "w_i": ParamSpec((i, 4 * h), initializers.xavier,
+                             fan_in=i, fan_out=4 * h),
+            "w_h": ParamSpec((h, 4 * h), initializers.xavier,
+                             fan_in=h, fan_out=4 * h),
+            "bias": ParamSpec((4 * h,), initializers.zeros),
+        }
+
+    def init(self, rng, dtype=None):
+        params, state = super().init(rng, dtype=dtype)
+        if self.forget_bias:
+            h = self.hidden_size
+            params["bias"] = params["bias"].at[h:2 * h].set(self.forget_bias)
+        return params, state
+
+    def init_hidden(self, batch, dtype=jnp.float32):
+        h = jnp.zeros((batch, self.hidden_size), dtype)
+        c = jnp.zeros((batch, self.hidden_size), dtype)
+        return (h, c)
+
+    def step(self, params, hidden, x):
+        h_prev, c_prev = hidden
+        gates = x @ params["w_i"] + h_prev @ params["w_h"] + params["bias"]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c = f * c_prev + i * g
+        h = o * jnp.tanh(c)
+        return h, (h, c)
+
+
+class LSTMPeephole(Cell):
+    """LSTM with peephole connections from the cell state to the gates
+    (reference: nn/LSTMPeephole.scala — diagonal peephole weights)."""
+
+    def __init__(self, input_size: int, hidden_size: int, name=None):
+        super().__init__(name)
+        self.input_size, self.hidden_size = input_size, hidden_size
+
+    def param_specs(self):
+        i, h = self.input_size, self.hidden_size
+        return {
+            "w_i": ParamSpec((i, 4 * h), initializers.xavier,
+                             fan_in=i, fan_out=4 * h),
+            "w_h": ParamSpec((h, 4 * h), initializers.xavier,
+                             fan_in=h, fan_out=4 * h),
+            "bias": ParamSpec((4 * h,), initializers.zeros),
+            "peep_i": ParamSpec((h,), initializers.zeros),
+            "peep_f": ParamSpec((h,), initializers.zeros),
+            "peep_o": ParamSpec((h,), initializers.zeros),
+        }
+
+    def init_hidden(self, batch, dtype=jnp.float32):
+        return (jnp.zeros((batch, self.hidden_size), dtype),
+                jnp.zeros((batch, self.hidden_size), dtype))
+
+    def step(self, params, hidden, x):
+        h_prev, c_prev = hidden
+        gates = x @ params["w_i"] + h_prev @ params["w_h"] + params["bias"]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i + params["peep_i"] * c_prev)
+        f = jax.nn.sigmoid(f + params["peep_f"] * c_prev)
+        g = jnp.tanh(g)
+        c = f * c_prev + i * g
+        o = jax.nn.sigmoid(o + params["peep_o"] * c)
+        h = o * jnp.tanh(c)
+        return h, (h, c)
+
+
+class GRU(Cell):
+    """GRU cell (reference: nn/GRU.scala). Packed reset/update gates; the
+    candidate uses the reset-gated hidden state (standard GRU, matching the
+    reference's p=0 dense path)."""
+
+    def __init__(self, input_size: int, hidden_size: int, name=None):
+        super().__init__(name)
+        self.input_size, self.hidden_size = input_size, hidden_size
+
+    def param_specs(self):
+        i, h = self.input_size, self.hidden_size
+        return {
+            "w_i": ParamSpec((i, 3 * h), initializers.xavier,
+                             fan_in=i, fan_out=3 * h),
+            "w_h": ParamSpec((h, 2 * h), initializers.xavier,
+                             fan_in=h, fan_out=2 * h),
+            "w_hc": ParamSpec((h, h), initializers.xavier,
+                              fan_in=h, fan_out=h),
+            "bias": ParamSpec((3 * h,), initializers.zeros),
+        }
+
+    def init_hidden(self, batch, dtype=jnp.float32):
+        return jnp.zeros((batch, self.hidden_size), dtype)
+
+    def step(self, params, hidden, x):
+        h = self.hidden_size
+        xi = x @ params["w_i"] + params["bias"]
+        hr_hu = hidden @ params["w_h"]
+        r = jax.nn.sigmoid(xi[..., :h] + hr_hu[..., :h])
+        u = jax.nn.sigmoid(xi[..., h:2 * h] + hr_hu[..., h:])
+        cand = jnp.tanh(xi[..., 2 * h:] + (r * hidden) @ params["w_hc"])
+        h_new = u * hidden + (1.0 - u) * cand
+        return h_new, h_new
+
+
+class ConvLSTMPeephole(Cell):
+    """Convolutional LSTM over (B, H, W, C) feature maps
+    (reference: nn/ConvLSTMPeephole.scala — conv gates + elementwise
+    peepholes). `spatial` fixes the map size so hidden state shapes are
+    static for XLA."""
+
+    def __init__(self, input_channels: int, hidden_channels: int,
+                 kernel: int, spatial: Tuple[int, int], peephole: bool = True,
+                 name=None):
+        super().__init__(name)
+        self.input_channels, self.hidden_channels = input_channels, hidden_channels
+        self.kernel, self.spatial, self.peephole = kernel, spatial, peephole
+        self.hidden_size = hidden_channels
+
+    def param_specs(self):
+        k, ci, ch = self.kernel, self.input_channels, self.hidden_channels
+        specs = {
+            "w_i": ParamSpec((k, k, ci, 4 * ch), initializers.xavier,
+                             fan_in=k * k * ci, fan_out=4 * ch),
+            "w_h": ParamSpec((k, k, ch, 4 * ch), initializers.xavier,
+                             fan_in=k * k * ch, fan_out=4 * ch),
+            "bias": ParamSpec((4 * ch,), initializers.zeros),
+        }
+        if self.peephole:
+            h, w = self.spatial
+            specs["peep_i"] = ParamSpec((h, w, ch), initializers.zeros)
+            specs["peep_f"] = ParamSpec((h, w, ch), initializers.zeros)
+            specs["peep_o"] = ParamSpec((h, w, ch), initializers.zeros)
+        return specs
+
+    def init_hidden(self, batch, dtype=jnp.float32):
+        h, w = self.spatial
+        shape = (batch, h, w, self.hidden_channels)
+        return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+    def _conv(self, x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, window_strides=(1, 1), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    def step(self, params, hidden, x):
+        h_prev, c_prev = hidden
+        gates = (self._conv(x, params["w_i"]) + self._conv(h_prev, params["w_h"])
+                 + params["bias"])
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        if self.peephole:
+            i = i + params["peep_i"] * c_prev
+            f = f + params["peep_f"] * c_prev
+        i, f = jax.nn.sigmoid(i), jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        c = f * c_prev + i * g
+        if self.peephole:
+            o = o + params["peep_o"] * c
+        o = jax.nn.sigmoid(o)
+        h = o * jnp.tanh(c)
+        return h, (h, c)
+
+
+class MultiRNNCell(Cell):
+    """Stack of cells applied at each time step
+    (reference: nn/MultiRNNCell.scala)."""
+
+    def __init__(self, cells: Sequence[Cell], name=None):
+        super().__init__(name)
+        self.cells = list(cells)
+        for idx, c in enumerate(self.cells):
+            self.add_child(str(idx), c)
+        self.hidden_size = self.cells[-1].hidden_size
+
+    def init_hidden(self, batch, dtype=jnp.float32):
+        return tuple(c.init_hidden(batch, dtype) for c in self.cells)
+
+    def step(self, params, hidden, x):
+        new_hidden = []
+        out = x
+        for idx, c in enumerate(self.cells):
+            out, nh = c.step(params[str(idx)], hidden[idx], out)
+            new_hidden.append(nh)
+        return out, tuple(new_hidden)
+
+
+class Recurrent(Module):
+    """Runs a cell over the time dimension of (B, T, ...) input via
+    `lax.scan` (reference: nn/Recurrent.scala:47 — there, per-step cloned
+    cells; here one compiled step body).
+
+    Options:
+      return_sequences — (B, T, H) outputs (True, reference default) or the
+                         final (B, H) output.
+      reverse          — process the sequence right-to-left.
+    """
+
+    def __init__(self, cell: Cell, return_sequences: bool = True,
+                 reverse: bool = False, name=None):
+        super().__init__(name)
+        self.cell = self.add_child("cell", cell)
+        self.return_sequences = return_sequences
+        self.reverse = reverse
+
+    def _apply(self, params, state, x, *, training=False, rng=None):
+        cell_params = params["cell"]
+        hidden0 = self.cell.init_hidden(x.shape[0], x.dtype)
+        xs = jnp.swapaxes(x, 0, 1)          # (T, B, ...) for scan
+        if self.reverse:
+            xs = jnp.flip(xs, axis=0)
+
+        def body(hidden, xt):
+            out, new_hidden = self.cell.step(cell_params, hidden, xt)
+            return new_hidden, out
+
+        final_hidden, outs = jax.lax.scan(body, hidden0, xs)
+        if self.reverse:
+            outs = jnp.flip(outs, axis=0)
+        if self.return_sequences:
+            return jnp.swapaxes(outs, 0, 1), state
+        return outs[-1] if not self.reverse else outs[0], state
+
+
+class BiRecurrent(Module):
+    """Bidirectional wrapper (reference: nn/BiRecurrent.scala): runs two
+    independent copies of the cell class forward and backward and merges
+    (`concat` on features, or `sum`)."""
+
+    def __init__(self, fwd_cell: Cell, bwd_cell: Cell, merge: str = "concat",
+                 name=None):
+        super().__init__(name)
+        self.fwd = self.add_child("fwd", Recurrent(fwd_cell))
+        self.bwd = self.add_child("bwd", Recurrent(bwd_cell, reverse=True))
+        if merge not in ("concat", "sum"):
+            raise ValueError(f"merge must be concat|sum, got {merge}")
+        self.merge = merge
+
+    def _apply(self, params, state, x, *, training=False, rng=None):
+        f, _ = self.fwd._apply(params["fwd"], state.get("fwd", {}), x)
+        b, _ = self.bwd._apply(params["bwd"], state.get("bwd", {}), x)
+        if self.merge == "concat":
+            return jnp.concatenate([f, b], axis=-1), state
+        return f + b, state
+
+
+class RecurrentDecoder(Module):
+    """Autoregressive decoder: feeds each step's output back as the next
+    input for `seq_length` steps (reference: nn/RecurrentDecoder.scala).
+    Input is the (B, features) start token/state."""
+
+    def __init__(self, cell: Cell, seq_length: int, name=None):
+        super().__init__(name)
+        self.cell = self.add_child("cell", cell)
+        self.seq_length = seq_length
+
+    def _apply(self, params, state, x, *, training=False, rng=None):
+        cell_params = params["cell"]
+        hidden0 = self.cell.init_hidden(x.shape[0], x.dtype)
+
+        def body(carry, _):
+            inp, hidden = carry
+            out, new_hidden = self.cell.step(cell_params, hidden, inp)
+            return (out, new_hidden), out
+
+        _, outs = jax.lax.scan(body, (x, hidden0), None,
+                               length=self.seq_length)
+        return jnp.swapaxes(outs, 0, 1), state
+
+
+class TimeDistributed(Module):
+    """Applies an inner module independently at every time step of
+    (B, T, ...) input (reference: nn/TimeDistributed.scala — there by
+    folding T into B; same trick here, which XLA turns into one big batched
+    op instead of a loop)."""
+
+    def __init__(self, inner: Module, name=None):
+        super().__init__(name)
+        self.inner = self.add_child("inner", inner)
+
+    def _apply(self, params, state, x, *, training=False, rng=None):
+        b, t = x.shape[0], x.shape[1]
+        flat = x.reshape((b * t,) + x.shape[2:])
+        out, new_inner_state = self.inner._apply(
+            params["inner"], state.get("inner", {}), flat,
+            training=training, rng=rng)
+        out = out.reshape((b, t) + out.shape[1:])
+        return out, {**state, "inner": new_inner_state}
+
+
+def beam_search(step_fn, init_state, start_tokens, *, beam_size: int,
+                vocab_size: int, max_len: int, eos_id: int,
+                alpha: float = 0.0):
+    """Batched beam search (reference: nn/SequenceBeamSearch.scala) as a
+    pure function over a token-level step:
+
+        logits, new_state = step_fn(tokens_last, state)   # (B*K, V)
+
+    `init_state` must already be tiled to B*K along the batch dim (use
+    `tile_beam`). Returns (sequences (B, K, max_len), scores (B, K)).
+    Implemented with `lax.scan` over decode positions: scores are kept
+    log-space; finished beams (emitted eos) are frozen by forcing eos with
+    probability one. Length penalty `alpha` follows GNMT:
+    score / ((5+len)/6)^alpha.
+    """
+    B = start_tokens.shape[0]
+    K = beam_size
+    neg_inf = jnp.float32(-1e9)
+
+    # scores (B, K): first beam live, rest -inf so step 1 expands one beam
+    init_scores = jnp.tile(
+        jnp.array([[0.0] + [float(neg_inf)] * (K - 1)], jnp.float32), (B, 1))
+    tokens0 = jnp.repeat(start_tokens[:, None], K, axis=1)      # (B, K)
+    finished0 = jnp.zeros((B, K), bool)
+    seqs0 = jnp.zeros((B, K, max_len), jnp.int32)
+
+    def body(carry, t):
+        seqs, last_tokens, scores, finished, state = carry
+        logits, new_state = step_fn(last_tokens.reshape(B * K), state)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        logp = logp.reshape(B, K, vocab_size)
+        # frozen beams: only eos continuation, with zero cost
+        frozen = jnp.full((B, K, vocab_size), neg_inf).at[:, :, eos_id].set(0.0)
+        logp = jnp.where(finished[..., None], frozen, logp)
+        cand = scores[..., None] + logp                      # (B, K, V)
+        flat = cand.reshape(B, K * vocab_size)
+        top_scores, top_idx = jax.lax.top_k(flat, K)         # (B, K)
+        beam_idx = top_idx // vocab_size
+        tok_idx = (top_idx % vocab_size).astype(jnp.int32)
+        gather = lambda arr: jnp.take_along_axis(
+            arr, beam_idx.reshape((B, K) + (1,) * (arr.ndim - 2)), axis=1)
+        seqs = gather(seqs)
+        seqs = seqs.at[:, :, t].set(tok_idx)
+        finished = jnp.take_along_axis(finished, beam_idx, axis=1) | \
+            (tok_idx == eos_id)
+        # reorder decoder state along the beam dim
+        def reorder(leaf):
+            leafk = leaf.reshape((B, K) + leaf.shape[1:])
+            leafk = jnp.take_along_axis(
+                leafk, beam_idx.reshape((B, K) + (1,) * (leafk.ndim - 2)),
+                axis=1)
+            return leafk.reshape((B * K,) + leaf.shape[1:])
+        new_state = jax.tree.map(reorder, new_state)
+        return (seqs, tok_idx, top_scores, finished, new_state), None
+
+    carry = (seqs0, tokens0, init_scores, finished0, init_state)
+    (seqs, _, scores, finished, _), _ = jax.lax.scan(
+        body, carry, jnp.arange(max_len))
+    if alpha:
+        lengths = jnp.sum(seqs != eos_id, axis=-1).astype(jnp.float32)
+        penalty = jnp.power((5.0 + lengths) / 6.0, alpha)
+        scores = scores / penalty
+    order = jnp.argsort(-scores, axis=-1)
+    seqs = jnp.take_along_axis(seqs, order[..., None], axis=1)
+    scores = jnp.take_along_axis(scores, order, axis=1)
+    return seqs, scores
+
+
+def tile_beam(tree, beam_size: int):
+    """Tile every leaf's batch dim K times: (B, ...) -> (B*K, ...)."""
+    return jax.tree.map(
+        lambda x: jnp.repeat(x, beam_size, axis=0), tree)
+
+
+class SequenceBeamSearch(Module):
+    """Module wrapper over :func:`beam_search` for API parity with the
+    reference (nn/SequenceBeamSearch.scala). Construct with a step closure."""
+
+    def __init__(self, step_fn, beam_size: int, vocab_size: int,
+                 max_len: int, eos_id: int, alpha: float = 0.0, name=None):
+        super().__init__(name)
+        self.step_fn, self.beam_size = step_fn, beam_size
+        self.vocab_size, self.max_len = vocab_size, max_len
+        self.eos_id, self.alpha = eos_id, alpha
+
+    def _apply(self, params, state, start_tokens, init_state, *,
+               training=False, rng=None):
+        out = beam_search(self.step_fn, init_state, start_tokens,
+                          beam_size=self.beam_size, vocab_size=self.vocab_size,
+                          max_len=self.max_len, eos_id=self.eos_id,
+                          alpha=self.alpha)
+        return out, state

@@ -17,3 +17,5 @@ from bigdl_tpu.optim.metrics import (ValidationMethod, ValidationResult,
 from bigdl_tpu.optim.local import (Optimizer, LocalOptimizer,
                                    GradientProcessor, ConstantClipping,
                                    L2NormClipping)
+from bigdl_tpu.optim.predictor import (Predictor, LocalPredictor, Evaluator,
+                                       PredictionService)

@@ -1,0 +1,126 @@
+"""Inference facades — the analogues of `Predictor`
+(reference: optim/Predictor.scala:35-260), `LocalPredictor`, `Evaluator`
+(optim/Evaluator.scala:40-95) and `PredictionService`
+(optim/PredictionService.scala:56-66).
+
+TPU-first design: the reference broadcasts shared-weight model clones to RDD
+partitions and threads batches through per-core replicas. Here one jitted
+forward owns the chip; "cloning" is free because params are immutable
+arrays, and concurrency-safety is by construction (pure functions), so
+`PredictionService` needs no blocking queue of instances — just a compiled
+function that any thread may call.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.core.module import Module
+from bigdl_tpu.optim.metrics import ValidationMethod, ValidationResult, evaluate
+
+
+def _pad_to(x: np.ndarray, n: int):
+    """Pad batch dim to `n` rows (repeat-last) so every step reuses ONE
+    compiled program — the analogue of the reference's per-partition batch
+    splitting (Predictor.scala:75-117), shaped for XLA instead of threads."""
+    pad = n - x.shape[0]
+    if pad == 0:
+        return x
+    reps = np.repeat(x[-1:], pad, axis=0)
+    return np.concatenate([x, reps], axis=0)
+
+
+class Predictor:
+    """Batched distributed-style inference over an iterable of inputs.
+
+        pred = Predictor(model, params, state, batch_size=128)
+        probs  = pred.predict(samples)        # (N, ...) stacked outputs
+        labels = pred.predict_class(samples)  # argmax over last dim
+    """
+
+    def __init__(self, model: Module, params, state, *,
+                 batch_size: int = 128, apply_fn=None):
+        self.model, self.params, self.state = model, params, state
+        self.batch_size = batch_size
+        self._fn = apply_fn or jax.jit(
+            lambda p, s, x: model.apply(p, s, x, training=False)[0])
+
+    def predict(self, inputs) -> np.ndarray:
+        xs = np.asarray(inputs)
+        outs = []
+        bs = self.batch_size
+        for i in range(0, xs.shape[0], bs):
+            chunk = xs[i:i + bs]
+            n = chunk.shape[0]
+            out = self._fn(self.params, self.state,
+                           jnp.asarray(_pad_to(chunk, bs)))
+            outs.append(np.asarray(out)[:n])
+        return np.concatenate(outs, axis=0)
+
+    def predict_class(self, inputs) -> np.ndarray:
+        return np.argmax(self.predict(inputs), axis=-1)
+
+
+LocalPredictor = Predictor
+
+
+class Evaluator:
+    """Evaluation facade (reference: optim/Evaluator.scala:40-95):
+
+        Evaluator(model).test(params, state, data_iter, [Top1Accuracy()])
+    """
+
+    def __init__(self, model: Module, apply_fn=None):
+        self.model = model
+        self._fn = apply_fn or jax.jit(
+            lambda p, s, x: model.apply(p, s, x, training=False)[0])
+
+    def test(self, params, state, data_iter,
+             methods: Sequence[ValidationMethod]) -> Dict[str, ValidationResult]:
+        return evaluate(self.model, params, state, data_iter, methods,
+                        apply_fn=self._fn)
+
+
+class PredictionService:
+    """Concurrent serving (reference: optim/PredictionService.scala:56-66
+    keeps a BlockingQueue of `instanceNum` shallow model copies; pure JAX
+    functions are reentrant so no queue is needed — `instance_num` is kept
+    for API parity and ignored).
+
+    Pads each request up to the next power-of-two rows (capped at
+    `max_batch`) so the service compiles O(log max_batch) programs total,
+    whatever request sizes arrive."""
+
+    def __init__(self, model: Module, params, state, *,
+                 instance_num: int = 1, max_batch: int = 256):
+        del instance_num
+        self.model, self.params, self.state = model, params, state
+        self.max_batch = max_batch
+        self._fn = jax.jit(
+            lambda p, s, x: model.apply(p, s, x, training=False)[0])
+
+    def _bucket(self, n: int) -> int:
+        b = 1
+        while b < n and b < self.max_batch:
+            b *= 2
+        return b
+
+    def predict(self, request) -> np.ndarray:
+        x = np.asarray(request)
+        if x.ndim == 0:
+            raise ValueError("request must be at least 1-D (batch of inputs)")
+        outs = []
+        i = 0
+        while i < x.shape[0]:
+            chunk = x[i:i + self.max_batch]
+            n = chunk.shape[0]
+            b = self._bucket(n)
+            out = self._fn(self.params, self.state,
+                           jnp.asarray(_pad_to(chunk, b)))
+            outs.append(np.asarray(out)[:n])
+            i += n
+        return np.concatenate(outs, axis=0)

@@ -173,3 +173,31 @@ def test_prauc_resets_between_runs():
     m.reset()
     m.batch(out, tgt)
     assert len(m.scores) == 1
+
+
+def test_predictor_and_service():
+    import jax
+    import numpy as np
+    from bigdl_tpu.nn import Linear, Sequential, SoftMax
+    from bigdl_tpu.optim.predictor import Predictor, PredictionService, Evaluator
+    from bigdl_tpu.optim.metrics import Top1Accuracy
+
+    model = Sequential(Linear(4, 3))
+    params, state = model.init(jax.random.PRNGKey(0))
+    x = np.random.RandomState(0).randn(10, 4).astype(np.float32)
+
+    pred = Predictor(model, params, state, batch_size=4)
+    out = pred.predict(x)
+    assert out.shape == (10, 3)
+    ref, _ = model.apply(params, state, x)
+    np.testing.assert_allclose(out, np.asarray(ref), rtol=1e-5, atol=1e-5)
+    labels = pred.predict_class(x)
+    assert labels.shape == (10,)
+
+    svc = PredictionService(model, params, state, max_batch=8)
+    out2 = svc.predict(x)
+    np.testing.assert_allclose(out2, out, rtol=1e-5, atol=1e-5)
+
+    y = labels.astype(np.int32)   # evaluate against own predictions => acc 1
+    res = Evaluator(model).test(params, state, [(x, y)], [Top1Accuracy()])
+    assert res["Top1Accuracy"].result == 1.0

@@ -1,0 +1,202 @@
+"""Recurrent stack tests — shape contracts, lax.scan equivalence to a
+Python-unrolled loop, golden parity vs torch.nn.LSTM (the analogue of the
+reference's golden-model suites vs Torch7, SURVEY §4), and beam search."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.nn.recurrent import (
+    LSTM, GRU, BiRecurrent, Cell, ConvLSTMPeephole, LSTMPeephole,
+    MultiRNNCell, Recurrent, RecurrentDecoder, RnnCell, SequenceBeamSearch,
+    TimeDistributed, beam_search, tile_beam)
+
+
+def _data(b=4, t=7, f=5, seed=0):
+    r = np.random.RandomState(seed)
+    return jnp.asarray(r.randn(b, t, f).astype(np.float32))
+
+
+@pytest.mark.parametrize("cell_cls", [RnnCell, LSTM, LSTMPeephole, GRU])
+def test_recurrent_shapes(cell_cls):
+    cell = cell_cls(5, 8)
+    layer = Recurrent(cell, return_sequences=True)
+    params, state = layer.init(jax.random.PRNGKey(0))
+    x = _data()
+    out, _ = layer.apply(params, state, x)
+    assert out.shape == (4, 7, 8)
+    last_layer = Recurrent(cell_cls(5, 8), return_sequences=False)
+    p2, s2 = last_layer.init(jax.random.PRNGKey(0))
+    out2, _ = last_layer.apply(p2, s2, x)
+    assert out2.shape == (4, 8)
+    # return_sequences[-1] == final output
+    np.testing.assert_allclose(np.asarray(out[:, -1]), np.asarray(out2),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_scan_matches_python_unroll():
+    cell = LSTM(5, 8)
+    layer = Recurrent(cell)
+    params, state = layer.init(jax.random.PRNGKey(1))
+    x = _data()
+    out, _ = layer.apply(params, state, x)
+    hidden = cell.init_hidden(4)
+    outs = []
+    for t in range(x.shape[1]):
+        o, hidden = cell.step(params["cell"], hidden, x[:, t])
+        outs.append(o)
+    ref = jnp.stack(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_lstm_golden_vs_torch():
+    torch = pytest.importorskip("torch")
+    b, t, f, h = 3, 6, 4, 5
+    layer = Recurrent(LSTM(f, h))
+    params, state = layer.init(jax.random.PRNGKey(2))
+    x = np.random.RandomState(3).randn(b, t, f).astype(np.float32)
+
+    tl = torch.nn.LSTM(f, h, batch_first=True)
+    with torch.no_grad():
+        # torch packs gates i,f,g,o like ours; torch weights are (4H, in)
+        tl.weight_ih_l0.copy_(torch.tensor(np.asarray(params["cell"]["w_i"]).T))
+        tl.weight_hh_l0.copy_(torch.tensor(np.asarray(params["cell"]["w_h"]).T))
+        tl.bias_ih_l0.copy_(torch.tensor(np.asarray(params["cell"]["bias"])))
+        tl.bias_hh_l0.zero_()
+        ref, _ = tl(torch.tensor(x))
+
+    out, _ = layer.apply(params, state, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), ref.numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_reverse_and_birecurrent():
+    x = _data()
+    fwd = Recurrent(LSTM(5, 8))
+    rev = Recurrent(LSTM(5, 8), reverse=True)
+    pf, sf = fwd.init(jax.random.PRNGKey(4))
+    out_f, _ = fwd.apply(pf, sf, x)
+    out_r, _ = rev.apply(pf, sf, jnp.flip(x, axis=1))
+    # reversing input and running reversed = flipped forward output
+    np.testing.assert_allclose(np.asarray(out_r),
+                               np.asarray(jnp.flip(out_f, axis=1)),
+                               rtol=1e-5, atol=1e-5)
+
+    bi = BiRecurrent(LSTM(5, 8), LSTM(5, 8))
+    p, s = bi.init(jax.random.PRNGKey(5))
+    out, _ = bi.apply(p, s, x)
+    assert out.shape == (4, 7, 16)
+    bi_sum = BiRecurrent(LSTM(5, 8), LSTM(5, 8), merge="sum")
+    p2, s2 = bi_sum.init(jax.random.PRNGKey(5))
+    out2, _ = bi_sum.apply(p2, s2, x)
+    assert out2.shape == (4, 7, 8)
+
+
+def test_multi_rnn_cell_and_decoder():
+    stack = MultiRNNCell([LSTM(5, 8), GRU(8, 6)])
+    layer = Recurrent(stack)
+    p, s = layer.init(jax.random.PRNGKey(6))
+    out, _ = layer.apply(p, s, _data())
+    assert out.shape == (4, 7, 6)
+
+    dec = RecurrentDecoder(LSTM(5, 5), seq_length=9)
+    p, s = dec.init(jax.random.PRNGKey(7))
+    out, _ = dec.apply(p, s, jnp.ones((4, 5)))
+    assert out.shape == (4, 9, 5)
+
+
+def test_conv_lstm():
+    cell = ConvLSTMPeephole(3, 6, kernel=3, spatial=(8, 8))
+    layer = Recurrent(cell)
+    p, s = layer.init(jax.random.PRNGKey(8))
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 4, 8, 8, 3),
+                    jnp.float32)
+    out, _ = layer.apply(p, s, x)
+    assert out.shape == (2, 4, 8, 8, 6)
+
+
+def test_time_distributed():
+    from bigdl_tpu.nn.linear import Linear
+    td = TimeDistributed(Linear(5, 3))
+    p, s = td.init(jax.random.PRNGKey(9))
+    x = _data()
+    out, _ = td.apply(p, s, x)
+    assert out.shape == (4, 7, 3)
+    inner = Linear(5, 3)
+    pi, si = inner.init(jax.random.PRNGKey(9))
+    ref, _ = inner.apply(p["inner"], si, x[:, 0])
+    np.testing.assert_allclose(np.asarray(out[:, 0]), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_recurrent_gradients_flow():
+    layer = Recurrent(LSTM(5, 8), return_sequences=False)
+    params, state = layer.init(jax.random.PRNGKey(10))
+    x = _data()
+
+    def loss(p):
+        out, _ = layer.apply(p, state, x)
+        return jnp.sum(out ** 2)
+
+    grads = jax.grad(loss)(params)
+    flat = jax.tree.leaves(grads)
+    assert all(np.all(np.isfinite(np.asarray(g))) for g in flat)
+    assert any(float(jnp.max(jnp.abs(g))) > 0 for g in flat)
+
+
+def test_beam_search_greedy_agrees():
+    """With beam_size=1 beam search must equal greedy argmax decoding."""
+    V, H, B, L = 7, 5, 2, 6
+    r = np.random.RandomState(11)
+    emb = jnp.asarray(r.randn(V, H).astype(np.float32))
+    w = jnp.asarray(r.randn(H, V).astype(np.float32))
+    cell = GRU(H, H)
+    cp, _ = cell.init(jax.random.PRNGKey(12))
+
+    def step_fn(tokens, hidden):
+        x = emb[tokens]
+        h, new_hidden = cell.step(cp, hidden, x)
+        return h @ w, new_hidden
+
+    start = jnp.zeros((B,), jnp.int32)
+    h0 = cell.init_hidden(B)
+    seqs, scores = beam_search(step_fn, h0, start, beam_size=1, vocab_size=V,
+                               max_len=L, eos_id=0)
+    # greedy reference
+    toks, hidden = start, cell.init_hidden(B)
+    greedy = []
+    for _ in range(L):
+        logits, hidden = step_fn(toks, hidden)
+        logp = jax.nn.log_softmax(logits)
+        # frozen-beam semantics: once eos is emitted, only eos follows
+        toks = jnp.argmax(logp, axis=-1).astype(jnp.int32)
+        if greedy and np.any(np.asarray(greedy[-1]) == 0):
+            done = np.asarray(greedy[-1]) == 0
+            toks = jnp.where(jnp.asarray(done), 0, toks)
+        greedy.append(toks)
+    greedy = jnp.stack(greedy, axis=1)
+    np.testing.assert_array_equal(np.asarray(seqs[:, 0]), np.asarray(greedy))
+
+
+def test_beam_search_widths_and_scores():
+    V, H, B, L, K = 6, 4, 2, 5, 3
+    r = np.random.RandomState(13)
+    emb = jnp.asarray(r.randn(V, H).astype(np.float32))
+    w = jnp.asarray(r.randn(H, V).astype(np.float32))
+    cell = RnnCell(H, H)
+    cp, _ = cell.init(jax.random.PRNGKey(14))
+
+    def step_fn(tokens, hidden):
+        h, nh = cell.step(cp, hidden, emb[tokens])
+        return h @ w, nh
+
+    start = jnp.zeros((B,), jnp.int32)
+    h0 = tile_beam(cell.init_hidden(B), K)
+    seqs, scores = beam_search(step_fn, h0, start, beam_size=K, vocab_size=V,
+                               max_len=L, eos_id=0, alpha=0.6)
+    assert seqs.shape == (B, K, L)
+    assert scores.shape == (B, K)
+    # sorted best-first
+    assert np.all(np.diff(np.asarray(scores), axis=-1) <= 1e-6)
