@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from bigdl_tpu.core import init as initializers
-from bigdl_tpu.core.module import Module, ParamSpec, _fold_name
+from bigdl_tpu.core.module import Module, ParamSpec
 
 
 class Cell(Module):

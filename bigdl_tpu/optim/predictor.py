@@ -23,6 +23,29 @@ from bigdl_tpu.core.module import Module
 from bigdl_tpu.optim.metrics import ValidationMethod, ValidationResult, evaluate
 
 
+def _jit_forward(model: Module):
+    return jax.jit(lambda p, s, x: model.apply(p, s, x, training=False)[0])
+
+
+def _batched_predict(fn, params, state, xs: np.ndarray, bucket) -> np.ndarray:
+    """Shared chunk/pad/slice loop: `bucket(n)` picks the padded batch size
+    (and the chunk stride) for an n-row remainder."""
+    outs = []
+    i = 0
+    while i < xs.shape[0]:
+        b = bucket(xs.shape[0] - i)
+        chunk = xs[i:i + b]
+        n = chunk.shape[0]
+        out = fn(params, state, jnp.asarray(_pad_to(chunk, b)))
+        outs.append(np.asarray(out)[:n])
+        i += n
+    if not outs:
+        probe = fn(params, state, jnp.asarray(
+            np.zeros((bucket(1),) + xs.shape[1:], xs.dtype)))
+        return np.zeros((0,) + probe.shape[1:], np.asarray(probe).dtype)
+    return np.concatenate(outs, axis=0)
+
+
 def _pad_to(x: np.ndarray, n: int):
     """Pad batch dim to `n` rows (repeat-last) so every step reuses ONE
     compiled program — the analogue of the reference's per-partition batch
@@ -46,20 +69,12 @@ class Predictor:
                  batch_size: int = 128, apply_fn=None):
         self.model, self.params, self.state = model, params, state
         self.batch_size = batch_size
-        self._fn = apply_fn or jax.jit(
-            lambda p, s, x: model.apply(p, s, x, training=False)[0])
+        self._fn = apply_fn or _jit_forward(model)
 
     def predict(self, inputs) -> np.ndarray:
-        xs = np.asarray(inputs)
-        outs = []
-        bs = self.batch_size
-        for i in range(0, xs.shape[0], bs):
-            chunk = xs[i:i + bs]
-            n = chunk.shape[0]
-            out = self._fn(self.params, self.state,
-                           jnp.asarray(_pad_to(chunk, bs)))
-            outs.append(np.asarray(out)[:n])
-        return np.concatenate(outs, axis=0)
+        return _batched_predict(self._fn, self.params, self.state,
+                                np.asarray(inputs),
+                                bucket=lambda n: self.batch_size)
 
     def predict_class(self, inputs) -> np.ndarray:
         return np.argmax(self.predict(inputs), axis=-1)
@@ -76,8 +91,7 @@ class Evaluator:
 
     def __init__(self, model: Module, apply_fn=None):
         self.model = model
-        self._fn = apply_fn or jax.jit(
-            lambda p, s, x: model.apply(p, s, x, training=False)[0])
+        self._fn = apply_fn or _jit_forward(model)
 
     def test(self, params, state, data_iter,
              methods: Sequence[ValidationMethod]) -> Dict[str, ValidationResult]:
@@ -100,27 +114,17 @@ class PredictionService:
         del instance_num
         self.model, self.params, self.state = model, params, state
         self.max_batch = max_batch
-        self._fn = jax.jit(
-            lambda p, s, x: model.apply(p, s, x, training=False)[0])
+        self._fn = _jit_forward(model)
 
     def _bucket(self, n: int) -> int:
         b = 1
-        while b < n and b < self.max_batch:
+        while b < n and b * 2 <= self.max_batch:
             b *= 2
-        return b
+        return min(b if b >= n else self.max_batch, self.max_batch)
 
     def predict(self, request) -> np.ndarray:
         x = np.asarray(request)
         if x.ndim == 0:
             raise ValueError("request must be at least 1-D (batch of inputs)")
-        outs = []
-        i = 0
-        while i < x.shape[0]:
-            chunk = x[i:i + self.max_batch]
-            n = chunk.shape[0]
-            b = self._bucket(n)
-            out = self._fn(self.params, self.state,
-                           jnp.asarray(_pad_to(chunk, b)))
-            outs.append(np.asarray(out)[:n])
-            i += n
-        return np.concatenate(outs, axis=0)
+        return _batched_predict(self._fn, self.params, self.state, x,
+                                bucket=self._bucket)

@@ -201,3 +201,22 @@ def test_predictor_and_service():
     y = labels.astype(np.int32)   # evaluate against own predictions => acc 1
     res = Evaluator(model).test(params, state, [(x, y)], [Top1Accuracy()])
     assert res["Top1Accuracy"].result == 1.0
+
+
+def test_predictor_empty_and_bucket():
+    import jax
+    import numpy as np
+    from bigdl_tpu.nn import Linear, Sequential
+    from bigdl_tpu.optim.predictor import Predictor, PredictionService
+
+    model = Sequential(Linear(4, 3))
+    params, state = model.init(jax.random.PRNGKey(0))
+    pred = Predictor(model, params, state, batch_size=4)
+    out = pred.predict(np.zeros((0, 4), np.float32))
+    assert out.shape == (0, 3)
+    svc = PredictionService(model, params, state, max_batch=100)
+    assert svc._bucket(5) == 8
+    assert svc._bucket(100) == 100
+    assert svc._bucket(200) == 100
+    out = svc.predict(np.zeros((0, 4), np.float32))
+    assert out.shape == (0, 3)

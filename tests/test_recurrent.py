@@ -200,3 +200,25 @@ def test_beam_search_widths_and_scores():
     assert scores.shape == (B, K)
     # sorted best-first
     assert np.all(np.diff(np.asarray(scores), axis=-1) <= 1e-6)
+
+
+def test_sequence_beam_search_module():
+    V, H, B, L, K = 6, 4, 2, 5, 2
+    r = np.random.RandomState(15)
+    emb = jnp.asarray(r.randn(V, H).astype(np.float32))
+    w = jnp.asarray(r.randn(H, V).astype(np.float32))
+    cell = RnnCell(H, H)
+    cp, _ = cell.init(jax.random.PRNGKey(16))
+
+    def step_fn(tokens, hidden):
+        h, nh = cell.step(cp, hidden, emb[tokens])
+        return h @ w, nh
+
+    start = jnp.zeros((B,), jnp.int32)
+    h0 = tile_beam(cell.init_hidden(B), K)
+    mod = SequenceBeamSearch(step_fn, K, V, L, eos_id=0)
+    (seqs, scores), _ = mod.apply({}, {}, start, h0)
+    ref_seqs, ref_scores = beam_search(step_fn, h0, start, beam_size=K,
+                                       vocab_size=V, max_len=L, eos_id=0)
+    np.testing.assert_array_equal(np.asarray(seqs), np.asarray(ref_seqs))
+    np.testing.assert_allclose(np.asarray(scores), np.asarray(ref_scores))
