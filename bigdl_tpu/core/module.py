@@ -110,10 +110,13 @@ class Module:
 
     # ------------------------------------------------------------------ apply
     def apply(self, params, state, *inputs, training: bool = False,
-              rng: Optional[jax.Array] = None):
-        """Pure forward. Returns ``(output, new_state)``."""
+              rng: Optional[jax.Array] = None, **kwargs):
+        """Pure forward. Returns ``(output, new_state)``. Extra keyword
+        arguments (e.g. attention's `mask=`/`causal=`) pass through to
+        `_apply`."""
         with jax.named_scope(self.name):
-            return self._apply(params, state, *inputs, training=training, rng=rng)
+            return self._apply(params, state, *inputs, training=training,
+                               rng=rng, **kwargs)
 
     def _apply(self, params, state, *inputs, training: bool = False,
                rng: Optional[jax.Array] = None):

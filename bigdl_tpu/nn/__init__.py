@@ -34,6 +34,11 @@ from bigdl_tpu.nn.arithmetic import (CAddTable, CMulTable, CSubTable, CDivTable,
                                      Sum, Mean, Max, Min, Clip, MM, MV, DotProduct,
                                      CosineDistance, PairwiseDistance, Scale,
                                      MixtureTable)
+from bigdl_tpu.nn.attention import (MultiHeadAttention, Attention,
+                                    FeedForwardNetwork, TransformerLayer,
+                                    Transformer, dot_product_attention,
+                                    blockwise_attention, causal_mask,
+                                    padding_mask, positional_encoding)
 from bigdl_tpu.nn.recurrent import (Cell, RnnCell, LSTM, LSTMPeephole, GRU,
                                     ConvLSTMPeephole, MultiRNNCell, Recurrent,
                                     BiRecurrent, RecurrentDecoder,

@@ -40,9 +40,10 @@ def _batched_predict(fn, params, state, xs: np.ndarray, bucket) -> np.ndarray:
         outs.append(np.asarray(out)[:n])
         i += n
     if not outs:
-        probe = fn(params, state, jnp.asarray(
-            np.zeros((bucket(1),) + xs.shape[1:], xs.dtype)))
-        return np.zeros((0,) + probe.shape[1:], np.asarray(probe).dtype)
+        probe = jax.eval_shape(
+            fn, params, state,
+            jax.ShapeDtypeStruct((bucket(1),) + xs.shape[1:], xs.dtype))
+        return np.zeros((0,) + probe.shape[1:], probe.dtype)
     return np.concatenate(outs, axis=0)
 
 
@@ -120,7 +121,7 @@ class PredictionService:
         b = 1
         while b < n and b * 2 <= self.max_batch:
             b *= 2
-        return min(b if b >= n else self.max_batch, self.max_batch)
+        return b if b >= n else self.max_batch
 
     def predict(self, request) -> np.ndarray:
         x = np.asarray(request)

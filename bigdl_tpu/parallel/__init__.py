@@ -18,10 +18,11 @@ from bigdl_tpu.parallel.sharding import (
     ShardingRules, batch_spec, replicated_spec, zero1_spec, shard_tree,
 )
 from bigdl_tpu.parallel.distri import DistriOptimizer
+from bigdl_tpu.parallel.ring import ring_attention, ring_self_attention
 
 __all__ = [
     "Engine", "create_mesh", "mesh_shape_for",
     "DATA_AXIS", "MODEL_AXIS", "PIPE_AXIS", "SEQ_AXIS", "EXPERT_AXIS",
     "ShardingRules", "batch_spec", "replicated_spec", "zero1_spec",
-    "shard_tree", "DistriOptimizer",
+    "shard_tree", "DistriOptimizer", "ring_attention", "ring_self_attention",
 ]
