@@ -11,10 +11,17 @@ when available, else 1.0.
 """
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+if os.environ.get("BIGDL_TPU_FORCE_CPU"):
+    # local smoke runs: the axon plugin ignores JAX_PLATFORMS=cpu, the
+    # config knob doesn't
+    import jax
+    jax.config.update("jax_platforms", "cpu")
 
 
 def bench_lenet_train(batch_size=512, warmup=3, iters=20):
@@ -57,10 +64,72 @@ def bench_lenet_train(batch_size=512, warmup=3, iters=20):
     return batch_size * iters / dt
 
 
+def bench_resnet50_train(batch_size=None, spatial=None, warmup=None,
+                         iters=None):
+    """ResNet-50 training throughput, imgs/sec on one chip — the BASELINE
+    headline metric. bf16 compute via the distributed trainer's dtype policy
+    is benchmarked separately; this is the plain fp32→bf16-matmul XLA path."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models import resnet
+    from bigdl_tpu.nn.criterion import ClassNLLCriterion
+    from bigdl_tpu.optim.method import SGD
+
+    on_tpu = jax.default_backend() != "cpu"
+    if batch_size is None:
+        batch_size = 128 if on_tpu else 8
+    if spatial is None:
+        spatial = 224 if on_tpu else 32     # keep CPU smoke runs fast
+    if warmup is None:
+        warmup = 2 if on_tpu else 1
+    if iters is None:
+        iters = 10 if on_tpu else 3
+
+    model = resnet.build(depth=50, class_num=1000)
+    criterion = ClassNLLCriterion()
+    method = SGD(0.1, momentum=0.9, weight_decay=1e-4)
+    params, state = model.init(jax.random.PRNGKey(0))
+    slots = method.init_slots(params)
+
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.randn(batch_size, spatial, spatial, 3)
+                    .astype(np.float32))
+    y = jnp.asarray(r.randint(0, 1000, size=batch_size).astype(np.int32))
+    rng = jax.random.PRNGKey(7)
+
+    @jax.jit
+    def step(params, state, slots, x, y):
+        def loss_fn(p):
+            out, ns = model.apply(p, state, x, training=True, rng=rng)
+            return criterion.forward(out, y), ns
+        (loss, ns), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        new_p, new_s = method.update(params, grads, slots,
+                                     jnp.float32(0.1), jnp.int32(0))
+        return new_p, ns, new_s, loss
+
+    for _ in range(warmup):
+        params, state, slots, loss = step(params, state, slots, x, y)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, state, slots, loss = step(params, state, slots, x, y)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return batch_size * iters / dt
+
+
 def main():
-    ips = bench_lenet_train()
+    which = sys.argv[1] if len(sys.argv) > 1 else "resnet50"
+    if which == "lenet":
+        ips = bench_lenet_train()
+        metric = "lenet_mnist_train_throughput"
+    else:
+        ips = bench_resnet50_train()
+        metric = "resnet50_imagenet_train_throughput_per_chip"
     print(json.dumps({
-        "metric": "lenet_mnist_train_throughput",
+        "metric": metric,
         "value": round(ips, 1),
         "unit": "images/sec",
         "vs_baseline": 1.0,

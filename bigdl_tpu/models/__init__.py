@@ -1,3 +1,4 @@
 """bigdl_tpu.models — model zoo (reference: models/, SURVEY.md §2.10)."""
 
-from bigdl_tpu.models import lenet
+from bigdl_tpu.models import (autoencoder, inception, lenet, resnet, rnn,
+                              vgg)

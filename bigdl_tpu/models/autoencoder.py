@@ -1,0 +1,17 @@
+"""Fully-connected autoencoder on MNIST (reference:
+models/autoencoder/Autoencoder.scala, Train.scala)."""
+
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+
+def build(class_num: int = 32) -> nn.Sequential:
+    """`class_num` is the bottleneck width, as in the reference CLI."""
+    return nn.Sequential(
+        nn.Flatten(),
+        nn.Linear(784, class_num, name="enc"),
+        nn.ReLU(),
+        nn.Linear(class_num, 784, name="dec"),
+        nn.Sigmoid(),
+        name="Autoencoder")

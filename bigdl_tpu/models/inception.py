@@ -1,0 +1,138 @@
+"""Inception-v1 / GoogLeNet (reference: models/inception/Inception_v1.scala,
+Inception_v2.scala; trainer models/inception/TrainInceptionV1.scala — the
+×8-chip ImageNet config in BASELINE.json).
+
+NHWC, bias-free convs + BN in the v2 variant; v1 uses biased convs + LRN like
+the reference. Inception branches concat on the channel axis — a single XLA
+fusion region per mixed block.
+"""
+
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+
+def _conv(nin, nout, k, stride=1, pad=0, name=None):
+    return nn.Sequential(
+        nn.SpatialConvolution(nin, nout, k, k, stride, stride, pad, pad,
+                              name=f"{name}_conv" if name else None),
+        nn.ReLU())
+
+
+def _inception_block(nin, c1, c3r, c3, c5r, c5, pool_proj, name=None):
+    """The 4-branch mixed module (reference: Inception_v1.scala `Inception`)."""
+    return nn.Sequential(
+        nn.Concat(
+            _conv(nin, c1, 1, name=f"{name}_1x1"),
+            nn.Sequential(_conv(nin, c3r, 1, name=f"{name}_3x3r"),
+                          _conv(c3r, c3, 3, pad=1, name=f"{name}_3x3")),
+            nn.Sequential(_conv(nin, c5r, 1, name=f"{name}_5x5r"),
+                          _conv(c5r, c5, 5, pad=2, name=f"{name}_5x5")),
+            nn.Sequential(nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1),
+                          _conv(nin, pool_proj, 1, name=f"{name}_pool")),
+            axis=-1),
+        name=name)
+
+
+def _stem():
+    return [
+        _conv(3, 64, 7, 2, 3, name="conv1"),
+        nn.SpatialMaxPooling(3, 3, 2, 2, -1, -1, ceil_mode=True),
+        nn.SpatialCrossMapLRN(5, 0.0001, 0.75),
+        _conv(64, 64, 1, name="conv2r"),
+        _conv(64, 192, 3, pad=1, name="conv2"),
+        nn.SpatialCrossMapLRN(5, 0.0001, 0.75),
+        nn.SpatialMaxPooling(3, 3, 2, 2, -1, -1, ceil_mode=True),
+    ]
+
+
+def _aux_head(nin, class_num, name):
+    """Aux classifier (reference: Inception_v1.scala loss1/loss2 branches):
+    5x5/3 avgpool → 1x1 conv 128 → fc 1024 → dropout 0.7 → fc classes."""
+    return nn.Sequential(
+        nn.SpatialAveragePooling(5, 5, 3, 3),
+        _conv(nin, 128, 1, name=f"{name}_conv"),
+        nn.Flatten(),
+        nn.Linear(128 * 4 * 4, 1024, name=f"{name}_fc"),
+        nn.ReLU(),
+        nn.Dropout(0.7),
+        nn.Linear(1024, class_num, name=f"{name}_classifier"),
+        nn.LogSoftMax(),
+        name=name)
+
+
+class _InceptionWithAux(nn.Module):
+    """Training graph with the two aux heads; apply returns
+    (main, aux1, aux2) log-probs. The reference combines them with a
+    weighted ParallelCriterion (0.3 on each aux)."""
+
+    def __init__(self, class_num, name="InceptionV1-aux"):
+        super().__init__(name)
+        self.add_child("to4a", nn.Sequential(
+            *_stem(),
+            _inception_block(192, 64, 96, 128, 16, 32, 32, name="3a"),
+            _inception_block(256, 128, 128, 192, 32, 96, 64, name="3b"),
+            nn.SpatialMaxPooling(3, 3, 2, 2, -1, -1, ceil_mode=True),
+            _inception_block(480, 192, 96, 208, 16, 48, 64, name="4a")))
+        self.add_child("aux1", _aux_head(512, class_num, "loss1"))
+        self.add_child("to4d", nn.Sequential(
+            _inception_block(512, 160, 112, 224, 24, 64, 64, name="4b"),
+            _inception_block(512, 128, 128, 256, 24, 64, 64, name="4c"),
+            _inception_block(512, 112, 144, 288, 32, 64, 64, name="4d")))
+        self.add_child("aux2", _aux_head(528, class_num, "loss2"))
+        self.add_child("tail", nn.Sequential(
+            _inception_block(528, 256, 160, 320, 32, 128, 128, name="4e"),
+            nn.SpatialMaxPooling(3, 3, 2, 2, -1, -1, ceil_mode=True),
+            _inception_block(832, 256, 160, 320, 32, 128, 128, name="5a"),
+            _inception_block(832, 384, 192, 384, 48, 128, 128, name="5b"),
+            nn.GlobalAveragePooling2D(),
+            nn.Dropout(0.4),
+            nn.Linear(1024, class_num, name="loss3_classifier"),
+            nn.LogSoftMax()))
+
+    def _apply(self, params, state, x, *, training=False, rng=None):
+        from bigdl_tpu.core.module import _fold_name
+        new_state = dict(state)
+
+        def run(name, h):
+            crng = None if rng is None else _fold_name(rng, name)
+            out, ns = self.children()[name].apply(
+                params[name], state[name], h, training=training, rng=crng)
+            new_state[name] = ns
+            return out
+
+        h4a = run("to4a", x)
+        aux1 = run("aux1", h4a)
+        h4d = run("to4d", h4a)
+        aux2 = run("aux2", h4d)
+        main = run("tail", h4d)
+        return (main, aux1, aux2), new_state
+
+
+def build_with_aux(class_num: int = 1000) -> _InceptionWithAux:
+    """Training variant with the two auxiliary classifiers (reference:
+    Inception_v1.scala full graph). apply → (main, aux1, aux2)."""
+    return _InceptionWithAux(class_num)
+
+
+def build(class_num: int = 1000, has_dropout: bool = True) -> nn.Sequential:
+    """Inception-v1 main tower; for the train-time aux-classifier graph use
+    `build_with_aux`."""
+    return nn.Sequential(
+        *_stem(),
+        _inception_block(192, 64, 96, 128, 16, 32, 32, name="3a"),
+        _inception_block(256, 128, 128, 192, 32, 96, 64, name="3b"),
+        nn.SpatialMaxPooling(3, 3, 2, 2, -1, -1, ceil_mode=True),
+        _inception_block(480, 192, 96, 208, 16, 48, 64, name="4a"),
+        _inception_block(512, 160, 112, 224, 24, 64, 64, name="4b"),
+        _inception_block(512, 128, 128, 256, 24, 64, 64, name="4c"),
+        _inception_block(512, 112, 144, 288, 32, 64, 64, name="4d"),
+        _inception_block(528, 256, 160, 320, 32, 128, 128, name="4e"),
+        nn.SpatialMaxPooling(3, 3, 2, 2, -1, -1, ceil_mode=True),
+        _inception_block(832, 256, 160, 320, 32, 128, 128, name="5a"),
+        _inception_block(832, 384, 192, 384, 48, 128, 128, name="5b"),
+        nn.GlobalAveragePooling2D(),
+        *( [nn.Dropout(0.4)] if has_dropout else [] ),
+        nn.Linear(1024, class_num, name="loss3_classifier"),
+        nn.LogSoftMax(),
+        name="InceptionV1")

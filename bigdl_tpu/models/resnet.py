@@ -1,0 +1,133 @@
+"""ResNet — CIFAR-10 (6n+2 basic-block) and ImageNet (bottleneck) variants
+(reference: models/resnet/ResNet.scala:75-284; trainers
+models/resnet/Train.scala, TrainImageNet.scala).
+
+TPU-first notes: NHWC layout throughout (XLA's preferred conv layout on TPU),
+batch-norm folded next to convs so XLA fuses conv+bn+relu, identity shortcuts
+as plain adds (free fusion). The reference's `optnet` memory-sharing option is
+unnecessary — XLA buffer assignment already reuses activations.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.module import Module, _fold_name
+from bigdl_tpu.core import init as initializers
+
+
+def _conv_bn(nin, nout, k, stride=1, pad=0, relu=True, name=None,
+             zero_init_bn=False):
+    layers = [
+        nn.SpatialConvolution(nin, nout, k, k, stride, stride, pad, pad,
+                              bias=False, name=f"{name}_conv" if name else None),
+        nn.SpatialBatchNormalization(
+            nout, name=f"{name}_bn" if name else None,
+            **({"w_init": initializers.zeros} if zero_init_bn else {})),
+    ]
+    if relu:
+        layers.append(nn.ReLU())
+    return layers
+
+
+class _Residual(Module):
+    """y = relu(f(x) + shortcut(x)); `shortcut` is identity or 1x1 conv+bn.
+
+    The reference builds this out of ConcatTable+CAddTable
+    (ResNet.scala:151-170); a dedicated block keeps the param tree readable.
+    """
+
+    def __init__(self, body: Module, shortcut: Optional[Module] = None,
+                 name=None):
+        super().__init__(name)
+        self.add_child("body", body)
+        self.short = shortcut
+        if shortcut is not None:
+            self.add_child("shortcut", shortcut)
+
+    def _apply(self, params, state, x, *, training=False, rng=None):
+        new_state = dict(state)
+        body_rng = None if rng is None else _fold_name(rng, "body")
+        y, new_state["body"] = self.children()["body"].apply(
+            params["body"], state["body"], x, training=training, rng=body_rng)
+        if self.short is not None:
+            s, new_state["shortcut"] = self.children()["shortcut"].apply(
+                params["shortcut"], state["shortcut"], x, training=training)
+        else:
+            s = x
+        return jax.nn.relu(y + s), new_state
+
+
+def _basic_block(nin, nout, stride, name=None):
+    body = nn.Sequential(
+        *_conv_bn(nin, nout, 3, stride, 1, relu=True),
+        *_conv_bn(nout, nout, 3, 1, 1, relu=False, zero_init_bn=True))
+    short = None
+    if stride != 1 or nin != nout:
+        short = nn.Sequential(*_conv_bn(nin, nout, 1, stride, 0, relu=False))
+    return _Residual(body, short, name=name)
+
+
+def _bottleneck(nin, nmid, stride, name=None, expansion=4):
+    nout = nmid * expansion
+    body = nn.Sequential(
+        *_conv_bn(nin, nmid, 1, 1, 0, relu=True),
+        *_conv_bn(nmid, nmid, 3, stride, 1, relu=True),
+        *_conv_bn(nmid, nout, 1, 1, 0, relu=False, zero_init_bn=True))
+    short = None
+    if stride != 1 or nin != nout:
+        short = nn.Sequential(*_conv_bn(nin, nout, 1, stride, 0, relu=False))
+    return _Residual(body, short, name=name)
+
+
+def build_cifar(depth: int = 20, class_num: int = 10) -> nn.Sequential:
+    """CIFAR-10 ResNet, depth = 6n+2 (reference: ResNet.scala CIFAR branch;
+    Train.scala uses depth 20). Input NHWC (B, 32, 32, 3)."""
+    if (depth - 2) % 6 != 0:
+        raise ValueError("CIFAR ResNet depth must be 6n+2")
+    n = (depth - 2) // 6
+    layers = [*_conv_bn(3, 16, 3, 1, 1, relu=True, name="stem")]
+    nin = 16
+    for stage, (width, stride) in enumerate([(16, 1), (32, 2), (64, 2)]):
+        for i in range(n):
+            layers.append(_basic_block(nin, width, stride if i == 0 else 1,
+                                       name=f"s{stage}b{i}"))
+            nin = width
+    layers += [nn.GlobalAveragePooling2D(),
+               nn.Linear(64, class_num, name="fc"),
+               nn.LogSoftMax()]
+    return nn.Sequential(*layers, name=f"ResNet{depth}-CIFAR")
+
+
+_IMAGENET_CFG = {
+    18: ("basic", [2, 2, 2, 2]),
+    34: ("basic", [3, 4, 6, 3]),
+    50: ("bottleneck", [3, 4, 6, 3]),
+    101: ("bottleneck", [3, 4, 23, 3]),
+    152: ("bottleneck", [3, 8, 36, 3]),
+}
+
+
+def build(depth: int = 50, class_num: int = 1000) -> nn.Sequential:
+    """ImageNet ResNet (reference: ResNet.scala ImageNet branch,
+    TrainImageNet.scala uses ResNet-50). Input NHWC (B, 224, 224, 3)."""
+    kind, reps = _IMAGENET_CFG[depth]
+    block = _basic_block if kind == "basic" else _bottleneck
+    expansion = 1 if kind == "basic" else 4
+    layers = [
+        *_conv_bn(3, 64, 7, 2, 3, relu=True, name="stem"),
+        nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1),
+    ]
+    nin = 64
+    for stage, (width, rep) in enumerate(zip([64, 128, 256, 512], reps)):
+        for i in range(rep):
+            stride = 2 if (stage > 0 and i == 0) else 1
+            layers.append(block(nin, width, stride, name=f"s{stage}b{i}"))
+            nin = width * expansion
+    layers += [nn.GlobalAveragePooling2D(),
+               nn.Linear(nin, class_num, name="fc"),
+               nn.LogSoftMax()]
+    return nn.Sequential(*layers, name=f"ResNet{depth}")

@@ -1,0 +1,41 @@
+"""PTB language models (reference: models/rnn/PTBModel.scala — LSTM LM —
+and example/languagemodel/PTBWordLM.scala which adds a Transformer option).
+
+Two flagships:
+  * `build_lstm`   — embedding → stacked LSTM → vocab projection.
+  * `build_transformer` — decoder-only Transformer LM (nn.Transformer).
+"""
+
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+
+def build_lstm(vocab_size: int = 10000, embed_dim: int = 200,
+               hidden_size: int = 200, num_layers: int = 2,
+               keep_prob: float = 1.0) -> nn.Sequential:
+    """LSTM LM. apply(params, state, tokens:(B,T) int32) -> (B,T,V) log-probs."""
+    layers = [nn.LookupTable(vocab_size, embed_dim)]
+    if keep_prob < 1.0:
+        layers.append(nn.Dropout(1.0 - keep_prob))
+    nin = embed_dim
+    for i in range(num_layers):
+        layers.append(nn.Recurrent(nn.LSTM(nin, hidden_size),
+                                   return_sequences=True))
+        if keep_prob < 1.0:
+            layers.append(nn.Dropout(1.0 - keep_prob))
+        nin = hidden_size
+    layers += [nn.TimeDistributed(nn.Linear(hidden_size, vocab_size)),
+               nn.LogSoftMax()]
+    return nn.Sequential(*layers, name="PTB-LSTM")
+
+
+def build_transformer(vocab_size: int = 10000, d_model: int = 256,
+                      num_heads: int = 4, d_ff: int = 1024,
+                      num_layers: int = 4, dropout: float = 0.1,
+                      max_len: int = 512, attn_impl: str = "dense"):
+    """Decoder-only Transformer LM (reference wires nn/Transformer.scala:53
+    into PTBWordLM). `attn_impl='blockwise'` enables the long-context path."""
+    return nn.Transformer(vocab_size, d_model, num_heads, d_ff, num_layers,
+                          mode="lm", dropout=dropout, max_len=max_len,
+                          attn_impl=attn_impl, name="PTB-Transformer")

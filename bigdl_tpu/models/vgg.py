@@ -1,0 +1,63 @@
+"""VGG (reference: models/vgg/VggForCifar10.scala for CIFAR and
+models/vgg/Vgg_16.scala / Vgg_19.scala for ImageNet; the VGG-16 Caffe-load +
+int8 inference config is in BASELINE.json)."""
+
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+_CFG = {
+    16: [2, 2, 3, 3, 3],
+    19: [2, 2, 4, 4, 4],
+}
+
+
+def _conv_relu(nin, nout, bn=False):
+    layers = [nn.SpatialConvolution(nin, nout, 3, 3, 1, 1, 1, 1,
+                                    bias=not bn)]
+    if bn:
+        layers.append(nn.SpatialBatchNormalization(nout))
+    layers.append(nn.ReLU())
+    return layers
+
+
+def build(depth: int = 16, class_num: int = 1000,
+          batch_norm: bool = False) -> nn.Sequential:
+    """ImageNet VGG-16/19. Input NHWC (B, 224, 224, 3)."""
+    reps = _CFG[depth]
+    widths = [64, 128, 256, 512, 512]
+    layers = []
+    nin = 3
+    for rep, width in zip(reps, widths):
+        for _ in range(rep):
+            layers += _conv_relu(nin, width, bn=batch_norm)
+            nin = width
+        layers.append(nn.SpatialMaxPooling(2, 2, 2, 2))
+    layers += [
+        nn.Flatten(),
+        nn.Linear(512 * 7 * 7, 4096, name="fc6"), nn.ReLU(), nn.Dropout(0.5),
+        nn.Linear(4096, 4096, name="fc7"), nn.ReLU(), nn.Dropout(0.5),
+        nn.Linear(4096, class_num, name="fc8"),
+        nn.LogSoftMax(),
+    ]
+    return nn.Sequential(*layers, name=f"VGG{depth}")
+
+
+def build_cifar(class_num: int = 10) -> nn.Sequential:
+    """VggForCifar10 (reference: models/vgg/VggForCifar10.scala) — VGG-16
+    body with BN, 512-wide head. Input NHWC (B, 32, 32, 3)."""
+    layers = []
+    nin = 3
+    for rep, width in zip(_CFG[16], [64, 128, 256, 512, 512]):
+        for _ in range(rep):
+            layers += _conv_relu(nin, width, bn=True)
+            nin = width
+        layers.append(nn.SpatialMaxPooling(2, 2, 2, 2))
+    layers += [
+        nn.Flatten(),
+        nn.Linear(512, 512, name="fc1"), nn.BatchNormalization(512),
+        nn.ReLU(), nn.Dropout(0.5),
+        nn.Linear(512, class_num, name="fc2"),
+        nn.LogSoftMax(),
+    ]
+    return nn.Sequential(*layers, name="VggForCifar10")

@@ -32,6 +32,19 @@ from bigdl_tpu.nn.linear import Linear
 NEG_INF = -1e9
 
 
+def _inline_dropout(x, rate, training, rng, layer):
+    """Inverted dropout for layers that fold dropout into a fused block.
+    Same contract as nn.Dropout: training with a nonzero rate requires rng."""
+    if not training or rate <= 0.0:
+        return x
+    if rng is None:
+        raise ValueError(
+            f"{layer.name}: dropout={rate} in training mode needs rng= "
+            f"(pass rng to apply, or set dropout=0)")
+    keep = 1.0 - rate
+    return x * jax.random.bernoulli(rng, keep, x.shape) / keep
+
+
 def dot_product_attention(q, k, v, mask=None, *, scale: Optional[float] = None):
     """softmax(q k^T * scale + mask) v over the last two dims.
 
@@ -82,7 +95,8 @@ def blockwise_attention(q, k, v, *, block_size: int, causal: bool = False,
     decode convention)."""
     B, H, Tq, d = q.shape
     Tk = k.shape[2]
-    assert Tk % block_size == 0, (Tk, block_size)
+    if Tk % block_size != 0:
+        raise ValueError(f"Tk={Tk} must divide by block_size={block_size}")
     nblk = Tk // block_size
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     if q_offset is None:
@@ -130,11 +144,15 @@ class MultiHeadAttention(Module):
     """
 
     def __init__(self, d_model: int, num_heads: int, *,
-                 dropout: float = 0.0, attn_impl: str = "dense",
+                 dropout: float = 0.0, attn_impl="dense",
                  block_size: int = 512, name=None):
         super().__init__(name)
         if d_model % num_heads:
             raise ValueError(f"d_model {d_model} % heads {num_heads} != 0")
+        if attn_impl not in ("dense", "blockwise") and not callable(attn_impl):
+            raise ValueError(
+                f"attn_impl must be 'dense', 'blockwise', or a callable "
+                f"(q, k, v, mask=..., causal=...) -> out; got {attn_impl!r}")
         self.d_model, self.num_heads = d_model, num_heads
         self.head_dim = d_model // num_heads
         self.dropout = dropout
@@ -152,8 +170,12 @@ class MultiHeadAttention(Module):
             0, 2, 1, 3)
 
     def _attend(self, q, k, v, mask, causal):
+        if callable(self.attn_impl):
+            return self.attn_impl(q, k, v, mask=mask, causal=causal)
         if self.attn_impl == "blockwise":
-            assert mask is None, "blockwise path supports causal= only"
+            if mask is not None:
+                raise ValueError("blockwise path supports causal= only; "
+                                 "use attn_impl='dense' with a mask")
             return blockwise_attention(q, k, v, block_size=self.block_size,
                                        causal=causal)
         if causal:
@@ -172,9 +194,7 @@ class MultiHeadAttention(Module):
         B, H, T, hd = out.shape
         out = out.transpose(0, 2, 1, 3).reshape(B, T, H * hd)
         out = out @ params["wo"]
-        if training and self.dropout > 0 and rng is not None:
-            keep = 1.0 - self.dropout
-            out = out * jax.random.bernoulli(rng, keep, out.shape) / keep
+        out = _inline_dropout(out, self.dropout, training, rng, self)
         return out, state
 
 
@@ -192,9 +212,7 @@ class FeedForwardNetwork(Module):
     def _apply(self, params, state, x, *, training=False, rng=None):
         h, s1 = self.w1.apply(params["w1"], state.get("w1", {}), x)
         h = self.activation(h)
-        if training and self.dropout > 0 and rng is not None:
-            keep = 1.0 - self.dropout
-            h = h * jax.random.bernoulli(rng, keep, h.shape) / keep
+        h = _inline_dropout(h, self.dropout, training, rng, self)
         out, s2 = self.w2.apply(params["w2"], state.get("w2", {}), h)
         return out, {**state, "w1": s1, "w2": s2}
 
@@ -239,7 +257,8 @@ class TransformerLayer(Module):
                 rng=rngs[0])
         x = x + a
         if self.cross:
-            assert memory is not None, "decoder block needs encoder memory"
+            if memory is None:
+                raise ValueError("decoder block needs encoder memory")
             h = run("ln_x", x)
             a = run("xattn", h, memory, mask=memory_mask, training=training,
                     rng=rngs[1])

@@ -27,15 +27,17 @@ class BatchNormalization(Module):
     """
 
     def __init__(self, n_output: int, eps: float = 1e-5, momentum: float = 0.1,
-                 affine: bool = True, name: Optional[str] = None):
+                 affine: bool = True, w_init=initializers.ones,
+                 name: Optional[str] = None):
         super().__init__(name=name)
         self.n_output, self.eps, self.momentum, self.affine = \
             n_output, eps, momentum, affine
+        self._w_init = w_init
 
     def param_specs(self):
         if not self.affine:
             return {}
-        return {"weight": ParamSpec((self.n_output,), initializers.ones),
+        return {"weight": ParamSpec((self.n_output,), self._w_init),
                 "bias": ParamSpec((self.n_output,), initializers.zeros)}
 
     def state_specs(self):

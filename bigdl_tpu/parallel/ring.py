@@ -95,11 +95,18 @@ def ring_self_attention(mesh: Mesh, q, k, v, *, causal: bool = False,
 
 
 class RingAttention:
-    """Drop-in `attn_impl` backend for MultiHeadAttention when the model
-    runs under shard_map with a 'seq' axis: call sites use
-    `ring_attention` directly; this class exists for discoverability/API
-    parity with attn_impl strings."""
+    """Callable `attn_impl` backend for MultiHeadAttention: use when the
+    model body runs inside shard_map with the sequence dimension sharded
+    over `axis_name` — e.g.
+    `MultiHeadAttention(d, h, attn_impl=RingAttention())`. Masks beyond
+    `causal=` are not supported (mask tensors would need to be sequence-
+    sharded alongside q/k/v)."""
 
-    @staticmethod
-    def __call__(q, k, v, *, causal=False):
-        return ring_attention(q, k, v, causal=causal)
+    def __init__(self, axis_name: str = SEQ_AXIS):
+        self.axis_name = axis_name
+
+    def __call__(self, q, k, v, *, mask=None, causal=False):
+        if mask is not None:
+            raise ValueError("RingAttention supports causal= only")
+        return ring_attention(q, k, v, axis_name=self.axis_name,
+                              causal=causal)
