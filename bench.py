@@ -17,11 +17,9 @@ import time
 
 import numpy as np
 
-if os.environ.get("BIGDL_TPU_FORCE_CPU"):
-    # local smoke runs: the axon plugin ignores JAX_PLATFORMS=cpu, the
-    # config knob doesn't
-    import jax
-    jax.config.update("jax_platforms", "cpu")
+from bigdl_tpu.utils.platform import force_cpu_if_requested
+
+force_cpu_if_requested()
 
 
 def bench_lenet_train(batch_size=512, warmup=3, iters=20):

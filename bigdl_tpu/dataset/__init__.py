@@ -1,5 +1,7 @@
-"""bigdl_tpu.dataset — data pipeline (reference: dataset/, SURVEY.md §2.7)."""
+"""bigdl_tpu.dataset — data pipeline (reference: dataset/, transform/,
+SURVEY.md §2.7)."""
 
 from bigdl_tpu.dataset.core import (DataSet, ArrayDataSet, Sample, MiniBatch,
                                     Transformer, SampleToMiniBatch, Identity)
-from bigdl_tpu.dataset import mnist
+from bigdl_tpu.dataset import cifar, mnist, text, vision
+from bigdl_tpu.dataset.prefetch import MTBatchPipeline, prefetch_to_device

@@ -1,0 +1,54 @@
+"""CIFAR-10 loader (reference: models/resnet/Train.scala CIFAR pipeline;
+dataset/DataSet.scala ImageFolder analogue). Reads the python-pickle batches
+if a folder is supplied, else yields a deterministic synthetic set so e2e
+runs are hermetic."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional, Tuple
+
+import numpy as np
+
+# reference: models/resnet/Train.scala trainMean/trainStd (RGB)
+TRAIN_MEAN = (125.3, 123.0, 113.9)
+TRAIN_STD = (63.0, 62.1, 66.7)
+
+
+def synthetic(n: int = 512, seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Class-dependent colored blobs — learnable, hermetic."""
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, n).astype(np.int32)
+    x = rng.randn(n, 32, 32, 3).astype(np.float32) * 20 + 120
+    for i in range(n):
+        c = y[i]
+        x[i, (c * 3) % 28:(c * 3) % 28 + 6, :, c % 3] += 80.0
+    return np.clip(x, 0, 255), y
+
+
+def load(folder: Optional[str] = None, train: bool = True,
+         n_synthetic: int = 512) -> Tuple[np.ndarray, np.ndarray]:
+    """Returns (images NHWC float32 0..255, labels int32)."""
+    if folder and os.path.isdir(folder):
+        names = ([f"data_batch_{i}" for i in range(1, 6)] if train
+                 else ["test_batch"])
+        xs, ys = [], []
+        for name in names:
+            path = os.path.join(folder, name)
+            if not os.path.exists(path):
+                continue
+            with open(path, "rb") as fh:
+                d = pickle.load(fh, encoding="bytes")
+            xs.append(np.asarray(d[b"data"], np.uint8)
+                      .reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+            ys.append(np.asarray(d[b"labels"], np.int32))
+        if xs:
+            return (np.concatenate(xs).astype(np.float32),
+                    np.concatenate(ys))
+    return synthetic(n_synthetic, seed=0 if train else 1)
+
+
+def normalize(images: np.ndarray) -> np.ndarray:
+    return ((images - np.asarray(TRAIN_MEAN, np.float32))
+            / np.asarray(TRAIN_STD, np.float32))

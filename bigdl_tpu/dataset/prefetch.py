@@ -1,0 +1,79 @@
+"""Host→device prefetch (the TPU-first replacement for the reference's
+Engine.default data threads + MTImageFeatureToBatch multithreaded batching:
+transform/vision/image/MTImageFeatureToBatch.scala, utils/ThreadPool.scala).
+
+`prefetch_to_device` keeps `size` batches in flight: host threads run the
+numpy pipeline while the device computes, and `jax.device_put` overlaps the
+H2D copy with the current step — the same overlap DistriOptimizer gets from
+fetching weights while tasks run."""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, Optional
+
+import jax
+import numpy as np
+
+
+def prefetch_to_device(it: Iterable, size: int = 2,
+                       sharding=None) -> Iterator:
+    """Wrap a host batch iterator; yields device-resident batches.
+
+    `sharding` (optional jax.sharding.Sharding or pytree of them) places each
+    batch directly into its distributed layout — the device_put does the
+    host-split + per-device transfer in one call."""
+
+    def place(batch):
+        if sharding is None:
+            return jax.tree_util.tree_map(
+                lambda a: jax.device_put(np.asarray(a))
+                if isinstance(a, np.ndarray) else a, batch)
+        return jax.device_put(batch, sharding)
+
+    q: "queue.Queue" = queue.Queue(maxsize=size)
+    _END = object()
+    err: list = []
+
+    def worker():
+        try:
+            for batch in it:
+                q.put(place(batch))
+        except BaseException as e:          # surfaced on the consumer side
+            err.append(e)
+        finally:
+            q.put(_END)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _END:
+            if err:
+                raise err[0]
+            return
+        yield item
+
+
+class MTBatchPipeline:
+    """Multithreaded per-sample transform → batch assembly (reference:
+    MTImageFeatureToBatch.scala — N transformer threads filling one batch
+    buffer). Order within a batch is not guaranteed, matching the reference."""
+
+    def __init__(self, transform_fn: Callable, batch_size: int,
+                 num_threads: int = 4):
+        self.transform_fn = transform_fn
+        self.batch_size = batch_size
+        self.num_threads = num_threads
+
+    def __call__(self, samples: Iterable) -> Iterator:
+        from concurrent.futures import ThreadPoolExecutor
+        items = list(samples)
+        with ThreadPoolExecutor(self.num_threads) as pool:
+            done = list(pool.map(self.transform_fn, items))
+        for i in range(0, len(done) - self.batch_size + 1, self.batch_size):
+            chunk = done[i:i + self.batch_size]
+            xs = np.stack([c[0] for c in chunk])
+            ys = np.stack([c[1] for c in chunk])
+            yield xs, ys

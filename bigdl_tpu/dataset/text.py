@@ -1,0 +1,164 @@
+"""Text pipeline (reference: dataset/text/ — SentenceTokenizer,
+SentenceSplitter, Dictionary, TextToLabeledSentence, LabeledSentenceToSample,
+seq2seq padding; PTB loading in models/rnn/Utils.scala).
+
+The reference tokenizes with OpenNLP; a regex word tokenizer covers the PTB /
+text-classification use-cases without a JVM dependency."""
+
+from __future__ import annotations
+
+import os
+import re
+from collections import Counter
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.dataset.core import Sample, Transformer
+
+_WORD_RE = re.compile(r"[\w']+|[.,!?;]")
+
+SENTENCE_START = "SENTENCE_START"
+SENTENCE_END = "SENTENCE_END"
+
+
+def tokenize(sentence: str) -> List[str]:
+    """(reference: dataset/text/SentenceTokenizer.scala)."""
+    return _WORD_RE.findall(sentence.lower())
+
+
+def split_sentences(text: str) -> List[str]:
+    """(reference: dataset/text/SentenceSplitter.scala)."""
+    return [s.strip() for s in re.split(r"(?<=[.!?])\s+", text) if s.strip()]
+
+
+class SentenceTokenizer(Transformer):
+    def apply(self, it):
+        return (tokenize(s) for s in it)
+
+
+class SentenceBiPadding(Transformer):
+    """Wrap sentences with start/end markers
+    (reference: dataset/text/SentenceBiPadding.scala)."""
+
+    def apply(self, it):
+        for toks in it:
+            yield [SENTENCE_START] + list(toks) + [SENTENCE_END]
+
+
+class Dictionary:
+    """Word↔index vocab capped at `vocab_size` by frequency, rest → UNK
+    (reference: dataset/text/Dictionary.scala)."""
+
+    UNK = "<unk>"
+
+    def __init__(self, sentences: Optional[Iterable[Sequence[str]]] = None,
+                 vocab_size: Optional[int] = None):
+        self.word2index: Dict[str, int] = {}
+        self.index2word: List[str] = []
+        if sentences is not None:
+            counts = Counter(w for s in sentences for w in s)
+            most = counts.most_common(vocab_size)
+            for w, _ in most:
+                self._add(w)
+        self._add(self.UNK)
+
+    def _add(self, w: str) -> int:
+        if w not in self.word2index:
+            self.word2index[w] = len(self.index2word)
+            self.index2word.append(w)
+        return self.word2index[w]
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.index2word)
+
+    def index(self, w: str) -> int:
+        return self.word2index.get(w, self.word2index[self.UNK])
+
+    def encode(self, words: Sequence[str]) -> np.ndarray:
+        return np.asarray([self.index(w) for w in words], np.int32)
+
+    def decode(self, ids: Sequence[int]) -> List[str]:
+        return [self.index2word[i] for i in ids]
+
+
+class LabeledSentence:
+    """data tokens + label tokens (reference:
+    dataset/text/LabeledSentence.scala)."""
+
+    __slots__ = ("data", "label")
+
+    def __init__(self, data: np.ndarray, label: np.ndarray):
+        self.data, self.label = data, label
+
+
+class TextToLabeledSentence(Transformer):
+    """token ids → (ids[:-1], ids[1:]) LM pairs (reference:
+    dataset/text/TextToLabeledSentence.scala)."""
+
+    def __init__(self, dictionary: Dictionary):
+        self.dictionary = dictionary
+
+    def apply(self, it):
+        for toks in it:
+            ids = self.dictionary.encode(toks)
+            if len(ids) < 2:
+                continue
+            yield LabeledSentence(ids[:-1], ids[1:])
+
+
+class LabeledSentenceToSample(Transformer):
+    """Pad/truncate to fixed length → Sample (reference:
+    dataset/text/LabeledSentenceToSample.scala). Fixed length keeps XLA
+    shapes static; label positions past the true length get `pad_label`
+    (mask them in the criterion)."""
+
+    def __init__(self, fixed_length: Optional[int] = None,
+                 pad_token: int = 0, pad_label: int = -1):
+        self.fixed_length = fixed_length
+        self.pad_token, self.pad_label = pad_token, pad_label
+
+    def apply(self, it):
+        for ls in it:
+            n = self.fixed_length or len(ls.data)
+            data = np.full(n, self.pad_token, np.int32)
+            label = np.full(n, self.pad_label, np.int32)
+            k = min(n, len(ls.data))
+            data[:k] = ls.data[:k]
+            label[:k] = ls.label[:k]
+            yield Sample(data, label)
+
+
+def ptb_raw(folder: Optional[str] = None, split: str = "train",
+            synthetic_words: int = 20000, seed: int = 0) -> List[str]:
+    """Load `ptb.<split>.txt` tokens if present (reference:
+    models/rnn/Utils.scala readWords), else a synthetic Zipf corpus so
+    pipelines/tests run hermetically."""
+    if folder:
+        path = os.path.join(folder, f"ptb.{split}.txt")
+        if os.path.exists(path):
+            with open(path) as fh:
+                return fh.read().replace("\n", " <eos> ").split()
+    rng = np.random.RandomState(seed)
+    vocab = [f"w{i}" for i in range(200)]
+    probs = 1.0 / np.arange(1, 201)
+    probs /= probs.sum()
+    return list(rng.choice(vocab, size=synthetic_words, p=probs))
+
+
+def ptb_batches(words: List[str], dictionary: Dictionary, batch_size: int,
+                num_steps: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Contiguous LM batching: (B, steps) inputs/targets arrays stacked
+    epoch-wise (reference: models/rnn/Train.scala data layout)."""
+    ids = dictionary.encode(words)
+    n = (len(ids) - 1) // (batch_size * num_steps) * batch_size * num_steps
+    if n <= 0:
+        raise ValueError("corpus too small for batch configuration")
+    x = ids[:n].reshape(batch_size, -1)
+    y = ids[1:n + 1].reshape(batch_size, -1)
+    steps = x.shape[1] // num_steps
+    xs = x[:, :steps * num_steps].reshape(batch_size, steps, num_steps)
+    ys = y[:, :steps * num_steps].reshape(batch_size, steps, num_steps)
+    return (np.transpose(xs, (1, 0, 2)).astype(np.int32),
+            np.transpose(ys, (1, 0, 2)).astype(np.int32))

@@ -1,0 +1,438 @@
+"""Vision pipeline — ImageFeature + composable augmentations
+(reference: transform/vision/image/ImageFeature.scala, ImageFrame.scala,
+transform/vision/image/augmentation/ — 19 files — and the classic
+dataset/image/ pipeline: croppers, normalizers, ColorJitter, Lighting, HFlip).
+
+TPU-first: all augmentation is host-side numpy over float HWC arrays (the
+reference leans on OpenCV JNI mats; XLA wants the device doing matmuls, not
+jpeg math). Randomness uses an explicit np.random.RandomState so pipelines
+are reproducible and shardable by seed.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.dataset.core import Sample, Transformer
+
+
+class ImageFeature(dict):
+    """Mutable record flowing through the pipeline (reference:
+    transform/vision/image/ImageFeature.scala — keys mirror its constants)."""
+
+    FLOATS = "floats"          # HWC float32 image
+    LABEL = "label"
+    ORIGINAL_SIZE = "originalSize"
+    BOXES = "boxes"            # (N, 4) xyxy
+    URI = "uri"
+
+    def __init__(self, floats: Optional[np.ndarray] = None, label=None,
+                 uri: Optional[str] = None, **kw):
+        super().__init__(**kw)
+        if floats is not None:
+            self[self.FLOATS] = np.asarray(floats, np.float32)
+            self[self.ORIGINAL_SIZE] = self[self.FLOATS].shape
+        if label is not None:
+            self[self.LABEL] = label
+        if uri is not None:
+            self[self.URI] = uri
+
+    @property
+    def floats(self) -> np.ndarray:
+        return self[self.FLOATS]
+
+    @floats.setter
+    def floats(self, v):
+        self[self.FLOATS] = v
+
+    @property
+    def label(self):
+        return self.get(self.LABEL)
+
+    def to_sample(self) -> Sample:
+        return Sample(self.floats, self.label)
+
+
+class FeatureTransformer(Transformer):
+    """Per-image stage (reference: FeatureTransformer composition via `->`).
+    Subclasses implement `transform(feature, rng)`; rng is shared pipeline
+    state seeded once."""
+
+    def __init__(self, seed: Optional[int] = None):
+        self._rng = np.random.RandomState(seed)
+
+    def transform(self, f: ImageFeature, rng: np.random.RandomState):
+        raise NotImplementedError
+
+    def apply(self, it):
+        for f in it:
+            out = self.transform(f, self._rng)
+            yield f if out is None else out
+
+
+class PixelTransformer(FeatureTransformer):
+    """Base for ops that only touch the float image."""
+
+    def pixels(self, img: np.ndarray, rng) -> np.ndarray:
+        raise NotImplementedError
+
+    def transform(self, f, rng):
+        f.floats = self.pixels(f.floats, rng).astype(np.float32)
+        return f
+
+
+class Brightness(PixelTransformer):
+    """Add uniform delta (reference: augmentation/Brightness.scala —
+    delta on 0..255-scale images)."""
+
+    def __init__(self, delta_low: float = -32.0, delta_high: float = 32.0,
+                 seed=None):
+        super().__init__(seed)
+        self.low, self.high = delta_low, delta_high
+
+    def pixels(self, img, rng):
+        return img + rng.uniform(self.low, self.high)
+
+
+class Contrast(PixelTransformer):
+    """Scale around zero (reference: augmentation/Contrast.scala)."""
+
+    def __init__(self, delta_low: float = 0.5, delta_high: float = 1.5,
+                 seed=None):
+        super().__init__(seed)
+        self.low, self.high = delta_low, delta_high
+
+    def pixels(self, img, rng):
+        return img * rng.uniform(self.low, self.high)
+
+
+def rgb_to_hsv(img: np.ndarray) -> np.ndarray:
+    """Vectorized RGB[0..1] → HSV (h in degrees 0..360)."""
+    r, g, b = img[..., 0], img[..., 1], img[..., 2]
+    maxc = img.max(-1)
+    minc = img.min(-1)
+    v = maxc
+    d = maxc - minc
+    s = np.where(maxc > 0, d / np.maximum(maxc, 1e-12), 0.0)
+    dz = np.maximum(d, 1e-12)
+    h = np.where(maxc == r, (g - b) / dz % 6.0,
+                 np.where(maxc == g, (b - r) / dz + 2.0, (r - g) / dz + 4.0))
+    h = np.where(d == 0, 0.0, h) * 60.0
+    return np.stack([h, s, v], -1)
+
+
+def hsv_to_rgb(hsv: np.ndarray) -> np.ndarray:
+    h, s, v = hsv[..., 0] / 60.0, hsv[..., 1], hsv[..., 2]
+    i = np.floor(h) % 6
+    f = h - np.floor(h)
+    p = v * (1 - s)
+    q = v * (1 - s * f)
+    t = v * (1 - s * (1 - f))
+    out = np.zeros(hsv.shape, hsv.dtype)
+    for idx, (rr, gg, bb) in enumerate(
+            [(v, t, p), (q, v, p), (p, v, t), (p, q, v), (t, p, v), (v, p, q)]):
+        m = i == idx
+        out[..., 0] = np.where(m, rr, out[..., 0])
+        out[..., 1] = np.where(m, gg, out[..., 1])
+        out[..., 2] = np.where(m, bb, out[..., 2])
+    return out
+
+
+class Saturation(PixelTransformer):
+    """Scale HSV saturation (reference: augmentation/Saturation.scala).
+    Expects 0..255 RGB input."""
+
+    def __init__(self, delta_low: float = 0.5, delta_high: float = 1.5,
+                 seed=None):
+        super().__init__(seed)
+        self.low, self.high = delta_low, delta_high
+
+    def pixels(self, img, rng):
+        hsv = rgb_to_hsv(img / 255.0)
+        hsv[..., 1] = np.clip(hsv[..., 1] * rng.uniform(self.low, self.high),
+                              0, 1)
+        return hsv_to_rgb(hsv) * 255.0
+
+
+class Hue(PixelTransformer):
+    """Rotate HSV hue by delta degrees (reference: augmentation/Hue.scala)."""
+
+    def __init__(self, delta_low: float = -18.0, delta_high: float = 18.0,
+                 seed=None):
+        super().__init__(seed)
+        self.low, self.high = delta_low, delta_high
+
+    def pixels(self, img, rng):
+        hsv = rgb_to_hsv(img / 255.0)
+        hsv[..., 0] = (hsv[..., 0] + rng.uniform(self.low, self.high)) % 360.0
+        return hsv_to_rgb(hsv) * 255.0
+
+
+class ChannelOrder(PixelTransformer):
+    """RGB↔BGR flip (reference: augmentation/ChannelOrder.scala)."""
+
+    def pixels(self, img, rng):
+        return img[..., ::-1]
+
+
+class ChannelNormalize(PixelTransformer):
+    """(x - mean) / std per channel (reference:
+    augmentation/ChannelNormalize.scala; classic BGRImgNormalizer)."""
+
+    def __init__(self, mean: Sequence[float], std: Sequence[float] = (1, 1, 1)):
+        super().__init__()
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+    def pixels(self, img, rng):
+        return (img - self.mean) / self.std
+
+
+def resize_bilinear(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
+    """Pure-numpy bilinear resize, align_corners=False (half-pixel centers,
+    the OpenCV INTER_LINEAR convention the reference uses)."""
+    h, w = img.shape[:2]
+    if (h, w) == (out_h, out_w):
+        return img.astype(np.float32)
+    ys = (np.arange(out_h) + 0.5) * h / out_h - 0.5
+    xs = (np.arange(out_w) + 0.5) * w / out_w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :, None]
+    img = img.astype(np.float32)
+    top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1] * wx
+    bot = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
+class Resize(FeatureTransformer):
+    """(reference: augmentation/Resize.scala)."""
+
+    def __init__(self, height: int, width: int, seed=None):
+        super().__init__(seed)
+        self.h, self.w = height, width
+
+    def transform(self, f, rng):
+        f.floats = resize_bilinear(f.floats, self.h, self.w)
+        return f
+
+
+class AspectScale(FeatureTransformer):
+    """Resize the short side to `scale`, cap long side
+    (reference: augmentation/AspectScale.scala)."""
+
+    def __init__(self, scale: int, max_size: int = 1000, seed=None):
+        super().__init__(seed)
+        self.scale, self.max_size = scale, max_size
+
+    def transform(self, f, rng):
+        h, w = f.floats.shape[:2]
+        short, long = min(h, w), max(h, w)
+        ratio = self.scale / short
+        if long * ratio > self.max_size:
+            ratio = self.max_size / long
+        f.floats = resize_bilinear(f.floats, int(round(h * ratio)),
+                                   int(round(w * ratio)))
+        return f
+
+
+class CenterCrop(FeatureTransformer):
+    """(reference: augmentation/Crop.scala CenterCrop; classic
+    BGRImgCropper cropperMethod="center")."""
+
+    def __init__(self, crop_h: int, crop_w: int, seed=None):
+        super().__init__(seed)
+        self.ch, self.cw = crop_h, crop_w
+
+    def transform(self, f, rng):
+        h, w = f.floats.shape[:2]
+        y = max(0, (h - self.ch) // 2)
+        x = max(0, (w - self.cw) // 2)
+        f.floats = f.floats[y:y + self.ch, x:x + self.cw]
+        return f
+
+
+class RandomCrop(FeatureTransformer):
+    """(reference: augmentation/Crop.scala RandomCrop)."""
+
+    def __init__(self, crop_h: int, crop_w: int, seed=None):
+        super().__init__(seed)
+        self.ch, self.cw = crop_h, crop_w
+
+    def transform(self, f, rng):
+        h, w = f.floats.shape[:2]
+        y = rng.randint(0, max(1, h - self.ch + 1))
+        x = rng.randint(0, max(1, w - self.cw + 1))
+        f.floats = f.floats[y:y + self.ch, x:x + self.cw]
+        return f
+
+
+class PaddedRandomCrop(FeatureTransformer):
+    """Zero-pad then random-crop — the CIFAR augmentation
+    (reference: models/resnet/Train.scala pipeline: pad 4, crop 32)."""
+
+    def __init__(self, crop_h: int, crop_w: int, pad: int = 4, seed=None):
+        super().__init__(seed)
+        self.ch, self.cw, self.pad = crop_h, crop_w, pad
+
+    def transform(self, f, rng):
+        img = np.pad(f.floats, ((self.pad, self.pad), (self.pad, self.pad),
+                                (0, 0)))
+        h, w = img.shape[:2]
+        y = rng.randint(0, h - self.ch + 1)
+        x = rng.randint(0, w - self.cw + 1)
+        f.floats = img[y:y + self.ch, x:x + self.cw]
+        return f
+
+
+class HFlip(FeatureTransformer):
+    """Random horizontal flip (reference: augmentation/HFlip.scala;
+    classic HFlip)."""
+
+    def __init__(self, p: float = 0.5, seed=None):
+        super().__init__(seed)
+        self.p = p
+
+    def transform(self, f, rng):
+        if rng.rand() < self.p:
+            f.floats = f.floats[:, ::-1]
+        return f
+
+
+class Expand(FeatureTransformer):
+    """Place image on a larger mean-filled canvas
+    (reference: augmentation/Expand.scala)."""
+
+    def __init__(self, max_ratio: float = 4.0,
+                 fill: Sequence[float] = (123, 117, 104), seed=None):
+        super().__init__(seed)
+        self.max_ratio, self.fill = max_ratio, np.asarray(fill, np.float32)
+
+    def transform(self, f, rng):
+        ratio = rng.uniform(1.0, self.max_ratio)
+        h, w, c = f.floats.shape
+        nh, nw = int(h * ratio), int(w * ratio)
+        canvas = np.broadcast_to(self.fill, (nh, nw, c)).copy()
+        y = rng.randint(0, nh - h + 1)
+        x = rng.randint(0, nw - w + 1)
+        canvas[y:y + h, x:x + w] = f.floats
+        f.floats = canvas
+        return f
+
+
+class ColorJitter(FeatureTransformer):
+    """Random-order brightness/contrast/saturation
+    (reference: dataset/image/ColorJitter.scala)."""
+
+    def __init__(self, brightness: float = 0.4, contrast: float = 0.4,
+                 saturation: float = 0.4, seed=None):
+        super().__init__(seed)
+        self.b, self.c, self.s = brightness, contrast, saturation
+
+    def transform(self, f, rng):
+        img = f.floats
+        ops = []
+        if self.b:
+            ops.append(lambda x: x * rng.uniform(1 - self.b, 1 + self.b))
+        if self.c:
+            ops.append(lambda x: (x - x.mean()) *
+                       rng.uniform(1 - self.c, 1 + self.c) + x.mean())
+        if self.s:
+            def sat(x):
+                grey = x.mean(-1, keepdims=True)
+                a = rng.uniform(1 - self.s, 1 + self.s)
+                return x * a + grey * (1 - a)
+            ops.append(sat)
+        for i in rng.permutation(len(ops)):
+            img = ops[i](img)
+        f.floats = img.astype(np.float32)
+        return f
+
+
+class Lighting(FeatureTransformer):
+    """AlexNet-style PCA lighting noise (reference:
+    dataset/image/Lighting.scala — eigvals/eigvecs are the ImageNet ones)."""
+
+    EIGVAL = np.array([0.2175, 0.0188, 0.0045], np.float32)
+    EIGVEC = np.array([[-0.5675, 0.7192, 0.4009],
+                       [-0.5808, -0.0045, -0.8140],
+                       [-0.5836, -0.6948, 0.4203]], np.float32)
+
+    def __init__(self, alpha_std: float = 0.1, seed=None):
+        super().__init__(seed)
+        self.alpha_std = alpha_std
+
+    def transform(self, f, rng):
+        alpha = rng.normal(0, self.alpha_std, 3).astype(np.float32)
+        noise = (self.EIGVEC * alpha * self.EIGVAL).sum(1)
+        f.floats = f.floats + noise
+        return f
+
+
+class RandomTransformer(FeatureTransformer):
+    """Apply inner transformer with probability p
+    (reference: augmentation/RandomTransformer.scala)."""
+
+    def __init__(self, inner: FeatureTransformer, p: float = 0.5, seed=None):
+        super().__init__(seed)
+        self.inner, self.p = inner, p
+
+    def transform(self, f, rng):
+        if rng.rand() < self.p:
+            return self.inner.transform(f, rng)
+        return f
+
+
+class Pipeline(FeatureTransformer):
+    """Chain of FeatureTransformers sharing one rng (reference: `->`)."""
+
+    def __init__(self, *stages: FeatureTransformer, seed=None):
+        super().__init__(seed)
+        self.stages = stages
+
+    def transform(self, f, rng):
+        for s in self.stages:
+            f = s.transform(f, rng)
+        return f
+
+
+class ImageFeatureToSample(Transformer):
+    """(reference: ImageFeatureToMiniBatch path / MatToFloats+ToSample)."""
+
+    def apply(self, it):
+        for f in it:
+            yield f.to_sample()
+
+
+class ImageFrame:
+    """Local collection of ImageFeatures with chained transforms
+    (reference: transform/vision/image/ImageFrame.scala LocalImageFrame;
+    the Distributed variant is the mesh data loader's job here)."""
+
+    def __init__(self, features: List[ImageFeature]):
+        self.features = list(features)
+        self._pipeline: List[FeatureTransformer] = []
+
+    @staticmethod
+    def from_arrays(images: np.ndarray, labels=None) -> "ImageFrame":
+        labels = labels if labels is not None else [None] * len(images)
+        return ImageFrame([ImageFeature(img, lab)
+                           for img, lab in zip(images, labels)])
+
+    def transform(self, t: FeatureTransformer) -> "ImageFrame":
+        self._pipeline.append(t)
+        return self
+
+    def __iter__(self):
+        it = iter(self.features)
+        for t in self._pipeline:
+            it = t.apply(it)
+        return it
+
+    def to_samples(self) -> List[Sample]:
+        return [f.to_sample() for f in self]

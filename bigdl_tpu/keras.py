@@ -1,0 +1,160 @@
+"""Keras-style high-level API (reference: nn/keras/Topology.scala:55-116 —
+`compile(optimizer, loss, metrics)` + `fit/evaluate/predict`; KerasLayer
+shape inference maps to lazy input-size resolution at `init`).
+
+The underlying layers ARE the bigdl_tpu.nn modules — this is a facade over
+the same Optimizer/Predictor machinery, as in the reference."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.module import Criterion, Module
+from bigdl_tpu.dataset import ArrayDataSet
+from bigdl_tpu.optim.local import Optimizer
+from bigdl_tpu.optim.method import SGD, Adam, Adagrad, Adamax, OptimMethod, RMSprop
+from bigdl_tpu.optim.metrics import (Loss, MAE, Top1Accuracy, Top5Accuracy,
+                                     ValidationMethod, evaluate)
+from bigdl_tpu.optim.predictor import Predictor
+from bigdl_tpu.optim.trigger import Trigger
+
+_OPTIMIZERS = {
+    "sgd": lambda: SGD(0.01),
+    "adam": lambda: Adam(1e-3),
+    "rmsprop": lambda: RMSprop(1e-3),
+    "adagrad": lambda: Adagrad(1e-2),
+    "adamax": lambda: Adamax(2e-3),
+}
+
+_LOSSES = {
+    "categorical_crossentropy": nn.ClassNLLCriterion,
+    "sparse_categorical_crossentropy": nn.ClassNLLCriterion,
+    "mse": nn.MSECriterion,
+    "mean_squared_error": nn.MSECriterion,
+    "mae": nn.AbsCriterion,
+    "mean_absolute_error": nn.AbsCriterion,
+    "binary_crossentropy": nn.BCECriterion,
+    "hinge": nn.MarginCriterion,
+    "kld": nn.DistKLDivCriterion,
+}
+
+_METRICS = {
+    "accuracy": Top1Accuracy,
+    "acc": Top1Accuracy,
+    "top5": Top5Accuracy,
+    "loss": Loss,
+    "mae": MAE,
+}
+
+
+def _resolve(table, value, kind):
+    if isinstance(value, str):
+        try:
+            return table[value.lower()]()
+        except KeyError:
+            raise ValueError(f"unknown {kind} {value!r}; "
+                             f"one of {sorted(table)}") from None
+    return value
+
+
+class KerasModel:
+    """compile/fit/evaluate/predict on any Module
+    (reference: nn/keras/Topology.scala KerasNet)."""
+
+    def __init__(self, module: Module):
+        self.module = module
+        self.params = None
+        self.model_state = None
+        self.optim_method: Optional[OptimMethod] = None
+        self.criterion: Optional[Criterion] = None
+        self.metrics: List[ValidationMethod] = []
+
+    def compile(self, optimizer: Union[str, OptimMethod],
+                loss: Union[str, Criterion],
+                metrics: Sequence[Union[str, ValidationMethod]] = ()):
+        """(reference: Topology.scala:55 compile)."""
+        self.optim_method = _resolve(_OPTIMIZERS, optimizer, "optimizer")
+        self.criterion = _resolve(_LOSSES, loss, "loss")
+        self.metrics = [_resolve(_METRICS, m, "metric") for m in metrics]
+        return self
+
+    def fit(self, x: np.ndarray, y: np.ndarray, batch_size: int = 32,
+            nb_epoch: int = 10, validation_data: Optional[Tuple] = None,
+            shuffle: bool = True, seed: int = 1):
+        """(reference: Topology.scala:89 fit)."""
+        if self.criterion is None:
+            raise RuntimeError("call compile() before fit()")
+        ds = ArrayDataSet(np.asarray(x), np.asarray(y), batch_size,
+                          shuffle=shuffle, drop_last=True, seed=seed)
+        opt = Optimizer(self.module, ds, self.criterion, self.optim_method,
+                        seed=seed)
+        opt.set_end_when(Trigger.max_epoch(nb_epoch))
+        if validation_data is not None and self.metrics:
+            vx, vy = validation_data
+            vds = ArrayDataSet(np.asarray(vx), np.asarray(vy), batch_size,
+                               shuffle=False)
+            opt.set_validation(Trigger.every_epoch(), vds, self.metrics)
+        if self.params is not None:
+            opt._resume_trees = {"params": self.params,
+                                 "model_state": self.model_state}
+        self.params, self.model_state = opt.optimize()
+        return self
+
+    def _ensure_init(self, seed=0):
+        if self.params is None:
+            self.params, self.model_state = self.module.init(
+                jax.random.PRNGKey(seed))
+
+    def evaluate(self, x: np.ndarray, y: np.ndarray, batch_size: int = 32):
+        """Returns list of ValidationResult
+        (reference: Topology.scala evaluate)."""
+        self._ensure_init()
+        methods = self.metrics or [Top1Accuracy()]
+        ds = ArrayDataSet(np.asarray(x), np.asarray(y), batch_size,
+                          shuffle=False)
+        return evaluate(self.module, self.params, self.model_state, ds,
+                        methods)
+
+    def predict(self, x: np.ndarray, batch_size: int = 32) -> np.ndarray:
+        """(reference: Topology.scala predict)."""
+        self._ensure_init()
+        return Predictor(self.module, self.params, self.model_state,
+                         batch_size=batch_size).predict(np.asarray(x))
+
+    def predict_classes(self, x: np.ndarray, batch_size: int = 32):
+        return np.argmax(self.predict(x, batch_size), axis=-1)
+
+    def save(self, path: str):
+        from bigdl_tpu.utils.serializer import save_module
+        self._ensure_init()
+        save_module(path, self.module, self.params, self.model_state)
+
+    @classmethod
+    def load(cls, path: str) -> "KerasModel":
+        from bigdl_tpu.utils.serializer import load_module
+        module, params, state = load_module(path)
+        m = cls(module)
+        m.params, m.model_state = params, state
+        return m
+
+
+class Sequential(KerasModel):
+    """Keras-style Sequential (reference: nn/keras/Topology.scala
+    Sequential)."""
+
+    def __init__(self, *layers: Module):
+        super().__init__(nn.Sequential(*layers, name="KerasSequential"))
+
+    def add(self, layer: Module):
+        self.module.add(layer)
+        return self
+
+
+def Model(module: Module) -> KerasModel:
+    """Wrap a Graph/Module as a compilable model
+    (reference: nn/keras/Topology.scala Model)."""
+    return KerasModel(module)

@@ -34,6 +34,26 @@ def _inception_block(nin, c1, c3r, c3, c5r, c5, pool_proj, name=None):
         name=name)
 
 
+# (name, nin, c1, c3r, c3, c5r, c5, pool_proj) for blocks 3a..5b — shared by
+# build() and build_with_aux() so the two graphs cannot drift
+_BLOCKS = [
+    ("3a", 192, 64, 96, 128, 16, 32, 32),
+    ("3b", 256, 128, 128, 192, 32, 96, 64),
+    ("4a", 480, 192, 96, 208, 16, 48, 64),
+    ("4b", 512, 160, 112, 224, 24, 64, 64),
+    ("4c", 512, 128, 128, 256, 24, 64, 64),
+    ("4d", 512, 112, 144, 288, 32, 64, 64),
+    ("4e", 528, 256, 160, 320, 32, 128, 128),
+    ("5a", 832, 256, 160, 320, 32, 128, 128),
+    ("5b", 832, 384, 192, 384, 48, 128, 128),
+]
+
+
+def _block(name):
+    cfg = next(b for b in _BLOCKS if b[0] == name)
+    return _inception_block(*cfg[1:], name=cfg[0])
+
+
 def _stem():
     return [
         _conv(3, 64, 7, 2, 3, name="conv1"),
@@ -70,21 +90,21 @@ class _InceptionWithAux(nn.Module):
         super().__init__(name)
         self.add_child("to4a", nn.Sequential(
             *_stem(),
-            _inception_block(192, 64, 96, 128, 16, 32, 32, name="3a"),
-            _inception_block(256, 128, 128, 192, 32, 96, 64, name="3b"),
+            _block("3a"),
+            _block("3b"),
             nn.SpatialMaxPooling(3, 3, 2, 2, -1, -1, ceil_mode=True),
-            _inception_block(480, 192, 96, 208, 16, 48, 64, name="4a")))
+            _block("4a")))
         self.add_child("aux1", _aux_head(512, class_num, "loss1"))
         self.add_child("to4d", nn.Sequential(
-            _inception_block(512, 160, 112, 224, 24, 64, 64, name="4b"),
-            _inception_block(512, 128, 128, 256, 24, 64, 64, name="4c"),
-            _inception_block(512, 112, 144, 288, 32, 64, 64, name="4d")))
+            _block("4b"),
+            _block("4c"),
+            _block("4d")))
         self.add_child("aux2", _aux_head(528, class_num, "loss2"))
         self.add_child("tail", nn.Sequential(
-            _inception_block(528, 256, 160, 320, 32, 128, 128, name="4e"),
+            _block("4e"),
             nn.SpatialMaxPooling(3, 3, 2, 2, -1, -1, ceil_mode=True),
-            _inception_block(832, 256, 160, 320, 32, 128, 128, name="5a"),
-            _inception_block(832, 384, 192, 384, 48, 128, 128, name="5b"),
+            _block("5a"),
+            _block("5b"),
             nn.GlobalAveragePooling2D(),
             nn.Dropout(0.4),
             nn.Linear(1024, class_num, name="loss3_classifier"),
@@ -120,17 +140,17 @@ def build(class_num: int = 1000, has_dropout: bool = True) -> nn.Sequential:
     `build_with_aux`."""
     return nn.Sequential(
         *_stem(),
-        _inception_block(192, 64, 96, 128, 16, 32, 32, name="3a"),
-        _inception_block(256, 128, 128, 192, 32, 96, 64, name="3b"),
+        _block("3a"),
+        _block("3b"),
         nn.SpatialMaxPooling(3, 3, 2, 2, -1, -1, ceil_mode=True),
-        _inception_block(480, 192, 96, 208, 16, 48, 64, name="4a"),
-        _inception_block(512, 160, 112, 224, 24, 64, 64, name="4b"),
-        _inception_block(512, 128, 128, 256, 24, 64, 64, name="4c"),
-        _inception_block(512, 112, 144, 288, 32, 64, 64, name="4d"),
-        _inception_block(528, 256, 160, 320, 32, 128, 128, name="4e"),
+        _block("4a"),
+        _block("4b"),
+        _block("4c"),
+        _block("4d"),
+        _block("4e"),
         nn.SpatialMaxPooling(3, 3, 2, 2, -1, -1, ceil_mode=True),
-        _inception_block(832, 256, 160, 320, 32, 128, 128, name="5a"),
-        _inception_block(832, 384, 192, 384, 48, 128, 128, name="5b"),
+        _block("5a"),
+        _block("5b"),
         nn.GlobalAveragePooling2D(),
         *( [nn.Dropout(0.4)] if has_dropout else [] ),
         nn.Linear(1024, class_num, name="loss3_classifier"),

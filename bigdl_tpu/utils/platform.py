@@ -1,0 +1,32 @@
+"""Platform selection for entry-point scripts.
+
+This image ships an experimental `axon` TPU plugin that ignores the
+`JAX_PLATFORMS` env var (and hangs when the chip tunnel is down). The jax
+config knob still wins if applied before backend init, so scripts call
+`force_cpu_if_requested()` first thing. Triggers on either knob:
+  * BIGDL_TPU_FORCE_CPU=1
+  * XLA_FLAGS containing --xla_force_host_platform_device_count (a CPU-mesh
+    run by definition — the driver's dryrun path)
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def cpu_requested() -> bool:
+    return bool(os.environ.get("BIGDL_TPU_FORCE_CPU")) or \
+        "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", "")
+
+
+def force_cpu_if_requested() -> bool:
+    """Apply the CPU override if requested. Safe to call repeatedly; must run
+    before any jax backend is initialized. Returns True if CPU was forced."""
+    if not cpu_requested():
+        return False
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized — too late to switch
+    return True

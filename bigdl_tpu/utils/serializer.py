@@ -1,0 +1,104 @@
+"""Durable model format — save/load a Module declaration + weights
+(reference: utils/serializer/ModuleSerializer.scala, ModuleLoader.scala:49 —
+protobuf definition + separate big-weight file with storage dedup;
+AbstractModule.saveModule/loadModule).
+
+Format: a zip containing
+  module.pkl    — pickled Module tree (declarations only: hyperparameters,
+                  no arrays — the analogue of the proto topology message)
+  arrays.npz    — every params/state leaf, keyed by pytree path
+  meta.json     — format version, framework version, leaf manifest
+
+Weight dedup (reference: ModuleLoader storage sharing) is inherent: shared
+Module instances appear once in the pickle graph, and leaves are stored by
+path so tied weights (same array object) serialize once per unique id.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import pickle
+import zipfile
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def _flatten(tree, prefix="", empties=None) -> Dict[str, Any]:
+    out = {}
+    if isinstance(tree, dict):
+        if not tree and empties is not None and prefix:
+            empties.append(prefix.rstrip("/"))
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{k}/", empties))
+    else:
+        out[prefix.rstrip("/")] = tree
+    return out
+
+
+def _unflatten(flat: Dict[str, Any]) -> Dict:
+    root: Dict = {}
+    for path, v in flat.items():
+        parts = path.split("/")
+        d = root
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = v
+    return root
+
+
+def save_module(path: str, module, params: Dict, state: Dict) -> None:
+    """(reference: AbstractModule.saveModule → ModulePersister)."""
+    leaves = {}
+    dedup: Dict[int, str] = {}
+    manifest = {}
+    empties: list = []
+    for kind, tree in (("params", params), ("state", state)):
+        for k, v in _flatten(tree, f"{kind}/", empties).items():
+            arr = np.asarray(v)
+            ref = dedup.get(id(v))
+            if ref is not None:
+                manifest[k] = {"ref": ref}
+            else:
+                dedup[id(v)] = k
+                leaves[k] = arr
+                manifest[k] = {"shape": list(arr.shape),
+                               "dtype": str(arr.dtype)}
+    buf = io.BytesIO()
+    # npz keys cannot contain '/' reliably across zip tools — escape
+    np.savez(buf, **{k.replace("/", "|"): a for k, a in leaves.items()})
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("module.pkl", pickle.dumps(module))
+        zf.writestr("arrays.npz", buf.getvalue())
+        zf.writestr("meta.json", json.dumps({
+            "format_version": FORMAT_VERSION,
+            "module_name": getattr(module, "name", type(module).__name__),
+            "manifest": manifest,
+            "empty_subtrees": empties,
+        }, indent=1))
+
+
+def load_module(path: str) -> Tuple[Any, Dict, Dict]:
+    """Returns (module, params, state)
+    (reference: Module.loadModule → ModuleLoader.loadFromFile)."""
+    with zipfile.ZipFile(path) as zf:
+        meta = json.loads(zf.read("meta.json"))
+        if meta["format_version"] > FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint format {meta['format_version']} is newer than "
+                f"supported {FORMAT_VERSION}")
+        module = pickle.loads(zf.read("module.pkl"))
+        npz = np.load(io.BytesIO(zf.read("arrays.npz")))
+        leaves = {k.replace("|", "/"): npz[k] for k in npz.files}
+    flat = {}
+    for k, info in meta["manifest"].items():
+        flat[k] = leaves[info["ref"]] if "ref" in info else leaves[k]
+    tree = _unflatten(flat)
+    for path in meta.get("empty_subtrees", ()):
+        d = tree
+        for p in path.split("/"):
+            d = d.setdefault(p, {})
+    return module, tree.get("params", {}), tree.get("state", {})

@@ -1,0 +1,267 @@
+"""TensorBoard-compatible training summaries
+(reference: visualization/TrainSummary.scala:32, ValidationSummary.scala:29,
+visualization/tensorboard/{EventWriter,RecordWriter}.scala,
+src/main/java/netty/Crc32c.java).
+
+Writes real TensorBoard event files with no TF dependency: the Event proto is
+hand-encoded (wire format below), records are framed TFRecord-style with
+masked CRC32C — byte-compatible with `tensorboard --logdir`.
+
+Event proto (tensorflow/core/util/event.proto):
+    double wall_time = 1; int64 step = 2; string file_version = 3;
+    Summary summary = 5;
+Summary.Value: tag = 1 (string), simple_value = 2 (float).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------- CRC32C
+_CRC_TABLE = []
+_POLY = 0x82F63B78
+for _n in range(256):
+    _c = _n
+    for _ in range(8):
+        _c = (_c >> 1) ^ _POLY if _c & 1 else _c >> 1
+    _CRC_TABLE.append(_c)
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    """Castagnoli CRC (reference: netty/Crc32c.java)."""
+    crc ^= 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+# -------------------------------------------------------- proto encoding
+def _varint(n: int) -> bytes:
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint(field << 3 | wire)
+
+
+def _pb_double(field: int, v: float) -> bytes:
+    return _tag(field, 1) + struct.pack("<d", v)
+
+
+def _pb_float(field: int, v: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", v)
+
+
+def _pb_int64(field: int, v: int) -> bytes:
+    return _tag(field, 0) + _varint(v & 0xFFFFFFFFFFFFFFFF)
+
+
+def _pb_bytes(field: int, v: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(v)) + v
+
+
+def _pb_string(field: int, v: str) -> bytes:
+    return _pb_bytes(field, v.encode())
+
+
+def encode_scalar_event(tag: str, value: float, step: int,
+                        wall_time: Optional[float] = None) -> bytes:
+    sv = _pb_string(1, tag) + _pb_float(2, value)
+    summary = _pb_bytes(1, sv)
+    return (_pb_double(1, wall_time if wall_time is not None else time.time())
+            + _pb_int64(2, step) + _pb_bytes(5, summary))
+
+
+def encode_file_version_event() -> bytes:
+    return _pb_double(1, time.time()) + _pb_string(3, "brain.Event:2")
+
+
+def frame_record(data: bytes) -> bytes:
+    """TFRecord framing (reference: RecordWriter.scala)."""
+    header = struct.pack("<Q", len(data))
+    return (header + struct.pack("<I", _masked_crc(header)) + data
+            + struct.pack("<I", _masked_crc(data)))
+
+
+def parse_records(blob: bytes) -> List[bytes]:
+    """Inverse of frame_record, with CRC verification (reference:
+    visualization/tensorboard/FileReader.scala)."""
+    out, off = [], 0
+    while off < len(blob):
+        (length,) = struct.unpack_from("<Q", blob, off)
+        (hcrc,) = struct.unpack_from("<I", blob, off + 8)
+        if _masked_crc(blob[off:off + 8]) != hcrc:
+            raise ValueError(f"corrupt record header at {off}")
+        data = blob[off + 12:off + 12 + length]
+        (dcrc,) = struct.unpack_from("<I", blob, off + 12 + length)
+        if _masked_crc(data) != dcrc:
+            raise ValueError(f"corrupt record body at {off}")
+        out.append(data)
+        off += 16 + length
+    return out
+
+
+def parse_scalar_event(data: bytes) -> Optional[Tuple[str, float, int]]:
+    """Minimal decoder for round-trip tests/readers: returns
+    (tag, value, step) for scalar events, None otherwise."""
+    off, step, tag, value = 0, 0, None, None
+    while off < len(data):
+        key = data[off]
+        field, wire = key >> 3, key & 7
+        off += 1
+        if wire == 0:
+            v = 0
+            shift = 0
+            while True:
+                b = data[off]
+                off += 1
+                v |= (b & 0x7F) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+            if field == 2:
+                step = v
+        elif wire == 1:
+            off += 8
+        elif wire == 5:
+            off += 4
+        elif wire == 2:
+            ln = 0
+            shift = 0
+            while True:
+                b = data[off]
+                off += 1
+                ln |= (b & 0x7F) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+            sub = data[off:off + ln]
+            off += ln
+            if field == 5:          # Summary
+                soff = 0
+                while soff < len(sub):
+                    skey = sub[soff]
+                    soff += 1
+                    sln = sub[soff]
+                    soff += 1
+                    val = sub[soff:soff + sln]
+                    soff += sln
+                    if skey >> 3 == 1:   # Value message
+                        voff = 0
+                        while voff < len(val):
+                            vkey = val[voff]
+                            vfield, vwire = vkey >> 3, vkey & 7
+                            voff += 1
+                            if vwire == 2:
+                                vln = val[voff]
+                                voff += 1
+                                if vfield == 1:
+                                    tag = val[voff:voff + vln].decode()
+                                voff += vln
+                            elif vwire == 5:
+                                if vfield == 2:
+                                    (value,) = struct.unpack_from(
+                                        "<f", val, voff)
+                                voff += 4
+                            elif vwire == 1:
+                                voff += 8
+                            else:
+                                return None
+        else:
+            return None
+    if tag is None or value is None:
+        return None
+    return tag, value, step
+
+
+class EventWriter:
+    """Dedicated writer thread draining a queue to an event file
+    (reference: visualization/tensorboard/EventWriter.scala:31-66)."""
+
+    def __init__(self, log_dir: str, flush_secs: float = 5.0):
+        os.makedirs(log_dir, exist_ok=True)
+        self.path = os.path.join(
+            log_dir, f"events.out.tfevents.{int(time.time())}.bigdl-tpu")
+        self._q: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self.flush_secs = flush_secs
+        self._fh = open(self.path, "ab")
+        self._fh.write(frame_record(encode_file_version_event()))
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def add_scalar(self, tag: str, value: float, step: int):
+        self._q.put(encode_scalar_event(tag, float(value), int(step)))
+
+    def _run(self):
+        while not self._stop.is_set() or not self._q.empty():
+            try:
+                ev = self._q.get(timeout=self.flush_secs)
+                self._fh.write(frame_record(ev))
+            except queue.Empty:
+                pass
+            if self._q.empty():
+                self._fh.flush()
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=10)
+        self._fh.flush()
+        self._fh.close()
+
+
+class Summary:
+    """Base summary bound to logdir/<app_name>/<tag> like the reference."""
+
+    tag = "summary"
+
+    def __init__(self, log_dir: str, app_name: str):
+        self.log_dir = os.path.join(log_dir, app_name, self.tag)
+        self._writer = EventWriter(self.log_dir)
+
+    def add_scalar(self, tag: str, value: float, step: int):
+        self._writer.add_scalar(tag, value, step)
+        return self
+
+    def read_scalar(self, tag: str) -> List[Tuple[int, float]]:
+        """(reference: TrainSummary.readScalar via FileReader)."""
+        self._writer._fh.flush()
+        out = []
+        for name in sorted(os.listdir(self.log_dir)):
+            with open(os.path.join(self.log_dir, name), "rb") as fh:
+                for rec in parse_records(fh.read()):
+                    parsed = parse_scalar_event(rec)
+                    if parsed and parsed[0] == tag:
+                        out.append((parsed[2], parsed[1]))
+        return out
+
+    def close(self):
+        self._writer.close()
+
+
+class TrainSummary(Summary):
+    """(reference: visualization/TrainSummary.scala:32 — Loss/Throughput/
+    LearningRate written per iteration by the trainer)."""
+    tag = "train"
+
+
+class ValidationSummary(Summary):
+    """(reference: visualization/ValidationSummary.scala:29)."""
+    tag = "validation"

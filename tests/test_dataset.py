@@ -1,0 +1,167 @@
+"""Data pipeline tests (reference test analogue: transform/vision specs and
+dataset/text specs — construct transforms, run on small arrays, assert
+shapes/values)."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset import (ArrayDataSet, MiniBatch, Sample,
+                               SampleToMiniBatch, cifar, text, vision)
+from bigdl_tpu.dataset.prefetch import prefetch_to_device
+from bigdl_tpu.dataset.vision import (AspectScale, Brightness, CenterCrop,
+                                      ChannelNormalize, ChannelOrder,
+                                      ColorJitter, Contrast, Expand,
+                                      FeatureTransformer, HFlip, Hue,
+                                      ImageFeature, ImageFrame, Lighting,
+                                      PaddedRandomCrop, Pipeline, RandomCrop,
+                                      RandomTransformer, Resize, Saturation,
+                                      hsv_to_rgb, resize_bilinear, rgb_to_hsv)
+
+
+def _img(h=8, w=8, c=3, seed=0):
+    return np.random.RandomState(seed).rand(h, w, c).astype(np.float32) * 255
+
+
+def test_hsv_roundtrip():
+    img = _img() / 255.0
+    back = hsv_to_rgb(rgb_to_hsv(img))
+    np.testing.assert_allclose(back, img, atol=1e-5)
+
+
+def test_resize_bilinear_identity_and_shape():
+    img = _img(8, 8)
+    np.testing.assert_allclose(resize_bilinear(img, 8, 8), img)
+    assert resize_bilinear(img, 16, 12).shape == (16, 12, 3)
+    # constant image stays constant
+    const = np.full((5, 5, 3), 7.0, np.float32)
+    np.testing.assert_allclose(resize_bilinear(const, 9, 11), 7.0, atol=1e-5)
+
+
+def test_crops_and_flip():
+    f = ImageFeature(_img(10, 10), label=1)
+    f = CenterCrop(6, 6).transform(f, np.random.RandomState(0))
+    assert f.floats.shape == (6, 6, 3)
+    f2 = ImageFeature(_img(10, 10))
+    f2 = RandomCrop(4, 4).transform(f2, np.random.RandomState(0))
+    assert f2.floats.shape == (4, 4, 3)
+    f3 = ImageFeature(_img(8, 8))
+    orig = f3.floats.copy()
+    f3 = HFlip(p=1.0).transform(f3, np.random.RandomState(0))
+    np.testing.assert_allclose(f3.floats, orig[:, ::-1])
+    f4 = ImageFeature(_img(32, 32))
+    f4 = PaddedRandomCrop(32, 32, pad=4).transform(
+        f4, np.random.RandomState(0))
+    assert f4.floats.shape == (32, 32, 3)
+
+
+def test_pixel_transforms_shapes():
+    rng = np.random.RandomState(0)
+    for t in [Brightness(), Contrast(), Saturation(), Hue(), ColorJitter(),
+              Lighting(), ChannelOrder(),
+              ChannelNormalize((120, 120, 120), (60, 60, 60))]:
+        f = ImageFeature(_img())
+        out = t.transform(f, rng)
+        assert out.floats.shape == (8, 8, 3)
+        assert np.isfinite(out.floats).all()
+
+
+def test_channel_normalize_values():
+    f = ImageFeature(np.full((2, 2, 3), 130.0, np.float32))
+    out = ChannelNormalize((120, 120, 120), (10, 10, 10)).transform(
+        f, np.random.RandomState(0))
+    np.testing.assert_allclose(out.floats, 1.0)
+
+
+def test_expand_and_aspect_scale():
+    f = ImageFeature(_img(10, 20))
+    out = Expand(max_ratio=2.0).transform(f, np.random.RandomState(0))
+    assert out.floats.shape[0] >= 10 and out.floats.shape[1] >= 20
+    f2 = ImageFeature(_img(10, 20))
+    out2 = AspectScale(30, max_size=100).transform(
+        f2, np.random.RandomState(0))
+    assert min(out2.floats.shape[:2]) == 30
+
+
+def test_image_frame_pipeline():
+    imgs = np.stack([_img(12, 12, seed=i) for i in range(6)])
+    labels = np.arange(6)
+    frame = ImageFrame.from_arrays(imgs, labels)
+    frame.transform(Pipeline(Resize(8, 8), HFlip(p=1.0, seed=0)))
+    samples = frame.to_samples()
+    assert len(samples) == 6
+    assert samples[0].feature.shape == (8, 8, 3)
+    assert samples[3].label == 3
+
+
+def test_random_transformer_never_fires_at_p0():
+    f = ImageFeature(_img())
+    orig = f.floats.copy()
+    out = RandomTransformer(HFlip(p=1.0), p=0.0).transform(
+        f, np.random.RandomState(0))
+    np.testing.assert_allclose(out.floats, orig)
+
+
+def test_cifar_synthetic_learnable_stats():
+    x, y = cifar.load(None, train=True, n_synthetic=64)
+    assert x.shape == (64, 32, 32, 3) and y.shape == (64,)
+    assert x.min() >= 0 and x.max() <= 255
+    xn = cifar.normalize(x)
+    assert abs(float(xn.mean())) < 1.5
+
+
+def test_tokenize_and_dictionary():
+    sents = [text.tokenize("The cat sat on the mat."),
+             text.tokenize("The dog sat!")]
+    d = text.Dictionary(sents, vocab_size=5)
+    assert d.vocab_size == 6        # 5 + UNK
+    ids = d.encode(["the", "zebra"])
+    assert ids[1] == d.word2index[text.Dictionary.UNK]
+    assert d.decode([ids[0]]) == ["the"]
+
+
+def test_text_lm_pipeline():
+    sents = ["the cat sat", "the dog ran fast"]
+    toks = list(text.SentenceTokenizer()(sents))
+    d = text.Dictionary(toks)
+    pipeline = (text.SentenceTokenizer()
+                >> text.SentenceBiPadding()
+                >> text.TextToLabeledSentence(d)
+                >> text.LabeledSentenceToSample(fixed_length=6))
+    samples = list(pipeline(sents))
+    assert len(samples) == 2
+    assert samples[0].feature.shape == (6,)
+    assert samples[0].label.shape == (6,)
+
+
+def test_ptb_batches_contiguity():
+    words = [f"w{i % 7}" for i in range(1000)]
+    d = text.Dictionary([words])
+    xs, ys = text.ptb_batches(words, d, batch_size=4, num_steps=10)
+    assert xs.shape[1:] == (4, 10) and ys.shape == xs.shape
+    # target is the next token of input everywhere
+    ids = d.encode(words)
+    np.testing.assert_array_equal(xs[0, 0, 1:], ys[0, 0, :-1])
+
+
+def test_prefetch_to_device_preserves_order_and_errors():
+    batches = [(np.full((2, 2), i, np.float32), np.array([i])) for i in range(5)]
+    out = list(prefetch_to_device(iter(batches), size=2))
+    assert len(out) == 5
+    assert float(out[3][0][0, 0]) == 3.0
+
+    def bad():
+        yield batches[0]
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        list(prefetch_to_device(bad(), size=1))
+
+
+def test_mt_batch_pipeline():
+    from bigdl_tpu.dataset.prefetch import MTBatchPipeline
+    items = [(np.full((3, 3), i, np.float32), i) for i in range(8)]
+    mt = MTBatchPipeline(lambda s: (s[0] * 2, np.int32(s[1])), batch_size=4,
+                         num_threads=2)
+    got = list(mt(items))
+    assert len(got) == 2
+    assert got[0][0].shape == (4, 3, 3)
