@@ -35,7 +35,9 @@ def build_transformer(vocab_size: int = 10000, d_model: int = 256,
                       num_layers: int = 4, dropout: float = 0.1,
                       max_len: int = 512, attn_impl: str = "dense"):
     """Decoder-only Transformer LM (reference wires nn/Transformer.scala:53
-    into PTBWordLM). `attn_impl='blockwise'` enables the long-context path."""
+    into PTBWordLM). `attn_impl='blockwise'` enables the long-context path.
+    Returns tied-embedding LOGITS — pair with CrossEntropyCriterion (the
+    LSTM variant ends in LogSoftMax and pairs with ClassNLLCriterion)."""
     return nn.Transformer(vocab_size, d_model, num_heads, d_ff, num_layers,
                           mode="lm", dropout=dropout, max_len=max_len,
                           attn_impl=attn_impl, name="PTB-Transformer")

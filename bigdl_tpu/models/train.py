@@ -1,0 +1,257 @@
+"""Training CLIs for the model zoo — the analogue of each model's
+`Train.scala` + scopt `Options.scala` (reference: models/lenet/Train.scala:35,
+models/resnet/Train.scala, models/inception/TrainInceptionV1.scala,
+models/rnn/Train.scala, models/vgg/Train.scala; perf harness
+models/utils/DistriOptimizerPerf.scala).
+
+    python -m bigdl_tpu.models.train lenet  --max-epoch 5
+    python -m bigdl_tpu.models.train resnet --depth 20 --batch-size 128
+    python -m bigdl_tpu.models.train ptb    --model lstm
+    python -m bigdl_tpu.models.train inception --batch-size 32 --max-iter 20
+
+Each reproduces a BASELINE.json config. Without real data folders the
+hermetic synthetic datasets are used so every CLI runs anywhere."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+import numpy as np
+
+from bigdl_tpu.utils.platform import force_cpu_if_requested
+
+
+def _common(p: argparse.ArgumentParser):
+    p.add_argument("-f", "--folder", default=None, help="dataset folder")
+    p.add_argument("-b", "--batch-size", type=int, default=None)
+    p.add_argument("-e", "--max-epoch", type=int, default=None)
+    p.add_argument("--max-iter", type=int, default=None)
+    p.add_argument("--learning-rate", type=float, default=None)
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--summary-dir", default=None)
+    p.add_argument("--synthetic-size", type=int, default=512)
+    p.add_argument("--optimizer", default=None,
+                   help="sgd|adam|rmsprop (model default otherwise)")
+
+
+def _end_trigger(args, default_epochs):
+    from bigdl_tpu.optim.trigger import Trigger
+    if args.max_iter:
+        return Trigger.max_iteration(args.max_iter)
+    return Trigger.max_epoch(args.max_epoch or default_epochs)
+
+
+def _finish(opt, args, model, app):
+    from bigdl_tpu.optim.trigger import Trigger
+    from bigdl_tpu import visualization as viz
+    if args.checkpoint:
+        opt.set_checkpoint(args.checkpoint, Trigger.every_epoch())
+    if args.summary_dir:
+        opt.set_train_summary(viz.TrainSummary(args.summary_dir, app))
+    params, state = opt.optimize()
+    print(f"{app}: finished at iter {opt.state['neval']} "
+          f"loss {opt.state.get('loss', float('nan')):.4f}")
+    return params, state
+
+
+def _method(args, default):
+    from bigdl_tpu.optim.method import SGD, Adam, RMSprop
+    lr = args.learning_rate
+    if args.optimizer == "adam":
+        return Adam(lr or 1e-3)
+    if args.optimizer == "rmsprop":
+        return RMSprop(lr or 1e-3)
+    if args.optimizer == "sgd":
+        return SGD(lr or 0.01, momentum=0.9)
+    # --learning-rate alone keeps the model's tuned default method
+    # (schedule, weight decay) and only overrides the base LR
+    if lr is not None:
+        default.learning_rate = lr
+    return default
+
+
+def train_lenet(args):
+    """(reference: models/lenet/Train.scala:35-102 — BASELINE config 1)."""
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import ArrayDataSet, mnist
+    from bigdl_tpu.optim.local import Optimizer
+    from bigdl_tpu.optim.method import SGD
+    from bigdl_tpu.optim.metrics import Top1Accuracy
+    from bigdl_tpu.optim.trigger import Trigger
+    from bigdl_tpu.models import lenet
+
+    x, y = mnist.load(args.folder, train=True,
+                      n_synthetic=args.synthetic_size)
+    x = mnist.normalize(x).reshape(-1, 28, 28, 1)
+    bs = args.batch_size or 128
+    ds = ArrayDataSet(x, y, bs, drop_last=True)
+    val = ArrayDataSet(x, y, bs, shuffle=False)
+    model = lenet.build(10)
+    opt = Optimizer(model, ds, nn.ClassNLLCriterion(),
+                    _method(args, SGD(0.05, momentum=0.9)))
+    opt.set_end_when(_end_trigger(args, 5))
+    opt.set_validation(Trigger.every_epoch(), val, [Top1Accuracy()])
+    return _finish(opt, args, model, "lenet")
+
+
+def train_resnet(args):
+    """(reference: models/resnet/Train.scala — BASELINE config 2:
+    ResNet on CIFAR-10)."""
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import ArrayDataSet, cifar
+    from bigdl_tpu.dataset.vision import (ChannelNormalize, HFlip, ImageFrame,
+                                          PaddedRandomCrop, Pipeline)
+    from bigdl_tpu.optim.local import Optimizer
+    from bigdl_tpu.optim.method import SGD
+    from bigdl_tpu.optim.metrics import Top1Accuracy
+    from bigdl_tpu.optim.schedule import MultiStep
+    from bigdl_tpu.optim.trigger import Trigger
+    from bigdl_tpu.models import resnet
+
+    x, y = cifar.load(args.folder, train=True,
+                      n_synthetic=args.synthetic_size)
+    frame = ImageFrame.from_arrays(x, y)
+    frame.transform(Pipeline(
+        PaddedRandomCrop(32, 32, pad=4, seed=1), HFlip(seed=2),
+        ChannelNormalize(cifar.TRAIN_MEAN, cifar.TRAIN_STD)))
+    aug = np.stack([f.floats for f in frame])
+    bs = args.batch_size or 128
+    ds = ArrayDataSet(aug, y, bs, drop_last=True)
+    model = resnet.build_cifar(depth=args.depth, class_num=10)
+    method = _method(args, SGD(0.1, momentum=0.9, weight_decay=1e-4,
+                               learning_rate_schedule=MultiStep(
+                                   [80, 120], 0.1)))
+    opt = Optimizer(model, ds, nn.ClassNLLCriterion(), method)
+    opt.set_end_when(_end_trigger(args, 10))
+    opt.set_validation(Trigger.every_epoch(),
+                       ArrayDataSet(aug, y, bs, shuffle=False),
+                       [Top1Accuracy()])
+    return _finish(opt, args, model, "resnet-cifar")
+
+
+def train_inception(args):
+    """(reference: models/inception/TrainInceptionV1.scala — BASELINE
+    config 3; synthetic stand-in for the ImageNet seq-file pipeline)."""
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import ArrayDataSet
+    from bigdl_tpu.optim.local import Optimizer
+    from bigdl_tpu.optim.method import SGD
+    from bigdl_tpu.optim.schedule import Poly
+    from bigdl_tpu.models import inception
+
+    n = min(args.synthetic_size, 64)
+    r = np.random.RandomState(0)
+    x = r.randn(n, 224, 224, 3).astype(np.float32)
+    y = r.randint(0, 1000, n).astype(np.int32)
+    bs = args.batch_size or 8
+    ds = ArrayDataSet(x, y, bs, drop_last=True)
+    model = inception.build(1000)
+    method = _method(args, SGD(
+        0.0898, momentum=0.9, weight_decay=1e-4,
+        learning_rate_schedule=Poly(0.5, 62000)))
+    opt = Optimizer(model, ds, nn.ClassNLLCriterion(), method)
+    opt.set_end_when(_end_trigger(args, 1))
+    return _finish(opt, args, model, "inception-v1")
+
+
+def train_vgg(args):
+    """(reference: models/vgg/Train.scala — VGG on CIFAR-10)."""
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import ArrayDataSet, cifar
+    from bigdl_tpu.optim.local import Optimizer
+    from bigdl_tpu.optim.method import SGD
+    from bigdl_tpu.models import vgg
+
+    x, y = cifar.load(args.folder, train=True,
+                      n_synthetic=args.synthetic_size)
+    xn = cifar.normalize(x)
+    bs = args.batch_size or 64
+    ds = ArrayDataSet(xn, y, bs, drop_last=True)
+    model = vgg.build_cifar(10)
+    opt = Optimizer(model, ds, nn.ClassNLLCriterion(),
+                    _method(args, SGD(0.01, momentum=0.9,
+                                      weight_decay=5e-4)))
+    opt.set_end_when(_end_trigger(args, 2))
+    return _finish(opt, args, model, "vgg-cifar")
+
+
+def train_ptb(args):
+    """(reference: models/rnn/Train.scala + example/languagemodel/
+    PTBWordLM.scala — BASELINE config 4)."""
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import text as T
+    from bigdl_tpu.dataset.core import IteratorDataSet, MiniBatch
+    from bigdl_tpu.optim.local import Optimizer
+    from bigdl_tpu.optim.method import Adam
+    from bigdl_tpu.models import rnn
+
+    words = T.ptb_raw(args.folder, "train")
+    d = T.Dictionary([words], vocab_size=args.vocab_size - 1)
+    bs = args.batch_size or 20
+    xs, ys = T.ptb_batches(words, d, bs, args.num_steps)
+
+    def epoch():
+        for i in range(xs.shape[0]):
+            yield MiniBatch(xs[i], ys[i])
+
+    ds = IteratorDataSet(epoch)
+    if args.model == "transformer":
+        model = rnn.build_transformer(d.vocab_size, d_model=args.hidden,
+                                      num_heads=4, d_ff=args.hidden * 4,
+                                      num_layers=args.layers, dropout=0.0)
+    else:
+        model = rnn.build_lstm(d.vocab_size, embed_dim=args.hidden,
+                               hidden_size=args.hidden,
+                               num_layers=args.layers)
+    # build_lstm ends in LogSoftMax (ClassNLL input); the Transformer LM
+    # returns tied-embedding logits (CrossEntropy input)
+    inner = (nn.CrossEntropyCriterion() if args.model == "transformer"
+             else nn.ClassNLLCriterion())
+    crit = nn.TimeDistributedCriterion(inner, size_average=True)
+    opt = Optimizer(model, ds, crit, _method(args, Adam(1e-3)))
+    opt.set_end_when(_end_trigger(args, 1))
+    params, state = _finish(opt, args, model, f"ptb-{args.model}")
+    print(f"ptb perplexity ~ {np.exp(opt.state['loss']):.1f}")
+    return params, state
+
+
+def main(argv=None):
+    force_cpu_if_requested()
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    ap = argparse.ArgumentParser(prog="bigdl_tpu.models.train")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("lenet", help="LeNet-5 on MNIST")
+    _common(p)
+
+    p = sub.add_parser("resnet", help="ResNet on CIFAR-10")
+    _common(p)
+    p.add_argument("--depth", type=int, default=20)
+
+    p = sub.add_parser("inception", help="Inception-v1 on ImageNet")
+    _common(p)
+
+    p = sub.add_parser("vgg", help="VGG on CIFAR-10")
+    _common(p)
+
+    p = sub.add_parser("ptb", help="PTB language model")
+    _common(p)
+    p.add_argument("--model", choices=["lstm", "transformer"],
+                   default="lstm")
+    p.add_argument("--hidden", type=int, default=128)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--num-steps", type=int, default=20)
+    p.add_argument("--vocab-size", type=int, default=10000)
+
+    args = ap.parse_args(argv)
+    fn = {"lenet": train_lenet, "resnet": train_resnet,
+          "inception": train_inception, "vgg": train_vgg,
+          "ptb": train_ptb}[args.cmd]
+    return fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main() is not None else 1)

@@ -1,0 +1,356 @@
+"""TF-semantics operations (reference: nn/ops/ — 70+ files with `Operation`
+base at nn/ops/Operation.scala: forward-only modules — plus nn/onnx/ Gemm/
+Reshape/Shape). Thin, forward-only Module wrappers over jnp/lax so TF-style
+graphs (and the GraphDef importer) have their op vocabulary.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.core.module import Module
+
+
+class Operation(Module):
+    """Forward-only op (reference: nn/ops/Operation.scala — backward
+    raises). Gradients still flow via autodiff where defined; `is_operation`
+    marks parity with the reference's contract."""
+    is_operation = True
+
+
+def _binary(name, fn):
+    cls = type(name, (Operation,), {
+        "forward": lambda self, params, a, b=None, **kw:
+            fn(a, b) if b is not None else fn(*a),
+        "__doc__": f"(reference: nn/ops/{name}.scala)"})
+    return cls
+
+
+Add = _binary("Add", jnp.add)
+Subtract = _binary("Subtract", jnp.subtract)
+Multiply = _binary("Multiply", jnp.multiply)
+Divide = _binary("Divide", jnp.divide)
+RealDiv = _binary("RealDiv", jnp.true_divide)
+FloorDiv = _binary("FloorDiv", jnp.floor_divide)
+Mod = _binary("Mod", jnp.mod)
+Maximum = _binary("Maximum", jnp.maximum)
+Minimum = _binary("Minimum", jnp.minimum)
+Pow = _binary("Pow", jnp.power)
+SquaredDifference = _binary("SquaredDifference",
+                            lambda a, b: jnp.square(a - b))
+
+Equal = _binary("Equal", lambda a, b: a == b)
+NotEqual = _binary("NotEqual", lambda a, b: a != b)
+Greater = _binary("Greater", lambda a, b: a > b)
+GreaterEqual = _binary("GreaterEqual", lambda a, b: a >= b)
+Less = _binary("Less", lambda a, b: a < b)
+LessEqual = _binary("LessEqual", lambda a, b: a <= b)
+LogicalAnd = _binary("LogicalAnd", jnp.logical_and)
+LogicalOr = _binary("LogicalOr", jnp.logical_or)
+
+
+class LogicalNot(Operation):
+    def forward(self, params, x, **_):
+        return jnp.logical_not(x)
+
+
+def _unary(name, fn):
+    return type(name, (Operation,), {
+        "forward": lambda self, params, x, **kw: fn(x),
+        "__doc__": f"(reference: nn/ops/{name}.scala)"})
+
+
+Abs = _unary("Abs", jnp.abs)
+Ceil = _unary("Ceil", jnp.ceil)
+Floor = _unary("Floor", jnp.floor)
+Round = _unary("Round", jnp.round)
+Exp = _unary("Exp", jnp.exp)
+Expm1 = _unary("Expm1", jnp.expm1)
+Log = _unary("Log", jnp.log)
+Log1p = _unary("Log1p", jnp.log1p)
+Sqrt = _unary("Sqrt", jnp.sqrt)
+Rsqrt = _unary("Rsqrt", lambda x: 1.0 / jnp.sqrt(x))
+Square = _unary("Square", jnp.square)
+Sign = _unary("Sign", jnp.sign)
+Erf = _unary("Erf", jax.scipy.special.erf)
+Erfc = _unary("Erfc", jax.scipy.special.erfc)
+Digamma = _unary("Digamma", jax.scipy.special.digamma)
+Lgamma = _unary("Lgamma", jax.scipy.special.gammaln)
+IsNan = _unary("IsNan", jnp.isnan)
+IsInf = _unary("IsInf", jnp.isinf)
+IsFinite = _unary("IsFinite", jnp.isfinite)
+
+
+class Cast(Operation):
+    """(reference: nn/ops/Cast.scala)."""
+
+    def __init__(self, dtype, name=None):
+        super().__init__(name)
+        self.dtype = dtype
+
+    def forward(self, params, x, **_):
+        return x.astype(self.dtype)
+
+
+class BatchMatMul(Operation):
+    """(reference: nn/ops/BatchMatMul.scala — adjX/adjY transposes)."""
+
+    def __init__(self, adj_x: bool = False, adj_y: bool = False, name=None):
+        super().__init__(name)
+        self.adj_x, self.adj_y = adj_x, adj_y
+
+    def forward(self, params, a, b=None, **_):
+        if b is None:
+            a, b = a
+        if self.adj_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.adj_y:
+            b = jnp.swapaxes(b, -1, -2)
+        return a @ b
+
+
+class MatMul(BatchMatMul):
+    """(reference: nn/ops/MatMul.scala)."""
+
+
+class TopK(Operation):
+    """Returns (values, indices) (reference: nn/ops/TopK.scala)."""
+
+    def __init__(self, k: int, sorted: bool = True, name=None):
+        super().__init__(name)
+        self.k = k
+        # lax.top_k always returns sorted values, which satisfies both the
+        # sorted=True contract and the order-unspecified sorted=False one
+        self.sorted = sorted
+
+    def forward(self, params, x, **_):
+        return lax.top_k(x, self.k)
+
+
+class OneHot(Operation):
+    """(reference: nn/ops/OneHot.scala)."""
+
+    def __init__(self, depth: int, on_value: float = 1.0,
+                 off_value: float = 0.0, axis: int = -1, name=None):
+        super().__init__(name)
+        self.depth, self.on, self.off, self.axis = \
+            depth, on_value, off_value, axis
+
+    def forward(self, params, x, **_):
+        oh = jax.nn.one_hot(x, self.depth, axis=self.axis)
+        return oh * (self.on - self.off) + self.off
+
+
+class Gather(Operation):
+    """(reference: nn/ops/Gather.scala)."""
+
+    def __init__(self, axis: int = 0, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def forward(self, params, x, indices=None, **_):
+        if indices is None:
+            x, indices = x
+        return jnp.take(x, indices, axis=self.axis)
+
+
+class Pad(Operation):
+    """(reference: nn/ops/Pad.scala — paddings (ndim, 2))."""
+
+    def __init__(self, paddings: Sequence[Tuple[int, int]],
+                 constant_value: float = 0.0, name=None):
+        super().__init__(name)
+        self.paddings = tuple(tuple(p) for p in paddings)
+        self.value = constant_value
+
+    def forward(self, params, x, **_):
+        return jnp.pad(x, self.paddings, constant_values=self.value)
+
+
+class Select(Operation):
+    """Ternary where (reference: nn/ops/Select.scala)."""
+
+    def forward(self, params, cond, t=None, f=None, **_):
+        if t is None:
+            cond, t, f = cond
+        return jnp.where(cond, t, f)
+
+
+class Tile(Operation):
+    """(reference: nn/ops/Tile.scala)."""
+
+    def __init__(self, multiples: Sequence[int], name=None):
+        super().__init__(name)
+        self.multiples = tuple(multiples)
+
+    def forward(self, params, x, **_):
+        return jnp.tile(x, self.multiples)
+
+
+class Slice(Operation):
+    """(reference: nn/ops/Slice.scala)."""
+
+    def __init__(self, begin: Sequence[int], size: Sequence[int], name=None):
+        super().__init__(name)
+        self.begin, self.size = tuple(begin), tuple(size)
+
+    def forward(self, params, x, **_):
+        size = tuple(x.shape[i] - b if s == -1 else s
+                     for i, (b, s) in enumerate(zip(self.begin, self.size)))
+        return lax.dynamic_slice(x, self.begin, size)
+
+
+class Rank(Operation):
+    def forward(self, params, x, **_):
+        return jnp.asarray(x.ndim, jnp.int32)
+
+
+class Shape(Operation):
+    """(reference: nn/onnx/Shape.scala, nn/ops/Shape)."""
+
+    def forward(self, params, x, **_):
+        return jnp.asarray(x.shape, jnp.int32)
+
+
+class ArgMax(Operation):
+    def __init__(self, axis: int = -1, name=None):
+        super().__init__(name)
+        self.axis = axis
+
+    def forward(self, params, x, **_):
+        return jnp.argmax(x, axis=self.axis).astype(jnp.int32)
+
+
+class ReduceOp(Operation):
+    def __init__(self, axis=None, keep_dims: bool = False, name=None):
+        super().__init__(name)
+        self.axis = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        self.keep_dims = keep_dims
+
+
+class Sum(ReduceOp):
+    def forward(self, params, x, **_):
+        return jnp.sum(x, axis=self.axis, keepdims=self.keep_dims)
+
+
+class Mean(ReduceOp):
+    def forward(self, params, x, **_):
+        return jnp.mean(x, axis=self.axis, keepdims=self.keep_dims)
+
+
+class Max(ReduceOp):
+    def forward(self, params, x, **_):
+        return jnp.max(x, axis=self.axis, keepdims=self.keep_dims)
+
+
+class Min(ReduceOp):
+    def forward(self, params, x, **_):
+        return jnp.min(x, axis=self.axis, keepdims=self.keep_dims)
+
+
+class Prod(ReduceOp):
+    def forward(self, params, x, **_):
+        return jnp.prod(x, axis=self.axis, keepdims=self.keep_dims)
+
+
+class All(ReduceOp):
+    def forward(self, params, x, **_):
+        return jnp.all(x, axis=self.axis, keepdims=self.keep_dims)
+
+
+class Any(ReduceOp):
+    def forward(self, params, x, **_):
+        return jnp.any(x, axis=self.axis, keepdims=self.keep_dims)
+
+
+class RandomUniform(Operation):
+    """(reference: nn/ops/RandomUniform.scala). Needs `rng` at apply —
+    functional randomness instead of the reference's seeded mutable state."""
+
+    def __init__(self, shape: Sequence[int], minval: float = 0.0,
+                 maxval: float = 1.0, name=None):
+        super().__init__(name)
+        self.shape = tuple(shape)
+        self.minval, self.maxval = minval, maxval
+
+    def _apply(self, params, state, *inputs, training=False, rng=None):
+        if rng is None:
+            raise ValueError("RandomUniform needs rng= at apply")
+        return jax.random.uniform(
+            rng, self.shape, minval=self.minval, maxval=self.maxval), state
+
+
+class TruncatedNormal(Operation):
+    """(reference: nn/ops/TruncatedNormal.scala)."""
+
+    def __init__(self, shape: Sequence[int], mean: float = 0.0,
+                 stddev: float = 1.0, name=None):
+        super().__init__(name)
+        self.shape = tuple(shape)
+        self.mean, self.stddev = mean, stddev
+
+    def _apply(self, params, state, *inputs, training=False, rng=None):
+        if rng is None:
+            raise ValueError("TruncatedNormal needs rng= at apply")
+        return (jax.random.truncated_normal(rng, -2.0, 2.0, self.shape)
+                * self.stddev + self.mean), state
+
+
+class CategoricalColHashBucket(Operation):
+    """String/int feature → hash bucket id (reference:
+    nn/ops/CategoricalColHashBucket.scala). Int inputs only under jit;
+    python strings are hashed host-side."""
+
+    def __init__(self, hash_bucket_size: int, name=None):
+        super().__init__(name)
+        self.n = hash_bucket_size
+
+    def forward(self, params, x, **_):
+        if isinstance(x, (list, tuple)):
+            import zlib
+            return jnp.asarray(
+                [zlib.crc32(str(v).encode()) % self.n for v in x], jnp.int32)
+        # Knuth multiplicative hash with XOR fold keeps all 32 bits live
+        # (a plain >>16 would cap bucket ids at 65535) and stays jittable
+        h = x.astype(jnp.uint32) * jnp.uint32(2654435761)
+        h = h ^ (h >> jnp.uint32(16))
+        return (h % jnp.uint32(self.n)).astype(jnp.int32)
+
+
+class InTopK(Operation):
+    """(reference: nn/ops/InTopK.scala)."""
+
+    def __init__(self, k: int, name=None):
+        super().__init__(name)
+        self.k = k
+
+    def forward(self, params, predictions, targets=None, **_):
+        if targets is None:
+            predictions, targets = predictions
+        _, idx = lax.top_k(predictions, self.k)
+        return jnp.any(idx == targets[:, None], axis=-1)
+
+
+class Gemm(Operation):
+    """ONNX Gemm: alpha*A'B' + beta*C (reference: nn/onnx/Gemm.scala)."""
+
+    def __init__(self, alpha: float = 1.0, beta: float = 1.0,
+                 trans_a: bool = False, trans_b: bool = False, name=None):
+        super().__init__(name)
+        self.alpha, self.beta = alpha, beta
+        self.trans_a, self.trans_b = trans_a, trans_b
+
+    def forward(self, params, a, b=None, c=None, **_):
+        if b is None:             # table form: (A, B) or (A, B, C)
+            a, b, *rest = a
+            c = rest[0] if rest else None
+        if self.trans_a:
+            a = a.T
+        if self.trans_b:
+            b = b.T
+        out = self.alpha * (a @ b)
+        return out + self.beta * c if c is not None else out

@@ -1,0 +1,177 @@
+"""Record I/O — TFRecord-compatible files with a native C++ fast path
+(reference: utils/tf/{TFRecordInputFormat,TFRecordOutputFormat}.scala, the
+SequenceFile ingestion of dataset/DataSet.scala SeqFileFolder, and the
+BigDL-core native layer §2.14 — here the native piece is
+native/recordio.cpp, loaded via ctypes with a pure-python fallback).
+
+Files written here are byte-compatible with TFRecord readers.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+from typing import Iterable, Iterator, List, Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "librecordio.so")
+
+_lib = None
+_lib_tried = False
+
+
+def _load_native():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    try:
+        if not os.path.exists(_LIB_PATH) and \
+                os.path.exists(os.path.join(_NATIVE_DIR, "Makefile")):
+            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                           capture_output=True, timeout=120)
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.rio_crc32c.restype = ctypes.c_uint32
+        lib.rio_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.rio_frame.restype = ctypes.c_uint64
+        lib.rio_frame.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                  ctypes.c_void_p]
+        lib.rio_parse.restype = ctypes.c_int64
+        lib.rio_parse.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                  ctypes.c_void_p, ctypes.c_void_p,
+                                  ctypes.c_uint64]
+        lib.rio_normalize_u8.restype = None
+        lib.rio_normalize_u8.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p]
+        _lib = lib
+    except Exception:
+        _lib = None
+    return _lib
+
+
+def native_available() -> bool:
+    return _load_native() is not None
+
+
+def crc32c(data: bytes) -> int:
+    lib = _load_native()
+    if lib is not None:
+        return lib.rio_crc32c(data, len(data))
+    from bigdl_tpu.visualization import crc32c as py_crc
+    return py_crc(data)
+
+
+def frame_record(data: bytes) -> bytes:
+    lib = _load_native()
+    if lib is not None:
+        out = ctypes.create_string_buffer(len(data) + 16)
+        n = lib.rio_frame(data, len(data), out)
+        return out.raw[:n]
+    from bigdl_tpu.visualization import frame_record as py_frame
+    return py_frame(data)
+
+
+def parse_records(blob: bytes) -> List[bytes]:
+    lib = _load_native()
+    if lib is not None:
+        cap = max(16, len(blob) // 16 + 1)
+        offs = (ctypes.c_uint64 * cap)()
+        lens = (ctypes.c_uint64 * cap)()
+        n = lib.rio_parse(blob, len(blob), offs, lens, cap)
+        if n == -1:
+            raise ValueError("corrupt record stream")
+        if n < 0:
+            raise ValueError("record stream overflow")
+        return [blob[offs[i]:offs[i] + lens[i]] for i in range(n)]
+    from bigdl_tpu.visualization import parse_records as py_parse
+    return py_parse(blob)
+
+
+def normalize_u8_batch(images: np.ndarray, mean, std) -> np.ndarray:
+    """uint8 (N,H,W,C) → float32 normalized, via the native loop when
+    available (reference: the assembly loop of MTImageFeatureToBatch)."""
+    images = np.ascontiguousarray(images, np.uint8)
+    n, h, w, c = images.shape
+    mean = np.ascontiguousarray(mean, np.float32)
+    std = np.ascontiguousarray(std, np.float32)
+    lib = _load_native()
+    if lib is not None and c <= 16:
+        out = np.empty((n, h, w, c), np.float32)
+        lib.rio_normalize_u8(
+            images.ctypes.data_as(ctypes.c_void_p), n, h * w, c,
+            mean.ctypes.data_as(ctypes.c_void_p),
+            std.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p))
+        return out
+    return (images.astype(np.float32) - mean) / std
+
+
+class RecordWriter:
+    """(reference: TFRecordOutputFormat / RecordWriter.scala)."""
+
+    def __init__(self, path: str):
+        self._fh = open(path, "wb")
+
+    def write(self, data: bytes):
+        self._fh.write(frame_record(data))
+
+    def close(self):
+        self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+class RecordReader:
+    """(reference: TFRecordInputFormat — here whole-file parse; shard by
+    file like the reference shards by HDFS split)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def __iter__(self) -> Iterator[bytes]:
+        with open(self.path, "rb") as fh:
+            yield from parse_records(fh.read())
+
+
+def write_array_records(path: str, features: np.ndarray,
+                        labels: Optional[np.ndarray] = None):
+    """Serialize (feature, label) pairs as records: a tiny header
+    (dtype/shape/label) + raw bytes — the role the reference's SequenceFile
+    ImageNet format plays (dataset/DataSet.scala SeqFileFolder)."""
+    with RecordWriter(path) as w:
+        for i in range(len(features)):
+            f = np.ascontiguousarray(features[i])
+            lab = -1 if labels is None else int(labels[i])
+            hdr = struct.pack("<i", lab) + struct.pack("<B", f.ndim) + \
+                b"".join(struct.pack("<q", d) for d in f.shape) + \
+                struct.pack("<B", len(str(f.dtype))) + str(f.dtype).encode()
+            w.write(hdr + f.tobytes())
+
+
+def read_array_records(path: str):
+    """Inverse of write_array_records → (features list, labels array)."""
+    feats, labs = [], []
+    for rec in RecordReader(path):
+        lab, = struct.unpack_from("<i", rec, 0)
+        ndim = rec[4]
+        shape = struct.unpack_from(f"<{ndim}q", rec, 5)
+        off = 5 + 8 * ndim
+        dtlen = rec[off]
+        dtype = rec[off + 1:off + 1 + dtlen].decode()
+        arr = np.frombuffer(rec, dtype=dtype,
+                            offset=off + 1 + dtlen).reshape(shape)
+        feats.append(arr)
+        labs.append(lab)
+    return feats, np.asarray(labs, np.int32)

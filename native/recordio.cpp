@@ -1,0 +1,128 @@
+// Native record I/O — TFRecord-compatible framing with masked CRC32C
+// (reference: the JNI-native layer of BigDL-core plus the record machinery
+// at utils/tf/TFRecordInputFormat.scala, visualization/tensorboard/
+// RecordWriter.scala and src/main/java/netty/Crc32c.java).
+//
+// The hot paths the Python layer offloads here:
+//   * crc32c over record payloads (slicing-by-8 table variant),
+//   * batch framing / parsing of many records in one call,
+//   * uint8 -> float32 image normalization into a caller-provided batch
+//     buffer (the assembly loop of MTImageFeatureToBatch.scala).
+//
+// Exposed as a plain C ABI for ctypes. Thread-safe: no globals beyond the
+// const tables.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ------------------------------------------------------------------ crc32c
+static uint32_t crc_table[8][256];
+static bool crc_init_done = false;
+
+static void crc_init() {
+    if (crc_init_done) return;
+    const uint32_t poly = 0x82F63B78u;
+    for (uint32_t n = 0; n < 256; n++) {
+        uint32_t c = n;
+        for (int k = 0; k < 8; k++) c = (c & 1) ? poly ^ (c >> 1) : c >> 1;
+        crc_table[0][n] = c;
+    }
+    for (uint32_t n = 0; n < 256; n++) {
+        uint32_t c = crc_table[0][n];
+        for (int s = 1; s < 8; s++) {
+            c = crc_table[0][c & 0xFF] ^ (c >> 8);
+            crc_table[s][n] = c;
+        }
+    }
+    crc_init_done = true;
+}
+
+uint32_t rio_crc32c(const uint8_t* data, uint64_t len) {
+    crc_init();
+    uint32_t crc = 0xFFFFFFFFu;
+    // slicing-by-8
+    while (len >= 8) {
+        uint32_t lo;
+        uint32_t hi;
+        memcpy(&lo, data, 4);
+        memcpy(&hi, data + 4, 4);
+        lo ^= crc;
+        crc = crc_table[7][lo & 0xFF] ^ crc_table[6][(lo >> 8) & 0xFF] ^
+              crc_table[5][(lo >> 16) & 0xFF] ^ crc_table[4][lo >> 24] ^
+              crc_table[3][hi & 0xFF] ^ crc_table[2][(hi >> 8) & 0xFF] ^
+              crc_table[1][(hi >> 16) & 0xFF] ^ crc_table[0][hi >> 24];
+        data += 8;
+        len -= 8;
+    }
+    while (len--) crc = crc_table[0][(crc ^ *data++) & 0xFF] ^ (crc >> 8);
+    return crc ^ 0xFFFFFFFFu;
+}
+
+static uint32_t masked_crc(const uint8_t* data, uint64_t len) {
+    uint32_t crc = rio_crc32c(data, len);
+    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8u;
+}
+
+// ------------------------------------------------------------------ framing
+// Frame one record into out (out must hold len + 16 bytes). Returns framed
+// size.
+uint64_t rio_frame(const uint8_t* data, uint64_t len, uint8_t* out) {
+    memcpy(out, &len, 8);
+    uint32_t hcrc = masked_crc(out, 8);
+    memcpy(out + 8, &hcrc, 4);
+    memcpy(out + 12, data, len);
+    uint32_t dcrc = masked_crc(data, len);
+    memcpy(out + 12 + len, &dcrc, 4);
+    return len + 16;
+}
+
+// Parse a blob of framed records: fills offsets[i] (payload start) and
+// lengths[i]. Returns record count, or -1 on CRC/framing corruption,
+// -2 if more than max_records present.
+int64_t rio_parse(const uint8_t* blob, uint64_t blob_len,
+                  uint64_t* offsets, uint64_t* lengths,
+                  uint64_t max_records) {
+    uint64_t off = 0;
+    int64_t n = 0;
+    while (off < blob_len) {
+        if (off + 12 > blob_len) return -1;
+        uint64_t len;
+        memcpy(&len, blob + off, 8);
+        uint32_t hcrc;
+        memcpy(&hcrc, blob + off + 8, 4);
+        if (masked_crc(blob + off, 8) != hcrc) return -1;
+        // overflow-safe bounds: need len + 16 bytes from off
+        if (off + 16 > blob_len || len > blob_len - off - 16) return -1;
+        uint32_t dcrc;
+        memcpy(&dcrc, blob + off + 12 + len, 4);
+        if (masked_crc(blob + off + 12, len) != dcrc) return -1;
+        if ((uint64_t)n >= max_records) return -2;
+        offsets[n] = off + 12;
+        lengths[n] = len;
+        n++;
+        off += 16 + len;
+    }
+    return n;
+}
+
+// ------------------------------------------------- batch image normalize
+// uint8 HWC images (n contiguous, each h*w*c bytes) -> float32 batch,
+// out[i] = (in[i] - mean[channel]) / std[channel].
+void rio_normalize_u8(const uint8_t* in, uint64_t n, uint64_t hw,
+                      uint64_t channels, const float* mean, const float* std,
+                      float* out) {
+    float inv[16];
+    for (uint64_t c = 0; c < channels && c < 16; c++)
+        inv[c] = 1.0f / std[c];
+    const uint64_t total = n * hw;
+    for (uint64_t p = 0; p < total; p++) {
+        const uint8_t* src = in + p * channels;
+        float* dst = out + p * channels;
+        for (uint64_t c = 0; c < channels; c++)
+            dst[c] = ((float)src[c] - mean[c]) * inv[c];
+    }
+}
+
+}  // extern "C"

@@ -1,0 +1,121 @@
+"""nn.ops tests (reference analogue: nn/ops per-op specs)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.nn import ops
+from bigdl_tpu.nn import quantized  # ensure both import cleanly together
+
+
+def _run(op, *args, **kw):
+    out, _ = op.apply({}, {}, *args, **kw)
+    return out
+
+
+def test_binary_and_compare():
+    a = jnp.asarray([1.0, 2.0, 3.0])
+    b = jnp.asarray([3.0, 2.0, 1.0])
+    np.testing.assert_allclose(_run(ops.Add(), a, b), [4, 4, 4])
+    np.testing.assert_allclose(_run(ops.SquaredDifference(), a, b), [4, 0, 4])
+    np.testing.assert_array_equal(_run(ops.Greater(), a, b),
+                                  [False, False, True])
+    np.testing.assert_array_equal(
+        _run(ops.LogicalAnd(), a > 1, b > 1), [False, True, False])
+
+
+def test_unary():
+    x = jnp.asarray([1.0, 4.0, 9.0])
+    np.testing.assert_allclose(_run(ops.Sqrt(), x), [1, 2, 3])
+    np.testing.assert_allclose(_run(ops.Rsqrt(), x), [1, 0.5, 1 / 3],
+                               rtol=1e-6)
+    assert bool(_run(ops.IsFinite(), jnp.asarray([jnp.inf]))[0]) is False
+
+
+def test_batch_matmul_adjoints():
+    r = np.random.RandomState(0)
+    a = jnp.asarray(r.randn(2, 3, 4), jnp.float32)
+    b = jnp.asarray(r.randn(2, 5, 4), jnp.float32)
+    out = _run(ops.BatchMatMul(adj_y=True), a, b)
+    assert out.shape == (2, 3, 5)
+    np.testing.assert_allclose(out, a @ jnp.swapaxes(b, -1, -2), rtol=1e-5)
+
+
+def test_topk_onehot_gather():
+    x = jnp.asarray([[1.0, 5.0, 3.0], [9.0, 2.0, 7.0]])
+    vals, idx = _run(ops.TopK(2), x)
+    np.testing.assert_allclose(vals, [[5, 3], [9, 7]])
+    oh = _run(ops.OneHot(4, on_value=2.0, off_value=-1.0),
+              jnp.asarray([1, 3]))
+    np.testing.assert_allclose(oh, [[-1, 2, -1, -1], [-1, -1, -1, 2]])
+    g = _run(ops.Gather(axis=1), x, jnp.asarray([2, 0]))
+    np.testing.assert_allclose(g, [[3, 1], [7, 9]])
+
+
+def test_pad_select_slice_tile():
+    x = jnp.ones((2, 2))
+    p = _run(ops.Pad([(1, 0), (0, 1)], constant_value=5.0), x)
+    assert p.shape == (3, 3) and float(p[0, 0]) == 5.0
+    s = _run(ops.Select(), jnp.asarray([True, False]),
+             jnp.asarray([1.0, 1.0]), jnp.asarray([2.0, 2.0]))
+    np.testing.assert_allclose(s, [1, 2])
+    sl = _run(ops.Slice([0, 1], [2, -1]), jnp.arange(6.0).reshape(2, 3))
+    np.testing.assert_allclose(sl, [[1, 2], [4, 5]])
+    t = _run(ops.Tile([2, 1]), x)
+    assert t.shape == (4, 2)
+
+
+def test_reductions_and_shape():
+    x = jnp.arange(6.0).reshape(2, 3)
+    assert float(_run(ops.Sum(), x)) == 15.0
+    np.testing.assert_allclose(_run(ops.Mean(axis=0), x), [1.5, 2.5, 3.5])
+    np.testing.assert_array_equal(_run(ops.Shape(), x), [2, 3])
+    assert int(_run(ops.Rank(), x)) == 2
+    np.testing.assert_array_equal(_run(ops.ArgMax(axis=1), x), [2, 2])
+
+
+def test_random_ops_require_rng():
+    with pytest.raises(ValueError, match="rng"):
+        _run(ops.RandomUniform((3,)))
+    out = _run(ops.RandomUniform((100,), 2.0, 4.0), rng=jax.random.PRNGKey(0))
+    assert out.shape == (100,) and float(out.min()) >= 2.0 \
+        and float(out.max()) <= 4.0
+    tn = _run(ops.TruncatedNormal((500,), stddev=0.5),
+              rng=jax.random.PRNGKey(1))
+    assert float(jnp.abs(tn).max()) <= 1.0 + 1e-6
+
+
+def test_hash_bucket_jittable():
+    x = jnp.asarray([1, 2, 3, 1000001], jnp.int32)
+    op = ops.CategoricalColHashBucket(10)
+    out = jax.jit(lambda v: op.forward({}, v))(x)
+    assert out.shape == (4,)
+    assert (np.asarray(out) >= 0).all() and (np.asarray(out) < 10).all()
+    # strings host-side
+    so = op.forward({}, ["a", "b", "a"])
+    assert so[0] == so[2]
+
+
+def test_in_topk_and_gemm():
+    pred = jnp.asarray([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1]])
+    hit = _run(ops.InTopK(1), pred, jnp.asarray([1, 2]))
+    np.testing.assert_array_equal(hit, [True, False])
+    a = jnp.ones((2, 3))
+    b = jnp.ones((3, 4))
+    c = jnp.ones((2, 4))
+    out = _run(ops.Gemm(alpha=2.0, beta=0.5), a, b, c)
+    np.testing.assert_allclose(out, 6.5)
+
+
+def test_hash_bucket_covers_large_spaces():
+    """Regression: >>16-only hashing capped bucket ids at 65535."""
+    op = ops.CategoricalColHashBucket(200000)
+    x = jnp.arange(0, 1 << 20, 101, dtype=jnp.int32)
+    out = np.asarray(op.forward({}, x))
+    assert out.max() > 65535
+
+
+def test_gemm_table_without_c():
+    out = _run(ops.Gemm(alpha=2.0), (jnp.ones((2, 3)), jnp.ones((3, 4))))
+    np.testing.assert_allclose(out, 6.0)
