@@ -1,0 +1,116 @@
+"""DataFrame-style estimator API (reference: dlframes/DLEstimator.scala:163,
+DLClassifier.scala:37, DLImageReader/DLImageTransformer — Spark ML
+`Estimator.fit(df) -> Model.transform(df)` pipelines).
+
+Spark-free equivalent: fit/transform over columnar dicts of numpy arrays
+(works directly on pandas DataFrames too — any mapping of name → array).
+The sklearn-ish contract keeps pipeline composability the reference gets
+from Spark ML."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.core.module import Criterion, Module
+
+
+def _col(df, name):
+    a = np.asarray(df[name])
+    return np.stack(a) if a.dtype == object else a
+
+
+class DLEstimator:
+    """Generic estimator: trains `model` with `criterion` on
+    (features_col, label_col) and returns a fitted DLModel
+    (reference: dlframes/DLEstimator.scala:163)."""
+
+    def __init__(self, model: Module, criterion: Criterion,
+                 feature_size: Sequence[int], label_size: Sequence[int] = (),
+                 features_col: str = "features", label_col: str = "label",
+                 batch_size: int = 32, max_epoch: int = 10,
+                 optim_method=None, learning_rate: Optional[float] = None):
+        self.model, self.criterion = model, criterion
+        self.feature_size = tuple(feature_size)
+        self.label_size = tuple(label_size)
+        self.features_col, self.label_col = features_col, label_col
+        self.batch_size, self.max_epoch = batch_size, max_epoch
+        self.optim_method = optim_method
+        self.learning_rate = learning_rate
+
+    def _label_transform(self, y: np.ndarray) -> np.ndarray:
+        return y
+
+    def fit(self, df) -> "DLModel":
+        from bigdl_tpu.dataset import ArrayDataSet
+        from bigdl_tpu.optim.local import Optimizer
+        from bigdl_tpu.optim.method import SGD
+        from bigdl_tpu.optim.trigger import Trigger
+
+        x = _col(df, self.features_col).reshape(
+            (-1,) + self.feature_size).astype(np.float32)
+        y = self._label_transform(_col(df, self.label_col))
+        method = self.optim_method or SGD(self.learning_rate or 1e-2,
+                                          momentum=0.9)
+        ds = ArrayDataSet(x, y, self.batch_size, drop_last=True)
+        opt = Optimizer(self.model, ds, self.criterion, method)
+        opt.set_end_when(Trigger.max_epoch(self.max_epoch))
+        params, state = opt.optimize()
+        return self._make_model(params, state)
+
+    def _make_model(self, params, state) -> "DLModel":
+        return DLModel(self.model, params, state, self.feature_size,
+                       features_col=self.features_col)
+
+
+class DLModel:
+    """Fitted transformer: adds a 'prediction' column
+    (reference: dlframes/DLEstimator.scala:362 DLModel.transform)."""
+
+    def __init__(self, model: Module, params, state,
+                 feature_size: Sequence[int],
+                 features_col: str = "features",
+                 prediction_col: str = "prediction",
+                 batch_size: int = 128):
+        self.model, self.params, self.state = model, params, state
+        self.feature_size = tuple(feature_size)
+        self.features_col, self.prediction_col = features_col, prediction_col
+        self.batch_size = batch_size
+
+    def _predict(self, x: np.ndarray) -> np.ndarray:
+        from bigdl_tpu.optim.predictor import Predictor
+        return Predictor(self.model, self.params, self.state,
+                         batch_size=self.batch_size).predict(x)
+
+    def _post(self, out: np.ndarray) -> np.ndarray:
+        return out
+
+    def transform(self, df) -> Dict[str, np.ndarray]:
+        x = _col(df, self.features_col).reshape(
+            (-1,) + self.feature_size).astype(np.float32)
+        out = self._post(self._predict(x))
+        res = {k: np.asarray(df[k]) for k in df.keys()} \
+            if hasattr(df, "keys") else {}
+        res[self.prediction_col] = out
+        return res
+
+
+class DLClassifier(DLEstimator):
+    """Classifier specialization: int labels, argmax prediction
+    (reference: dlframes/DLClassifier.scala:37)."""
+
+    def _label_transform(self, y):
+        return np.asarray(y).astype(np.int32)
+
+    def _make_model(self, params, state):
+        return DLClassifierModel(self.model, params, state,
+                                 self.feature_size,
+                                 features_col=self.features_col)
+
+
+class DLClassifierModel(DLModel):
+    """(reference: dlframes/DLClassifier.scala:68)."""
+
+    def _post(self, out):
+        return np.argmax(out, axis=-1).astype(np.int32)

@@ -1,0 +1,5 @@
+"""bigdl_tpu.interop — model format importers/exporters
+(reference: utils/caffe/, utils/tf/, utils/TorchFile.scala,
+utils/ConvertModel.scala; SURVEY.md §2.8)."""
+
+from bigdl_tpu.interop import caffe, protowire, tensorflow, torchfile

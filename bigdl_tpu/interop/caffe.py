@@ -1,0 +1,165 @@
+"""Caffe importer (reference: utils/caffe/CaffeLoader.scala:57,544-561 with
+per-layer Converter/V1LayerConverter; proto schema caffe.proto — field
+numbers below are from the public caffe.proto).
+
+`load_caffe(model, params, path)` copies weights from a `.caffemodel` into an
+existing bigdl_tpu module by layer-name matching — the reference's
+CaffeLoader.load(model, defPath, modelPath, matchAll) contract. Weight
+layout conversion: Caffe conv blobs are (cout, cin, kh, kw) → ours are
+(kh, kw, cin, cout); FC blobs (out, in) → (in, out).
+
+NetParameter:  name=1, layers(V1)=2, layer=100
+LayerParameter:  name=1, type=2, blobs=7
+V1LayerParameter: name=4, type=5(enum), blobs=6
+BlobProto: num=1, channels=2, height=3, width=4, data=5 (packed float),
+           shape=7 (BlobShape{dim=1 repeated int64}), double_data=9
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu.interop import protowire as pw
+
+
+def _blob_to_array(blob: pw.Msg) -> np.ndarray:
+    data = blob.floats(5)
+    if not data:
+        data = blob.doubles(9)
+    arr = np.asarray(data, np.float32)
+    if blob.has(7):
+        dims = blob.msg(7).ints(1)
+        if dims:
+            return arr.reshape(dims)
+    legacy = [blob.int(1, 1), blob.int(2, 1), blob.int(3, 1), blob.int(4, 1)]
+    # squeeze leading 1s of the legacy (num, channels, height, width)
+    while len(legacy) > 1 and legacy[0] == 1:
+        legacy.pop(0)
+    return arr.reshape(legacy)
+
+
+def parse_caffemodel(path: str) -> Dict[str, List[np.ndarray]]:
+    """Returns {layer_name: [blob arrays]} from a binary caffemodel
+    (both LayerParameter and legacy V1LayerParameter nets)."""
+    with open(path, "rb") as fh:
+        net = pw.Msg(fh.read())
+    out: Dict[str, List[np.ndarray]] = {}
+    for layer in net.msgs(100):                   # modern LayerParameter
+        blobs = [_blob_to_array(b) for b in layer.msgs(7)]
+        if blobs:
+            out[layer.str(1)] = blobs
+    for layer in net.msgs(2):                     # V1LayerParameter
+        blobs = [_blob_to_array(b) for b in layer.msgs(6)]
+        if blobs:
+            out[layer.str(4)] = blobs
+    return out
+
+
+def _convert_weight(w: np.ndarray, target_shape,
+                    fc_chw: Optional[Tuple[int, int, int]]) -> np.ndarray:
+    if w.ndim == 4:            # conv (cout, cin, kh, kw) -> (kh, kw, cin, cout)
+        w = w.transpose(2, 3, 1, 0)
+    elif w.ndim == 2:          # fc (out, in) -> (in, out)
+        w = w.T
+        if fc_chw is not None:
+            # caffe flattened NCHW; our Flatten is NHWC — permute input dim
+            c, h, ww = fc_chw
+            w = w.reshape(c, h, ww, -1).transpose(1, 2, 0, 3) \
+                .reshape(c * h * ww, -1)
+    if tuple(w.shape) != tuple(target_shape):
+        raise ValueError(f"cannot map caffe blob {w.shape} onto "
+                         f"{tuple(target_shape)}")
+    return w
+
+
+def load_caffe(model, params: Dict, path: str, match_all: bool = True,
+               fc_input_shapes: Optional[Dict[str, Tuple[int, int, int]]]
+               = None) -> Dict:
+    """Copy caffemodel weights into `params` by layer name
+    (reference: CaffeLoader.load — matchAll requires every named layer with
+    weights to be found). Returns a NEW params tree.
+
+    `fc_input_shapes` maps the name of each Linear that directly consumes a
+    flattened conv feature map to its (C, H, W): Caffe flattens NCHW while
+    this framework flattens NHWC, so those weights need an input-dim
+    permutation. Loading such a layer WITHOUT the shape raises — silent
+    mis-permutation would run fine and predict garbage."""
+    blobs = parse_caffemodel(path)
+    fc_input_shapes = fc_input_shapes or {}
+    has_conv_blob = any(b[0].ndim == 4 for b in blobs.values())
+    new_params = _copy_tree(params)
+    matched = set()
+
+    def visit(mod, p):
+        name = getattr(mod, "name", "")
+        if name in blobs and "weight" in p:
+            bl = blobs[name]
+            fc_chw = fc_input_shapes.get(name)
+            if bl[0].ndim == 2 and has_conv_blob and fc_chw is None \
+                    and name not in fc_input_shapes:
+                raise ValueError(
+                    f"FC layer {name!r} in a net with conv layers: pass "
+                    f"fc_input_shapes={{{name!r}: (C, H, W)}} if it consumes "
+                    f"a flattened feature map (Caffe flattens NCHW, this "
+                    f"framework NHWC), or {{{name!r}: None}} if it follows "
+                    f"another FC/global pool and needs no permutation")
+            p["weight"] = np.asarray(_convert_weight(
+                bl[0], np.shape(p["weight"]), fc_chw))
+            if len(bl) > 1 and "bias" in p:
+                p["bias"] = np.asarray(bl[1], np.float32).reshape(
+                    np.shape(p["bias"]))
+            matched.add(name)
+        for cname, child in mod.children().items():
+            visit(child, p[cname])
+
+    visit(model, new_params)
+    if match_all:
+        missing = set(blobs) - matched
+        if missing:
+            raise ValueError(
+                f"caffemodel layers not found in model: {sorted(missing)}; "
+                f"pass match_all=False to ignore")
+    return new_params
+
+
+def _copy_tree(tree):
+    if isinstance(tree, dict):
+        return {k: _copy_tree(v) for k, v in tree.items()}
+    return tree
+
+
+# ------------------------------------------------------------------ export
+def save_caffemodel(path: str, model, params: Dict) -> None:
+    """Export weights as a binary caffemodel (reference: CaffePersister).
+    Conv/FC layouts are converted back to Caffe's."""
+    layers = []
+
+    def visit(mod, p):
+        name = getattr(mod, "name", "")
+        if "weight" in p:
+            w = np.asarray(p["weight"], np.float32)
+            if w.ndim == 4:
+                w = w.transpose(3, 2, 0, 1)
+            elif w.ndim == 2:
+                w = w.T
+            blobs = [w]
+            if "bias" in p:
+                blobs.append(np.asarray(p["bias"], np.float32))
+            body = pw.field_str(1, name) + \
+                pw.field_str(2, type(mod).__name__)
+            for b in blobs:
+                blob = pw.field_bytes(7, pw.field_packed_ints(
+                    1, list(b.shape))) + \
+                    pw.field_packed_floats(5, b.reshape(-1).tolist())
+                body += pw.field_bytes(7, blob)
+            layers.append(pw.field_bytes(100, body))
+        for cname, child in mod.children().items():
+            visit(child, p[cname])
+
+    visit(model, params)
+    with open(path, "wb") as fh:
+        fh.write(pw.field_str(1, getattr(model, "name", "net")))
+        for l in layers:
+            fh.write(l)

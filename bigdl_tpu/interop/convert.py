@@ -1,0 +1,98 @@
+"""Any-to-any model converter CLI (reference: utils/ConvertModel.scala —
+`--from bigdl|caffe|torch|tf --to ...`).
+
+    python -m bigdl_tpu.interop.convert --input m.bigdl-tpu --output m.caffemodel
+    python -m bigdl_tpu.interop.convert --input m.bigdl-tpu --output w.t7
+
+Formats are inferred from extensions: .bigdl-tpu (full module+weights),
+.caffemodel (weights by layer name), .t7 (weight table). Caffe/t7 exports
+carry weights only — importing them back requires the module definition
+(a .bigdl-tpu file or code), like the reference requires the prototxt."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def _fmt(path: str) -> str:
+    for ext, fmt in ((".bigdl-tpu", "bigdl"), (".caffemodel", "caffe"),
+                     (".t7", "torch")):
+        if path.endswith(ext):
+            return fmt
+    raise ValueError(f"cannot infer format of {path!r} "
+                     f"(.bigdl-tpu | .caffemodel | .t7)")
+
+
+def _params_to_table(params, prefix=""):
+    out = {}
+    for k, v in params.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_params_to_table(v, key + "."))
+        else:
+            out[key] = np.asarray(v)
+    return out
+
+
+def _table_to_params(table, skeleton):
+    """Overlay a flat weight table onto the module's param skeleton (keeps
+    empty subtrees of parameterless layers intact)."""
+    def copy(t):
+        return {k: copy(v) for k, v in t.items()} if isinstance(t, dict) \
+            else t
+    root = copy(skeleton)
+    for key, v in table.items():
+        parts = key.split(".")
+        d = root
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = v
+    return root
+
+
+def convert(input_path: str, output_path: str, module_path: str = None):
+    from bigdl_tpu.utils.serializer import load_module, save_module
+    src, dst = _fmt(input_path), _fmt(output_path)
+
+    if src == "bigdl":
+        module, params, state = load_module(input_path)
+    else:
+        if not module_path:
+            raise ValueError(f"importing from {src} needs --module "
+                             f"(a .bigdl-tpu file providing the topology)")
+        module, params, state = load_module(module_path)
+        if src == "caffe":
+            from bigdl_tpu.interop.caffe import load_caffe
+            params = load_caffe(module, params, input_path)
+        elif src == "torch":
+            from bigdl_tpu.interop import torchfile
+            params = _table_to_params(torchfile.load(input_path), params)
+
+    if dst == "bigdl":
+        save_module(output_path, module, params, state)
+    elif dst == "caffe":
+        from bigdl_tpu.interop.caffe import save_caffemodel
+        save_caffemodel(output_path, module, params)
+    elif dst == "torch":
+        from bigdl_tpu.interop import torchfile
+        torchfile.save(output_path, _params_to_table(params))
+    print(f"converted {input_path} ({src}) -> {output_path} ({dst})")
+
+
+def main(argv=None):
+    from bigdl_tpu.utils.platform import force_cpu_if_requested
+    force_cpu_if_requested()
+    ap = argparse.ArgumentParser(prog="bigdl_tpu.interop.convert")
+    ap.add_argument("--input", required=True)
+    ap.add_argument("--output", required=True)
+    ap.add_argument("--module", default=None,
+                    help="topology .bigdl-tpu when importing caffe/t7")
+    args = ap.parse_args(argv)
+    convert(args.input, args.output, args.module)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,160 @@
+"""Schema-less protobuf wire-format codec (the foundation of the Caffe and
+TensorFlow importers — reference: utils/caffe/CaffeLoader.scala and
+utils/tf/TensorflowLoader.scala parse generated-proto messages; here the
+wire format is decoded directly, no protoc dependency).
+
+Wire types: 0 varint, 1 fixed64, 2 length-delimited, 5 fixed32."""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, Iterator, List, Tuple, Union
+
+VARINT, FIXED64, BYTES, FIXED32 = 0, 1, 2, 5
+
+
+def read_varint(buf: bytes, off: int) -> Tuple[int, int]:
+    v = 0
+    shift = 0
+    while True:
+        b = buf[off]
+        off += 1
+        v |= (b & 0x7F) << shift
+        shift += 7
+        if not b & 0x80:
+            return v, off
+
+
+def write_varint(v: int) -> bytes:
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def iter_fields(buf: bytes) -> Iterator[Tuple[int, int, Union[int, bytes]]]:
+    """Yields (field_number, wire_type, value) — value is int for
+    varint/fixed, bytes for length-delimited."""
+    off = 0
+    n = len(buf)
+    while off < n:
+        key, off = read_varint(buf, off)
+        field, wire = key >> 3, key & 7
+        if wire == VARINT:
+            v, off = read_varint(buf, off)
+            yield field, wire, v
+        elif wire == FIXED64:
+            yield field, wire, struct.unpack_from("<Q", buf, off)[0]
+            off += 8
+        elif wire == FIXED32:
+            yield field, wire, struct.unpack_from("<I", buf, off)[0]
+            off += 4
+        elif wire == BYTES:
+            ln, off = read_varint(buf, off)
+            yield field, wire, buf[off:off + ln]
+            off += ln
+        else:
+            raise ValueError(f"unsupported wire type {wire} at offset {off}")
+
+
+class Msg:
+    """Decoded message: field number → list of raw values. Sub-messages are
+    decoded lazily with `msg`/`msgs`."""
+
+    def __init__(self, buf: bytes):
+        self.fields: Dict[int, List] = {}
+        for field, wire, val in iter_fields(buf):
+            self.fields.setdefault(field, []).append((wire, val))
+
+    def has(self, field: int) -> bool:
+        return field in self.fields
+
+    def _vals(self, field):
+        return [v for _, v in self.fields.get(field, [])]
+
+    def ints(self, field: int) -> List[int]:
+        out = []
+        for wire, v in self.fields.get(field, []):
+            if wire == VARINT:
+                out.append(v)
+            elif wire == BYTES:          # packed repeated
+                off = 0
+                while off < len(v):
+                    x, off = read_varint(v, off)
+                    out.append(x)
+            else:
+                out.append(v)
+        return out
+
+    def int(self, field: int, default: int = 0) -> int:
+        vals = self.ints(field)
+        return vals[0] if vals else default
+
+    def floats(self, field: int) -> List[float]:
+        out = []
+        for wire, v in self.fields.get(field, []):
+            if wire == FIXED32:
+                out.append(struct.unpack("<f", struct.pack("<I", v))[0])
+            elif wire == BYTES:          # packed repeated float
+                out.extend(struct.unpack(f"<{len(v) // 4}f", v))
+            elif wire == FIXED64:
+                out.append(struct.unpack("<d", struct.pack("<Q", v))[0])
+        return out
+
+    def doubles(self, field: int) -> List[float]:
+        out = []
+        for wire, v in self.fields.get(field, []):
+            if wire == FIXED64:
+                out.append(struct.unpack("<d", struct.pack("<Q", v))[0])
+            elif wire == BYTES:
+                out.extend(struct.unpack(f"<{len(v) // 8}d", v))
+        return out
+
+    def float(self, field: int, default: float = 0.0) -> float:
+        vals = self.floats(field)
+        return vals[0] if vals else default
+
+    def bytes_(self, field: int, default: bytes = b"") -> bytes:
+        vals = self._vals(field)
+        return vals[0] if vals else default
+
+    def str(self, field: int, default: str = "") -> str:
+        return self.bytes_(field, default.encode()).decode()
+
+    def strs(self, field: int) -> List[str]:
+        return [v.decode() for v in self._vals(field)]
+
+    def msg(self, field: int) -> "Msg":
+        return Msg(self.bytes_(field))
+
+    def msgs(self, field: int) -> List["Msg"]:
+        return [Msg(v) for v in self._vals(field)]
+
+
+# ----------------------------------------------------------------- encoding
+def field_varint(field: int, v: int) -> bytes:
+    return write_varint(field << 3 | VARINT) + write_varint(v)
+
+
+def field_bytes(field: int, v: bytes) -> bytes:
+    return write_varint(field << 3 | BYTES) + write_varint(len(v)) + v
+
+
+def field_str(field: int, v: str) -> bytes:
+    return field_bytes(field, v.encode())
+
+
+def field_float(field: int, v: float) -> bytes:
+    return write_varint(field << 3 | FIXED32) + struct.pack("<f", v)
+
+
+def field_packed_floats(field: int, vals) -> bytes:
+    return field_bytes(field, struct.pack(f"<{len(vals)}f", *vals))
+
+
+def field_packed_ints(field: int, vals) -> bytes:
+    return field_bytes(field, b"".join(write_varint(v) for v in vals))

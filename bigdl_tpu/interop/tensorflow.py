@@ -1,0 +1,239 @@
+"""TensorFlow frozen-GraphDef importer (reference:
+utils/tf/TensorflowLoader.scala:55,201,358 — parses a frozen GraphDef and
+maps ops onto layers; the reference ships 161 per-op loaders, this covers
+the op vocabulary the zoo models use, per SURVEY.md §7 scoping).
+
+GraphDef: node=1 (NodeDef)
+NodeDef: name=1, op=2, input=3 (repeated string), attr=5 (map entries
+         {key=1, value=2:AttrValue})
+AttrValue: s=2, i=3, f=4, b=5, type=6, shape=7, tensor=8, list=1
+TensorProto: dtype=1, tensor_shape=2, tensor_content=4, float_val=5,
+             int_val=7; TensorShapeProto: dim=2 {size=1}
+DataType: DT_FLOAT=1, DT_INT32=3
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.interop import protowire as pw
+
+DT_FLOAT, DT_INT32 = 1, 3
+
+
+def _parse_tensor(t: pw.Msg) -> np.ndarray:
+    dtype = t.int(1, DT_FLOAT)
+    dims = [d.int(1) for d in t.msg(2).msgs(2)] if t.has(2) else []
+    content = t.bytes_(4)
+    np_dtype = np.float32 if dtype == DT_FLOAT else np.int32
+    if content:
+        arr = np.frombuffer(content, np_dtype)
+    elif dtype == DT_FLOAT:
+        arr = np.asarray(t.floats(5), np.float32)
+    else:
+        arr = np.asarray(t.ints(7), np.int32)
+    if dims:
+        if arr.size == 1 and int(np.prod(dims)) > 1:
+            arr = np.full(dims, arr.reshape(-1)[0])   # splat encoding
+        arr = arr.reshape(dims)
+    return arr
+
+
+class TFNode:
+    def __init__(self, msg: pw.Msg):
+        self.name = msg.str(1)
+        self.op = msg.str(2)
+        self.inputs = [i.split(":")[0].lstrip("^") for i in msg.strs(3)]
+        self.attrs: Dict[str, pw.Msg] = {}
+        for entry in msg.msgs(5):
+            self.attrs[entry.str(1)] = entry.msg(2)
+
+    def attr_tensor(self, key) -> Optional[np.ndarray]:
+        a = self.attrs.get(key)
+        return _parse_tensor(a.msg(8)) if a is not None and a.has(8) else None
+
+    def attr_ints(self, key) -> List[int]:
+        a = self.attrs.get(key)
+        if a is None:
+            return []
+        return a.msg(1).ints(3) if a.has(1) else a.ints(3)
+
+    def attr_str(self, key, default="") -> str:
+        a = self.attrs.get(key)
+        return a.str(2, default) if a is not None else default
+
+
+def _pool(fn, init):
+    def run(node, x):
+        ks = node.attr_ints("ksize") or [1, 2, 2, 1]
+        st = node.attr_ints("strides") or [1, 2, 2, 1]
+        pad = node.attr_str("padding", "VALID")
+        return lax.reduce_window(x, init, fn, tuple(ks), tuple(st), pad)
+    return run
+
+
+class TFGraph:
+    """Executable imported graph: `run({placeholder: value}, outputs=[...])`
+    (reference: the Session/BigDLSessionImpl execution surface,
+    utils/tf/Session.scala:43)."""
+
+    def __init__(self, nodes: Sequence[TFNode]):
+        self.nodes = {n.name: n for n in nodes}
+        self.order = [n.name for n in nodes]    # GraphDef is topo-ordered
+
+    @property
+    def placeholders(self) -> List[str]:
+        return [n for n in self.order if self.nodes[n].op == "Placeholder"]
+
+    def run(self, feed: Dict[str, np.ndarray],
+            outputs: Optional[Sequence[str]] = None):
+        values: Dict[str, jnp.ndarray] = {}
+        for name in self.order:
+            node = self.nodes[name]
+            missing = [i for i in node.inputs if i not in values]
+            if missing:
+                raise ValueError(
+                    f"node {name!r} consumes {missing} before they are "
+                    f"defined — GraphDef is not topologically ordered")
+            ins = [values[i] for i in node.inputs]
+            values[name] = self._exec(node, ins, feed)
+        outs = outputs or [self.order[-1]]
+        res = [values[o] for o in outs]
+        return res[0] if len(res) == 1 else tuple(res)
+
+    def _exec(self, node: TFNode, ins, feed):
+        op = node.op
+        if op == "Placeholder":
+            if node.name not in feed:
+                raise KeyError(f"missing feed for placeholder {node.name}")
+            return jnp.asarray(feed[node.name])
+        if op == "Const":
+            return jnp.asarray(node.attr_tensor("value"))
+        if op in ("Identity", "StopGradient", "Snapshot"):
+            return ins[0]
+        if op == "MatMul":
+            a, b = ins
+            ta = node.attrs.get("transpose_a")
+            tb = node.attrs.get("transpose_b")
+            if ta is not None and ta.int(5):
+                a = a.T
+            if tb is not None and tb.int(5):
+                b = b.T
+            return a @ b
+        if op in ("Add", "AddV2", "BiasAdd"):
+            return ins[0] + ins[1]
+        if op == "Sub":
+            return ins[0] - ins[1]
+        if op == "Mul":
+            return ins[0] * ins[1]
+        if op == "RealDiv":
+            return ins[0] / ins[1]
+        if op == "Conv2D":
+            strides = node.attr_ints("strides") or [1, 1, 1, 1]
+            pad = node.attr_str("padding", "SAME")
+            return lax.conv_general_dilated(
+                ins[0], ins[1], tuple(strides[1:3]), pad,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if op == "DepthwiseConv2dNative":
+            strides = node.attr_ints("strides") or [1, 1, 1, 1]
+            pad = node.attr_str("padding", "SAME")
+            w = ins[1]
+            kh, kw, cin, mult = w.shape
+            w = w.reshape(kh, kw, 1, cin * mult)
+            return lax.conv_general_dilated(
+                ins[0], w, tuple(strides[1:3]), pad,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=cin)
+        if op == "MaxPool":
+            return _pool(lax.max, -jnp.inf)(node, ins[0])
+        if op == "AvgPool":
+            # divide by the count of VALID cells per window (TF excludes
+            # SAME-padding cells from the average)
+            summed = _pool(lax.add, 0.0)(node, ins[0])
+            counts = _pool(lax.add, 0.0)(node, jnp.ones_like(ins[0]))
+            return summed / counts
+        if op == "Relu":
+            return jax.nn.relu(ins[0])
+        if op == "Relu6":
+            return jnp.clip(ins[0], 0, 6)
+        if op == "Sigmoid":
+            return jax.nn.sigmoid(ins[0])
+        if op == "Tanh":
+            return jnp.tanh(ins[0])
+        if op == "Softmax":
+            return jax.nn.softmax(ins[0], axis=-1)
+        if op == "Reshape":
+            return ins[0].reshape([int(d) for d in np.asarray(ins[1])])
+        if op == "Squeeze":
+            dims = node.attr_ints("squeeze_dims")
+            return jnp.squeeze(ins[0], axis=tuple(dims) if dims else None)
+        if op == "ExpandDims":
+            return jnp.expand_dims(ins[0], int(np.asarray(ins[1])))
+        if op == "Mean":
+            axes = tuple(int(a) for a in np.asarray(ins[1]).reshape(-1))
+            keep = node.attrs.get("keep_dims")
+            return jnp.mean(ins[0], axis=axes,
+                            keepdims=bool(keep.int(5)) if keep else False)
+        if op == "Pad":
+            pads = np.asarray(ins[1])
+            return jnp.pad(ins[0], [(int(a), int(b)) for a, b in pads])
+        if op == "ConcatV2":
+            axis = int(np.asarray(ins[-1]))
+            return jnp.concatenate(ins[:-1], axis=axis)
+        if op == "FusedBatchNorm" or op == "FusedBatchNormV3":
+            x, scale, offset, mean, var = ins
+            a = node.attrs.get("epsilon")
+            eps = a.float(4, 1e-3) if a is not None else 1e-3
+            return (x - mean) / jnp.sqrt(var + eps) * scale + offset
+        raise NotImplementedError(
+            f"TF op {op!r} (node {node.name}) is not in the supported set")
+
+
+def load_graphdef(path_or_bytes) -> TFGraph:
+    """Parse a frozen GraphDef (reference: TensorflowLoader.load:55)."""
+    if isinstance(path_or_bytes, (bytes, bytearray)):
+        buf = bytes(path_or_bytes)
+    else:
+        with open(path_or_bytes, "rb") as fh:
+            buf = fh.read()
+    gd = pw.Msg(buf)
+    return TFGraph([TFNode(m) for m in gd.msgs(1)])
+
+
+# --------------------------------------------------------- GraphDef building
+def make_node(name: str, op: str, inputs: Sequence[str] = (),
+              tensor: Optional[np.ndarray] = None,
+              ints: Optional[Dict[str, List[int]]] = None,
+              strs: Optional[Dict[str, str]] = None) -> bytes:
+    """Encode one NodeDef (used by the exporter/tests — the analogue of
+    TensorflowSaver, utils/tf/TensorflowSaver.scala)."""
+    body = pw.field_str(1, name) + pw.field_str(2, op)
+    for i in inputs:
+        body += pw.field_str(3, i)
+
+    def attr(key: str, value: bytes) -> bytes:
+        return pw.field_bytes(5, pw.field_str(1, key) +
+                              pw.field_bytes(2, value))
+
+    if tensor is not None:
+        t = np.asarray(tensor)
+        dt = DT_FLOAT if t.dtype.kind == "f" else DT_INT32
+        t = t.astype(np.float32 if dt == DT_FLOAT else np.int32)
+        shape = b"".join(pw.field_bytes(2, pw.field_varint(1, d))
+                         for d in t.shape)
+        tp = pw.field_varint(1, dt) + pw.field_bytes(2, shape) + \
+            pw.field_bytes(4, t.tobytes())
+        body += attr("value", pw.field_bytes(8, tp))
+        body += attr("dtype", pw.field_varint(6, dt))
+    for key, vals in (ints or {}).items():
+        body += attr(key, pw.field_bytes(1, pw.field_packed_ints(3, vals)))
+    for key, s in (strs or {}).items():
+        body += attr(key, pw.field_str(2, s))
+    return pw.field_bytes(1, body)

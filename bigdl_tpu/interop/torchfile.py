@@ -1,0 +1,192 @@
+"""Torch7 `.t7` binary codec (reference: utils/TorchFile.scala — used by
+File.loadTorch/saveTorch and the 132 golden-model Torch specs).
+
+Binary little-endian format: each value is tagged with an int32 type id:
+  0 number (float64), 1 string, 2 table, 3 function, 4 torch object,
+  5 boolean, 6/7 legacy, 8 recursive function.
+Torch objects carry an object index (for reference sharing), a version
+string ("V 1"), a class name, then the class payload. Tensors store
+ndim/sizes/strides/storageOffset then a Storage reference; storages store
+size + raw data. Supported classes: {Float,Double,Long,Int,Byte}Tensor and
+their Storages — enough for weight exchange and golden files."""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, IO, Tuple
+
+import numpy as np
+
+TYPE_NUMBER, TYPE_STRING, TYPE_TABLE = 0, 1, 2
+TYPE_TORCH, TYPE_BOOLEAN = 4, 5
+
+_TENSOR_DTYPES = {
+    "torch.FloatTensor": np.float32, "torch.DoubleTensor": np.float64,
+    "torch.LongTensor": np.int64, "torch.IntTensor": np.int32,
+    "torch.ByteTensor": np.uint8,
+}
+_STORAGE_DTYPES = {
+    "torch.FloatStorage": np.float32, "torch.DoubleStorage": np.float64,
+    "torch.LongStorage": np.int64, "torch.IntStorage": np.int32,
+    "torch.ByteStorage": np.uint8,
+}
+_DTYPE_TO_TENSOR = {np.dtype(v): k for k, v in _TENSOR_DTYPES.items()}
+
+
+class _Reader:
+    def __init__(self, fh: IO[bytes]):
+        self.fh = fh
+        self.memo: Dict[int, Any] = {}
+
+    def _i4(self) -> int:
+        return struct.unpack("<i", self.fh.read(4))[0]
+
+    def _i8(self) -> int:
+        return struct.unpack("<q", self.fh.read(8))[0]
+
+    def _f8(self) -> float:
+        return struct.unpack("<d", self.fh.read(8))[0]
+
+    def _string(self) -> str:
+        n = self._i4()
+        return self.fh.read(n).decode("latin-1")
+
+    def read(self) -> Any:
+        t = self._i4()
+        if t == TYPE_NUMBER:
+            v = self._f8()
+            return int(v) if v.is_integer() else v
+        if t == TYPE_STRING:
+            return self._string()
+        if t == TYPE_BOOLEAN:
+            return bool(self._i4())
+        if t == TYPE_TABLE:
+            idx = self._i4()
+            if idx in self.memo:
+                return self.memo[idx]
+            n = self._i4()
+            table: Dict[Any, Any] = {}
+            self.memo[idx] = table
+            for _ in range(n):
+                k = self.read()
+                table[k] = self.read()
+            return table
+        if t == TYPE_TORCH:
+            idx = self._i4()
+            if idx in self.memo:
+                return self.memo[idx]
+            _version = self._string()           # "V 1"
+            cls = self._string()
+            obj = self._read_torch_object(cls, idx)
+            return obj
+        raise ValueError(f"unsupported t7 type id {t}")
+
+    def _read_torch_object(self, cls: str, idx: int):
+        if cls in _TENSOR_DTYPES:
+            ndim = self._i4()
+            sizes = [self._i8() for _ in range(ndim)]
+            strides = [self._i8() for _ in range(ndim)]
+            offset = self._i8() - 1              # 1-based
+            self.memo[idx] = None                # placeholder
+            storage = self.read()                # nested Storage object
+            flat = storage
+            if ndim == 0 or not sizes:
+                arr = np.asarray([], _TENSOR_DTYPES[cls])
+            else:
+                arr = np.lib.stride_tricks.as_strided(
+                    flat[offset:],
+                    shape=sizes,
+                    strides=[s * flat.itemsize for s in strides]).copy()
+            self.memo[idx] = arr
+            return arr
+        if cls in _STORAGE_DTYPES:
+            size = self._i8()
+            dtype = np.dtype(_STORAGE_DTYPES[cls])
+            data = np.frombuffer(
+                self.fh.read(size * dtype.itemsize), dtype).copy()
+            self.memo[idx] = data
+            return data
+        raise ValueError(f"unsupported torch class {cls}")
+
+
+class _Writer:
+    def __init__(self, fh: IO[bytes]):
+        self.fh = fh
+        self.next_idx = 1
+
+    def _i4(self, v: int):
+        self.fh.write(struct.pack("<i", v))
+
+    def _i8(self, v: int):
+        self.fh.write(struct.pack("<q", v))
+
+    def _string(self, s: str):
+        b = s.encode("latin-1")
+        self._i4(len(b))
+        self.fh.write(b)
+
+    def write(self, obj: Any):
+        if isinstance(obj, bool):
+            self._i4(TYPE_BOOLEAN)
+            self._i4(int(obj))
+        elif isinstance(obj, (int, float)):
+            self._i4(TYPE_NUMBER)
+            self.fh.write(struct.pack("<d", float(obj)))
+        elif isinstance(obj, str):
+            self._i4(TYPE_STRING)
+            self._string(obj)
+        elif isinstance(obj, np.ndarray):
+            self._write_tensor(obj)
+        elif isinstance(obj, dict):
+            self._i4(TYPE_TABLE)
+            self._i4(self.next_idx)
+            self.next_idx += 1
+            self._i4(len(obj))
+            for k, v in obj.items():
+                self.write(k)
+                self.write(v)
+        else:
+            raise TypeError(f"cannot write {type(obj)} to t7")
+
+    def _write_tensor(self, arr: np.ndarray):
+        cls = _DTYPE_TO_TENSOR.get(arr.dtype)
+        if cls is None:
+            arr = arr.astype(np.float32)
+            cls = "torch.FloatTensor"
+        arr = np.ascontiguousarray(arr)
+        self._i4(TYPE_TORCH)
+        self._i4(self.next_idx)
+        self.next_idx += 1
+        self._string("V 1")
+        self._string(cls)
+        self._i4(arr.ndim)
+        for s in arr.shape:
+            self._i8(s)
+        stride = 1
+        strides = []
+        for s in reversed(arr.shape):
+            strides.insert(0, stride)
+            stride *= s
+        for s in strides:
+            self._i8(s)
+        self._i8(1)                              # storageOffset, 1-based
+        # nested storage object
+        self._i4(TYPE_TORCH)
+        self._i4(self.next_idx)
+        self.next_idx += 1
+        self._string("V 1")
+        self._string(cls.replace("Tensor", "Storage"))
+        self._i8(arr.size)
+        self.fh.write(arr.tobytes())
+
+
+def save(path: str, obj: Any) -> None:
+    """(reference: File.saveTorch, utils/TorchFile.scala)."""
+    with open(path, "wb") as fh:
+        _Writer(fh).write(obj)
+
+
+def load(path: str) -> Any:
+    """(reference: File.loadTorch)."""
+    with open(path, "rb") as fh:
+        return _Reader(fh).read()

@@ -59,3 +59,9 @@ from bigdl_tpu.nn.criterion import (ClassNLLCriterion, CrossEntropyCriterion,
                                     DiceCoefficientCriterion, MultiMarginCriterion,
                                     ClassSimplexCriterion, PGCriterion,
                                     TransformerCriterion)
+
+from bigdl_tpu.nn import detection, ops, quantized, sparse
+from bigdl_tpu.nn.detection import (Anchor, DetectionOutputSSD, FPN, Nms,
+                                    Pooler, PriorBox, RoiAlign, RoiPooling)
+from bigdl_tpu.nn.sparse import (LookupTableSparse, SparseCOO,
+                                 SparseJoinTable, SparseLinear)

@@ -1,0 +1,365 @@
+"""Detection / segmentation ops (reference: nn/Anchor.scala, nn/Nms.scala,
+nn/PriorBox.scala, nn/Proposal.scala, nn/RoiPooling.scala, nn/RoiAlign.scala,
+nn/Pooler.scala, nn/FPN.scala, nn/DetectionOutputSSD.scala and the MaskRCNN
+stack at models/maskrcnn/).
+
+TPU-first: everything is fixed-shape and mask-based — NMS keeps a static
+`max_output` count with a validity mask instead of dynamic-length outputs
+(dynamic shapes would force retraces), so the whole detection head stays
+inside one XLA program.
+Boxes are (x1, y1, x2, y2) in pixel coordinates throughout.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.core.module import Module
+
+
+def box_area(boxes):
+    return jnp.maximum(boxes[..., 2] - boxes[..., 0], 0) * \
+        jnp.maximum(boxes[..., 3] - boxes[..., 1], 0)
+
+
+def box_iou(a, b):
+    """Pairwise IoU: a (N,4), b (M,4) → (N,M)."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = box_area(a)[:, None] + box_area(b)[None, :] - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+def nms(boxes, scores, iou_threshold: float = 0.5,
+        max_output: int = 100) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Hard NMS with static output size (reference: nn/Nms.scala).
+
+    Returns (indices (max_output,), valid mask (max_output,)). Indices of
+    suppressed/padded slots are 0 with valid=False. Jittable: a fori_loop
+    over the fixed max_output count — the XLA-friendly formulation of the
+    reference's dynamic loop."""
+    n = boxes.shape[0]
+    iou = box_iou(boxes, boxes)
+    order_scores = scores
+
+    def body(i, carry):
+        alive, sel_idx, sel_valid = carry
+        masked = jnp.where(alive, order_scores, -jnp.inf)
+        best = jnp.argmax(masked)
+        ok = masked[best] > -jnp.inf
+        sel_idx = sel_idx.at[i].set(jnp.where(ok, best, 0))
+        sel_valid = sel_valid.at[i].set(ok)
+        # kill everything overlapping the winner (including itself)
+        kill = iou[best] > iou_threshold
+        alive = alive & ~(kill & ok)
+        alive = alive.at[best].set(False)
+        return alive, sel_idx, sel_valid
+
+    alive0 = jnp.ones((n,), bool)
+    idx0 = jnp.zeros((max_output,), jnp.int32)
+    val0 = jnp.zeros((max_output,), bool)
+    _, idx, valid = lax.fori_loop(0, max_output, body, (alive0, idx0, val0))
+    return idx, valid
+
+
+class Nms(Module):
+    """(reference: nn/Nms.scala)."""
+
+    def __init__(self, iou_threshold: float = 0.5, max_output: int = 100,
+                 name=None):
+        super().__init__(name)
+        self.iou_threshold, self.max_output = iou_threshold, max_output
+
+    def forward(self, params, boxes, scores=None, **_):
+        if scores is None:
+            boxes, scores = boxes
+        return nms(boxes, scores, self.iou_threshold, self.max_output)
+
+
+def encode_boxes(anchors, gt):
+    """Box regression targets (dx, dy, dw, dh)
+    (reference: nn/util/BboxUtil encode)."""
+    aw = anchors[..., 2] - anchors[..., 0]
+    ah = anchors[..., 3] - anchors[..., 1]
+    ax = anchors[..., 0] + 0.5 * aw
+    ay = anchors[..., 1] + 0.5 * ah
+    gw = gt[..., 2] - gt[..., 0]
+    gh = gt[..., 3] - gt[..., 1]
+    gx = gt[..., 0] + 0.5 * gw
+    gy = gt[..., 1] + 0.5 * gh
+    return jnp.stack([(gx - ax) / aw, (gy - ay) / ah,
+                      jnp.log(gw / aw), jnp.log(gh / ah)], -1)
+
+
+def decode_boxes(anchors, deltas, clip_shape: Optional[Tuple[int, int]] = None):
+    """Inverse of encode_boxes (reference: BboxUtil decode / Proposal)."""
+    aw = anchors[..., 2] - anchors[..., 0]
+    ah = anchors[..., 3] - anchors[..., 1]
+    ax = anchors[..., 0] + 0.5 * aw
+    ay = anchors[..., 1] + 0.5 * ah
+    cx = deltas[..., 0] * aw + ax
+    cy = deltas[..., 1] * ah + ay
+    w = jnp.exp(deltas[..., 2]) * aw
+    h = jnp.exp(deltas[..., 3]) * ah
+    boxes = jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                       cx + 0.5 * w, cy + 0.5 * h], -1)
+    if clip_shape is not None:
+        hh, ww = clip_shape
+        boxes = jnp.stack([boxes[..., 0].clip(0, ww), boxes[..., 1].clip(0, hh),
+                           boxes[..., 2].clip(0, ww), boxes[..., 3].clip(0, hh)],
+                          -1)
+    return boxes
+
+
+class Anchor:
+    """Sliding-window anchor generation (reference: nn/Anchor.scala —
+    ratios × scales per feature-map cell)."""
+
+    def __init__(self, ratios: Sequence[float] = (0.5, 1.0, 2.0),
+                 scales: Sequence[float] = (8.0, 16.0, 32.0)):
+        self.ratios = tuple(ratios)
+        self.scales = tuple(scales)
+
+    @property
+    def num(self) -> int:
+        return len(self.ratios) * len(self.scales)
+
+    def generate(self, feat_h: int, feat_w: int, stride: int) -> jnp.ndarray:
+        """(H*W*A, 4) anchors in input-image coordinates."""
+        base = []
+        for r in self.ratios:
+            for s in self.scales:
+                size = s * stride
+                w = size * math.sqrt(1.0 / r)
+                h = size * math.sqrt(r)
+                base.append([-w / 2, -h / 2, w / 2, h / 2])
+        base = jnp.asarray(base)                       # (A, 4)
+        xs = (jnp.arange(feat_w) + 0.5) * stride
+        ys = (jnp.arange(feat_h) + 0.5) * stride
+        cx, cy = jnp.meshgrid(xs, ys)                  # (H, W)
+        shifts = jnp.stack([cx, cy, cx, cy], -1).reshape(-1, 1, 4)
+        return (shifts + base[None]).reshape(-1, 4)
+
+
+class PriorBox:
+    """SSD prior boxes with min/max sizes + aspect ratios
+    (reference: nn/PriorBox.scala)."""
+
+    def __init__(self, min_sizes: Sequence[float],
+                 max_sizes: Sequence[float] = (),
+                 aspect_ratios: Sequence[float] = (2.0,),
+                 flip: bool = True, clip: bool = False):
+        self.min_sizes = tuple(min_sizes)
+        self.max_sizes = tuple(max_sizes)
+        ar = [1.0]
+        for r in aspect_ratios:
+            ar.append(r)
+            if flip:
+                ar.append(1.0 / r)
+        self.aspect_ratios = tuple(ar)
+        self.clip = clip
+
+    def generate(self, feat_h: int, feat_w: int, img_h: int,
+                 img_w: int) -> jnp.ndarray:
+        """(H*W*P, 4) normalized [0,1] priors."""
+        step_x, step_y = img_w / feat_w, img_h / feat_h
+        whs = []
+        for i, ms in enumerate(self.min_sizes):
+            whs.append((ms, ms))
+            if i < len(self.max_sizes):
+                s = math.sqrt(ms * self.max_sizes[i])
+                whs.append((s, s))
+            for r in self.aspect_ratios:
+                if abs(r - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * math.sqrt(r), ms / math.sqrt(r)))
+        whs = jnp.asarray(whs)                         # (P, 2)
+        xs = (jnp.arange(feat_w) + 0.5) * step_x
+        ys = (jnp.arange(feat_h) + 0.5) * step_y
+        cx, cy = jnp.meshgrid(xs, ys)
+        centers = jnp.stack([cx, cy], -1).reshape(-1, 1, 2)
+        half = whs[None] / 2.0
+        boxes = jnp.concatenate([centers - half, centers + half], -1)
+        boxes = boxes.reshape(-1, 4) / jnp.asarray(
+            [img_w, img_h, img_w, img_h], jnp.float32)
+        return boxes.clip(0, 1) if self.clip else boxes
+
+
+def roi_align(features, boxes, box_indices, output_size: Tuple[int, int],
+              spatial_scale: float = 1.0, sampling_ratio: int = 2):
+    """RoiAlign with bilinear sampling (reference: nn/RoiAlign.scala).
+
+    features (B, H, W, C); boxes (N, 4) in input coords; box_indices (N,)
+    batch index per box. Returns (N, out_h, out_w, C)."""
+    out_h, out_w = output_size
+    b, h, w, c = features.shape
+    boxes = boxes * spatial_scale
+    n = boxes.shape[0]
+    sr = sampling_ratio
+
+    def one_box(box, bi):
+        x1, y1, x2, y2 = box[0], box[1], box[2], box[3]
+        bw = jnp.maximum(x2 - x1, 1.0)
+        bh = jnp.maximum(y2 - y1, 1.0)
+        # sr×sr samples per output bin, bilinear each, then average
+        gy = y1 + (jnp.arange(out_h * sr) + 0.5) * bh / (out_h * sr)
+        gx = x1 + (jnp.arange(out_w * sr) + 0.5) * bw / (out_w * sr)
+        yy = jnp.clip(gy - 0.5, 0, h - 1)
+        xx = jnp.clip(gx - 0.5, 0, w - 1)
+        y0 = jnp.floor(yy).astype(jnp.int32)
+        x0 = jnp.floor(xx).astype(jnp.int32)
+        y1i = jnp.minimum(y0 + 1, h - 1)
+        x1i = jnp.minimum(x0 + 1, w - 1)
+        wy = (yy - y0)[:, None, None]
+        wx = (xx - x0)[None, :, None]
+        img = features[bi]
+        top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1i] * wx
+        bot = img[y1i][:, x0] * (1 - wx) + img[y1i][:, x1i] * wx
+        sampled = top * (1 - wy) + bot * wy            # (out_h*sr, out_w*sr, C)
+        return sampled.reshape(out_h, sr, out_w, sr, c).mean((1, 3))
+
+    return jax.vmap(one_box)(boxes, box_indices)
+
+
+class RoiAlign(Module):
+    """(reference: nn/RoiAlign.scala)."""
+
+    def __init__(self, output_size: Tuple[int, int],
+                 spatial_scale: float = 1.0, sampling_ratio: int = 2,
+                 name=None):
+        super().__init__(name)
+        self.output_size = tuple(output_size)
+        self.spatial_scale = spatial_scale
+        self.sampling_ratio = sampling_ratio
+
+    def forward(self, params, features, boxes=None, box_indices=None, **_):
+        if boxes is None:
+            features, boxes, box_indices = features
+        if box_indices is None:
+            box_indices = jnp.zeros((boxes.shape[0],), jnp.int32)
+        return roi_align(features, boxes, box_indices, self.output_size,
+                         self.spatial_scale, self.sampling_ratio)
+
+
+class RoiPooling(RoiAlign):
+    """Max-style RoI pooling approximated by RoiAlign with sampling_ratio 1
+    (reference: nn/RoiPooling.scala; RoiAlign supersedes it in MaskRCNN)."""
+
+    def __init__(self, pooled_h: int, pooled_w: int,
+                 spatial_scale: float = 1.0, name=None):
+        super().__init__((pooled_h, pooled_w), spatial_scale,
+                         sampling_ratio=1, name=name)
+
+
+class Pooler(Module):
+    """Multi-level RoiAlign: route each box to an FPN level by its scale
+    (reference: nn/Pooler.scala)."""
+
+    def __init__(self, output_size: Tuple[int, int],
+                 scales: Sequence[float], sampling_ratio: int = 2,
+                 canonical_size: float = 224.0, name=None):
+        super().__init__(name)
+        self.output_size = tuple(output_size)
+        self.scales = tuple(scales)
+        self.sampling_ratio = sampling_ratio
+        self.canonical = canonical_size
+
+    def forward(self, params, features_list, boxes=None, box_indices=None,
+                **_):
+        if boxes is None:
+            features_list, boxes, box_indices = features_list
+        if box_indices is None:
+            box_indices = jnp.zeros((boxes.shape[0],), jnp.int32)
+        nlevels = len(self.scales)
+        sizes = jnp.sqrt(box_area(boxes))
+        # FPN eq. 1: a canonical-size box maps to the second-coarsest level
+        # (P4 of P2..P5), i.e. index nlevels-2
+        lvl = jnp.floor(jnp.log2(sizes / self.canonical + 1e-6)
+                        + nlevels - 2)
+        lvl = jnp.clip(lvl, 0, nlevels - 1).astype(jnp.int32)
+        outs = [roi_align(f, boxes, box_indices, self.output_size, s,
+                          self.sampling_ratio)
+                for f, s in zip(features_list, self.scales)]
+        stacked = jnp.stack(outs)                     # (L, N, oh, ow, C)
+        return jnp.take_along_axis(
+            stacked, lvl[None, :, None, None, None], axis=0)[0]
+
+
+class FPN(Module):
+    """Feature Pyramid Network over a list of backbone features
+    (reference: nn/FPN.scala): 1x1 lateral convs + top-down upsample adds +
+    3x3 output convs."""
+
+    def __init__(self, in_channels: Sequence[int], out_channels: int,
+                 name=None):
+        super().__init__(name)
+        from bigdl_tpu.nn.conv import SpatialConvolution
+        self.n = len(in_channels)
+        self.out_channels = out_channels
+        for i, c in enumerate(in_channels):
+            self.add_child(f"lateral{i}",
+                           SpatialConvolution(c, out_channels, 1, 1))
+            self.add_child(f"output{i}",
+                           SpatialConvolution(out_channels, out_channels,
+                                              3, 3, pad_w=1, pad_h=1))
+
+    def _apply(self, params, state, features, *, training=False, rng=None):
+        ch = self.children()
+        laterals = []
+        for i, f in enumerate(features):
+            out, _ = ch[f"lateral{i}"].apply(params[f"lateral{i}"],
+                                             state[f"lateral{i}"], f)
+            laterals.append(out)
+        # top-down: coarsest to finest
+        for i in range(self.n - 2, -1, -1):
+            up = laterals[i + 1]
+            th, tw = laterals[i].shape[1], laterals[i].shape[2]
+            up = jax.image.resize(up, (up.shape[0], th, tw, up.shape[3]),
+                                  "nearest")
+            laterals[i] = laterals[i] + up
+        outs = []
+        for i, l in enumerate(laterals):
+            out, _ = ch[f"output{i}"].apply(params[f"output{i}"],
+                                            state[f"output{i}"], l)
+            outs.append(out)
+        return tuple(outs), state
+
+
+class DetectionOutputSSD(Module):
+    """SSD post-processing: decode + per-class NMS with static shapes
+    (reference: nn/DetectionOutputSSD.scala). Returns (boxes (C,K,4),
+    scores (C,K), valid (C,K)) per image for the top-K of each class."""
+
+    def __init__(self, n_classes: int, iou_threshold: float = 0.45,
+                 top_k: int = 100, conf_threshold: float = 0.01,
+                 background_id: int = 0, name=None):
+        super().__init__(name)
+        self.n_classes = n_classes
+        self.iou_threshold = iou_threshold
+        self.top_k = top_k
+        self.conf_threshold = conf_threshold
+        self.background_id = background_id
+
+    def forward(self, params, priors, loc=None, conf=None, **_):
+        if loc is None:
+            priors, loc, conf = priors
+        boxes = decode_boxes(priors, loc)
+
+        def per_class(c_scores):
+            s = jnp.where(c_scores >= self.conf_threshold, c_scores, -jnp.inf)
+            idx, valid = nms(boxes, s, self.iou_threshold, self.top_k)
+            return boxes[idx], jnp.where(valid, c_scores[idx], 0.0), valid
+
+        cls_scores = jnp.swapaxes(conf, 0, 1)          # (C, N)
+        out_boxes, out_scores, out_valid = jax.vmap(per_class)(cls_scores)
+        # zero out the background class
+        bg = jnp.arange(self.n_classes) == self.background_id
+        out_valid = out_valid & ~bg[:, None]
+        return out_boxes, out_scores, out_valid

@@ -1,0 +1,141 @@
+"""Sparse input path (reference: tensor/SparseTensor.scala + nn/
+SparseLinear.scala, nn/SparseJoinTable.scala, nn/LookupTableSparse.scala).
+
+TPU-first: XLA has no sparse tensors — the idiomatic mapping is fixed-width
+COO with padding (`ids`/`values` + weights per row) consumed by gather +
+segment-sum, which lowers to dense MXU-friendly ops. `SparseCOO` is the
+host-side container; `nnz_per_row` is static so programs never retrace."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.core.module import Module, ParamSpec
+from bigdl_tpu.core import init as initializers
+
+
+class SparseCOO:
+    """Fixed-width row-sparse batch: ids (B, K) int32 (pad with `pad_id`),
+    values (B, K) float32 (pad with 0). The analogue of the reference's
+    2-dim SparseTensor batches."""
+
+    __slots__ = ("ids", "values", "n_cols", "pad_id")
+
+    def __init__(self, ids, values, n_cols: int, pad_id: int = -1):
+        self.ids = jnp.asarray(ids, jnp.int32)
+        self.values = jnp.asarray(values, jnp.float32)
+        self.n_cols = n_cols
+        self.pad_id = pad_id
+
+    @staticmethod
+    def from_dense(dense: np.ndarray, nnz_per_row: int,
+                   pad_id: int = -1) -> "SparseCOO":
+        """Keep the nnz_per_row largest-|value| entries of each row."""
+        dense = np.asarray(dense)
+        b, n = dense.shape
+        ids = np.full((b, nnz_per_row), pad_id, np.int32)
+        vals = np.zeros((b, nnz_per_row), np.float32)
+        for i in range(b):
+            nz = np.nonzero(dense[i])[0]
+            if len(nz) > nnz_per_row:
+                nz = nz[np.argsort(-np.abs(dense[i][nz]))[:nnz_per_row]]
+            ids[i, :len(nz)] = nz
+            vals[i, :len(nz)] = dense[i][nz]
+        return SparseCOO(ids, vals, n, pad_id)
+
+    def to_dense(self) -> jnp.ndarray:
+        b, k = self.ids.shape
+        out = jnp.zeros((b, self.n_cols), jnp.float32)
+        mask = self.ids != self.pad_id
+        safe = jnp.where(mask, self.ids, 0)
+        rows = jnp.repeat(jnp.arange(b), k)
+        return out.at[rows, safe.reshape(-1)].add(
+            jnp.where(mask, self.values, 0.0).reshape(-1))
+
+
+class SparseLinear(Module):
+    """y = sparse_x @ W + b via gather + weighted sum
+    (reference: nn/SparseLinear.scala — there backed by MKL sparse BLAS;
+    here the gather/segment-sum lowers to dense dots over the K window)."""
+
+    def __init__(self, in_features: int, out_features: int,
+                 bias: bool = True, name=None):
+        super().__init__(name)
+        self.in_features, self.out_features = in_features, out_features
+        self.has_bias = bias
+
+    def param_specs(self):
+        specs = {"weight": ParamSpec((self.in_features, self.out_features),
+                                     initializers.xavier,
+                                     fan_in=self.in_features,
+                                     fan_out=self.out_features)}
+        if self.has_bias:
+            specs["bias"] = ParamSpec((self.out_features,),
+                                      initializers.zeros)
+        return specs
+
+    def forward(self, params, x: SparseCOO, **_):
+        mask = (x.ids != x.pad_id).astype(jnp.float32)
+        safe = jnp.where(x.ids != x.pad_id, x.ids, 0)
+        rows = params["weight"][safe]                # (B, K, out)
+        y = jnp.einsum("bk,bko->bo", x.values * mask, rows)
+        if self.has_bias:
+            y = y + params["bias"]
+        return y
+
+
+class LookupTableSparse(Module):
+    """Embedding bag over variable-length id lists: mean/sum/sqrtn combiner
+    (reference: nn/LookupTableSparse.scala)."""
+
+    def __init__(self, n_index: int, n_output: int, combiner: str = "sum",
+                 name=None):
+        super().__init__(name)
+        if combiner not in ("sum", "mean", "sqrtn"):
+            raise ValueError(f"combiner must be sum|mean|sqrtn, "
+                             f"got {combiner}")
+        self.n_index, self.n_output = n_index, n_output
+        self.combiner = combiner
+
+    def param_specs(self):
+        return {"weight": ParamSpec(
+            (self.n_index, self.n_output),
+            initializers.random_normal(0.0, 1.0),
+            fan_in=self.n_index, fan_out=self.n_output)}
+
+    def forward(self, params, x: SparseCOO, **_):
+        mask = (x.ids != x.pad_id).astype(jnp.float32)
+        safe = jnp.where(x.ids != x.pad_id, x.ids, 0)
+        emb = params["weight"][safe]                 # (B, K, D)
+        weighted = emb * (x.values * mask)[..., None]
+        s = weighted.sum(1)
+        if self.combiner == "sum":
+            return s
+        cnt = jnp.maximum(mask.sum(1, keepdims=True), 1.0)
+        if self.combiner == "mean":
+            return s / cnt
+        sq = jnp.sqrt(jnp.maximum((x.values * mask)
+                                  .__pow__(2).sum(1, keepdims=True), 1e-12))
+        return s / sq
+
+
+class SparseJoinTable(Module):
+    """Concatenate sparse batches along the feature dim
+    (reference: nn/SparseJoinTable.scala)."""
+
+    def forward(self, params, *xs, **_):
+        if len(xs) == 1 and isinstance(xs[0], (tuple, list)):
+            xs = tuple(xs[0])
+        ids, vals, offset = [], [], 0
+        pad = xs[0].pad_id
+        for x in xs:
+            shifted = jnp.where(x.ids != x.pad_id, x.ids + offset, pad)
+            ids.append(shifted)
+            vals.append(x.values)
+            offset += x.n_cols
+        return SparseCOO(jnp.concatenate(ids, 1), jnp.concatenate(vals, 1),
+                         offset, pad)

@@ -32,8 +32,9 @@ def _load_native():
         return _lib
     _lib_tried = True
     try:
-        if not os.path.exists(_LIB_PATH) and \
-                os.path.exists(os.path.join(_NATIVE_DIR, "Makefile")):
+        if os.path.exists(os.path.join(_NATIVE_DIR, "Makefile")):
+            # always invoke make — a no-op when the .so is newer than the
+            # source, and a rebuild when recordio.cpp changed
             subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
                            capture_output=True, timeout=120)
         lib = ctypes.CDLL(_LIB_PATH)

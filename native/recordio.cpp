@@ -19,24 +19,28 @@ extern "C" {
 
 // ------------------------------------------------------------------ crc32c
 static uint32_t crc_table[8][256];
-static bool crc_init_done = false;
 
 static void crc_init() {
-    if (crc_init_done) return;
-    const uint32_t poly = 0x82F63B78u;
-    for (uint32_t n = 0; n < 256; n++) {
-        uint32_t c = n;
-        for (int k = 0; k < 8; k++) c = (c & 1) ? poly ^ (c >> 1) : c >> 1;
-        crc_table[0][n] = c;
-    }
-    for (uint32_t n = 0; n < 256; n++) {
-        uint32_t c = crc_table[0][n];
-        for (int s = 1; s < 8; s++) {
-            c = crc_table[0][c & 0xFF] ^ (c >> 8);
-            crc_table[s][n] = c;
+    // C++11 magic static: thread-safe one-time init even when concurrent
+    // ctypes calls enter without the GIL
+    static const bool initialized = [] {
+        const uint32_t poly = 0x82F63B78u;
+        for (uint32_t n = 0; n < 256; n++) {
+            uint32_t c = n;
+            for (int k = 0; k < 8; k++)
+                c = (c & 1) ? poly ^ (c >> 1) : c >> 1;
+            crc_table[0][n] = c;
         }
-    }
-    crc_init_done = true;
+        for (uint32_t n = 0; n < 256; n++) {
+            uint32_t c = crc_table[0][n];
+            for (int s = 1; s < 8; s++) {
+                c = crc_table[0][c & 0xFF] ^ (c >> 8);
+                crc_table[s][n] = c;
+            }
+        }
+        return true;
+    }();
+    (void)initialized;
 }
 
 uint32_t rio_crc32c(const uint8_t* data, uint64_t len) {

@@ -1,0 +1,224 @@
+"""Importer tests (reference analogues: CaffeLoaderSpec, TensorflowLoaderSpec,
+TorchFileSpec — round-trips through self-encoded files in each format)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.interop import caffe, tensorflow as tfio, torchfile
+
+
+# ------------------------------------------------------------------- torch
+def test_t7_tensor_roundtrip(tmp_path):
+    p = str(tmp_path / "t.t7")
+    arr = np.random.RandomState(0).randn(3, 4, 5).astype(np.float32)
+    torchfile.save(p, arr)
+    back = torchfile.load(p)
+    np.testing.assert_allclose(back, arr)
+    assert back.dtype == np.float32
+
+
+def test_t7_table_roundtrip(tmp_path):
+    p = str(tmp_path / "tbl.t7")
+    obj = {"weight": np.ones((2, 2), np.float64), "bias": np.zeros(2),
+           "epoch": 3, "name": "lenet", "train": True}
+    torchfile.save(p, obj)
+    back = torchfile.load(p)
+    assert back["epoch"] == 3 and back["name"] == "lenet"
+    assert back["train"] is True
+    np.testing.assert_allclose(back["weight"], 1.0)
+
+
+def test_t7_int_tensors(tmp_path):
+    p = str(tmp_path / "i.t7")
+    arr = np.arange(10, dtype=np.int64)
+    torchfile.save(p, arr)
+    np.testing.assert_array_equal(torchfile.load(p), arr)
+
+
+# ------------------------------------------------------------------- caffe
+def _make_caffemodel(tmp_path, model, params):
+    path = str(tmp_path / "net.caffemodel")
+    caffe.save_caffemodel(path, model, params)
+    return path
+
+
+def test_caffe_roundtrip_linear_conv(tmp_path):
+    model = nn.Sequential(
+        nn.SpatialConvolution(3, 4, 3, 3, pad_w=1, pad_h=1, name="conv1"),
+        nn.ReLU(),
+        nn.Flatten(),
+        nn.Linear(4 * 6 * 6, 5, name="fc1"))
+    params, state = model.init(jax.random.PRNGKey(0))
+    path = _make_caffemodel(tmp_path, model, params)
+
+    blobs = caffe.parse_caffemodel(path)
+    assert set(blobs) == {"conv1", "fc1"}
+    assert blobs["conv1"][0].shape == (4, 3, 3, 3)   # caffe layout
+
+    # load into a freshly initialized copy → outputs must match the source.
+    # fc1 consumes a flattened map but the file was exported from OUR layout,
+    # so explicit None = no permutation.
+    params2, _ = model.init(jax.random.PRNGKey(42))
+    loaded = caffe.load_caffe(model, params2, path,
+                              fc_input_shapes={"fc1": None})
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 6, 6, 3), jnp.float32)
+    ref, _ = model.apply(params, state, x)
+    out, _ = model.apply(loaded, state, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+    # omitting the FC shape info in a conv net must fail loudly, not load a
+    # silently mis-permuted weight
+    with pytest.raises(ValueError, match="fc_input_shapes"):
+        caffe.load_caffe(model, params2, path)
+
+
+def test_caffe_fc_after_conv_permutation(tmp_path):
+    """A weight stored in Caffe's NCHW-flatten order must land correctly in
+    our NHWC-flatten Linear when (C, H, W) is supplied."""
+    from bigdl_tpu.interop import protowire as pw
+    c, h, w, out_f = 3, 2, 2, 5
+    r = np.random.RandomState(0)
+    # caffe stores FC as (out, in) with in flattened from (C, H, W)
+    w_chw = r.randn(out_f, c * h * w).astype(np.float32)
+    blob = pw.field_bytes(7, pw.field_packed_ints(1, [out_f, c * h * w])) + \
+        pw.field_packed_floats(5, w_chw.reshape(-1).tolist())
+    layer = pw.field_str(1, "fc") + pw.field_str(2, "InnerProduct") + \
+        pw.field_bytes(7, blob)
+    path = str(tmp_path / "fc.caffemodel")
+    with open(path, "wb") as fh:
+        fh.write(pw.field_bytes(100, layer))
+
+    model = nn.Sequential(nn.Flatten(), nn.Linear(c * h * w, out_f,
+                                                  bias=False, name="fc"))
+    params, state = model.init(jax.random.PRNGKey(0))
+    loaded = caffe.load_caffe(model, params, path,
+                              fc_input_shapes={"fc": (c, h, w)})
+    x = r.randn(4, h, w, c).astype(np.float32)     # our NHWC input
+    out, _ = model.apply(loaded, state, jnp.asarray(x))
+    # reference: caffe would flatten NCHW
+    ref = x.transpose(0, 3, 1, 2).reshape(4, -1) @ w_chw.T
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5)
+
+
+def test_caffe_match_all_enforced(tmp_path):
+    model = nn.Sequential(nn.Linear(4, 2, name="fc_a"))
+    params, _ = model.init(jax.random.PRNGKey(0))
+    path = _make_caffemodel(tmp_path, model, params)
+    other = nn.Sequential(nn.Linear(4, 2, name="fc_DIFFERENT"))
+    oparams, _ = other.init(jax.random.PRNGKey(1))
+    with pytest.raises(ValueError, match="not found"):
+        caffe.load_caffe(other, oparams, path)
+    # non-strict mode passes through
+    out = caffe.load_caffe(other, oparams, path, match_all=False)
+    np.testing.assert_allclose(np.asarray(out["0"]["weight"]),
+                               np.asarray(oparams["0"]["weight"]))
+
+
+# ---------------------------------------------------------------- tf graph
+def test_graphdef_mlp_runs():
+    r = np.random.RandomState(0)
+    w1 = r.randn(4, 8).astype(np.float32)
+    b1 = r.randn(8).astype(np.float32)
+    w2 = r.randn(8, 3).astype(np.float32)
+    gd = b"".join([
+        tfio.make_node("x", "Placeholder"),
+        tfio.make_node("w1", "Const", tensor=w1),
+        tfio.make_node("b1", "Const", tensor=b1),
+        tfio.make_node("mm1", "MatMul", ["x", "w1"]),
+        tfio.make_node("h1", "BiasAdd", ["mm1", "b1"]),
+        tfio.make_node("relu", "Relu", ["h1"]),
+        tfio.make_node("w2", "Const", tensor=w2),
+        tfio.make_node("mm2", "MatMul", ["relu", "w2"]),
+        tfio.make_node("probs", "Softmax", ["mm2"]),
+    ])
+    g = tfio.load_graphdef(gd)
+    assert g.placeholders == ["x"]
+    x = r.randn(5, 4).astype(np.float32)
+    out = g.run({"x": x}, outputs=["probs"])
+    ref = jax.nn.softmax(jnp.maximum(x @ w1 + b1, 0) @ w2, axis=-1)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+
+def test_graphdef_conv_pool():
+    r = np.random.RandomState(1)
+    k = r.randn(3, 3, 2, 4).astype(np.float32)
+    gd = b"".join([
+        tfio.make_node("x", "Placeholder"),
+        tfio.make_node("k", "Const", tensor=k),
+        tfio.make_node("conv", "Conv2D", ["x", "k"],
+                       ints={"strides": [1, 1, 1, 1]},
+                       strs={"padding": "SAME"}),
+        tfio.make_node("relu", "Relu", ["conv"]),
+        tfio.make_node("pool", "MaxPool", ["relu"],
+                       ints={"ksize": [1, 2, 2, 1],
+                             "strides": [1, 2, 2, 1]},
+                       strs={"padding": "VALID"}),
+    ])
+    g = tfio.load_graphdef(gd)
+    x = r.randn(1, 8, 8, 2).astype(np.float32)
+    out = g.run({"x": x}, outputs=["pool"])
+    assert out.shape == (1, 4, 4, 4)
+    ref = jax.lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(k), (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    ref = jax.lax.reduce_window(jax.nn.relu(ref), -jnp.inf, jax.lax.max,
+                                (1, 2, 2, 1), (1, 2, 2, 1), "VALID")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+
+def test_graphdef_unsupported_op_errors():
+    gd = tfio.make_node("q", "SomeExoticOp")
+    with pytest.raises(NotImplementedError, match="SomeExoticOp"):
+        tfio.load_graphdef(gd).run({})
+
+
+def test_graphdef_batchnorm_and_mean():
+    r = np.random.RandomState(2)
+    x = r.randn(2, 4, 4, 3).astype(np.float32)
+    scale = np.ones(3, np.float32) * 2
+    offset = np.zeros(3, np.float32)
+    mean = x.mean((0, 1, 2))
+    var = x.var((0, 1, 2))
+    gd = b"".join([
+        tfio.make_node("x", "Placeholder"),
+        tfio.make_node("scale", "Const", tensor=scale),
+        tfio.make_node("offset", "Const", tensor=offset),
+        tfio.make_node("mean", "Const", tensor=mean),
+        tfio.make_node("var", "Const", tensor=var),
+        tfio.make_node("bn", "FusedBatchNorm",
+                       ["x", "scale", "offset", "mean", "var"]),
+        tfio.make_node("axes", "Const",
+                       tensor=np.asarray([1, 2], np.int32)),
+        tfio.make_node("gap", "Mean", ["bn", "axes"]),
+    ])
+    out = tfio.load_graphdef(gd).run({"x": x}, outputs=["gap"])
+    assert out.shape == (2, 3)
+    assert abs(float(np.asarray(out).mean())) < 1.0
+
+
+def test_graphdef_avgpool_same_excludes_padding():
+    x = np.ones((1, 5, 5, 1), np.float32)
+    gd = b"".join([
+        tfio.make_node("x", "Placeholder"),
+        tfio.make_node("pool", "AvgPool", ["x"],
+                       ints={"ksize": [1, 2, 2, 1],
+                             "strides": [1, 2, 2, 1]},
+                       strs={"padding": "SAME"}),
+    ])
+    out = np.asarray(tfio.load_graphdef(gd).run({"x": x}, outputs=["pool"]))
+    # TF averages only valid cells: all-ones input -> all-ones output,
+    # including the border windows that overlap padding
+    np.testing.assert_allclose(out, 1.0, rtol=1e-6)
+
+
+def test_graphdef_non_topo_order_raises():
+    gd = b"".join([
+        tfio.make_node("sum", "Add", ["a", "b"]),
+        tfio.make_node("a", "Const", tensor=np.ones(2, np.float32)),
+        tfio.make_node("b", "Const", tensor=np.ones(2, np.float32)),
+    ])
+    with pytest.raises(ValueError, match="topologically"):
+        tfio.load_graphdef(gd).run({})
