@@ -1,0 +1,6 @@
+"""bigdl_tpu.kernels — Pallas TPU kernels for the ops where XLA's automatic
+fusion leaves throughput on the table (the analogue of the reference's
+hand-tuned BigDL-core native kernels, SURVEY.md §2.14; guide:
+/opt/skills/guides/pallas_guide.md)."""
+
+from bigdl_tpu.kernels.flash_attention import flash_attention

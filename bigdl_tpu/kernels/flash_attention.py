@@ -1,0 +1,185 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Why a hand kernel when `blockwise_attention` (nn/attention.py) already gives
+O(T·block) memory: XLA materializes the per-block (Tq, block) logits in HBM
+between scan steps; the Pallas kernel keeps the whole online-softmax state
+(accumulator, running max/sum) in VMEM across the K-block grid walk, so HBM
+traffic is exactly q+k+v reads + one output write — the flash-attention
+recipe mapped onto the MXU/VMEM hierarchy.
+
+Forward is the fused kernel; backward (`jax.custom_vjp`) recomputes with the
+numerically-identical `blockwise_attention` and differentiates that — same
+gradients, standard rematerialization trade.
+
+The kernel grid is (batch*heads, q_blocks, k_blocks), iterated sequentially
+on TPU (k minor), with the softmax state in VMEM scratch persisting across
+the k dimension. Causal masking skips fully-masked K blocks' contribution
+via predication.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:                                    # pltpu only imports on TPU builds
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except Exception:                       # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+NEG_INF = -1e30
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+               block_q: int, block_k: int, seq_k: int, causal: bool,
+               scale: float, q_offset: int):
+    kb = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    qb = pl.program_id(1)
+    # causal: K blocks entirely above the diagonal contribute nothing —
+    # skip their MXU work via predication (compute runs only `@pl.when`)
+    if causal:
+        needed = kb * block_k <= q_offset + qb * block_q + block_q - 1
+    else:
+        needed = jnp.asarray(True)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0]                              # (block_q, d)
+        k = k_ref[0]                              # (block_k, d)
+        v = v_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+
+        if causal:
+            q_pos = (q_offset + qb * block_q +
+                     jax.lax.broadcasted_iota(jnp.int32,
+                                              (block_q, block_k), 0))
+            k_pos = (kb * block_k +
+                     jax.lax.broadcasted_iota(jnp.int32,
+                                              (block_q, block_k), 1))
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+        m_prev = m_ref[:]                         # (block_q, 1)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                    # (block_q, block_k)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+    @pl.when(kb == nk - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[:] /
+                    jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
+               scale: Optional[float], interpret: bool):
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    if tq % block_q or tk % block_k:
+        raise ValueError(f"Tq={tq} %% block_q={block_q} and Tk={tk} %% "
+                         f"block_k={block_k} must both be 0")
+    if pltpu is None:
+        raise RuntimeError(
+            "jax.experimental.pallas.tpu is unavailable in this JAX build; "
+            "use nn.attention.blockwise_attention instead")
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+    bh = b * h
+    qf = q.reshape(bh, tq, d)
+    kf = k.reshape(bh, tk, d)
+    vf = v.reshape(bh, tk, d)
+    grid = (bh, tq // block_q, tk // block_k)
+
+    kernel = functools.partial(
+        _fa_kernel, block_q=block_q, block_k=block_k, seq_k=tk,
+        causal=causal, scale=sc, q_offset=tk - tq)
+    scratch = [
+        pltpu.VMEM((block_q, d), jnp.float32),    # acc
+        pltpu.VMEM((block_q, 1), jnp.float32),    # running max
+        pltpu.VMEM((block_q, 1), jnp.float32),    # running sum
+    ]
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda s, i, j: (s, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda s, i, j: (s, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda s, i, j: (s, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda s, i, j: (s, i, 0)),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, tq, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, block_q: int = 128, block_k: int = 128,
+                    causal: bool = False, scale: Optional[float] = None,
+                    interpret: bool = False):
+    """Fused attention: q (B, H, Tq, d), k/v (B, H, Tk, d) → (B, H, Tq, d).
+
+    `interpret=True` runs the kernel in the Pallas interpreter (CPU tests).
+    Numerics match `nn.attention.dot_product_attention` to fp32 tolerance."""
+    return _flash_fwd(q, k, v, block_q=min(block_q, q.shape[2]),
+                      block_k=min(block_k, k.shape[2]), causal=causal,
+                      scale=scale, interpret=interpret)
+
+
+def _fwd(q, k, v, block_q, block_k, causal, scale, interpret):
+    out = flash_attention(q, k, v, block_q, block_k, causal, scale,
+                          interpret)
+    return out, (q, k, v)
+
+
+def _bwd(block_q, block_k, causal, scale, interpret, res, g):
+    q, k, v = res
+    from bigdl_tpu.nn.attention import blockwise_attention
+
+    def ref(q, k, v):
+        return blockwise_attention(
+            q, k, v, block_size=min(block_k, k.shape[2]), causal=causal,
+            scale=scale)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)
+
+
+class PallasFlashAttention:
+    """Callable `attn_impl` backend for MultiHeadAttention:
+    `MultiHeadAttention(d, h, attn_impl=PallasFlashAttention())`.
+    causal= only (like blockwise)."""
+
+    def __init__(self, block_q: int = 128, block_k: int = 128,
+                 interpret: bool = False):
+        self.block_q, self.block_k, self.interpret = \
+            block_q, block_k, interpret
+
+    def __call__(self, q, k, v, *, mask=None, causal=False):
+        if mask is not None:
+            raise ValueError("PallasFlashAttention supports causal= only")
+        return flash_attention(q, k, v, self.block_q, self.block_k, causal,
+                               None, self.interpret)

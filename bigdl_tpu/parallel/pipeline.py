@@ -1,0 +1,137 @@
+"""Pipeline parallelism — GPipe-style microbatched schedule over a 'pipe'
+mesh axis (no reference equivalent: SURVEY.md §2.13 marks PP as absent in
+BigDL; this is a deliberate TPU-native extension, designed per the
+scaling-book recipe: stage params live one-per-device on the pipe axis,
+activations hop stages via `lax.ppermute` over ICI, and autodiff through the
+permutation yields the reverse schedule for backward).
+
+Usage (uniform stages — e.g. N identical transformer blocks):
+
+    stacked = stack_stage_params([p0, p1, p2, p3])     # leading stage axis
+    y = pipeline_apply(stage_fn, stacked, x, mesh, n_microbatches=8)
+
+`stage_fn(stage_params, h) -> h` is one stage's forward. Inside, the input
+batch is split into microbatches; stage s processes microbatch m at tick
+s + m (the classic GPipe diagonal), so the bubble is (S-1)/(M+S-1).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from bigdl_tpu.parallel.mesh import PIPE_AXIS
+
+
+def stack_stage_params(stage_params: Sequence) -> object:
+    """Stack per-stage param pytrees along a new leading 'stage' axis —
+    shard that axis over 'pipe' so each device holds exactly its stage."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *stage_params)
+
+
+def stage_spec(tree) -> object:
+    """PartitionSpecs sharding the leading stage axis over the pipe axis."""
+    return jax.tree.map(
+        lambda x: P(PIPE_AXIS, *([None] * (jnp.ndim(x) - 1))), tree)
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
+                   n_microbatches: int, axis_name: str = PIPE_AXIS):
+    """Run S pipeline stages over the batch with M microbatches.
+
+    x: (batch, ...) — batch must divide by n_microbatches. Returns the
+    final-stage output with the same batch shape. Differentiable end-to-end
+    (grads flow back through the ppermute chain)."""
+    n_stages = mesh.shape[axis_name]
+    b = x.shape[0]
+    if b % n_microbatches:
+        raise ValueError(f"batch {b} must divide microbatches "
+                         f"{n_microbatches}")
+    mb = b // n_microbatches
+    xs = x.reshape((n_microbatches, mb) + x.shape[1:])
+
+    p_params = stage_spec(stacked_params)
+    # every device sees all microbatches; only stage 0 consumes them
+    in_specs = (p_params, P())
+    out_specs = P(axis_name)
+
+    def shard_fn(params_stage, xs):
+        # params_stage leaves keep a leading stage axis of length 1
+        params_local = jax.tree.map(lambda a: a[0], params_stage)
+        s = lax.axis_index(axis_name)
+        ticks = n_microbatches + n_stages - 1
+        h_shape = xs.shape[1:]
+
+        def tick(t, carry):
+            buf, outs = carry
+            # stage 0 reads microbatch t (clamped), others read the buffer
+            m_idx = jnp.clip(t, 0, n_microbatches - 1)
+            inp = jnp.where(s == 0, lax.dynamic_index_in_dim(
+                xs, m_idx, keepdims=False), buf)
+            h = stage_fn(params_local, inp)
+            active = (t >= s) & (t - s < n_microbatches)
+            h = jnp.where(active, h, jnp.zeros_like(h))
+            # collect at the last stage: microbatch index t - (S-1)
+            out_idx = jnp.clip(t - (n_stages - 1), 0, n_microbatches - 1)
+            is_out = (s == n_stages - 1) & (t >= n_stages - 1)
+            cur = lax.dynamic_index_in_dim(outs, out_idx, keepdims=False)
+            outs = lax.dynamic_update_index_in_dim(
+                outs, jnp.where(is_out, h, cur), out_idx, 0)
+            # rotate activations stage s -> s+1
+            buf = lax.ppermute(
+                h, axis_name,
+                [(i, (i + 1) % n_stages) for i in range(n_stages)])
+            return buf, outs
+
+        buf0 = jnp.zeros(h_shape, x.dtype)
+        outs0 = jnp.zeros((n_microbatches,) + h_shape, x.dtype)
+        _, outs = lax.fori_loop(0, ticks, tick, (buf0, outs0))
+        # out_specs concatenates over pipe; add the leading axis back
+        return outs[None]
+
+    outs = shard_map(shard_fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_vma=False)(
+        stacked_params, xs)
+    # (S, M, mb, ...) — only the last stage's slot holds real outputs
+    return outs[-1].reshape((b,) + x.shape[1:])
+
+
+class Pipeline:
+    """Module-style facade: wrap a stage Module applied S times.
+
+        pipe = Pipeline(block, n_stages=4, n_microbatches=8)
+        stacked = pipe.shard(pipe.init(rng), mesh)
+        y = pipe.apply(stacked, x, mesh)
+    """
+
+    def __init__(self, stage_module, n_stages: int, n_microbatches: int):
+        self.stage = stage_module
+        self.n_stages = n_stages
+        self.n_microbatches = n_microbatches
+
+    def init(self, rng, dtype=None):
+        ps = []
+        for i in range(self.n_stages):
+            p, _ = self.stage.init(jax.random.fold_in(rng, i), dtype=dtype)
+            ps.append(p)
+        return stack_stage_params(ps)
+
+    def shard(self, stacked, mesh: Mesh):
+        specs = stage_spec(stacked)
+        return jax.tree.map(
+            lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+            stacked, specs)
+
+    def apply(self, stacked, x, mesh: Mesh):
+        def stage_fn(params, h):
+            out, _ = self.stage.apply(params, {}, h)
+            return out
+        return pipeline_apply(stage_fn, stacked, x, mesh,
+                              self.n_microbatches)

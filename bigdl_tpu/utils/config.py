@@ -1,0 +1,89 @@
+"""Config / flag system (reference: the ~40 `bigdl.*` JVM system properties
+— utils/Engine.scala:210-216, parameters/AllReduceParameter.scala:32-44,
+optim/DistriOptimizer.scala:882-883, nn/mkldnn/Fusion.scala:34 — documented
+in docs/docs/ScalaUserGuide/configuration.md).
+
+Here: one typed env-var registry under the `BIGDL_TPU_` prefix. Every knob
+is declared with a default + docstring so `print_config()` is the
+configuration reference."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+
+def _bool(s: str) -> bool:
+    return s.lower() in ("1", "true", "yes", "on")
+
+
+@dataclass
+class Knob:
+    name: str                 # env var suffix
+    default: Any
+    parse: Callable
+    doc: str
+
+    @property
+    def env(self) -> str:
+        return f"BIGDL_TPU_{self.name}"
+
+    def get(self):
+        raw = os.environ.get(self.env)
+        return self.default if raw is None else self.parse(raw)
+
+
+_REGISTRY: Dict[str, Knob] = {}
+
+
+def _register(name, default, parse, doc):
+    _REGISTRY[name] = Knob(name, default, parse, doc)
+
+
+# reference: bigdl.localMode / bigdl.coreNumber — here device selection
+_register("FORCE_CPU", False, _bool,
+          "Run on host CPU even when a TPU plugin is present "
+          "(utils/platform.py; reference: bigdl.localMode)")
+_register("SEED", 1, int,
+          "Global default RNG seed for trainers "
+          "(reference: RandomGenerator defaults)")
+_register("COMPUTE_DTYPE", "", str,
+          "Forward/backward compute dtype for the distributed trainer: "
+          "'' (fp32) or 'bfloat16' (reference: FP16 wire compression, "
+          "parameters/FP16CompressedTensor.scala — bf16 is the TPU form)")
+_register("PREFETCH_SIZE", 2, int,
+          "Host->device prefetch depth (dataset/prefetch.py; reference: "
+          "bigdl.Parameter.syncPoolSize data threads)")
+_register("FAILURE_RETRY_TIMES", 5, int,
+          "Driver-loop retries from last checkpoint before giving up "
+          "(reference: bigdl.failure.retryTimes, DistriOptimizer.scala:882)")
+_register("FAILURE_RETRY_INTERVAL_S", 120, int,
+          "Sliding window (seconds) for counting retries "
+          "(reference: bigdl.failure.retryTimeInterval)")
+_register("CHECK_SINGLETON", False, _bool,
+          "Warn when two trainers share one process "
+          "(reference: bigdl.check.singleton)")
+_register("LOG_THROUGHPUT_EVERY", 20, int,
+          "Iterations between trainer log lines "
+          "(reference: per-iteration Throughput log)")
+
+
+def get(name: str):
+    """config.get('SEED') — typed, env-overridable."""
+    return _REGISTRY[name].get()
+
+
+def knobs() -> Dict[str, Knob]:
+    return dict(_REGISTRY)
+
+
+def print_config() -> str:
+    lines = []
+    for k in _REGISTRY.values():
+        cur = k.get()
+        mark = " (set)" if os.environ.get(k.env) is not None else ""
+        lines.append(f"{k.env} = {cur!r}{mark}\n    {k.doc}")
+    out = "\n".join(lines)
+    print(out)
+    return out

@@ -15,7 +15,9 @@ import os
 
 
 def cpu_requested() -> bool:
-    return bool(os.environ.get("BIGDL_TPU_FORCE_CPU")) or \
+    raw = os.environ.get("BIGDL_TPU_FORCE_CPU", "")
+    # same parse as the utils.config registry: "false"/"0" mean off
+    return raw.lower() in ("1", "true", "yes", "on") or \
         "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", "")
 
 

@@ -1,0 +1,113 @@
+"""Profiling / per-module timing (reference: AbstractModule forward/backward
+nanosecond timers + getTimes/getTimesGroupByModuleType,
+nn/abstractnn/AbstractModule.scala:168-190,255-299; per-iteration phase
+metrics optim/Metrics.scala; perf CLI nn/mkldnn/Perf.scala:37-126).
+
+Two tools:
+  * `module_times` — eager per-child wall time (the reference's getTimes):
+    runs each direct child separately with block_until_ready. Under jit XLA
+    fuses across modules, so this measures the un-fused upper bound — use it
+    to find the hot module, then `xla_profile` for the fused truth.
+  * `xla_profile` — wraps jax.profiler around a jitted fn; the trace opens
+    in TensorBoard/Perfetto with per-op attribution (module names appear via
+    the `jax.named_scope` each Module.apply installs).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def module_times(model, params, state, *inputs, repeats: int = 3,
+                 training: bool = False, rng=None) -> List[Tuple[str, float]]:
+    """Per-direct-child forward wall time in seconds, sorted descending
+    (reference: getTimesGroupByModuleType). Works on containers whose
+    children execute sequentially (Sequential); for others it times the
+    whole module."""
+    from bigdl_tpu.core.container import Sequential
+
+    results: List[Tuple[str, float]] = []
+    children = model.children()
+    # only Sequential runs children as a chain; time anything else whole
+    if not children or not isinstance(model, Sequential):
+        children = {model.name: model}
+        params = {model.name: params}
+        state = {model.name: state}
+
+    h = inputs
+    for cname, child in children.items():
+        cp = params.get(cname, {}) if isinstance(params, dict) else {}
+        cs = state.get(cname, {}) if isinstance(state, dict) else {}
+
+        def run():
+            out, _ = child.apply(cp, cs, *h, training=training, rng=rng)
+            return out
+
+        out = run()                        # warm up / get next input
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            jax.block_until_ready(run())
+        dt = (time.perf_counter() - t0) / repeats
+        results.append((f"{cname}:{child.name}", dt))
+        h = out if isinstance(out, tuple) else (out,)
+    return sorted(results, key=lambda kv: -kv[1])
+
+
+def format_times(times: List[Tuple[str, float]]) -> str:
+    total = sum(t for _, t in times) or 1e-12
+    lines = [f"{'module':<40} {'ms':>10} {'%':>6}"]
+    for name, t in times:
+        lines.append(f"{name:<40} {t * 1e3:>10.3f} {t / total:>6.1%}")
+    return "\n".join(lines)
+
+
+def xla_profile(fn: Callable, *args, logdir: str = "/tmp/bigdl_tpu_profile",
+                iters: int = 3):
+    """Trace `iters` calls of (jitted) `fn` into a TensorBoard profile dir
+    (reference analogue: the Metrics phase timers; here XLA's own profiler
+    carries per-fusion timing)."""
+    out = fn(*args)                        # compile outside the trace
+    jax.block_until_ready(out)
+    with jax.profiler.trace(logdir):
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+    return logdir
+
+
+class IterationMetrics:
+    """Phase-timing accumulator for training loops (reference:
+    optim/Metrics.scala:31-123 — set/add per phase, summary string)."""
+
+    def __init__(self):
+        self._sums: Dict[str, float] = {}
+        self._counts: Dict[str, int] = {}
+
+    def add(self, phase: str, seconds: float):
+        self._sums[phase] = self._sums.get(phase, 0.0) + seconds
+        self._counts[phase] = self._counts.get(phase, 0) + 1
+
+    def time(self, phase: str):
+        metrics = self
+
+        class _Ctx:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+
+            def __exit__(self, *a):
+                metrics.add(phase, time.perf_counter() - self.t0)
+
+        return _Ctx()
+
+    def summary(self) -> str:
+        lines = []
+        for phase, s in sorted(self._sums.items(), key=lambda kv: -kv[1]):
+            n = self._counts[phase]
+            lines.append(f"{phase}: total {s:.3f}s over {n} "
+                         f"(avg {s / n * 1e3:.2f}ms)")
+        return "\n".join(lines)

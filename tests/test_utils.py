@@ -1,0 +1,51 @@
+"""Config and profiling utils tests (reference analogues: EngineSpec config
+checks, Metrics accumulator behavior)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.utils import config
+from bigdl_tpu.utils.profile import (IterationMetrics, format_times,
+                                     module_times)
+
+
+def test_config_defaults_and_env_override(monkeypatch):
+    assert config.get("SEED") == 1
+    monkeypatch.setenv("BIGDL_TPU_SEED", "42")
+    assert config.get("SEED") == 42
+    monkeypatch.setenv("BIGDL_TPU_FORCE_CPU", "true")
+    assert config.get("FORCE_CPU") is True
+    out = config.print_config()
+    assert "BIGDL_TPU_SEED = 42 (set)" in out
+    assert "BIGDL_TPU_FAILURE_RETRY_TIMES" in out
+
+
+def test_module_times_orders_by_cost():
+    model = nn.Sequential(
+        nn.Linear(64, 512, name="big"),
+        nn.ReLU(),
+        nn.Linear(512, 4, name="small"))
+    params, state = model.init(jax.random.PRNGKey(0))
+    x = jnp.asarray(np.random.RandomState(0).randn(32, 64), jnp.float32)
+    times = module_times(model, params, state, x, repeats=2)
+    assert len(times) == 3
+    names = [n for n, _ in times]
+    assert any("big" in n for n in names)
+    table = format_times(times)
+    assert "module" in table and "%" in table
+
+
+def test_iteration_metrics_summary():
+    m = IterationMetrics()
+    with m.time("forward"):
+        pass
+    with m.time("forward"):
+        pass
+    m.add("comm", 0.5)
+    s = m.summary()
+    assert "comm: total 0.500s over 1" in s
+    assert "forward" in s
