@@ -17,13 +17,17 @@ import jax
 import numpy as np
 
 
-def prefetch_to_device(it: Iterable, size: int = 2,
+def prefetch_to_device(it: Iterable, size: Optional[int] = None,
                        sharding=None) -> Iterator:
     """Wrap a host batch iterator; yields device-resident batches.
 
     `sharding` (optional jax.sharding.Sharding or pytree of them) places each
     batch directly into its distributed layout — the device_put does the
-    host-split + per-device transfer in one call."""
+    host-split + per-device transfer in one call. `size` defaults to the
+    BIGDL_TPU_PREFETCH_SIZE knob (utils/config.py)."""
+    if size is None:
+        from bigdl_tpu.utils import config
+        size = config.get("PREFETCH_SIZE")
 
     def place(batch):
         if sharding is None:

@@ -76,9 +76,20 @@ class Optimizer:
     XLA compiles one program (use the pipeline's fixed-size batcher).
     """
 
+    _live_instances = 0
+
     def __init__(self, model: Module, dataset, criterion: Criterion,
                  optim_method: Optional[OptimMethod] = None,
-                 seed: int = 1):
+                 seed: Optional[int] = None):
+        from bigdl_tpu.utils import config
+        if seed is None:
+            seed = config.get("SEED")
+        Optimizer._live_instances += 1
+        if config.get("CHECK_SINGLETON") and Optimizer._live_instances > 1:
+            log.warning(
+                "multiple Optimizer instances in one process "
+                "(BIGDL_TPU_CHECK_SINGLETON is set; reference: "
+                "bigdl.check.singleton)")
         self.model, self.dataset, self.criterion = model, dataset, criterion
         self.method = optim_method or SGD(1e-2)
         self.end_when: Trigger = Trigger.max_epoch(1)
@@ -90,6 +101,8 @@ class Optimizer:
         self.grad_processors: List[GradientProcessor] = []
         self.seed = seed
         self.state: Dict = {"epoch": 0, "neval": 0, "records": 0}
+        from bigdl_tpu.utils import config as _config
+        self._log_every = max(1, _config.get("LOG_THROUGHPUT_EVERY"))
         self._summary = None
         self._val_summary = None
 
@@ -246,7 +259,7 @@ class Optimizer:
                 st["loss"] = loss_f
                 wall = time.time() - it_start
                 epoch_records += n
-                if st["neval"] % 20 == 1:
+                if st["neval"] % self._log_every == 1:
                     log.info("epoch %d iter %d loss %.4f lr %.5f %.1f rec/s",
                              st["epoch"], st["neval"], loss_f, lr, n / max(wall, 1e-9))
                 if self._summary is not None:
@@ -308,6 +321,41 @@ class Optimizer:
                                     "model_state": model_state,
                                     "slots": slots}, meta)
         log.info("checkpoint -> %s", path)
+
+    # -------------------------------------------------------------- retry
+    def optimize_with_retry(self, retries: Optional[int] = None,
+                            window_s: Optional[float] = None):
+        """Driver-side failure recovery (reference:
+        optim/DistriOptimizer.scala:886-963): on an exception, reload the
+        latest checkpoint under `ckpt_path` and retry, up to
+        BIGDL_TPU_FAILURE_RETRY_TIMES attempts within a
+        BIGDL_TPU_FAILURE_RETRY_INTERVAL_S sliding window. Requires
+        `set_checkpoint` to have been called (no snapshot → no recovery)."""
+        from bigdl_tpu.utils import config
+        if retries is None:
+            retries = config.get("FAILURE_RETRY_TIMES")
+        if window_s is None:
+            window_s = config.get("FAILURE_RETRY_INTERVAL_S")
+        if self.ckpt_path is None:
+            raise RuntimeError("optimize_with_retry needs set_checkpoint() "
+                               "so there is a snapshot to recover from")
+        failures: List[float] = []
+        while True:
+            try:
+                return self.optimize()
+            except KeyboardInterrupt:
+                raise
+            except Exception as e:             # noqa: BLE001 — driver loop
+                now = time.time()
+                failures = [t for t in failures if now - t < window_s]
+                failures.append(now)
+                if len(failures) > retries:
+                    log.error("giving up after %d failures in %.0fs window",
+                              len(failures), window_s)
+                    raise
+                log.warning("training failed (%s); retry %d/%d from latest "
+                            "checkpoint", e, len(failures), retries)
+                self.resume(self.ckpt_path)
 
 
 LocalOptimizer = Optimizer

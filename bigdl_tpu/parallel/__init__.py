@@ -19,10 +19,18 @@ from bigdl_tpu.parallel.sharding import (
 )
 from bigdl_tpu.parallel.distri import DistriOptimizer
 from bigdl_tpu.parallel.ring import ring_attention, ring_self_attention
+from bigdl_tpu.parallel.ulysses import (ulysses_attention,
+                                        ulysses_self_attention)
+from bigdl_tpu.parallel.pipeline import (Pipeline, pipeline_apply,
+                                         stack_stage_params)
+from bigdl_tpu.parallel.moe import MoE, expert_parallel_apply
 
 __all__ = [
     "Engine", "create_mesh", "mesh_shape_for",
     "DATA_AXIS", "MODEL_AXIS", "PIPE_AXIS", "SEQ_AXIS", "EXPERT_AXIS",
     "ShardingRules", "batch_spec", "replicated_spec", "zero1_spec",
     "shard_tree", "DistriOptimizer", "ring_attention", "ring_self_attention",
+    "ulysses_attention", "ulysses_self_attention",
+    "Pipeline", "pipeline_apply", "stack_stage_params",
+    "MoE", "expert_parallel_apply",
 ]

@@ -73,8 +73,14 @@ class DistriOptimizer(Optimizer):
                  rules: Optional[ShardingRules] = None,
                  zero1: bool = True,
                  compute_dtype: Any = None,
-                 seed: int = 1):
+                 seed: Optional[int] = None):
         super().__init__(model, dataset, criterion, optim_method, seed=seed)
+        if compute_dtype is None:
+            # reference: FP16 wire compression knob; here the bf16 policy
+            from bigdl_tpu.utils import config
+            import jax.numpy as _jnp
+            if config.get("COMPUTE_DTYPE") == "bfloat16":
+                compute_dtype = _jnp.bfloat16
         self.mesh = mesh if mesh is not None else Engine.mesh()
         self.rules = rules or ShardingRules()
         self.zero1 = zero1

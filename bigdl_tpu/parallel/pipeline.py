@@ -50,6 +50,12 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
     final-stage output with the same batch shape. Differentiable end-to-end
     (grads flow back through the ppermute chain)."""
     n_stages = mesh.shape[axis_name]
+    stage_dims = {int(l.shape[0]) for l in jax.tree.leaves(stacked_params)}
+    if stage_dims and stage_dims != {n_stages}:
+        raise ValueError(
+            f"stacked params have stage axis {sorted(stage_dims)} but the "
+            f"'{axis_name}' mesh axis has {n_stages} devices — each device "
+            f"must own exactly one stage")
     b = x.shape[0]
     if b % n_microbatches:
         raise ValueError(f"batch {b} must divide microbatches "
@@ -119,7 +125,15 @@ class Pipeline:
     def init(self, rng, dtype=None):
         ps = []
         for i in range(self.n_stages):
-            p, _ = self.stage.init(jax.random.fold_in(rng, i), dtype=dtype)
+            p, s = self.stage.init(jax.random.fold_in(rng, i), dtype=dtype)
+            if any(hasattr(l, "shape") for l in jax.tree.leaves(s)):
+                raise NotImplementedError(
+                    f"pipeline stage {self.stage.name!r} carries mutable "
+                    f"state (e.g. BatchNorm running stats), which the GPipe "
+                    f"schedule cannot thread across microbatches — use "
+                    f"stateless normalization (LayerNorm/RMSNorm) in "
+                    f"pipelined stages")
+            self._state_skeleton = s      # empty-dict tree, reused in apply
             ps.append(p)
         return stack_stage_params(ps)
 
@@ -130,8 +144,10 @@ class Pipeline:
             stacked, specs)
 
     def apply(self, stacked, x, mesh: Mesh):
+        skeleton = getattr(self, "_state_skeleton", {})
+
         def stage_fn(params, h):
-            out, _ = self.stage.apply(params, {}, h)
+            out, _ = self.stage.apply(params, skeleton, h)
             return out
         return pipeline_apply(stage_fn, stacked, x, mesh,
                               self.n_microbatches)

@@ -30,7 +30,8 @@ def ulysses_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
                       scale: Optional[float] = None):
     """Call INSIDE shard_map with q/k/v (B, H, T_local, d) sequence-sharded
     on `axis_name`. Returns (B, H, T_local, d), sequence-sharded again.
-    H must divide the axis size."""
+    The axis size must divide the head count H (each device takes H/N
+    heads after the all-to-all)."""
     n = lax.axis_size(axis_name)
     h = q.shape[1]
     if h % n:

@@ -15,9 +15,8 @@ import os
 
 
 def cpu_requested() -> bool:
-    raw = os.environ.get("BIGDL_TPU_FORCE_CPU", "")
-    # same parse as the utils.config registry: "false"/"0" mean off
-    return raw.lower() in ("1", "true", "yes", "on") or \
+    from bigdl_tpu.utils import config
+    return config.get("FORCE_CPU") or \
         "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", "")
 
 

@@ -49,3 +49,59 @@ def test_iteration_metrics_summary():
     s = m.summary()
     assert "comm: total 0.500s over 1" in s
     assert "forward" in s
+
+
+def test_config_knobs_are_wired(monkeypatch):
+    """Every documented knob must have a real consumer."""
+    import numpy as np
+    from bigdl_tpu.optim.local import Optimizer
+    from bigdl_tpu.dataset import ArrayDataSet
+
+    # SEED
+    monkeypatch.setenv("BIGDL_TPU_SEED", "123")
+    ds = ArrayDataSet(np.zeros((4, 2), np.float32),
+                      np.zeros(4, np.int32), 2)
+    opt = Optimizer(nn.Linear(2, 2), ds, nn.MSECriterion())
+    assert opt.seed == 123
+    # LOG_THROUGHPUT_EVERY
+    monkeypatch.setenv("BIGDL_TPU_LOG_THROUGHPUT_EVERY", "5")
+    opt2 = Optimizer(nn.Linear(2, 2), ds, nn.MSECriterion())
+    assert opt2._log_every == 5
+    # FORCE_CPU honors false
+    monkeypatch.setenv("BIGDL_TPU_FORCE_CPU", "false")
+    from bigdl_tpu.utils import platform
+    monkeypatch.setenv("XLA_FLAGS", "")
+    assert platform.cpu_requested() is False
+    monkeypatch.setenv("BIGDL_TPU_FORCE_CPU", "1")
+    assert platform.cpu_requested() is True
+
+
+def test_optimize_with_retry_recovers(tmp_path, monkeypatch):
+    """A transient failure mid-training resumes from checkpoint."""
+    import numpy as np
+    from bigdl_tpu.optim.local import Optimizer
+    from bigdl_tpu.optim.method import SGD
+    from bigdl_tpu.optim.trigger import Trigger
+    from bigdl_tpu.dataset import ArrayDataSet
+
+    r = np.random.RandomState(0)
+    x = r.randn(32, 4).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int32)
+    model = nn.Sequential(nn.Linear(4, 2), nn.LogSoftMax())
+    ds = ArrayDataSet(x, y, 8, drop_last=True)
+    opt = Optimizer(model, ds, nn.ClassNLLCriterion(), SGD(0.1))
+    opt.set_end_when(Trigger.max_epoch(4))
+    opt.set_checkpoint(str(tmp_path), Trigger.every_epoch())
+
+    calls = {"n": 0}
+    real = opt._maybe_validate
+
+    def flaky(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 6:          # blow up once mid-epoch-2
+            raise RuntimeError("injected fault")
+        return real(*a, **kw)
+
+    opt._maybe_validate = flaky
+    params, state = opt.optimize_with_retry(retries=2, window_s=60)
+    assert opt.state["epoch"] >= 3   # completed after recovery
