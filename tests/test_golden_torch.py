@@ -1,0 +1,220 @@
+"""Golden-model parity tests against PyTorch (CPU) — the analogue of the
+reference's 132 Torch7 golden specs (test/.../torch/TH.scala: run torch,
+compare within tolerance; SURVEY.md §4 maps this to 'compare vs PyTorch
+goldens'). Weights are copied between frameworks with explicit layout
+conversion, then outputs AND input-gradients are compared."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax                                                   # noqa: E402
+import jax.numpy as jnp                                      # noqa: E402
+
+import bigdl_tpu.nn as nn                                    # noqa: E402
+
+
+def _j2t(x):
+    return torch.from_numpy(np.asarray(x).copy())
+
+
+def _grad_pair(jfn, jx, tfn, tx):
+    """Forward outputs + input grads for a scalar-sum objective."""
+    jout = jfn(jnp.asarray(jx))
+    jgrad = jax.grad(lambda x: jfn(x).sum())(jnp.asarray(jx))
+    txt = _j2t(tx).requires_grad_(True)
+    tout = tfn(txt)
+    tout.sum().backward()
+    return (np.asarray(jout), np.asarray(jgrad),
+            tout.detach().numpy(), txt.grad.numpy())
+
+
+def test_linear_matches_torch():
+    r = np.random.RandomState(0)
+    layer = nn.Linear(16, 8)
+    params, state = layer.init(jax.random.PRNGKey(0))
+    tl = torch.nn.Linear(16, 8)
+    with torch.no_grad():
+        tl.weight.copy_(_j2t(params["weight"]).T)     # ours (in,out)
+        tl.bias.copy_(_j2t(params["bias"]))
+    x = r.randn(4, 16).astype(np.float32)
+    jo, jg, to, tg = _grad_pair(
+        lambda x: layer.apply(params, state, x)[0], x, tl, x)
+    np.testing.assert_allclose(jo, to, atol=1e-5)
+    np.testing.assert_allclose(jg, tg, atol=1e-5)
+
+
+def test_conv2d_matches_torch():
+    r = np.random.RandomState(1)
+    layer = nn.SpatialConvolution(3, 6, 3, 3, 2, 2, 1, 1)
+    params, state = layer.init(jax.random.PRNGKey(0))
+    tc = torch.nn.Conv2d(3, 6, 3, stride=2, padding=1)
+    with torch.no_grad():
+        # ours (kh, kw, cin, cout) -> torch (cout, cin, kh, kw)
+        tc.weight.copy_(_j2t(np.transpose(params["weight"], (3, 2, 0, 1))))
+        tc.bias.copy_(_j2t(params["bias"]))
+    x = r.randn(2, 9, 9, 3).astype(np.float32)        # NHWC
+
+    jo, jg, to, tg = _grad_pair(
+        lambda x: layer.apply(params, state, x)[0], x,
+        lambda x: tc(x.permute(0, 3, 1, 2)).permute(0, 2, 3, 1),
+        x)
+    np.testing.assert_allclose(jo, to, atol=1e-4)
+    np.testing.assert_allclose(jg, tg, atol=1e-4)
+
+
+def test_batchnorm_matches_torch_train_and_eval():
+    r = np.random.RandomState(2)
+    layer = nn.SpatialBatchNormalization(4, eps=1e-5, momentum=0.1)
+    params, state = layer.init(jax.random.PRNGKey(0))
+    tb = torch.nn.BatchNorm2d(4, eps=1e-5, momentum=0.1)
+    x = r.randn(8, 5, 5, 4).astype(np.float32)
+
+    # train step: outputs + updated running stats
+    jout, new_state = layer.apply(params, state, jnp.asarray(x),
+                                  training=True)
+    tb.train()
+    tout = tb(_j2t(x).permute(0, 3, 1, 2)).permute(0, 2, 3, 1)
+    np.testing.assert_allclose(np.asarray(jout), tout.detach().numpy(),
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(new_state["running_mean"]),
+                               tb.running_mean.numpy(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(new_state["running_var"]),
+                               tb.running_var.numpy(), atol=1e-4)
+
+    # eval with those stats
+    jeval, _ = layer.apply(params, new_state, jnp.asarray(x),
+                           training=False)
+    tb.eval()
+    teval = tb(_j2t(x).permute(0, 3, 1, 2)).permute(0, 2, 3, 1)
+    np.testing.assert_allclose(np.asarray(jeval), teval.detach().numpy(),
+                               atol=1e-4)
+
+
+def test_maxpool_avgpool_match_torch():
+    r = np.random.RandomState(3)
+    x = r.randn(2, 8, 8, 3).astype(np.float32)
+    jmax = nn.SpatialMaxPooling(2, 2, 2, 2)
+    jo, _ = jmax.apply({}, {}, jnp.asarray(x))
+    to = torch.nn.functional.max_pool2d(
+        _j2t(x).permute(0, 3, 1, 2), 2).permute(0, 2, 3, 1)
+    np.testing.assert_allclose(np.asarray(jo), to.numpy(), atol=1e-6)
+
+    javg = nn.SpatialAveragePooling(2, 2, 2, 2)
+    jo, _ = javg.apply({}, {}, jnp.asarray(x))
+    to = torch.nn.functional.avg_pool2d(
+        _j2t(x).permute(0, 3, 1, 2), 2).permute(0, 2, 3, 1)
+    np.testing.assert_allclose(np.asarray(jo), to.numpy(), atol=1e-6)
+
+
+def test_lstm_matches_torch():
+    r = np.random.RandomState(4)
+    input_size, hidden = 6, 5
+    cell = nn.LSTM(input_size, hidden)
+    rec = nn.Recurrent(cell, return_sequences=True)
+    params, state = rec.init(jax.random.PRNGKey(0))
+    cp = params["cell"]
+
+    tl = torch.nn.LSTM(input_size, hidden, batch_first=True)
+    # ours: w_i (in, 4H), w_h (H, 4H), bias (4H) in i,f,g,o order?
+    # torch: weight_ih (4H, in) in i,f,g,o order
+    gates = ["i", "f", "g", "o"]
+    if "w_i" in cp:
+        wi = np.asarray(cp["w_i"]).T
+        wh = np.asarray(cp["w_h"]).T
+        b = np.asarray(cp["bias"])
+    else:
+        wi = np.concatenate([np.asarray(cp[f"w_i{g}"]).T for g in gates], 0)
+        wh = np.concatenate([np.asarray(cp[f"w_h{g}"]).T for g in gates], 0)
+        b = np.concatenate([np.asarray(cp[f"b_{g}"]) for g in gates], 0)
+    with torch.no_grad():
+        tl.weight_ih_l0.copy_(_j2t(wi))
+        tl.weight_hh_l0.copy_(_j2t(wh))
+        tl.bias_ih_l0.copy_(_j2t(b))
+        tl.bias_hh_l0.zero_()
+    x = r.randn(3, 7, input_size).astype(np.float32)
+    jo, _ = rec.apply(params, state, jnp.asarray(x))
+    to, _ = tl(_j2t(x))
+    np.testing.assert_allclose(np.asarray(jo), to.detach().numpy(),
+                               atol=1e-5)
+
+
+def test_activations_match_torch():
+    r = np.random.RandomState(5)
+    x = r.randn(4, 10).astype(np.float32) * 3
+    pairs = [
+        (nn.ReLU(), torch.nn.functional.relu),
+        (nn.Tanh(), torch.tanh),
+        (nn.Sigmoid(), torch.sigmoid),
+        (nn.ELU(), torch.nn.functional.elu),
+        (nn.SoftPlus(), torch.nn.functional.softplus),
+        (nn.LogSoftMax(), lambda t: torch.log_softmax(t, -1)),
+        (nn.SoftMax(), lambda t: torch.softmax(t, -1)),
+        (nn.GELU(), torch.nn.functional.gelu),
+        (nn.HardTanh(), torch.nn.functional.hardtanh),
+        (nn.LeakyReLU(), torch.nn.functional.leaky_relu),
+    ]
+    for jlayer, tfn in pairs:
+        jo, _ = jlayer.apply({}, {}, jnp.asarray(x))
+        to = tfn(_j2t(x))
+        np.testing.assert_allclose(
+            np.asarray(jo), to.numpy(), atol=2e-5,
+            err_msg=type(jlayer).__name__)
+
+
+def test_criterions_match_torch():
+    r = np.random.RandomState(6)
+    logits = r.randn(8, 5).astype(np.float32)
+    target = r.randint(0, 5, 8).astype(np.int64)
+    logp = jax.nn.log_softmax(jnp.asarray(logits))
+
+    jl = nn.ClassNLLCriterion().forward(logp, jnp.asarray(target, jnp.int32))
+    tl = torch.nn.functional.nll_loss(
+        torch.log_softmax(_j2t(logits), -1), _j2t(target))
+    np.testing.assert_allclose(float(jl), float(tl), atol=1e-5)
+
+    pred = r.randn(8, 5).astype(np.float32)
+    tgt = r.randn(8, 5).astype(np.float32)
+    jm = nn.MSECriterion().forward(jnp.asarray(pred), jnp.asarray(tgt))
+    tm = torch.nn.functional.mse_loss(_j2t(pred), _j2t(tgt))
+    np.testing.assert_allclose(float(jm), float(tm), atol=1e-5)
+
+    p = 1 / (1 + np.exp(-pred))
+    t01 = (tgt > 0).astype(np.float32)
+    jb = nn.BCECriterion().forward(jnp.asarray(p), jnp.asarray(t01))
+    tb = torch.nn.functional.binary_cross_entropy(_j2t(p), _j2t(t01))
+    np.testing.assert_allclose(float(jb), float(tb), atol=1e-5)
+
+    js = nn.SmoothL1Criterion().forward(jnp.asarray(pred), jnp.asarray(tgt))
+    ts = torch.nn.functional.smooth_l1_loss(_j2t(pred), _j2t(tgt))
+    np.testing.assert_allclose(float(js), float(ts), atol=1e-5)
+
+
+def test_layernorm_matches_torch():
+    r = np.random.RandomState(7)
+    layer = nn.LayerNormalization(12)
+    params, state = layer.init(jax.random.PRNGKey(0))
+    tl = torch.nn.LayerNorm(12, eps=layer.eps)
+    with torch.no_grad():
+        tl.weight.copy_(_j2t(params["weight"]).reshape(-1))
+        tl.bias.copy_(_j2t(params["bias"]).reshape(-1))
+    x = r.randn(4, 9, 12).astype(np.float32)
+    jo, _ = layer.apply(params, state, jnp.asarray(x))
+    to = tl(_j2t(x))
+    np.testing.assert_allclose(np.asarray(jo), to.detach().numpy(),
+                               atol=1e-5)
+
+
+def test_embedding_matches_torch():
+    r = np.random.RandomState(8)
+    layer = nn.LookupTable(20, 6)
+    params, state = layer.init(jax.random.PRNGKey(0))
+    te = torch.nn.Embedding(20, 6)
+    with torch.no_grad():
+        te.weight.copy_(_j2t(params["weight"]))
+    idx = r.randint(0, 20, (3, 5))
+    jo, _ = layer.apply(params, state, jnp.asarray(idx, jnp.int32))
+    to = te(_j2t(idx.astype(np.int64)))
+    np.testing.assert_allclose(np.asarray(jo), to.detach().numpy(),
+                               atol=1e-6)
