@@ -93,10 +93,30 @@ class _Reader:
             if ndim == 0 or not sizes:
                 arr = np.asarray([], _TENSOR_DTYPES[cls])
             else:
-                arr = np.lib.stride_tricks.as_strided(
-                    flat[offset:],
-                    shape=sizes,
-                    strides=[s * flat.itemsize for s in strides]).copy()
+                # Bounds-check file-controlled geometry before as_strided:
+                # a malformed .t7 must not trigger out-of-bounds reads.
+                if offset < 0 or any(s < 0 for s in sizes):
+                    raise ValueError(
+                        f"t7 tensor has negative offset/size: "
+                        f"offset={offset} sizes={sizes}")
+                if any(s == 0 for s in sizes):
+                    arr = np.zeros(sizes, _TENSOR_DTYPES[cls])
+                else:
+                    max_index = offset + sum(
+                        (sz - 1) * st for sz, st in zip(sizes, strides)
+                        if st > 0)
+                    min_index = offset + sum(
+                        (sz - 1) * st for sz, st in zip(sizes, strides)
+                        if st < 0)
+                    if min_index < 0 or max_index >= flat.size:
+                        raise ValueError(
+                            f"t7 tensor geometry out of bounds: offset="
+                            f"{offset} sizes={sizes} strides={strides} "
+                            f"storage={flat.size}")
+                    arr = np.lib.stride_tricks.as_strided(
+                        flat[offset:],
+                        shape=sizes,
+                        strides=[s * flat.itemsize for s in strides]).copy()
             self.memo[idx] = arr
             return arr
         if cls in _STORAGE_DTYPES:

@@ -51,7 +51,8 @@ class SELU(_Elementwise):
 
 
 class GELU(_Elementwise):
-    fn = staticmethod(jax.nn.gelu)
+    # exact-erf GELU (torch default); jax.nn.gelu defaults to tanh approx
+    fn = staticmethod(lambda x: jax.nn.gelu(x, approximate=False))
 
 
 class Swish(_Elementwise):

@@ -219,6 +219,10 @@ class Optimizer:
         trees, meta = ckpt.load_checkpoint(snap)
         self._resume_trees = trees
         meta.pop("epoch_finished", None)  # don't re-fire per-epoch triggers
+        # counters rewind on resume — the validate/checkpoint dedup marks
+        # from the failed run must not suppress the replayed iterations
+        self.__dict__.pop("_last_val_neval", None)
+        self.__dict__.pop("_last_ckpt_neval", None)
         self.state.update(meta)
         log.info("resumed from %s at %s", snap, meta)
         return True
@@ -240,38 +244,46 @@ class Optimizer:
 
         self._eval_fn = self._build_eval_fn()
 
+        # Losses are NOT fetched per step: pending (iter, lr, loss) tuples
+        # buffer the device values and are flushed to host on the log
+        # cadence (or right before validation/checkpoint), so step
+        # dispatches run back-to-back and the chip never idles on a
+        # Python-side sync. (The reference's driver logs from returned
+        # accumulators, not per-replica syncs —
+        # optim/DistriOptimizer.scala:410-418.)
+        self._pending: List[tuple] = []
+        self._window_t0 = time.time()
+        self._window_records = 0
+
         while not self.end_when(st):
             epoch_start = time.time()
             epoch_records = 0
             ended_mid_epoch = False
             for x, y in self.dataset:
-                it_start = time.time()
                 lr = self.method.current_lr(st)
                 rng, sub = jax.random.split(rng)
                 xd, yd = self._place_batch(x, y)
                 params, model_state, slots, loss = step(
                     params, model_state, slots, xd, yd,
                     jnp.float32(lr), jnp.int32(st["neval"]), sub)
-                loss_f = float(loss)       # sync point, like reference's driver
                 n = x.shape[0]
                 st["neval"] += 1
                 st["records"] += n
-                st["loss"] = loss_f
-                wall = time.time() - it_start
+                # st["loss"] stays the last *flushed* float — storing the
+                # device value here would let loss-based triggers force a
+                # per-step sync. min_loss stopping granularity is therefore
+                # the log cadence.
                 epoch_records += n
-                if st["neval"] % self._log_every == 1:
-                    log.info("epoch %d iter %d loss %.4f lr %.5f %.1f rec/s",
-                             st["epoch"], st["neval"], loss_f, lr, n / max(wall, 1e-9))
-                if self._summary is not None:
-                    self._summary.add_scalar("Loss", loss_f, st["neval"])
-                    self._summary.add_scalar("LearningRate", lr, st["neval"])
-                    self._summary.add_scalar("Throughput", n / max(wall, 1e-9),
-                                             st["neval"])
+                self._window_records += n
+                self._pending.append((st["neval"], lr, loss))
+                if st["neval"] % self._log_every == 0:
+                    self._flush_metrics(st)
                 self._maybe_validate(params, model_state, st)
                 self._maybe_checkpoint(params, model_state, slots, st)
                 if self.end_when(st):
                     ended_mid_epoch = True
                     break
+            self._flush_metrics(st)
             if ended_mid_epoch:
                 # partial epoch: don't advance counters or fire per-epoch
                 # triggers — resume must replay the unfinished epoch
@@ -285,10 +297,35 @@ class Optimizer:
             self._maybe_checkpoint(params, model_state, slots, st)
             st["epoch_finished"] = False
 
+        self._flush_metrics(st)
+
         self.params, self.model_state, self.slots = params, model_state, slots
         return params, model_state
 
     # ------------------------------------------------------------- internals
+    def _flush_metrics(self, st):
+        """Fetch pending device losses (blocks only until the last dispatched
+        step completes), emit the log line + summary scalars, and reset the
+        throughput window."""
+        pending = getattr(self, "_pending", None)
+        if not pending:
+            return
+        dt = time.time() - self._window_t0
+        rate = self._window_records / max(dt, 1e-9)
+        losses = jax.device_get([p[2] for p in pending])
+        last_iter, last_lr = pending[-1][0], pending[-1][1]
+        st["loss"] = float(losses[-1])
+        log.info("epoch %d iter %d loss %.4f lr %.5f %.1f rec/s",
+                 st["epoch"], last_iter, st["loss"], last_lr, rate)
+        if self._summary is not None:
+            for (neval, lr, _), loss_f in zip(pending, losses):
+                self._summary.add_scalar("Loss", float(loss_f), neval)
+                self._summary.add_scalar("LearningRate", lr, neval)
+                self._summary.add_scalar("Throughput", rate, neval)
+        self._pending = []
+        self._window_t0 = time.time()
+        self._window_records = 0
+
     def _maybe_validate(self, params, model_state, st):
         if self.val_trigger is None or not self.val_trigger(st):
             return
@@ -297,6 +334,7 @@ class Optimizer:
         if getattr(self, "_last_val_neval", -1) == st["neval"]:
             return
         self._last_val_neval = st["neval"]
+        self._flush_metrics(st)
         from bigdl_tpu.optim.metrics import evaluate
         totals = evaluate(self.model, params, model_state, self.val_dataset,
                           self.val_methods, apply_fn=self._eval_fn)
@@ -314,6 +352,7 @@ class Optimizer:
         if getattr(self, "_last_ckpt_neval", -1) == st["neval"]:
             return
         self._last_ckpt_neval = st["neval"]
+        self._flush_metrics(st)
         path = f"{self.ckpt_path}/snapshot-{st['neval']}"
         meta = {k: v for k, v in st.items()
                 if isinstance(v, (int, float, bool, str))}
@@ -355,7 +394,14 @@ class Optimizer:
                     raise
                 log.warning("training failed (%s); retry %d/%d from latest "
                             "checkpoint", e, len(failures), retries)
-                self.resume(self.ckpt_path)
+                if not self.resume(self.ckpt_path):
+                    # no snapshot yet — discard the mutated counters from the
+                    # failed run so triggers/progress restart from scratch
+                    log.warning("no snapshot found; retrying from scratch")
+                    self.state = {"epoch": 0, "neval": 0, "records": 0}
+                    self.__dict__.pop("_resume_trees", None)
+                    self.__dict__.pop("_last_val_neval", None)
+                    self.__dict__.pop("_last_ckpt_neval", None)
 
 
 LocalOptimizer = Optimizer

@@ -101,8 +101,12 @@ def normalize_u8_batch(images: np.ndarray, mean, std) -> np.ndarray:
     available (reference: the assembly loop of MTImageFeatureToBatch)."""
     images = np.ascontiguousarray(images, np.uint8)
     n, h, w, c = images.shape
-    mean = np.ascontiguousarray(mean, np.float32)
-    std = np.ascontiguousarray(std, np.float32)
+    # Broadcast to per-channel vectors before the ctypes call — the native
+    # loop indexes mean[ch]/std[ch] and must never read past the buffer.
+    mean = np.ascontiguousarray(
+        np.broadcast_to(np.asarray(mean, np.float32), (c,)))
+    std = np.ascontiguousarray(
+        np.broadcast_to(np.asarray(std, np.float32), (c,)))
     lib = _load_native()
     if lib is not None and c <= 16:
         out = np.empty((n, h, w, c), np.float32)

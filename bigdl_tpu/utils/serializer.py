@@ -26,6 +26,48 @@ import numpy as np
 
 FORMAT_VERSION = 1
 
+# Modules whose classes a checkpoint pickle may reference. The reference
+# format (ModuleSerializer protobuf) is declarative with no code-execution
+# surface; we approximate that by refusing to unpickle anything outside the
+# framework's own namespace + numpy array reconstruction.
+_SAFE_MODULE_PREFIXES = ("bigdl_tpu.",)
+_SAFE_GLOBALS = {
+    ("builtins", "set"), ("builtins", "frozenset"), ("builtins", "slice"),
+    ("builtins", "complex"), ("builtins", "range"), ("builtins", "bytearray"),
+    ("collections", "OrderedDict"), ("collections", "defaultdict"),
+    ("numpy", "ndarray"), ("numpy", "dtype"),
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy._core.multiarray", "scalar"),
+    # jax.Array leaves held as module attributes pickle via this pair
+    ("jax._src.array", "_reconstruct_array"),
+    ("jax.numpy", "array"),
+}
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        if (module, name) in _SAFE_GLOBALS or any(
+                module == p.rstrip(".") or module.startswith(p)
+                for p in _SAFE_MODULE_PREFIXES):
+            return super().find_class(module, name)
+        # numpy scalar/dtype *classes* (numpy.float32, numpy.bool_, dtype
+        # metaclasses…) are data, not code — allow any type from the numpy
+        # root namespace, nothing callable that isn't a class.
+        if module in ("numpy", "numpy.dtypes"):
+            obj = super().find_class(module, name)
+            if isinstance(obj, type):
+                return obj
+        raise pickle.UnpicklingError(
+            f"checkpoint pickle references disallowed global "
+            f"{module}.{name}; only bigdl_tpu classes and numpy array "
+            f"reconstruction are permitted")
+
+
+def _safe_loads(data: bytes):
+    return _RestrictedUnpickler(io.BytesIO(data)).load()
+
 
 def _flatten(tree, prefix="", empties=None) -> Dict[str, Any]:
     out = {}
@@ -90,7 +132,7 @@ def load_module(path: str) -> Tuple[Any, Dict, Dict]:
             raise ValueError(
                 f"checkpoint format {meta['format_version']} is newer than "
                 f"supported {FORMAT_VERSION}")
-        module = pickle.loads(zf.read("module.pkl"))
+        module = _safe_loads(zf.read("module.pkl"))
         npz = np.load(io.BytesIO(zf.read("arrays.npz")))
         leaves = {k.replace("|", "/"): npz[k] for k in npz.files}
     flat = {}
