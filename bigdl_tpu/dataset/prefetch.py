@@ -60,6 +60,21 @@ def prefetch_to_device(it: Iterable, size: Optional[int] = None,
         yield item
 
 
+class PrefetchDataSet:
+    """Wrap an epoch-iterable dataset so each epoch's batches stream through
+    `prefetch_to_device` — the trainer sees device-resident batches while
+    the host pipeline runs ahead."""
+
+    def __init__(self, dataset, size: Optional[int] = None, sharding=None):
+        self.dataset, self.size, self.sharding = dataset, size, sharding
+
+    def __iter__(self):
+        return prefetch_to_device(self.dataset, self.size, self.sharding)
+
+    def __getattr__(self, name):          # delegate len/num_records/...
+        return getattr(self.dataset, name)
+
+
 class MTBatchPipeline:
     """Multithreaded per-sample transform → batch assembly (reference:
     MTImageFeatureToBatch.scala — N transformer threads filling one batch

@@ -25,6 +25,15 @@ from bigdl_tpu.utils.platform import force_cpu_if_requested
 
 def _common(p: argparse.ArgumentParser):
     p.add_argument("-f", "--folder", default=None, help="dataset folder")
+    p.add_argument("--data", default=None,
+                   help="record-shard glob (bigdl_tpu.dataset.sharded) — "
+                        "the ImageNet seq-file path; overrides --folder")
+    p.add_argument("--data-val", default=None, help="validation shard glob")
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--workers", type=int, default=None,
+                   help="data-loader decode threads")
+    p.add_argument("--crop", type=int, default=None,
+                   help="input crop size for shard datasets (default 224)")
     p.add_argument("-b", "--batch-size", type=int, default=None)
     p.add_argument("-e", "--max-epoch", type=int, default=None)
     p.add_argument("--max-iter", type=int, default=None)
@@ -96,9 +105,55 @@ def train_lenet(args):
     return _finish(opt, args, model, "lenet")
 
 
+def _sharded_imagenet(args, bs, crop=None):
+    """(train_ds, val_ds|None) from record shards — the reference's
+    SeqFileFolder ImageNet ingestion (dataset/DataSet.scala:326-660)."""
+    crop = crop or getattr(args, "crop", None) or 224
+    from bigdl_tpu.dataset.prefetch import PrefetchDataSet
+    from bigdl_tpu.dataset.sharded import (ShardedRecordDataset,
+                                           imagenet_eval_transform,
+                                           imagenet_train_transform)
+    train = PrefetchDataSet(ShardedRecordDataset(
+        args.data, bs, transform=imagenet_train_transform(crop),
+        num_workers=args.workers))
+    val = None
+    if args.data_val:
+        val = PrefetchDataSet(ShardedRecordDataset(
+            args.data_val, bs, transform=imagenet_eval_transform(crop),
+            shuffle=False, drop_last=False, num_workers=args.workers))
+    return train, val
+
+
+def train_resnet_imagenet(args):
+    """ResNet-50 on ImageNet record shards (reference:
+    models/resnet/TrainImageNet.scala — the BASELINE north-star config)."""
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.optim.local import Optimizer
+    from bigdl_tpu.optim.method import SGD
+    from bigdl_tpu.optim.metrics import Top1Accuracy, Top5Accuracy
+    from bigdl_tpu.optim.schedule import Poly
+    from bigdl_tpu.optim.trigger import Trigger
+    from bigdl_tpu.models import resnet
+
+    bs = args.batch_size or 64
+    ds, val = _sharded_imagenet(args, bs)
+    model = resnet.build(depth=args.depth if args.depth >= 18 else 50,
+                         class_num=args.num_classes)
+    method = _method(args, SGD(0.1, momentum=0.9, weight_decay=1e-4,
+                               learning_rate_schedule=Poly(2.0, 90000)))
+    opt = Optimizer(model, ds, nn.ClassNLLCriterion(), method)
+    opt.set_end_when(_end_trigger(args, 1))
+    if val is not None:
+        opt.set_validation(Trigger.every_epoch(), val,
+                           [Top1Accuracy(), Top5Accuracy()])
+    return _finish(opt, args, model, "resnet-imagenet")
+
+
 def train_resnet(args):
     """(reference: models/resnet/Train.scala — BASELINE config 2:
-    ResNet on CIFAR-10)."""
+    ResNet on CIFAR-10; with --data, the ImageNet shard path)."""
+    if args.data:
+        return train_resnet_imagenet(args)
     import bigdl_tpu.nn as nn
     from bigdl_tpu.dataset import ArrayDataSet, cifar
     from bigdl_tpu.dataset.vision import (ChannelNormalize, HFlip, ImageFrame,
@@ -139,20 +194,29 @@ def train_inception(args):
     from bigdl_tpu.optim.local import Optimizer
     from bigdl_tpu.optim.method import SGD
     from bigdl_tpu.optim.schedule import Poly
+    from bigdl_tpu.optim.metrics import Top1Accuracy, Top5Accuracy
+    from bigdl_tpu.optim.trigger import Trigger
     from bigdl_tpu.models import inception
 
-    n = min(args.synthetic_size, 64)
-    r = np.random.RandomState(0)
-    x = r.randn(n, 224, 224, 3).astype(np.float32)
-    y = r.randint(0, 1000, n).astype(np.int32)
     bs = args.batch_size or 8
-    ds = ArrayDataSet(x, y, bs, drop_last=True)
-    model = inception.build(1000)
+    if args.data:
+        ds, val = _sharded_imagenet(args, bs)
+        classes = args.num_classes
+    else:
+        n = min(args.synthetic_size, 64)
+        r = np.random.RandomState(0)
+        x = r.randn(n, 224, 224, 3).astype(np.float32)
+        y = r.randint(0, 1000, n).astype(np.int32)
+        ds, val, classes = ArrayDataSet(x, y, bs, drop_last=True), None, 1000
+    model = inception.build(classes)
     method = _method(args, SGD(
         0.0898, momentum=0.9, weight_decay=1e-4,
         learning_rate_schedule=Poly(0.5, 62000)))
     opt = Optimizer(model, ds, nn.ClassNLLCriterion(), method)
     opt.set_end_when(_end_trigger(args, 1))
+    if args.data and val is not None:
+        opt.set_validation(Trigger.every_epoch(), val,
+                           [Top1Accuracy(), Top5Accuracy()])
     return _finish(opt, args, model, "inception-v1")
 
 
