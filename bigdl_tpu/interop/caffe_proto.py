@@ -1,0 +1,471 @@
+"""Caffe prototxt topology import — build the whole model from the net
+definition, then load .caffemodel weights into it (reference:
+utils/caffe/CaffeLoader.scala:544-561 `loadCaffe` = createCaffeModel from
+prototxt + copyParameters; per-layer mapping in utils/caffe/Converter.scala
+and V1LayerConverter.scala).
+
+The prototxt is protobuf text format — parsed here with a small tokenizer
+(no generated code, same spirit as interop/protowire.py for the binary
+format). Shape is propagated layer by layer so InnerProduct weights get the
+NCHW→NHWC flatten permutation automatically (the reference derives this from
+the graph too; round-1's hand-supplied `fc_input_shapes` is gone).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.container import Graph, Input
+from bigdl_tpu.core.module import Module, ParamSpec
+from bigdl_tpu.core import init as initializers
+
+
+# ------------------------------------------------------- text-format parser
+_TOKEN = re.compile(r"""
+    \s+ | \#[^\n]* |                      # whitespace / comments (skipped)
+    (?P<brace>[{}])    |
+    (?P<colon>:)       |
+    (?P<string>"(?:[^"\\]|\\.)*")  |
+    (?P<value>[^\s{}:"#]+)
+""", re.VERBOSE)
+
+
+def _tokenize(text: str):
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if m is None:
+            raise ValueError(f"prototxt parse error at byte {pos}: "
+                             f"{text[pos:pos + 40]!r}")
+        pos = m.end()
+        for kind in ("brace", "colon", "string", "value"):
+            if m.group(kind) is not None:
+                yield kind, m.group(kind)
+                break
+
+
+def _coerce(raw: str):
+    if raw in ("true", "True"):
+        return True
+    if raw in ("false", "False"):
+        return False
+    try:
+        return int(raw)
+    except ValueError:
+        pass
+    try:
+        return float(raw)
+    except ValueError:
+        return raw                          # enum identifier
+
+
+class PText(dict):
+    """Parsed text-proto message: key → list of values (str/num/PText)."""
+
+    def add(self, key, value):
+        self.setdefault(key, []).append(value)
+
+    def one(self, key, default=None):
+        v = self.get(key)
+        return v[0] if v else default
+
+    def many(self, key) -> list:
+        return self.get(key, [])
+
+    def msg(self, key) -> "PText":
+        return self.one(key, PText())
+
+
+def parse_prototxt(text: str) -> PText:
+    tokens = list(_tokenize(text))
+    i = 0
+
+    def parse_msg(depth=0) -> PText:
+        nonlocal i
+        msg = PText()
+        while i < len(tokens):
+            kind, tok = tokens[i]
+            if kind == "brace" and tok == "}":
+                i += 1
+                return msg
+            if kind not in ("value",):
+                raise ValueError(f"expected field name, got {tok!r}")
+            key = tok
+            i += 1
+            kind, tok = tokens[i]
+            if kind == "colon":
+                i += 1
+                kind, tok = tokens[i]
+                if kind == "string":
+                    msg.add(key, tok[1:-1])
+                elif kind == "value":
+                    msg.add(key, _coerce(tok))
+                elif kind == "brace" and tok == "{":   # key: { ... }
+                    i += 1
+                    msg.add(key, parse_msg(depth + 1))
+                    continue
+                else:
+                    raise ValueError(f"bad value token {tok!r} for {key}")
+                i += 1
+            elif kind == "brace" and tok == "{":
+                i += 1
+                msg.add(key, parse_msg(depth + 1))
+            else:
+                raise ValueError(f"expected ':' or '{{' after {key!r}")
+        if depth != 0:
+            raise ValueError("unbalanced braces in prototxt")
+        return msg
+
+    return parse_msg()
+
+
+# --------------------------------------------------------- converter module
+class Scale(Module):
+    """Per-channel scale+shift (caffe Scale layer; reference:
+    utils/caffe/Converter.scala fromCaffeScale → CMul/CAdd)."""
+
+    def __init__(self, n: int, bias: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.n, self.bias = n, bias
+
+    def param_specs(self):
+        specs = {"weight": ParamSpec((self.n,), initializers.ones)}
+        if self.bias:
+            specs["bias"] = ParamSpec((self.n,), initializers.zeros)
+        return specs
+
+    def forward(self, params, x, **_):
+        y = x * params["weight"]
+        if self.bias:
+            y = y + params["bias"]
+        return y
+
+
+# ------------------------------------------------------------ shape helpers
+def _conv_out(size, k, s, p, d=1):
+    keff = (k - 1) * d + 1
+    return (size + 2 * p - keff) // s + 1
+
+
+def _pool_out(size, k, s, p):
+    # caffe pooling uses ceil, clamped so the last window starts in-bounds —
+    # the same rule the pooling layers implement
+    from bigdl_tpu.nn.pooling import ceil_pool_out
+    return ceil_pool_out(size, k, s, p)
+
+
+# V1 (layers { type: CONVOLUTION }) enum → V2 string names
+_V1_TYPES = {
+    "CONVOLUTION": "Convolution", "INNER_PRODUCT": "InnerProduct",
+    "RELU": "ReLU", "POOLING": "Pooling", "LRN": "LRN",
+    "DROPOUT": "Dropout", "SOFTMAX": "Softmax",
+    "SOFTMAX_LOSS": "Softmax", "CONCAT": "Concat", "ELTWISE": "Eltwise",
+    "SIGMOID": "Sigmoid", "TANH": "TanH", "FLATTEN": "Flatten",
+    "DATA": "Input", "ACCURACY": "_skip", "SPLIT": "Split",
+}
+
+
+def _first_int(param: PText, key: str, default: int) -> int:
+    v = param.one(key)
+    return int(v) if v is not None else default
+
+
+def _hw(param: PText, base: str, default: int) -> Tuple[int, int]:
+    """caffe kernel/stride/pad can be scalar (+repeated) or _h/_w."""
+    h = param.one(f"{base}_h")
+    w = param.one(f"{base}_w")
+    if h is not None or w is not None:
+        return int(h or default), int(w or default)
+    v = param.one(base, default)
+    return int(v), int(v)
+
+
+class CaffeNet:
+    """Built model: module graph + params/state with loaded weights."""
+
+    def __init__(self, module, params, state, input_shape, name_map):
+        self.module, self.params, self.state = module, params, state
+        self.input_shape = input_shape        # NHWC
+        self.name_map = name_map              # caffe layer name -> graph key
+
+
+def load(prototxt_path: str, caffemodel_path: Optional[str] = None,
+         input_shape: Optional[Sequence[int]] = None,
+         rng=None) -> CaffeNet:
+    """prototxt (+ optional caffemodel weights) → CaffeNet.
+
+    `input_shape` overrides the prototxt input dims; give (H, W, C).
+    (reference: CaffeLoader.scala:544 `load(model, defPath, modelPath)`.)"""
+    with open(prototxt_path) as fh:
+        net = parse_prototxt(fh.read())
+
+    layers = net.many("layer") or net.many("layers")
+    if not layers:
+        raise ValueError("prototxt has no layer/layers entries")
+
+    # ---- input declaration: top-level input/input_dim | input_shape | Input
+    input_names = [n for n in net.many("input")]
+    dims = [int(d) for d in net.many("input_dim")]
+    if not dims and net.one("input_shape") is not None:
+        dims = [int(d) for d in net.msg("input_shape").many("dim")]
+    if input_shape is not None:
+        h, w, c = input_shape
+    elif len(dims) >= 4:
+        c, h, w = dims[1], dims[2], dims[3]
+    else:
+        h = w = c = None                      # must come from an Input layer
+
+    blobs: Dict[str, object] = {}             # caffe blob name -> graph Node
+    shapes: Dict[str, tuple] = {}             # blob name -> (H, W, C) | (F,)
+    weights: List[tuple] = []                 # (node, params, state)
+    name_map_nodes: List[tuple] = []
+
+    def declare_input(blob, hh, ww, cc):
+        node = Input()
+        blobs[blob] = node
+        shapes[blob] = (hh, ww, cc)
+        return node
+
+    inputs = []
+    if input_names and h is not None:
+        inputs.append(declare_input(input_names[0], h, w, c))
+
+    def mk(blob_out, module, parents, out_shape, p_over=None, s_over=None,
+           lname=None):
+        node = module(*parents)
+        blobs[blob_out] = node
+        shapes[blob_out] = out_shape
+        if p_over or s_over:
+            weights.append((node, p_over or {}, s_over or {}))
+        if lname:
+            name_map_nodes.append((lname, node))
+        return node
+
+    model_blobs: Dict[str, List[np.ndarray]] = {}
+    if caffemodel_path:
+        from bigdl_tpu.interop.caffe import parse_caffemodel
+        model_blobs = parse_caffemodel(caffemodel_path)
+
+    def blob_w(lname, idx):
+        bs = model_blobs.get(lname)
+        return bs[idx] if bs and len(bs) > idx else None
+
+    last_top = None
+    for layer in layers:
+        ltype = layer.one("type", "")
+        if not isinstance(ltype, str):
+            ltype = str(ltype)
+        ltype = _V1_TYPES.get(ltype, ltype)
+        lname = layer.one("name", ltype)
+        bottoms = [str(b) for b in layer.many("bottom")]
+        tops = [str(t) for t in layer.many("top")]
+        top = tops[0] if tops else lname
+        include = layer.one("include")
+        if include is not None and include.one("phase") == "TEST":
+            continue
+        if ltype in ("_skip", "Accuracy", "Silence"):
+            continue
+        if ltype == "Input" or (not bottoms and ltype in ("Data", "HDF5Data")):
+            p = layer.msg("input_param")
+            sh = p.msg("shape")
+            ldims = [int(d) for d in sh.many("dim")]
+            if input_shape is not None:
+                ih, iw, ic = input_shape
+            elif len(ldims) >= 4:
+                ic, ih, iw = ldims[1], ldims[2], ldims[3]
+            else:
+                raise ValueError(f"Input layer {lname} without dims and no "
+                                 f"input_shape given")
+            inputs.append(declare_input(top, ih, iw, ic))
+            last_top = top
+            continue
+        if not bottoms:
+            continue
+        bot = bottoms[0]
+        if bot not in blobs:
+            raise ValueError(f"layer {lname}: bottom {bot!r} undefined — "
+                             f"unsupported topology or missing input decl")
+        parent = [blobs[b] for b in bottoms]
+        in_shape = shapes[bot]
+
+        if ltype == "Convolution":
+            p = layer.msg("convolution_param")
+            cout = _first_int(p, "num_output", 1)
+            if p.one("kernel_size") is not None:
+                kh = kw = int(p.one("kernel_size"))
+            else:                   # kernel_h/kernel_w spelling
+                kh, kw = _hw(p, "kernel", 1)
+            sh_, sw_ = _hw(p, "stride", 1)
+            ph_, pw_ = _hw(p, "pad", 0)
+            dil = _first_int(p, "dilation", 1)
+            group = _first_int(p, "group", 1)
+            bias = bool(p.one("bias_term", True))
+            ih, iw, ic = in_shape
+            oh = _conv_out(ih, kh, sh_, ph_, dil)
+            ow = _conv_out(iw, kw, sw_, pw_, dil)
+            if dil == 1:
+                m = nn.SpatialConvolution(ic, cout, kw, kh, sw_, sh_,
+                                          pw_, ph_, n_group=group, bias=bias)
+            else:
+                m = nn.SpatialDilatedConvolution(ic, cout, kw, kh, sw_, sh_,
+                                                 pw_, ph_, dil, dil,
+                                                 bias=bias)
+            p_over = {}
+            w0 = blob_w(lname, 0)
+            if w0 is not None:
+                # caffe (cout, cin/g, kh, kw) -> ours (kh, kw, cin/g, cout)
+                p_over["weight"] = np.transpose(w0, (2, 3, 1, 0))
+            b0 = blob_w(lname, 1)
+            if bias and b0 is not None:
+                p_over["bias"] = b0.reshape(-1)
+            mk(top, m, parent, (oh, ow, cout), p_over, lname=lname)
+        elif ltype == "InnerProduct":
+            p = layer.msg("inner_product_param")
+            nout = _first_int(p, "num_output", 1)
+            bias = bool(p.one("bias_term", True))
+            p_over = {}
+            w0 = blob_w(lname, 0)
+            if len(in_shape) == 3:
+                ih, iw, ic = in_shape
+                nin = ih * iw * ic
+                flat = mk(f"{top}__flat", nn.Flatten(), parent, (nin,))
+                parent = [flat]
+                if w0 is not None:
+                    # caffe rows index CHW flatten; ours flatten HWC
+                    w0 = (w0.reshape(nout, ic, ih, iw)
+                          .transpose(0, 2, 3, 1).reshape(nout, nin))
+            else:
+                nin = in_shape[0]
+            m = nn.Linear(nin, nout, bias=bias)
+            if w0 is not None:
+                p_over["weight"] = w0.T
+            b0 = blob_w(lname, 1)
+            if bias and b0 is not None:
+                p_over["bias"] = b0.reshape(-1)
+            mk(top, m, parent, (nout,), p_over, lname=lname)
+        elif ltype == "Pooling":
+            p = layer.msg("pooling_param")
+            pool = str(p.one("pool", "MAX"))
+            if p.one("global_pooling"):
+                ih, iw, ic = in_shape
+                m = (nn.GlobalAveragePooling2D() if pool == "AVE"
+                     else nn.SpatialAdaptiveMaxPooling(1, 1))
+                out_shape = (ic,) if pool == "AVE" else (1, 1, ic)
+                mk(top, m, parent, out_shape, lname=lname)
+            else:
+                if p.one("kernel_size") is not None:
+                    kh = kw = int(p.one("kernel_size"))
+                else:
+                    kh, kw = _hw(p, "kernel", 2)
+                sh_, sw_ = _hw(p, "stride", 1)
+                ph_, pw_ = _hw(p, "pad", 0)
+                ih, iw, ic = in_shape
+                oh, ow = _pool_out(ih, kh, sh_, ph_), _pool_out(iw, kw, sw_, pw_)
+                if pool == "AVE":
+                    m = nn.SpatialAveragePooling(kw, kh, sw_, sh_, pw_, ph_,
+                                                 ceil_mode=True,
+                                                 count_include_pad=True)
+                else:
+                    m = nn.SpatialMaxPooling(kw, kh, sw_, sh_, pw_, ph_,
+                                             ceil_mode=True)
+                mk(top, m, parent, (oh, ow, ic), lname=lname)
+        elif ltype == "ReLU":
+            mk(top, nn.ReLU(), parent, in_shape, lname=lname)
+        elif ltype == "Sigmoid":
+            mk(top, nn.Sigmoid(), parent, in_shape, lname=lname)
+        elif ltype == "TanH":
+            mk(top, nn.Tanh(), parent, in_shape, lname=lname)
+        elif ltype == "Dropout":
+            p = layer.msg("dropout_param")
+            ratio = float(p.one("dropout_ratio", 0.5))
+            mk(top, nn.Dropout(ratio), parent, in_shape, lname=lname)
+        elif ltype in ("Softmax", "SoftmaxWithLoss"):
+            mk(top, nn.SoftMax(axis=-1), parent, in_shape, lname=lname)
+        elif ltype == "LRN":
+            p = layer.msg("lrn_param")
+            size = _first_int(p, "local_size", 5)
+            alpha = float(p.one("alpha", 1.0))
+            beta = float(p.one("beta", 0.75))
+            k = float(p.one("k", 1.0))
+            mk(top, nn.SpatialCrossMapLRN(size, alpha, beta, k), parent,
+               in_shape, lname=lname)
+        elif ltype == "Concat":
+            p = layer.msg("concat_param")
+            axis = _first_int(p, "axis", 1)     # caffe NCHW channel axis
+            our_axis = -1 if axis == 1 else axis
+            ih, iw, _ = in_shape
+            csum = sum(shapes[b][-1] for b in bottoms)
+            mk(top, nn.JoinTable(our_axis), parent, (ih, iw, csum),
+               lname=lname)
+        elif ltype == "Eltwise":
+            p = layer.msg("eltwise_param")
+            op = str(p.one("operation", "SUM"))
+            m = {"SUM": nn.CAddTable, "PROD": nn.CMulTable,
+                 "MAX": nn.CMaxTable}[op]()
+            mk(top, m, parent, in_shape, lname=lname)
+        elif ltype == "BatchNorm":
+            ic = in_shape[-1]
+            m = nn.SpatialBatchNormalization(ic, eps=1e-5, affine=False)
+            s_over = {}
+            mean_b, var_b, sf = (blob_w(lname, 0), blob_w(lname, 1),
+                                 blob_w(lname, 2))
+            if mean_b is not None and sf is not None:
+                scale = 1.0 / sf.reshape(-1)[0] if sf.reshape(-1)[0] else 1.0
+                s_over = {"running_mean": mean_b.reshape(-1) * scale,
+                          "running_var": var_b.reshape(-1) * scale}
+            mk(top, m, parent, in_shape, None, s_over, lname=lname)
+        elif ltype == "Scale":
+            p = layer.msg("scale_param")
+            bias = bool(p.one("bias_term", False))
+            ic = in_shape[-1]
+            p_over = {}
+            w0, b0 = blob_w(lname, 0), blob_w(lname, 1)
+            if w0 is not None:
+                p_over["weight"] = w0.reshape(-1)
+            if bias and b0 is not None:
+                p_over["bias"] = b0.reshape(-1)
+            mk(top, Scale(ic, bias=bias), parent, in_shape, p_over,
+               lname=lname)
+        elif ltype == "Flatten":
+            ih, iw, ic = in_shape
+            mk(top, nn.Flatten(), parent, (ih * iw * ic,), lname=lname)
+        elif ltype == "Split":
+            for t in tops:                    # pure fan-out aliases
+                blobs[t] = blobs[bot]
+                shapes[t] = in_shape
+        else:
+            raise NotImplementedError(
+                f"caffe layer type {ltype!r} ({lname}) has no converter "
+                f"(reference: utils/caffe/Converter.scala)")
+        last_top = top
+
+    if not inputs:
+        raise ValueError("no input declaration found (input:/input_shape/"
+                         "Input layer) and no input_shape argument")
+    out_node = blobs[last_top]
+    g = Graph(inputs, [out_node])
+    params, state = g.init(rng if rng is not None else jax.random.PRNGKey(0))
+    for node, p_over, s_over in weights:
+        key = g._node_key[id(node)]
+        for kname, v in p_over.items():
+            params[key][kname] = jnp.asarray(np.ascontiguousarray(v))
+        for kname, v in s_over.items():
+            state[key][kname] = jnp.asarray(np.ascontiguousarray(v))
+    name_map = {nm: g._node_key[id(n)] for nm, n in name_map_nodes
+                if id(n) in g._node_key}
+    first = inputs[0]
+    in_shape_nhwc = None
+    for blob, node in blobs.items():
+        if node is first and blob in shapes and len(shapes[blob]) == 3:
+            hh, ww, cc = shapes[blob]
+            in_shape_nhwc = (hh, ww, cc)
+            break
+    return CaffeNet(g, params, state, in_shape_nhwc, name_map)

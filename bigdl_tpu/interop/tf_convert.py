@@ -1,0 +1,279 @@
+"""TF GraphDef → trainable module graph (reference:
+utils/tf/TensorflowLoader.scala:201-358 — `buildBigDLModel` pattern-matches
+the parsed graph into BigDL layers so the imported model can be fine-tuned;
+per-op loaders live in utils/tf/loaders/).
+
+Where the interpreter (interop/tensorflow.py TFGraph.run) executes a frozen
+graph, this converter produces an `nn.Graph` whose weights are real params:
+the imported model composes with the trainer, `quantize()`, freeze masks,
+and the serializer like any hand-built model.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.container import Graph, Input, Node
+from bigdl_tpu.core.module import Module, ParamSpec
+from bigdl_tpu.core import init as initializers
+from bigdl_tpu.interop.tensorflow import TFGraph, TFNode
+
+
+# ------------------------------------------------ converter-private modules
+class BiasAdd(Module):
+    """Trainable bias (reference: nn/tf/BiasAdd.scala loader)."""
+
+    def __init__(self, n: int, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.n = n
+
+    def param_specs(self):
+        return {"bias": ParamSpec((self.n,), initializers.zeros)}
+
+    def forward(self, params, x, **_):
+        return x + params["bias"]
+
+
+class ConstPad(Module):
+    """Fixed zero padding from a TF Pad const operand."""
+
+    def __init__(self, pads: Sequence[Tuple[int, int]],
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.pads = [tuple(int(v) for v in p) for p in pads]
+
+    def forward(self, params, x, **_):
+        return jnp.pad(x, self.pads)
+
+
+class ReduceMean(Module):
+    """TF Mean over const axes."""
+
+    def __init__(self, axes: Sequence[int], keepdims: bool,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.axes, self.keepdims = tuple(int(a) for a in axes), keepdims
+
+    def forward(self, params, x, **_):
+        return jnp.mean(x, axis=self.axes, keepdims=self.keepdims)
+
+
+# ------------------------------------------------------------ const folding
+_ALIAS_OPS = ("Identity", "StopGradient", "Snapshot")
+
+
+def _const_value(g: TFGraph, name: str) -> Optional[np.ndarray]:
+    """Resolve Const (possibly through Identity chains); None if not const."""
+    node = g.nodes.get(name)
+    seen = set()
+    while node is not None and node.op in _ALIAS_OPS and node.inputs:
+        if node.name in seen:
+            return None
+        seen.add(node.name)
+        node = g.nodes.get(node.inputs[0])
+    if node is not None and node.op == "Const":
+        return node.attr_tensor("value")
+    return None
+
+
+def _pad_arg(pad: str) -> int:
+    return -1 if pad == "SAME" else 0
+
+
+# ------------------------------------------------------------- conversion
+def to_module(graph: TFGraph, inputs: Optional[Sequence[str]] = None,
+              outputs: Optional[Sequence[str]] = None,
+              rng=None):
+    """Convert a parsed GraphDef into (module, params, state, name_map).
+
+    `name_map` maps TF node names → Graph child keys (for freezing /
+    inspection). Unsupported ops raise NotImplementedError listing the op,
+    mirroring the reference's loader-not-found error
+    (TensorflowLoader.scala:358).
+    """
+    input_names = list(inputs) if inputs else graph.placeholders
+    if not input_names:
+        raise ValueError("graph has no Placeholder and no explicit inputs")
+    output_names = list(outputs) if outputs else [graph.order[-1]]
+
+    sym: Dict[str, Node] = {}
+    weights: List[Tuple[Node, Dict[str, np.ndarray], Dict[str, np.ndarray]]] = []
+    name_of_node: List[Tuple[str, Node]] = []
+
+    def is_data(name: str) -> bool:
+        return name in sym
+
+    for name in input_names:
+        sym[name] = Input()
+        name_of_node.append((name, sym[name]))
+
+    for name in graph.order:
+        if name in sym:
+            continue
+        node = graph.nodes[name]
+        if _const_value(graph, name) is not None:
+            continue                       # weight/shape operand, not a layer
+        data_ins = [i for i in node.inputs if is_data(i)]
+        if not data_ins:
+            continue                       # dead / const subgraph
+        built = _build_layer(graph, node, data_ins, sym, weights)
+        if built is not None:
+            sym[name] = built
+            name_of_node.append((name, built))
+
+    missing = [o for o in output_names if o not in sym]
+    if missing:
+        raise ValueError(f"outputs {missing} were not converted")
+    g = Graph([sym[i] for i in input_names],
+              [sym[o] for o in output_names])
+    params, state = g.init(rng if rng is not None else jax.random.PRNGKey(0))
+    for n, p_over, s_over in weights:
+        key = g._node_key[id(n)]
+        for k, v in p_over.items():
+            params[key][k] = jnp.asarray(v)
+        for k, v in s_over.items():
+            state[key][k] = jnp.asarray(v)
+    name_map = {nm: g._node_key[id(n)] for nm, n in name_of_node
+                if id(n) in g._node_key}
+    return g, params, state, name_map
+
+
+def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
+                 sym: Dict[str, Node], weights) -> Optional[Node]:
+    op = node.op
+    const = lambda i: _const_value(graph, node.inputs[i])
+    parent = [sym[i] for i in data_ins]
+
+    def mk(module, p_over=None, s_over=None, parents=parent):
+        n = module(*parents)
+        if p_over or s_over:
+            weights.append((n, p_over or {}, s_over or {}))
+        return n
+
+    if op in _ALIAS_OPS:
+        return sym[data_ins[0]]
+    if op == "Conv2D":
+        w = const(1)
+        if w is None:
+            raise NotImplementedError(f"Conv2D {node.name}: non-const filter")
+        strides = node.attr_ints("strides") or [1, 1, 1, 1]
+        pad = _pad_arg(node.attr_str("padding", "SAME"))
+        kh, kw, cin, cout = w.shape
+        m = nn.SpatialConvolution(cin, cout, kw, kh, strides[2], strides[1],
+                                  pad, pad, bias=False)
+        return mk(m, {"weight": w})
+    if op == "DepthwiseConv2dNative":
+        w = const(1)
+        if w is None:
+            raise NotImplementedError(
+                f"DepthwiseConv2dNative {node.name}: non-const filter")
+        strides = node.attr_ints("strides") or [1, 1, 1, 1]
+        pad = _pad_arg(node.attr_str("padding", "SAME"))
+        kh, kw, cin, mult = w.shape
+        m = nn.SpatialConvolution(cin, cin * mult, kw, kh,
+                                  strides[2], strides[1], pad, pad,
+                                  n_group=cin, bias=False)
+        return mk(m, {"weight": w.reshape(kh, kw, 1, cin * mult)})
+    if op == "MatMul":
+        w = const(1)
+        if w is None:
+            raise NotImplementedError(f"MatMul {node.name}: non-const weight")
+        tb = node.attrs.get("transpose_b")
+        if tb is not None and tb.int(5):
+            w = w.T
+        m = nn.Linear(w.shape[0], w.shape[1], bias=False)
+        return mk(m, {"weight": w})
+    if op == "BiasAdd" or (op in ("Add", "AddV2") and const(1) is not None
+                           and np.asarray(const(1)).ndim <= 1):
+        b = const(1)
+        if b is None:                      # tensor + tensor
+            return mk(nn.CAddTable())
+        b = np.asarray(b).reshape(-1)
+        return mk(BiasAdd(b.shape[0]), {"bias": b})
+    if op in ("Add", "AddV2"):
+        return mk(nn.CAddTable())
+    if op == "Mul":
+        return mk(nn.CMulTable())
+    if op in ("FusedBatchNorm", "FusedBatchNormV3"):
+        scale = const(1)
+        offset = const(2)
+        mean = const(3)
+        var = const(4)
+        if any(v is None for v in (scale, offset, mean, var)):
+            raise NotImplementedError(
+                f"{op} {node.name}: non-const moments")
+        a = node.attrs.get("epsilon")
+        eps = a.float(4, 1e-3) if a is not None else 1e-3
+        m = nn.SpatialBatchNormalization(scale.shape[0], eps=eps)
+        return mk(m, {"weight": scale, "bias": offset},
+                  {"running_mean": mean, "running_var": var})
+    if op == "MaxPool":
+        ks = node.attr_ints("ksize") or [1, 2, 2, 1]
+        st = node.attr_ints("strides") or [1, 2, 2, 1]
+        pad = _pad_arg(node.attr_str("padding", "VALID"))
+        return mk(nn.SpatialMaxPooling(ks[2], ks[1], st[2], st[1], pad, pad))
+    if op == "AvgPool":
+        ks = node.attr_ints("ksize") or [1, 2, 2, 1]
+        st = node.attr_ints("strides") or [1, 2, 2, 1]
+        pad = _pad_arg(node.attr_str("padding", "VALID"))
+        return mk(nn.SpatialAveragePooling(ks[2], ks[1], st[2], st[1],
+                                           pad, pad))
+    if op == "Relu":
+        return mk(nn.ReLU())
+    if op == "Relu6":
+        return mk(nn.ReLU6())
+    if op == "Sigmoid":
+        return mk(nn.Sigmoid())
+    if op == "Tanh":
+        return mk(nn.Tanh())
+    if op == "Softmax":
+        return mk(nn.SoftMax(axis=-1))
+    if op == "Reshape":
+        shape = const(1)
+        if shape is None:
+            raise NotImplementedError(f"Reshape {node.name}: dynamic shape")
+        shape = [int(d) for d in np.asarray(shape).reshape(-1)]
+        if shape and shape[0] in (-1, 0):
+            if len(shape) == 2 and shape[1] == -1:
+                return mk(nn.Flatten())
+            return mk(nn.Reshape(shape[1:], batch_mode=True))
+        return mk(nn.Reshape(shape, batch_mode=False))
+    if op == "Squeeze":
+        dims = node.attr_ints("squeeze_dims")
+        return mk(nn.Squeeze(tuple(dims) if dims else None))
+    if op == "ExpandDims":
+        axis = const(1)
+        return mk(nn.Unsqueeze(int(np.asarray(axis))))
+    if op == "ConcatV2":
+        axis = _const_value(graph, node.inputs[-1])
+        return mk(nn.JoinTable(int(np.asarray(axis))))
+    if op == "Mean":
+        axes = const(1)
+        if axes is None:
+            raise NotImplementedError(f"Mean {node.name}: dynamic axes")
+        axes = tuple(int(a) for a in np.asarray(axes).reshape(-1))
+        keep = node.attrs.get("keep_dims")
+        keepdims = bool(keep.int(5)) if keep is not None else False
+        if axes == (1, 2) and not keepdims:
+            return mk(nn.GlobalAveragePooling2D())
+        return mk(ReduceMean(axes, keepdims))
+    if op == "Pad":
+        pads = const(1)
+        if pads is None:
+            raise NotImplementedError(f"Pad {node.name}: dynamic paddings")
+        return mk(ConstPad(np.asarray(pads).tolist()))
+    raise NotImplementedError(
+        f"TF op {op!r} (node {node.name}) has no module loader "
+        f"(reference: utils/tf/loaders/)")
+
+
+def load_model(path_or_bytes, inputs=None, outputs=None):
+    """Frozen GraphDef file/bytes → (module, params, state, name_map)."""
+    from bigdl_tpu.interop.tensorflow import load_graphdef
+    return to_module(load_graphdef(path_or_bytes), inputs, outputs)

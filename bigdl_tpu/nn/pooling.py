@@ -53,11 +53,21 @@ class SpatialMaxPooling(Module):
             (1, self.dh, self.dw, 1), self._padding(x))
 
 
-def _ceil_extra(size, k, d, p):
-    """Extra one-sided pad so output size matches ceil division."""
+def ceil_pool_out(size, k, d, p):
+    """Ceil-mode pooled output size. Torch rule (reference
+    SpatialMaxPooling.scala follows it): the last window must START inside
+    the input + left padding, else the ceil cell is dropped. Shared with the
+    caffe importer's shape propagation (interop/caffe_proto.py)."""
     import math
-    out_ceil = math.ceil((size + 2 * p - k) / d) + 1
-    needed = (out_ceil - 1) * d + k - 2 * p
+    out = math.ceil((size + 2 * p - k) / d) + 1
+    if (out - 1) * d >= size + p:
+        out -= 1
+    return out
+
+
+def _ceil_extra(size, k, d, p):
+    """Extra one-sided pad so reduce_window matches ceil_pool_out."""
+    needed = (ceil_pool_out(size, k, d, p) - 1) * d + k - 2 * p
     return max(0, needed - size)
 
 

@@ -1,0 +1,447 @@
+"""Golden-model parity, part 2 — the 'hard parts' of SURVEY §7(a):
+ceil-mode pooling, LRN, RReLU train/eval, dilated/transposed/separable/1D/3D
+conv, GRU/vanilla RNN, and the sizeAverage criterion matrix
+(analogue of the reference's Torch7 golden specs, test/.../torch/*Spec.scala)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax                                                   # noqa: E402
+import jax.numpy as jnp                                      # noqa: E402
+
+import bigdl_tpu.nn as nn                                    # noqa: E402
+
+
+def _j2t(x):
+    return torch.from_numpy(np.asarray(x).copy())
+
+
+def _nhwc_to_torch(x):
+    return _j2t(x).permute(0, 3, 1, 2)
+
+
+def _torch_to_nhwc(t):
+    return t.permute(0, 2, 3, 1).detach().numpy()
+
+
+# ------------------------------------------------------------------ pooling
+@pytest.mark.parametrize("size,k,s,p", [(7, 3, 2, 0), (8, 3, 2, 1),
+                                        (9, 2, 3, 0)])
+def test_maxpool_ceil_mode(size, k, s, p):
+    r = np.random.RandomState(0)
+    x = r.randn(2, size, size, 3).astype(np.float32)
+    layer = nn.SpatialMaxPooling(k, k, s, s, p, p, ceil_mode=True)
+    jo, _ = layer.apply({}, {}, jnp.asarray(x))
+    to = torch.nn.functional.max_pool2d(
+        _nhwc_to_torch(x), k, s, p, ceil_mode=True)
+    np.testing.assert_allclose(np.asarray(jo), _torch_to_nhwc(to), atol=1e-6)
+
+
+@pytest.mark.parametrize("include_pad", [True, False])
+@pytest.mark.parametrize("ceil_mode", [False, True])
+def test_avgpool_padding_divisor_rules(include_pad, ceil_mode):
+    r = np.random.RandomState(1)
+    x = r.randn(2, 9, 9, 2).astype(np.float32)
+    layer = nn.SpatialAveragePooling(3, 3, 2, 2, 1, 1, ceil_mode=ceil_mode,
+                                     count_include_pad=include_pad)
+    jo, _ = layer.apply({}, {}, jnp.asarray(x))
+    to = torch.nn.functional.avg_pool2d(
+        _nhwc_to_torch(x), 3, 2, 1, ceil_mode=ceil_mode,
+        count_include_pad=include_pad)
+    np.testing.assert_allclose(np.asarray(jo), _torch_to_nhwc(to), atol=1e-5)
+
+
+def test_volumetric_maxpool():
+    r = np.random.RandomState(2)
+    x = r.randn(2, 6, 8, 8, 2).astype(np.float32)     # NDHWC
+    layer = nn.VolumetricMaxPooling(2, 2, 2)
+    jo, _ = layer.apply({}, {}, jnp.asarray(x))
+    to = torch.nn.functional.max_pool3d(
+        _j2t(x).permute(0, 4, 1, 2, 3), 2)
+    np.testing.assert_allclose(np.asarray(jo),
+                               to.permute(0, 2, 3, 4, 1).numpy(), atol=1e-6)
+
+
+def test_adaptive_maxpool():
+    r = np.random.RandomState(3)
+    x = r.randn(2, 12, 12, 3).astype(np.float32)
+    layer = nn.SpatialAdaptiveMaxPooling(4, 4)
+    jo, _ = layer.apply({}, {}, jnp.asarray(x))
+    to = torch.nn.functional.adaptive_max_pool2d(_nhwc_to_torch(x), 4)
+    np.testing.assert_allclose(np.asarray(jo), _torch_to_nhwc(to), atol=1e-6)
+
+
+# -------------------------------------------------------------------- norms
+@pytest.mark.parametrize("size,alpha,beta,k", [(5, 1e-4, 0.75, 1.0),
+                                               (3, 2e-4, 0.6, 2.0)])
+def test_lrn_matches_torch(size, alpha, beta, k):
+    r = np.random.RandomState(4)
+    x = (r.randn(2, 6, 6, 8) * 5).astype(np.float32)
+    layer = nn.SpatialCrossMapLRN(size, alpha, beta, k)
+    jo, _ = layer.apply({}, {}, jnp.asarray(x))
+    to = torch.nn.functional.local_response_norm(
+        _nhwc_to_torch(x), size, alpha=alpha, beta=beta, k=k)
+    np.testing.assert_allclose(np.asarray(jo), _torch_to_nhwc(to),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_l2_normalize_matches_torch():
+    r = np.random.RandomState(5)
+    x = r.randn(4, 10).astype(np.float32)
+    jo, _ = nn.Normalize(2.0).apply({}, {}, jnp.asarray(x))
+    to = torch.nn.functional.normalize(_j2t(x), p=2.0, dim=-1)
+    np.testing.assert_allclose(np.asarray(jo), to.numpy(), atol=1e-6)
+
+
+# -------------------------------------------------------------- activations
+def test_rrelu_eval_matches_torch_and_train_in_bounds():
+    r = np.random.RandomState(6)
+    x = (r.randn(64, 32) * 2).astype(np.float32)
+    lower, upper = 1 / 8, 1 / 3
+    layer = nn.RReLU(lower, upper)
+    # eval: deterministic mean slope — exact parity
+    jo, _ = layer.apply({}, {}, jnp.asarray(x), training=False)
+    to = torch.nn.functional.rrelu(_j2t(x), lower, upper, training=False)
+    np.testing.assert_allclose(np.asarray(jo), to.numpy(), atol=1e-6)
+    # train: slopes random per element, bounded by [lower, upper]
+    jt, _ = layer.apply({}, {}, jnp.asarray(x), training=True,
+                        rng=jax.random.PRNGKey(0))
+    jt = np.asarray(jt)
+    neg = x < 0
+    slopes = jt[neg] / x[neg]
+    assert slopes.min() >= lower - 1e-6 and slopes.max() <= upper + 1e-6
+    assert abs(slopes.mean() - (lower + upper) / 2) < 0.02
+    np.testing.assert_array_equal(jt[~neg], x[~neg])
+
+
+def test_more_activations_match_torch():
+    r = np.random.RandomState(7)
+    x = (r.randn(4, 10) * 3).astype(np.float32)
+    pairs = [
+        (nn.SELU(), torch.nn.functional.selu),
+        (nn.ReLU6(), torch.nn.functional.relu6),
+        (nn.SoftSign(), torch.nn.functional.softsign),
+        (nn.SoftMin(), lambda t: torch.softmax(-t, -1)),
+        (nn.Swish(), torch.nn.functional.silu),
+        (nn.Threshold(0.5, -2.0),
+         lambda t: torch.nn.functional.threshold(t, 0.5, -2.0)),
+        (nn.SoftPlus(beta=2.0),
+         lambda t: torch.nn.functional.softplus(t, beta=2.0)),
+        (nn.LeakyReLU(0.2),
+         lambda t: torch.nn.functional.leaky_relu(t, 0.2)),
+        (nn.HardTanh(-2.0, 2.0),
+         lambda t: torch.nn.functional.hardtanh(t, -2.0, 2.0)),
+    ]
+    for jlayer, tfn in pairs:
+        jo, _ = jlayer.apply({}, {}, jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(jo), tfn(_j2t(x)).numpy(),
+                                   atol=2e-5, err_msg=type(jlayer).__name__)
+
+
+def test_prelu_matches_torch():
+    r = np.random.RandomState(8)
+    x = r.randn(4, 6).astype(np.float32)
+    layer = nn.PReLU(6)
+    params, state = layer.init(jax.random.PRNGKey(0))
+    slopes = (r.rand(6) * 0.5).astype(np.float32)
+    params = {"weight": jnp.asarray(slopes)}
+    jo, _ = layer.apply(params, state, jnp.asarray(x))
+    to = torch.nn.functional.prelu(_j2t(x), _j2t(slopes))
+    np.testing.assert_allclose(np.asarray(jo), to.numpy(), atol=1e-6)
+
+
+# ------------------------------------------------------------- convolutions
+def test_dilated_conv_matches_torch():
+    r = np.random.RandomState(9)
+    layer = nn.SpatialDilatedConvolution(3, 5, 3, 3, 1, 1, 2, 2, 2, 2)
+    params, state = layer.init(jax.random.PRNGKey(0))
+    tc = torch.nn.Conv2d(3, 5, 3, stride=1, padding=2, dilation=2)
+    with torch.no_grad():
+        tc.weight.copy_(_j2t(np.transpose(params["weight"], (3, 2, 0, 1))))
+        tc.bias.copy_(_j2t(params["bias"]))
+    x = r.randn(2, 10, 10, 3).astype(np.float32)
+    jo, _ = layer.apply(params, state, jnp.asarray(x))
+    to = tc(_nhwc_to_torch(x))
+    np.testing.assert_allclose(np.asarray(jo), _torch_to_nhwc(to), atol=1e-4)
+
+
+@pytest.mark.parametrize("stride,pad,adj", [(2, 1, 0), (2, 0, 1), (3, 1, 0)])
+def test_transposed_conv_matches_torch(stride, pad, adj):
+    r = np.random.RandomState(10)
+    layer = nn.SpatialFullConvolution(4, 3, 3, 3, stride, stride, pad, pad,
+                                      adj, adj)
+    params, state = layer.init(jax.random.PRNGKey(0))
+    tc = torch.nn.ConvTranspose2d(4, 3, 3, stride=stride, padding=pad,
+                                  output_padding=adj)
+    with torch.no_grad():
+        # ours (kh, kw, nin, nout) -> torch (nin, nout, kh, kw)
+        tc.weight.copy_(_j2t(np.transpose(params["weight"], (2, 3, 0, 1))))
+        tc.bias.copy_(_j2t(params["bias"]))
+    x = r.randn(2, 6, 6, 4).astype(np.float32)
+    jo, _ = layer.apply(params, state, jnp.asarray(x))
+    to = tc(_nhwc_to_torch(x))
+    np.testing.assert_allclose(np.asarray(jo), _torch_to_nhwc(to), atol=1e-4)
+
+
+def test_separable_conv_matches_torch():
+    r = np.random.RandomState(11)
+    nin, nout, mult = 3, 8, 2
+    layer = nn.SpatialSeparableConvolution(nin, nout, mult, 3, 3, 1, 1, 1, 1)
+    params, state = layer.init(jax.random.PRNGKey(0))
+    tdw = torch.nn.Conv2d(nin, nin * mult, 3, padding=1, groups=nin,
+                          bias=False)
+    tpw = torch.nn.Conv2d(nin * mult, nout, 1)
+    with torch.no_grad():
+        # ours depth (kh, kw, 1, nin*mult) — feature_group_count=nin means
+        # output channel c comes from input group c // mult
+        tdw.weight.copy_(_j2t(np.transpose(
+            params["depth_weight"], (3, 2, 0, 1))))
+        tpw.weight.copy_(_j2t(np.transpose(
+            params["point_weight"], (3, 2, 0, 1))))
+        tpw.bias.copy_(_j2t(params["bias"]))
+    x = r.randn(2, 7, 7, nin).astype(np.float32)
+    jo, _ = layer.apply(params, state, jnp.asarray(x))
+    to = tpw(tdw(_nhwc_to_torch(x)))
+    np.testing.assert_allclose(np.asarray(jo), _torch_to_nhwc(to), atol=1e-4)
+
+
+def test_temporal_conv_matches_torch():
+    r = np.random.RandomState(12)
+    layer = nn.TemporalConvolution(6, 4, 3, 2)
+    params, state = layer.init(jax.random.PRNGKey(0))
+    tc = torch.nn.Conv1d(6, 4, 3, stride=2)
+    with torch.no_grad():
+        # ours (kw, cin, cout) -> torch (cout, cin, kw)
+        tc.weight.copy_(_j2t(np.transpose(params["weight"], (2, 1, 0))))
+        tc.bias.copy_(_j2t(params["bias"]))
+    x = r.randn(2, 11, 6).astype(np.float32)         # NTC
+    jo, _ = layer.apply(params, state, jnp.asarray(x))
+    to = tc(_j2t(x).permute(0, 2, 1)).permute(0, 2, 1)
+    np.testing.assert_allclose(np.asarray(jo), to.detach().numpy(),
+                               atol=1e-5)
+
+
+def test_volumetric_conv_matches_torch():
+    r = np.random.RandomState(13)
+    layer = nn.VolumetricConvolution(2, 4, 3, 3, 3, 2, 2, 2, 1, 1, 1)
+    params, state = layer.init(jax.random.PRNGKey(0))
+    tc = torch.nn.Conv3d(2, 4, 3, stride=2, padding=1)
+    with torch.no_grad():
+        # ours (kt, kh, kw, cin, cout) -> torch (cout, cin, kt, kh, kw)
+        tc.weight.copy_(_j2t(np.transpose(params["weight"], (4, 3, 0, 1, 2))))
+        tc.bias.copy_(_j2t(params["bias"]))
+    x = r.randn(2, 5, 7, 7, 2).astype(np.float32)    # NDHWC
+    jo, _ = layer.apply(params, state, jnp.asarray(x))
+    to = tc(_j2t(x).permute(0, 4, 1, 2, 3)).permute(0, 2, 3, 4, 1)
+    np.testing.assert_allclose(np.asarray(jo), to.detach().numpy(),
+                               atol=1e-4)
+
+
+# --------------------------------------------------------------- recurrence
+def test_gru_matches_torch_autograd():
+    """Our GRU is the reference's Cho variant — candidate = tanh(Wx + U(r⊙h))
+    (reference: nn/GRU.scala buildModel h2g3(r*h)); torch.nn.GRU is the cudnn
+    variant r⊙(Uh). Parity is checked against a torch-autograd replica of the
+    same math, incl. input gradients."""
+    r = np.random.RandomState(14)
+    input_size, hidden = 5, 4
+    cell = nn.GRU(input_size, hidden)
+    rec = nn.Recurrent(cell, return_sequences=True)
+    params, state = rec.init(jax.random.PRNGKey(0))
+    cp = params["cell"]
+    wi = _j2t(cp["w_i"])
+    wh = _j2t(cp["w_h"])
+    whc = _j2t(cp["w_hc"])
+    b = _j2t(cp["bias"])
+
+    def tgru(x):
+        h = torch.zeros(x.shape[0], hidden)
+        outs = []
+        for t in range(x.shape[1]):
+            xi = x[:, t] @ wi + b
+            hr_hu = h @ wh
+            rg = torch.sigmoid(xi[:, :hidden] + hr_hu[:, :hidden])
+            u = torch.sigmoid(xi[:, hidden:2 * hidden] + hr_hu[:, hidden:])
+            cand = torch.tanh(xi[:, 2 * hidden:] + (rg * h) @ whc)
+            h = u * h + (1.0 - u) * cand
+            outs.append(h)
+        return torch.stack(outs, 1)
+
+    x = r.randn(3, 6, input_size).astype(np.float32)
+    jo, _ = rec.apply(params, state, jnp.asarray(x))
+    jg = jax.grad(lambda v: rec.apply(params, state, v)[0].sum())(
+        jnp.asarray(x))
+    tx = _j2t(x).requires_grad_(True)
+    to = tgru(tx)
+    to.sum().backward()
+    np.testing.assert_allclose(np.asarray(jo), to.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(jg), tx.grad.numpy(), atol=1e-5)
+
+
+def test_vanilla_rnn_matches_torch():
+    r = np.random.RandomState(15)
+    input_size, hidden = 4, 3
+    cell = nn.RnnCell(input_size, hidden)
+    rec = nn.Recurrent(cell, return_sequences=True)
+    params, state = rec.init(jax.random.PRNGKey(0))
+    cp = params["cell"]
+    tr = torch.nn.RNN(input_size, hidden, batch_first=True)
+    with torch.no_grad():
+        tr.weight_ih_l0.copy_(_j2t(np.asarray(cp["w_i"]).T))
+        tr.weight_hh_l0.copy_(_j2t(np.asarray(cp["w_h"]).T))
+        tr.bias_ih_l0.copy_(_j2t(cp["bias"]))
+        tr.bias_hh_l0.zero_()
+    x = r.randn(2, 5, input_size).astype(np.float32)
+    jo, _ = rec.apply(params, state, jnp.asarray(x))
+    to, _ = tr(_j2t(x))
+    np.testing.assert_allclose(np.asarray(jo), to.detach().numpy(),
+                               atol=1e-5)
+
+
+# --------------------------------------------------- criterions (reductions)
+def test_classnll_weights_ignore_and_sum():
+    r = np.random.RandomState(16)
+    logits = r.randn(8, 5).astype(np.float32)
+    target = r.randint(0, 5, 8).astype(np.int64)
+    weights = (r.rand(5) + 0.5).astype(np.float32)
+    logp_t = torch.log_softmax(_j2t(logits), -1)
+    logp_j = jax.nn.log_softmax(jnp.asarray(logits))
+    tj = jnp.asarray(target, jnp.int32)
+
+    # weighted mean: torch divides by total weight, like the reference
+    jl = nn.ClassNLLCriterion(weights=weights).forward(logp_j, tj)
+    tl = torch.nn.functional.nll_loss(logp_t, _j2t(target),
+                                      weight=_j2t(weights))
+    np.testing.assert_allclose(float(jl), float(tl), atol=1e-5)
+
+    # sum reduction (sizeAverage=false)
+    jl = nn.ClassNLLCriterion(size_average=False).forward(logp_j, tj)
+    tl = torch.nn.functional.nll_loss(logp_t, _j2t(target), reduction="sum")
+    np.testing.assert_allclose(float(jl), float(tl), atol=1e-4)
+
+    # ignore_index
+    target[:3] = 2
+    tj = jnp.asarray(target, jnp.int32)
+    jl = nn.ClassNLLCriterion(ignore_index=2).forward(logp_j, tj)
+    tl = torch.nn.functional.nll_loss(logp_t, _j2t(target), ignore_index=2)
+    np.testing.assert_allclose(float(jl), float(tl), atol=1e-5)
+
+    # CrossEntropy = fused logits path
+    jl = nn.CrossEntropyCriterion().forward(jnp.asarray(logits), tj)
+    tl = torch.nn.functional.cross_entropy(_j2t(logits), _j2t(target))
+    np.testing.assert_allclose(float(jl), float(tl), atol=1e-5)
+
+
+def test_criterion_matrix_matches_torch():
+    r = np.random.RandomState(17)
+    a = r.randn(6, 4).astype(np.float32)
+    b = r.randn(6, 4).astype(np.float32)
+    y1 = np.sign(r.randn(6)).astype(np.float32)
+    ja, jb, jy = jnp.asarray(a), jnp.asarray(b), jnp.asarray(y1)
+
+    cases = [
+        (nn.AbsCriterion().forward(ja, jb),
+         torch.nn.functional.l1_loss(_j2t(a), _j2t(b))),
+        (nn.AbsCriterion(size_average=False).forward(ja, jb),
+         torch.nn.functional.l1_loss(_j2t(a), _j2t(b), reduction="sum")),
+        (nn.MSECriterion(size_average=False).forward(ja, jb),
+         torch.nn.functional.mse_loss(_j2t(a), _j2t(b), reduction="sum")),
+        (nn.KLDivCriterion().forward(
+            jax.nn.log_softmax(ja), jax.nn.softmax(jb)),
+         torch.nn.functional.kl_div(torch.log_softmax(_j2t(a), -1),
+                                    torch.softmax(_j2t(b), -1))),
+        # ours defaults margin=1.0 (reference/Torch7); torch.nn defaults 0
+        (nn.MarginRankingCriterion().forward(
+            (ja[:, 0], jb[:, 0]), jy),
+         torch.nn.functional.margin_ranking_loss(
+             _j2t(a[:, 0]), _j2t(b[:, 0]), _j2t(y1), margin=1.0)),
+        (nn.HingeEmbeddingCriterion().forward(jnp.abs(ja[:, 0]), jy),
+         torch.nn.functional.hinge_embedding_loss(
+             _j2t(np.abs(a[:, 0])), _j2t(y1))),
+        (nn.CosineEmbeddingCriterion().forward((ja, jb), jy),
+         torch.nn.functional.cosine_embedding_loss(
+             _j2t(a), _j2t(b), _j2t(y1))),
+        (nn.SoftMarginCriterion().forward(ja[:, 0], jy),
+         torch.nn.functional.soft_margin_loss(_j2t(a[:, 0]), _j2t(y1))),
+        (nn.BCECriterionWithLogits().forward(
+            ja, jnp.asarray((b > 0).astype(np.float32))),
+         torch.nn.functional.binary_cross_entropy_with_logits(
+             _j2t(a), _j2t((b > 0).astype(np.float32)))),
+    ]
+    for i, (jl, tl) in enumerate(cases):
+        np.testing.assert_allclose(float(jl), float(tl), atol=2e-5,
+                                   err_msg=f"case {i}")
+
+
+def test_multimargin_and_multilabel_soft_margin():
+    r = np.random.RandomState(18)
+    x = r.randn(5, 4).astype(np.float32)
+    t = r.randint(0, 4, 5)
+    jl = nn.MultiMarginCriterion().forward(jnp.asarray(x),
+                                           jnp.asarray(t, jnp.int32))
+    tl = torch.nn.functional.multi_margin_loss(_j2t(x), _j2t(t.astype(np.int64)))
+    np.testing.assert_allclose(float(jl), float(tl), atol=1e-5)
+
+    labels = (r.rand(5, 4) > 0.5).astype(np.float32)
+    jl = nn.MultiLabelSoftMarginCriterion().forward(jnp.asarray(x),
+                                                    jnp.asarray(labels))
+    tl = torch.nn.functional.multilabel_soft_margin_loss(_j2t(x), _j2t(labels))
+    np.testing.assert_allclose(float(jl), float(tl), atol=1e-5)
+
+
+# ------------------------------------------------------------- dropout/misc
+def test_dropout_eval_identity_train_scales():
+    r = np.random.RandomState(19)
+    x = r.randn(512, 8).astype(np.float32) + 5.0
+    layer = nn.Dropout(0.4)
+    jo, _ = layer.apply({}, {}, jnp.asarray(x), training=False)
+    np.testing.assert_array_equal(np.asarray(jo), x)   # eval = identity
+    jt, _ = layer.apply({}, {}, jnp.asarray(x), training=True,
+                        rng=jax.random.PRNGKey(1))
+    jt = np.asarray(jt)
+    kept = jt != 0
+    # inverted dropout: kept values scaled by 1/(1-p); mean preserved
+    np.testing.assert_allclose(jt[kept], (x / 0.6)[kept], rtol=1e-5)
+    assert abs(kept.mean() - 0.6) < 0.03
+    assert abs(jt.mean() - x.mean()) < 0.25
+
+
+def test_grad_parity_conv_chain():
+    """Input-gradient parity through a conv→pool→LRN→fc chain — backward
+    semantics of the composition, not just forwards."""
+    r = np.random.RandomState(20)
+    conv = nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1)
+    model = nn.Sequential(conv, nn.ReLU(),
+                          nn.SpatialMaxPooling(2, 2, 2, 2, ceil_mode=True),
+                          nn.SpatialCrossMapLRN(3, 1e-3, 0.75, 1.0))
+    params, state = model.init(jax.random.PRNGKey(0))
+    cp = params[conv.name] if conv.name in params else params
+    # locate conv params in the tree
+    flat = jax.tree_util.tree_leaves_with_path(params)
+    wt = {"/".join(str(k) for k in path): leaf for path, leaf in flat}
+    wkey = next(k for k in wt if "weight" in k)
+    bkey = next(k for k in wt if "bias" in k)
+
+    tconv = torch.nn.Conv2d(3, 4, 3, padding=1)
+    with torch.no_grad():
+        tconv.weight.copy_(_j2t(np.transpose(wt[wkey], (3, 2, 0, 1))))
+        tconv.bias.copy_(_j2t(wt[bkey]))
+
+    def tmodel(tx):
+        h = torch.relu(tconv(tx.permute(0, 3, 1, 2)))
+        h = torch.nn.functional.max_pool2d(h, 2, ceil_mode=True)
+        h = torch.nn.functional.local_response_norm(h, 3, alpha=1e-3,
+                                                    beta=0.75, k=1.0)
+        return h.permute(0, 2, 3, 1)
+
+    x = r.randn(2, 7, 7, 3).astype(np.float32)
+    jfn = lambda v: model.apply(params, state, v)[0]
+    jo = jfn(jnp.asarray(x))
+    jg = jax.grad(lambda v: jfn(v).sum())(jnp.asarray(x))
+    tx = _j2t(x).requires_grad_(True)
+    to = tmodel(tx)
+    to.sum().backward()
+    np.testing.assert_allclose(np.asarray(jo), to.detach().numpy(), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(jg), tx.grad.numpy(), atol=1e-4)
