@@ -1,0 +1,213 @@
+"""COCO segmentation utilities — RLE masks, polygon rasterization, COCO
+JSON dataset (reference: dataset/segmentation/MaskUtils.scala RLE codec,
+dataset/segmentation/COCODataset.scala JSON model + seq-file generator
+COCOSeqFileGenerator.scala).
+
+Host-side numpy: mask decode/rasterize are data-pipeline work, not TPU ops.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+# ----------------------------------------------------------------- RLE core
+def rle_encode(mask: np.ndarray) -> List[int]:
+    """Binary mask (H, W) → COCO uncompressed RLE counts, column-major
+    (Fortran) order starting with the count of zeros
+    (reference: MaskUtils.scala binaryToRLE)."""
+    flat = np.asarray(mask, bool).flatten(order="F").astype(np.int8)
+    changes = np.flatnonzero(np.diff(flat))
+    runs = np.diff(np.concatenate([[0], changes + 1, [flat.size]]))
+    counts = runs.tolist()
+    if flat.size and flat[0] == 1:
+        counts = [0] + counts
+    return [int(c) for c in counts]
+
+
+def rle_decode(counts: Sequence[int], h: int, w: int) -> np.ndarray:
+    """COCO RLE counts → binary mask (H, W)."""
+    flat = np.zeros(h * w, np.uint8)
+    pos = 0
+    val = 0
+    for c in counts:
+        if val:
+            flat[pos:pos + c] = 1
+        pos += c
+        val ^= 1
+    if pos != h * w:
+        raise ValueError(f"RLE length {pos} != {h}x{w}")
+    return flat.reshape((h, w), order="F")
+
+
+def rle_area(counts: Sequence[int]) -> int:
+    """Foreground pixel count (reference: MaskUtils rleArea)."""
+    return int(sum(counts[1::2]))
+
+
+def rle_to_string(counts: Sequence[int]) -> str:
+    """COCO compressed RLE string (LEB128 with delta encoding of odd runs)
+    — byte-compatible with pycocotools' rleToString."""
+    out = bytearray()
+    for i, c in enumerate(counts):
+        x = int(c)
+        if i > 2:
+            x -= int(counts[i - 2])
+        more = True
+        while more:
+            bits = x & 0x1F
+            x >>= 5
+            more = not (x == 0 and not (bits & 0x10)) and \
+                not (x == -1 and (bits & 0x10))
+            if more:
+                bits |= 0x20
+            out.append(bits + 48)
+    return out.decode("ascii")
+
+
+def rle_from_string(s: str) -> List[int]:
+    """Inverse of rle_to_string (reference: MaskUtils string2RLE)."""
+    counts: List[int] = []
+    i = 0
+    data = s.encode("ascii")
+    while i < len(data):
+        x = 0
+        k = 0
+        more = True
+        while more:
+            c = data[i] - 48
+            x |= (c & 0x1F) << (5 * k)
+            more = bool(c & 0x20)
+            i += 1
+            if not more and (c & 0x10):
+                x |= -1 << (5 * (k + 1))
+            k += 1
+        if len(counts) > 2:
+            x += counts[-2]
+        counts.append(int(x))
+    return counts
+
+
+def rle_iou(a_counts, b_counts, h: int, w: int) -> float:
+    """IoU of two RLE masks (decode-based; fixtures are small)."""
+    a = rle_decode(a_counts, h, w).astype(bool)
+    b = rle_decode(b_counts, h, w).astype(bool)
+    union = np.logical_or(a, b).sum()
+    if union == 0:
+        return 0.0
+    return float(np.logical_and(a, b).sum() / union)
+
+
+# ----------------------------------------------------------- polygon masks
+def poly_to_mask(polys: Sequence[Sequence[float]], h: int, w: int) -> np.ndarray:
+    """COCO polygon list ([[x0,y0,x1,y1,...], ...]) → binary mask (H, W),
+    even-odd scanline fill at pixel centers (reference: MaskUtils
+    mergeRLEsIntoOne over frPoly)."""
+    mask = np.zeros((h, w), np.uint8)
+    for poly in polys:
+        pts = np.asarray(poly, np.float64).reshape(-1, 2)
+        if len(pts) < 3:
+            continue
+        xs, ys = pts[:, 0], pts[:, 1]
+        x0, x1 = np.roll(xs, 1), xs
+        y0, y1 = np.roll(ys, 1), ys
+        for row in range(h):
+            cy = row + 0.5
+            cond = ((y0 <= cy) & (y1 > cy)) | ((y1 <= cy) & (y0 > cy))
+            if not cond.any():
+                continue
+            xint = x0[cond] + (cy - y0[cond]) * (x1[cond] - x0[cond]) \
+                / (y1[cond] - y0[cond])
+            xint = np.sort(xint)
+            for a, b in zip(xint[::2], xint[1::2]):
+                lo = max(0, int(np.ceil(a - 0.5)))
+                hi = min(w, int(np.floor(b - 0.5)) + 1)
+                if hi > lo:
+                    mask[row, lo:hi] = 1
+    return mask
+
+
+# ------------------------------------------------------------ COCO dataset
+class COCOAnnotation:
+    __slots__ = ("bbox", "category", "iscrowd", "area", "segmentation",
+                 "image_id", "id")
+
+    def __init__(self, bbox, category, iscrowd, area, segmentation,
+                 image_id, ann_id):
+        self.bbox = bbox                     # (x, y, w, h) COCO convention
+        self.category = category             # contiguous label index
+        self.iscrowd = iscrowd
+        self.area = area
+        self.segmentation = segmentation     # raw: polygons or RLE dict
+        self.image_id = image_id
+        self.id = ann_id
+
+    @property
+    def xyxy(self) -> Tuple[float, float, float, float]:
+        x, y, w, h = self.bbox
+        return (x, y, x + w, y + h)
+
+    def mask(self, h: int, w: int) -> Optional[np.ndarray]:
+        seg = self.segmentation
+        if seg is None:
+            return None
+        if isinstance(seg, dict):
+            counts = seg["counts"]
+            if isinstance(counts, str):
+                counts = rle_from_string(counts)
+            sh, sw = seg.get("size", (h, w))
+            return rle_decode(counts, sh, sw)
+        return poly_to_mask(seg, h, w)
+
+
+class COCOImage:
+    __slots__ = ("id", "file_name", "height", "width", "annotations")
+
+    def __init__(self, iid, file_name, height, width):
+        self.id, self.file_name = iid, file_name
+        self.height, self.width = height, width
+        self.annotations: List[COCOAnnotation] = []
+
+
+class COCODataset:
+    """COCO instances JSON (reference: COCODataset.scala case classes +
+    `COCODataset.load`). Categories are remapped to contiguous indices
+    0..C-1 in the order of the `categories` array, like the reference's
+    categoryIdx mapping."""
+
+    def __init__(self, annotation_json: str, image_root: Optional[str] = None):
+        with open(annotation_json) as fh:
+            doc = json.load(fh)
+        self.image_root = image_root
+        self.categories = doc.get("categories", [])
+        self.cat_index = {c["id"]: i for i, c in enumerate(self.categories)}
+        self.cat_names = [c.get("name", str(c["id"])) for c in self.categories]
+        self.images: Dict[int, COCOImage] = {}
+        for im in doc.get("images", []):
+            self.images[im["id"]] = COCOImage(
+                im["id"], im.get("file_name", ""), im.get("height", 0),
+                im.get("width", 0))
+        for ann in doc.get("annotations", []):
+            img = self.images.get(ann["image_id"])
+            if img is None:
+                continue
+            img.annotations.append(COCOAnnotation(
+                tuple(ann.get("bbox", (0, 0, 0, 0))),
+                self.cat_index.get(ann.get("category_id"), -1),
+                int(ann.get("iscrowd", 0)),
+                float(ann.get("area", 0.0)),
+                ann.get("segmentation"),
+                ann["image_id"], ann.get("id", -1)))
+
+    def __len__(self):
+        return len(self.images)
+
+    def __iter__(self) -> Iterator[COCOImage]:
+        return iter(self.images.values())
+
+    def image_path(self, img: COCOImage) -> str:
+        return os.path.join(self.image_root or "", img.file_name)

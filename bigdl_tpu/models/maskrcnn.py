@@ -1,0 +1,216 @@
+"""Mask R-CNN inference pipeline (reference:
+models/maskrcnn/MaskRCNN.scala — ResNet-FPN backbone, RegionProposal,
+BoxHead, MaskHead; nn/RegionProposal.scala, nn/BoxHead.scala,
+nn/MaskHead.scala).
+
+TPU-first shape discipline: every stage has a STATIC output size —
+`pre_nms_topk` proposals per level, `max_detections` final boxes with a
+validity mask — so the whole forward jits to one XLA program (the
+reference's dynamic box counts become masked fixed-size tensors).
+Inference-only, like the reference's model zoo entry.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.module import Module
+from bigdl_tpu.nn.detection import (Anchor, FPN, Pooler, decode_boxes, nms)
+
+
+def _conv_block(cin, cout, stride):
+    return nn.Sequential(
+        nn.SpatialConvolution(cin, cout, 3, 3, stride, stride, 1, 1,
+                              bias=False),
+        nn.SpatialBatchNormalization(cout), nn.ReLU(),
+        nn.SpatialConvolution(cout, cout, 3, 3, 1, 1, 1, 1, bias=False),
+        nn.SpatialBatchNormalization(cout), nn.ReLU())
+
+
+class _Backbone(Module):
+    """Small strided backbone emitting C2..C5 at strides 4/8/16/32
+    (stand-in for the reference's ResNet-50 trunk; swap via `build`)."""
+
+    def __init__(self, channels: Sequence[int], name=None):
+        super().__init__(name)
+        cin = 3
+        strides = (4, 8, 16, 32)
+        prev_s = 1
+        for i, (c, s) in enumerate(zip(channels, strides)):
+            self.add_child(f"stage{i}", _conv_block(cin, c, s // prev_s))
+            cin, prev_s = c, s
+
+    def _apply(self, params, state, x, training=False, rng=None):
+        outs = []
+        new_state = {}
+        for key, child in self.children().items():
+            x, new_state[key] = child.apply(params[key], state[key], x,
+                                            training=training)
+            outs.append(x)
+        return tuple(outs), new_state
+
+
+class _RPNHead(Module):
+    """Shared 3x3 conv + objectness/delta 1x1s, applied per level
+    (reference: nn/RegionProposal.scala head)."""
+
+    def __init__(self, channels: int, num_anchors: int, name=None):
+        super().__init__(name)
+        self.add_child("conv", nn.SpatialConvolution(
+            channels, channels, 3, 3, 1, 1, 1, 1))
+        self.add_child("logits", nn.SpatialConvolution(
+            channels, num_anchors, 1, 1))
+        self.add_child("deltas", nn.SpatialConvolution(
+            channels, 4 * num_anchors, 1, 1))
+
+    def _apply(self, params, state, feat, training=False, rng=None):
+        ch = self.children()
+        h, _ = ch["conv"].apply(params["conv"], state["conv"], feat)
+        h = jax.nn.relu(h)
+        logits, _ = ch["logits"].apply(params["logits"], state["logits"], h)
+        deltas, _ = ch["deltas"].apply(params["deltas"], state["deltas"], h)
+        return (logits, deltas), state
+
+
+class MaskRCNN(Module):
+    """Inference model: `apply(params, state, images)` →
+    dict(boxes, scores, labels, masks, valid) with static shapes.
+
+    images: (1, H, W, 3) — single-image inference, like the reference's
+    MaskRCNN zoo entry (batch via vmap/pmap outside).
+    """
+
+    def __init__(self, num_classes: int,
+                 backbone_channels: Sequence[int] = (32, 64, 128, 256),
+                 fpn_channels: int = 64,
+                 pre_nms_topk: int = 256,
+                 post_nms_topk: int = 64,
+                 max_detections: int = 32,
+                 mask_resolution: int = 14,
+                 score_thresh: float = 0.05,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.num_classes = num_classes
+        self.pre_nms_topk = pre_nms_topk
+        self.post_nms_topk = post_nms_topk
+        self.max_detections = max_detections
+        self.score_thresh = score_thresh
+        self.strides = (4, 8, 16, 32)
+        self.anchor = Anchor(ratios=(0.5, 1.0, 2.0), scales=(4.0,))
+        self.add_child("backbone", _Backbone(backbone_channels))
+        self.add_child("fpn", FPN(backbone_channels, fpn_channels))
+        self.add_child("rpn", _RPNHead(fpn_channels, self.anchor.num))
+        self.add_child("pooler", Pooler((7, 7),
+                                        [1.0 / s for s in self.strides]))
+        self.add_child("mask_pooler", Pooler(
+            (mask_resolution, mask_resolution),
+            [1.0 / s for s in self.strides]))
+        rep = fpn_channels * 7 * 7
+        self.add_child("box_fc1", nn.Linear(rep, 256))
+        self.add_child("box_fc2", nn.Linear(256, 256))
+        self.add_child("cls_score", nn.Linear(256, num_classes + 1))
+        self.add_child("bbox_pred", nn.Linear(256, 4 * (num_classes + 1)))
+        self.add_child("mask_conv1", nn.SpatialConvolution(
+            fpn_channels, fpn_channels, 3, 3, 1, 1, 1, 1))
+        self.add_child("mask_conv2", nn.SpatialConvolution(
+            fpn_channels, fpn_channels, 3, 3, 1, 1, 1, 1))
+        self.add_child("mask_deconv", nn.SpatialFullConvolution(
+            fpn_channels, fpn_channels, 2, 2, 2, 2))
+        self.add_child("mask_logits", nn.SpatialConvolution(
+            fpn_channels, num_classes, 1, 1))
+
+    # ---------------------------------------------------------- stages
+    def _proposals(self, params, state, feats, img_hw):
+        """Top-scoring decoded anchors across levels → NMS → proposals."""
+        ch = self.children()
+        all_boxes, all_scores = [], []
+        for lvl, (feat, stride) in enumerate(zip(feats, self.strides)):
+            (logits, deltas), _ = ch["rpn"].apply(params["rpn"],
+                                                  state["rpn"], feat)
+            h, w = feat.shape[1], feat.shape[2]
+            anchors = self.anchor.generate(h, w, stride)       # (HWA, 4)
+            scores = jax.nn.sigmoid(logits.reshape(-1))
+            deltas = deltas.reshape(h, w, self.anchor.num, 4).reshape(-1, 4)
+            k = min(self.pre_nms_topk, scores.shape[0])
+            top_s, top_i = jax.lax.top_k(scores, k)
+            boxes = decode_boxes(anchors[top_i], deltas[top_i],
+                                 clip_shape=img_hw)
+            all_boxes.append(boxes)
+            all_scores.append(top_s)
+        boxes = jnp.concatenate(all_boxes)
+        scores = jnp.concatenate(all_scores)
+        idx, valid = nms(boxes, scores, 0.7, self.post_nms_topk)
+        return boxes[idx], valid
+
+    def _apply(self, params, state, images, training=False, rng=None):
+        if training:
+            raise NotImplementedError(
+                "MaskRCNN is inference-only (matches the reference zoo "
+                "entry models/maskrcnn/MaskRCNN.scala)")
+        ch = self.children()
+        img_hw = (images.shape[1], images.shape[2])
+        feats, _ = ch["backbone"].apply(params["backbone"],
+                                        state["backbone"], images)
+        pyr, _ = ch["fpn"].apply(params["fpn"], state["fpn"], feats)
+        proposals, prop_valid = self._proposals(params, state, pyr, img_hw)
+
+        zeros = jnp.zeros((proposals.shape[0],), jnp.int32)
+        rois, _ = ch["pooler"].apply(params["pooler"], state["pooler"],
+                                     (list(pyr), proposals, zeros))
+        flat = rois.reshape(rois.shape[0], -1)
+        h, _ = ch["box_fc1"].apply(params["box_fc1"], state["box_fc1"], flat)
+        h = jax.nn.relu(h)
+        h, _ = ch["box_fc2"].apply(params["box_fc2"], state["box_fc2"], h)
+        h = jax.nn.relu(h)
+        cls, _ = ch["cls_score"].apply(params["cls_score"],
+                                       state["cls_score"], h)
+        probs = jax.nn.softmax(cls, -1)                  # (P, C+1); 0 = bg
+        bdeltas, _ = ch["bbox_pred"].apply(params["bbox_pred"],
+                                           state["bbox_pred"], h)
+        bdeltas = bdeltas.reshape(-1, self.num_classes + 1, 4)
+
+        fg = probs[:, 1:]                                # (P, C)
+        best = jnp.argmax(fg, -1)                        # (P,)
+        score = jnp.take_along_axis(fg, best[:, None], 1)[:, 0]
+        score = jnp.where(prop_valid, score, 0.0)
+        sel_deltas = jnp.take_along_axis(
+            bdeltas, (best + 1)[:, None, None].repeat(4, 2), 1)[:, 0]
+        boxes = decode_boxes(proposals, sel_deltas, clip_shape=img_hw)
+
+        keep, keep_valid = nms(boxes, score, 0.5, self.max_detections)
+        out_boxes = boxes[keep]
+        out_scores = score[keep]
+        out_labels = best[keep]
+        out_valid = keep_valid & (out_scores > self.score_thresh)
+
+        mrois, _ = ch["mask_pooler"].apply(
+            params["mask_pooler"], state["mask_pooler"],
+            (list(pyr), out_boxes, jnp.zeros((out_boxes.shape[0],),
+                                             jnp.int32)))
+        m, _ = ch["mask_conv1"].apply(params["mask_conv1"],
+                                      state["mask_conv1"], mrois)
+        m = jax.nn.relu(m)
+        m, _ = ch["mask_conv2"].apply(params["mask_conv2"],
+                                      state["mask_conv2"], m)
+        m = jax.nn.relu(m)
+        m, _ = ch["mask_deconv"].apply(params["mask_deconv"],
+                                       state["mask_deconv"], m)
+        m = jax.nn.relu(m)
+        mlogits, _ = ch["mask_logits"].apply(params["mask_logits"],
+                                             state["mask_logits"], m)
+        # (N, 2R, 2R, C) → per-detection mask of its predicted class
+        masks = jax.nn.sigmoid(jnp.take_along_axis(
+            mlogits, out_labels[:, None, None, None].astype(jnp.int32), 3)
+            [..., 0])
+        return {"boxes": out_boxes, "scores": out_scores,
+                "labels": out_labels, "masks": masks,
+                "valid": out_valid}, state
+
+
+def build(num_classes: int = 80, **kw) -> MaskRCNN:
+    """(reference: models/maskrcnn/MaskRCNN.scala `apply`)."""
+    return MaskRCNN(num_classes, **kw)

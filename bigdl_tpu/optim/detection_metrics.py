@@ -1,0 +1,251 @@
+"""Detection validation — mean average precision, VOC and COCO styles
+(reference: optim/ValidationMethod.scala:230-756 —
+MeanAveragePrecision / MeanAveragePrecisionObjectDetection with the
+use07metric flag and the COCO IoU sweep; mask IoU variant for MaskRCNN).
+
+Host-side numpy: AP is a global sort over all detections, inherently not
+sum-decomposable, so these methods accumulate across `batch` calls and
+compute on demand (the `reset` hook of ValidationMethod clears them).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.optim.metrics import ValidationMethod, ValidationResult
+
+
+def box_iou_np(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """IoU matrix for xyxy boxes: (Na, 4) x (Nb, 4) → (Na, Nb)."""
+    a = np.asarray(a, np.float64).reshape(-1, 4)
+    b = np.asarray(b, np.float64).reshape(-1, 4)
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = np.clip(a[:, 2] - a[:, 0], 0, None) * \
+        np.clip(a[:, 3] - a[:, 1], 0, None)
+    area_b = np.clip(b[:, 2] - b[:, 0], 0, None) * \
+        np.clip(b[:, 3] - b[:, 1], 0, None)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+
+
+def average_precision(scores: np.ndarray, tp: np.ndarray, n_gt: int,
+                      use_07_metric: bool = False) -> float:
+    """AP from per-detection (score, is-true-positive) pairs
+    (reference: ValidationMethod.scala AP computation — 11-point VOC2007
+    interpolation or the continuous all-points integral)."""
+    if n_gt == 0:
+        return float("nan")
+    if scores.size == 0:
+        return 0.0
+    order = np.argsort(-scores, kind="stable")
+    tp = tp[order].astype(np.float64)
+    fp = 1.0 - tp
+    tp_cum = np.cumsum(tp)
+    fp_cum = np.cumsum(fp)
+    recall = tp_cum / n_gt
+    precision = tp_cum / np.maximum(tp_cum + fp_cum, 1e-12)
+    if use_07_metric:
+        ap = 0.0
+        for t in np.linspace(0, 1, 11):
+            mask = recall >= t
+            ap += (precision[mask].max() if mask.any() else 0.0) / 11.0
+        return float(ap)
+    # all-points: precision envelope integral (VOC2010+/COCO style)
+    mrec = np.concatenate([[0.0], recall, [1.0]])
+    mpre = np.concatenate([[0.0], precision, [0.0]])
+    for i in range(mpre.size - 2, -1, -1):
+        mpre[i] = max(mpre[i], mpre[i + 1])
+    idx = np.flatnonzero(mrec[1:] != mrec[:-1]) + 1
+    return float(np.sum((mrec[idx] - mrec[idx - 1]) * mpre[idx]))
+
+
+class _Accumulator:
+    """Per-(class, iou-threshold) detection matching state."""
+
+    def __init__(self, num_classes: int, thresholds: Sequence[float]):
+        self.num_classes = num_classes
+        self.thresholds = list(thresholds)
+        # per class: list of (score, tp_flags per threshold)
+        self.dets: List[List[Tuple[float, np.ndarray]]] = \
+            [[] for _ in range(num_classes)]
+        self.n_gt = np.zeros(num_classes, np.int64)
+
+    def add_image(self, boxes, scores, labels, gt_boxes, gt_labels,
+                  difficult=None):
+        boxes = np.asarray(boxes, np.float64).reshape(-1, 4)
+        scores = np.asarray(scores, np.float64).reshape(-1)
+        labels = np.asarray(labels, np.int64).reshape(-1)
+        gt_boxes = np.asarray(gt_boxes, np.float64).reshape(-1, 4)
+        gt_labels = np.asarray(gt_labels, np.int64).reshape(-1)
+        difficult = (np.zeros(len(gt_labels), bool) if difficult is None
+                     else np.asarray(difficult, bool).reshape(-1))
+        for c in range(self.num_classes):
+            det_sel = labels == c
+            gt_sel = gt_labels == c
+            self.n_gt[c] += int((gt_sel & ~difficult).sum())
+            db = boxes[det_sel]
+            ds = scores[det_sel]
+            gb = gt_boxes[gt_sel]
+            gd = difficult[gt_sel]
+            if ds.size == 0:
+                continue
+            order = np.argsort(-ds, kind="stable")
+            iou = box_iou_np(db[order], gb) if gb.size else \
+                np.zeros((ds.size, 0))
+            # flags: 0 = FP, 1 = TP, 2 = ignore (matched a difficult GT —
+            # VOC rule: neither TP nor FP)
+            flags = np.zeros((ds.size, len(self.thresholds)), np.int8)
+            for ti, thr in enumerate(self.thresholds):
+                matched = np.zeros(len(gb), bool)
+                for di in range(ds.size):
+                    if iou.shape[1] == 0:
+                        continue
+                    cand = iou[di].copy()
+                    cand[matched] = -1.0
+                    gi = int(np.argmax(cand))
+                    if cand[gi] >= thr:
+                        if gd[gi]:
+                            flags[di, ti] = 2    # difficult: not consumed
+                        else:
+                            matched[gi] = True
+                            flags[di, ti] = 1
+            for di in range(ds.size):
+                self.dets[c].append((float(ds[order][di]), flags[di]))
+
+    def compute(self, use_07_metric: bool) -> Dict[str, float]:
+        aps = np.full((self.num_classes, len(self.thresholds)), np.nan)
+        for c in range(self.num_classes):
+            if not self.dets[c] and self.n_gt[c] == 0:
+                continue
+            scores = np.asarray([d[0] for d in self.dets[c]])
+            flags = (np.stack([d[1] for d in self.dets[c]])
+                     if self.dets[c] else
+                     np.zeros((0, len(self.thresholds)), np.int8))
+            for ti in range(len(self.thresholds)):
+                if flags.size:
+                    keep = flags[:, ti] != 2     # drop ignored detections
+                    aps[c, ti] = average_precision(
+                        scores[keep], flags[keep, ti] == 1,
+                        int(self.n_gt[c]), use_07_metric)
+                else:
+                    aps[c, ti] = average_precision(
+                        scores, np.zeros(0, bool), int(self.n_gt[c]),
+                        use_07_metric)
+        return {"ap_matrix": aps,
+                "map": float(np.nanmean(aps)) if np.isfinite(aps).any()
+                else 0.0}
+
+
+class MeanAveragePrecision(ValidationMethod):
+    """mAP over xyxy box detections.
+
+    `batch(output, target)`: output is a per-image list of
+    (boxes, scores, labels); target a per-image list of
+    (gt_boxes, gt_labels[, difficult]). Styles:
+      * VOC: single IoU threshold (default 0.5), optional 11-point metric
+        (reference: MeanAveragePrecisionObjectDetection, use07metric)
+      * COCO: IoU swept over 0.5:0.05:0.95, averaged
+        (reference: the COCO branch of ValidationMethod.scala:230+)
+    """
+
+    def __init__(self, num_classes: int, iou: float = 0.5,
+                 use_07_metric: bool = False, coco: bool = False,
+                 name: Optional[str] = None):
+        self.num_classes = num_classes
+        self.use_07_metric = use_07_metric
+        self.thresholds = (list(np.arange(0.5, 0.9999, 0.05)) if coco
+                           else [iou])
+        self.coco = coco
+        self.name = name or ("COCOMeanAveragePrecision" if coco
+                             else "MeanAveragePrecision")
+        self.reset()
+
+    def reset(self):
+        self._acc = _Accumulator(self.num_classes, self.thresholds)
+
+    def batch(self, output, target):
+        for det, gt in zip(output, target):
+            boxes, scores, labels = det[0], det[1], det[2]
+            gt_boxes, gt_labels = gt[0], gt[1]
+            difficult = gt[2] if len(gt) > 2 else None
+            self._acc.add_image(boxes, scores, labels, gt_boxes, gt_labels,
+                                difficult)
+        acc = self._acc
+        use07 = self.use_07_metric
+        return ValidationResult(
+            (0.0, 0.0), lambda _vals: acc.compute(use07)["map"])
+
+    def per_class(self) -> Dict[str, float]:
+        aps = self._acc.compute(self.use_07_metric)["ap_matrix"]
+        return {f"class_{c}": float(np.nanmean(aps[c]))
+                for c in range(self.num_classes)}
+
+
+class MaskMeanAveragePrecision(MeanAveragePrecision):
+    """Segmentation mAP: IoU computed on RLE masks instead of boxes
+    (reference: MeanAveragePrecision mask branch for MaskRCNN). Detections
+    carry (masks, scores, labels) where masks are RLE counts lists with a
+    shared (h, w); targets (gt_masks, gt_labels[, difficult])."""
+
+    def __init__(self, num_classes: int, size: Tuple[int, int],
+                 coco: bool = True, name: Optional[str] = None):
+        self.size = size
+        super().__init__(num_classes, coco=coco,
+                         name=name or "MaskMeanAveragePrecision")
+
+    def batch(self, output, target):
+        from bigdl_tpu.dataset.segmentation import rle_decode
+        h, w = self.size
+
+        def to_boxes_via_masks(masks):
+            # decode each RLE to a flat bitmap; IoU matrix computed densely
+            return [rle_decode(m, h, w).astype(bool) for m in masks]
+
+        for det, gt in zip(output, target):
+            masks, scores, labels = det[0], det[1], det[2]
+            gt_masks, gt_labels = gt[0], gt[1]
+            dm = to_boxes_via_masks(masks)
+            gm = to_boxes_via_masks(gt_masks)
+            self._add_mask_image(dm, scores, labels, gm, gt_labels)
+        acc = self._acc
+        use07 = self.use_07_metric
+        return ValidationResult(
+            (0.0, 0.0), lambda _vals: acc.compute(use07)["map"])
+
+    def _add_mask_image(self, masks, scores, labels, gt_masks, gt_labels):
+        scores = np.asarray(scores, np.float64).reshape(-1)
+        labels = np.asarray(labels, np.int64).reshape(-1)
+        gt_labels = np.asarray(gt_labels, np.int64).reshape(-1)
+        iou_full = np.zeros((len(masks), len(gt_masks)))
+        for i, m in enumerate(masks):
+            for j, g in enumerate(gt_masks):
+                union = np.logical_or(m, g).sum()
+                iou_full[i, j] = (np.logical_and(m, g).sum() / union
+                                  if union else 0.0)
+        for c in range(self.num_classes):
+            det_sel = np.flatnonzero(labels == c)
+            gt_sel = np.flatnonzero(gt_labels == c)
+            self._acc.n_gt[c] += len(gt_sel)
+            if det_sel.size == 0:
+                continue
+            order = det_sel[np.argsort(-scores[det_sel], kind="stable")]
+            iou = iou_full[np.ix_(order, gt_sel)]
+            tps = np.zeros((len(order), len(self.thresholds)), bool)
+            for ti, thr in enumerate(self.thresholds):
+                matched = np.zeros(len(gt_sel), bool)
+                for di in range(len(order)):
+                    if iou.shape[1] == 0:
+                        continue
+                    cand = iou[di].copy()
+                    cand[matched] = -1.0
+                    gi = int(np.argmax(cand))
+                    if cand[gi] >= thr:
+                        matched[gi] = True
+                        tps[di, ti] = True
+            for di in range(len(order)):
+                self._acc.dets[c].append((float(scores[order][di]), tps[di]))
