@@ -1,5 +1,6 @@
 """bigdl_tpu.interop — model format importers/exporters
 (reference: utils/caffe/, utils/tf/, utils/TorchFile.scala,
-utils/ConvertModel.scala; SURVEY.md §2.8)."""
+utils/ConvertModel.scala, pyspark/bigdl/contrib/onnx/; SURVEY.md §2.8)."""
 
-from bigdl_tpu.interop import caffe, protowire, tensorflow, torchfile
+from bigdl_tpu.interop import (caffe, onnx, protowire, tensorflow,
+                               torchfile)

@@ -19,11 +19,11 @@ import numpy as np
 
 def _fmt(path: str) -> str:
     for ext, fmt in ((".bigdl-tpu", "bigdl"), (".caffemodel", "caffe"),
-                     (".t7", "torch")):
+                     (".t7", "torch"), (".onnx", "onnx"), (".pb", "tf")):
         if path.endswith(ext):
             return fmt
     raise ValueError(f"cannot infer format of {path!r} "
-                     f"(.bigdl-tpu | .caffemodel | .t7)")
+                     f"(.bigdl-tpu | .caffemodel | .t7 | .onnx | .pb)")
 
 
 def _params_to_table(params, prefix=""):
@@ -59,6 +59,12 @@ def convert(input_path: str, output_path: str, module_path: str = None):
 
     if src == "bigdl":
         module, params, state = load_module(input_path)
+    elif src == "onnx":
+        from bigdl_tpu.interop.onnx import load_model as load_onnx
+        module, params, state, _ = load_onnx(input_path)
+    elif src == "tf":
+        from bigdl_tpu.interop.tf_convert import load_model as load_tf
+        module, params, state, _ = load_tf(input_path)
     else:
         if not module_path:
             raise ValueError(f"importing from {src} needs --module "
@@ -71,6 +77,9 @@ def convert(input_path: str, output_path: str, module_path: str = None):
             from bigdl_tpu.interop import torchfile
             params = _table_to_params(torchfile.load(input_path), params)
 
+    if dst in ("onnx", "tf"):
+        raise ValueError(f"{dst} is an import-only format (like the "
+                         f"reference's onnx_loader / TensorflowLoader)")
     if dst == "bigdl":
         save_module(output_path, module, params, state)
     elif dst == "caffe":

@@ -1,0 +1,268 @@
+"""ONNX importer goldens vs torch (reference:
+pyspark/bigdl/contrib/onnx/onnx_loader.py + ops_mapping.py — the import
+surface; torch supplies the numerical ground truth for each op since ONNX
+semantics are NCHW/torch-shaped)."""
+
+import numpy as np
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.interop.onnx import (load_model, make_graph, make_model,
+                                    make_node, parse_model, to_module)
+
+
+def _run(model_bytes, *xs):
+    module, params, state, name_map = load_model(model_bytes)
+    out, _ = module.apply(params, state,
+                          *[jnp.asarray(x) for x in xs], training=False)
+    return np.asarray(out), (module, params, state, name_map)
+
+
+def test_onnx_convnet_matches_torch():
+    r = np.random.RandomState(0)
+    w1 = (r.randn(8, 3, 3, 3) * 0.2).astype(np.float32)
+    b1 = (r.randn(8) * 0.1).astype(np.float32)
+    scale = (r.rand(8) + 0.5).astype(np.float32)
+    beta = (r.randn(8) * 0.1).astype(np.float32)
+    mean = (r.randn(8) * 0.1).astype(np.float32)
+    var = (r.rand(8) + 0.5).astype(np.float32)
+    wfc = (r.randn(10, 8 * 4 * 4) * 0.1).astype(np.float32)
+    bfc = (r.randn(10) * 0.1).astype(np.float32)
+
+    graph = make_graph(
+        nodes=[
+            make_node("Conv", ["x", "w1", "b1"], ["c1"],
+                      kernel_shape=[3, 3], strides=[1, 1],
+                      pads=[1, 1, 1, 1]),
+            make_node("BatchNormalization",
+                      ["c1", "scale", "beta", "mean", "var"], ["bn"],
+                      epsilon=1e-5),
+            make_node("Relu", ["bn"], ["r1"]),
+            make_node("MaxPool", ["r1"], ["p1"], kernel_shape=[2, 2],
+                      strides=[2, 2]),
+            make_node("Flatten", ["p1"], ["fl"], axis=1),
+            make_node("Gemm", ["fl", "wfc", "bfc"], ["logits"],
+                      transB=1),
+            make_node("Softmax", ["logits"], ["prob"], axis=-1),
+        ],
+        inputs={"x": [2, 3, 8, 8]},
+        outputs=["prob"],
+        initializers={"w1": w1, "b1": b1, "scale": scale, "beta": beta,
+                      "mean": mean, "var": var, "wfc": wfc, "bfc": bfc})
+    model = make_model(graph)
+
+    x = r.randn(2, 3, 8, 8).astype(np.float32)
+    got, (module, params, state, name_map) = _run(model, x)
+
+    tm = torch.nn.Sequential(
+        torch.nn.Conv2d(3, 8, 3, padding=1),
+        torch.nn.BatchNorm2d(8, eps=1e-5),
+        torch.nn.ReLU(),
+        torch.nn.MaxPool2d(2),
+        torch.nn.Flatten(),
+        torch.nn.Linear(8 * 4 * 4, 10),
+        torch.nn.Softmax(dim=-1))
+    with torch.no_grad():
+        tm[0].weight.copy_(torch.from_numpy(w1))
+        tm[0].bias.copy_(torch.from_numpy(b1))
+        tm[1].weight.copy_(torch.from_numpy(scale))
+        tm[1].bias.copy_(torch.from_numpy(beta))
+        tm[1].running_mean.copy_(torch.from_numpy(mean))
+        tm[1].running_var.copy_(torch.from_numpy(var))
+        tm[5].weight.copy_(torch.from_numpy(wfc))
+        tm[5].bias.copy_(torch.from_numpy(bfc))
+    tm.eval()
+    with torch.no_grad():
+        want = tm(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(got, want, atol=2e-5)
+    assert "c1" in name_map and "prob" in name_map
+
+
+def test_onnx_gemm_alpha_beta_trans():
+    r = np.random.RandomState(1)
+    a = r.randn(4, 6).astype(np.float32)
+    b = r.randn(5, 6).astype(np.float32)        # transB
+    c = r.randn(5).astype(np.float32)
+    graph = make_graph(
+        [make_node("Gemm", ["a", "b", "c"], ["y"],
+                   alpha=0.5, beta=2.0, transB=1)],
+        inputs={"a": [4, 6]}, outputs=["y"],
+        initializers={"b": b, "c": c})
+    got, _ = _run(make_model(graph), a)
+    want = 0.5 * a @ b.T + 2.0 * c
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_onnx_avgpool_semantics():
+    r = np.random.RandomState(2)
+    x = r.randn(1, 3, 7, 7).astype(np.float32)
+    graph = make_graph(
+        [make_node("AveragePool", ["x"], ["y"], kernel_shape=[3, 3],
+                   strides=[2, 2], pads=[1, 1, 1, 1],
+                   count_include_pad=0)],
+        inputs={"x": [1, 3, 7, 7]}, outputs=["y"], initializers={})
+    got, _ = _run(make_model(graph), x)
+    want = torch.nn.functional.avg_pool2d(
+        torch.from_numpy(x), 3, 2, padding=1,
+        count_include_pad=False).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+    graph = make_graph(
+        [make_node("GlobalAveragePool", ["x"], ["y"])],
+        inputs={"x": [1, 3, 7, 7]}, outputs=["y"], initializers={})
+    got, _ = _run(make_model(graph), x)
+    np.testing.assert_allclose(got, x.mean(axis=(2, 3), keepdims=True),
+                               atol=1e-5)
+
+
+def test_onnx_maxpool_ceil_mode():
+    r = np.random.RandomState(3)
+    x = r.randn(1, 2, 7, 7).astype(np.float32)
+    graph = make_graph(
+        [make_node("MaxPool", ["x"], ["y"], kernel_shape=[3, 3],
+                   strides=[2, 2], ceil_mode=1)],
+        inputs={"x": [1, 2, 7, 7]}, outputs=["y"], initializers={})
+    got, _ = _run(make_model(graph), x)
+    want = torch.nn.functional.max_pool2d(
+        torch.from_numpy(x), 3, 2, ceil_mode=True).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_onnx_residual_and_broadcast():
+    r = np.random.RandomState(4)
+    x = r.randn(2, 4, 5, 5).astype(np.float32)
+    w = (r.randn(4, 4, 1, 1) * 0.3).astype(np.float32)
+    chan = r.randn(1, 4, 1, 1).astype(np.float32)
+    graph = make_graph(
+        [
+            make_node("Conv", ["x", "w"], ["c"], kernel_shape=[1, 1]),
+            make_node("Add", ["c", "x"], ["res"]),        # residual
+            make_node("Add", ["res", "chan"], ["shift"]),  # per-channel
+            make_node("Mul", ["shift", "two"], ["sc"]),    # scalar
+            make_node("Add", ["sc", "wvec"], ["y"]),       # 1-D → W axis
+        ],
+        inputs={"x": [2, 4, 5, 5]}, outputs=["y"],
+        initializers={"w": w, "chan": chan,
+                      "two": np.float32(2.0).reshape(()),
+                      "wvec": np.arange(5, dtype=np.float32)})
+    got, _ = _run(make_model(graph), x)
+    conv = torch.nn.functional.conv2d(torch.from_numpy(x),
+                                      torch.from_numpy(w)).numpy()
+    want = (conv + x + chan) * 2.0 + np.arange(5, dtype=np.float32)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_onnx_concat_branches():
+    r = np.random.RandomState(5)
+    x = r.randn(1, 3, 6, 6).astype(np.float32)
+    wa = (r.randn(4, 3, 1, 1) * 0.4).astype(np.float32)
+    wb = (r.randn(2, 3, 3, 3) * 0.2).astype(np.float32)
+    graph = make_graph(
+        [
+            make_node("Conv", ["x", "wa"], ["a"], kernel_shape=[1, 1]),
+            make_node("Conv", ["x", "wb"], ["b"], kernel_shape=[3, 3],
+                      pads=[1, 1, 1, 1]),
+            make_node("Concat", ["a", "b"], ["y"], axis=1),
+        ],
+        inputs={"x": [1, 3, 6, 6]}, outputs=["y"],
+        initializers={"wa": wa, "wb": wb})
+    got, _ = _run(make_model(graph), x)
+    ta = torch.nn.functional.conv2d(torch.from_numpy(x),
+                                    torch.from_numpy(wa))
+    tb = torch.nn.functional.conv2d(torch.from_numpy(x),
+                                    torch.from_numpy(wb), padding=1)
+    want = torch.cat([ta, tb], dim=1).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_onnx_conv_transpose():
+    r = np.random.RandomState(6)
+    x = r.randn(1, 3, 4, 4).astype(np.float32)
+    w = (r.randn(3, 5, 3, 3) * 0.3).astype(np.float32)   # (Cin, Cout, kh, kw)
+    b = (r.randn(5) * 0.1).astype(np.float32)
+    graph = make_graph(
+        [make_node("ConvTranspose", ["x", "w", "b"], ["y"],
+                   kernel_shape=[3, 3], strides=[2, 2],
+                   pads=[1, 1, 1, 1], output_padding=[1, 1])],
+        inputs={"x": [1, 3, 4, 4]}, outputs=["y"], initializers={"w": w,
+                                                                 "b": b})
+    got, _ = _run(make_model(graph), x)
+    want = torch.nn.functional.conv_transpose2d(
+        torch.from_numpy(x), torch.from_numpy(w), torch.from_numpy(b),
+        stride=2, padding=1, output_padding=1).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_onnx_gather_embedding_mean():
+    r = np.random.RandomState(7)
+    emb = r.randn(20, 6).astype(np.float32)
+    idx = np.array([[1, 4, 9], [0, 2, 19]], np.int32)
+    graph = make_graph(
+        [
+            make_node("Gather", ["emb", "idx"], ["e"], axis=0),
+            make_node("ReduceMean", ["e"], ["m"], axes=[1], keepdims=0),
+        ],
+        inputs={"idx": [2, 3]}, outputs=["m"], initializers={"emb": emb})
+    got, _ = _run(make_model(graph), idx)
+    np.testing.assert_allclose(got, emb[idx].mean(axis=1), atol=1e-5)
+
+
+def test_onnx_activation_tail():
+    r = np.random.RandomState(8)
+    x = r.randn(3, 5).astype(np.float32)
+    graph = make_graph(
+        [
+            make_node("LeakyRelu", ["x"], ["a"], alpha=0.2),
+            make_node("Clip", ["a"], ["b"], min=-0.5, max=0.5),
+            make_node("Erf", ["b"], ["y"]),
+        ],
+        inputs={"x": [3, 5]}, outputs=["y"], initializers={})
+    got, _ = _run(make_model(graph), x)
+    want = torch.erf(torch.clamp(
+        torch.nn.functional.leaky_relu(torch.from_numpy(x), 0.2),
+        -0.5, 0.5)).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_onnx_imported_model_is_trainable():
+    r = np.random.RandomState(9)
+    w1 = (r.randn(4, 3, 3, 3) * 0.2).astype(np.float32)
+    wfc = (r.randn(3, 4) * 0.3).astype(np.float32)
+    graph = make_graph(
+        [
+            make_node("Conv", ["x", "w1"], ["c"], kernel_shape=[3, 3],
+                      pads=[1, 1, 1, 1]),
+            make_node("Relu", ["c"], ["rl"]),
+            make_node("GlobalAveragePool", ["rl"], ["g"]),
+            make_node("Flatten", ["g"], ["f"], axis=1),
+            make_node("MatMul", ["f", "wfc"], ["y"]),
+        ],
+        inputs={"x": [4, 3, 8, 8]}, outputs=["y"],
+        initializers={"w1": w1, "wfc": wfc.T.copy()})
+    module, params, state, _ = to_module(parse_model(make_model(graph)))
+    crit = nn.CrossEntropyCriterion()
+    x = jnp.asarray(r.randn(4, 3, 8, 8), jnp.float32)
+    y = jnp.asarray([0, 1, 2, 0], jnp.int32)
+
+    def loss_fn(p):
+        out, _ = module.apply(p, state, x, training=True,
+                              rng=jax.random.PRNGKey(0))
+        return crit.forward(out, y)
+
+    l0, grads = jax.value_and_grad(loss_fn)(params)
+    gnorm = sum(float(jnp.abs(g).sum()) for g in jax.tree.leaves(grads))
+    assert gnorm > 0
+    p2 = jax.tree.map(lambda p, g: p - 0.1 * g, params, grads)
+    assert float(loss_fn(p2)) < float(l0)
+
+
+def test_onnx_unsupported_op_raises():
+    graph = make_graph(
+        [make_node("FancyNewOp", ["x"], ["y"])],
+        inputs={"x": [1, 4]}, outputs=["y"], initializers={})
+    with pytest.raises(NotImplementedError, match="FancyNewOp"):
+        to_module(parse_model(make_model(graph)))
