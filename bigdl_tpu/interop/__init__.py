@@ -2,5 +2,5 @@
 (reference: utils/caffe/, utils/tf/, utils/TorchFile.scala,
 utils/ConvertModel.scala, pyspark/bigdl/contrib/onnx/; SURVEY.md §2.8)."""
 
-from bigdl_tpu.interop import (caffe, onnx, protowire, tensorflow,
-                               torchfile)
+from bigdl_tpu.interop import (caffe, keras_loader, onnx, protowire,
+                               tensorflow, torchfile)

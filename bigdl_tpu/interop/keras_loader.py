@@ -1,0 +1,842 @@
+"""Keras model JSON/HDF5 loader (reference:
+pyspark/bigdl/keras/converter.py:32-218 — DefinitionLoader builds a BigDL
+graph from `model.to_json()` and WeightLoader copies HDF5 weights in;
+pyspark/bigdl/nn/layer.py:791 `Model.load_keras`).
+
+Design notes:
+- Targets the Keras 2 serialization format (`class_name` + `config` tree
+  for Sequential and Functional models; `save_weights()` / `model.save()`
+  legacy HDF5 layout). The reference targeted Keras 1.2.2 — same shape of
+  problem, updated vocabulary.
+- Keras is channels-last like this framework, so Conv2D kernels
+  (kh, kw, cin, cout) and Dense kernels (in, out) drop straight into our
+  `ParamSpec` layouts — no transposition, unlike the reference's dim-ordering
+  shuffles (converter.py WeightsConverter.convert_convolution2d).
+- Carries a shape-inference pass (the reference leans on Keras itself for
+  shapes, KerasLayer.scala computeOutputShape): each builder maps an input
+  shape `(None, ...)` to its output shape so Dense/Conv/BN dims never need
+  to be hand-supplied.
+- Definition-only loads (`model_from_json`) produce randomly-initialized
+  trainable models; HDF5 weights overlay by layer name.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.container import Graph, Input
+from bigdl_tpu.core.module import Module
+
+Shape = Tuple[Optional[int], ...]
+
+
+# ----------------------------------------------------------- local modules
+class _GlobalMaxPool2D(Module):
+    def forward(self, params, x, **_):
+        return jnp.max(x, axis=(1, 2))
+
+
+class _GlobalPool1D(Module):
+    def __init__(self, op: str, name=None):
+        super().__init__(name=name)
+        self.op = op
+
+    def forward(self, params, x, **_):
+        f = jnp.mean if self.op == "avg" else jnp.max
+        return f(x, axis=1)
+
+
+class _Merge(Module):
+    """Keras merge layers (Add/Multiply/Average/...)."""
+
+    def __init__(self, mode: str, name=None):
+        super().__init__(name=name)
+        self.mode = mode
+
+    def forward(self, params, *xs, **_):
+        if len(xs) == 1 and isinstance(xs[0], (tuple, list)):
+            xs = tuple(xs[0])
+        if self.mode == "add":
+            out = sum(xs[1:], xs[0])
+        elif self.mode == "mul":
+            out = xs[0]
+            for x in xs[1:]:
+                out = out * x
+        elif self.mode == "avg":
+            out = sum(xs[1:], xs[0]) / len(xs)
+        elif self.mode == "sub":
+            out = xs[0] - xs[1]
+        elif self.mode == "max":
+            out = xs[0]
+            for x in xs[1:]:
+                out = jnp.maximum(out, x)
+        elif self.mode == "min":
+            out = xs[0]
+            for x in xs[1:]:
+                out = jnp.minimum(out, x)
+        else:
+            raise ValueError(self.mode)
+        return out
+
+
+class _Pad1D(Module):
+    def __init__(self, left: int, right: int, name=None):
+        super().__init__(name=name)
+        self.left, self.right = left, right
+
+    def forward(self, params, x, **_):
+        return jnp.pad(x, [(0, 0), (self.left, self.right), (0, 0)])
+
+
+# -------------------------------------------------------------- activations
+_ACTIVATIONS: Dict[str, Callable[[], Module]] = {
+    "relu": nn.ReLU, "sigmoid": nn.Sigmoid, "tanh": nn.Tanh,
+    "softmax": lambda: nn.SoftMax(axis=-1), "softplus": nn.SoftPlus,
+    "softsign": nn.SoftSign, "elu": nn.ELU, "selu": nn.SELU,
+    "gelu": nn.GELU, "swish": nn.Swish, "silu": nn.Swish,
+    "hard_sigmoid": nn.HardSigmoid, "linear": nn.Identity,
+    "exponential": nn.Exp,
+}
+
+
+def _activation(name: str) -> Optional[Module]:
+    if name in (None, "linear"):
+        return None
+    if name not in _ACTIVATIONS:
+        raise NotImplementedError(f"keras activation {name!r}")
+    return _ACTIVATIONS[name]()
+
+
+def _maybe_act(module: Module, cfg: dict,
+               adapter) -> Tuple[Module, Callable]:
+    """Wrap `module` with its fused activation; re-root the weight adapter."""
+    act = _activation(cfg.get("activation", "linear"))
+    if act is None:
+        return module, adapter
+    seq = nn.Sequential()
+    seq.add(module)
+    seq.add(act)
+    def wrapped(wts):
+        p, s = adapter(wts)
+        return {"0": p}, ({"0": s} if s else {})
+    return seq, wrapped
+
+
+def _pair(v) -> Tuple[int, int]:
+    return (v, v) if isinstance(v, int) else (int(v[0]), int(v[1]))
+
+
+def _conv_out(n: Optional[int], k: int, s: int, same: bool) -> Optional[int]:
+    if n is None:
+        return None
+    return math.ceil(n / s) if same else (n - k) // s + 1
+
+
+# ------------------------------------------------------------ layer builders
+# each builder: (cfg, in_shapes: List[Shape]) →
+#   (module | None, out_shape, adapter(wts)->(params, state))
+_NO_W = lambda wts: ({}, {})
+
+
+def _b_input(cfg, shapes):
+    shape = tuple(cfg.get("batch_input_shape") or cfg.get("batch_shape"))
+    return None, shape, _NO_W
+
+
+def _b_dense(cfg, shapes):
+    cin = shapes[0][-1]
+    units = cfg["units"]
+    m = nn.Linear(cin, units, bias=cfg.get("use_bias", True))
+    def adapter(wts):
+        p = {"weight": wts[0]}
+        if len(wts) > 1:
+            p["bias"] = wts[1]
+        return p, {}
+    out = shapes[0][:-1] + (units,)
+    m, adapter = _maybe_act(m, cfg, adapter)
+    return m, out, adapter
+
+
+def _b_activation(cfg, shapes):
+    return _activation(cfg["activation"]), shapes[0], _NO_W
+
+
+def _b_dropout(cfg, shapes):
+    return nn.Dropout(cfg.get("rate", 0.5)), shapes[0], _NO_W
+
+
+def _b_flatten(cfg, shapes):
+    n = 1
+    for d in shapes[0][1:]:
+        n *= d
+    return nn.Flatten(), (shapes[0][0], n), _NO_W
+
+
+def _b_reshape(cfg, shapes):
+    tgt = tuple(cfg["target_shape"])
+    return (nn.Reshape(tgt, batch_mode=True), (shapes[0][0],) + tgt, _NO_W)
+
+
+def _b_permute(cfg, shapes):
+    dims = [d - 1 for d in cfg["dims"]]     # keras dims are 1-based
+    out = (shapes[0][0],) + tuple(shapes[0][1:][d] for d in dims)
+    return nn.Permute(dims), out, _NO_W
+
+
+def _b_repeat(cfg, shapes):
+    n = cfg["n"]
+    return (nn.Replicate(n, axis=1), (shapes[0][0], n) + shapes[0][1:],
+            _NO_W)
+
+
+def _b_conv2d(cfg, shapes):
+    b_, h, w, cin = shapes[0]
+    kh, kw = _pair(cfg["kernel_size"])
+    sh, sw = _pair(cfg.get("strides", 1))
+    dh, dw = _pair(cfg.get("dilation_rate", 1))
+    same = cfg.get("padding", "valid") == "same"
+    filters = cfg["filters"]
+    use_bias = cfg.get("use_bias", True)
+    pad = -1 if same else 0
+    if (dh, dw) != (1, 1):
+        m = nn.SpatialDilatedConvolution(cin, filters, kw, kh, sw, sh,
+                                         pad, pad, dw, dh, bias=use_bias)
+        ke_h, ke_w = (kh - 1) * dh + 1, (kw - 1) * dw + 1
+    else:
+        m = nn.SpatialConvolution(cin, filters, kw, kh, sw, sh, pad, pad,
+                                  bias=use_bias)
+        ke_h, ke_w = kh, kw
+    def adapter(wts):
+        p = {"weight": wts[0]}
+        if len(wts) > 1:
+            p["bias"] = wts[1]
+        return p, {}
+    out = (b_, _conv_out(h, ke_h, sh, same), _conv_out(w, ke_w, sw, same),
+           filters)
+    m, adapter = _maybe_act(m, cfg, adapter)
+    return m, out, adapter
+
+
+def _b_depthwise2d(cfg, shapes):
+    b_, h, w, cin = shapes[0]
+    kh, kw = _pair(cfg["kernel_size"])
+    sh, sw = _pair(cfg.get("strides", 1))
+    same = cfg.get("padding", "valid") == "same"
+    mult = cfg.get("depth_multiplier", 1)
+    use_bias = cfg.get("use_bias", True)
+    m = nn.SpatialConvolution(cin, cin * mult, kw, kh, sw, sh,
+                              -1 if same else 0, -1 if same else 0,
+                              n_group=cin, bias=use_bias)
+    def adapter(wts):
+        k = np.asarray(wts[0])              # (kh, kw, cin, mult)
+        p = {"weight": k.reshape(k.shape[0], k.shape[1], 1, -1)}
+        if len(wts) > 1:
+            p["bias"] = wts[1]
+        return p, {}
+    out = (b_, _conv_out(h, kh, sh, same), _conv_out(w, kw, sw, same),
+           cin * mult)
+    m, adapter = _maybe_act(m, cfg, adapter)
+    return m, out, adapter
+
+
+def _b_sepconv2d(cfg, shapes):
+    b_, h, w, cin = shapes[0]
+    kh, kw = _pair(cfg["kernel_size"])
+    sh, sw = _pair(cfg.get("strides", 1))
+    same = cfg.get("padding", "valid") == "same"
+    mult = cfg.get("depth_multiplier", 1)
+    filters = cfg["filters"]
+    use_bias = cfg.get("use_bias", True)
+    m = nn.SpatialSeparableConvolution(cin, filters, mult, kw, kh, sw, sh,
+                                       -1 if same else 0, -1 if same else 0,
+                                       bias=use_bias)
+    def adapter(wts):
+        depth = np.asarray(wts[0])
+        p = {"depth_weight": depth.reshape(depth.shape[0], depth.shape[1],
+                                           1, -1),
+             "point_weight": wts[1]}
+        if len(wts) > 2:
+            p["bias"] = wts[2]
+        return p, {}
+    out = (b_, _conv_out(h, kh, sh, same), _conv_out(w, kw, sw, same),
+           filters)
+    m, adapter = _maybe_act(m, cfg, adapter)
+    return m, out, adapter
+
+
+def _b_conv2d_transpose(cfg, shapes):
+    b_, h, w, cin = shapes[0]
+    kh, kw = _pair(cfg["kernel_size"])
+    sh, sw = _pair(cfg.get("strides", 1))
+    same = cfg.get("padding", "valid") == "same"
+    filters = cfg["filters"]
+    use_bias = cfg.get("use_bias", True)
+    if same:
+        ph = max(0, -((sh - kh) // 2))      # ceil((k-s)/2)
+        pw_ = max(0, -((sw - kw) // 2))
+        ah = max(0, sh - kh + 2 * ph)
+        aw = max(0, sw - kw + 2 * pw_)
+        oh = None if h is None else h * sh
+        ow = None if w is None else w * sw
+    else:
+        ph = pw_ = ah = aw = 0
+        oh = None if h is None else (h - 1) * sh + kh
+        ow = None if w is None else (w - 1) * sw + kw
+    m = nn.SpatialFullConvolution(cin, filters, kw, kh, sw, sh, pw_, ph,
+                                  adj_w=aw, adj_h=ah, bias=use_bias)
+    def adapter(wts):
+        k = np.asarray(wts[0])              # keras: (kh, kw, out, in)
+        p = {"weight": np.transpose(k, (0, 1, 3, 2))}
+        if len(wts) > 1:
+            p["bias"] = wts[1]
+        return p, {}
+    out = (b_, oh, ow, filters)
+    m, adapter = _maybe_act(m, cfg, adapter)
+    return m, out, adapter
+
+
+def _b_conv1d(cfg, shapes):
+    b_, t, cin = shapes[0]
+    k = cfg["kernel_size"][0] if isinstance(cfg["kernel_size"],
+                                            (list, tuple)) \
+        else cfg["kernel_size"]
+    s = cfg.get("strides", 1)
+    s = s[0] if isinstance(s, (list, tuple)) else s
+    same = cfg.get("padding", "valid") == "same"
+    filters = cfg["filters"]
+    use_bias = cfg.get("use_bias", True)
+    conv = nn.TemporalConvolution(cin, filters, k, s, bias=use_bias)
+    def adapter(wts):
+        p = {"weight": wts[0]}
+        if len(wts) > 1:
+            p["bias"] = wts[1]
+        return p, {}
+    if same:
+        left = (k - 1) // 2
+        seq = nn.Sequential()
+        seq.add(_Pad1D(left, k - 1 - left))
+        seq.add(conv)
+        base = adapter
+        adapter = lambda wts: ({"1": base(wts)[0]}, {})
+        m = seq
+        ot = None if t is None else math.ceil(t / s)
+    else:
+        m = conv
+        ot = _conv_out(t, k, s, False)
+    out = (b_, ot, filters)
+    m, adapter = _maybe_act(m, cfg, adapter)
+    return m, out, adapter
+
+
+def _b_pool2d(cls):
+    def build(cfg, shapes):
+        b_, h, w, c = shapes[0]
+        kh, kw = _pair(cfg.get("pool_size", 2))
+        st = cfg.get("strides") or (kh, kw)
+        sh, sw = _pair(st)
+        same = cfg.get("padding", "valid") == "same"
+        pad = -1 if same else 0
+        if cls == "max":
+            m = nn.SpatialMaxPooling(kw, kh, sw, sh, pad, pad)
+        else:
+            m = nn.SpatialAveragePooling(kw, kh, sw, sh, pad, pad,
+                                         count_include_pad=False)
+        out = (b_, _conv_out(h, kh, sh, same), _conv_out(w, kw, sw, same), c)
+        return m, out, _NO_W
+    return build
+
+
+def _b_maxpool1d(cfg, shapes):
+    b_, t, c = shapes[0]
+    k = cfg.get("pool_size", 2)
+    k = k[0] if isinstance(k, (list, tuple)) else k
+    s = cfg.get("strides") or k
+    s = s[0] if isinstance(s, (list, tuple)) else s
+    if cfg.get("padding", "valid") == "same":
+        raise NotImplementedError("MaxPooling1D padding='same'")
+    return (nn.TemporalMaxPooling(k, s), (b_, _conv_out(t, k, s, False), c),
+            _NO_W)
+
+
+def _b_batchnorm(cfg, shapes):
+    axis = cfg.get("axis", -1)
+    if isinstance(axis, (list, tuple)):
+        axis = axis[0]
+    rank = len(shapes[0])
+    if axis not in (-1, rank - 1):
+        raise NotImplementedError(f"BatchNormalization axis={axis} "
+                                  f"(channels-last only)")
+    c = shapes[0][-1]
+    # keras momentum is the OLD-average weight; ours is the batch weight
+    m = nn.BatchNormalization(c, eps=cfg.get("epsilon", 1e-3),
+                              momentum=1.0 - cfg.get("momentum", 0.99))
+    scale = cfg.get("scale", True)
+    center = cfg.get("center", True)
+    def adapter(wts):
+        i = 0
+        p = {}
+        if scale:
+            p["weight"] = wts[i]; i += 1
+        if center:
+            p["bias"] = wts[i]; i += 1
+        s = {"running_mean": wts[i], "running_var": wts[i + 1]}
+        return p, s
+    return m, shapes[0], adapter
+
+
+def _b_embedding(cfg, shapes):
+    m = nn.LookupTable(cfg["input_dim"], cfg["output_dim"])
+    out = shapes[0] + (cfg["output_dim"],)
+    return m, out, lambda wts: ({"weight": wts[0]}, {})
+
+
+def _gru_reorder(k, h):
+    """keras [z|r|h] blocks → our [r|u|c] order."""
+    return np.concatenate([k[..., h:2 * h], k[..., :h], k[..., 2 * h:]],
+                          axis=-1)
+
+
+def _rnn_cell(cls: str, cfg, cin: int):
+    units = cfg["units"]
+    if cls == "LSTM":
+        if cfg.get("activation", "tanh") != "tanh" or \
+                cfg.get("recurrent_activation", "sigmoid") not in (
+                    "sigmoid", "hard_sigmoid"):
+            raise NotImplementedError("LSTM with non-default activations")
+        cell = nn.LSTM(cin, units)
+        def adapt(wts):
+            p = {"w_i": wts[0], "w_h": wts[1]}
+            if len(wts) > 2:
+                b = np.asarray(wts[2])
+                p["bias"] = b.sum(axis=0) if b.ndim == 2 else b
+            return p
+        return cell, adapt
+    if cls == "GRU":
+        if cfg.get("reset_after", False):
+            raise NotImplementedError(
+                "GRU reset_after=True (keras 2.x CuDNN variant) — the "
+                "recurrent bias cannot fold into the packed-gate cell")
+        cell = nn.GRU(cin, units)
+        def adapt(wts):
+            ki = _gru_reorder(np.asarray(wts[0]), units)
+            kr = np.asarray(wts[1])
+            p = {"w_i": ki,
+                 "w_h": np.concatenate([kr[:, units:2 * units],
+                                        kr[:, :units]], axis=-1),
+                 "w_hc": kr[:, 2 * units:]}
+            if len(wts) > 2:
+                p["bias"] = _gru_reorder(np.asarray(wts[2]).reshape(-1)
+                                         [:3 * units], units)
+            return p
+        return cell, adapt
+    if cls == "SimpleRNN":
+        cell = nn.RnnCell(cin, units)
+        def adapt(wts):
+            p = {"w_i": wts[0], "w_h": wts[1]}
+            if len(wts) > 2:
+                p["bias"] = wts[2]
+            return p
+        return cell, adapt
+    raise NotImplementedError(f"keras RNN {cls}")
+
+
+def _b_rnn(cls):
+    def build(cfg, shapes):
+        b_, t, cin = shapes[0]
+        cell, adapt = _rnn_cell(cls, cfg, cin)
+        ret_seq = cfg.get("return_sequences", False)
+        m = nn.Recurrent(cell, return_sequences=ret_seq,
+                         reverse=cfg.get("go_backwards", False))
+        out = (b_, t, cfg["units"]) if ret_seq else (b_, cfg["units"])
+        return m, out, lambda wts: ({"cell": adapt(wts)}, {})
+    return build
+
+
+def _b_bidirectional(cfg, shapes):
+    inner = cfg["layer"]
+    icls, icfg = inner["class_name"], inner["config"]
+    if not icfg.get("return_sequences", False):
+        raise NotImplementedError("Bidirectional(return_sequences=False)")
+    merge = cfg.get("merge_mode", "concat")
+    if merge not in ("concat", "sum"):
+        raise NotImplementedError(f"Bidirectional merge_mode={merge}")
+    b_, t, cin = shapes[0]
+    fwd, adapt = _rnn_cell(icls, icfg, cin)
+    bwd, _ = _rnn_cell(icls, icfg, cin)
+    m = nn.BiRecurrent(fwd, bwd, merge=merge)
+    units = icfg["units"]
+    out = (b_, t, units * (2 if merge == "concat" else 1))
+    def adapter(wts):
+        half = len(wts) // 2
+        return ({"fwd": {"cell": adapt(wts[:half])},
+                 "bwd": {"cell": adapt(wts[half:])}}, {})
+    return m, out, adapter
+
+
+def _b_timedistributed(cfg, shapes):
+    inner = cfg["layer"]
+    if inner["class_name"] != "Dense":
+        raise NotImplementedError("TimeDistributed supports Dense only "
+                                  "(Dense already maps over leading axes)")
+    return _b_dense(inner["config"], shapes)
+
+
+def _b_concat(cfg, shapes):
+    axis = cfg.get("axis", -1)
+    n = sum(s[axis] for s in shapes)
+    out = list(shapes[0])
+    out[axis] = n
+    return nn.JoinTable(axis), tuple(out), _NO_W
+
+
+def _b_merge(mode):
+    def build(cfg, shapes):
+        return _Merge(mode), shapes[0], _NO_W
+    return build
+
+
+def _b_zeropad2d(cfg, shapes):
+    p = cfg.get("padding", 1)
+    if isinstance(p, int):
+        pt = pb = pl = pr = p
+    elif isinstance(p[0], (list, tuple)):
+        (pt, pb), (pl, pr) = p
+    else:
+        pt = pb = p[0]
+        pl = pr = p[1]
+    b_, h, w, c = shapes[0]
+    out = (b_, None if h is None else h + pt + pb,
+           None if w is None else w + pl + pr, c)
+    return nn.SpatialZeroPadding(pl, pr, pt, pb), out, _NO_W
+
+
+def _b_upsample2d(cfg, shapes):
+    sh, sw = _pair(cfg.get("size", 2))
+    b_, h, w, c = shapes[0]
+    out = (b_, None if h is None else h * sh,
+           None if w is None else w * sw, c)
+    return nn.UpSampling2D((sh, sw)), out, _NO_W
+
+
+def _b_leakyrelu(cfg, shapes):
+    return (nn.LeakyReLU(cfg.get("alpha", cfg.get("negative_slope", 0.3))),
+            shapes[0], _NO_W)
+
+
+def _b_elu_layer(cfg, shapes):
+    return nn.ELU(cfg.get("alpha", 1.0)), shapes[0], _NO_W
+
+
+def _b_prelu(cfg, shapes):
+    shared = cfg.get("shared_axes") or []
+    rank = len(shapes[0])
+    if shared and sorted(shared) != list(range(1, rank - 1)):
+        raise NotImplementedError("PReLU with partial shared_axes")
+    n = shapes[0][-1] if shared or rank == 2 else None
+    if n is None and rank > 2:
+        raise NotImplementedError("PReLU with full alpha map — use "
+                                  "shared_axes over spatial dims")
+    m = nn.PReLU(n_output_plane=n)
+    return m, shapes[0], lambda wts: (
+        {"weight": np.asarray(wts[0]).reshape(-1)}, {})
+
+
+def _b_softmax_layer(cfg, shapes):
+    return nn.SoftMax(axis=cfg.get("axis", -1)), shapes[0], _NO_W
+
+
+def _b_spatialdropout(cls):
+    def build(cfg, shapes):
+        return cls(cfg.get("rate", 0.5)), shapes[0], _NO_W
+    return build
+
+
+def _b_masking(cfg, shapes):
+    return nn.Masking(cfg.get("mask_value", 0.0)), shapes[0], _NO_W
+
+
+def _b_layernorm(cfg, shapes):
+    axis = cfg.get("axis", -1)
+    if isinstance(axis, (list, tuple)):
+        axis = axis[0]
+    rank = len(shapes[0])
+    if axis not in (-1, rank - 1):
+        raise NotImplementedError("LayerNormalization: last-axis only")
+    m = nn.LayerNormalization(shapes[0][-1], eps=cfg.get("epsilon", 1e-3))
+    def adapter(wts):
+        return {"weight": wts[0], "bias": wts[1]}, {}
+    return m, shapes[0], adapter
+
+
+_BUILDERS: Dict[str, Callable] = {
+    "InputLayer": _b_input,
+    "Dense": _b_dense,
+    "Activation": _b_activation,
+    "Dropout": _b_dropout,
+    "Flatten": _b_flatten,
+    "Reshape": _b_reshape,
+    "Permute": _b_permute,
+    "RepeatVector": _b_repeat,
+    "Conv2D": _b_conv2d, "Convolution2D": _b_conv2d,
+    "DepthwiseConv2D": _b_depthwise2d,
+    "SeparableConv2D": _b_sepconv2d,
+    "Conv2DTranspose": _b_conv2d_transpose,
+    "Conv1D": _b_conv1d, "Convolution1D": _b_conv1d,
+    "MaxPooling2D": _b_pool2d("max"),
+    "AveragePooling2D": _b_pool2d("avg"),
+    "GlobalAveragePooling2D": lambda c, s: (
+        nn.GlobalAveragePooling2D(), (s[0][0], s[0][-1]), _NO_W),
+    "GlobalMaxPooling2D": lambda c, s: (
+        _GlobalMaxPool2D(), (s[0][0], s[0][-1]), _NO_W),
+    "MaxPooling1D": _b_maxpool1d,
+    "GlobalAveragePooling1D": lambda c, s: (
+        _GlobalPool1D("avg"), (s[0][0], s[0][-1]), _NO_W),
+    "GlobalMaxPooling1D": lambda c, s: (
+        _GlobalPool1D("max"), (s[0][0], s[0][-1]), _NO_W),
+    "BatchNormalization": _b_batchnorm,
+    "LayerNormalization": _b_layernorm,
+    "Embedding": _b_embedding,
+    "LSTM": _b_rnn("LSTM"), "GRU": _b_rnn("GRU"),
+    "SimpleRNN": _b_rnn("SimpleRNN"),
+    "Bidirectional": _b_bidirectional,
+    "TimeDistributed": _b_timedistributed,
+    "Concatenate": _b_concat, "Merge": _b_concat,
+    "Add": _b_merge("add"), "Multiply": _b_merge("mul"),
+    "Average": _b_merge("avg"), "Subtract": _b_merge("sub"),
+    "Maximum": _b_merge("max"), "Minimum": _b_merge("min"),
+    "ZeroPadding2D": _b_zeropad2d,
+    "UpSampling2D": _b_upsample2d,
+    "LeakyReLU": _b_leakyrelu,
+    "ELU": _b_elu_layer,
+    "PReLU": _b_prelu,
+    "Softmax": _b_softmax_layer,
+    "SpatialDropout1D": _b_spatialdropout(nn.SpatialDropout1D),
+    "SpatialDropout2D": _b_spatialdropout(nn.SpatialDropout2D),
+    "Masking": _b_masking,
+}
+
+
+def _build_layer(class_name: str, cfg: dict, in_shapes: List[Shape]):
+    if class_name not in _BUILDERS:
+        raise NotImplementedError(
+            f"keras layer {class_name!r} has no converter "
+            f"(reference: converter.py LayerConverter.create)")
+    return _BUILDERS[class_name](cfg, in_shapes)
+
+
+# ----------------------------------------------------------- model assembly
+class _Loaded:
+    """module + per-keras-layer weight plumbing."""
+
+    def __init__(self, module, adapters, key_of_layer):
+        self.module = module
+        self.adapters = adapters            # layer name → adapter
+        self.key_of_layer = key_of_layer    # layer name → param-tree key
+
+    def init(self, rng=None):
+        return self.module.init(rng if rng is not None
+                                else jax.random.PRNGKey(0))
+
+    def apply_weights(self, params, state, weight_table: Dict[str, list],
+                      by_name: bool = False):
+        """Overlay keras HDF5 weights onto (params, state) by layer name
+        (reference: WeightLoader.load_weights_from_hdf5 by_name contract)."""
+        missing = []
+        for lname, adapter in self.adapters.items():
+            if lname not in weight_table:
+                missing.append(lname)
+                continue
+            p_over, s_over = adapter(weight_table[lname])
+            key = self.key_of_layer[lname]
+            _merge_tree(params[key], p_over)
+            if s_over:
+                _merge_tree(state[key], s_over)
+        if missing and not by_name:
+            raise ValueError(f"HDF5 file is missing weights for layers "
+                             f"{missing} (pass by_name=True to skip)")
+        return params, state
+
+
+def _merge_tree(dst, over):
+    for k, v in over.items():
+        if isinstance(v, dict):
+            _merge_tree(dst[k], v)
+        else:
+            dst[k] = jnp.asarray(np.asarray(v))
+
+
+def _build_sequential(layers: List[dict]) -> _Loaded:
+    seq = nn.Sequential()
+    adapters, key_of_layer = {}, {}
+    shape: Optional[Shape] = None
+    idx = 0
+    for spec in layers:
+        cls, cfg = spec["class_name"], spec.get("config", {})
+        if shape is None and cls != "InputLayer":
+            bis = cfg.get("batch_input_shape") or cfg.get("batch_shape")
+            if bis is None:
+                raise ValueError("first keras layer carries no "
+                                 "batch_input_shape")
+            shape = tuple(bis)
+        module, shape, adapter = _build_layer(cls, cfg, [shape])
+        if module is None:
+            continue
+        seq.add(module)
+        lname = cfg.get("name", f"layer_{idx}")
+        if adapter is not _NO_W:
+            adapters[lname] = adapter
+        key_of_layer[lname] = str(idx)
+        idx += 1
+    return _Loaded(seq, adapters, key_of_layer)
+
+
+def _build_functional(config: dict) -> _Loaded:
+    layers = {sp["name"]: sp for sp in config["layers"]}
+    sym: Dict[str, object] = {}
+    shapes: Dict[str, Shape] = {}
+    adapters, node_of_layer = {}, {}
+
+    def inbound_names(spec) -> List[str]:
+        nodes = spec.get("inbound_nodes") or []
+        if not nodes:
+            return []
+        first = nodes[0]
+        if isinstance(first, dict):        # keras 3 "args" format
+            raise NotImplementedError(
+                "keras 3 inbound_nodes format; export with Keras 2 "
+                "(tf.keras) to_json")
+        return [entry[0] for entry in first]
+
+    remaining = list(config["layers"])
+    progress = True
+    while remaining and progress:
+        progress = False
+        rest = []
+        for spec in remaining:
+            name = spec["name"]
+            srcs = inbound_names(spec)
+            if any(s not in sym for s in srcs):
+                rest.append(spec)
+                continue
+            cls, cfg = spec["class_name"], spec.get("config", {})
+            if cls == "InputLayer" or not srcs:
+                _, shape, _ = _b_input(cfg, [])
+                sym[name] = Input()
+                shapes[name] = shape
+                node_of_layer[name] = sym[name]
+            else:
+                in_shapes = [shapes[s] for s in srcs]
+                module, out_shape, adapter = _build_layer(cls, cfg,
+                                                          in_shapes)
+                if module is None:
+                    sym[name] = sym[srcs[0]]
+                    shapes[name] = out_shape
+                else:
+                    sym[name] = module(*[sym[s] for s in srcs])
+                    shapes[name] = out_shape
+                    if adapter is not _NO_W:
+                        adapters[name] = adapter
+                    node_of_layer[name] = sym[name]
+            progress = True
+        remaining = rest
+    if remaining:
+        raise ValueError(f"unresolvable keras graph (cycle or missing "
+                         f"inputs): {[s['name'] for s in remaining]}")
+
+    in_names = [e[0] for e in config["input_layers"]]
+    out_names = [e[0] for e in config["output_layers"]]
+    g = Graph([sym[n] for n in in_names], [sym[n] for n in out_names])
+    key_of_layer = {n: g._node_key[id(node)]
+                    for n, node in node_of_layer.items()
+                    if id(node) in g._node_key}
+    adapters = {n: a for n, a in adapters.items() if n in key_of_layer}
+    return _Loaded(g, adapters, key_of_layer)
+
+
+def _build_from_config(tree: dict) -> _Loaded:
+    cls = tree.get("class_name")
+    config = tree.get("config")
+    if cls == "Sequential":
+        layers = config if isinstance(config, list) else config["layers"]
+        return _build_sequential(layers)
+    if cls in ("Model", "Functional"):
+        return _build_functional(config)
+    raise ValueError(f"unsupported keras model class {cls!r}")
+
+
+# ----------------------------------------------------------------- HDF5 IO
+def _h5_str(v) -> str:
+    return v.decode() if isinstance(v, bytes) else str(v)
+
+
+def _read_h5_weights(path: str) -> Dict[str, list]:
+    import h5py
+    table: Dict[str, list] = {}
+    with h5py.File(path, "r") as f:
+        g = f["model_weights"] if "model_weights" in f else f
+        names = [_h5_str(n) for n in g.attrs.get("layer_names", [])]
+        for ln in names:
+            lg = g[ln]
+            wnames = [_h5_str(n) for n in lg.attrs.get("weight_names", [])]
+            if wnames:
+                table[ln] = [np.asarray(lg[w]) for w in wnames]
+    return table
+
+
+def _read_h5_config(path: str) -> Optional[dict]:
+    import h5py
+    with h5py.File(path, "r") as f:
+        raw = f.attrs.get("model_config")
+        if raw is None:
+            return None
+        return json.loads(_h5_str(raw))
+
+
+# ----------------------------------------------------------------- public
+def model_from_json(json_str_or_path: str):
+    """Keras `model.to_json()` → (module, params, state, loaded).
+
+    `loaded.apply_weights(params, state, table)` overlays HDF5 weights
+    (reference: DefinitionLoader.from_json_path, converter.py:362)."""
+    s = json_str_or_path
+    if not s.lstrip().startswith("{"):
+        with open(s) as f:
+            s = f.read()
+    loaded = _build_from_config(json.loads(s))
+    params, state = loaded.init()
+    return loaded.module, params, state, loaded
+
+
+def load_keras(json_path: Optional[str] = None,
+               hdf5_path: Optional[str] = None,
+               by_name: bool = False):
+    """Definition (+ optional weights) → (module, params, state).
+
+    Mirrors the reference entry point `Model.load_keras(json_path,
+    hdf5_path)` (pyspark/bigdl/nn/layer.py:791): pass a to_json file and/or
+    a save_weights/model.save HDF5."""
+    if json_path is None and hdf5_path is None:
+        raise ValueError("need a model JSON and/or an HDF5 file")
+    if json_path is not None:
+        module, params, state, loaded = model_from_json(json_path)
+    else:
+        cfg = _read_h5_config(hdf5_path)
+        if cfg is None:
+            raise ValueError(f"{hdf5_path} has no model_config — pass the "
+                             f"model JSON too")
+        loaded = _build_from_config(cfg)
+        module = loaded.module
+        params, state = loaded.init()
+    if hdf5_path is not None:
+        table = _read_h5_weights(hdf5_path)
+        params, state = loaded.apply_weights(params, state, table,
+                                             by_name=by_name)
+    return module, params, state

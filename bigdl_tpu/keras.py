@@ -158,3 +158,16 @@ def Model(module: Module) -> KerasModel:
     """Wrap a Graph/Module as a compilable model
     (reference: nn/keras/Topology.scala Model)."""
     return KerasModel(module)
+
+
+def load_keras(json_path: Optional[str] = None,
+               hdf5_path: Optional[str] = None,
+               by_name: bool = False) -> Tuple[KerasModel, dict, dict]:
+    """Import a Keras to_json/HDF5 model as a compilable KerasModel
+    (reference: pyspark/bigdl/nn/layer.py:791 Model.load_keras).
+    Returns (model, params, state) — pass params/state to fit/predict."""
+    from bigdl_tpu.interop.keras_loader import load_keras as _load
+    module, params, state = _load(json_path, hdf5_path, by_name=by_name)
+    model = KerasModel(module)
+    model.params, model.model_state = params, state
+    return model, params, state
