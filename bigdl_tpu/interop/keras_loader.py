@@ -140,6 +140,21 @@ def _conv_out(n: Optional[int], k: int, s: int, same: bool) -> Optional[int]:
     return math.ceil(n / s) if same else (n - k) // s + 1
 
 
+def _reject_unsupported(cfg: dict, layer: str, *keys_defaults):
+    """Raise on config the builder cannot honor instead of silently
+    producing wrong numerics (e.g. channels_first layouts, dilated 1-D
+    convs)."""
+    if cfg.get("data_format", "channels_last") == "channels_first":
+        raise NotImplementedError(
+            f"{layer}: data_format='channels_first' (this framework is "
+            f"channels-last; re-export the model with channels_last)")
+    for key, default in keys_defaults:
+        v = cfg.get(key, default)
+        vs = v if isinstance(v, (list, tuple)) else [v]
+        if any(x != default for x in vs):
+            raise NotImplementedError(f"{layer}: {key}={v!r} unsupported")
+
+
 # ------------------------------------------------------------ layer builders
 # each builder: (cfg, in_shapes: List[Shape]) →
 #   (module | None, out_shape, adapter(wts)->(params, state))
@@ -198,21 +213,25 @@ def _b_repeat(cfg, shapes):
 
 
 def _b_conv2d(cfg, shapes):
+    _reject_unsupported(cfg, "Conv2D")
     b_, h, w, cin = shapes[0]
     kh, kw = _pair(cfg["kernel_size"])
     sh, sw = _pair(cfg.get("strides", 1))
     dh, dw = _pair(cfg.get("dilation_rate", 1))
+    groups = cfg.get("groups", 1)
     same = cfg.get("padding", "valid") == "same"
     filters = cfg["filters"]
     use_bias = cfg.get("use_bias", True)
     pad = -1 if same else 0
     if (dh, dw) != (1, 1):
+        if groups != 1:
+            raise NotImplementedError("Conv2D: dilated grouped conv")
         m = nn.SpatialDilatedConvolution(cin, filters, kw, kh, sw, sh,
                                          pad, pad, dw, dh, bias=use_bias)
         ke_h, ke_w = (kh - 1) * dh + 1, (kw - 1) * dw + 1
     else:
         m = nn.SpatialConvolution(cin, filters, kw, kh, sw, sh, pad, pad,
-                                  bias=use_bias)
+                                  n_group=groups, bias=use_bias)
         ke_h, ke_w = kh, kw
     def adapter(wts):
         p = {"weight": wts[0]}
@@ -226,6 +245,7 @@ def _b_conv2d(cfg, shapes):
 
 
 def _b_depthwise2d(cfg, shapes):
+    _reject_unsupported(cfg, "DepthwiseConv2D", ("dilation_rate", 1))
     b_, h, w, cin = shapes[0]
     kh, kw = _pair(cfg["kernel_size"])
     sh, sw = _pair(cfg.get("strides", 1))
@@ -248,6 +268,7 @@ def _b_depthwise2d(cfg, shapes):
 
 
 def _b_sepconv2d(cfg, shapes):
+    _reject_unsupported(cfg, "SeparableConv2D", ("dilation_rate", 1))
     b_, h, w, cin = shapes[0]
     kh, kw = _pair(cfg["kernel_size"])
     sh, sw = _pair(cfg.get("strides", 1))
@@ -273,6 +294,8 @@ def _b_sepconv2d(cfg, shapes):
 
 
 def _b_conv2d_transpose(cfg, shapes):
+    _reject_unsupported(cfg, "Conv2DTranspose", ("dilation_rate", 1),
+                        ("groups", 1))
     b_, h, w, cin = shapes[0]
     kh, kw = _pair(cfg["kernel_size"])
     sh, sw = _pair(cfg.get("strides", 1))
@@ -304,6 +327,7 @@ def _b_conv2d_transpose(cfg, shapes):
 
 
 def _b_conv1d(cfg, shapes):
+    _reject_unsupported(cfg, "Conv1D", ("dilation_rate", 1), ("groups", 1))
     b_, t, cin = shapes[0]
     k = cfg["kernel_size"][0] if isinstance(cfg["kernel_size"],
                                             (list, tuple)) \
@@ -338,6 +362,7 @@ def _b_conv1d(cfg, shapes):
 
 def _b_pool2d(cls):
     def build(cfg, shapes):
+        _reject_unsupported(cfg, f"{cls}Pooling2D")
         b_, h, w, c = shapes[0]
         kh, kw = _pair(cfg.get("pool_size", 2))
         st = cfg.get("strides") or (kh, kw)
@@ -490,11 +515,22 @@ def _b_timedistributed(cfg, shapes):
 
 
 def _b_concat(cfg, shapes):
-    axis = cfg.get("axis", -1)
+    axis = cfg.get("axis", cfg.get("concat_axis", -1))
     n = sum(s[axis] for s in shapes)
     out = list(shapes[0])
     out[axis] = n
     return nn.JoinTable(axis), tuple(out), _NO_W
+
+
+def _b_merge_v1(cfg, shapes):
+    """Keras 1 Merge layer: dispatch on its `mode` config."""
+    mode = cfg.get("mode", "sum")
+    if mode in ("concat",):
+        return _b_concat(cfg, shapes)
+    table = {"sum": "add", "mul": "mul", "ave": "avg", "max": "max"}
+    if mode not in table:
+        raise NotImplementedError(f"keras Merge mode {mode!r}")
+    return _Merge(table[mode]), shapes[0], _NO_W
 
 
 def _b_merge(mode):
@@ -504,6 +540,7 @@ def _b_merge(mode):
 
 
 def _b_zeropad2d(cfg, shapes):
+    _reject_unsupported(cfg, "ZeroPadding2D")
     p = cfg.get("padding", 1)
     if isinstance(p, int):
         pt = pb = pl = pr = p
@@ -608,7 +645,7 @@ _BUILDERS: Dict[str, Callable] = {
     "SimpleRNN": _b_rnn("SimpleRNN"),
     "Bidirectional": _b_bidirectional,
     "TimeDistributed": _b_timedistributed,
-    "Concatenate": _b_concat, "Merge": _b_concat,
+    "Concatenate": _b_concat, "Merge": _b_merge_v1,
     "Add": _b_merge("add"), "Multiply": _b_merge("mul"),
     "Average": _b_merge("avg"), "Subtract": _b_merge("sub"),
     "Maximum": _b_merge("max"), "Minimum": _b_merge("min"),
@@ -656,21 +693,28 @@ class _Loaded:
                 continue
             p_over, s_over = adapter(weight_table[lname])
             key = self.key_of_layer[lname]
-            _merge_tree(params[key], p_over)
+            _merge_tree(params[key], p_over, lname)
             if s_over:
-                _merge_tree(state[key], s_over)
+                _merge_tree(state[key], s_over, lname)
         if missing and not by_name:
             raise ValueError(f"HDF5 file is missing weights for layers "
                              f"{missing} (pass by_name=True to skip)")
         return params, state
 
 
-def _merge_tree(dst, over):
+def _merge_tree(dst, over, where=""):
     for k, v in over.items():
         if isinstance(v, dict):
-            _merge_tree(dst[k], v)
+            _merge_tree(dst[k], v, f"{where}/{k}")
         else:
-            dst[k] = jnp.asarray(np.asarray(v))
+            v = np.asarray(v)
+            have = tuple(np.shape(dst[k]))
+            if have != tuple(v.shape):
+                raise ValueError(
+                    f"HDF5 weight {where}/{k} has shape {tuple(v.shape)} "
+                    f"but the model expects {have} — the weights file does "
+                    f"not match the definition")
+            dst[k] = jnp.asarray(v)
 
 
 def _build_sequential(layers: List[dict]) -> _Loaded:
@@ -708,6 +752,11 @@ def _build_functional(config: dict) -> _Loaded:
         nodes = spec.get("inbound_nodes") or []
         if not nodes:
             return []
+        if len(nodes) > 1:
+            raise NotImplementedError(
+                f"layer {spec.get('name')!r} is applied {len(nodes)} times "
+                f"(shared/reused layer) — weight sharing across call sites "
+                f"is not supported by this loader")
         first = nodes[0]
         if isinstance(first, dict):        # keras 3 "args" format
             raise NotImplementedError(

@@ -1,0 +1,170 @@
+"""Pipeline v2: heterogeneous stages, streamed input, 1F1B training
+(no reference equivalent — SURVEY.md §2.13 parity-plus; scheduling follows
+the classic 1F1B literature, memory model per the scaling-book)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.module import Module
+from bigdl_tpu.parallel.pipeline import Pipeline
+
+
+def _mesh(n):
+    return Mesh(np.asarray(jax.devices()[:n]).reshape(n), ("pipe",))
+
+
+def _seq_reference(pipe, pv, x, training=False):
+    """Run the stages back-to-back without the pipeline machinery."""
+    h = jnp.asarray(x)
+    for i, stage in enumerate(pipe.stages):
+        p = pipe._p_meta[i].unflatten(pv["flat"][i])
+        s = pipe._s_meta[i].unflatten(pv["state"][i])
+        h, _ = stage.apply(p, s, h, training=training,
+                           rng=jax.random.PRNGKey(0))
+    return h
+
+
+def test_hetero_pipeline_matches_sequential():
+    r = np.random.RandomState(0)
+    stages = [
+        nn.Linear(8, 8),
+        nn.Sequential().add(nn.Linear(8, 16)).add(nn.ReLU())
+                       .add(nn.Linear(16, 8)),         # different structure
+        nn.Sequential().add(nn.LayerNormalization(8)).add(nn.Tanh()),
+        nn.Linear(8, 8, bias=False),
+    ]
+    pipe = Pipeline(stages, n_microbatches=4)
+    pv = pipe.init(jax.random.PRNGKey(0))
+    mesh = _mesh(4)
+    pv = pipe.shard(pv, mesh)
+    x = jnp.asarray(r.randn(8, 8), jnp.float32)
+    got = pipe.apply(pv, x, mesh)
+    want = _seq_reference(pipe, pv, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_1f1b_grads_match_autodiff():
+    r = np.random.RandomState(1)
+    stages = [nn.Linear(6, 6), nn.Sequential().add(nn.Linear(6, 12))
+              .add(nn.Tanh()).add(nn.Linear(12, 6)), nn.Linear(6, 6),
+              nn.Linear(6, 6)]
+    M = 8
+    pipe = Pipeline(stages, n_microbatches=M)
+    pv = pipe.init(jax.random.PRNGKey(1))
+    mesh = _mesh(4)
+    pv = pipe.shard(pv, mesh)
+    x = jnp.asarray(r.randn(16, 6), jnp.float32)
+    y = jnp.asarray(r.randn(16, 6), jnp.float32)
+
+    def loss_fn(h, t):
+        return jnp.mean((h - t) ** 2)
+
+    loss, grads, _ = pipe.train_step(pv, x, y, loss_fn, mesh)
+
+    # reference: same loss via plain autodiff over the flat rows,
+    # averaged per microbatch exactly like the schedule does
+    def ref_loss(flat):
+        mb = x.shape[0] // M
+        total = 0.0
+        for m in range(M):
+            h = x[m * mb:(m + 1) * mb]
+            for i, stage in enumerate(pipe.stages):
+                p = pipe._p_meta[i].unflatten(flat[i])
+                s = pipe._s_meta[i].unflatten(pv["state"][i])
+                h, _ = stage.apply(p, s, h, training=True,
+                                   rng=jax.random.PRNGKey(0))
+            total = total + loss_fn(h, y[m * mb:(m + 1) * mb])
+        return total / M
+
+    want_loss = ref_loss(pv["flat"])
+    want_grads = jax.grad(ref_loss)(pv["flat"])
+    np.testing.assert_allclose(float(loss), float(want_loss), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads), np.asarray(want_grads),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_pipeline_batchnorm_state_threads():
+    """BatchNorm stages are now supported: running stats update across
+    microbatches in schedule order (round-1 raised NotImplementedError)."""
+    stages = [nn.Sequential().add(nn.Linear(4, 4))
+              .add(nn.BatchNormalization(4, momentum=0.5)),
+              nn.Linear(4, 4)]
+    pipe = Pipeline(stages, n_microbatches=4)
+    pv = pipe.init(jax.random.PRNGKey(0))
+    mesh = _mesh(2)
+    pv = pipe.shard(pv, mesh)
+    x = jnp.asarray(np.random.RandomState(0).randn(8, 4) * 3 + 1,
+                    jnp.float32)
+    out, pv2 = pipe.apply(pv, x, mesh, training=True)
+    s0_before = pipe._s_meta[0].unflatten(pv["state"][0])
+    s0_after = pipe._s_meta[0].unflatten(pv2["state"][0])
+    rm_b = jax.tree.leaves(s0_before)[0]
+    rm_a = jax.tree.leaves(s0_after)[0]
+    assert float(jnp.abs(rm_a - rm_b).max()) > 1e-3  # stats moved
+
+
+def test_uniform_sugar_still_works():
+    pipe = Pipeline(nn.Linear(6, 6), n_stages=2, n_microbatches=2)
+    pv = pipe.init(jax.random.PRNGKey(0))
+    mesh = _mesh(2)
+    pv = pipe.shard(pv, mesh)
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 6), jnp.float32)
+    out = pipe.apply(pv, x, mesh)
+    assert out.shape == (4, 6)
+
+
+def test_shape_changing_stage_rejected():
+    pipe = Pipeline([nn.Linear(6, 8), nn.Linear(8, 6)], n_microbatches=2)
+    pv = pipe.init(jax.random.PRNGKey(0))
+    mesh = _mesh(2)
+    x = jnp.zeros((4, 6), jnp.float32)
+    with pytest.raises(ValueError, match="preserve"):
+        pipe.apply(pipe.shard(pv, mesh), x, mesh)
+
+
+class _BlockWithLoss(Module):
+    pass
+
+
+def test_pipelined_transformer_lm_converges():
+    """8-device: embed outside, 4 pipelined transformer blocks, head
+    outside; 1F1B train steps drive the LM loss down (VERDICT item 7)."""
+    vocab, d, T, B, M = 17, 16, 8, 16, 8
+    r = np.random.RandomState(0)
+    mesh = _mesh(4)
+
+    blocks = [nn.TransformerLayer(d, 2, 2 * d, dropout=0.0)
+              for _ in range(4)]
+    pipe = Pipeline(blocks, n_microbatches=M)
+    pv = pipe.init(jax.random.PRNGKey(0))
+    pv = pipe.shard(pv, mesh)
+
+    emb = jnp.asarray(r.randn(vocab, d) * 0.1, jnp.float32)
+    head = jnp.asarray(r.randn(d, vocab) * 0.1, jnp.float32)
+
+    # data: repeating token pattern → next-token prediction is learnable
+    toks = np.stack([(np.arange(T) + i) % vocab for i in range(B)])
+    xt = jnp.asarray(toks[:, :-1])
+    yt = jnp.asarray(toks[:, 1:])
+
+    def lm_loss(h_mb, y_mb):
+        logits = h_mb @ head
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, y_mb[..., None],
+                                             axis=-1))
+
+    losses = []
+    flat = pv["flat"]
+    for step in range(30):
+        pv_step = {"flat": flat, "state": pv["state"]}
+        h_in = emb[xt]                       # embed outside the pipe
+        loss, grads, pv_step = pipe.train_step(pv_step, h_in, yt,
+                                               lm_loss, mesh)
+        flat = flat - 0.5 * grads
+        losses.append(float(loss))
+    assert losses[-1] < 0.5 * losses[0], losses
