@@ -5,8 +5,11 @@ resume, optim/DistriOptimizer.scala:124-134,466-474).
 Format: one directory per snapshot containing
   * `tree.json`  — pytree structure + array metadata + training counters
   * `arrays.npz` — all leaves, keyed by flat path
-Pure host-side numpy; device arrays are fetched with `jax.device_get` (under
-multi-host each host saves only addressable shards — hook for later rounds).
+Pure host-side numpy. Under multi-host, cross-host shards are gathered
+collectively (`process_allgather`), process 0 writes the complete snapshot,
+and all processes barrier before returning. Loading on every process
+assumes `path` is on a filesystem shared by all hosts (NFS/GCS-fuse — the
+same contract as the reference's HDFS paths, utils/File.scala).
 """
 
 from __future__ import annotations
@@ -57,24 +60,55 @@ def _unflatten(spec, flat: Dict[str, Any], prefix=""):
     return flat[prefix.rstrip(_SEP)]
 
 
+def _fetch(v) -> np.ndarray:
+    """Device array → host ndarray. Under multi-host, shards that live on
+    other processes are gathered with a collective (all processes must call
+    this — mirrors the reference's driver collecting executor state,
+    optim/DistriOptimizer.scala:466-474)."""
+    if isinstance(v, jax.Array) and not v.is_fully_addressable:
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(v, tiled=True))
+    return np.asarray(jax.device_get(v))
+
+
 def save_checkpoint(path: str, trees: Dict[str, Any],
                     meta: Optional[Dict] = None) -> None:
-    """Save named pytrees (e.g. {'params':…, 'state':…, 'optim':…}) + meta."""
-    tmp = path + ".tmp"
-    if os.path.exists(tmp):
-        shutil.rmtree(tmp)
-    os.makedirs(tmp, exist_ok=True)
+    """Save named pytrees (e.g. {'params':…, 'state':…, 'optim':…}) + meta.
+
+    Multi-host: every process participates (cross-host shards are gathered
+    collectively), process 0 writes, and all processes synchronize before
+    returning so a subsequent load sees a complete snapshot."""
+    multihost = jax.process_count() > 1
+    writer = not multihost or jax.process_index() == 0
     arrays, specs = {}, {}
-    for name, tree in trees.items():
-        specs[name] = _spec(tree)
-        for k, v in _flatten(tree, f"{name}{_SEP}").items():
-            arrays[k] = np.asarray(jax.device_get(v))
-    np.savez(os.path.join(tmp, "arrays.npz"), **arrays)
-    with open(os.path.join(tmp, "tree.json"), "w") as f:
-        json.dump({"specs": specs, "meta": meta or {}}, f)
-    if os.path.exists(path):
-        shutil.rmtree(path)
-    os.replace(tmp, path)
+    try:
+        for name, tree in trees.items():
+            specs[name] = _spec(tree)
+            for k, v in _flatten(tree, f"{name}{_SEP}").items():
+                addressable = not (isinstance(v, jax.Array)
+                                   and not v.is_fully_addressable)
+                if addressable and not writer:
+                    continue               # only the writer needs the copy;
+                    # non-addressable leaves must be gathered symmetrically
+                arrays[k] = _fetch(v)
+        if writer:
+            tmp = path + ".tmp"
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp)
+            os.makedirs(tmp, exist_ok=True)
+            np.savez(os.path.join(tmp, "arrays.npz"), **arrays)
+            with open(os.path.join(tmp, "tree.json"), "w") as f:
+                json.dump({"specs": specs, "meta": meta or {}}, f)
+            if os.path.exists(path):
+                shutil.rmtree(path)
+            os.replace(tmp, path)
+    finally:
+        # reached even if the write fails, so the other hosts' barrier
+        # doesn't hang forever on a host-0 IO error
+        if multihost:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(
+                f"ckpt:{os.path.basename(path)}")
 
 
 def load_checkpoint(path: str) -> Tuple[Dict[str, Any], Dict]:

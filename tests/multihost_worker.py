@@ -1,0 +1,90 @@
+"""Worker process for the 2-process multi-host test (launched by
+tests/test_multihost.py). Exercises the multi-node bring-up path the
+reference drives through its per-executor Engine + parameter-sync
+machinery (utils/Engine.scala:266, optim/DistriOptimizer.scala:466-474):
+
+  * `Engine.init(coordinator_address=...)` → `jax.distributed.initialize`
+  * global-batch assembly from process-local shards
+    (`jax.make_array_from_process_local_data`, parallel/distri.py)
+  * a data-parallel DistriOptimizer run spanning both processes
+  * checkpoint save (cross-host shard gather + barrier) and load
+
+Prints one JSON line the launcher asserts on."""
+
+import json
+import os
+import sys
+
+
+def main():
+    port, pid, tmpdir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=2")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from bigdl_tpu.parallel.mesh import Engine
+    mesh = Engine.init(coordinator_address=f"127.0.0.1:{port}",
+                       num_processes=2, process_id=pid)
+
+    report = {"pid": pid,
+              "process_count": jax.process_count(),
+              "device_count": jax.device_count(),
+              "local_devices": jax.local_device_count()}
+
+    # ---- global batch from process-local shards (distri.py:_place_array)
+    n_global, feat = 8, 4
+    full = np.arange(n_global * feat, dtype=np.float32).reshape(n_global,
+                                                                feat)
+    local = full[pid * (n_global // 2):(pid + 1) * (n_global // 2)]
+    sharding = NamedSharding(mesh, P("data"))
+    garr = jax.make_array_from_process_local_data(sharding, local)
+    report["global_shape"] = list(garr.shape)
+    # global reduction sees both processes' shards
+    total = float(jnp.sum(garr))
+    report["global_sum_ok"] = abs(total - float(full.sum())) < 1e-3
+
+    # ---- data-parallel training across both processes
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.nn.criterion import ClassNLLCriterion
+    from bigdl_tpu.optim.method import SGD
+    from bigdl_tpu.optim.trigger import Trigger
+    from bigdl_tpu.parallel.distri import DistriOptimizer
+    from bigdl_tpu.dataset import ArrayDataSet
+
+    r = np.random.RandomState(0)            # same data on both: split below
+    X = r.randn(64, 8).astype(np.float32)
+    Y = (X[:, :4].sum(1) > X[:, 4:].sum(1)).astype(np.int32)
+    Xl = X[pid * 32:(pid + 1) * 32]
+    Yl = Y[pid * 32:(pid + 1) * 32]
+    model = nn.Sequential().add(nn.Linear(8, 16)).add(nn.ReLU()) \
+        .add(nn.Linear(16, 2)).add(nn.LogSoftMax())
+    ds = ArrayDataSet(Xl, Yl, batch_size=16, shuffle=False, drop_last=True)
+    opt = DistriOptimizer(model, ds, ClassNLLCriterion(), SGD(0.3),
+                          mesh=mesh)
+    opt.set_end_when(Trigger.max_epoch(10))
+    params, _ = opt.optimize()
+    report["final_loss"] = float(opt.state["loss"])
+    report["loss_ok"] = report["final_loss"] < 0.4
+
+    # ---- checkpoint under multihost: sharded array gather + barrier
+    from bigdl_tpu.utils import checkpoint as ckpt
+    ck = os.path.join(tmpdir, "snap")
+    trees = {"params": params, "batch": garr}   # garr is cross-host sharded
+    ckpt.save_checkpoint(ck, trees, {"neval": 7})
+    loaded, meta = ckpt.load_checkpoint(ck)
+    same_batch = np.allclose(loaded["batch"], full)
+    same_params = all(
+        np.allclose(a, np.asarray(b)) for a, b in
+        zip(jax.tree.leaves(loaded["params"]), jax.tree.leaves(params)))
+    report["ckpt_ok"] = bool(same_batch and same_params
+                             and meta["neval"] == 7)
+
+    print("REPORT " + json.dumps(report), flush=True)
+
+
+if __name__ == "__main__":
+    main()

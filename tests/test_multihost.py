@@ -1,0 +1,62 @@
+"""Multi-host proof: 2 real processes, 4 total devices, one training run
+(reference: the multi-node executor topology of utils/Engine.scala +
+optim/DistriOptimizer.scala — here jax.distributed over a CPU collective
+backend; VERDICT round-1 item 8)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_training(tmp_path):
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    repo = os.path.dirname(os.path.dirname(worker))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)               # worker sets its own
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(port), str(pid), str(tmp_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        cwd=repo)
+        for pid in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out.decode())
+    finally:
+        for p in procs:                      # no orphans on deadlock
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+    reports = []
+    for out in outs:
+        lines = [l for l in out.splitlines() if l.startswith("REPORT ")]
+        assert lines, f"no report in:\n{out}"
+        reports.append(json.loads(lines[0][len("REPORT "):]))
+    for rep in reports:
+        assert rep["process_count"] == 2
+        assert rep["device_count"] == 4
+        assert rep["local_devices"] == 2
+        assert rep["global_shape"] == [8, 4]
+        assert rep["global_sum_ok"], rep
+        assert rep["loss_ok"], rep
+        assert rep["ckpt_ok"], rep
+    # both processes ran the same SPMD program → identical final loss
+    assert abs(reports[0]["final_loss"] - reports[1]["final_loss"]) < 1e-5
