@@ -4,12 +4,16 @@ from bigdl_tpu.core.container import (Concat, ConcatTable, Container, Graph,
                                       Input, Node, ParallelTable, Sequential)
 from bigdl_tpu.core.module import Criterion, Module
 
-from bigdl_tpu.nn.linear import Linear, Bilinear, CMul, CAdd, Add, Mul
+from bigdl_tpu.nn.linear import (Linear, Bilinear, CMul, CAdd, Add, Mul,
+                                 Maxout)
 from bigdl_tpu.nn.conv import (SpatialConvolution, SpatialDilatedConvolution,
                                SpatialFullConvolution, SpatialSeparableConvolution,
-                               TemporalConvolution, VolumetricConvolution)
+                               SpatialShareConvolution, LocallyConnected1D,
+                               LocallyConnected2D, TemporalConvolution,
+                               VolumetricConvolution, VolumetricFullConvolution)
 from bigdl_tpu.nn.pooling import (SpatialMaxPooling, SpatialAveragePooling,
                                   TemporalMaxPooling, VolumetricMaxPooling,
+                                  VolumetricAveragePooling,
                                   SpatialAdaptiveMaxPooling, GlobalAveragePooling2D)
 from bigdl_tpu.nn.activation import (ReLU, ReLU6, Tanh, Sigmoid, ELU, SELU, GELU,
                                      Swish, SoftMax, LogSoftMax, SoftMin, SoftPlus,
@@ -42,6 +46,7 @@ from bigdl_tpu.nn.attention import (MultiHeadAttention, Attention,
 from bigdl_tpu.nn.recurrent import (Cell, RnnCell, LSTM, LSTMPeephole, GRU,
                                     ConvLSTMPeephole, MultiRNNCell, Recurrent,
                                     BiRecurrent, RecurrentDecoder,
+                                    BinaryTreeLSTM,
                                     TimeDistributed, SequenceBeamSearch,
                                     beam_search, tile_beam)
 from bigdl_tpu.nn.criterion import (ClassNLLCriterion, CrossEntropyCriterion,
@@ -58,7 +63,15 @@ from bigdl_tpu.nn.criterion import (ClassNLLCriterion, CrossEntropyCriterion,
                                     TimeDistributedMaskCriterion,
                                     DiceCoefficientCriterion, MultiMarginCriterion,
                                     ClassSimplexCriterion, PGCriterion,
-                                    TransformerCriterion)
+                                    TransformerCriterion,
+                                    CosineDistanceCriterion,
+                                    CosineProximityCriterion,
+                                    DotProductCriterion,
+                                    KullbackLeiblerDivergenceCriterion,
+                                    L1HingeEmbeddingCriterion,
+                                    MeanAbsolutePercentageCriterion,
+                                    MeanSquaredLogarithmicCriterion,
+                                    PoissonCriterion, SoftmaxWithCriterion)
 
 from bigdl_tpu.nn import detection, ops, quantized, sparse
 from bigdl_tpu.nn.detection import (Anchor, DetectionOutputSSD, FPN, Nms,

@@ -221,3 +221,137 @@ class VolumetricConvolution(Module):
         if self.bias:
             y = y + params["bias"]
         return y
+
+
+class SpatialShareConvolution(SpatialConvolution):
+    """Alias of SpatialConvolution (reference: nn/SpatialShareConvolution.scala
+    — there, a variant sharing im2col buffers across a batch to cut memory;
+    XLA never materializes im2col, so the optimization is inherent and the
+    two layers coincide)."""
+
+
+class LocallyConnected2D(Module):
+    """Conv with untied (per-output-position) weights
+    (reference: nn/LocallyConnected2D.scala; keras LocallyConnected2D).
+    NHWC; requires static input spatial dims (weights depend on them).
+
+    weight: (out_h, out_w, kh*kw*cin, cout) — patches are gathered with
+    static kernel-offset slices (XLA fuses these; no im2col buffer) and
+    contracted with one einsum so the MXU sees a single batched matmul.
+    """
+
+    def __init__(self, n_input_plane: int, input_width: int, input_height: int,
+                 n_output_plane: int, kernel_w: int, kernel_h: int,
+                 stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = 0, pad_h: int = 0, bias: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.nin, self.nout = n_input_plane, n_output_plane
+        self.iw, self.ih = input_width, input_height
+        self.kw, self.kh = kernel_w, kernel_h
+        self.sw, self.sh = stride_w, stride_h
+        self.pw, self.ph, self.bias = pad_w, pad_h, bias
+        self.oh = (input_height + 2 * pad_h - kernel_h) // stride_h + 1
+        self.ow = (input_width + 2 * pad_w - kernel_w) // stride_w + 1
+
+    def param_specs(self):
+        k = self.kh * self.kw * self.nin
+        specs = {"weight": ParamSpec((self.oh, self.ow, k, self.nout),
+                                     initializers.xavier, fan_in=k,
+                                     fan_out=self.nout)}
+        if self.bias:
+            specs["bias"] = ParamSpec((self.oh, self.ow, self.nout),
+                                      initializers.zeros)
+        return specs
+
+    def _patches(self, x):
+        if self.ph or self.pw:
+            x = jnp.pad(x, [(0, 0), (self.ph, self.ph), (self.pw, self.pw),
+                            (0, 0)])
+        cols = []
+        for i in range(self.kh):
+            for j in range(self.kw):
+                cols.append(x[:, i:i + self.oh * self.sh:self.sh,
+                              j:j + self.ow * self.sw:self.sw, :])
+        # (B, oh, ow, kh*kw, cin) → (B, oh, ow, kh*kw*cin)
+        p = jnp.stack(cols, axis=3)
+        return p.reshape(p.shape[:3] + (-1,))
+
+    def forward(self, params, x, **_):
+        p = self._patches(x)
+        y = jnp.einsum("bhwk,hwkf->bhwf", p, params["weight"])
+        if self.bias:
+            y = y + params["bias"]
+        return y
+
+
+class LocallyConnected1D(Module):
+    """1-D untied conv over (N, T, C)
+    (reference: nn/LocallyConnected1D.scala)."""
+
+    def __init__(self, n_input_frame: int, input_frame_size: int,
+                 output_frame_size: int, kernel_w: int, stride_w: int = 1,
+                 bias: bool = True, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.nt, self.nin, self.nout = n_input_frame, input_frame_size, \
+            output_frame_size
+        self.kw, self.sw, self.bias = kernel_w, stride_w, bias
+        self.ot = (n_input_frame - kernel_w) // stride_w + 1
+
+    def param_specs(self):
+        k = self.kw * self.nin
+        specs = {"weight": ParamSpec((self.ot, k, self.nout),
+                                     initializers.xavier, fan_in=k,
+                                     fan_out=self.nout)}
+        if self.bias:
+            specs["bias"] = ParamSpec((self.ot, self.nout),
+                                      initializers.zeros)
+        return specs
+
+    def forward(self, params, x, **_):
+        cols = [x[:, j:j + self.ot * self.sw:self.sw, :]
+                for j in range(self.kw)]
+        p = jnp.stack(cols, axis=2).reshape(x.shape[0], self.ot, -1)
+        y = jnp.einsum("btk,tkf->btf", p, params["weight"])
+        if self.bias:
+            y = y + params["bias"]
+        return y
+
+
+class VolumetricFullConvolution(Module):
+    """3-D transposed conv over (N, D, H, W, C)
+    (reference: nn/VolumetricFullConvolution.scala) via lhs dilation —
+    the same fractional-stride lowering as SpatialFullConvolution."""
+
+    def __init__(self, n_input_plane, n_output_plane, k_t, k_w, k_h,
+                 d_t=1, d_w=1, d_h=1, pad_t=0, pad_w=0, pad_h=0,
+                 adj_t: int = 0, adj_w: int = 0, adj_h: int = 0,
+                 bias: bool = True, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.nin, self.nout = n_input_plane, n_output_plane
+        self.k = (k_t, k_h, k_w)
+        self.s = (d_t, d_h, d_w)
+        self.p = (pad_t, pad_h, pad_w)
+        self.adj = (adj_t, adj_h, adj_w)
+        self.bias = bias
+
+    def param_specs(self):
+        kt, kh, kw = self.k
+        fan_in = kt * kh * kw * self.nin
+        specs = {"weight": ParamSpec((kt, kh, kw, self.nin, self.nout),
+                                     initializers.kaiming, fan_in=fan_in)}
+        if self.bias:
+            specs["bias"] = ParamSpec((self.nout,), initializers.zeros)
+        return specs
+
+    def forward(self, params, x, **_):
+        pads = [(k - 1 - p, k - 1 - p + a)
+                for k, p, a in zip(self.k, self.p, self.adj)]
+        w = jnp.flip(params["weight"], axis=(0, 1, 2))
+        y = lax.conv_general_dilated(
+            x, w, window_strides=(1, 1, 1), padding=pads,
+            lhs_dilation=self.s,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        if self.bias:
+            y = y + params["bias"]
+        return y

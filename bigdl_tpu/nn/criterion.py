@@ -457,3 +457,127 @@ class TransformerCriterion(Criterion):
         if self.target_transform is not None:
             target = self.target_transform(target)
         return self.criterion.forward(input, target)
+
+
+class CosineDistanceCriterion(Criterion):
+    """1 - cos(input, target), mean over batch
+    (reference: nn/CosineDistanceCriterion.scala)."""
+
+    def __init__(self, size_average: bool = True):
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        num = jnp.sum(input * target, axis=-1)
+        den = jnp.linalg.norm(input, axis=-1) * \
+            jnp.linalg.norm(target, axis=-1)
+        per = 1.0 - num / jnp.maximum(den, 1e-12)
+        return jnp.mean(per) if self.size_average else jnp.sum(per)
+
+
+class CosineProximityCriterion(Criterion):
+    """Negative cosine proximity, the keras-style loss
+    (reference: nn/CosineProximityCriterion.scala — -sum(l2norm(x)·l2norm(y))
+    averaged over the batch)."""
+
+    def forward(self, input, target):
+        xn = input / jnp.maximum(jnp.linalg.norm(input, axis=-1,
+                                                 keepdims=True), 1e-12)
+        yn = target / jnp.maximum(jnp.linalg.norm(target, axis=-1,
+                                                  keepdims=True), 1e-12)
+        return -jnp.mean(jnp.sum(xn * yn, axis=-1))
+
+
+class DotProductCriterion(Criterion):
+    """Negative dot product of input and target — the policy-gradient
+    building block (reference: nn/DotProductCriterion.scala)."""
+
+    def __init__(self, size_average: bool = False):
+        self.size_average = size_average
+
+    def forward(self, input, target):
+        per = jnp.sum(input * target, axis=-1)
+        return -(jnp.mean(per) if self.size_average else jnp.sum(per))
+
+
+class KullbackLeiblerDivergenceCriterion(Criterion):
+    """Keras-style clipped KL divergence
+    (reference: nn/KullbackLeiblerDivergenceCriterion.scala — inputs are
+    probabilities, clipped to [eps, 1])."""
+
+    eps = 1e-7
+
+    def forward(self, input, target):
+        x = jnp.clip(input, self.eps, 1.0)
+        y = jnp.clip(target, self.eps, 1.0)
+        return jnp.mean(jnp.sum(y * jnp.log(y / x), axis=-1))
+
+
+class L1HingeEmbeddingCriterion(Criterion):
+    """Hinge on the pairwise L1 distance; input is a pair (x1, x2),
+    target y ∈ {1, -1} (reference: nn/L1HingeEmbeddingCriterion.scala)."""
+
+    def __init__(self, margin: float = 1.0):
+        self.margin = margin
+
+    def forward(self, input, target):
+        x1, x2 = input
+        d = jnp.sum(jnp.abs(x1 - x2), axis=-1)
+        y = jnp.reshape(target, d.shape)
+        per = jnp.where(y > 0, d, jnp.maximum(0.0, self.margin - d))
+        return jnp.mean(per)
+
+
+class MeanAbsolutePercentageCriterion(Criterion):
+    """(reference: nn/MeanAbsolutePercentageCriterion.scala — keras MAPE,
+    |y-x| / clip(|y|) * 100)."""
+
+    def forward(self, input, target):
+        diff = jnp.abs(target - input) / \
+            jnp.clip(jnp.abs(target), 1e-7, None)
+        return 100.0 * jnp.mean(diff)
+
+
+class MeanSquaredLogarithmicCriterion(Criterion):
+    """(reference: nn/MeanSquaredLogarithmicCriterion.scala — keras MSLE)."""
+
+    def forward(self, input, target):
+        a = jnp.log(jnp.clip(input, 1e-7, None) + 1.0)
+        b = jnp.log(jnp.clip(target, 1e-7, None) + 1.0)
+        return jnp.mean((a - b) ** 2)
+
+
+class PoissonCriterion(Criterion):
+    """Poisson NLL, keras-style (reference: nn/PoissonCriterion.scala —
+    mean(x - y·log(x)))."""
+
+    def forward(self, input, target):
+        return jnp.mean(input - target * jnp.log(input + 1e-7))
+
+
+class SoftmaxWithCriterion(Criterion):
+    """Caffe-style fused softmax + multinomial NLL over spatial logits
+    (reference: nn/SoftmaxWithCriterion.scala). Input (..., C) channels-last
+    logits (the reference is NCHW axis 1); target int labels over the
+    remaining axes; `ignore_label` positions are dropped from the
+    normalization."""
+
+    def __init__(self, ignore_label: Optional[int] = None,
+                 normalize_mode: str = "valid"):
+        self.ignore_label = ignore_label
+        self.normalize_mode = normalize_mode
+
+    def forward(self, input, target):
+        logp = jax.nn.log_softmax(input, axis=-1)
+        t = jnp.asarray(target, jnp.int32)
+        safe_t = jnp.clip(t, 0, input.shape[-1] - 1)   # ignore_label may be OOB
+        picked = jnp.take_along_axis(logp, safe_t[..., None], axis=-1)[..., 0]
+        if self.ignore_label is None:
+            mask = jnp.ones_like(picked)
+        else:
+            mask = (t != self.ignore_label).astype(picked.dtype)
+        total = -jnp.sum(picked * mask)
+        if self.normalize_mode == "valid":
+            return total / jnp.maximum(jnp.sum(mask), 1.0)
+        if self.normalize_mode == "batch_size":
+            return total / picked.shape[0]
+        return total

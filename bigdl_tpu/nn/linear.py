@@ -118,3 +118,30 @@ class Mul(Module):
 
     def forward(self, params, x, **_):
         return x * params["weight"][0]
+
+
+class Maxout(Module):
+    """Linear maxout: the element-wise max of `maxout_number` Linear layers
+    (reference: nn/Maxout.scala:30 — Linear(in, out*maxN) → View(maxN, out)
+    → Max; here one packed MXU matmul and a reshape-max)."""
+
+    def __init__(self, input_size: int, output_size: int, maxout_number: int,
+                 with_bias: bool = True, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.input_size, self.output_size = input_size, output_size
+        self.maxout_number, self.with_bias = maxout_number, with_bias
+
+    def param_specs(self):
+        n = self.output_size * self.maxout_number
+        specs = {"weight": ParamSpec((self.input_size, n), initializers.xavier,
+                                     fan_in=self.input_size, fan_out=n)}
+        if self.with_bias:
+            specs["bias"] = ParamSpec((n,), initializers.zeros)
+        return specs
+
+    def forward(self, params, x, **_):
+        y = x @ params["weight"]
+        if self.with_bias:
+            y = y + params["bias"]
+        y = y.reshape(y.shape[:-1] + (self.maxout_number, self.output_size))
+        return jnp.max(y, axis=-2)

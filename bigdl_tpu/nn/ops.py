@@ -354,3 +354,130 @@ class Gemm(Operation):
             b = b.T
         out = self.alpha * (a @ b)
         return out + self.beta * c if c is not None else out
+
+
+# ------------------------------------------------- control flow (nn/tf/)
+class Cond(Operation):
+    """Data-dependent branch (reference: nn/tf/ControlOps.scala
+    SwitchOps/MergeOps — TF's Switch/Merge dataflow pair; on TPU the
+    whole construct is one `lax.cond`, compiled with both branches
+    resident so there is no host round-trip)."""
+
+    def __init__(self, true_module: Module, false_module: Module,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.true_module = self.add_child("true", true_module)
+        self.false_module = self.add_child("false", false_module)
+
+    def _apply(self, params, state, pred, *xs, training=False, rng=None):
+        def tb(operands):
+            out, new_s = self.true_module.apply(
+                params["true"], state["true"], *operands,
+                training=training, rng=rng)
+            return out, {"true": new_s, "false": state["false"]}
+
+        def fb(operands):
+            out, new_s = self.false_module.apply(
+                params["false"], state["false"], *operands,
+                training=training, rng=rng)
+            return out, {"true": state["true"], "false": new_s}
+        return lax.cond(jnp.asarray(pred).astype(bool).reshape(()),
+                        tb, fb, xs)
+
+
+class Switch(Operation):
+    """TF Switch: route input to port 0 (pred false) or port 1 (pred true);
+    the un-taken port is zeros (reference: nn/tf/ControlOps.scala
+    SwitchOps). Returns (false_out, true_out)."""
+
+    def forward(self, params, data, pred=None, **_):
+        if pred is None:
+            data, pred = data
+        p = jnp.asarray(pred).astype(bool).reshape(())
+        z = jnp.zeros_like(data)
+        return jnp.where(p, z, data), jnp.where(p, data, z)
+
+
+class MergeOps(Operation):
+    """TF Merge: forward whichever input is 'available' — here, select by
+    index (reference: nn/tf/ControlOps.scala MergeOps)."""
+
+    def forward(self, params, *inputs, **_):
+        if len(inputs) == 1 and isinstance(inputs[0], (tuple, list)):
+            inputs = tuple(inputs[0])
+        idx = jnp.asarray(inputs[-1], jnp.int32).reshape(())
+        stacked = jnp.stack(inputs[:-1])
+        return lax.dynamic_index_in_dim(stacked, idx, keepdims=False)
+
+
+# ------------------------------------------------ TensorArray (nn/tf/)
+class TensorArrayCreate(Operation):
+    """Preallocated (size, ...) buffer — the XLA-native TensorArray: fixed
+    shape so the whole read/write chain stays on device (reference:
+    nn/tf/TensorArray.scala TensorArrayCreator; dynamic growth has no TPU
+    lowering, so size is a constructor argument here)."""
+
+    def __init__(self, size: int, element_shape: Sequence[int],
+                 dtype=jnp.float32, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.size = size
+        self.element_shape = tuple(element_shape)
+        self.dtype = dtype
+
+    def forward(self, params, *_, **__):
+        return jnp.zeros((self.size,) + self.element_shape, self.dtype)
+
+
+class TensorArrayWrite(Operation):
+    """(ta, index, value) → ta with value at index (reference:
+    nn/tf/TensorArray.scala TensorArrayWriter)."""
+
+    def forward(self, params, ta, index=None, value=None, **_):
+        if index is None:
+            ta, index, value = ta
+        idx = jnp.asarray(index, jnp.int32).reshape(())
+        return lax.dynamic_update_index_in_dim(ta, value, idx, 0)
+
+
+class TensorArrayRead(Operation):
+    """(ta, index) → element (reference: nn/tf/TensorArray.scala)."""
+
+    def forward(self, params, ta, index=None, **_):
+        if index is None:
+            ta, index = ta
+        idx = jnp.asarray(index, jnp.int32).reshape(())
+        return lax.dynamic_index_in_dim(ta, idx, keepdims=False)
+
+
+class TensorArrayScatter(Operation):
+    """(ta, indices, values) → ta with rows scattered (reference:
+    nn/tf/TensorArray.scala TensorArrayScatter)."""
+
+    def forward(self, params, ta, indices=None, values=None, **_):
+        if indices is None:
+            ta, indices, values = ta
+        return ta.at[jnp.asarray(indices, jnp.int32)].set(values)
+
+
+class TensorArrayGather(Operation):
+    """(ta, indices) → stacked rows (reference: nn/tf/TensorArray.scala)."""
+
+    def forward(self, params, ta, indices=None, **_):
+        if indices is None:
+            ta, indices = ta
+        return ta[jnp.asarray(indices, jnp.int32)]
+
+
+class TensorArrayStack(Operation):
+    """ta → the whole buffer as one tensor."""
+
+    def forward(self, params, ta, **_):
+        return ta
+
+
+class TensorArrayConcat(Operation):
+    """ta (N, E, ...) → (N*E, ...) (reference: nn/tf/TensorArray.scala
+    TensorArrayConcat)."""
+
+    def forward(self, params, ta, **_):
+        return ta.reshape((-1,) + ta.shape[2:])

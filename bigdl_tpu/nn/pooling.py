@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import Optional
 
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from bigdl_tpu.core.module import Module
@@ -178,3 +179,28 @@ class GlobalAveragePooling2D(Module):
 
     def forward(self, params, x, **_):
         return jnp.mean(x, axis=(1, 2))
+
+
+class VolumetricAveragePooling(Module):
+    """3D average pool over (N, D, H, W, C)
+    (reference: nn/VolumetricAveragePooling.scala)."""
+
+    def __init__(self, k_t, k_w, k_h, d_t=None, d_w=None, d_h=None,
+                 pad_t=0, pad_w=0, pad_h=0, count_include_pad: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.k = (k_t, k_h, k_w)
+        self.s = (d_t or k_t, d_h or k_h, d_w or k_w)
+        self.p = (pad_t, pad_h, pad_w)
+        self.include_pad = count_include_pad
+
+    def forward(self, params, x, **_):
+        window = (1,) + self.k + (1,)
+        strides = (1,) + self.s + (1,)
+        pad = [(0, 0)] + [(p, p) for p in self.p] + [(0, 0)]
+        summed = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+        if self.include_pad:
+            return summed / float(np.prod(self.k))
+        counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window,
+                                   strides, pad)
+        return summed / jnp.maximum(counts, 1.0)

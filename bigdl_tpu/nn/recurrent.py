@@ -23,6 +23,7 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from bigdl_tpu.core import init as initializers
 from bigdl_tpu.core.module import Module, ParamSpec
@@ -474,3 +475,93 @@ class SequenceBeamSearch(Module):
                           max_len=self.max_len, eos_id=self.eos_id,
                           alpha=self.alpha)
         return out, state
+
+
+class BinaryTreeLSTM(Module):
+    """Binary tree-LSTM over batched constituency trees
+    (reference: nn/BinaryTreeLSTM.scala:40-280 — leaf module c=Wx,
+    h=sigmoid(W_o x)*tanh(c); composer with per-child forget gates,
+    c = i*u + lf*lc + rf*rc, h = o*tanh(c)).
+
+    Input: (embeddings (B, T, D), tree (B, N, 3) int32) where tree rows are
+    [left_child, right_child, leaf_index] with 1-based node/leaf indices and
+    0 = no child (BinaryTreeLSTM.scala:495-505 TensorTree layout). Nodes
+    must be topologically ordered (children before parents) — the reference
+    recurses per node at runtime (recursiveForward:265); here one `lax.scan`
+    over the node axis with gathered child states keeps the whole batch on
+    the MXU, and gates are packed into single (H, 5H) matmuls.
+
+    Output: (B, N, H) — every node's hidden state, root last.
+    """
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 gate_output: bool = True, name=None):
+        super().__init__(name)
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.gate_output = gate_output
+
+    def param_specs(self):
+        d, h = self.input_size, self.hidden_size
+        return {
+            "leaf_wc": ParamSpec((d, h), initializers.xavier, fan_in=d),
+            "leaf_bc": ParamSpec((h,), initializers.zeros),
+            "leaf_wo": ParamSpec((d, h), initializers.xavier, fan_in=d),
+            "leaf_bo": ParamSpec((h,), initializers.zeros),
+            # composer packed gates [i | lf | rf | update | o]
+            "wl": ParamSpec((h, 5 * h), initializers.xavier, fan_in=h),
+            "wr": ParamSpec((h, 5 * h), initializers.xavier, fan_in=h),
+            "bias": ParamSpec((5 * h,), initializers.zeros),
+        }
+
+    def forward(self, params, inputs, tree=None, **_):
+        if tree is None:
+            inputs, tree = inputs
+        x = inputs
+        b, n_nodes = tree.shape[0], tree.shape[1]
+        h = self.hidden_size
+        c_buf = jnp.zeros((b, n_nodes + 1, h), x.dtype)  # slot 0 = "no child"
+        h_buf = jnp.zeros((b, n_nodes + 1, h), x.dtype)
+
+        def gather(buf, idx):
+            return jnp.take_along_axis(
+                buf, jnp.clip(idx, 0, n_nodes)[:, None, None]
+                .astype(jnp.int32).repeat(h, axis=2), axis=1)[:, 0]
+
+        def step(carry, node_idx):
+            c_buf, h_buf = carry
+            row = tree[:, node_idx, :]            # (B, 3)
+            left, right, leaf = row[:, 0], row[:, 1], row[:, 2]
+            is_leaf = (left == 0)[:, None]
+            # --- leaf cell
+            xl = jnp.take_along_axis(
+                x, jnp.clip(leaf - 1, 0, x.shape[1] - 1)[:, None, None]
+                .astype(jnp.int32).repeat(x.shape[2], axis=2), axis=1)[:, 0]
+            c_leaf = xl @ params["leaf_wc"] + params["leaf_bc"]
+            o_leaf = jax.nn.sigmoid(xl @ params["leaf_wo"]
+                                    + params["leaf_bo"])
+            h_leaf = o_leaf * jnp.tanh(c_leaf) if self.gate_output \
+                else jnp.tanh(c_leaf)
+            # --- composer cell
+            lc, lh = gather(c_buf, left), gather(h_buf, left)
+            rc, rh = gather(c_buf, right), gather(h_buf, right)
+            gates = lh @ params["wl"] + rh @ params["wr"] + params["bias"]
+            i, lf, rf, u, o = jnp.split(gates, 5, axis=-1)
+            c_comp = jax.nn.sigmoid(i) * jnp.tanh(u) + \
+                jax.nn.sigmoid(lf) * lc + jax.nn.sigmoid(rf) * rc
+            h_comp = jax.nn.sigmoid(o) * jnp.tanh(c_comp) \
+                if self.gate_output else jnp.tanh(c_comp)
+            c_new = jnp.where(is_leaf, c_leaf, c_comp)
+            h_new = jnp.where(is_leaf, h_leaf, h_comp)
+            # padding rows (all-zero) produce zero states
+            is_pad = (jnp.abs(row).sum(axis=1) == 0)[:, None]
+            c_new = jnp.where(is_pad, jnp.zeros_like(c_new), c_new)
+            h_new = jnp.where(is_pad, jnp.zeros_like(h_new), h_new)
+            c_buf = lax.dynamic_update_slice(
+                c_buf, c_new[:, None, :], (0, node_idx + 1, 0))
+            h_buf = lax.dynamic_update_slice(
+                h_buf, h_new[:, None, :], (0, node_idx + 1, 0))
+            return (c_buf, h_buf), h_new
+
+        (_, _), hs = lax.scan(step, (c_buf, h_buf),
+                              jnp.arange(n_nodes, dtype=jnp.int32))
+        return jnp.swapaxes(hs, 0, 1)             # (B, N, H)

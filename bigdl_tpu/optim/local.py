@@ -278,6 +278,7 @@ class Optimizer:
                 self._pending.append((st["neval"], lr, loss))
                 if st["neval"] % self._log_every == 0:
                     self._flush_metrics(st)
+                self._maybe_param_summary(params, st)
                 self._maybe_validate(params, model_state, st)
                 self._maybe_checkpoint(params, model_state, slots, st)
                 if self.end_when(st):
@@ -293,6 +294,7 @@ class Optimizer:
             dur = time.time() - epoch_start
             log.info("epoch %d done: %d records in %.1fs (%.1f rec/s)",
                      st["epoch"] - 1, epoch_records, dur, epoch_records / max(dur, 1e-9))
+            self._maybe_param_summary(params, st)
             self._maybe_validate(params, model_state, st)
             self._maybe_checkpoint(params, model_state, slots, st)
             st["epoch_finished"] = False
@@ -325,6 +327,33 @@ class Optimizer:
         self._pending = []
         self._window_t0 = time.time()
         self._window_records = 0
+
+    def _maybe_param_summary(self, params, st):
+        """Per-parameter histogram dumps when the train summary carries a
+        'Parameters' trigger (reference: optim/AbstractOptimizer.scala:47-91
+        — trainSummary.setSummaryTrigger("Parameters", ...) dumps the
+        parameter table). Costs a device→host fetch of every param; gate it
+        on a sparse trigger like the reference warns."""
+        if self._summary is None:
+            return
+        trig = getattr(self._summary, "get_summary_trigger",
+                       lambda _n: None)("Parameters")
+        if trig is None or not trig(st):
+            return
+        if getattr(self, "_last_hist_neval", -1) == st["neval"]:
+            return
+        self._last_hist_neval = st["neval"]
+        import numpy as _np
+
+        def walk(tree, prefix):
+            for k, v in tree.items():
+                path = f"{prefix}.{k}" if prefix else str(k)
+                if isinstance(v, dict):
+                    walk(v, path)
+                else:
+                    self._summary.add_histogram(
+                        path, _np.asarray(jax.device_get(v)), st["neval"])
+        walk(params, "")
 
     def _maybe_validate(self, params, model_state, st):
         if self.val_trigger is None or not self.val_trigger(st):

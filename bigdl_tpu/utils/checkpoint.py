@@ -88,9 +88,12 @@ def save_checkpoint(path: str, trees: Dict[str, Any],
                 addressable = not (isinstance(v, jax.Array)
                                    and not v.is_fully_addressable)
                 if addressable and not writer:
-                    continue               # only the writer needs the copy;
-                    # non-addressable leaves must be gathered symmetrically
-                arrays[k] = _fetch(v)
+                    continue               # writer-only copy; non-addressable
+                    # leaves must be gathered symmetrically below
+                fetched = _fetch(v)
+                if writer:                 # non-writers only join the
+                    arrays[k] = fetched    # collective, never keep the copy
+
         if writer:
             tmp = path + ".tmp"
             if os.path.exists(tmp):

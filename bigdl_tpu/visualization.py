@@ -89,6 +89,33 @@ def encode_scalar_event(tag: str, value: float, step: int,
             + _pb_int64(2, step) + _pb_bytes(5, summary))
 
 
+def _pb_packed_doubles(field: int, vals) -> bytes:
+    payload = struct.pack(f"<{len(vals)}d", *vals)
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def encode_histogram_event(tag: str, values, step: int,
+                           bins: int = 30,
+                           wall_time: Optional[float] = None) -> bytes:
+    """Per-parameter distribution summary (reference:
+    optim/AbstractOptimizer.scala:47-91 writes `Parameters` histograms via
+    visualization/Summary.scala histogram; proto: HistogramProto)."""
+    import numpy as _np
+    v = _np.asarray(values, _np.float64).reshape(-1)
+    if v.size == 0:
+        v = _np.zeros(1)
+    counts, edges = _np.histogram(v, bins=bins)
+    histo = (_pb_double(1, float(v.min())) + _pb_double(2, float(v.max()))
+             + _pb_double(3, float(v.size)) + _pb_double(4, float(v.sum()))
+             + _pb_double(5, float((v * v).sum()))
+             + _pb_packed_doubles(6, [float(e) for e in edges[1:]])
+             + _pb_packed_doubles(7, [float(c) for c in counts]))
+    sv = _pb_string(1, tag) + _pb_bytes(5, histo)
+    summary = _pb_bytes(1, sv)
+    return (_pb_double(1, wall_time if wall_time is not None else time.time())
+            + _pb_int64(2, step) + _pb_bytes(5, summary))
+
+
 def encode_file_version_event() -> bytes:
     return _pb_double(1, time.time()) + _pb_string(3, "brain.Event:2")
 
@@ -116,6 +143,26 @@ def parse_records(blob: bytes) -> List[bytes]:
         out.append(data)
         off += 16 + length
     return out
+
+
+def parse_histogram_event(data: bytes):
+    """Decoder for histogram events: returns (tag, stats, step) where stats
+    has min/max/num/sum/sum_squares/bucket_limit/bucket, or None."""
+    from bigdl_tpu.interop.protowire import Msg
+    ev = Msg(data)
+    if not ev.has(5):
+        return None
+    step = ev.int(2, 0)
+    val = ev.msg(5).msg(1)                  # Summary.value[0]
+    if not val.has(5):
+        return None                         # not a histogram event
+    tag = val.str(1)
+    h = val.msg(5)
+    stats = {"min": h.doubles(1)[0], "max": h.doubles(2)[0],
+             "num": h.doubles(3)[0], "sum": h.doubles(4)[0],
+             "sum_squares": h.doubles(5)[0],
+             "bucket_limit": h.doubles(6), "bucket": h.doubles(7)}
+    return tag, stats, step
 
 
 def parse_scalar_event(data: bytes) -> Optional[Tuple[str, float, int]]:
@@ -210,6 +257,17 @@ class EventWriter:
     def add_scalar(self, tag: str, value: float, step: int):
         self._q.put(encode_scalar_event(tag, float(value), int(step)))
 
+    def add_histogram(self, tag: str, values, step: int):
+        self._q.put(encode_histogram_event(tag, values, int(step)))
+
+    def flush(self):
+        """Block until the queue is drained and bytes hit the file —
+        readers must not race the writer thread."""
+        import time as _time
+        while not self._q.empty():
+            _time.sleep(0.01)
+        self._fh.flush()
+
     def _run(self):
         while not self._stop.is_set() or not self._q.empty():
             try:
@@ -235,22 +293,44 @@ class Summary:
     def __init__(self, log_dir: str, app_name: str):
         self.log_dir = os.path.join(log_dir, app_name, self.tag)
         self._writer = EventWriter(self.log_dir)
+        self._triggers = {}
+
+    def set_summary_trigger(self, name: str, trigger) -> "Summary":
+        """(reference: visualization/TrainSummary.scala:57
+        setSummaryTrigger — e.g. ('Parameters', Trigger.several_iteration(n))
+        turns on per-parameter histogram dumps in the optimizer)."""
+        self._triggers[name] = trigger
+        return self
+
+    def get_summary_trigger(self, name: str):
+        return self._triggers.get(name)
 
     def add_scalar(self, tag: str, value: float, step: int):
         self._writer.add_scalar(tag, value, step)
         return self
 
-    def read_scalar(self, tag: str) -> List[Tuple[int, float]]:
-        """(reference: TrainSummary.readScalar via FileReader)."""
-        self._writer._fh.flush()
+    def add_histogram(self, tag: str, values, step: int):
+        self._writer.add_histogram(tag, values, step)
+        return self
+
+    def _read_events(self, parse_fn, tag: str):
+        self._writer.flush()
         out = []
         for name in sorted(os.listdir(self.log_dir)):
             with open(os.path.join(self.log_dir, name), "rb") as fh:
                 for rec in parse_records(fh.read()):
-                    parsed = parse_scalar_event(rec)
+                    parsed = parse_fn(rec)
                     if parsed and parsed[0] == tag:
                         out.append((parsed[2], parsed[1]))
         return out
+
+    def read_histogram(self, tag: str):
+        """List of (step, stats) for a histogram tag."""
+        return self._read_events(parse_histogram_event, tag)
+
+    def read_scalar(self, tag: str) -> List[Tuple[int, float]]:
+        """(reference: TrainSummary.readScalar via FileReader)."""
+        return self._read_events(parse_scalar_event, tag)
 
     def close(self):
         self._writer.close()
