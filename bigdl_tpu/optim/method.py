@@ -17,6 +17,7 @@ the update is elementwise XLA code, already data-parallel on device.
 
 from __future__ import annotations
 
+import math
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -310,12 +311,101 @@ class LarsSGD(OptimMethod):
                 {"velocity": treedef.unflatten([o[1] for o in outs])})
 
 
+def _cubic_interpolate(x1, f1, g1, x2, f2, g2, lo=None, hi=None):
+    """Minimizer of the cubic through (x1,f1,g1),(x2,f2,g2), clipped to
+    [lo, hi] (reference: optim/LineSearch.scala polyinterp — the classic
+    Nocedal–Wright interpolation)."""
+    if lo is None:
+        lo, hi = (x1, x2) if x1 <= x2 else (x2, x1)
+    d1 = g1 + g2 - 3 * (f1 - f2) / (x1 - x2)
+    sq = d1 * d1 - g1 * g2
+    if sq >= 0:
+        d2 = math.sqrt(sq)
+        den = (g2 - g1 + 2 * d2) if x1 <= x2 else (g1 - g2 + 2 * d2)
+        if abs(den) > 1e-20:
+            if x1 <= x2:
+                pos = x2 - (x2 - x1) * ((g2 + d2 - d1) / den)
+            else:
+                pos = x1 - (x1 - x2) * ((g1 + d2 - d1) / den)
+            return min(max(pos, lo), hi)
+    return (lo + hi) / 2.0
+
+
+def _strong_wolfe(feval, x, t, d, f0, g0, gtd0,
+                  c1: float = 1e-4, c2: float = 0.9,
+                  tol_change: float = 1e-9, max_ls: int = 25):
+    """Strong-Wolfe line search along d from x (reference:
+    optim/LineSearch.scala lswolfe; same bracket-then-zoom structure as
+    torch.optim.lbfgs._strong_wolfe). Returns (f_t, g_t, t, n_evals)."""
+    def ph(t_):
+        f, g = feval(x + t_ * d)
+        return float(f), g, float(jnp.dot(g, d))
+
+    f_prev, g_prev, gtd_prev = float(f0), g0, float(gtd0)
+    t_prev = 0.0
+    f_t, g_t, gtd_t = ph(t)
+    n_evals = 1
+    # --- bracketing phase
+    bracket = None
+    for _ in range(max_ls):
+        if f_t > float(f0) + c1 * t * gtd0 or f_t >= f_prev and n_evals > 1:
+            bracket = (t_prev, f_prev, g_prev, gtd_prev, t, f_t, g_t, gtd_t)
+            break
+        if abs(gtd_t) <= -c2 * gtd0:
+            return f_t, g_t, t, n_evals          # Wolfe satisfied
+        if gtd_t >= 0:
+            bracket = (t, f_t, g_t, gtd_t, t_prev, f_prev, g_prev, gtd_prev)
+            break
+        t_new = _cubic_interpolate(t_prev, f_prev, gtd_prev, t, f_t, gtd_t,
+                                   lo=t + 0.01 * (t - t_prev),
+                                   hi=t * 10)
+        t_prev, f_prev, g_prev, gtd_prev = t, f_t, g_t, gtd_t
+        t = t_new
+        f_t, g_t, gtd_t = ph(t)
+        n_evals += 1
+    if bracket is None:
+        return f_t, g_t, t, n_evals
+    # --- zoom phase
+    (t_lo, f_lo, g_lo, gtd_lo, t_hi, f_hi, g_hi, gtd_hi) = bracket
+    insuf = False
+    for _ in range(max_ls):
+        if abs(t_hi - t_lo) * max(abs(gtd_lo), abs(gtd_hi)) < tol_change:
+            break
+        t = _cubic_interpolate(t_lo, f_lo, gtd_lo, t_hi, f_hi, gtd_hi)
+        # insufficient-progress safeguard (reference: LineSearch.scala /
+        # torch lbfgs): a minimizer clipped onto a bracket endpoint would
+        # re-evaluate the same point forever — bisect instead
+        span = abs(t_hi - t_lo)
+        eps = 0.1 * span
+        if min(abs(t - t_lo), abs(t - t_hi)) < eps:
+            if insuf or t in (t_lo, t_hi):
+                mid = (t_lo + t_hi) / 2.0
+                t = mid
+                insuf = False
+            else:
+                insuf = True
+        else:
+            insuf = False
+        f_t, g_t, gtd_t = ph(t)
+        n_evals += 1
+        if f_t > float(f0) + c1 * t * gtd0 or f_t >= f_lo:
+            t_hi, f_hi, g_hi, gtd_hi = t, f_t, g_t, gtd_t
+        else:
+            if abs(gtd_t) <= -c2 * gtd0:
+                return f_t, g_t, t, n_evals
+            if gtd_t * (t_hi - t_lo) >= 0:
+                t_hi, f_hi, g_hi, gtd_hi = t_lo, f_lo, g_lo, gtd_lo
+            t_lo, f_lo, g_lo, gtd_lo = t, f_t, g_t, gtd_t
+    return f_lo, g_lo, t_lo, n_evals
+
+
 class LBFGS(OptimMethod):
-    """Limited-memory BFGS with two-loop recursion (reference:
-    optim/LBFGS.scala + LineSearch.scala). Host-driven: `step(feval, x)` runs
-    the jitted loss/grad `feval` repeatedly — the reference similarly drives
-    closures. Intended for full-batch local optimization (e.g. style
-    transfer, classic ML), not the distributed hot path."""
+    """Limited-memory BFGS with two-loop recursion and a strong-Wolfe line
+    search (reference: optim/LBFGS.scala + LineSearch.scala lswolfe).
+    Host-driven: `step(feval, x)` runs the jitted loss/grad `feval`
+    repeatedly — the reference similarly drives closures. Intended for
+    full-batch local optimization (e.g. style transfer, classic ML), not
+    the distributed hot path."""
 
     def __init__(self, max_iter: int = 20, max_eval: Optional[float] = None,
                  tol_fun: float = 1e-5, tol_x: float = 1e-9,
@@ -331,19 +421,19 @@ class LBFGS(OptimMethod):
         old_dirs, old_stps = [], []
         f, g = feval(x)
         losses = [float(f)]
-        prev_g = g
         d = -g
         t = min(1.0, 1.0 / float(jnp.sum(jnp.abs(g)))) * self.learning_rate
         n_eval = 1
         for it in range(self.max_iter):
-            x_new = x + t * d
-            f_new, g_new = feval(x_new)
-            n_eval += 1
-            if float(f_new) > float(f) and it > 0:
-                t *= 0.5
-                continue
-            y = g_new - prev_g
-            s = x_new - x
+            gtd = float(jnp.dot(g, d))
+            if gtd > -self.tol_x:
+                break                       # not a descent direction
+            f_new, g_new, t_used, evals = _strong_wolfe(
+                feval, x, t, d, f, g, gtd)
+            n_eval += evals
+            s = t_used * d
+            x = x + s
+            y = g_new - g
             ys = float(jnp.dot(y, s))
             if ys > 1e-10:
                 if len(old_dirs) >= self.n_correction:
@@ -351,10 +441,10 @@ class LBFGS(OptimMethod):
                     old_stps.pop(0)
                 old_dirs.append(y)
                 old_stps.append(s)
-            x, f, prev_g = x_new, f_new, g_new
+            f, g = f_new, g_new
             losses.append(float(f))
             # two-loop recursion
-            q = -g_new
+            q = -g
             alphas = []
             for y_i, s_i in zip(reversed(old_dirs), reversed(old_stps)):
                 rho = 1.0 / float(jnp.dot(y_i, s_i))
@@ -371,7 +461,7 @@ class LBFGS(OptimMethod):
             t = self.learning_rate
             if len(losses) > 1 and abs(losses[-1] - losses[-2]) < self.tol_fun:
                 break
-            if float(jnp.max(jnp.abs(t * d))) < self.tol_x:
+            if float(jnp.max(jnp.abs(s))) < self.tol_x:   # the step taken
                 break
             if n_eval >= self.max_eval:
                 break

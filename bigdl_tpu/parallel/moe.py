@@ -35,6 +35,39 @@ def router_probs(x, w_gate):
     return jax.nn.softmax(logits, axis=-1), logits
 
 
+def topk_dispatch(probs, k: int, capacity: int):
+    """Top-k routing (generalizes Switch top-1): each token is sent to its
+    k best experts with gates renormalized over the chosen k. Returns
+    (dispatch (T, E, C), combine (T, E, C), aux_load_balance).
+
+    Queue positions account for earlier choices so a token's i-th choice
+    lands after all previous choices' assignments to that expert; tokens
+    past capacity are dropped choice-wise (their other choices survive)."""
+    t, e = probs.shape
+    topv, topi = lax.top_k(probs, k)                          # (T, k)
+    gates = topv / jnp.maximum(topv.sum(axis=-1, keepdims=True), 1e-9)
+    dispatch = jnp.zeros((t, e, capacity), probs.dtype)
+    combine = jnp.zeros((t, e, capacity), probs.dtype)
+    counts = jnp.zeros((e,), probs.dtype)
+    frac_acc = jnp.zeros((e,), probs.dtype)
+    for i in range(k):                                        # k is static
+        oh = jax.nn.one_hot(topi[:, i], e, dtype=probs.dtype)
+        pos = jnp.cumsum(oh, axis=0) * oh + counts * oh
+        slot = (pos.sum(axis=1) - 1).astype(jnp.int32)
+        keep = slot < capacity
+        slot_oh = jax.nn.one_hot(jnp.where(keep, slot, capacity),
+                                 capacity + 1,
+                                 dtype=probs.dtype)[:, :capacity]
+        disp_i = oh[:, :, None] * slot_oh[:, None, :]
+        dispatch = dispatch + disp_i
+        combine = combine + disp_i * (gates[:, i] * keep)[:, None, None]
+        counts = counts + oh.sum(axis=0)
+        frac_acc = frac_acc + oh.mean(axis=0)
+    # Switch eq. 4 generalized: E * sum_e (assignments_e / k) * mean_prob_e
+    aux = e * jnp.sum(frac_acc / k * probs.mean(axis=0))
+    return dispatch, combine, aux
+
+
 def top1_dispatch(probs, capacity: int):
     """Switch routing: returns (dispatch (T, E, C) bool-ish float,
     combine (T, E, C) float, aux_load_balance_loss).
@@ -69,10 +102,17 @@ class MoE(Module):
     sharded over the 'expert' mesh axis."""
 
     def __init__(self, d_model: int, d_ff: int, n_experts: int,
-                 capacity_factor: float = 1.25, name=None):
+                 capacity_factor: float = 1.25, top_k: int = 1,
+                 dropless: bool = False, name=None):
         super().__init__(name)
         self.d_model, self.d_ff, self.n_experts = d_model, d_ff, n_experts
         self.capacity_factor = capacity_factor
+        self.top_k = top_k
+        # dropless: capacity = worst-case tokens-per-expert (T), so no token
+        # is ever dropped. Exact but memory ∝ T·E·C — the block-sparse
+        # MegaBlocks-style path is the production answer; this is the
+        # correctness-first one.
+        self.dropless = dropless
 
     def param_specs(self):
         d, f, e = self.d_model, self.d_ff, self.n_experts
@@ -88,8 +128,15 @@ class MoE(Module):
 
     def capacity(self, n_tokens: int) -> int:
         import math
+        if self.dropless:
+            return n_tokens
         return max(1, int(math.ceil(
-            n_tokens / self.n_experts * self.capacity_factor)))
+            n_tokens * self.top_k / self.n_experts * self.capacity_factor)))
+
+    def _dispatch(self, probs, cap):
+        if self.top_k == 1:
+            return top1_dispatch(probs, cap)
+        return topk_dispatch(probs, self.top_k, cap)
 
     def _experts(self, params, xe):
         """xe (E, C', d) -> (E, C', d): per-expert FFN via batched matmul."""
@@ -101,7 +148,7 @@ class MoE(Module):
         tokens = x.reshape(b * t, d)
         probs, logits = router_probs(tokens, params["gate"])
         cap = self.capacity(b * t)
-        dispatch, combine, aux = top1_dispatch(probs, cap)
+        dispatch, combine, aux = self._dispatch(probs, cap)
         xe = jnp.einsum("td,tec->ecd", tokens, dispatch)     # (E, C, d)
         ye = self._experts(params, xe)
         y = jnp.einsum("ecd,tec->td", ye, combine)
@@ -142,7 +189,7 @@ def expert_parallel_apply(moe: MoE, params, x, mesh: Mesh,
         tokens = x_local.reshape(b * t, d)
         probs, logits = router_probs(tokens, params_local["gate"])
         cap = moe.capacity(b * t)
-        dispatch, combine, aux = top1_dispatch(probs, cap)
+        dispatch, combine, aux = moe._dispatch(probs, cap)
         xe = jnp.einsum("td,tec->ecd", tokens, dispatch)     # (E, C, d)
         # (E, C, d) -> (E/n, n*C, d): this device's expert group's queues
         # from every device

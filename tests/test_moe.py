@@ -94,3 +94,69 @@ def test_moe_trains():
         if first is None:
             first = float(l)
     assert float(l) < first * 0.5, (first, float(l))
+
+
+def test_topk_dispatch_semantics():
+    """top-2 routing: each token reaches its 2 best experts with gates
+    renormalized over the chosen pair; capacity drops are choice-wise."""
+    from bigdl_tpu.parallel.moe import topk_dispatch
+    probs = jnp.asarray([[0.6, 0.3, 0.1],
+                         [0.1, 0.5, 0.4],
+                         [0.45, 0.45, 0.1]], jnp.float32)
+    dispatch, combine, aux = topk_dispatch(probs, 2, capacity=3)
+    # every token dispatched exactly twice
+    np.testing.assert_allclose(np.asarray(dispatch.sum(axis=(1, 2))),
+                               [2, 2, 2])
+    # gates renormalize: token 0 -> experts 0,1 with 0.6/0.9, 0.3/0.9
+    g0 = np.asarray(combine[0].sum(axis=1))
+    np.testing.assert_allclose(g0, [0.6 / 0.9, 0.3 / 0.9, 0.0], atol=1e-6)
+    assert float(aux) > 0
+
+
+def test_topk_capacity_drops_choicewise():
+    from bigdl_tpu.parallel.moe import topk_dispatch
+    # all 3 tokens pick expert 0 first; capacity 1 keeps only token 0's
+    # first choice; second choices (expert 1) all fit with capacity 3... use
+    # capacity 1 to see drops
+    probs = jnp.asarray([[0.9, 0.1], [0.8, 0.2], [0.7, 0.3]], jnp.float32)
+    dispatch, combine, _ = topk_dispatch(probs, 2, capacity=1)
+    # expert 0 serves only token 0; expert 1 only token 0's second choice
+    np.testing.assert_allclose(np.asarray(dispatch[:, 0, 0]), [1, 0, 0])
+    np.testing.assert_allclose(np.asarray(dispatch[:, 1, 0]), [1, 0, 0])
+
+
+def test_moe_top2_matches_manual_combine():
+    """top-2 MoE output = sum of gated expert outputs (no drops with
+    dropless=True)."""
+    moe = MoE(d_model=4, d_ff=8, n_experts=3, top_k=2, dropless=True)
+    params, state = moe.init(jax.random.PRNGKey(0))
+    r = np.random.RandomState(1)
+    x = jnp.asarray(r.randn(1, 5, 4), jnp.float32)
+    out, _ = moe.apply(params, state, x)
+
+    tokens = np.asarray(x).reshape(5, 4)
+    probs = np.asarray(jax.nn.softmax(
+        jnp.asarray(tokens) @ params["gate"], axis=-1))
+    w_up, w_down = np.asarray(params["w_up"]), np.asarray(params["w_down"])
+    want = tokens.copy()
+    for t in range(5):
+        top2 = np.argsort(-probs[t])[:2]
+        gsum = probs[t][top2].sum()
+        for e in top2:
+            h = np.maximum(tokens[t] @ w_up[e], 0)
+            want[t] += (probs[t][e] / gsum) * (h @ w_down[e])
+    np.testing.assert_allclose(np.asarray(out).reshape(5, 4), want,
+                               atol=1e-4)
+
+
+def test_moe_top2_expert_parallel_matches_local():
+    moe = MoE(d_model=8, d_ff=16, n_experts=4, top_k=2,
+              capacity_factor=4.0)
+    params, state = moe.init(jax.random.PRNGKey(0))
+    x = jnp.asarray(np.random.RandomState(2).randn(2, 8, 8), jnp.float32)
+    ref, _ = moe.apply(params, state, x)
+    out, aux = expert_parallel_apply(moe, params, x, _mesh(2))
+    # EP enforces capacity per shard, so allow the generous factor to make
+    # behavior identical, then require exact agreement
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+    assert np.isfinite(float(aux["load_balance"]))
