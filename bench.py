@@ -21,6 +21,7 @@ ResNet-50 training on the reference's dual-socket Broadwell-class Xeon
 ResNet-50 training throughput of that era is ~30-60 imgs/sec).
 """
 
+import functools
 import json
 import os
 import subprocess
@@ -46,16 +47,26 @@ def _peak_flops(device_kind: str):
 
 
 # --------------------------------------------------------------------- child
-def _time_steps(step, args, warmup, iters):
+def _time_steps(step, carry, warmup, iters):
+    """Times `carry = step(carry)` chains. Steps are DATA-DEPENDENT (each
+    consumes the previous carry) and completion is forced by fetching the
+    carry's last leaf to the host: on this image's axon TPU plugin,
+    `jax.block_until_ready` returns before execution finishes, so timing
+    un-chained dispatches measures the enqueue rate, not the chip (round-1
+    bench inflated throughput ~40x this way). A device->host transfer of a
+    value data-dependent on every step cannot lie."""
     import jax
-    out = step(*args)
-    for _ in range(warmup - 1):
-        out = step(*args)
-    jax.block_until_ready(out)
+
+    def sync(c):
+        return float(jax.device_get(jax.tree.leaves(c)[-1].ravel()[0]))
+
+    for _ in range(warmup):
+        carry = step(carry)
+    sync(carry)
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = step(*args)
-    jax.block_until_ready(out)
+        carry = step(carry)
+    sync(carry)
     return (time.perf_counter() - t0) / iters
 
 
@@ -89,11 +100,12 @@ def _bench_resnet50(compute_dtype=None, batch_size=None, spatial=None,
     y = jnp.asarray(r.randint(0, 1000, size=batch_size).astype(np.int32))
     rng = jax.random.PRNGKey(7)
 
-    def step(params, state, slots, x, y):
+    def step(params, slots, model_state, x, y):
         def loss_fn(p):
             pc = cast_floating(p, compute_dtype) if compute_dtype else p
             xc = x.astype(compute_dtype) if compute_dtype else x
-            out, ns = model.apply(pc, state, xc, training=True, rng=rng)
+            out, ns = model.apply(pc, model_state, xc, training=True,
+                                  rng=rng)
             if compute_dtype:
                 out = out.astype(jnp.float32)
             return criterion.forward(out, y), ns
@@ -102,16 +114,19 @@ def _bench_resnet50(compute_dtype=None, batch_size=None, spatial=None,
             grads = cast_floating(grads, jnp.float32)
         new_p, new_s = method.update(params, grads, slots,
                                      jnp.float32(0.1), jnp.int32(0))
-        return new_p, ns, new_s, loss
+        # ns (BN running stats) rides the carry so XLA can't DCE the
+        # EMA-update subgraph out of the timed step
+        return new_p, new_s, ns, loss
 
-    jitted = jax.jit(step)
-    compiled = jitted.lower(params, state, slots, x, y).compile()
+    jitted = jax.jit(step, donate_argnums=(0, 1, 2))
+    compiled = jitted.lower(params, slots, state, x, y).compile()
     cost = compiled.cost_analysis()
     if isinstance(cost, (list, tuple)):
         cost = cost[0] if cost else {}
     flops = float((cost or {}).get("flops", 0.0))
 
-    sec = _time_steps(lambda *a: compiled(*a)[3], (params, state, slots, x, y),
+    sec = _time_steps(lambda c: compiled(c[0], c[1], c[2], x, y),
+                      (params, slots, state, jnp.float32(0.0)),
                       warmup, iters)
     return batch_size / sec, flops, sec
 
@@ -135,17 +150,18 @@ def _bench_lenet(batch_size=512, warmup=3, iters=20):
     x = jnp.asarray(r.randn(batch_size, 28, 28, 1).astype(np.float32))
     y = jnp.asarray(r.randint(0, 10, size=batch_size).astype(np.int32))
 
-    @jax.jit
-    def step(params, state, slots, x, y):
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def step(params, slots, model_state, x, y):
         def loss_fn(p):
-            out, ns = model.apply(p, state, x, training=True)
+            out, ns = model.apply(p, model_state, x, training=True)
             return criterion.forward(out, y), ns
         (loss, ns), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         new_p, new_s = method.update(params, grads, slots,
                                      jnp.float32(0.01), jnp.int32(0))
-        return new_p, ns, new_s, loss
+        return new_p, new_s, ns, loss
 
-    sec = _time_steps(lambda *a: step(*a)[3], (params, state, slots, x, y),
+    sec = _time_steps(lambda c: step(c[0], c[1], c[2], x, y),
+                      (params, slots, state, jnp.float32(0.0)),
                       warmup, iters)
     return batch_size / sec
 
