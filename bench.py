@@ -55,18 +55,15 @@ def _time_steps(step, carry, warmup, iters):
     un-chained dispatches measures the enqueue rate, not the chip (round-1
     bench inflated throughput ~40x this way). A device->host transfer of a
     value data-dependent on every step cannot lie."""
-    import jax
-
-    def sync(c):
-        return float(jax.device_get(jax.tree.leaves(c)[-1].ravel()[0]))
+    from bigdl_tpu.utils.sync import force_completion
 
     for _ in range(warmup):
         carry = step(carry)
-    sync(carry)
+    force_completion(carry)
     t0 = time.perf_counter()
     for _ in range(iters):
         carry = step(carry)
-    sync(carry)
+    force_completion(carry)
     return (time.perf_counter() - t0) / iters
 
 

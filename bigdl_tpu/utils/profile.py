@@ -5,7 +5,7 @@ metrics optim/Metrics.scala; perf CLI nn/mkldnn/Perf.scala:37-126).
 
 Two tools:
   * `module_times` — eager per-child wall time (the reference's getTimes):
-    runs each direct child separately with block_until_ready. Under jit XLA
+    runs each direct child separately, syncing via host fetch. Under jit XLA
     fuses across modules, so this measures the un-fused upper bound — use it
     to find the hot module, then `xla_profile` for the fused truth.
   * `xla_profile` — wraps jax.profiler around a jitted fn; the trace opens
@@ -22,6 +22,9 @@ import jax
 import jax.numpy as jnp
 
 
+from bigdl_tpu.utils.sync import chain_dep, force_completion as _sync
+
+
 def module_times(model, params, state, *inputs, repeats: int = 3,
                  training: bool = False, rng=None) -> List[Tuple[str, float]]:
     """Per-direct-child forward wall time in seconds, sorted descending
@@ -31,6 +34,15 @@ def module_times(model, params, state, *inputs, repeats: int = 3,
     from bigdl_tpu.core.container import Sequential
 
     results: List[Tuple[str, float]] = []
+    # the sync fetch itself costs a device round-trip (~70ms through this
+    # image's chip tunnel) — measure and subtract it so small modules don't
+    # all report the RTT
+    probe = jnp.zeros((1,))
+    _sync(probe)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        _sync(probe + 1.0)
+    rtt = (time.perf_counter() - t0) / 3
     children = model.children()
     # only Sequential runs children as a chain; time anything else whole
     if not children or not isinstance(model, Sequential):
@@ -43,16 +55,21 @@ def module_times(model, params, state, *inputs, repeats: int = 3,
         cp = params.get(cname, {}) if isinstance(params, dict) else {}
         cs = state.get(cname, {}) if isinstance(state, dict) else {}
 
-        def run():
-            out, _ = child.apply(cp, cs, *h, training=training, rng=rng)
+        def run(hh):
+            out, _ = child.apply(cp, cs, *hh, training=training, rng=rng)
             return out
 
-        out = run()                        # warm up / get next input
-        jax.block_until_ready(out)
+        out = run(h)                       # warm up / get next input
+        _sync(out)
         t0 = time.perf_counter()
+        hh, last = h, out
         for _ in range(repeats):
-            jax.block_until_ready(run())
-        dt = (time.perf_counter() - t0) / repeats
+            last = run(hh)
+            # only data-dependent chains are guaranteed to execute
+            # back-to-back on this image's plugin (utils/sync.py)
+            hh = (chain_dep(h[0], last),) + tuple(h[1:])
+        _sync(last)                        # RTT paid once, subtracted below
+        dt = max(0.0, (time.perf_counter() - t0 - rtt)) / max(1, repeats)
         results.append((f"{cname}:{child.name}", dt))
         h = out if isinstance(out, tuple) else (out,)
     return sorted(results, key=lambda kv: -kv[1])
@@ -72,11 +89,11 @@ def xla_profile(fn: Callable, *args, logdir: str = "/tmp/bigdl_tpu_profile",
     (reference analogue: the Metrics phase timers; here XLA's own profiler
     carries per-fusion timing)."""
     out = fn(*args)                        # compile outside the trace
-    jax.block_until_ready(out)
+    _sync(out)
     with jax.profiler.trace(logdir):
         for _ in range(iters):
             out = fn(*args)
-        jax.block_until_ready(out)
+        _sync(out)
     return logdir
 
 

@@ -1,0 +1,38 @@
+"""Device-completion helpers for timing code.
+
+On this image's axon TPU plugin, `jax.block_until_ready` returns at
+schedule time, and even repeated un-chained dispatches of the same
+executable are not guaranteed to execute back-to-back. Every timed region
+must therefore (a) make successive steps data-dependent and (b) end with a
+real device→host fetch that depends on the work being timed. These helpers
+are shared by bench.py and utils/profile.py so the plugin workaround lives
+in exactly one place."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _first_elem(leaf):
+    """One element of `leaf` without materializing a full copy."""
+    return leaf[(0,) * leaf.ndim] if getattr(leaf, "ndim", 0) else leaf
+
+
+def force_completion(tree) -> None:
+    """Block until every array leaf of `tree` has actually been computed,
+    by fetching one element of each to the host."""
+    leaves = [l for l in jax.tree.leaves(tree) if hasattr(l, "ndim")]
+    if leaves:
+        jax.device_get([_first_elem(l) for l in leaves])
+
+
+def chain_dep(x, out):
+    """Return `x` unchanged in value but data-dependent on EVERY array leaf
+    of `out`, so the next dispatch cannot start (or be elided) before `out`
+    is fully computed."""
+    leaves = [l for l in jax.tree.leaves(out) if hasattr(l, "ndim")]
+    if not leaves:
+        return x
+    z = sum(_first_elem(l).astype(jnp.float32) for l in leaves) * 0.0
+    return x + z.astype(x.dtype)
