@@ -38,7 +38,7 @@ def module_times(model, params, state, *inputs, repeats: int = 3,
     # image's chip tunnel) — measure and subtract it so small modules don't
     # all report the RTT
     probe = jnp.zeros((1,))
-    _sync(probe)
+    _sync(probe + 1.0)                     # compile the probe add untimed
     t0 = time.perf_counter()
     for _ in range(3):
         _sync(probe + 1.0)
@@ -91,8 +91,12 @@ def xla_profile(fn: Callable, *args, logdir: str = "/tmp/bigdl_tpu_profile",
     out = fn(*args)                        # compile outside the trace
     _sync(out)
     with jax.profiler.trace(logdir):
+        cur = args
         for _ in range(iters):
-            out = fn(*args)
+            out = fn(*cur)
+            # chain iterations — un-chained identical dispatches may overlap
+            # or be elided on this image's plugin (utils/sync.py)
+            cur = (chain_dep(cur[0], out),) + tuple(cur[1:])
         _sync(out)
     return logdir
 

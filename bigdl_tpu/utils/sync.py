@@ -19,10 +19,15 @@ def _first_elem(leaf):
     return leaf[(0,) * leaf.ndim] if getattr(leaf, "ndim", 0) else leaf
 
 
+def _array_leaves(tree):
+    return [l for l in jax.tree.leaves(tree)
+            if hasattr(l, "ndim") and getattr(l, "size", 0)]
+
+
 def force_completion(tree) -> None:
-    """Block until every array leaf of `tree` has actually been computed,
-    by fetching one element of each to the host."""
-    leaves = [l for l in jax.tree.leaves(tree) if hasattr(l, "ndim")]
+    """Block until every (non-empty) array leaf of `tree` has actually been
+    computed, by fetching one element of each to the host."""
+    leaves = _array_leaves(tree)
     if leaves:
         jax.device_get([_first_elem(l) for l in leaves])
 
@@ -30,9 +35,11 @@ def force_completion(tree) -> None:
 def chain_dep(x, out):
     """Return `x` unchanged in value but data-dependent on EVERY array leaf
     of `out`, so the next dispatch cannot start (or be elided) before `out`
-    is fully computed."""
-    leaves = [l for l in jax.tree.leaves(out) if hasattr(l, "ndim")]
+    is fully computed. Non-finite leaf values are masked so the contract
+    holds even for overflowing/diverging outputs."""
+    leaves = _array_leaves(out)
     if not leaves:
         return x
     z = sum(_first_elem(l).astype(jnp.float32) for l in leaves) * 0.0
+    z = jnp.where(jnp.isfinite(z), z, 0.0)
     return x + z.astype(x.dtype)

@@ -110,19 +110,26 @@ def main():
     qacc = evaluate(qmodel, qparams, state, val,
                     [Top1Accuracy()])["Top1Accuracy"].result
 
+    from bigdl_tpu.utils.sync import chain_dep, force_completion
     fwd = jax.jit(lambda p, x: model.apply(p, state, x)[0])
     qfwd = jax.jit(lambda p, x: qmodel.apply(p, state, x)[0])
     xb = jnp.asarray(x[:256])
-    jax.block_until_ready(fwd(params, xb))
-    jax.block_until_ready(qfwd(qparams, xb))
-    t0 = time.perf_counter()
-    for _ in range(10):
-        jax.block_until_ready(fwd(params, xb))
-    tf32 = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    for _ in range(10):
-        jax.block_until_ready(qfwd(qparams, xb))
-    ti8 = time.perf_counter() - t0
+
+    def timed(f, p):
+        # chained dispatches + host-fetch completion: block_until_ready is
+        # not sufficient on this image's TPU plugin (utils/sync.py)
+        out = f(p, xb)
+        force_completion(out)
+        cur = xb
+        t0 = time.perf_counter()
+        for _ in range(10):
+            out = f(p, cur)
+            cur = chain_dep(xb, out)
+        force_completion(out)
+        return time.perf_counter() - t0
+
+    tf32 = timed(fwd, params)
+    ti8 = timed(qfwd, qparams)
 
     print(f"fp32 acc {facc:.4f} | int8 acc {qacc:.4f} | "
           f"drop {facc - qacc:.4f}")
