@@ -63,7 +63,9 @@ class TFNode:
         a = self.attrs.get(key)
         if a is None:
             return []
-        return a.msg(1).ints(3) if a.has(1) else a.ints(3)
+        raw = a.msg(1).ints(3) if a.has(1) else a.ints(3)
+        # varints are unsigned on the wire; TF attr ints are int64
+        return [v - (1 << 64) if v >= (1 << 63) else v for v in raw]
 
     def attr_str(self, key, default="") -> str:
         a = self.attrs.get(key)
@@ -211,7 +213,8 @@ def load_graphdef(path_or_bytes) -> TFGraph:
 def make_node(name: str, op: str, inputs: Sequence[str] = (),
               tensor: Optional[np.ndarray] = None,
               ints: Optional[Dict[str, List[int]]] = None,
-              strs: Optional[Dict[str, str]] = None) -> bytes:
+              strs: Optional[Dict[str, str]] = None,
+              scalars: Optional[Dict[str, object]] = None) -> bytes:
     """Encode one NodeDef (used by the exporter/tests — the analogue of
     TensorflowSaver, utils/tf/TensorflowSaver.scala)."""
     body = pw.field_str(1, name) + pw.field_str(2, op)
@@ -236,4 +239,14 @@ def make_node(name: str, op: str, inputs: Sequence[str] = (),
         body += attr(key, pw.field_bytes(1, pw.field_packed_ints(3, vals)))
     for key, s in (strs or {}).items():
         body += attr(key, pw.field_str(2, s))
+    for key, v in (scalars or {}).items():
+        # AttrValue scalar fields: i=3 varint, f=4 float, b=5 varint
+        if isinstance(v, bool):
+            body += attr(key, pw.field_varint(5, int(v)))
+        elif isinstance(v, int):
+            body += attr(key, pw.field_varint(3, v & ((1 << 64) - 1)))
+        elif isinstance(v, float):
+            body += attr(key, pw.field_float(4, v))
+        else:
+            raise ValueError(f"unsupported scalar attr {key}={v!r}")
     return pw.field_bytes(1, body)

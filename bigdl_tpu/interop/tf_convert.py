@@ -17,6 +17,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 import bigdl_tpu.nn as nn
 from bigdl_tpu.core.container import Graph, Input, Node
@@ -62,6 +63,73 @@ class ReduceMean(Module):
 
     def forward(self, params, x, **_):
         return jnp.mean(x, axis=self.axes, keepdims=self.keepdims)
+
+
+class Lambda(Module):
+    """Stateless op captured as a named callable (the converter's analogue
+    of the reference's thin one-op loaders, utils/tf/loaders/)."""
+
+    def __init__(self, fn, label: str, n_in: int = 1,
+                 name: Optional[str] = None):
+        super().__init__(name=name or label)
+        self._fn, self.label, self.n_in = fn, label, n_in
+
+    def forward(self, params, *xs, **_):
+        if len(xs) == 1 and isinstance(xs[0], (tuple, list)):
+            xs = tuple(xs[0])
+        return self._fn(*xs)
+
+
+class ConstBinary(Module):
+    """x (op) const, with the const on either side."""
+
+    def __init__(self, fn, const_arr, const_first: bool,
+                 label: str, name: Optional[str] = None):
+        super().__init__(name=name or label)
+        self._fn = fn
+        self.const = jnp.asarray(const_arr)
+        self.const_first = const_first
+        self.label = label
+
+    def forward(self, params, x, **_):
+        return self._fn(self.const, x) if self.const_first \
+            else self._fn(x, self.const)
+
+
+# TF DataType enum → numpy dtype (types.proto)
+_TF_DTYPES = {1: jnp.float32, 2: jnp.float64, 3: jnp.int32, 4: jnp.uint8,
+              5: jnp.int16, 6: jnp.int8, 9: jnp.int64, 10: jnp.bool_,
+              14: jnp.bfloat16, 19: jnp.float16}
+
+_UNARY_OPS = {
+    "Abs": jnp.abs, "Neg": jnp.negative, "Exp": jnp.exp, "Log": jnp.log,
+    "Log1p": jnp.log1p, "Expm1": jnp.expm1, "Sqrt": jnp.sqrt,
+    "Rsqrt": lambda x: 1.0 / jnp.sqrt(x), "Square": jnp.square,
+    "Reciprocal": lambda x: 1.0 / x, "Inv": lambda x: 1.0 / x,
+    "Ceil": jnp.ceil, "Floor": jnp.floor, "Round": jnp.round,
+    "Rint": jnp.round, "Sign": jnp.sign,
+    "Erf": jax.scipy.special.erf,
+    "Erfc": lambda x: 1.0 - jax.scipy.special.erf(x),
+    "IsFinite": jnp.isfinite, "IsInf": jnp.isinf, "IsNan": jnp.isnan,
+    "LogicalNot": jnp.logical_not,
+    "Softplus": jax.nn.softplus, "Softsign": jax.nn.soft_sign,
+}
+
+_BINARY_OPS = {
+    "Sub": jnp.subtract, "Div": jnp.divide, "RealDiv": jnp.divide,
+    "FloorDiv": jnp.floor_divide, "TruncateDiv": lambda a, b:
+        jnp.trunc(a / b).astype(a.dtype),
+    "FloorMod": jnp.mod, "Mod": jnp.mod, "Pow": jnp.power,
+    "Maximum": jnp.maximum, "Minimum": jnp.minimum,
+    "SquaredDifference": lambda a, b: jnp.square(a - b),
+    "Equal": lambda a, b: a == b, "NotEqual": lambda a, b: a != b,
+    "Greater": lambda a, b: a > b, "GreaterEqual": lambda a, b: a >= b,
+    "Less": lambda a, b: a < b, "LessEqual": lambda a, b: a <= b,
+    "LogicalAnd": jnp.logical_and, "LogicalOr": jnp.logical_or,
+}
+
+_REDUCE_OPS = {"Sum": jnp.sum, "Max": jnp.max, "Min": jnp.min,
+               "Prod": jnp.prod, "All": jnp.all, "Any": jnp.any}
 
 
 # ------------------------------------------------------------ const folding
@@ -144,6 +212,11 @@ def to_module(graph: TFGraph, inputs: Optional[Sequence[str]] = None,
     return g, params, state, name_map
 
 
+def _sint(v: int) -> int:
+    """Sign-extend a uint64 varint (TF attr ints are int64)."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
 def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
                  sym: Dict[str, Node], weights) -> Optional[Node]:
     op = node.op
@@ -155,6 +228,31 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
         if p_over or s_over:
             weights.append((n, p_over or {}, s_over or {}))
         return n
+
+    def attr_int(key: str, default: int) -> int:
+        a = node.attrs.get(key)
+        return _sint(a.int(3, default)) if a is not None else default
+
+    def mixed(n: int):
+        """Resolve the first n inputs position-by-position: consts are
+        closed over, symbolic inputs pass through — `Graph` only wires
+        symbolic parents, so op handlers must not assume all-dynamic."""
+        slots, parents = [], []
+        for i in range(n):
+            cv = _const_value(graph, node.inputs[i])
+            if cv is not None:
+                slots.append(jnp.asarray(cv))
+            else:
+                slots.append(None)
+                parents.append(sym[node.inputs[i]])
+
+        def wrap(fn):
+            def inner(*xs):
+                it = iter(xs)
+                return fn(*[s if s is not None else next(it)
+                            for s in slots])
+            return inner
+        return wrap, parents
 
     if op in _ALIAS_OPS:
         return sym[data_ins[0]]
@@ -268,6 +366,227 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
         if pads is None:
             raise NotImplementedError(f"Pad {node.name}: dynamic paddings")
         return mk(ConstPad(np.asarray(pads).tolist()))
+    # ------------------------------------------------------- elementwise
+    if op in _UNARY_OPS:
+        return mk(Lambda(_UNARY_OPS[op], op.lower()))
+    if op == "LeakyRelu":
+        a = node.attrs.get("alpha")
+        return mk(nn.LeakyReLU(a.float(4, 0.2) if a is not None else 0.2))
+    if op == "Elu":
+        return mk(nn.ELU())
+    if op == "Selu":
+        return mk(nn.SELU())
+    if op == "LogSoftmax":
+        return mk(nn.LogSoftMax(axis=-1))
+    if op == "Cast":
+        a = node.attrs.get("DstT")
+        dst = _TF_DTYPES.get(a.int(6) if a is not None else 1, jnp.float32)
+        return mk(Lambda(lambda x, d=dst: x.astype(d), "cast"))
+    if op in _BINARY_OPS:
+        fn = _BINARY_OPS[op]
+        if len(data_ins) == 2:
+            return mk(Lambda(fn, op.lower(), n_in=2))
+        ci = 0 if node.inputs and _const_value(graph, node.inputs[0]) \
+            is not None else 1
+        c = _const_value(graph, node.inputs[ci])
+        if c is None:
+            raise NotImplementedError(f"{op} {node.name}: missing operand")
+        return mk(ConstBinary(fn, c, const_first=(ci == 0), label=op.lower()))
+    if op == "AddN":
+        wrap, parents = mixed(len(node.inputs))
+        return mk(Lambda(wrap(lambda *xs: sum(xs[1:], xs[0])), "add_n",
+                         n_in=len(parents)), parents=parents)
+    if op in _REDUCE_OPS:
+        axes = const(1)
+        if axes is None:
+            raise NotImplementedError(f"{op} {node.name}: dynamic axes")
+        axes = tuple(int(a) for a in np.asarray(axes).reshape(-1))
+        keep = node.attrs.get("keep_dims")
+        keepdims = bool(keep.int(5)) if keep is not None else False
+        fn = _REDUCE_OPS[op]
+        return mk(Lambda(lambda x, f=fn, a=axes, k=keepdims:
+                         f(x, axis=a, keepdims=k), op.lower()))
+
+    # ------------------------------------------------------- shape/array
+    if op == "Shape":
+        return mk(Lambda(lambda x: jnp.asarray(x.shape, jnp.int32), "shape"))
+    if op == "Rank":
+        return mk(Lambda(lambda x: jnp.asarray(x.ndim, jnp.int32), "rank"))
+    if op == "Pack":
+        axis = attr_int("axis", 0)
+        wrap, parents = mixed(len(node.inputs))
+        return mk(Lambda(wrap(lambda *xs, ax=axis: jnp.stack(xs, axis=ax)),
+                         "pack", n_in=len(parents)), parents=parents)
+    if op == "Tile":
+        mult = const(1)
+        if mult is None:
+            raise NotImplementedError(f"Tile {node.name}: dynamic multiples")
+        reps = tuple(int(v) for v in np.asarray(mult).reshape(-1))
+        return mk(Lambda(lambda x, r=reps: jnp.tile(x, r), "tile"))
+    if op == "Slice":
+        begin, size = const(1), const(2)
+        if begin is None or size is None:
+            raise NotImplementedError(f"Slice {node.name}: dynamic operands")
+        b = [int(v) for v in np.asarray(begin).reshape(-1)]
+        s = [int(v) for v in np.asarray(size).reshape(-1)]
+
+        def do_slice(x, b=tuple(b), s=tuple(s)):
+            idx = tuple(slice(bi, x.shape[i] if si == -1 else bi + si)
+                        for i, (bi, si) in enumerate(zip(b, s)))
+            return x[idx]
+        return mk(Lambda(do_slice, "slice"))
+    if op == "StridedSlice":
+        begin, end, strides = const(1), const(2), const(3)
+        if any(v is None for v in (begin, end, strides)):
+            raise NotImplementedError(
+                f"StridedSlice {node.name}: dynamic operands")
+        if attr_int("ellipsis_mask", 0) or attr_int("new_axis_mask", 0):
+            raise NotImplementedError(
+                f"StridedSlice {node.name}: ellipsis/new_axis masks")
+        bm = attr_int("begin_mask", 0)
+        em = attr_int("end_mask", 0)
+        sm = attr_int("shrink_axis_mask", 0)
+        b = [int(v) for v in np.asarray(begin).reshape(-1)]
+        e = [int(v) for v in np.asarray(end).reshape(-1)]
+        st = [int(v) for v in np.asarray(strides).reshape(-1)]
+
+        def do_ss(x, b=tuple(b), e=tuple(e), st=tuple(st),
+                  bm=bm, em=em, sm=sm):
+            idx = []
+            for i in range(len(b)):
+                if sm & (1 << i):
+                    idx.append(b[i])
+                    continue
+                lo = None if bm & (1 << i) else b[i]
+                hi = None if em & (1 << i) else e[i]
+                idx.append(slice(lo, hi, st[i]))
+            return x[tuple(idx)]
+        return mk(Lambda(do_ss, "strided_slice"))
+    if op == "Transpose":
+        perm = const(1)
+        if perm is None:
+            raise NotImplementedError(f"Transpose {node.name}: dynamic perm")
+        p = tuple(int(v) for v in np.asarray(perm).reshape(-1))
+        return mk(Lambda(lambda x, pp=p: jnp.transpose(x, pp), "transpose"))
+    if op in ("Gather", "GatherV2"):
+        data = _const_value(graph, node.inputs[0])
+        ax = const(2) if len(node.inputs) > 2 else 0
+        axis = int(np.asarray(ax).reshape(())) if ax is not None else 0
+        if data is not None and data.ndim == 2 and axis == 0:
+            m = nn.LookupTable(data.shape[0], data.shape[1])
+            return mk(m, {"weight": data})
+        wrap, parents = mixed(2)
+        return mk(Lambda(wrap(lambda x, i, a=axis:
+                              jnp.take(x, jnp.asarray(i, jnp.int32),
+                                       axis=a)),
+                         "gather", n_in=len(parents)), parents=parents)
+    if op == "OneHot":
+        depth = const(1)
+        on = const(2)
+        off = const(3)
+        if depth is None:
+            raise NotImplementedError(f"OneHot {node.name}: dynamic depth")
+        d = int(np.asarray(depth).reshape(()))
+        on_v = float(np.asarray(on).reshape(())) if on is not None else 1.0
+        off_v = float(np.asarray(off).reshape(())) if off is not None else 0.0
+        return mk(Lambda(lambda x, dd=d, o=on_v, f=off_v:
+                         jax.nn.one_hot(x, dd) * (o - f) + f, "one_hot"))
+    if op in ("Select", "SelectV2"):
+        wrap, parents = mixed(3)
+        return mk(Lambda(wrap(lambda c, t, f: jnp.where(c, t, f)),
+                         "select", n_in=len(parents)), parents=parents)
+    if op == "ArgMax":
+        if len(node.inputs) > 1 and const(1) is None:
+            raise NotImplementedError(f"ArgMax {node.name}: dynamic axis")
+        ax = const(1) if len(node.inputs) > 1 else None
+        axis = int(np.asarray(ax).reshape(())) if ax is not None else 0
+        return mk(Lambda(lambda x, a=axis:
+                         jnp.argmax(x, axis=a).astype(jnp.int64), "argmax"))
+    if op == "ResizeBilinear":
+        size = const(1)
+        if size is None:
+            raise NotImplementedError(f"ResizeBilinear {node.name}: dynamic")
+        h, w = (int(v) for v in np.asarray(size).reshape(-1))
+        a = node.attrs.get("align_corners")
+        return mk(nn.ResizeBilinear(
+            h, w, align_corners=bool(a.int(5)) if a is not None else False))
+    if op == "BatchMatMul" or op == "BatchMatMulV2":
+        adj_x = node.attrs.get("adj_x")
+        adj_y = node.attrs.get("adj_y")
+        ax = bool(adj_x.int(5)) if adj_x is not None else False
+        ay = bool(adj_y.int(5)) if adj_y is not None else False
+
+        def bmm(a, b, ax=ax, ay=ay):
+            if ax:
+                a = jnp.swapaxes(a, -1, -2)
+            if ay:
+                b = jnp.swapaxes(b, -1, -2)
+            return jnp.matmul(a, b)
+        if len(data_ins) == 2:
+            return mk(Lambda(bmm, "batch_matmul", n_in=2))
+        w = const(1)
+        if w is None:
+            raise NotImplementedError(f"{op} {node.name}: missing operand")
+        return mk(ConstBinary(lambda a, b: bmm(b, a), w, const_first=True,
+                              label="batch_matmul"))
+
+    # ------------------------------------------------------------ spatial
+    if op == "LRN":
+        r = node.attrs.get("depth_radius")
+        radius = r.int(3, 5) if r is not None else 5
+        size = 2 * radius + 1
+        alpha = node.attrs.get("alpha")
+        beta = node.attrs.get("beta")
+        bias = node.attrs.get("bias")
+        # TF alpha is per-element (not /size like torch): compensate
+        return mk(nn.SpatialCrossMapLRN(
+            size, (alpha.float(4, 1.0) if alpha is not None else 1.0) * size,
+            beta.float(4, 0.5) if beta is not None else 0.5,
+            bias.float(4, 1.0) if bias is not None else 1.0))
+    if op == "Conv2DBackpropInput":
+        out_shape = _const_value(graph, node.inputs[0])
+        w = _const_value(graph, node.inputs[1])
+        if out_shape is None or w is None:
+            raise NotImplementedError(
+                f"Conv2DBackpropInput {node.name}: dynamic operands")
+        strides = node.attr_ints("strides") or [1, 1, 1, 1]
+        sh, sw = strides[1], strides[2]
+        kh, kw, cout, cin = w.shape          # filter (kh,kw,out_c,in_c_of_op)
+        oh, ow = int(out_shape[1]), int(out_shape[2])
+        same = node.attr_str("padding", "SAME") == "SAME"
+
+        # input spatial dims from the forward conv's shape rule, then solve
+        # (in-1)*s + k - 2p + adj = out for (p, adj)
+        def solve(out, k, s):
+            inp = -(-out // s) if same else (out - k) // s + 1
+            total = (inp - 1) * s + k - out
+            p = max(0, (total + 1) // 2)
+            return p, 2 * p - total
+        ph, ah = solve(oh, kh, sh)
+        pw_, aw = solve(ow, kw, sw)
+        m = nn.SpatialFullConvolution(cin, cout, kw, kh, sw, sh, pw_, ph,
+                                      adj_w=aw, adj_h=ah, bias=False)
+        return mk(m, {"weight": np.transpose(w, (0, 1, 3, 2))})
+    if op == "Conv3D":
+        w = const(1)
+        if w is None:
+            raise NotImplementedError(f"Conv3D {node.name}: non-const filter")
+        strides = node.attr_ints("strides") or [1, 1, 1, 1, 1]
+        same = node.attr_str("padding", "SAME") == "SAME"
+        kd, kh, kw, cin, cout = w.shape
+        if same and (any(s != 1 for s in strides[1:4])
+                     or any(k % 2 == 0 for k in (kd, kh, kw))):
+            raise NotImplementedError(
+                f"Conv3D {node.name}: SAME with stride>1/even kernel pads "
+                f"asymmetrically")
+        pt, ph, pw_ = ((kd - 1) // 2, (kh - 1) // 2, (kw - 1) // 2) \
+            if same else (0, 0, 0)
+        m = nn.VolumetricConvolution(
+            cin, cout, kd, kw, kh, strides[1], strides[3], strides[2],
+            pad_t=pt, pad_w=pw_, pad_h=ph, bias=False)
+        # TF filter is already DHWIO — a real trainable param, like Conv2D
+        return mk(m, {"weight": w})
+
     raise NotImplementedError(
         f"TF op {op!r} (node {node.name}) has no module loader "
         f"(reference: utils/tf/loaders/)")
