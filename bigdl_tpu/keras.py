@@ -30,9 +30,22 @@ _OPTIMIZERS = {
     "adamax": lambda: Adamax(2e-3),
 }
 
+class _CategoricalCE(nn.CrossEntropyCriterion):
+    """keras categorical_crossentropy: one-hot targets, logits input."""
+
+    def forward(self, input, target):
+        import jax.numpy as jnp
+        return super().forward(input, jnp.argmax(target, axis=-1))
+
+
+# Cross-entropy losses take LOGITS (softmax fused into the criterion, like
+# keras from_logits=True / torch CrossEntropyLoss). Round 1 mapped these to
+# ClassNLLCriterion, which expects log-probabilities — on the common
+# raw-logit head that silently trains garbage (loss → -inf). A model that
+# ends in SoftMax still converges here (double softmax is monotone).
 _LOSSES = {
-    "categorical_crossentropy": nn.ClassNLLCriterion,
-    "sparse_categorical_crossentropy": nn.ClassNLLCriterion,
+    "categorical_crossentropy": _CategoricalCE,
+    "sparse_categorical_crossentropy": nn.CrossEntropyCriterion,
     "mse": nn.MSECriterion,
     "mean_squared_error": nn.MSECriterion,
     "mae": nn.AbsCriterion,

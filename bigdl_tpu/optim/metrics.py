@@ -46,11 +46,19 @@ class ValidationMethod:
         """Called before each evaluation run; stateful methods clear buffers."""
 
 
+def _class_target(output, target):
+    """Accept integer labels or one-hot rows (keras categorical_* targets)."""
+    if target.ndim == output.ndim and target.shape[-1] == output.shape[-1]:
+        return jnp.argmax(target, axis=-1)
+    return target
+
+
 class Top1Accuracy(ValidationMethod):
     """(reference: ValidationMethod.scala:173)."""
     name = "Top1Accuracy"
 
     def batch(self, output, target):
+        target = _class_target(output, target)
         pred = jnp.argmax(output, axis=-1)
         correct = float(jnp.sum(pred == target.astype(pred.dtype)))
         return ValidationResult((correct, target.size),
@@ -62,6 +70,7 @@ class Top5Accuracy(ValidationMethod):
     name = "Top5Accuracy"
 
     def batch(self, output, target):
+        target = _class_target(output, target)
         k = min(5, output.shape[-1])
         top = jnp.argsort(output, axis=-1)[..., -k:]
         hit = jnp.any(top == target.astype(top.dtype)[..., None], axis=-1)
