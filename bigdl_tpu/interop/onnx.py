@@ -41,7 +41,8 @@ import bigdl_tpu.nn as nn
 from bigdl_tpu.core.container import Graph, Input, Node
 from bigdl_tpu.core.module import Module
 from bigdl_tpu.interop import protowire as pw
-from bigdl_tpu.interop.tf_convert import BiasAdd, ConstPad, ReduceMean
+from bigdl_tpu.interop.tf_convert import (BiasAdd, ConstPad, Lambda,
+                                          ReduceMean)
 
 # onnx.proto3 TensorProto.DataType
 _DTYPES = {1: np.float32, 2: np.uint8, 3: np.int8, 6: np.int32, 7: np.int64,
@@ -233,15 +234,7 @@ def make_model(graph: bytes, opset: int = 13) -> bytes:
 
 
 # -------------------------------------------------- converter-local modules
-class _Lambda(Module):
-    """Stateless elementwise/shape op captured as a named callable."""
-
-    def __init__(self, fn, label: str, name: Optional[str] = None):
-        super().__init__(name=name or label)
-        self._fn, self.label = fn, label
-
-    def forward(self, params, x, **_):
-        return self._fn(x)
+_Lambda = Lambda                 # shared with the TF converter (one home)
 
 
 class _ConstBinary(Module):
@@ -260,6 +253,22 @@ class _ConstBinary(Module):
         f = self._OPS[self.op]
         return f(self.const, x) if self.const_first else f(x, self.const)
 
+
+_REDUCES = {
+    "ReduceSum": lambda x, a, k: jnp.sum(x, axis=a, keepdims=k),
+    "ReduceMax": lambda x, a, k: jnp.max(x, axis=a, keepdims=k),
+    "ReduceMin": lambda x, a, k: jnp.min(x, axis=a, keepdims=k),
+    "ReduceProd": lambda x, a, k: jnp.prod(x, axis=a, keepdims=k),
+    "ReduceL1": lambda x, a, k: jnp.sum(jnp.abs(x), axis=a, keepdims=k),
+    "ReduceL2": lambda x, a, k: jnp.sqrt(
+        jnp.sum(jnp.square(x), axis=a, keepdims=k)),
+    "ReduceSumSquare": lambda x, a, k: jnp.sum(jnp.square(x), axis=a,
+                                               keepdims=k),
+    "ReduceLogSum": lambda x, a, k: jnp.log(jnp.sum(x, axis=a,
+                                                    keepdims=k)),
+    "ReduceLogSumExp": lambda x, a, k: jax.scipy.special.logsumexp(
+        x, axis=a, keepdims=k),
+}
 
 _NCHW2NHWC = [(1, 2), (2, 3)]              # axis-swap program for nn.Transpose
 _NHWC2NCHW = [(1, 3), (2, 3)]
@@ -384,7 +393,7 @@ def _build(g, node, sym, consts, mk, as_nhwc, as_onnx, lay, rnk, record):
     is_sym = lambda i: i < len(ins) and ins[i] in sym
 
     # ---------------------------------------------------------- aliases
-    if op in ("Identity", "Cast"):
+    if op == "Identity":
         sym[out] = sym[ins[0]]
         return
     if op == "Dropout":
@@ -687,19 +696,177 @@ def _build(g, node, sym, consts, mk, as_nhwc, as_onnx, lay, rnk, record):
                 return record(out, n, "onnx")
             parent = n
         return
-    if op in ("ReduceMean", "ReduceSum", "ReduceMax", "ReduceMin"):
+    if op == "ReduceMean" or op in _REDUCES:
         axes = node.ints_("axes")
         if axes is None and len(ins) > 1 and const(1) is not None:
             axes = [int(v) for v in np.asarray(const(1)).reshape(-1)]
         keep = bool(node.i("keepdims", 1))
         if op == "ReduceMean":
-            m = ReduceMean(axes, keep)
+            m = _Lambda(lambda x, k=keep: jnp.mean(x, keepdims=k),
+                        "reduce_mean_all") if axes is None \
+                else ReduceMean(axes, keep)
         else:
-            fn = {"ReduceSum": jnp.sum, "ReduceMax": jnp.max,
-                  "ReduceMin": jnp.min}[op]
-            m = _Lambda(lambda x, f=fn, a=tuple(axes), k=keep:
-                        f(x, axis=a, keepdims=k), op.lower())
+            a = None if axes is None else tuple(axes)  # None → all axes
+            m = _Lambda(lambda x, f=_REDUCES[op], aa=a, k=keep:
+                        f(x, aa, k), op.lower())
         return mk(out, m, [as_onnx(ins[0])], "onnx")
+
+    # ------------------------------------------------------ array tail
+    if op in ("Max", "Min", "Mean") and len(ins) > 1:   # n-ary elementwise
+        fn = {"Max": jnp.maximum, "Min": jnp.minimum}.get(op)
+        layouts = [lay[id(sym[i])] for i in ins if i in sym]
+        to = as_nhwc if "nhwc" in layouts else as_onnx
+        layout = "nhwc" if "nhwc" in layouts else "onnx"
+        # const operands close over their position (Graph only wires
+        # symbolic parents)
+        slots = [None if i in sym else jnp.asarray(consts[i]) for i in ins]
+        parents = [to(i) for i in ins if i in sym]
+        n_total = len(ins)
+
+        def nary(*xs, f=fn, slots=tuple(slots), nt=n_total, o=op):
+            it = iter(xs)
+            vals = [s if s is not None else next(it) for s in slots]
+            r = vals[0]
+            for v in vals[1:]:
+                r = (r + v) if o == "Mean" else f(r, v)
+            return r / nt if o == "Mean" else r
+        return mk(out, _Lambda(nary, op.lower(), n_in=len(parents)),
+                  parents, layout)
+    if op == "Cast":
+        to = node.i("to", 1)
+        dt = _DTYPES.get(to)
+        if dt is None:
+            raise NotImplementedError(f"Cast {node.name}: data_type {to}")
+        parent = sym[ins[0]]
+        return mk(out, _Lambda(lambda x, d=dt: x.astype(d), "cast"),
+                  [parent], lay[id(parent)])
+    if op == "Slice":
+        starts = node.ints_("starts")
+        ends = node.ints_("ends")
+        axes = node.ints_("axes")
+        steps = None
+        if starts is None:                 # opset >= 10: inputs
+            def ci(i):
+                c = const(i)
+                return None if c is None else [int(v) for v in
+                                               np.asarray(c).reshape(-1)]
+            starts, ends = ci(1), ci(2)
+            axes = ci(3) if len(ins) > 3 else None
+            steps = ci(4) if len(ins) > 4 else None
+        if starts is None or ends is None:
+            raise NotImplementedError(f"Slice {node.name}: dynamic operands")
+        axes = axes or list(range(len(starts)))
+        steps = steps or [1] * len(starts)
+
+        def do_slice(x, st=tuple(starts), en=tuple(ends), ax=tuple(axes),
+                     sp=tuple(steps)):
+            idx = [slice(None)] * x.ndim
+            for s, e, a, p in zip(st, en, ax, sp):
+                idx[a] = slice(s, None if e >= 2 ** 31 - 1 else e, p)
+            return x[tuple(idx)]
+        return mk(out, _Lambda(do_slice, "slice"), [as_onnx(ins[0])],
+                  "onnx")
+    if op == "Expand":
+        shape = const(1)
+        if shape is None:
+            raise NotImplementedError(f"Expand {node.name}: dynamic shape")
+        tgt = tuple(int(v) for v in np.asarray(shape).reshape(-1))
+        return mk(out, _Lambda(lambda x, t=tgt: jnp.broadcast_to(
+            x, jnp.broadcast_shapes(x.shape, t)), "expand"),
+            [as_onnx(ins[0])], "onnx")
+    if op == "Tile":
+        reps = const(1)
+        if reps is None:
+            raise NotImplementedError(f"Tile {node.name}: dynamic repeats")
+        r = tuple(int(v) for v in np.asarray(reps).reshape(-1))
+        return mk(out, _Lambda(lambda x, rr=r: jnp.tile(x, rr), "tile"),
+                  [as_onnx(ins[0])], "onnx")
+    if op == "Where":
+        if not (is_sym(0) and is_sym(1) and is_sym(2)):
+            vals = [consts.get(i) if i not in sym else None for i in ins]
+
+            def where_mixed(*xs, vals=tuple(
+                    None if v is None else jnp.asarray(v) for v in vals)):
+                it = iter(xs)
+                ops_ = [v if v is not None else next(it) for v in vals]
+                return jnp.where(*ops_)
+            parents = [as_onnx(i) for i in ins if i in sym]
+            return mk(out, _Lambda(where_mixed, "where",
+                                   n_in=len(parents)), parents, "onnx")
+        return mk(out, _Lambda(jnp.where, "where", n_in=3),
+                  [as_onnx(i) for i in ins], "onnx")
+    if op in ("ArgMax", "ArgMin"):
+        axis = node.i("axis", 0)
+        keep = bool(node.i("keepdims", 1))
+        fn = jnp.argmax if op == "ArgMax" else jnp.argmin
+        return mk(out, _Lambda(lambda x, f=fn, a=axis, k=keep:
+                               (f(x, axis=a, keepdims=k)).astype(jnp.int64),
+                               op.lower()), [as_onnx(ins[0])], "onnx")
+    if op == "Split":
+        axis = node.i("axis", 0)
+        splits = node.ints_("split")
+        if splits is None and len(ins) > 1 and const(1) is not None:
+            splits = [int(v) for v in np.asarray(const(1)).reshape(-1)]
+        parent = as_onnx(ins[0])
+        n_out = len(node.outputs)
+        if splits:
+            bounds = np.cumsum(splits)[:-1].tolist()
+        else:
+            bounds = n_out                 # equal split
+
+        def do_split(x, b=bounds, a=axis):
+            return tuple(jnp.split(x, b, axis=a))
+        split_node = _Lambda(do_split, "split")(parent)
+        lay[id(split_node)] = "onnx"
+        for i, oname in enumerate(node.outputs):
+            sel = nn.SelectTable(i)(split_node)
+            record(oname, sel, "onnx")
+        return
+    if op == "InstanceNormalization":
+        scale, beta = const(1), const(2)
+        if scale is None or beta is None:
+            raise NotImplementedError(
+                f"InstanceNormalization {node.name}: non-const scale")
+        eps = node.f("epsilon", 1e-5)
+
+        def inorm(x, s=jnp.asarray(scale), b=jnp.asarray(beta), e=eps):
+            # nhwc: normalize each channel over spatial dims per sample
+            mu = jnp.mean(x, axis=(1, 2), keepdims=True)
+            var = jnp.var(x, axis=(1, 2), keepdims=True)
+            return (x - mu) / jnp.sqrt(var + e) * s + b
+        return mk(out, _Lambda(inorm, "instance_norm"), [as_nhwc(ins[0])],
+                  "nhwc")
+    if op == "Resize":
+        sizes = const(3) if len(ins) > 3 else None
+        scales = const(2) if len(ins) > 2 else None
+        mode = node.s("mode", "nearest")
+        if sizes is None and scales is None:
+            raise NotImplementedError(f"Resize {node.name}: dynamic size")
+        method = {"nearest": "nearest", "linear": "bilinear",
+                  "cubic": "bicubic"}.get(mode)
+        if method is None:
+            raise NotImplementedError(f"Resize {node.name}: mode {mode}")
+
+        def resize(x, sz=sizes, sc=scales, m=method):
+            import jax.image
+            if sz is not None:
+                _, ch, oh, ow = (int(v) for v in np.asarray(sz).reshape(-1))
+            else:
+                f = np.asarray(sc).reshape(-1)
+                oh = int(round(x.shape[1] * float(f[2])))
+                ow = int(round(x.shape[2] * float(f[3])))
+            return jax.image.resize(x, (x.shape[0], oh, ow, x.shape[3]), m)
+        return mk(out, _Lambda(resize, "resize"), [as_nhwc(ins[0])],
+                  "nhwc")
+    if op == "HardSigmoid":
+        a, b = node.f("alpha", 0.2), node.f("beta", 0.5)
+        return mk(out, _Lambda(lambda x, aa=a, bb=b:
+                               jnp.clip(aa * x + bb, 0, 1), "hard_sigmoid"),
+                  [sym[ins[0]]], lay[id(sym[ins[0]])])
+    if op == "HardSwish":
+        return mk(out, _Lambda(lambda x: x * jnp.clip(x / 6 + 0.5, 0, 1),
+                               "hard_swish"),
+                  [sym[ins[0]]], lay[id(sym[ins[0]])])
 
     raise NotImplementedError(
         f"ONNX op {op!r} (node {node.name}) has no module loader "

@@ -266,3 +266,104 @@ def test_onnx_unsupported_op_raises():
         inputs={"x": [1, 4]}, outputs=["y"], initializers={})
     with pytest.raises(NotImplementedError, match="FancyNewOp"):
         to_module(parse_model(make_model(graph)))
+
+
+def test_onnx_array_op_tail():
+    """Round-2 op breadth: Slice/Expand/Tile/Where/Cast/Split/Reduce*."""
+    r = np.random.RandomState(10)
+    x = r.rand(2, 6).astype(np.float32)
+    graph = make_graph(
+        [
+            make_node("Slice", ["x", "st", "en", "ax"], ["sl"]),
+            make_node("Tile", ["sl", "rep"], ["tl"]),
+            make_node("ReduceL2", ["tl"], ["l2"], axes=[1], keepdims=0),
+        ],
+        inputs={"x": [2, 6]}, outputs=["l2"],
+        initializers={"st": np.asarray([1], np.int64),
+                      "en": np.asarray([5], np.int64),
+                      "ax": np.asarray([1], np.int64),
+                      "rep": np.asarray([1, 2], np.int64)})
+    got, _ = _run(make_model(graph), x)
+    want = np.linalg.norm(np.tile(x[:, 1:5], (1, 2)), axis=1)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_onnx_split_and_where():
+    r = np.random.RandomState(11)
+    x = r.randn(2, 6).astype(np.float32)
+    graph = make_graph(
+        [
+            make_node("Split", ["x"], ["a", "b"], axis=1),
+            make_node("Where", ["cnd", "a", "b"], ["y"]),
+        ],
+        inputs={"x": [2, 6], "cnd": [2, 3]}, outputs=["y"],
+        initializers={})
+    module, params, state, _ = to_module(parse_model(make_model(graph)))
+    cnd = np.asarray([[True, False, True], [False, True, False]])
+    out, _ = module.apply(params, state, jnp.asarray(x),
+                          jnp.asarray(cnd), training=False)
+    a, b = x[:, :3], x[:, 3:]
+    np.testing.assert_allclose(np.asarray(out), np.where(cnd, a, b),
+                               atol=1e-6)
+
+
+def test_onnx_instance_norm_and_resize():
+    import torch
+    r = np.random.RandomState(12)
+    x = r.randn(2, 3, 6, 6).astype(np.float32)
+    scale = (r.rand(3) + 0.5).astype(np.float32)
+    beta = (r.randn(3) * 0.1).astype(np.float32)
+    graph = make_graph(
+        [
+            make_node("InstanceNormalization", ["x", "s", "b"], ["n"],
+                      epsilon=1e-5),
+            make_node("Resize", ["n", "roi", "scales"], ["y"],
+                      mode="nearest"),
+        ],
+        inputs={"x": [2, 3, 6, 6]}, outputs=["y"],
+        initializers={"s": scale, "b": beta,
+                      "roi": np.zeros(0, np.float32),
+                      "scales": np.asarray([1, 1, 2, 2], np.float32)})
+    got, _ = _run(make_model(graph), x)
+    tn = torch.nn.functional.instance_norm(
+        torch.from_numpy(x), weight=torch.from_numpy(scale),
+        bias=torch.from_numpy(beta), eps=1e-5)
+    want = torch.nn.functional.interpolate(tn, scale_factor=2,
+                                           mode="nearest").numpy()
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_onnx_nary_and_argmax():
+    r = np.random.RandomState(13)
+    a = r.randn(3, 4).astype(np.float32)
+    b = r.randn(3, 4).astype(np.float32)
+    c = r.randn(3, 4).astype(np.float32)
+    graph = make_graph(
+        [
+            make_node("Max", ["a", "b", "c"], ["m"]),
+            make_node("ArgMax", ["m"], ["y"], axis=1, keepdims=0),
+        ],
+        inputs={"a": [3, 4], "b": [3, 4], "c": [3, 4]}, outputs=["y"],
+        initializers={})
+    module, params, state, _ = to_module(parse_model(make_model(graph)))
+    out, _ = module.apply(params, state, jnp.asarray(a), jnp.asarray(b),
+                          jnp.asarray(c), training=False)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.maximum(np.maximum(a, b), c).argmax(1))
+
+
+def test_onnx_cast_and_reduce_all_and_const_nary():
+    r = np.random.RandomState(14)
+    x = r.rand(2, 3).astype(np.float32) + 0.5
+    cap = np.full((2, 3), 0.9, np.float32)
+    graph = make_graph(
+        [
+            make_node("Min", ["x", "cap"], ["m"]),         # const operand
+            make_node("Cast", ["m"], ["ci"], to=7),        # -> int64
+            make_node("Cast", ["ci"], ["cf"], to=1),       # -> float32
+            make_node("ReduceSum", ["cf"], ["y"], keepdims=0),  # all axes
+        ],
+        inputs={"x": [2, 3]}, outputs=["y"], initializers={"cap": cap})
+    got, _ = _run(make_model(graph), x)
+    want = np.minimum(x, cap).astype(np.int64).astype(np.float32).sum()
+    np.testing.assert_allclose(got, want, atol=1e-6)
