@@ -48,23 +48,16 @@ def _peak_flops(device_kind: str):
 
 # --------------------------------------------------------------------- child
 def _time_steps(step, carry, warmup, iters):
-    """Times `carry = step(carry)` chains. Steps are DATA-DEPENDENT (each
-    consumes the previous carry) and completion is forced by fetching the
-    carry's last leaf to the host: on this image's axon TPU plugin,
-    `jax.block_until_ready` returns before execution finishes, so timing
-    un-chained dispatches measures the enqueue rate, not the chip (round-1
-    bench inflated throughput ~40x this way). A device->host transfer of a
-    value data-dependent on every step cannot lie."""
-    from bigdl_tpu.utils.sync import force_completion
+    """Plugin-safe timing (see utils/sync.py time_steps: data-dependent
+    chains + host-fetch completion; round-1's block_until_ready timing
+    inflated throughput ~40x)."""
+    from bigdl_tpu.utils.sync import time_steps
 
-    for _ in range(warmup):
-        carry = step(carry)
-    force_completion(carry)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        carry = step(carry)
-    force_completion(carry)
-    return (time.perf_counter() - t0) / iters
+    def adapt(c):
+        out = step(c)
+        return out, out                    # carry IS the observed tree
+    sec, _ = time_steps(adapt, carry, warmup, iters)
+    return sec
 
 
 def _bench_resnet50(compute_dtype=None, batch_size=None, spatial=None,

@@ -698,8 +698,12 @@ def _build(g, node, sym, consts, mk, as_nhwc, as_onnx, lay, rnk, record):
         return
     if op == "ReduceMean" or op in _REDUCES:
         axes = node.ints_("axes")
-        if axes is None and len(ins) > 1 and const(1) is not None:
-            axes = [int(v) for v in np.asarray(const(1)).reshape(-1)]
+        if axes is None and len(ins) > 1 and ins[1]:
+            c = const(1)
+            if c is None:
+                raise NotImplementedError(
+                    f"{op} {node.name}: dynamic axes input")
+            axes = [int(v) for v in np.asarray(c).reshape(-1)]
         keep = bool(node.i("keepdims", 1))
         if op == "ReduceMean":
             m = _Lambda(lambda x, k=keep: jnp.mean(x, keepdims=k),
@@ -718,8 +722,18 @@ def _build(g, node, sym, consts, mk, as_nhwc, as_onnx, lay, rnk, record):
         to = as_nhwc if "nhwc" in layouts else as_onnx
         layout = "nhwc" if "nhwc" in layouts else "onnx"
         # const operands close over their position (Graph only wires
-        # symbolic parents)
-        slots = [None if i in sym else jnp.asarray(consts[i]) for i in ins]
+        # symbolic parents); in the moved layout they need the same
+        # NCHW-broadcast translation as the binary path
+        def conv_const(c):
+            c = np.asarray(c)
+            if layout != "nhwc":
+                return jnp.asarray(c)
+            if c.ndim >= 3:
+                return jnp.asarray(_channels_last_const(c))
+            if c.ndim == 1:
+                return jnp.asarray(c)[:, None]     # logical W axis
+            return jnp.asarray(c)
+        slots = [None if i in sym else conv_const(consts[i]) for i in ins]
         parents = [to(i) for i in ins if i in sym]
         n_total = len(ins)
 

@@ -156,3 +156,80 @@ def build(class_num: int = 1000, has_dropout: bool = True) -> nn.Sequential:
         nn.Linear(1024, class_num, name="loss3_classifier"),
         nn.LogSoftMax(),
         name="InceptionV1")
+
+
+# --------------------------------------------------------------- Inception-v2
+def _conv_bn(nin, nout, k, stride=1, pad=0, name=None):
+    """conv + BN + ReLU triplet (reference: Inception_v2.scala:31-36)."""
+    return nn.Sequential(
+        nn.SpatialConvolution(nin, nout, k, k, stride, stride, pad, pad,
+                              name=f"{name}" if name else None),
+        nn.SpatialBatchNormalization(nout, eps=1e-3),
+        nn.ReLU())
+
+
+def _inception_block_v2(nin, c1, c3, d3, pool, name=None):
+    """BN-Inception mixed block (reference: Inception_v2.scala
+    Inception_Layer_v2:28-106). `c1`=0 drops the 1x1 branch; `pool` is
+    (kind, proj) where kind in {'avg','max'} and proj=0 means a stride-2
+    downsample block (3x3 branches stride 2, pool stride 2, no proj)."""
+    kind, proj = pool
+    down = kind == "max" and proj == 0
+    s2 = 2 if down else 1
+    branches = []
+    if c1:
+        branches.append(_conv_bn(nin, c1, 1, name=f"{name}_1x1"))
+    branches.append(nn.Sequential(
+        _conv_bn(nin, c3[0], 1, name=f"{name}_3x3r"),
+        _conv_bn(c3[0], c3[1], 3, s2, 1, name=f"{name}_3x3")))
+    branches.append(nn.Sequential(
+        _conv_bn(nin, d3[0], 1, name=f"{name}_d3r"),
+        _conv_bn(d3[0], d3[1], 3, 1, 1, name=f"{name}_d3a"),
+        _conv_bn(d3[1], d3[1], 3, s2, 1, name=f"{name}_d3b")))
+    if kind == "avg":
+        p = nn.Sequential(nn.SpatialAveragePooling(3, 3, 1, 1, 1, 1,
+                                                   ceil_mode=True))
+    elif proj:
+        p = nn.Sequential(nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1,
+                                               ceil_mode=True))
+    else:
+        p = nn.Sequential(nn.SpatialMaxPooling(3, 3, 2, 2,
+                                               ceil_mode=True))
+    if proj:
+        p.add(_conv_bn(nin, proj, 1, name=f"{name}_pool_proj"))
+    branches.append(p)
+    return nn.Sequential(nn.Concat(*branches, axis=-1), name=name)
+
+
+# (name, nin, c1, (c3r, c3), (d3r, d3), (pool_kind, proj)) — Inception_v2
+# NoAuxClassifier topology (Inception_v2.scala:186-228)
+_BLOCKS_V2 = [
+    ("3a", 192, 64, (64, 64), (64, 96), ("avg", 32)),
+    ("3b", 256, 64, (64, 96), (64, 96), ("avg", 64)),
+    ("3c", 320, 0, (128, 160), (64, 96), ("max", 0)),
+    ("4a", 576, 224, (64, 96), (96, 128), ("avg", 128)),
+    ("4b", 576, 192, (96, 128), (96, 128), ("avg", 128)),
+    ("4c", 576, 160, (128, 160), (128, 160), ("avg", 96)),
+    ("4d", 576, 96, (128, 192), (160, 192), ("avg", 96)),
+    ("4e", 576, 0, (128, 192), (192, 256), ("max", 0)),
+    ("5a", 1024, 352, (192, 320), (160, 224), ("avg", 128)),
+    ("5b", 1024, 352, (192, 320), (192, 224), ("max", 128)),
+]
+
+
+def build_v2(class_num: int = 1000) -> nn.Sequential:
+    """BN-Inception / Inception-v2 without aux heads (reference:
+    models/inception/Inception_v2.scala Inception_v2_NoAuxClassifier)."""
+    m = nn.Sequential(name="InceptionV2")
+    m.add(_conv_bn(3, 64, 7, 2, 3, name="conv1"))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2, ceil_mode=True))
+    m.add(_conv_bn(64, 64, 1, name="conv2r"))
+    m.add(_conv_bn(64, 192, 3, pad=1, name="conv2"))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2, ceil_mode=True))
+    for name, nin, c1, c3, d3, pool in _BLOCKS_V2:
+        m.add(_inception_block_v2(nin, c1, c3, d3, pool, name=name))
+    m.add(nn.SpatialAveragePooling(7, 7, 1, 1, ceil_mode=True))
+    m.add(nn.Flatten())
+    m.add(nn.Linear(1024, class_num))
+    m.add(nn.LogSoftMax())
+    return m

@@ -1,0 +1,107 @@
+"""Zoo perf harness CLI (reference: models/utils/DistriOptimizerPerf.scala:32
++ LocalOptimizerPerf.scala + nn/mkldnn/Perf.scala:125-126 — per-model
+train-step throughput on synthetic data).
+
+    python -m bigdl_tpu.models.perf --model resnet50 --batch-size 128
+    python -m bigdl_tpu.models.perf --model inception-v2 --dtype bf16
+
+Timing uses the plugin-safe chained-dispatch + host-fetch protocol from
+`utils/sync.py` (see bench.py)."""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import sys
+
+
+def _model(name: str, class_num: int):
+    from bigdl_tpu.models import autoencoder, inception, lenet, resnet, vgg
+    builders = {
+        "lenet": lambda: (lenet.build(10), (28, 28, 1)),
+        "resnet50": lambda: (resnet.build(50, class_num), (224, 224, 3)),
+        "resnet20-cifar": lambda: (resnet.build_cifar(20, 10), (32, 32, 3)),
+        "inception-v1": lambda: (inception.build(class_num), (224, 224, 3)),
+        "inception-v2": lambda: (inception.build_v2(class_num),
+                                 (224, 224, 3)),
+        "vgg16": lambda: (vgg.build(16, class_num), (224, 224, 3)),
+        "autoencoder": lambda: (autoencoder.build(), (28, 28, 1)),
+    }
+    if name not in builders:
+        raise SystemExit(f"unknown model {name!r}; one of {sorted(builders)}")
+    return builders[name]()
+
+
+def run(model_name: str, batch_size: int, iters: int, warmup: int,
+        dtype: str, class_num: int) -> float:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu.core.module import cast_floating
+    from bigdl_tpu.nn.criterion import ClassNLLCriterion, MSECriterion
+    from bigdl_tpu.optim.method import SGD
+    from bigdl_tpu.utils.sync import time_steps
+
+    model, spatial = _model(model_name, class_num)
+    autoenc = model_name == "autoencoder"
+    criterion = MSECriterion() if autoenc else ClassNLLCriterion()
+    method = SGD(0.1, momentum=0.9)
+    compute_dtype = {"bf16": jnp.bfloat16, "fp32": None}[dtype]
+
+    params, state = model.init(jax.random.PRNGKey(0))
+    slots = method.init_slots(params)
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.randn(batch_size, *spatial).astype(np.float32))
+    y = x.reshape(batch_size, -1) if autoenc else \
+        jnp.asarray(r.randint(0, class_num, size=batch_size)
+                    .astype(np.int32))
+    rng = jax.random.PRNGKey(7)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def step(params, slots, model_state):
+        def loss_fn(p):
+            pc = cast_floating(p, compute_dtype) if compute_dtype else p
+            xc = x.astype(compute_dtype) if compute_dtype else x
+            out, ns = model.apply(pc, model_state, xc, training=True,
+                                  rng=rng)
+            return criterion.forward(out.astype(jnp.float32), y), ns
+        (loss, ns), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        if compute_dtype:
+            grads = cast_floating(grads, jnp.float32)
+        new_p, new_s = method.update(params, grads, slots,
+                                     jnp.float32(0.1), jnp.int32(0))
+        return new_p, new_s, ns, loss
+
+    def adapt(carry):
+        out = step(*carry)
+        return out[:3], out
+    sec, _ = time_steps(adapt, (params, slots, state), warmup, iters)
+    return batch_size / sec
+
+
+def main(argv=None):
+    from bigdl_tpu.utils.platform import force_cpu_if_requested
+    force_cpu_if_requested()
+    ap = argparse.ArgumentParser(prog="bigdl_tpu.models.perf")
+    ap.add_argument("--model", default="resnet50")
+    ap.add_argument("--batch-size", type=int, default=None)
+    ap.add_argument("--iters", type=int, default=None)
+    ap.add_argument("--warmup", type=int, default=None)
+    ap.add_argument("--dtype", choices=("bf16", "fp32"), default="bf16")
+    ap.add_argument("--class-num", type=int, default=1000)
+    args = ap.parse_args(argv)
+    import jax
+    on_tpu = jax.default_backend() != "cpu"
+    bs = args.batch_size if args.batch_size is not None \
+        else (128 if on_tpu else 4)
+    iters = args.iters if args.iters is not None else (20 if on_tpu else 2)
+    warmup = args.warmup if args.warmup is not None \
+        else (3 if on_tpu else 1)
+    ips = run(args.model, bs, iters, warmup, args.dtype, args.class_num)
+    print(f"{args.model} [{args.dtype}] batch {bs}: {ips:.1f} records/sec "
+          f"({jax.default_backend()})")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -208,7 +208,8 @@ def train_inception(args):
         x = r.randn(n, 224, 224, 3).astype(np.float32)
         y = r.randint(0, 1000, n).astype(np.int32)
         ds, val, classes = ArrayDataSet(x, y, bs, drop_last=True), None, 1000
-    model = inception.build(classes)
+    v2 = getattr(args, "v2", False)
+    model = inception.build_v2(classes) if v2 else inception.build(classes)
     method = _method(args, SGD(
         0.0898, momentum=0.9, weight_decay=1e-4,
         learning_rate_schedule=Poly(0.5, 62000)))
@@ -217,7 +218,8 @@ def train_inception(args):
     if args.data and val is not None:
         opt.set_validation(Trigger.every_epoch(), val,
                            [Top1Accuracy(), Top5Accuracy()])
-    return _finish(opt, args, model, "inception-v1")
+    return _finish(opt, args, model, "inception-v2" if v2 else
+                   "inception-v1")
 
 
 def train_vgg(args):
@@ -295,8 +297,10 @@ def main(argv=None):
     _common(p)
     p.add_argument("--depth", type=int, default=20)
 
-    p = sub.add_parser("inception", help="Inception-v1 on ImageNet")
+    p = sub.add_parser("inception", help="Inception-v1/v2 on ImageNet")
     _common(p)
+    p.add_argument("--v2", action="store_true",
+                   help="BN-Inception (Inception_v2.scala)")
 
     p = sub.add_parser("vgg", help="VGG on CIFAR-10")
     _common(p)

@@ -32,6 +32,27 @@ def force_completion(tree) -> None:
         jax.device_get([_first_elem(l) for l in leaves])
 
 
+def time_steps(step, carry, warmup: int, iters: int):
+    """Time `carry, observed = step(carry)` chains with the plugin-safe
+    protocol: steps must be data-dependent through `carry`, and completion
+    is forced by a host fetch of `observed` — `block_until_ready` measures
+    only the enqueue rate on this image's TPU plugin. The single home for
+    the timing loop used by bench.py and models/perf.py.
+
+    Returns (seconds_per_step, final_carry). warmup=0 measures cold
+    (compile included) — that is the caller's explicit choice."""
+    import time as _time
+    observed = carry
+    for _ in range(warmup):
+        carry, observed = step(carry)
+    force_completion(observed)
+    t0 = _time.perf_counter()
+    for _ in range(iters):
+        carry, observed = step(carry)
+    force_completion(observed)
+    return (_time.perf_counter() - t0) / max(1, iters), carry
+
+
 def chain_dep(x, out):
     """Return `x` unchanged in value but data-dependent on EVERY array leaf
     of `out`, so the next dispatch cannot start (or be elided) before `out`

@@ -109,3 +109,15 @@ def test_resnet_train_step_decreases_loss():
         params, state, slots, loss = step(params, state, slots)
         losses.append(float(loss))
     assert losses[-1] < losses[0]
+
+
+def test_inception_v2():
+    x = jnp.asarray(np.random.RandomState(0).randn(1, 224, 224, 3),
+                    jnp.float32)
+    out = _fwd(inception.build_v2(class_num=11), x)
+    assert out.shape == (1, 11)
+    # BN-Inception has ~11.2M params at 1000 classes
+    m = inception.build_v2(1000)
+    p, _ = m.init(jax.random.PRNGKey(0))
+    n = sum(int(l.size) for l in jax.tree.leaves(p))
+    assert 10_500_000 < n < 12_000_000, n

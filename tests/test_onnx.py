@@ -367,3 +367,23 @@ def test_onnx_cast_and_reduce_all_and_const_nary():
     got, _ = _run(make_model(graph), x)
     want = np.minimum(x, cap).astype(np.int64).astype(np.float32).sum()
     np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_onnx_nary_const_channel_layout():
+    """Conv output (NHWC internally) clamped by a (1,C,1,1) const — the
+    const must get the same layout translation as binary elementwise."""
+    r = np.random.RandomState(15)
+    x = r.randn(1, 3, 4, 4).astype(np.float32)
+    w = (r.randn(3, 3, 1, 1) * 0.5).astype(np.float32)
+    cap = np.asarray([0.1, 0.2, 0.3], np.float32).reshape(1, 3, 1, 1)
+    graph = make_graph(
+        [
+            make_node("Conv", ["x", "w"], ["c"], kernel_shape=[1, 1]),
+            make_node("Min", ["c", "cap"], ["y"]),
+        ],
+        inputs={"x": [1, 3, 4, 4]}, outputs=["y"],
+        initializers={"w": w, "cap": cap})
+    got, _ = _run(make_model(graph), x)
+    conv = torch.nn.functional.conv2d(torch.from_numpy(x),
+                                      torch.from_numpy(w)).numpy()
+    np.testing.assert_allclose(got, np.minimum(conv, cap), atol=1e-5)
