@@ -71,7 +71,21 @@ from bigdl_tpu.nn.criterion import (ClassNLLCriterion, CrossEntropyCriterion,
                                     L1HingeEmbeddingCriterion,
                                     MeanAbsolutePercentageCriterion,
                                     MeanSquaredLogarithmicCriterion,
-                                    PoissonCriterion, SoftmaxWithCriterion)
+                                    PoissonCriterion, SoftmaxWithCriterion,
+                                    CategoricalCrossEntropy)
+from bigdl_tpu.nn.misc import (ActivityRegularization, BifurcateSplitTable,
+                               BinaryThreshold, Bottle, CAveTable, Cosine,
+                               ConvLSTMPeephole3D, Cropping2D, Cropping3D,
+                               CrossProduct, Euclidean, ExpandSize,
+                               GaussianSampler, GradientReversal, HardShrink,
+                               Highway, L1Penalty, LogSigmoid, MapTable,
+                               MaskedSelect, NarrowTable,
+                               NegativeEntropyPenalty, Pack, Reverse,
+                               SoftShrink, SpatialContrastiveNormalization,
+                               SpatialConvolutionMap,
+                               SpatialDivisiveNormalization,
+                               SpatialSubtractiveNormalization,
+                               SpatialWithinChannelLRN, TanhShrink, Tile)
 
 from bigdl_tpu.nn import detection, ops, quantized, sparse
 from bigdl_tpu.nn.detection import (Anchor, DetectionOutputSSD, FPN, Nms,

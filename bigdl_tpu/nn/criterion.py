@@ -581,3 +581,18 @@ class SoftmaxWithCriterion(Criterion):
         if self.normalize_mode == "batch_size":
             return total / picked.shape[0]
         return total
+
+
+class CategoricalCrossEntropy(Criterion):
+    """keras categorical cross-entropy: one-hot targets, probability input
+    renormalized per row then clipped, exactly the keras/reference order
+    (nn/CategoricalCrossEntropy.scala) — the renormalization also changes
+    the gradient (-t/p + sum(t)/sum(p)), so it matters for training parity,
+    not just the forward value."""
+
+    eps = 1e-7
+
+    def forward(self, input, target):
+        p = input / jnp.sum(input, axis=-1, keepdims=True)
+        p = jnp.clip(p, self.eps, 1.0 - self.eps)
+        return -jnp.mean(jnp.sum(target * jnp.log(p), axis=-1))

@@ -1,0 +1,594 @@
+"""Layer tail — the remaining small reference layers (reference files cited
+per class; this file closes the nn/*.scala name gap that round-2's audit
+surfaced). All NHWC / channels-last where spatial.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bigdl_tpu.core import init as initializers
+from bigdl_tpu.core.module import Module, ParamSpec
+
+
+# ------------------------------------------------------------- elementwise
+class BinaryThreshold(Module):
+    """x > th → 1 else 0 (reference: nn/BinaryThreshold.scala)."""
+
+    def __init__(self, th: float = 1e-6, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.th = th
+
+    def forward(self, params, x, **_):
+        return (x > self.th).astype(x.dtype)
+
+
+class HardShrink(Module):
+    """(reference: nn/HardShrink.scala)."""
+
+    def __init__(self, lambda_: float = 0.5, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.l = lambda_
+
+    def forward(self, params, x, **_):
+        return jnp.where(jnp.abs(x) > self.l, x, 0.0)
+
+
+class SoftShrink(Module):
+    """(reference: nn/SoftShrink.scala)."""
+
+    def __init__(self, lambda_: float = 0.5, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.l = lambda_
+
+    def forward(self, params, x, **_):
+        return jnp.sign(x) * jnp.maximum(jnp.abs(x) - self.l, 0.0)
+
+
+class TanhShrink(Module):
+    """x - tanh(x) (reference: nn/TanhShrink.scala)."""
+
+    def forward(self, params, x, **_):
+        return x - jnp.tanh(x)
+
+
+class LogSigmoid(Module):
+    """(reference: nn/LogSigmoid.scala)."""
+
+    def forward(self, params, x, **_):
+        return jax.nn.log_sigmoid(x)
+
+
+class GradientReversal(Module):
+    """Identity forward, -λ·grad backward (reference:
+    nn/GradientReversal.scala — domain-adversarial training)."""
+
+    def __init__(self, lambda_: float = 1.0, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.l = lambda_
+
+        @jax.custom_vjp
+        def rev(x):
+            return x
+
+        def fwd(x):
+            return x, None
+
+        def bwd(_, g):
+            return (-self.l * g,)
+        rev.defvjp(fwd, bwd)
+        self._rev = rev
+
+    def forward(self, params, x, **_):
+        return self._rev(x)
+
+
+# ---------------------------------------------------- penalties/regularizers
+class _Penalty(Module):
+    """Identity whose penalty is exposed in state['aux'] — with autodiff the
+    caller adds it to the loss (the reference injects it via backward)."""
+
+    def _penalty(self, x):
+        raise NotImplementedError
+
+    def _apply(self, params, state, x, *, training=False, rng=None):
+        return x, {**state, "aux": {"penalty": self._penalty(x)}}
+
+
+class L1Penalty(_Penalty):
+    """(reference: nn/L1Penalty.scala)."""
+
+    def __init__(self, l1weight: float = 1.0, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.w = l1weight
+
+    def _penalty(self, x):
+        return self.w * jnp.sum(jnp.abs(x))
+
+
+class ActivityRegularization(_Penalty):
+    """(reference: nn/ActivityRegularization.scala — keras l1/l2)."""
+
+    def __init__(self, l1: float = 0.0, l2: float = 0.0,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.l1, self.l2 = l1, l2
+
+    def _penalty(self, x):
+        return self.l1 * jnp.sum(jnp.abs(x)) + self.l2 * jnp.sum(x * x)
+
+
+class NegativeEntropyPenalty(_Penalty):
+    """(reference: nn/NegativeEntropyPenalty.scala — input is a prob
+    distribution over the last axis)."""
+
+    def __init__(self, beta: float = 0.01, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.beta = beta
+
+    def _penalty(self, x):
+        return self.beta * jnp.sum(x * jnp.log(jnp.clip(x, 1e-12, None)))
+
+
+# ------------------------------------------------------------ shape/table
+class Reverse(Module):
+    """Flip along a dimension (reference: nn/Reverse.scala)."""
+
+    def __init__(self, dimension: int = 0, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.dim = dimension
+
+    def forward(self, params, x, **_):
+        return jnp.flip(x, axis=self.dim)
+
+
+class Tile(Module):
+    """Repeat along a dim (reference: nn/Tile.scala)."""
+
+    def __init__(self, dim: int, copies: int, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.dim, self.copies = dim, copies
+
+    def forward(self, params, x, **_):
+        reps = [1] * x.ndim
+        reps[self.dim] = self.copies
+        return jnp.tile(x, reps)
+
+
+class ExpandSize(Module):
+    """Broadcast to target sizes, -1 keeps (reference: nn/ExpandSize.scala)."""
+
+    def __init__(self, sizes: Sequence[int], name: Optional[str] = None):
+        super().__init__(name=name)
+        self.sizes = tuple(sizes)
+
+    def forward(self, params, x, **_):
+        tgt = tuple(x.shape[i] if s == -1 else s
+                    for i, s in enumerate(self.sizes))
+        return jnp.broadcast_to(x, tgt)
+
+
+class Pack(Module):
+    """Stack a table of tensors along a new dim (reference: nn/Pack.scala)."""
+
+    def __init__(self, dim: int = 0, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.dim = dim
+
+    def forward(self, params, *xs, **_):
+        if len(xs) == 1 and isinstance(xs[0], (tuple, list)):
+            xs = tuple(xs[0])
+        return jnp.stack(xs, axis=self.dim)
+
+
+class NarrowTable(Module):
+    """Slice a table (reference: nn/NarrowTable.scala)."""
+
+    def __init__(self, offset: int, length: int = 1,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.offset, self.length = offset, length
+
+    def forward(self, params, *xs, **_):
+        if len(xs) == 1 and isinstance(xs[0], (tuple, list)):
+            xs = tuple(xs[0])
+        out = xs[self.offset:self.offset + self.length]
+        return out[0] if self.length == 1 else out
+
+
+class BifurcateSplitTable(Module):
+    """Split a tensor into a 2-element table along dim (reference:
+    nn/BifurcateSplitTable.scala)."""
+
+    def __init__(self, dimension: int, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.dim = dimension
+
+    def forward(self, params, x, **_):
+        h = x.shape[self.dim] // 2
+        a = lax.slice_in_dim(x, 0, h, axis=self.dim)
+        b = lax.slice_in_dim(x, h, x.shape[self.dim], axis=self.dim)
+        return a, b
+
+
+class CAveTable(Module):
+    """Elementwise average of a table (reference: nn/CAveTable.scala)."""
+
+    def forward(self, params, *xs, **_):
+        if len(xs) == 1 and isinstance(xs[0], (tuple, list)):
+            xs = tuple(xs[0])
+        return sum(xs[1:], xs[0]) / len(xs)
+
+
+class CrossProduct(Module):
+    """Pairwise dot products of table entries (reference:
+    nn/CrossProduct.scala — factorization-machine style)."""
+
+    def forward(self, params, *xs, **_):
+        if len(xs) == 1 and isinstance(xs[0], (tuple, list)):
+            xs = tuple(xs[0])
+        outs = []
+        for i in range(len(xs)):
+            for j in range(i + 1, len(xs)):
+                outs.append(jnp.sum(xs[i] * xs[j], axis=-1, keepdims=True))
+        return jnp.concatenate(outs, axis=-1)
+
+
+class MaskedSelect(Module):
+    """Select by boolean mask into a fixed-width padded vector (reference:
+    nn/MaskedSelect.scala returns a dynamic-length vector; XLA needs static
+    shapes, so the output is (max_out,) zero-padded with the count
+    returned alongside)."""
+
+    def __init__(self, max_out: int, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.max_out = max_out
+
+    def forward(self, params, x, mask=None, **_):
+        if mask is None:
+            x, mask = x
+        flat = x.reshape(-1)
+        m = mask.reshape(-1).astype(bool)
+        idx = jnp.nonzero(m, size=self.max_out, fill_value=flat.shape[0])[0]
+        padded = jnp.concatenate([flat, jnp.zeros((1,), flat.dtype)])
+        return padded[idx], jnp.sum(m)
+
+
+class Bottle(Module):
+    """Flatten leading dims, apply child, restore (reference:
+    nn/Bottle.scala)."""
+
+    def __init__(self, module: Module, n_input_dim: int = 2,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.child = self.add_child("0", module)
+        self.n = n_input_dim
+
+    def _apply(self, params, state, x, *, training=False, rng=None):
+        lead = x.shape[:-(self.n - 1)] if self.n > 1 else x.shape
+        flat = x.reshape((-1,) + x.shape[x.ndim - (self.n - 1):]) \
+            if self.n > 1 else x.reshape(-1)
+        out, ns = self.child.apply(params["0"], state["0"], flat,
+                                   training=training, rng=rng)
+        return out.reshape(lead + out.shape[1:]), {**state, "0": ns}
+
+
+class MapTable(Module):
+    """Apply the same module (shared params) to every table element
+    (reference: nn/MapTable.scala)."""
+
+    def __init__(self, module: Module, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.child = self.add_child("0", module)
+
+    def _apply(self, params, state, *xs, training=False, rng=None):
+        if len(xs) == 1 and isinstance(xs[0], (tuple, list)):
+            xs = tuple(xs[0])
+        outs = []
+        ns = state["0"]
+        for x in xs:
+            o, ns = self.child.apply(params["0"], ns, x,
+                                     training=training, rng=rng)
+            outs.append(o)
+        return tuple(outs), {**state, "0": ns}
+
+
+# ----------------------------------------------------------- prototype layers
+class Cosine(Module):
+    """Cosine similarity to weight rows (reference: nn/Cosine.scala)."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.nin, self.nout = input_size, output_size
+
+    def param_specs(self):
+        return {"weight": ParamSpec((self.nout, self.nin),
+                                    initializers.xavier, fan_in=self.nin)}
+
+    def forward(self, params, x, **_):
+        w = params["weight"]
+        xn = x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True),
+                             1e-12)
+        wn = w / jnp.maximum(jnp.linalg.norm(w, axis=-1, keepdims=True),
+                             1e-12)
+        return xn @ wn.T
+
+
+class Euclidean(Module):
+    """Euclidean distance to weight rows (reference: nn/Euclidean.scala)."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.nin, self.nout = input_size, output_size
+
+    def param_specs(self):
+        return {"weight": ParamSpec((self.nout, self.nin),
+                                    initializers.xavier, fan_in=self.nin)}
+
+    def forward(self, params, x, **_):
+        w = params["weight"]
+        d2 = jnp.sum((x[..., None, :] - w) ** 2, axis=-1)
+        return jnp.sqrt(jnp.maximum(d2, 1e-12))
+
+
+class Highway(Module):
+    """y = T(x)·H(x) + (1-T(x))·x (reference: nn/Highway.scala)."""
+
+    def __init__(self, size: int, activation=jnp.tanh,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.size = size
+        self.act = activation
+
+    def param_specs(self):
+        s = self.size
+        return {
+            "w_h": ParamSpec((s, s), initializers.xavier, fan_in=s),
+            "b_h": ParamSpec((s,), initializers.zeros),
+            "w_t": ParamSpec((s, s), initializers.xavier, fan_in=s),
+            # gate bias < 0 biases toward carry early in training
+            "b_t": ParamSpec((s,), initializers.const(-1.0)),
+        }
+
+    def forward(self, params, x, **_):
+        h = self.act(x @ params["w_h"] + params["b_h"])
+        t = jax.nn.sigmoid(x @ params["w_t"] + params["b_t"])
+        return t * h + (1.0 - t) * x
+
+
+class GaussianSampler(Module):
+    """VAE reparameterization: sample N(mu, exp(log_var)) (reference:
+    nn/GaussianSampler.scala). Input: (mu, log_var); needs rng when
+    training."""
+
+    def _apply(self, params, state, x, *, training=False, rng=None):
+        mu, log_var = x
+        if rng is None:
+            return mu, state                       # eval: mean
+        eps = jax.random.normal(rng, mu.shape, mu.dtype)
+        return mu + jnp.exp(0.5 * log_var) * eps, state
+
+
+# ------------------------------------------------------ spatial local norm
+def _gaussian_kernel(size: int, sigma: float = 1.0) -> np.ndarray:
+    ax = np.arange(size) - (size - 1) / 2.0
+    k = np.exp(-(ax ** 2) / (2 * sigma ** 2))
+    k2 = np.outer(k, k)
+    return (k2 / k2.sum()).astype(np.float32)
+
+
+class SpatialSubtractiveNormalization(Module):
+    """Subtract the local (gaussian-weighted, cross-channel) mean
+    (reference: nn/SpatialSubtractiveNormalization.scala). NHWC."""
+
+    def __init__(self, n_input_plane: int = 1, kernel: Optional[np.ndarray]
+                 = None, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.nin = n_input_plane
+        k = np.asarray(kernel, np.float32) if kernel is not None \
+            else _gaussian_kernel(9)
+        self.kernel = k / (k.sum() * n_input_plane)
+
+    def _local_mean(self, x):
+        kh, kw = self.kernel.shape
+        w = jnp.asarray(self.kernel)[:, :, None, None]
+        w = jnp.tile(w, (1, 1, self.nin, 1))       # sum over channels
+        mean = lax.conv_general_dilated(
+            x, w, (1, 1), [(kh // 2, (kh - 1) // 2),
+                           (kw // 2, (kw - 1) // 2)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        # normalize by the actually-covered kernel mass near borders
+        ones = jnp.ones_like(x[..., :1])
+        coef = lax.conv_general_dilated(
+            ones, jnp.asarray(self.kernel)[:, :, None, None] * self.nin,
+            (1, 1), [(kh // 2, (kh - 1) // 2), (kw // 2, (kw - 1) // 2)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return mean / jnp.maximum(coef, 1e-12)
+
+    def forward(self, params, x, **_):
+        return x - self._local_mean(x)
+
+
+class SpatialDivisiveNormalization(SpatialSubtractiveNormalization):
+    """Divide by the local std-dev estimate (reference:
+    nn/SpatialDivisiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 threshold: float = 1e-4, thresval: float = 1e-4,
+                 name: Optional[str] = None):
+        super().__init__(n_input_plane, kernel, name=name)
+        self.threshold, self.thresval = threshold, thresval
+
+    def forward(self, params, x, **_):
+        local_std = jnp.sqrt(jnp.maximum(self._local_mean(x * x), 0.0))
+        mean_std = jnp.mean(local_std, axis=(1, 2, 3), keepdims=True)
+        denom = jnp.maximum(local_std, mean_std)
+        denom = jnp.where(denom < self.threshold, self.thresval, denom)
+        return x / denom
+
+
+class SpatialContrastiveNormalization(Module):
+    """Subtractive then divisive local norm (reference:
+    nn/SpatialContrastiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 threshold: float = 1e-4, thresval: float = 1e-4,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.sub = self.add_child(
+            "sub", SpatialSubtractiveNormalization(n_input_plane, kernel))
+        self.div = self.add_child(
+            "div", SpatialDivisiveNormalization(n_input_plane, kernel,
+                                                threshold, thresval))
+
+    def _apply(self, params, state, x, *, training=False, rng=None):
+        y, _ = self.sub.apply(params["sub"], state["sub"], x)
+        z, _ = self.div.apply(params["div"], state["div"], y)
+        return z, state
+
+
+class SpatialWithinChannelLRN(Module):
+    """LRN over a spatial window within each channel (reference:
+    nn/SpatialWithinChannelLRN.scala). NHWC."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0,
+                 beta: float = 0.75, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.size, self.alpha, self.beta = size, alpha, beta
+
+    def forward(self, params, x, **_):
+        k = self.size
+        win = (1, k, k, 1)
+        pad = [(0, 0), (k // 2, (k - 1) // 2), (k // 2, (k - 1) // 2),
+               (0, 0)]
+        sq_sum = lax.reduce_window(x * x, 0.0, lax.add, win, (1, 1, 1, 1),
+                                   pad)
+        denom = (1.0 + self.alpha / (k * k) * sq_sum) ** self.beta
+        return x / denom
+
+
+class ConvLSTMPeephole3D(Module):
+    """3-D convolutional LSTM cell over (B, D, H, W, C) volumes
+    (reference: nn/ConvLSTMPeephole3D.scala). Packed conv gates; use with
+    `nn.Recurrent` via step()."""
+
+    def __init__(self, input_channels: int, hidden_channels: int,
+                 kernel: int, spatial: Tuple[int, int, int],
+                 peephole: bool = True, name=None):
+        super().__init__(name)
+        self.cin, self.ch = input_channels, hidden_channels
+        self.k = kernel
+        self.spatial = tuple(spatial)
+        self.peephole = peephole
+
+    def param_specs(self):
+        k, ci, ch = self.k, self.cin, self.ch
+        specs = {
+            "w_i": ParamSpec((k, k, k, ci, 4 * ch), initializers.xavier,
+                             fan_in=k * k * k * ci),
+            "w_h": ParamSpec((k, k, k, ch, 4 * ch), initializers.xavier,
+                             fan_in=k * k * k * ch),
+            "bias": ParamSpec((4 * ch,), initializers.zeros),
+        }
+        if self.peephole:
+            for g in ("peep_i", "peep_f", "peep_o"):
+                specs[g] = ParamSpec((self.ch,), initializers.zeros)
+        return specs
+
+    def init_hidden(self, batch, dtype=jnp.float32):
+        d, h, w = self.spatial
+        z = jnp.zeros((batch, d, h, w, self.ch), dtype)
+        return (z, z)
+
+    def _conv(self, x, w):
+        p = self.k // 2
+        return lax.conv_general_dilated(
+            x, w, (1, 1, 1), [(p, p)] * 3,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+
+    def step(self, params, hidden, x):
+        h_prev, c_prev = hidden
+        gates = self._conv(x, params["w_i"]) + \
+            self._conv(h_prev, params["w_h"]) + params["bias"]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        if self.peephole:
+            i = i + params["peep_i"] * c_prev
+            f = f + params["peep_f"] * c_prev
+        i, f = jax.nn.sigmoid(i), jax.nn.sigmoid(f)
+        c = f * c_prev + i * jnp.tanh(g)
+        if self.peephole:
+            o = o + params["peep_o"] * c
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return h, (h, c)
+
+
+class Cropping2D(Module):
+    """Crop rows/cols NHWC (reference: nn/Cropping2D.scala)."""
+
+    def __init__(self, height_crop: Sequence[int] = (0, 0),
+                 width_crop: Sequence[int] = (0, 0),
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.hc, self.wc = tuple(height_crop), tuple(width_crop)
+
+    def forward(self, params, x, **_):
+        h, w = x.shape[1], x.shape[2]
+        return x[:, self.hc[0]:h - self.hc[1],
+                 self.wc[0]:w - self.wc[1], :]
+
+
+class Cropping3D(Module):
+    """Crop NDHWC (reference: nn/Cropping3D.scala)."""
+
+    def __init__(self, dim1_crop=(0, 0), dim2_crop=(0, 0), dim3_crop=(0, 0),
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.c = (tuple(dim1_crop), tuple(dim2_crop), tuple(dim3_crop))
+
+    def forward(self, params, x, **_):
+        d, h, w = x.shape[1], x.shape[2], x.shape[3]
+        (d0, d1), (h0, h1), (w0, w1) = self.c
+        return x[:, d0:d - d1, h0:h - h1, w0:w - w1, :]
+
+
+class SpatialConvolutionMap(Module):
+    """Conv with an explicit input→output connection table (reference:
+    nn/SpatialConvolutionMap.scala). conn_table rows are (in_plane,
+    out_plane), 0-based; realized as a dense conv with a fixed sparsity
+    mask — XLA folds the mask into the kernel."""
+
+    def __init__(self, conn_table: Sequence[Tuple[int, int]],
+                 kernel_w: int, kernel_h: int, stride_w: int = 1,
+                 stride_h: int = 1, pad_w: int = 0, pad_h: int = 0,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        tbl = np.asarray(conn_table, np.int32)
+        self.nin = int(tbl[:, 0].max()) + 1
+        self.nout = int(tbl[:, 1].max()) + 1
+        mask = np.zeros((self.nin, self.nout), np.float32)
+        mask[tbl[:, 0], tbl[:, 1]] = 1.0
+        self.mask = mask
+        self.kw, self.kh = kernel_w, kernel_h
+        self.sw, self.sh = stride_w, stride_h
+        self.pw, self.ph = pad_w, pad_h
+
+    def param_specs(self):
+        fan_in = self.kh * self.kw * self.nin
+        return {"weight": ParamSpec((self.kh, self.kw, self.nin, self.nout),
+                                    initializers.kaiming, fan_in=fan_in),
+                "bias": ParamSpec((self.nout,), initializers.zeros)}
+
+    def forward(self, params, x, **_):
+        w = params["weight"] * jnp.asarray(self.mask)
+        y = lax.conv_general_dilated(
+            x, w, (self.sh, self.sw), [(self.ph, self.ph),
+                                       (self.pw, self.pw)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return y + params["bias"]
