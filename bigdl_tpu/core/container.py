@@ -149,6 +149,18 @@ class Graph(Module):
                 self.add_child(str(i), node.module)
         self._node_key = {id(n): str(i) for i, n in enumerate(self._order)}
 
+    # `_node_key` is keyed by object identity, which does not survive
+    # pickling — rebuild it from `_order` (whose node objects ARE the ones
+    # referenced by input/output/parent links, preserved by pickle's memo)
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        d.pop("_node_key", None)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._node_key = {id(n): str(i) for i, n in enumerate(self._order)}
+
     def _topo_sort(self) -> List[Node]:
         seen, order = set(), []
 

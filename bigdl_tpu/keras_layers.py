@@ -26,18 +26,64 @@ compose with the trainer, quantization, serializer, and mesh optimizers.
 
 from __future__ import annotations
 
+import itertools
+
 import jax
 
 from bigdl_tpu.keras import KerasModel
 
+_name_counter = itertools.count()
 
-def _cfg(class_name: str, input_shape=None, name=None, **kw) -> dict:
+
+class Layer(dict):
+    """A layer config. Usable two ways, like keras:
+
+      * appended to `Sequential` (it IS the config dict), or
+      * called on symbolic tensors for the functional API:
+        ``h = Dense(64, activation="relu")(x)`` (reference:
+        nn/keras/KerasLayer.scala `inputs(...)` wiring).
+    """
+
+    def __call__(self, *inputs: "KTensor") -> "KTensor":
+        if getattr(self, "_invoked", False):
+            raise NotImplementedError(
+                f"layer {self['config'].get('name')!r} called twice — "
+                f"weight sharing across call sites is not supported")
+        self._invoked = True
+        self["config"].setdefault(
+            "name",
+            f"{self['class_name'].lower()}_{next(_name_counter)}")
+        return KTensor(self, inputs)
+
+
+class KTensor:
+    """Symbolic output of a layer call (functional API handle)."""
+
+    def __init__(self, layer: Layer, inputs):
+        self.layer = layer
+        self.inputs = tuple(inputs)
+
+    @property
+    def name(self) -> str:
+        return self.layer["config"]["name"]
+
+
+def Input(shape, name=None) -> KTensor:
+    """Functional-API entry point (reference: nn/keras/Input.scala)."""
+    cfg = Layer({"class_name": "InputLayer",
+                 "config": {"batch_input_shape": [None] + list(shape)}})
+    if name is not None:
+        cfg["config"]["name"] = name
+    return cfg()
+
+
+def _cfg(class_name: str, input_shape=None, name=None, **kw) -> Layer:
     cfg = {k: v for k, v in kw.items() if v is not None}
     if input_shape is not None:
         cfg["batch_input_shape"] = [None] + list(input_shape)
     if name is not None:
         cfg["name"] = name
-    return {"class_name": class_name, "config": cfg}
+    return Layer({"class_name": class_name, "config": cfg})
 
 
 def _pair(v):
@@ -220,6 +266,35 @@ def TimeDistributed(layer, input_shape=None, name=None):
     return _cfg("TimeDistributed", input_shape, name, layer=layer)
 
 
+# ----------------------------------------------------------------- merges
+def Concatenate(axis=-1, name=None):
+    return _cfg("Concatenate", None, name, axis=axis)
+
+
+def Add(name=None):
+    return _cfg("Add", None, name)
+
+
+def Multiply(name=None):
+    return _cfg("Multiply", None, name)
+
+
+def Average(name=None):
+    return _cfg("Average", None, name)
+
+
+def Subtract(name=None):
+    return _cfg("Subtract", None, name)
+
+
+def Maximum(name=None):
+    return _cfg("Maximum", None, name)
+
+
+def Minimum(name=None):
+    return _cfg("Minimum", None, name)
+
+
 # ------------------------------------------------------------ activations
 def LeakyReLU(alpha=0.3, input_shape=None, name=None):
     return _cfg("LeakyReLU", input_shape, name, alpha=alpha)
@@ -342,3 +417,90 @@ class Sequential(KerasModel):
             idx += 1
         lines.append(f"total params: {total}")
         return "\n".join(lines)
+
+
+class Model(KerasModel):
+    """Functional model over symbolic tensors (reference:
+    nn/keras/Model.scala / Topology.scala):
+
+        x = kl.Input((8,))
+        a = kl.Dense(16, activation="relu")(x)
+        b = kl.Dense(16, activation="tanh")(x)
+        y = kl.Dense(2)(kl.Concatenate()(a, b))
+        model = kl.Model(x, y)
+
+    Built lazily through the importer's functional builder, so every dim
+    is inferred."""
+
+    def __init__(self, inputs, outputs, name: str = "model"):
+        super().__init__(module=None)
+        self._inputs = inputs if isinstance(inputs, (list, tuple)) \
+            else [inputs]
+        self._outputs = outputs if isinstance(outputs, (list, tuple)) \
+            else [outputs]
+        self._name = name
+        self._built = False
+
+    def _config(self) -> dict:
+        layers, seen = [], set()
+
+        def visit(t: KTensor):
+            if id(t) in seen:
+                return
+            seen.add(id(t))
+            for p in t.inputs:
+                visit(p)
+            layers.append({
+                "name": t.name,
+                "class_name": t.layer["class_name"],
+                "config": dict(t.layer["config"]),
+                "inbound_nodes":
+                    [[[p.name, 0, 0, {}] for p in t.inputs]]
+                    if t.inputs else [],
+            })
+        for o in self._outputs:
+            visit(o)
+        for i in self._inputs:
+            if id(i) not in seen:
+                raise ValueError(f"input {i.name!r} is not connected to "
+                                 f"any output")
+        return {"class_name": "Model", "config": {
+            "name": self._name,
+            "layers": layers,
+            "input_layers": [[i.name, 0, 0] for i in self._inputs],
+            "output_layers": [[o.name, 0, 0] for o in self._outputs],
+        }}
+
+    def build(self, rng=None) -> "Model":
+        if not self._built:
+            from bigdl_tpu.interop.keras_loader import _build_from_config
+            loaded = _build_from_config(self._config())
+            self.module = loaded.module
+            self.params, self.model_state = loaded.init(rng)
+            self._built = True
+        return self
+
+    def compile(self, *a, **kw):
+        self.build()
+        return super().compile(*a, **kw)
+
+    def fit(self, *a, **kw):
+        self.build()
+        return super().fit(*a, **kw)
+
+    def evaluate(self, *a, **kw):
+        self.build()
+        return super().evaluate(*a, **kw)
+
+    def predict(self, *a, **kw):
+        self.build()
+        return super().predict(*a, **kw)
+
+    def save(self, path: str):
+        self.build()
+        return super().save(path)
+
+
+# Model.load cannot reconstruct the symbolic graph; return a plain
+# KerasModel (module tree + weights round-trip, like Sequential.load)
+Model.load = classmethod(lambda cls, path: KerasModel.load(path))

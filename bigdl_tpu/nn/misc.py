@@ -16,6 +16,13 @@ from bigdl_tpu.core import init as initializers
 from bigdl_tpu.core.module import Module, ParamSpec
 
 
+def _as_table(xs):
+    """Unwrap the single-tuple calling convention for table layers."""
+    if len(xs) == 1 and isinstance(xs[0], (tuple, list)):
+        return tuple(xs[0])
+    return xs
+
+
 # ------------------------------------------------------------- elementwise
 class BinaryThreshold(Module):
     """x > th → 1 else 0 (reference: nn/BinaryThreshold.scala)."""
@@ -181,8 +188,7 @@ class Pack(Module):
         self.dim = dim
 
     def forward(self, params, *xs, **_):
-        if len(xs) == 1 and isinstance(xs[0], (tuple, list)):
-            xs = tuple(xs[0])
+        xs = _as_table(xs)
         return jnp.stack(xs, axis=self.dim)
 
 
@@ -195,8 +201,7 @@ class NarrowTable(Module):
         self.offset, self.length = offset, length
 
     def forward(self, params, *xs, **_):
-        if len(xs) == 1 and isinstance(xs[0], (tuple, list)):
-            xs = tuple(xs[0])
+        xs = _as_table(xs)
         out = xs[self.offset:self.offset + self.length]
         return out[0] if self.length == 1 else out
 
@@ -220,8 +225,7 @@ class CAveTable(Module):
     """Elementwise average of a table (reference: nn/CAveTable.scala)."""
 
     def forward(self, params, *xs, **_):
-        if len(xs) == 1 and isinstance(xs[0], (tuple, list)):
-            xs = tuple(xs[0])
+        xs = _as_table(xs)
         return sum(xs[1:], xs[0]) / len(xs)
 
 
@@ -230,8 +234,7 @@ class CrossProduct(Module):
     nn/CrossProduct.scala — factorization-machine style)."""
 
     def forward(self, params, *xs, **_):
-        if len(xs) == 1 and isinstance(xs[0], (tuple, list)):
-            xs = tuple(xs[0])
+        xs = _as_table(xs)
         outs = []
         for i in range(len(xs)):
             for j in range(i + 1, len(xs)):
@@ -256,7 +259,9 @@ class MaskedSelect(Module):
         m = mask.reshape(-1).astype(bool)
         idx = jnp.nonzero(m, size=self.max_out, fill_value=flat.shape[0])[0]
         padded = jnp.concatenate([flat, jnp.zeros((1,), flat.dtype)])
-        return padded[idx], jnp.sum(m)
+        # count is clamped to what the buffer actually holds so the
+        # (values, count) pair stays consistent under truncation
+        return padded[idx], jnp.minimum(jnp.sum(m), self.max_out)
 
 
 class Bottle(Module):
@@ -287,8 +292,7 @@ class MapTable(Module):
         self.child = self.add_child("0", module)
 
     def _apply(self, params, state, *xs, training=False, rng=None):
-        if len(xs) == 1 and isinstance(xs[0], (tuple, list)):
-            xs = tuple(xs[0])
+        xs = _as_table(xs)
         outs = []
         ns = state["0"]
         for x in xs:
@@ -370,8 +374,11 @@ class GaussianSampler(Module):
 
     def _apply(self, params, state, x, *, training=False, rng=None):
         mu, log_var = x
-        if rng is None:
+        if not training:
             return mu, state                       # eval: mean
+        if rng is None:
+            raise ValueError("GaussianSampler needs rng when training "
+                             "(same contract as Dropout)")
         eps = jax.random.normal(rng, mu.shape, mu.dtype)
         return mu + jnp.exp(0.5 * log_var) * eps, state
 
@@ -580,7 +587,9 @@ class SpatialConvolutionMap(Module):
         self.pw, self.ph = pad_w, pad_h
 
     def param_specs(self):
-        fan_in = self.kh * self.kw * self.nin
+        # fan-in reflects the connection table, not the dense kernel —
+        # a sparse table with dense fan-in would under-scale the init
+        fan_in = self.kh * self.kw * int(self.mask.sum(0).max())
         return {"weight": ParamSpec((self.kh, self.kw, self.nin, self.nout),
                                     initializers.kaiming, fan_in=fan_in),
                 "bias": ParamSpec((self.nout,), initializers.zeros)}

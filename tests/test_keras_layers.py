@@ -111,3 +111,60 @@ def test_save_load_and_onehot_metrics(tmp_path):
     m2.fit(X, Y, batch_size=32, nb_epoch=60)
     res = m2.evaluate(X, Y, batch_size=32)
     assert res["Top1Accuracy"].result > 0.9
+
+
+def test_functional_api_branches():
+    x = kl.Input((8,), name="in")
+    a = kl.Dense(16, activation="relu")(x)
+    b = kl.Dense(16, activation="tanh")(x)
+    merged = kl.Concatenate()(a, b)
+    y = kl.Dense(2)(merged)
+    model = kl.Model(x, y)
+    model.build()
+    xv = np.random.RandomState(5).randn(4, 8).astype(np.float32)
+    out = model.predict(xv, batch_size=4)
+    assert out.shape == (4, 2)
+    # dims were inferred: concat gives 32 -> Dense(2) weight (32, 2)
+    leaves = {tuple(l.shape) for l in jax.tree.leaves(model.params)}
+    assert (32, 2) in leaves
+
+    r = np.random.RandomState(6)
+    X0 = r.randn(400, 8).astype(np.float32)
+    X = X0[np.abs(X0.sum(1)) > 0.7][:64]
+    Y = (X.sum(1) > 0).astype(np.int64)
+    model.compile("adam", "sparse_categorical_crossentropy", ["acc"])
+    model.fit(X, Y, batch_size=32, nb_epoch=60)
+    res = model.evaluate(X, Y, batch_size=32)
+    assert res["Top1Accuracy"].result > 0.9
+
+
+def test_functional_residual_add():
+    x = kl.Input((6,))
+    h = kl.Dense(6, activation="relu")(x)
+    y = kl.Add()(h, x)                     # residual merge
+    model = kl.Model(x, y)
+    model.build()
+    xv = np.random.RandomState(7).randn(3, 6).astype(np.float32)
+    out = model.predict(xv, batch_size=3)
+    assert out.shape == (3, 6)
+
+
+def test_functional_reuse_raises():
+    d = kl.Dense(4)
+    x = kl.Input((4,))
+    d(x)
+    import pytest
+    with pytest.raises(NotImplementedError, match="twice"):
+        d(x)
+
+
+def test_functional_model_save_load(tmp_path):
+    x = kl.Input((4,))
+    y = kl.Dense(2)(x)
+    m = kl.Model(x, y)
+    p = str(tmp_path / "m.bigdl-tpu")
+    m.save(p)                              # exercises Graph pickling
+    lm = kl.Model.load(p)
+    xv = np.random.RandomState(8).randn(2, 4).astype(np.float32)
+    np.testing.assert_allclose(lm.predict(xv, batch_size=2),
+                               m.predict(xv, batch_size=2), atol=1e-6)

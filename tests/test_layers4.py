@@ -145,12 +145,24 @@ def test_gaussian_sampler():
     p, s = m.init(jax.random.PRNGKey(0))
     mu = jnp.zeros((2000, 2))
     log_var = jnp.zeros((2000, 2))
-    out, _ = m.apply(p, s, (mu, log_var), rng=jax.random.PRNGKey(1))
+    out, _ = m.apply(p, s, (mu, log_var), training=True,
+                     rng=jax.random.PRNGKey(1))
     assert abs(float(out.mean())) < 0.1
     assert abs(float(out.std()) - 1.0) < 0.1
-    # eval (no rng): returns the mean
+    # eval: returns the mean
     out, _ = m.apply(p, s, (mu, log_var))
     assert float(jnp.abs(out).max()) == 0.0
+    # training without rng is a loud error (Dropout contract)
+    with pytest.raises(ValueError, match="rng"):
+        m.apply(p, s, (mu, log_var), training=True)
+
+
+def test_masked_select_truncation_consistent():
+    m = nn.MaskedSelect(max_out=2)
+    p, s = m.init(jax.random.PRNGKey(0))
+    (vals, n), _ = m.apply(p, s, (jnp.asarray([1.0, 2.0, 3.0]),
+                                  jnp.asarray([True, True, True])))
+    assert int(n) == 2 and vals.shape == (2,)
 
 
 def test_local_normalization_family():
