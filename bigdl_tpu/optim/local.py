@@ -263,6 +263,10 @@ class Optimizer:
                 lr = self.method.current_lr(st)
                 rng, sub = jax.random.split(rng)
                 xd, yd = self._place_batch(x, y)
+                if self._param_summary_enabled():
+                    # batch refs only (never donated) — lets the Parameters
+                    # summary recompute gradients on its cadence
+                    self._last_batch = (xd, yd, sub)
                 params, model_state, slots, loss = step(
                     params, model_state, slots, xd, yd,
                     jnp.float32(lr), jnp.int32(st["neval"]), sub)
@@ -278,7 +282,7 @@ class Optimizer:
                 self._pending.append((st["neval"], lr, loss))
                 if st["neval"] % self._log_every == 0:
                     self._flush_metrics(st)
-                self._maybe_param_summary(params, st)
+                self._maybe_param_summary(params, model_state, st)
                 self._maybe_validate(params, model_state, st)
                 self._maybe_checkpoint(params, model_state, slots, st)
                 if self.end_when(st):
@@ -294,13 +298,14 @@ class Optimizer:
             dur = time.time() - epoch_start
             log.info("epoch %d done: %d records in %.1fs (%.1f rec/s)",
                      st["epoch"] - 1, epoch_records, dur, epoch_records / max(dur, 1e-9))
-            self._maybe_param_summary(params, st)
+            self._maybe_param_summary(params, model_state, st)
             self._maybe_validate(params, model_state, st)
             self._maybe_checkpoint(params, model_state, slots, st)
             st["epoch_finished"] = False
 
         self._flush_metrics(st)
 
+        self._last_batch = None            # release pinned device buffers
         self.params, self.model_state, self.slots = params, model_state, slots
         return params, model_state
 
@@ -328,32 +333,64 @@ class Optimizer:
         self._window_t0 = time.time()
         self._window_records = 0
 
-    def _maybe_param_summary(self, params, st):
+    def _param_summary_enabled(self) -> bool:
+        return self._summary is not None and getattr(
+            self._summary, "get_summary_trigger",
+            lambda _n: None)("Parameters") is not None
+
+    def _maybe_param_summary(self, params, model_state, st):
         """Per-parameter histogram dumps when the train summary carries a
         'Parameters' trigger (reference: optim/AbstractOptimizer.scala:47-91
         — trainSummary.setSummaryTrigger("Parameters", ...) dumps the
         parameter table). Costs a device→host fetch of every param; gate it
-        on a sparse trigger like the reference warns."""
-        if self._summary is None:
+        on a sparse trigger like the reference warns.
+
+        Gradients are recomputed at the CURRENT (post-update) params on the
+        most recent batch — one lr-step later than the reference's
+        gradWeight, but a quantity the current program actually defines
+        (params and model_state are the post-step outputs, whose buffers
+        have not yet been donated to the next step)."""
+        if not self._param_summary_enabled():
             return
-        trig = getattr(self._summary, "get_summary_trigger",
-                       lambda _n: None)("Parameters")
-        if trig is None or not trig(st):
+        trig = self._summary.get_summary_trigger("Parameters")
+        if not trig(st):
             return
         if getattr(self, "_last_hist_neval", -1) == st["neval"]:
             return
         self._last_hist_neval = st["neval"]
         import numpy as _np
 
-        def walk(tree, prefix):
+        grads = None
+        if getattr(self, "_last_batch", None) is not None:
+            # one extra fwd+bwd on the histogram cadence — the reference
+            # dumps gradWeight alongside weight (AbstractOptimizer.scala:47)
+            if not hasattr(self, "_hist_grad_fn"):
+                model, criterion = self.model, self.criterion
+
+                def gfn(p, ms, x, y, rng):
+                    def loss_fn(p):
+                        out, _ = model.apply(p, ms, x, training=True,
+                                             rng=rng)
+                        return criterion.forward(out, y)
+                    return jax.grad(loss_fn)(p)
+                self._hist_grad_fn = jax.jit(gfn)
+            x, y, sub = self._last_batch
+            grads = self._hist_grad_fn(params, model_state, x, y, sub)
+
+        def walk(tree, gtree, prefix):
             for k, v in tree.items():
                 path = f"{prefix}.{k}" if prefix else str(k)
+                g = None if gtree is None else gtree.get(k)
                 if isinstance(v, dict):
-                    walk(v, path)
+                    walk(v, g, path)
                 else:
                     self._summary.add_histogram(
                         path, _np.asarray(jax.device_get(v)), st["neval"])
-        walk(params, "")
+                    if g is not None:
+                        self._summary.add_histogram(
+                            f"{path}.grad",
+                            _np.asarray(jax.device_get(g)), st["neval"])
+        walk(params, grads, "")
 
     def _maybe_validate(self, params, model_state, st):
         if self.val_trigger is None or not self.val_trigger(st):

@@ -289,3 +289,29 @@ def test_optimizer_writes_parameter_histograms(tmp_path):
     ts4 = TrainSummary(str(tmp_path / "e"), "app")
     assert len(ts4.read_histogram("0.weight")) == 2
     ts4.close()
+
+
+def test_optimizer_writes_gradient_histograms(tmp_path):
+    from bigdl_tpu.visualization import TrainSummary
+    from bigdl_tpu.optim.local import Optimizer
+    from bigdl_tpu.optim.method import SGD
+    from bigdl_tpu.optim.trigger import Trigger
+    from bigdl_tpu.dataset import ArrayDataSet
+
+    r = np.random.RandomState(11)
+    X = r.randn(32, 4).astype(np.float32)
+    Y = (X.sum(1) > 0).astype(np.int32)
+    model = nn.Sequential().add(nn.Linear(4, 2)).add(nn.LogSoftMax())
+    ds = ArrayDataSet(X, Y, batch_size=16, shuffle=False)
+    opt = Optimizer(model, ds, nn.ClassNLLCriterion(), SGD(0.1))
+    ts = TrainSummary(str(tmp_path), "app")
+    ts.set_summary_trigger("Parameters", Trigger.several_iteration(2))
+    opt.set_train_summary(ts)
+    opt.set_end_when(Trigger.max_iteration(4))
+    opt.optimize()
+    ts.close()
+    ts2 = TrainSummary(str(tmp_path), "app")
+    ghist = ts2.read_histogram("0.weight.grad")
+    ts2.close()
+    assert len(ghist) >= 1
+    assert ghist[0][1]["num"] == 8
