@@ -16,16 +16,25 @@ import sys
 
 
 def _model(name: str, class_num: int):
-    from bigdl_tpu.models import autoencoder, inception, lenet, resnet, vgg
+    """Returns (model, input_shape, kind) — kind drives data synthesis:
+    'image' float NHWC, 'tokens' int ids with LM loss."""
+    from bigdl_tpu.models import (autoencoder, inception, lenet, resnet,
+                                  rnn, vgg)
     builders = {
-        "lenet": lambda: (lenet.build(10), (28, 28, 1)),
-        "resnet50": lambda: (resnet.build(50, class_num), (224, 224, 3)),
-        "resnet20-cifar": lambda: (resnet.build_cifar(20, 10), (32, 32, 3)),
-        "inception-v1": lambda: (inception.build(class_num), (224, 224, 3)),
+        "lenet": lambda: (lenet.build(10), (28, 28, 1), "image"),
+        "resnet50": lambda: (resnet.build(50, class_num), (224, 224, 3),
+                             "image"),
+        "resnet20-cifar": lambda: (resnet.build_cifar(20, 10), (32, 32, 3),
+                                   "image"),
+        "inception-v1": lambda: (inception.build(class_num), (224, 224, 3),
+                                 "image"),
         "inception-v2": lambda: (inception.build_v2(class_num),
-                                 (224, 224, 3)),
-        "vgg16": lambda: (vgg.build(16, class_num), (224, 224, 3)),
-        "autoencoder": lambda: (autoencoder.build(), (28, 28, 1)),
+                                 (224, 224, 3), "image"),
+        "vgg16": lambda: (vgg.build(16, class_num), (224, 224, 3), "image"),
+        "autoencoder": lambda: (autoencoder.build(), (28, 28, 1), "image"),
+        "ptb-lstm": lambda: (rnn.build_lstm(), (64,), "tokens"),
+        "ptb-transformer": lambda: (rnn.build_transformer(), (64,),
+                                    "tokens"),
     }
     if name not in builders:
         raise SystemExit(f"unknown model {name!r}; one of {sorted(builders)}")
@@ -39,30 +48,44 @@ def run(model_name: str, batch_size: int, iters: int, warmup: int,
     import numpy as np
 
     from bigdl_tpu.core.module import cast_floating
-    from bigdl_tpu.nn.criterion import ClassNLLCriterion, MSECriterion
+    from bigdl_tpu.nn.criterion import (ClassNLLCriterion,
+                                        CrossEntropyCriterion, MSECriterion)
     from bigdl_tpu.optim.method import SGD
     from bigdl_tpu.utils.sync import time_steps
 
-    model, spatial = _model(model_name, class_num)
+    model, spatial, kind = _model(model_name, class_num)
     autoenc = model_name == "autoencoder"
-    criterion = MSECriterion() if autoenc else ClassNLLCriterion()
     method = SGD(0.1, momentum=0.9)
     compute_dtype = {"bf16": jnp.bfloat16, "fp32": None}[dtype]
 
     params, state = model.init(jax.random.PRNGKey(0))
     slots = method.init_slots(params)
     r = np.random.RandomState(0)
-    x = jnp.asarray(r.randn(batch_size, *spatial).astype(np.float32))
-    y = x.reshape(batch_size, -1) if autoenc else \
-        jnp.asarray(r.randint(0, class_num, size=batch_size)
-                    .astype(np.int32))
+    if kind == "tokens":
+        vocab = 10000
+        x = jnp.asarray(r.randint(0, vocab, (batch_size,) + spatial)
+                        .astype(np.int32))
+        y = jnp.asarray(r.randint(0, vocab, (batch_size,) + spatial)
+                        .astype(np.int32))
+        # both criterions handle (B, T, V) with (B, T) targets natively —
+        # TimeDistributedCriterion would trace an unrolled T-loop under jit
+        criterion = ClassNLLCriterion() if model_name == "ptb-lstm" \
+            else CrossEntropyCriterion()
+    else:
+        x = jnp.asarray(r.randn(batch_size, *spatial).astype(np.float32))
+        y = x.reshape(batch_size, -1) if autoenc else \
+            jnp.asarray(r.randint(0, class_num, size=batch_size)
+                        .astype(np.int32))
+        criterion = MSECriterion() if autoenc else ClassNLLCriterion()
     rng = jax.random.PRNGKey(7)
 
     @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
     def step(params, slots, model_state):
         def loss_fn(p):
             pc = cast_floating(p, compute_dtype) if compute_dtype else p
-            xc = x.astype(compute_dtype) if compute_dtype else x
+            xc = (x.astype(compute_dtype)
+                  if compute_dtype and jnp.issubdtype(x.dtype, jnp.floating)
+                  else x)
             out, ns = model.apply(pc, model_state, xc, training=True,
                                   rng=rng)
             return criterion.forward(out.astype(jnp.float32), y), ns

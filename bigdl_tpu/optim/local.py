@@ -363,16 +363,44 @@ class Optimizer:
         grads = None
         if getattr(self, "_last_batch", None) is not None:
             # one extra fwd+bwd on the histogram cadence — the reference
-            # dumps gradWeight alongside weight (AbstractOptimizer.scala:47)
+            # dumps gradWeight alongside weight (AbstractOptimizer.scala:47).
+            # Mirrors the training step's gradient path exactly: same
+            # compute dtype, gradient processors, and frozen mask — a
+            # divergent recompute would mislead anyone debugging
+            # exploding/vanishing gradients from these histograms.
             if not hasattr(self, "_hist_grad_fn"):
+                from bigdl_tpu.core.module import cast_floating
                 model, criterion = self.model, self.criterion
+                compute_dtype = getattr(self, "compute_dtype", None)
+                processors = list(self.grad_processors)
+                frozen = any(m._frozen for m in model.modules())
 
                 def gfn(p, ms, x, y, rng):
-                    def loss_fn(p):
-                        out, _ = model.apply(p, ms, x, training=True,
+                    def loss_fn(pp):
+                        pc = cast_floating(pp, compute_dtype) \
+                            if compute_dtype else pp
+                        xc = (x.astype(compute_dtype)
+                              if compute_dtype
+                              and jnp.issubdtype(x.dtype, jnp.floating)
+                              else x)
+                        out, _ = model.apply(pc, ms, xc, training=True,
                                              rng=rng)
+                        if compute_dtype:
+                            out = jax.tree.map(
+                                lambda o: o.astype(jnp.float32)
+                                if jnp.issubdtype(o.dtype, jnp.floating)
+                                else o, out)
                         return criterion.forward(out, y)
-                    return jax.grad(loss_fn)(p)
+                    g = jax.grad(loss_fn)(p)
+                    if compute_dtype:
+                        g = cast_floating(g, jnp.float32)
+                    for proc in processors:
+                        g = proc(g, p)
+                    if frozen:
+                        tm = model.trainable_mask(p)
+                        g = jax.tree.map(
+                            lambda gg, m: jnp.where(m, gg, 0.0), g, tm)
+                    return g
                 self._hist_grad_fn = jax.jit(gfn)
             x, y, sub = self._last_batch
             grads = self._hist_grad_fn(params, model_state, x, y, sub)
