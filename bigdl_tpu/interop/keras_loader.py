@@ -600,6 +600,65 @@ def _b_masking(cfg, shapes):
     return nn.Masking(cfg.get("mask_value", 0.0)), shapes[0], _NO_W
 
 
+def _b_highway(cfg, shapes):
+    """Keras-1 Highway (reference: converter.py convert_highway — weights
+    [W, W_carry, b, b_carry]; both kernels are (in, out) like ours)."""
+    act_name = cfg.get("activation", "linear")
+    act_mod = _activation(act_name)         # reuse the loader's table
+    act = (lambda v: v) if act_mod is None \
+        else (lambda v, m=act_mod: m.forward({}, v))
+    size = shapes[0][-1]
+    m = nn.Highway(size, activation=act)
+    def adapter(wts):
+        p = {"w_h": wts[0], "w_t": wts[1]}
+        if len(wts) > 2:
+            p["b_h"], p["b_t"] = wts[2], wts[3]
+        else:
+            # keras bias=False means NO bias — zero both (our param_specs
+            # default the gate bias to -1, which would skew toward carry)
+            p["b_h"] = np.zeros(size, np.float32)
+            p["b_t"] = np.zeros(size, np.float32)
+        return p, {}
+    return m, shapes[0], adapter
+
+
+def _b_maxoutdense(cfg, shapes):
+    """Keras-1 MaxoutDense (reference: converter.py convert_maxoutdense —
+    kernel (maxN, in, out) → our packed (in, maxN*out))."""
+    out_dim = cfg.get("output_dim", cfg.get("units"))
+    maxn = cfg.get("nb_feature", 4)
+    use_bias = cfg.get("bias", cfg.get("use_bias", True))
+    m = nn.Maxout(shapes[0][-1], out_dim, maxn, with_bias=use_bias)
+    def adapter(wts):
+        k = np.asarray(wts[0])              # (maxN, in, out)
+        p = {"weight": np.concatenate([k[i] for i in range(k.shape[0])],
+                                      axis=1)}
+        if len(wts) > 1:
+            p["bias"] = np.asarray(wts[1]).reshape(-1)
+        return p, {}
+    return m, shapes[0][:-1] + (out_dim,), adapter
+
+
+def _b_srelu(cfg, shapes):
+    """(reference: converter.py convert_srelu — weights
+    [t_left, a_left, t_right, a_right])."""
+    shared = cfg.get("shared_axes") or []
+    rank = len(shapes[0])
+    if shared and sorted(shared) != list(range(1, rank - 1)):
+        raise NotImplementedError("SReLU with partial shared_axes")
+    shape = (shapes[0][-1],) if shared or rank == 2 else shapes[0][1:]
+    m = nn.SReLU(shape)
+    def adapter(wts):
+        tl = np.asarray(wts[0]).reshape(shape)
+        tr = np.asarray(wts[2]).reshape(shape)
+        return {"t_left": tl,
+                "a_left": np.asarray(wts[1]).reshape(shape),
+                # keras-1 reparameterizes: t_right_actual = t_left + |t_right|
+                "t_right": tl + np.abs(tr),
+                "a_right": np.asarray(wts[3]).reshape(shape)}, {}
+    return m, shapes[0], adapter
+
+
 def _b_layernorm(cfg, shapes):
     axis = cfg.get("axis", -1)
     if isinstance(axis, (list, tuple)):
@@ -658,6 +717,9 @@ _BUILDERS: Dict[str, Callable] = {
     "SpatialDropout1D": _b_spatialdropout(nn.SpatialDropout1D),
     "SpatialDropout2D": _b_spatialdropout(nn.SpatialDropout2D),
     "Masking": _b_masking,
+    "Highway": _b_highway,
+    "MaxoutDense": _b_maxoutdense,
+    "SReLU": _b_srelu,
 }
 
 

@@ -266,6 +266,20 @@ def TimeDistributed(layer, input_shape=None, name=None):
     return _cfg("TimeDistributed", input_shape, name, layer=layer)
 
 
+# ------------------------------------------------------- keras-1 layers
+def Highway(activation="linear", input_shape=None, name=None):
+    return _cfg("Highway", input_shape, name, activation=activation)
+
+
+def MaxoutDense(output_dim, nb_feature=4, input_shape=None, name=None):
+    return _cfg("MaxoutDense", input_shape, name, output_dim=output_dim,
+                nb_feature=nb_feature)
+
+
+def SReLU(shared_axes=None, input_shape=None, name=None):
+    return _cfg("SReLU", input_shape, name, shared_axes=shared_axes)
+
+
 # ----------------------------------------------------------------- merges
 def Concatenate(axis=-1, name=None):
     return _cfg("Concatenate", None, name, axis=axis)

@@ -300,3 +300,48 @@ def test_keras_missing_weights_and_unsupported():
     ])
     with pytest.raises(NotImplementedError, match="FancyKerasLayer"):
         model_from_json(bad)
+
+
+def test_keras1_highway_maxout_srelu(tmp_path):
+    """Keras-1 layer converters (reference: converter.py convert_highway/
+    convert_maxoutdense/convert_srelu)."""
+    r = np.random.RandomState(20)
+    d = 5
+    W = (r.randn(d, d) * 0.4).astype(np.float32)
+    Wc = (r.randn(d, d) * 0.4).astype(np.float32)
+    b = (r.randn(d) * 0.1).astype(np.float32)
+    bc = (r.randn(d) * 0.1).astype(np.float32)
+    k = (r.randn(3, d, 4) * 0.4).astype(np.float32)   # maxout (maxN,in,out)
+    kb = (r.randn(3, 4) * 0.1).astype(np.float32)
+    sr = [(r.randn(4) * 0.3).astype(np.float32),          # t_left != 0
+          np.ones(4, np.float32),
+          (r.randn(4) * 0.5).astype(np.float32),          # may be negative
+          np.ones(4, np.float32)]
+
+    model_json = _seq_json([
+        {"class_name": "Highway",
+         "config": {"name": "hw", "activation": "tanh",
+                    "batch_input_shape": [None, d]}},
+        {"class_name": "MaxoutDense",
+         "config": {"name": "mx", "output_dim": 4, "nb_feature": 3}},
+        {"class_name": "SReLU", "config": {"name": "sr"}},
+    ])
+    h5 = tmp_path / "w.h5"
+    _write_h5(h5, {"hw": [W, Wc, b, bc], "mx": [k, kb], "sr": sr})
+    module, params, state = load_keras(json_path=model_json,
+                                       hdf5_path=str(h5))
+    x = r.randn(3, d).astype(np.float32)
+    got, _ = module.apply(params, state, jnp.asarray(x), training=False)
+
+    # reference math
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+    h = np.tanh(x @ W + b)
+    t = sig(x @ Wc + bc)
+    hw = t * h + (1 - t) * x
+    mx = np.stack([hw @ k[i] + kb[i] for i in range(3)], 1).max(1)
+    tl, al, tr_raw, ar = sr
+    tr = tl + np.abs(tr_raw)            # keras-1 reparameterization
+    y = np.where(mx < tl, tl + al * (mx - tl), mx)
+    y = np.where(mx > tr, tr + ar * (mx - tr), y)
+    np.testing.assert_allclose(np.asarray(got), y, atol=1e-5)
