@@ -50,7 +50,16 @@ class TFNode:
     def __init__(self, msg: pw.Msg):
         self.name = msg.str(1)
         self.op = msg.str(2)
-        self.inputs = [i.split(":")[0].lstrip("^") for i in msg.strs(3)]
+        self.inputs: List[str] = []          # data inputs, port stripped
+        self.input_ports: List[tuple] = []   # (name, port) per data input
+        self.control_inputs: List[str] = []  # "^name" dependencies
+        for raw in msg.strs(3):
+            if raw.startswith("^"):
+                self.control_inputs.append(raw[1:])
+                continue
+            name, _, port = raw.partition(":")
+            self.inputs.append(name)
+            self.input_ports.append((name, int(port) if port else 0))
         self.attrs: Dict[str, pw.Msg] = {}
         for entry in msg.msgs(5):
             self.attrs[entry.str(1)] = entry.msg(2)

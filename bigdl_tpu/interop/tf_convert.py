@@ -171,6 +171,7 @@ def to_module(graph: TFGraph, inputs: Optional[Sequence[str]] = None,
     output_names = list(outputs) if outputs else [graph.order[-1]]
 
     sym: Dict[str, Node] = {}
+    sym_ports: Dict[Tuple[str, int], Node] = {}   # port>0 outputs
     weights: List[Tuple[Node, Dict[str, np.ndarray], Dict[str, np.ndarray]]] = []
     name_of_node: List[Tuple[str, Node]] = []
 
@@ -190,16 +191,29 @@ def to_module(graph: TFGraph, inputs: Optional[Sequence[str]] = None,
         data_ins = [i for i in node.inputs if is_data(i)]
         if not data_ins:
             continue                       # dead / const subgraph
-        built = _build_layer(graph, node, data_ins, sym, weights)
-        if built is not None:
+        built = _build_layer(graph, node, data_ins, sym, weights,
+                             sym_ports)
+        if isinstance(built, dict):        # multi-output op (Split/Unpack)
+            for port, tap in built.items():
+                sym_ports[(name, port)] = tap
+                name_of_node.append((f"{name}:{port}" if port else name,
+                                     tap))
+            sym[name] = built[0]
+        elif built is not None:
             sym[name] = built
             name_of_node.append((name, built))
 
-    missing = [o for o in output_names if o not in sym]
+    def out_node(spec: str):
+        name, _, port = spec.partition(":")
+        if port and int(port):
+            return sym_ports.get((name, int(port)))
+        return sym.get(name)
+
+    missing = [o for o in output_names if out_node(o) is None]
     if missing:
         raise ValueError(f"outputs {missing} were not converted")
     g = Graph([sym[i] for i in input_names],
-              [sym[o] for o in output_names])
+              [out_node(o) for o in output_names])
     params, state = g.init(rng if rng is not None else jax.random.PRNGKey(0))
     for n, p_over, s_over in weights:
         key = g._node_key[id(n)]
@@ -218,10 +232,25 @@ def _sint(v: int) -> int:
 
 
 def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
-                 sym: Dict[str, Node], weights) -> Optional[Node]:
+                 sym: Dict[str, Node], weights,
+                 sym_ports: Optional[Dict] = None):
     op = node.op
     const = lambda i: _const_value(graph, node.inputs[i])
-    parent = [sym[i] for i in data_ins]
+    sym_ports = sym_ports or {}
+
+    def resolve(nm: str, port: int) -> Node:
+        if port:
+            tap = sym_ports.get((nm, port))
+            if tap is None:
+                raise NotImplementedError(
+                    f"{node.name} consumes {nm}:{port}, but "
+                    f"{graph.nodes[nm].op if nm in graph.nodes else nm!r} "
+                    f"has no converted output port {port}")
+            return tap
+        return sym[nm]
+
+    parent = [resolve(nm, pt) for nm, pt in node.input_ports
+              if nm in sym]
 
     def mk(module, p_over=None, s_over=None, parents=parent):
         n = module(*parents)
@@ -244,7 +273,8 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
                 slots.append(jnp.asarray(cv))
             else:
                 slots.append(None)
-                parents.append(sym[node.inputs[i]])
+                nm, pt = node.input_ports[i]
+                parents.append(resolve(nm, pt))
 
         def wrap(fn):
             def inner(*xs):
@@ -255,7 +285,7 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
         return wrap, parents
 
     if op in _ALIAS_OPS:
-        return sym[data_ins[0]]
+        return parent[0]                  # port-resolved (Identity('sp:1'))
     if op == "Conv2D":
         w = const(1)
         if w is None:
@@ -529,6 +559,41 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
             raise NotImplementedError(f"{op} {node.name}: missing operand")
         return mk(ConstBinary(lambda a, b: bmm(b, a), w, const_first=True,
                               label="batch_matmul"))
+
+    # --------------------------------------------- multi-output (ports)
+    if op in ("Split", "SplitV", "Unpack"):
+        if op == "Split":                  # inputs: (axis, value)
+            ax = _const_value(graph, node.inputs[0])
+            if ax is None:
+                raise NotImplementedError(f"Split {node.name}: dynamic axis")
+            axis = int(np.asarray(ax).reshape(()))
+            n_out = attr_int("num_split", 1)
+            bounds = n_out
+        elif op == "SplitV":               # (value, size_splits, axis)
+            sizes = _const_value(graph, node.inputs[1])
+            ax = _const_value(graph, node.inputs[2])
+            if sizes is None or ax is None:
+                raise NotImplementedError(
+                    f"SplitV {node.name}: dynamic operands")
+            axis = int(np.asarray(ax).reshape(()))
+            sz = [int(v) for v in np.asarray(sizes).reshape(-1)]
+            n_out = len(sz)
+            bounds = np.cumsum(sz)[:-1].tolist()
+        else:                              # Unpack: value; num + axis attrs
+            axis = attr_int("axis", 0)
+            n_out = attr_int("num", 1)
+            bounds = None
+
+        if op == "Unpack":
+            def do_split(x, a=axis, n=n_out):
+                return tuple(jnp.squeeze(s, a)
+                             for s in jnp.split(x, n, axis=a))
+        else:
+            def do_split(x, b=bounds, a=axis):
+                return tuple(jnp.split(x, b, axis=a))
+        src = parent[0]
+        tup = Lambda(do_split, op.lower())(src)
+        return {i: nn.SelectTable(i)(tup) for i in range(n_out)}
 
     # ------------------------------------------------------------ spatial
     if op == "LRN":

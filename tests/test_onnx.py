@@ -387,3 +387,35 @@ def test_onnx_nary_const_channel_layout():
     conv = torch.nn.functional.conv2d(torch.from_numpy(x),
                                       torch.from_numpy(w)).numpy()
     np.testing.assert_allclose(got, np.minimum(conv, cap), atol=1e-5)
+
+
+def test_onnx_import_then_quantize_int8():
+    """Imported graphs compose with int8 quantization (the BASELINE
+    config-5 shape: load foreign model -> quantize -> inference parity)."""
+    from bigdl_tpu.nn.quantized import quantize
+    r = np.random.RandomState(16)
+    w1 = (r.randn(8, 3, 3, 3) * 0.2).astype(np.float32)
+    b1 = (r.randn(8) * 0.1).astype(np.float32)
+    wfc = (r.randn(8, 10) * 0.3).astype(np.float32)
+    graph = make_graph(
+        [
+            make_node("Conv", ["x", "w1", "b1"], ["c"], kernel_shape=[3, 3],
+                      pads=[1, 1, 1, 1]),
+            make_node("Relu", ["c"], ["rl"]),
+            make_node("GlobalAveragePool", ["rl"], ["g"]),
+            make_node("Flatten", ["g"], ["f"], axis=1),
+            make_node("MatMul", ["f", "wfc"], ["y"]),
+        ],
+        inputs={"x": [4, 3, 8, 8]}, outputs=["y"],
+        initializers={"w1": w1, "b1": b1, "wfc": wfc})
+    module, params, state, _ = load_model(make_model(graph))
+    x = jnp.asarray(r.randn(4, 3, 8, 8), jnp.float32)
+    ref, _ = module.apply(params, state, x, training=False)
+
+    qmodule, qparams = quantize(module, params)
+    out, _ = qmodule.apply(qparams, state, x, training=False)
+    # int8 inference tracks float closely and ranks identically
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=0.05, rtol=0.1)
+    np.testing.assert_array_equal(np.asarray(out).argmax(-1),
+                                  np.asarray(ref).argmax(-1))

@@ -283,3 +283,75 @@ def test_strided_slice_ellipsis_raises():
              make_node("y", "StridedSlice", ["x", "b", "e", "s"],
                        scalars={"ellipsis_mask": 1})],
             {"x": x}, ["y"])
+
+
+def test_split_multi_output_ports():
+    """Split's :1/:2 output ports wire to their consumers (round-2 soft
+    spot: ports were previously stripped)."""
+    r = np.random.RandomState(11)
+    x = r.randn(2, 6).astype(np.float32)
+    nodes = [
+        make_node("x", "Placeholder"),
+        make_node("axis", "Const", tensor=np.asarray(1, np.int32)),
+        make_node("sp", "Split", ["axis", "x"], scalars={"num_split": 3}),
+        make_node("y", "Sub", ["sp:2", "sp"]),      # port 2 minus port 0
+    ]
+    got = _convert_run(nodes, {"x": x}, ["y"])
+    np.testing.assert_allclose(got, x[:, 4:6] - x[:, 0:2], atol=1e-6)
+
+
+def test_splitv_and_unpack_ports():
+    r = np.random.RandomState(12)
+    x = r.randn(2, 7).astype(np.float32)
+    nodes = [
+        make_node("x", "Placeholder"),
+        make_node("sz", "Const", tensor=np.asarray([3, 4], np.int32)),
+        make_node("ax", "Const", tensor=np.asarray(1, np.int32)),
+        make_node("sv", "SplitV", ["x", "sz", "ax"]),
+        make_node("y", "Abs", ["sv:1"]),
+    ]
+    got = _convert_run(nodes, {"x": x}, ["y"])
+    np.testing.assert_allclose(got, np.abs(x[:, 3:]), atol=1e-6)
+
+    x2 = r.randn(2, 3, 4).astype(np.float32)
+    nodes = [
+        make_node("x", "Placeholder"),
+        make_node("up", "Unpack", ["x"], scalars={"num": 3, "axis": 1}),
+        make_node("y", "Maximum", ["up:0", "up:2"]),
+    ]
+    got = _convert_run(nodes, {"x": x2}, ["y"])
+    np.testing.assert_allclose(got, np.maximum(x2[:, 0], x2[:, 2]),
+                               atol=1e-6)
+
+
+def test_control_inputs_are_dependencies_not_data():
+    r = np.random.RandomState(13)
+    x = r.randn(2, 3).astype(np.float32)
+    nodes = [
+        make_node("x", "Placeholder"),
+        make_node("side", "Abs", ["x"]),
+        make_node("y", "Neg", ["x", "^side"]),   # control dep, not operand
+    ]
+    got = _convert_run(nodes, {"x": x}, ["y"])
+    np.testing.assert_allclose(got, -x, atol=1e-6)
+
+
+def test_port_resolution_through_alias_pack_and_outputs():
+    """Review regressions: Identity over a port, Pack of ports, and a
+    ':port' graph output all resolve the right slice."""
+    r = np.random.RandomState(14)
+    x = r.randn(2, 6).astype(np.float32)
+    nodes = [
+        make_node("x", "Placeholder"),
+        make_node("axis", "Const", tensor=np.asarray(1, np.int32)),
+        make_node("sp", "Split", ["axis", "x"], scalars={"num_split": 3}),
+        make_node("idn", "Identity", ["sp:1"]),
+        make_node("pk", "Pack", ["sp:1", "sp:2"], scalars={"axis": 1}),
+    ]
+    got = _convert_run(nodes, {"x": x}, ["idn"])
+    np.testing.assert_allclose(got, x[:, 2:4], atol=1e-6)
+    got = _convert_run(nodes, {"x": x}, ["pk"])
+    np.testing.assert_allclose(
+        got, np.stack([x[:, 2:4], x[:, 4:6]], axis=1), atol=1e-6)
+    got = _convert_run(nodes, {"x": x}, ["sp:2"])   # port as output
+    np.testing.assert_allclose(got, x[:, 4:6], atol=1e-6)
