@@ -204,13 +204,37 @@ def child_main():
 
 
 # -------------------------------------------------------------------- parent
+def _tpu_alive(timeout_s: int = 150) -> bool:
+    """Cheap liveness probe in a throwaway child: the axon tunnel, when
+    wedged, hangs jax backend init forever — burn 2.5 min here instead of
+    the full measurement timeouts below."""
+    probe = ("import jax, jax.numpy as jnp; "
+             "d = jax.devices(); "
+             "x = (jnp.ones((256, 256)) @ jnp.ones((256, 256)))"
+             ".block_until_ready(); "
+             "print('ALIVE', d[0].platform)")
+    try:
+        r = subprocess.run([sys.executable, "-c", probe],
+                           capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return False
+    return r.returncode == 0 and "ALIVE" in r.stdout \
+        and "cpu" not in r.stdout.lower()
+
+
 def parent_main():
-    attempts = [
-        ("tpu", {}, 900),
-        ("tpu-retry", {}, 600),
-        ("cpu-fallback", {"BIGDL_TPU_FORCE_CPU": "1"}, 900),
-    ]
-    errors = []
+    if _tpu_alive():
+        attempts = [
+            ("tpu", {}, 900),
+            ("tpu-retry", {}, 600),
+            ("cpu-fallback", {"BIGDL_TPU_FORCE_CPU": "1"}, 900),
+        ]
+    else:
+        attempts = [
+            ("cpu-fallback", {"BIGDL_TPU_FORCE_CPU": "1"}, 900),
+        ]
+    errors = ([] if attempts[0][0] != "cpu-fallback"
+              else ["tpu: liveness probe failed (chip tunnel down/wedged)"])
     for name, extra_env, tmo in attempts:
         env = dict(os.environ, **extra_env)
         env[_CHILD_FLAG] = "1"

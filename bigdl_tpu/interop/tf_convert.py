@@ -113,6 +113,9 @@ _UNARY_OPS = {
     "IsFinite": jnp.isfinite, "IsInf": jnp.isinf, "IsNan": jnp.isnan,
     "LogicalNot": jnp.logical_not,
     "Softplus": jax.nn.softplus, "Softsign": jax.nn.soft_sign,
+    "Digamma": jax.scipy.special.digamma,
+    "Lgamma": jax.scipy.special.gammaln,
+    "L2Loss": lambda x: 0.5 * jnp.sum(jnp.square(x)),
 }
 
 _BINARY_OPS = {
@@ -120,6 +123,7 @@ _BINARY_OPS = {
     "FloorDiv": jnp.floor_divide, "TruncateDiv": lambda a, b:
         jnp.trunc(a / b).astype(a.dtype),
     "FloorMod": jnp.mod, "Mod": jnp.mod, "Pow": jnp.power,
+    "TruncateMod": jnp.fmod,
     "Maximum": jnp.maximum, "Minimum": jnp.minimum,
     "SquaredDifference": lambda a, b: jnp.square(a - b),
     "Equal": lambda a, b: a == b, "NotEqual": lambda a, b: a != b,
@@ -317,7 +321,8 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
             w = w.T
         m = nn.Linear(w.shape[0], w.shape[1], bias=False)
         return mk(m, {"weight": w})
-    if op == "BiasAdd" or (op in ("Add", "AddV2") and const(1) is not None
+    if op in ("BiasAdd", "BiasAddV1") \
+            or (op in ("Add", "AddV2") and const(1) is not None
                            and np.asarray(const(1)).ndim <= 1):
         b = const(1)
         if b is None:                      # tensor + tensor
@@ -328,7 +333,7 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
         return mk(nn.CAddTable())
     if op == "Mul":
         return mk(nn.CMulTable())
-    if op in ("FusedBatchNorm", "FusedBatchNormV3"):
+    if op in ("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3"):
         scale = const(1)
         offset = const(2)
         mean = const(3)
@@ -652,9 +657,132 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
         # TF filter is already DHWIO — a real trainable param, like Conv2D
         return mk(m, {"weight": w})
 
+    if op in ("NoOp", "Assert"):
+        # control-only nodes produce no data (reference: loaders/NoOp.scala,
+        # loaders/Assert.scala → ControlDependency); nothing to wire
+        return None
+    if op == "ApproximateEqual":
+        a = node.attrs.get("tolerance")
+        tol = a.float(4, 1e-5) if a is not None else 1e-5
+        wrap, parents = mixed(2)
+        return mk(Lambda(wrap(lambda x, y, t=tol: jnp.abs(x - y) < t),
+                         "approximate_equal", n_in=len(parents)),
+                  parents=parents)
+    if op == "Fill":
+        dims = const(0)
+        if dims is None:
+            raise NotImplementedError(f"Fill {node.name}: dynamic dims")
+        shape = tuple(int(d) for d in np.asarray(dims).reshape(-1))
+        return mk(Lambda(lambda v, s=shape: jnp.broadcast_to(v, s), "fill"))
+    if op in ("TopK", "TopKV2"):
+        if op == "TopKV2":
+            kv = const(1)
+            if kv is None:
+                raise NotImplementedError(f"{op} {node.name}: dynamic k")
+            k = int(np.asarray(kv).reshape(()))
+        else:
+            k = attr_int("k", 1)
+        src = parent[0]
+        tup = Lambda(lambda x, kk=k: jax.lax.top_k(x, kk), op.lower())(src)
+        return {0: nn.SelectTable(0)(tup), 1: nn.SelectTable(1)(tup)}
+    if op == "InTopK":
+        k = attr_int("k", 1)
+        wrap, parents = mixed(2)
+
+        def in_top_k(pred, targets, kk=k):
+            # target's score must be within the top-k of its row
+            kth = jax.lax.top_k(pred, kk)[0][..., -1]
+            t = jnp.take_along_axis(
+                pred, targets[:, None].astype(jnp.int32), axis=1)[:, 0]
+            return t >= kth
+        return mk(Lambda(wrap(in_top_k), "in_top_k", n_in=len(parents)),
+                  parents=parents)
+    if op == "SoftmaxCrossEntropyWithLogits":
+        # two outputs: per-row loss (port 0), gradient wrt logits (port 1)
+        wrap, parents = mixed(2)
+
+        def sce(logits, labels):
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return (-jnp.sum(labels * logp, axis=-1),
+                    jax.nn.softmax(logits, axis=-1) - labels)
+        src = Lambda(wrap(sce), "softmax_xent", n_in=len(parents))(*parents)
+        return {0: nn.SelectTable(0)(src), 1: nn.SelectTable(1)(src)}
+    if op == "SegmentSum":
+        ids = const(1)
+        if ids is None:
+            raise NotImplementedError(
+                f"SegmentSum {node.name}: dynamic segment_ids (output "
+                f"shape would be data-dependent)")
+        seg = np.asarray(ids).reshape(-1).astype(np.int32)
+        num = int(seg.max()) + 1 if seg.size else 0
+        return mk(Lambda(lambda x, s=jnp.asarray(seg), n=num:
+                         jax.ops.segment_sum(x, s, num_segments=n),
+                         "segment_sum"))
+    if op == "Dilation2D":
+        w = const(1)
+        if w is None:
+            raise NotImplementedError(
+                f"Dilation2D {node.name}: non-const filter")
+        strides = node.attr_ints("strides") or [1, 1, 1, 1]
+        rates = node.attr_ints("rates") or [1, 1, 1, 1]
+        same = node.attr_str("padding", "SAME") == "SAME"
+        kh, kw, _ = w.shape
+
+        def dilate(x, w=jnp.asarray(w), sh=strides[1], sw=strides[2],
+                   rh=rates[1], rw=rates[2], same=same, kh=kh, kw=kw):
+            # morphological dilation: y = max_{di,dj}(x[..,i*s+di*r,..] + w)
+            ekh, ekw = (kh - 1) * rh + 1, (kw - 1) * rw + 1
+            if same:
+                # TF SAME: pad_total from the output size (ceil(in/s)),
+                # pad_top = pad_total//2 — NOT (ek-1)//2, which shifts
+                # windows when stride > 1
+                th = max((-(-x.shape[1] // sh) - 1) * sh + ekh - x.shape[1], 0)
+                tw = max((-(-x.shape[2] // sw) - 1) * sw + ekw - x.shape[2], 0)
+                x = jnp.pad(x, ((0, 0), (th // 2, th - th // 2),
+                                (tw // 2, tw - tw // 2), (0, 0)),
+                            constant_values=-jnp.inf)
+            oh = (x.shape[1] - ekh) // sh + 1
+            ow = (x.shape[2] - ekw) // sw + 1
+            out = None
+            for di in range(kh):
+                for dj in range(kw):
+                    sl = x[:, di * rh: di * rh + oh * sh: sh,
+                           dj * rw: dj * rw + ow * sw: sw, :] + w[di, dj]
+                    out = sl if out is None else jnp.maximum(out, sl)
+            return out
+        return mk(Lambda(dilate, "dilation2d"))
+    if op in ("Conv3DBackpropInput", "Conv3DBackpropInputV2"):
+        out_shape = _const_value(graph, node.inputs[0])
+        w = _const_value(graph, node.inputs[1])
+        if out_shape is None or w is None:
+            raise NotImplementedError(
+                f"{op} {node.name}: dynamic operands")
+        strides = node.attr_ints("strides") or [1, 1, 1, 1, 1]
+        sd, sh, sw = strides[1], strides[2], strides[3]
+        kd, kh, kw, cout, cin = w.shape
+        od, oh, ow = (int(out_shape[i]) for i in (1, 2, 3))
+        same = node.attr_str("padding", "SAME") == "SAME"
+
+        def solve(out, k, s):
+            inp = -(-out // s) if same else (out - k) // s + 1
+            total = (inp - 1) * s + k - out
+            p = max(0, (total + 1) // 2)
+            return p, 2 * p - total
+        pd, ad = solve(od, kd, sd)
+        ph, ah = solve(oh, kh, sh)
+        pw_, aw = solve(ow, kw, sw)
+        if ad or ah or aw:
+            raise NotImplementedError(
+                f"{op} {node.name}: asymmetric output adjustment")
+        m = nn.VolumetricFullConvolution(
+            cin, cout, kd, kw, kh, sd, sw, sh,
+            pad_t=pd, pad_w=pw_, pad_h=ph, bias=False)
+        return mk(m, {"weight": np.transpose(w, (0, 1, 2, 4, 3))})
+
     raise NotImplementedError(
         f"TF op {op!r} (node {node.name}) has no module loader "
-        f"(reference: utils/tf/loaders/)")
+        f"(reference: utils/tf/loaders/; decode/queue/reader input-pipeline "
+        f"ops are handled by the dataset layer, not the graph)")
 
 
 def load_model(path_or_bytes, inputs=None, outputs=None):

@@ -37,7 +37,8 @@ from bigdl_tpu.nn.arithmetic import (CAddTable, CMulTable, CSubTable, CDivTable,
                                      Power, Sqrt, Square, Abs, Exp, Log, Negative,
                                      Sum, Mean, Max, Min, Clip, MM, MV, DotProduct,
                                      CosineDistance, PairwiseDistance, Scale,
-                                     MixtureTable)
+                                     MixtureTable, TableOperation,
+                                     CMulTableExpand, CDivTableExpand)
 from bigdl_tpu.nn.attention import (MultiHeadAttention, Attention,
                                     FeedForwardNetwork, TransformerLayer,
                                     Transformer, dot_product_attention,
@@ -46,7 +47,7 @@ from bigdl_tpu.nn.attention import (MultiHeadAttention, Attention,
 from bigdl_tpu.nn.recurrent import (Cell, RnnCell, LSTM, LSTMPeephole, GRU,
                                     ConvLSTMPeephole, MultiRNNCell, Recurrent,
                                     BiRecurrent, RecurrentDecoder,
-                                    BinaryTreeLSTM,
+                                    BinaryTreeLSTM, TreeLSTM,
                                     TimeDistributed, SequenceBeamSearch,
                                     beam_search, tile_beam)
 from bigdl_tpu.nn.criterion import (ClassNLLCriterion, CrossEntropyCriterion,
@@ -90,5 +91,7 @@ from bigdl_tpu.nn.misc import (ActivityRegularization, BifurcateSplitTable,
 from bigdl_tpu.nn import detection, ops, quantized, sparse
 from bigdl_tpu.nn.detection import (Anchor, DetectionOutputSSD, FPN, Nms,
                                     Pooler, PriorBox, RoiAlign, RoiPooling)
-from bigdl_tpu.nn.sparse import (LookupTableSparse, SparseCOO,
+from bigdl_tpu.nn.rcnn import (BoxHead, DetectionOutputFrcnn, MaskHead,
+                               Proposal, RegionProposal)
+from bigdl_tpu.nn.sparse import (DenseToSparse, LookupTableSparse, SparseCOO,
                                  SparseJoinTable, SparseLinear)

@@ -257,6 +257,38 @@ class Scale(Sequential):
         super().__init__(CMul(size), CAdd(size), name=name)
 
 
+class TableOperation(Module):
+    """Run a two-input table layer after broadcast-expanding the smaller
+    input to the larger one's shape (reference: nn/TableOperation.scala:35
+    — used as `CMulTableExpand`/`CDivTableExpand` for tensor-vs-scalar
+    table math)."""
+
+    def __init__(self, operation_layer: Module,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.add_child("op", operation_layer)
+
+    def forward(self, params, *inputs, **_):
+        a, b = _table(inputs)
+        if a.size < b.size:
+            a = jnp.broadcast_to(a.reshape(
+                a.shape + (1,) * (b.ndim - a.ndim)), b.shape)
+        elif b.size < a.size:
+            b = jnp.broadcast_to(b.reshape(
+                b.shape + (1,) * (a.ndim - b.ndim)), a.shape)
+        return self.children()["op"].forward(params.get("op", {}), (a, b))
+
+
+def CMulTableExpand(name=None):
+    """(reference: nn/TableOperation.scala CMulTableExpand factory)."""
+    return TableOperation(CMulTable(), name=name)
+
+
+def CDivTableExpand(name=None):
+    """(reference: nn/TableOperation.scala CDivTableExpand factory)."""
+    return TableOperation(CDivTable(), name=name)
+
+
 class MixtureTable(Module):
     """Mixture-of-experts blend: (gates, expert_outputs_stacked_or_tuple)
     (reference: nn/MixtureTable.scala)."""

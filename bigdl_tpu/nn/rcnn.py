@@ -1,0 +1,366 @@
+"""Standalone R-CNN head layers (reference: nn/RegionProposal.scala:40,
+nn/BoxHead.scala:30, nn/MaskHead.scala:24, nn/Proposal.scala:34,
+nn/DetectionOutputFrcnn.scala:48).
+
+The reference exposes these as public composable modules (the MaskRCNN
+model wires them together); this module does the same over the TPU-native
+primitives in nn/detection.py. Everything is static-shape: proposal counts
+are fixed (`post_nms_top_n`, `max_per_image`) with validity masks, so the
+full two-stage detector stays inside one XLA program with no retraces.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.core.module import Module
+from bigdl_tpu.core import init as initializers
+from bigdl_tpu.nn.conv import SpatialConvolution, SpatialFullConvolution
+from bigdl_tpu.nn.linear import Linear
+from bigdl_tpu.nn.detection import Anchor, Pooler, decode_boxes, nms
+
+
+def _normal_init(std):
+    def _init(rng, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
+        return std * jax.random.normal(rng, shape, dtype)
+    return _init
+
+
+class RegionProposal(Module):
+    """Multi-level RPN: shared conv head over FPN features + per-level
+    anchor decode + joint top-k/NMS proposal selection (reference:
+    nn/RegionProposal.scala:40-247; the per-level head of
+    `rpnHead` at :88-106, post-processing `ProposalPostProcessor` at :247+).
+
+    Input: (features_list, image_hw) where features_list is a tuple of
+    NHWC maps (one per anchor stride, batch size B). Output:
+    (proposals (B, post_nms_top_n, 4), valid (B, post_nms_top_n)).
+    """
+
+    def __init__(self, in_channels: int,
+                 anchor_sizes: Sequence[float] = (32, 64, 128, 256),
+                 aspect_ratios: Sequence[float] = (0.5, 1.0, 2.0),
+                 anchor_stride: Sequence[float] = (4, 8, 16, 32),
+                 pre_nms_top_n: int = 1000, post_nms_top_n: int = 1000,
+                 nms_thresh: float = 0.7, min_size: int = 0, name=None):
+        super().__init__(name)
+        assert len(anchor_sizes) == len(anchor_stride), \
+            "anchor sizes and strides must pair up (one anchor set per level)"
+        self.sizes = tuple(float(s) for s in anchor_sizes)
+        self.strides = tuple(int(s) for s in anchor_stride)
+        self.ratios = tuple(float(r) for r in aspect_ratios)
+        self.pre_nms_top_n = pre_nms_top_n
+        self.post_nms_top_n = post_nms_top_n
+        self.nms_thresh = nms_thresh
+        self.min_size = min_size
+        # one scale per level (size/stride), shared ratios — like the
+        # reference's per-stride Anchor list
+        self.anchors = [Anchor(self.ratios, (s / st,))
+                        for s, st in zip(self.sizes, self.strides)]
+        na = self.anchors[0].num
+        self.add_child("conv", SpatialConvolution(
+            in_channels, in_channels, 3, 3, pad_w=1, pad_h=1,
+            w_init=_normal_init(0.01)))
+        self.add_child("cls_logits", SpatialConvolution(
+            in_channels, na, 1, 1, w_init=_normal_init(0.01)))
+        self.add_child("bbox_pred", SpatialConvolution(
+            in_channels, na * 4, 1, 1, w_init=_normal_init(0.01)))
+
+    def _head(self, params, state, feat):
+        ch = self.children()
+        h, _ = ch["conv"].apply(params["conv"], state["conv"], feat)
+        h = jax.nn.relu(h)
+        logits, _ = ch["cls_logits"].apply(params["cls_logits"],
+                                           state["cls_logits"], h)
+        deltas, _ = ch["bbox_pred"].apply(params["bbox_pred"],
+                                          state["bbox_pred"], h)
+        return logits, deltas
+
+    def _apply(self, params, state, features, image_hw=None, *,
+               training=False, rng=None):
+        if image_hw is None:
+            features, image_hw = features
+        if isinstance(features, jnp.ndarray):
+            features = (features,)
+        img_h, img_w = int(image_hw[0]), int(image_hw[1])
+
+        all_scores, all_boxes = [], []
+        for lvl, feat in enumerate(features):
+            logits, deltas = self._head(params, state, feat)
+            b, fh, fw, na = logits.shape
+            anchors = self.anchors[lvl].generate(fh, fw, self.strides[lvl])
+            scores = logits.reshape(b, fh * fw * na)
+            deltas = deltas.reshape(b, fh * fw * na, 4)
+            boxes = decode_boxes(anchors[None], deltas,
+                                 clip_shape=(img_h, img_w))
+            # per-level pre-NMS top-k (static k, like preNmsTopN)
+            k = min(self.pre_nms_top_n, scores.shape[1])
+            top_s, top_i = jax.lax.top_k(scores, k)
+            top_b = jnp.take_along_axis(boxes, top_i[..., None], axis=1)
+            all_scores.append(top_s)
+            all_boxes.append(top_b)
+
+        scores = jnp.concatenate(all_scores, axis=1)       # (B, sumK)
+        boxes = jnp.concatenate(all_boxes, axis=1)         # (B, sumK, 4)
+        # objectness first, THEN the -inf min-size mask (nms treats any
+        # score > -inf as selectable, so masking must come last)
+        scores = jax.nn.sigmoid(scores)
+        if self.min_size > 0:
+            w = boxes[..., 2] - boxes[..., 0]
+            h = boxes[..., 3] - boxes[..., 1]
+            scores = jnp.where((w >= self.min_size) & (h >= self.min_size),
+                               scores, -jnp.inf)
+
+        def per_image(bx, sc):
+            idx, valid = nms(bx, sc, self.nms_thresh, self.post_nms_top_n)
+            return bx[idx], valid
+        props, valid = jax.vmap(per_image)(boxes, scores)
+        return (props, valid), state
+
+
+class Proposal(Module):
+    """Classic single-level Faster-RCNN proposal layer: takes RPN class
+    probabilities + box deltas, returns scored rois (reference:
+    nn/Proposal.scala:34 — objectness sort, decode, clip, min-size filter,
+    NMS; test-time preNmsTopN/postNmsTopN).
+
+    Input: (cls_prob (B, H, W, 2A), bbox_pred (B, H, W, 4A), im_info (2,)).
+    Output: (rois (B, post_nms_top_n, 4), valid (B, post_nms_top_n)).
+    """
+
+    def __init__(self, pre_nms_top_n: int = 6000,
+                 post_nms_top_n: int = 300,
+                 ratios: Sequence[float] = (0.5, 1.0, 2.0),
+                 scales: Sequence[float] = (8, 16, 32),
+                 rpn_pre_nms_top_n_train: int = 12000,
+                 rpn_post_nms_top_n_train: int = 2000,
+                 stride: int = 16, nms_thresh: float = 0.7,
+                 min_size: int = 16, name=None):
+        super().__init__(name)
+        self.pre_test, self.post_test = pre_nms_top_n, post_nms_top_n
+        self.pre_train = rpn_pre_nms_top_n_train
+        self.post_train = rpn_post_nms_top_n_train
+        self.anchor = Anchor(ratios, scales)
+        self.stride = stride
+        self.nms_thresh = nms_thresh
+        self.min_size = min_size
+
+    def _apply(self, params, state, cls_prob, bbox_pred=None, im_info=None,
+               *, training=False, rng=None):
+        if bbox_pred is None:
+            cls_prob, bbox_pred, im_info = cls_prob
+        b, fh, fw, a2 = cls_prob.shape
+        na = self.anchor.num
+        img_h, img_w = int(im_info[0]), int(im_info[1])
+        anchors = self.anchor.generate(fh, fw, self.stride)
+        # foreground scores are the second half of the 2A channel block
+        # (reference Proposal.scala: narrow on channel A+1..2A)
+        fg = cls_prob.reshape(b, fh * fw, 2, na)[:, :, 1, :]
+        scores = fg.reshape(b, fh * fw * na)
+        deltas = bbox_pred.reshape(b, fh * fw * na, 4)
+        boxes = decode_boxes(anchors[None], deltas, clip_shape=(img_h, img_w))
+
+        w = boxes[..., 2] - boxes[..., 0]
+        h = boxes[..., 3] - boxes[..., 1]
+        scores = jnp.where((w >= self.min_size) & (h >= self.min_size),
+                           scores, -jnp.inf)
+        pre = self.pre_train if training else self.pre_test
+        post = self.post_train if training else self.post_test
+        k = min(pre, scores.shape[1])
+        top_s, top_i = jax.lax.top_k(scores, k)
+        top_b = jnp.take_along_axis(boxes, top_i[..., None], axis=1)
+
+        def per_image(bx, sc):
+            idx, valid = nms(bx, sc, self.nms_thresh, post)
+            return bx[idx], valid
+        rois, valid = jax.vmap(per_image)(top_b, top_s)
+        return (rois, valid), state
+
+
+class BoxHead(Module):
+    """Second-stage box head: multi-level RoiAlign pooler → 2 FC → class
+    logits + box regression → per-class NMS post-processing (reference:
+    nn/BoxHead.scala:30-110 featureExtractor/clsPredictor/bboxPredictor +
+    BoxPostProcessor at :108+; box-decode weights (10,10,5,5)).
+
+    Input: (features_list, proposals (N, 4), image_hw). Output:
+    (boxes (max_per_image, 4), scores, labels, valid) for one image.
+    """
+
+    DECODE_W = (10.0, 10.0, 5.0, 5.0)
+
+    def __init__(self, in_channels: int, resolution: int,
+                 scales: Sequence[float], sampling_ratio: int,
+                 score_thresh: float, nms_thresh: float,
+                 max_per_image: int, output_size: int, num_classes: int,
+                 name=None):
+        super().__init__(name)
+        self.resolution = resolution
+        self.score_thresh = score_thresh
+        self.nms_thresh = nms_thresh
+        self.max_per_image = max_per_image
+        self.num_classes = num_classes
+        self.add_child("pooler", Pooler((resolution, resolution), scales,
+                                        sampling_ratio))
+        in_size = in_channels * resolution * resolution
+        self.add_child("fc1", Linear(in_size, output_size,
+                                     w_init=initializers.xavier))
+        self.add_child("fc2", Linear(output_size, output_size,
+                                     w_init=initializers.xavier))
+        self.add_child("cls_score", Linear(output_size, num_classes,
+                                           w_init=_normal_init(0.01)))
+        self.add_child("bbox_pred", Linear(output_size, num_classes * 4,
+                                           w_init=_normal_init(0.001)))
+
+    def extract_features(self, params, state, features, proposals):
+        ch = self.children()
+        pooled, _ = ch["pooler"].apply(params["pooler"], state["pooler"],
+                                       (features, proposals, None))
+        flat = pooled.reshape(pooled.shape[0], -1)
+        h, _ = ch["fc1"].apply(params["fc1"], state["fc1"], flat)
+        h = jax.nn.relu(h)
+        h, _ = ch["fc2"].apply(params["fc2"], state["fc2"], h)
+        return jax.nn.relu(h)
+
+    def _apply(self, params, state, features, proposals=None, image_hw=None,
+               *, training=False, rng=None):
+        if proposals is None:
+            features, proposals, image_hw = features
+        ch = self.children()
+        feats = self.extract_features(params, state, features, proposals)
+        logits, _ = ch["cls_score"].apply(params["cls_score"],
+                                          state["cls_score"], feats)
+        deltas, _ = ch["bbox_pred"].apply(params["bbox_pred"],
+                                          state["bbox_pred"], feats)
+        probs = jax.nn.softmax(logits, -1)                 # (N, C)
+        n = proposals.shape[0]
+        deltas = deltas.reshape(n, self.num_classes, 4) / \
+            jnp.asarray(self.DECODE_W)
+        clip = (int(image_hw[0]), int(image_hw[1])) \
+            if image_hw is not None else None
+        boxes_c = decode_boxes(proposals[:, None, :], deltas, clip)  # (N,C,4)
+
+        def per_class(c):
+            sc = jnp.where(probs[:, c] >= self.score_thresh, probs[:, c],
+                           -jnp.inf)
+            idx, valid = nms(boxes_c[:, c], sc, self.nms_thresh,
+                             self.max_per_image)
+            return (boxes_c[idx, c], jnp.where(valid, probs[idx, c], 0.0),
+                    valid)
+        cs = jnp.arange(1, self.num_classes)               # skip background 0
+        cb, cscores, cvalid = jax.vmap(per_class)(cs)      # (C-1, K, ...)
+        labels = jnp.broadcast_to(cs[:, None], cscores.shape)
+        # keep the max_per_image best across classes (reference: maxPerImage
+        # global cap after per-class NMS)
+        flat_s = jnp.where(cvalid, cscores, -jnp.inf).reshape(-1)
+        top_s, top_i = jax.lax.top_k(flat_s, self.max_per_image)
+        out_boxes = cb.reshape(-1, 4)[top_i]
+        out_labels = labels.reshape(-1)[top_i]
+        out_valid = top_s > -jnp.inf
+        out_scores = jnp.where(out_valid, top_s, 0.0)
+        return (out_boxes, out_scores, out_labels, out_valid), state
+
+
+class MaskHead(Module):
+    """Mask branch: pooler → conv stack → deconv upsample → per-class mask
+    logits, sigmoid-selected by predicted label (reference:
+    nn/MaskHead.scala:24-120 maskFeatureExtractor/maskPredictor +
+    MaskPostProcessor).
+
+    Input: (features_list, boxes (N, 4), labels (N,)). Output:
+    masks (N, 2*resolution, 2*resolution) probabilities for each box's
+    predicted class.
+    """
+
+    def __init__(self, in_channels: int, resolution: int,
+                 scales: Sequence[float], sampling_ratio: int,
+                 layers: Sequence[int], dilation: int, num_classes: int,
+                 name=None):
+        super().__init__(name)
+        assert dilation == 1, "only dilation=1 is supported (as reference)"
+        self.num_classes = num_classes
+        self.add_child("pooler", Pooler((resolution, resolution), scales,
+                                        sampling_ratio))
+        cin = in_channels
+        self.n_convs = len(layers)
+        for i, cout in enumerate(layers):
+            self.add_child(f"mask_fcn{i}", SpatialConvolution(
+                cin, cout, 3, 3, pad_w=1, pad_h=1))
+            cin = cout
+        self.add_child("conv_mask", SpatialFullConvolution(
+            cin, cin, 2, 2, stride_w=2, stride_h=2))
+        self.add_child("mask_logits", SpatialConvolution(
+            cin, num_classes, 1, 1))
+
+    def _apply(self, params, state, features, boxes=None, labels=None, *,
+               training=False, rng=None):
+        if boxes is None:
+            features, boxes, labels = features
+        ch = self.children()
+        h, _ = ch["pooler"].apply(params["pooler"], state["pooler"],
+                                  (features, boxes, None))
+        for i in range(self.n_convs):
+            h, _ = ch[f"mask_fcn{i}"].apply(params[f"mask_fcn{i}"],
+                                            state[f"mask_fcn{i}"], h)
+            h = jax.nn.relu(h)
+        h, _ = ch["conv_mask"].apply(params["conv_mask"],
+                                     state["conv_mask"], h)
+        h = jax.nn.relu(h)
+        logits, _ = ch["mask_logits"].apply(params["mask_logits"],
+                                            state["mask_logits"], h)
+        probs = jax.nn.sigmoid(logits)                     # (N, 2R, 2R, C)
+        if labels is None:
+            return probs, state
+        sel = jnp.take_along_axis(
+            probs, labels[:, None, None, None].astype(jnp.int32), axis=-1)
+        return sel[..., 0], state
+
+
+class DetectionOutputFrcnn(Module):
+    """Faster-RCNN test-time post-processing: per-class box decode +
+    NMS over (im_info, rois, cls_prob, bbox_pred) (reference:
+    nn/DetectionOutputFrcnn.scala:48 — nmsThresh 0.3, nClasses,
+    optional bbox normalization).
+
+    Input: (cls_prob (N, C), bbox_pred (N, 4C), rois (N, 4), im_info (2,)).
+    Output: (boxes (K, 4), scores (K,), labels (K,), valid (K,)).
+    """
+
+    def __init__(self, nms_thresh: float = 0.3, n_classes: int = 21,
+                 max_per_image: int = 100, score_thresh: float = 0.05,
+                 name=None):
+        super().__init__(name)
+        self.nms_thresh = nms_thresh
+        self.n_classes = n_classes
+        self.max_per_image = max_per_image
+        self.score_thresh = score_thresh
+
+    def forward(self, params, cls_prob, bbox_pred=None, rois=None,
+                im_info=None, **_):
+        if bbox_pred is None:
+            cls_prob, bbox_pred, rois, im_info = cls_prob
+        n = rois.shape[0]
+        deltas = bbox_pred.reshape(n, self.n_classes, 4)
+        clip = (int(im_info[0]), int(im_info[1])) if im_info is not None \
+            else None
+        boxes_c = decode_boxes(rois[:, None, :], deltas, clip)
+
+        def per_class(c):
+            sc = jnp.where(cls_prob[:, c] >= self.score_thresh,
+                           cls_prob[:, c], -jnp.inf)
+            idx, valid = nms(boxes_c[:, c], sc, self.nms_thresh,
+                             self.max_per_image)
+            return (boxes_c[idx, c],
+                    jnp.where(valid, cls_prob[idx, c], 0.0), valid)
+        cs = jnp.arange(1, self.n_classes)
+        cb, cscores, cvalid = jax.vmap(per_class)(cs)
+        labels = jnp.broadcast_to(cs[:, None], cscores.shape)
+        flat_s = jnp.where(cvalid, cscores, -jnp.inf).reshape(-1)
+        top_s, top_i = jax.lax.top_k(flat_s, self.max_per_image)
+        out_valid = top_s > -jnp.inf
+        return (cb.reshape(-1, 4)[top_i],
+                jnp.where(out_valid, top_s, 0.0),
+                labels.reshape(-1)[top_i], out_valid)

@@ -477,7 +477,17 @@ class SequenceBeamSearch(Module):
         return out, state
 
 
-class BinaryTreeLSTM(Module):
+class TreeLSTM(Module):
+    """Abstract tree-LSTM contract (reference: nn/TreeLSTM.scala:25 —
+    shared input/hidden sizes and memory-zero helpers for tree-structured
+    recursion; BinaryTreeLSTM is the concrete child)."""
+
+    def __init__(self, input_size: int, hidden_size: int, name=None):
+        super().__init__(name)
+        self.input_size, self.hidden_size = input_size, hidden_size
+
+
+class BinaryTreeLSTM(TreeLSTM):
     """Binary tree-LSTM over batched constituency trees
     (reference: nn/BinaryTreeLSTM.scala:40-280 — leaf module c=Wx,
     h=sigmoid(W_o x)*tanh(c); composer with per-child forget gates,
@@ -496,8 +506,7 @@ class BinaryTreeLSTM(Module):
 
     def __init__(self, input_size: int, hidden_size: int,
                  gate_output: bool = True, name=None):
-        super().__init__(name)
-        self.input_size, self.hidden_size = input_size, hidden_size
+        super().__init__(input_size, hidden_size, name=name)
         self.gate_output = gate_output
 
     def param_specs(self):

@@ -139,3 +139,24 @@ class SparseJoinTable(Module):
             offset += x.n_cols
         return SparseCOO(jnp.concatenate(ids, 1), jnp.concatenate(vals, 1),
                          offset, pad)
+
+
+class DenseToSparse(Module):
+    """Convert a dense (B, N) batch into the fixed-width SparseCOO form
+    (reference: nn/DenseToSparse.scala:30 — Tensor.sparse(input); here the
+    static nnz_per_row keeps the downstream program shape-stable).
+
+    Host-side boundary op: runs on concrete arrays (the conversion itself
+    is data-dependent), feeding SparseLinear/SparseJoinTable inputs.
+    """
+
+    def __init__(self, nnz_per_row: int, pad_id: int = -1,
+                 propagate_back: bool = True, name=None):
+        super().__init__(name)
+        self.nnz_per_row = nnz_per_row
+        self.pad_id = pad_id
+        self.propagate_back = propagate_back
+
+    def forward(self, params, x, **_):
+        return SparseCOO.from_dense(np.asarray(x), self.nnz_per_row,
+                                    self.pad_id)

@@ -355,3 +355,146 @@ def test_port_resolution_through_alias_pack_and_outputs():
         got, np.stack([x[:, 2:4], x[:, 4:6]], axis=1), atol=1e-6)
     got = _convert_run(nodes, {"x": x}, ["sp:2"])   # port as output
     np.testing.assert_allclose(got, x[:, 4:6], atol=1e-6)
+
+
+# ----------------------------------------------------- round-3 op tail
+def test_topk_ports_and_in_top_k():
+    x = np.asarray([[0.1, 0.9, 0.3, 0.5],
+                    [0.8, 0.2, 0.7, 0.1]], np.float32)
+    vals = _convert_run(
+        [make_node("x", "Placeholder"),
+         make_node("k", "Const", tensor=np.asarray(2, np.int32)),
+         make_node("t", "TopKV2", ["x", "k"]),
+         make_node("y", "Identity", ["t"])], {"x": x}, ["y"])
+    np.testing.assert_allclose(vals, np.sort(x, 1)[:, ::-1][:, :2])
+    idx = _convert_run(
+        [make_node("x", "Placeholder"),
+         make_node("t", "TopK", ["x"], scalars={"k": 1}),
+         make_node("y", "Identity", ["t:1"])], {"x": x}, ["y"])
+    np.testing.assert_array_equal(idx.reshape(-1), [1, 0])
+
+    targets = np.asarray([1, 1], np.int32)
+    got = _convert_run(
+        [make_node("p", "Placeholder"), make_node("t", "Placeholder"),
+         make_node("y", "InTopK", ["p", "t"], scalars={"k": 2})],
+        {"p": x, "t": targets}, ["y"])
+    np.testing.assert_array_equal(got, [True, False])
+
+
+def test_softmax_xent_ports():
+    logits = np.asarray([[1.0, 2.0, 0.5], [0.1, 0.2, 3.0]], np.float32)
+    labels = np.eye(3, dtype=np.float32)[[1, 2]]
+    loss = _convert_run(
+        [make_node("x", "Placeholder"), make_node("l", "Placeholder"),
+         make_node("s", "SoftmaxCrossEntropyWithLogits", ["x", "l"]),
+         make_node("y", "Identity", ["s"])],
+        {"x": logits, "l": labels}, ["y"])
+    p = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+    np.testing.assert_allclose(loss, -(labels * np.log(p)).sum(1), rtol=1e-5)
+    grad = _convert_run(
+        [make_node("x", "Placeholder"), make_node("l", "Placeholder"),
+         make_node("s", "SoftmaxCrossEntropyWithLogits", ["x", "l"]),
+         make_node("y", "Identity", ["s:1"])],
+        {"x": logits, "l": labels}, ["y"])
+    np.testing.assert_allclose(grad, p - labels, rtol=1e-5)
+
+
+def test_fill_segment_sum_truncate_mod_approx_equal():
+    v = np.asarray(3.5, np.float32)
+    got = _convert_run(
+        [make_node("v", "Placeholder"),
+         make_node("d", "Const", tensor=np.asarray([2, 3], np.int32)),
+         make_node("y", "Fill", ["d", "v"])], {"v": v}, ["y"])
+    np.testing.assert_allclose(got, np.full((2, 3), 3.5))
+
+    x = np.arange(8, dtype=np.float32).reshape(4, 2)
+    got = _convert_run(
+        [make_node("x", "Placeholder"),
+         make_node("s", "Const", tensor=np.asarray([0, 0, 1, 1], np.int32)),
+         make_node("y", "SegmentSum", ["x", "s"])], {"x": x}, ["y"])
+    np.testing.assert_allclose(got, [[2, 4], [10, 12]])
+
+    a = np.asarray([7.0, -7.0], np.float32)
+    b = np.asarray([3.0, 3.0], np.float32)
+    got = _convert_run(
+        [make_node("a", "Placeholder"), make_node("b", "Placeholder"),
+         make_node("y", "TruncateMod", ["a", "b"])], {"a": a, "b": b}, ["y"])
+    np.testing.assert_allclose(got, np.fmod(a, b), atol=1e-6)
+
+    got = _convert_run(
+        [make_node("a", "Placeholder"), make_node("b", "Placeholder"),
+         make_node("y", "ApproximateEqual", ["a", "b"],
+                   scalars={"tolerance": 0.5})],
+        {"a": np.asarray([1.0, 1.2], np.float32),
+         "b": np.asarray([1.1, 2.0], np.float32)}, ["y"])
+    np.testing.assert_array_equal(got, [True, False])
+
+
+def test_dilation2d_matches_manual():
+    r = np.random.RandomState(5)
+    x = r.rand(1, 5, 5, 2).astype(np.float32)
+    w = r.rand(2, 2, 2).astype(np.float32)
+    got = _convert_run(
+        [make_node("x", "Placeholder"),
+         make_node("w", "Const", tensor=w),
+         make_node("y", "Dilation2D", ["x", "w"],
+                   ints={"strides": [1, 1, 1, 1], "rates": [1, 1, 1, 1]},
+                   strs={"padding": "VALID"})], {"x": x}, ["y"])
+    expect = np.full((1, 4, 4, 2), -np.inf, np.float32)
+    for di in range(2):
+        for dj in range(2):
+            expect = np.maximum(expect, x[:, di:di+4, dj:dj+4, :] + w[di, dj])
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_lgamma_digamma_l2loss():
+    x = np.asarray([1.5, 2.5, 4.0], np.float32)
+    got = _convert_run(
+        [make_node("x", "Placeholder"), make_node("y", "Lgamma", ["x"])],
+        {"x": x}, ["y"])
+    import math
+    np.testing.assert_allclose(got, [math.lgamma(float(v)) for v in x],
+                               rtol=1e-5)
+    got = _convert_run(
+        [make_node("x", "Placeholder"), make_node("y", "L2Loss", ["x"])],
+        {"x": x}, ["y"])
+    np.testing.assert_allclose(got, 0.5 * (x ** 2).sum(), rtol=1e-6)
+
+
+def test_dilation2d_same_strided_tf_padding():
+    # SAME + stride 2: TF pads from the output size (pad_total//2 on top),
+    # windows land at rows 0 and 2 for a 4x4 input with a 3x3 filter
+    r = np.random.RandomState(7)
+    x = r.rand(1, 4, 4, 1).astype(np.float32)
+    w = r.rand(3, 3, 1).astype(np.float32)
+    got = _convert_run(
+        [make_node("x", "Placeholder"),
+         make_node("w", "Const", tensor=w),
+         make_node("y", "Dilation2D", ["x", "w"],
+                   ints={"strides": [1, 2, 2, 1], "rates": [1, 1, 1, 1]},
+                   strs={"padding": "SAME"})], {"x": x}, ["y"])
+    # manual: pad_total = max((2-1)*2+3-4, 0) = 1 -> top 0, bottom 1
+    xp = np.full((1, 5, 5, 1), -np.inf, np.float32)
+    xp[:, :4, :4] = x
+    expect = np.zeros((1, 2, 2, 1), np.float32)
+    for oi in range(2):
+        for oj in range(2):
+            vals = [xp[0, oi*2+di, oj*2+dj, 0] + w[di, dj, 0]
+                    for di in range(3) for dj in range(3)
+                    if oi*2+di < 5 and oj*2+dj < 5]
+            expect[0, oi, oj, 0] = max(vals)
+    np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+def test_truncate_mod_preserves_int_dtype():
+    from bigdl_tpu.interop.tensorflow import load_graphdef
+    from bigdl_tpu.interop.tf_convert import to_module
+    import jax.numpy as jnp
+    g = load_graphdef(b"".join(
+        [make_node("a", "Placeholder"), make_node("b", "Placeholder"),
+         make_node("y", "TruncateMod", ["a", "b"])]))
+    mod, p, s, _ = to_module(g, inputs=["a", "b"], outputs=["y"])
+    out, _ = mod.apply(p, s, jnp.asarray([7, -7], jnp.int32),
+                       jnp.asarray([3, 3], jnp.int32))
+    assert out.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(out), [1, -1])
