@@ -3,4 +3,4 @@
 utils/ConvertModel.scala, pyspark/bigdl/contrib/onnx/; SURVEY.md §2.8)."""
 
 from bigdl_tpu.interop import (caffe, keras_loader, onnx, protowire,
-                               tensorflow, torchfile)
+                               tensorflow, tf_example, torchfile)

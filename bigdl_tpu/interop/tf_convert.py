@@ -115,7 +115,7 @@ _UNARY_OPS = {
     "Softplus": jax.nn.softplus, "Softsign": jax.nn.soft_sign,
     "Digamma": jax.scipy.special.digamma,
     "Lgamma": jax.scipy.special.gammaln,
-    "L2Loss": lambda x: 0.5 * jnp.sum(jnp.square(x)),
+    "L2Loss": lambda x: nn.ops.L2Loss().forward({}, x),
 }
 
 _BINARY_OPS = {
@@ -725,32 +725,10 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
                 f"Dilation2D {node.name}: non-const filter")
         strides = node.attr_ints("strides") or [1, 1, 1, 1]
         rates = node.attr_ints("rates") or [1, 1, 1, 1]
-        same = node.attr_str("padding", "SAME") == "SAME"
-        kh, kw, _ = w.shape
-
-        def dilate(x, w=jnp.asarray(w), sh=strides[1], sw=strides[2],
-                   rh=rates[1], rw=rates[2], same=same, kh=kh, kw=kw):
-            # morphological dilation: y = max_{di,dj}(x[..,i*s+di*r,..] + w)
-            ekh, ekw = (kh - 1) * rh + 1, (kw - 1) * rw + 1
-            if same:
-                # TF SAME: pad_total from the output size (ceil(in/s)),
-                # pad_top = pad_total//2 — NOT (ek-1)//2, which shifts
-                # windows when stride > 1
-                th = max((-(-x.shape[1] // sh) - 1) * sh + ekh - x.shape[1], 0)
-                tw = max((-(-x.shape[2] // sw) - 1) * sw + ekw - x.shape[2], 0)
-                x = jnp.pad(x, ((0, 0), (th // 2, th - th // 2),
-                                (tw // 2, tw - tw // 2), (0, 0)),
-                            constant_values=-jnp.inf)
-            oh = (x.shape[1] - ekh) // sh + 1
-            ow = (x.shape[2] - ekw) // sw + 1
-            out = None
-            for di in range(kh):
-                for dj in range(kw):
-                    sl = x[:, di * rh: di * rh + oh * sh: sh,
-                           dj * rw: dj * rw + ow * sw: sw, :] + w[di, dj]
-                    out = sl if out is None else jnp.maximum(out, sl)
-            return out
-        return mk(Lambda(dilate, "dilation2d"))
+        d2d = nn.ops.Dilation2D(strides, rates,
+                                node.attr_str("padding", "SAME"))
+        return mk(Lambda(lambda x, d=d2d, wc=jnp.asarray(w):
+                         d.forward({}, x, wc), "dilation2d"))
     if op in ("Conv3DBackpropInput", "Conv3DBackpropInputV2"):
         out_shape = _const_value(graph, node.inputs[0])
         w = _const_value(graph, node.inputs[1])

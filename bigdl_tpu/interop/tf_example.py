@@ -1,0 +1,120 @@
+"""tf.train.Example wire codec (reference: the TFRecord-of-Examples
+ingestion in utils/tf/{TFRecordInputFormat,TFRecordOutputFormat}.scala and
+the ParseExample/ParseSingleExample loaders, utils/tf/loaders/
+ParseExample.scala — there backed by the generated org/tensorflow/example
+protos; here a hand-rolled wire codec over interop/protowire like the rest
+of the importers).
+
+Schema (example.proto / feature.proto, public field numbers):
+  Example{1: Features}  Features{1: map<string, Feature>}
+  map entry{1: key, 2: value}  Feature{1: BytesList, 2: FloatList,
+  3: Int64List}  *List{1: repeated payload}
+
+Together with utils/recordio.py (TFRecord framing, CRC32C masked) this
+reads/writes files interchangeable with TF's tf.data TFRecordDataset of
+serialized Examples — the reference's on-disk interop format for both its
+TFRecord input format and its ImageNet seq-file flow.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Union
+
+import numpy as np
+
+from bigdl_tpu.interop import protowire as pw
+
+FeatureValue = Union[bytes, str, float, int, Sequence, np.ndarray]
+
+
+def _bytes_list(vals: List[bytes]) -> bytes:
+    return b"".join(pw.field_bytes(1, v) for v in vals)
+
+
+def _float_list(vals) -> bytes:
+    return pw.field_packed_floats(1, [float(v) for v in vals])
+
+
+_U64 = (1 << 64) - 1
+
+
+def _int64_list(vals) -> bytes:
+    # negative int64s go on the wire as 10-byte two's-complement varints
+    # (proto semantics); write_varint needs the masked non-negative form
+    return pw.field_packed_ints(1, [int(v) & _U64 for v in vals])
+
+
+def _sign64(v: int) -> int:
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _encode_feature(value: FeatureValue) -> bytes:
+    """One Feature message from a python value (type-dispatched like
+    tf.train.Feature construction)."""
+    if isinstance(value, bytes):
+        return pw.field_bytes(1, _bytes_list([value]))
+    if isinstance(value, str):
+        return pw.field_bytes(1, _bytes_list([value.encode()]))
+    if isinstance(value, (int, np.integer)):
+        return pw.field_bytes(3, _int64_list([value]))
+    if isinstance(value, (float, np.floating)):
+        return pw.field_bytes(2, _float_list([value]))
+    arr = np.asarray(value)
+    if arr.dtype.kind in "iu":
+        return pw.field_bytes(3, _int64_list(arr.reshape(-1)))
+    if arr.dtype.kind == "f":
+        return pw.field_bytes(2, _float_list(arr.reshape(-1)))
+    if arr.dtype.kind in "SU" or arr.dtype == object:
+        items = [v if isinstance(v, bytes) else str(v).encode()
+                 for v in arr.reshape(-1)]
+        return pw.field_bytes(1, _bytes_list(items))
+    raise TypeError(f"unsupported feature value dtype {arr.dtype}")
+
+
+def encode_example(features: Dict[str, FeatureValue]) -> bytes:
+    """dict → serialized tf.train.Example bytes."""
+    body = b""
+    for key, value in features.items():
+        entry = pw.field_str(1, key) + \
+            pw.field_bytes(2, _encode_feature(value))
+        body += pw.field_bytes(1, entry)               # Features.feature map
+    return pw.field_bytes(1, body)                     # Example.features
+
+
+def decode_example(buf: bytes) -> Dict[str, Union[List[bytes], np.ndarray]]:
+    """Serialized Example → {name: np.ndarray (int64/float32) or
+    [bytes, ...]} — the ParseSingleExample output surface."""
+    out: Dict[str, Union[List[bytes], np.ndarray]] = {}
+    features = pw.Msg(buf).msg(1)
+    for entry in features.msgs(1):
+        key = entry.str(1)
+        feat = entry.msg(2)
+        if feat.has(1):                                # BytesList
+            out[key] = feat.msg(1)._vals(1)
+        elif feat.has(2):                              # FloatList
+            out[key] = np.asarray(feat.msg(2).floats(1), np.float32)
+        elif feat.has(3):                              # Int64List
+            out[key] = np.asarray([_sign64(v) for v in feat.msg(3).ints(1)],
+                                  np.int64)
+        else:
+            out[key] = []
+    return out
+
+
+def write_example_file(path: str, examples) -> int:
+    """Write an iterable of feature-dicts as a TFRecord file of Examples.
+    Returns the record count."""
+    from bigdl_tpu.utils.recordio import RecordWriter
+    n = 0
+    with RecordWriter(path) as w:
+        for ex in examples:
+            w.write(encode_example(ex))
+            n += 1
+    return n
+
+
+def read_example_file(path: str):
+    """Yield decoded feature-dicts from a TFRecord file of Examples."""
+    from bigdl_tpu.utils.recordio import RecordReader
+    for payload in RecordReader(path):
+        yield decode_example(payload)

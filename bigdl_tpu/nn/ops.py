@@ -481,3 +481,361 @@ class TensorArrayConcat(Operation):
 
     def forward(self, params, ta, **_):
         return ta.reshape((-1,) + ta.shape[2:])
+
+
+# --------------------------------------------------------- numeric tail
+FloorMod = _binary("FloorMod", jnp.mod)
+TruncateDiv = _binary("TruncateDiv",
+                      lambda a, b: jnp.trunc(a / b).astype(a.dtype))
+TruncateMod = _binary("TruncateMod", jnp.fmod)
+Inv = _unary("Inv", lambda x: 1.0 / x)
+Rint = _unary("Rint", jnp.round)
+
+
+class L2Loss(Operation):
+    """sum(x^2)/2 (reference: nn/ops/L2Loss.scala)."""
+
+    def forward(self, params, x, **_):
+        return 0.5 * jnp.sum(jnp.square(x))
+
+
+class ApproximateEqual(Operation):
+    """|a - b| < tolerance (reference: nn/ops/ApproximateEqual.scala)."""
+
+    def __init__(self, tolerance: float = 1e-5, name=None):
+        super().__init__(name)
+        self.tolerance = tolerance
+
+    def forward(self, params, a, b=None, **_):
+        if b is None:
+            a, b = a
+        return jnp.abs(a - b) < self.tolerance
+
+
+class Compare(Operation):
+    """Elementwise comparison by operator name (reference:
+    nn/ops/Compare.scala — the base of Greater/Less/Equal...)."""
+
+    _OPS = {"gt": jnp.greater, "ge": jnp.greater_equal, "lt": jnp.less,
+            "le": jnp.less_equal, "eq": jnp.equal, "ne": jnp.not_equal}
+
+    def __init__(self, op: str, name=None):
+        super().__init__(name)
+        self._fn = self._OPS[op]
+
+    def forward(self, params, a, b=None, **_):
+        if b is None:
+            a, b = a
+        return self._fn(a, b)
+
+
+class SegmentSum(Operation):
+    """(data, segment_ids) → per-segment sums; num_segments is static
+    (reference: nn/ops/SegmentSum.scala — XLA needs the output shape)."""
+
+    def __init__(self, num_segments: int, name=None):
+        super().__init__(name)
+        self.num_segments = num_segments
+
+    def forward(self, params, data, segment_ids=None, **_):
+        if segment_ids is None:
+            data, segment_ids = data
+        return jax.ops.segment_sum(data,
+                                   jnp.asarray(segment_ids, jnp.int32),
+                                   num_segments=self.num_segments)
+
+
+class CrossEntropy(Operation):
+    """(logits, one-hot labels) → per-row softmax cross-entropy
+    (reference: nn/ops/CrossEntropy.scala)."""
+
+    def forward(self, params, logits, labels=None, **_):
+        if labels is None:
+            logits, labels = logits
+        return -jnp.sum(labels * jax.nn.log_softmax(logits, -1), axis=-1)
+
+
+class RangeOps(Operation):
+    """[start, limit, delta] (static scalars) → arange tensor
+    (reference: nn/ops/RangeOps.scala)."""
+
+    def __init__(self, start, limit, delta=1, name=None):
+        super().__init__(name)
+        self.start, self.limit, self.delta = start, limit, delta
+
+    def forward(self, params, *_, **__):
+        return jnp.arange(self.start, self.limit, self.delta)
+
+
+class DepthwiseConv2D(Operation):
+    """(x NHWC, filter (kh, kw, cin, mult)) → depthwise conv, forward-only
+    (reference: nn/ops/DepthwiseConv2D.scala)."""
+
+    def __init__(self, stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = -1, pad_h: int = -1, name=None):
+        super().__init__(name)
+        self.sw, self.sh, self.pw, self.ph = stride_w, stride_h, pad_w, pad_h
+
+    def forward(self, params, x, w=None, **_):
+        if w is None:
+            x, w = x
+        kh, kw, cin, mult = w.shape
+        pad = "SAME" if (self.pw < 0 or self.ph < 0) else \
+            [(self.ph, self.ph), (self.pw, self.pw)]
+        return lax.conv_general_dilated(
+            x, w.reshape(kh, kw, 1, cin * mult), (self.sh, self.sw), pad,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=cin)
+
+
+class Dilation2D(Operation):
+    """(x NHWC, filter (kh, kw, c)) → morphological dilation with TF SAME
+    padding (reference: nn/ops/Dilation2D.scala)."""
+
+    def __init__(self, strides=(1, 1, 1, 1), rates=(1, 1, 1, 1),
+                 padding: str = "SAME", name=None):
+        super().__init__(name)
+        self.strides, self.rates = tuple(strides), tuple(rates)
+        self.padding = padding
+
+    def forward(self, params, x, w=None, **_):
+        if w is None:
+            x, w = x
+        kh, kw, _ = w.shape
+        sh, sw = self.strides[1], self.strides[2]
+        rh, rw = self.rates[1], self.rates[2]
+        ekh, ekw = (kh - 1) * rh + 1, (kw - 1) * rw + 1
+        if self.padding == "SAME":
+            th = max((-(-x.shape[1] // sh) - 1) * sh + ekh - x.shape[1], 0)
+            tw = max((-(-x.shape[2] // sw) - 1) * sw + ekw - x.shape[2], 0)
+            x = jnp.pad(x, ((0, 0), (th // 2, th - th // 2),
+                            (tw // 2, tw - tw // 2), (0, 0)),
+                        constant_values=-jnp.inf)
+        oh = (x.shape[1] - ekh) // sh + 1
+        ow = (x.shape[2] - ekw) // sw + 1
+        out = None
+        for di in range(kh):
+            for dj in range(kw):
+                sl = x[:, di * rh: di * rh + oh * sh: sh,
+                       dj * rw: dj * rw + ow * sw: sw, :] + w[di, dj]
+                out = sl if out is None else jnp.maximum(out, sl)
+        return out
+
+
+# ---------------------------------------------- feature-column ops
+# The reference's TF feature-column family (nn/ops/{BucketizedCol,
+# CategoricalColVocaList, CrossCol, IndicatorCol, Kv2Tensor, MkString,
+# Substr}.scala). String handling is host-side by design — strings never
+# reach the device; the dense/int outputs are what feeds jitted programs.
+class BucketizedCol(Operation):
+    """Numeric column → bucket index by boundary list (reference:
+    nn/ops/BucketizedCol.scala). Jittable (searchsorted)."""
+
+    def __init__(self, boundaries: Sequence[float], name=None):
+        super().__init__(name)
+        assert len(boundaries) >= 1, "need at least one boundary"
+        self.boundaries = jnp.asarray(sorted(boundaries), jnp.float32)
+
+    def forward(self, params, x, **_):
+        return jnp.searchsorted(self.boundaries, x, side="right") \
+            .astype(jnp.int32)
+
+
+class CategoricalColVocaList(Operation):
+    """String column → vocabulary ids (reference:
+    nn/ops/CategoricalColVocaList.scala). Host-side; each row may hold a
+    delimiter-joined list. Unknown words map to vocab_len + hash % oov
+    buckets (or default id vocab_len when is_set_default)."""
+
+    def __init__(self, vocab: Sequence[str], str_delimiter: str = ",",
+                 is_set_default: bool = False, num_oov_buckets: int = 0,
+                 name=None):
+        super().__init__(name)
+        self.vocab = {w: i for i, w in enumerate(vocab)}
+        self.delim = str_delimiter
+        self.is_set_default = is_set_default
+        self.num_oov = num_oov_buckets
+
+    def _lookup(self, w: str):
+        import zlib
+        if w in self.vocab:
+            return self.vocab[w]
+        if self.num_oov > 0:
+            return len(self.vocab) + zlib.crc32(w.encode()) % self.num_oov
+        if self.is_set_default:
+            return len(self.vocab)
+        return -1                                    # dropped
+    def forward(self, params, rows, **_):
+        out = []
+        for row in rows:
+            ids = [self._lookup(w) for w in str(row).split(self.delim)]
+            out.append([i for i in ids if i >= 0])
+        width = max((len(r) for r in out), default=1) or 1
+        padded = [r + [-1] * (width - len(r)) for r in out]
+        return jnp.asarray(padded, jnp.int32)
+
+
+class CrossCol(Operation):
+    """Cross of several string columns → hashed bucket ids (reference:
+    nn/ops/CrossCol.scala — cartesian product of per-column token lists,
+    hashed into hash_bucket_size). Host-side."""
+
+    def __init__(self, hash_bucket_size: int, str_delimiter: str = ",",
+                 name=None):
+        super().__init__(name)
+        self.n = hash_bucket_size
+        self.delim = str_delimiter
+
+    def forward(self, params, *cols, **_):
+        import itertools
+        import zlib
+        if len(cols) == 1 and isinstance(cols[0], (tuple, list)) \
+                and isinstance(cols[0][0], (tuple, list)):
+            cols = tuple(cols[0])
+        rows = len(cols[0])
+        out = []
+        for r in range(rows):
+            tokens = [str(c[r]).split(self.delim) for c in cols]
+            out.append([zlib.crc32("_X_".join(combo).encode()) % self.n
+                        for combo in itertools.product(*tokens)])
+        width = max((len(r) for r in out), default=1) or 1
+        return jnp.asarray([r + [-1] * (width - len(r)) for r in out],
+                           jnp.int32).reshape(rows, width)
+
+
+class IndicatorCol(Operation):
+    """Padded id lists (B, K) int32 (-1 = pad) → multi-hot / count vector
+    (B, fea_len) (reference: nn/ops/IndicatorCol.scala). Jittable."""
+
+    def __init__(self, fea_len: int, is_count: bool = True, name=None):
+        super().__init__(name)
+        self.fea_len = fea_len
+        self.is_count = is_count
+
+    def forward(self, params, ids, **_):
+        ids = jnp.asarray(ids, jnp.int32)
+        oh = jax.nn.one_hot(ids, self.fea_len, dtype=jnp.float32)
+        counts = jnp.sum(oh, axis=-2)                # pads one_hot to 0
+        return counts if self.is_count else jnp.minimum(counts, 1.0)
+
+
+class Kv2Tensor(Operation):
+    """"k:v,k:v" string rows → dense (B, n_cols) tensor (reference:
+    nn/ops/Kv2Tensor.scala). Host-side."""
+
+    def __init__(self, kv_delimiter: str = ",", item_delimiter: str = ":",
+                 n_cols: int = 0, name=None):
+        super().__init__(name)
+        self.kv_delim = kv_delimiter
+        self.item_delim = item_delimiter
+        self.n_cols = n_cols
+
+    def forward(self, params, rows, **_):
+        import numpy as np
+        parsed = []
+        width = self.n_cols
+        for row in rows:
+            kv = {}
+            for item in str(row).split(self.kv_delim):
+                if not item:
+                    continue
+                k, _, v = item.partition(self.item_delim)
+                kv[int(k)] = float(v)
+            parsed.append(kv)
+            if not self.n_cols and kv:
+                width = max(width, max(kv) + 1)
+        out = np.zeros((len(parsed), width), np.float32)
+        for i, kv in enumerate(parsed):
+            for k, v in kv.items():
+                if k < width:
+                    out[i, k] = v
+        return jnp.asarray(out)
+
+
+class MkString(Operation):
+    """Tensor rows → delimiter-joined strings (reference:
+    nn/ops/MkString.scala). Host-side; returns a python list."""
+
+    def __init__(self, str_delimiter: str = ",", name=None):
+        super().__init__(name)
+        self.delim = str_delimiter
+
+    def forward(self, params, x, **_):
+        import numpy as np
+        arr = np.asarray(x)
+        fmt = (lambda v: str(int(v))) if arr.dtype.kind in "iu" else str
+        return [self.delim.join(fmt(v) for v in row) for row in arr]
+
+
+class Substr(Operation):
+    """String rows → substring [pos, pos+len) (reference:
+    utils/tf/loaders/Substr.scala semantics). Host-side."""
+
+    def __init__(self, pos: int = 0, length: int = -1, name=None):
+        super().__init__(name)
+        self.pos, self.length = pos, length
+
+    def forward(self, params, rows, **_):
+        end = None if self.length < 0 else self.pos + self.length
+        return [str(r)[self.pos:end] for r in rows]
+
+
+# ------------------------------------------------------------- adapters
+class TensorOp(Operation):
+    """Chainable tensor transformer (reference: nn/ops/TensorOp.scala —
+    composed pure functions as one forward-only op)."""
+
+    def __init__(self, fn=None, name=None):
+        super().__init__(name)
+        self._fn = fn or (lambda x: x)
+
+    def forward(self, params, x, **_):
+        return self._fn(x)
+
+    def then(self, other) -> "TensorOp":
+        g = other._fn if isinstance(other, TensorOp) else other
+        return TensorOp(lambda x, f=self._fn, g=g: g(f(x)))
+
+    @staticmethod
+    def exp():
+        return TensorOp(jnp.exp)
+
+    @staticmethod
+    def log():
+        return TensorOp(jnp.log)
+
+    @staticmethod
+    def sqrt():
+        return TensorOp(jnp.sqrt)
+
+    @staticmethod
+    def abs():
+        return TensorOp(jnp.abs)
+
+
+class ModuleToOperation(Operation):
+    """Wrap any module as a forward-only op (reference:
+    nn/ops/ModuleToOperation.scala). Delegates through apply() so
+    stateful/_apply-only modules (BatchNorm, Dropout...) work and
+    training/rng thread through."""
+
+    def __init__(self, module, name=None):
+        super().__init__(name)
+        self.add_child("m", module)
+
+    def _apply(self, params, state, *xs, training=False, rng=None):
+        out, ns = self.children()["m"].apply(
+            params.get("m", {}), state.get("m", {}), *xs,
+            training=training, rng=rng)
+        return out, {**state, "m": ns}
+
+    def forward(self, params, *xs, training=False, rng=None):
+        # convenience for stateless wrapped modules
+        out, _ = self._apply(params, {"m": {}}, *xs, training=training,
+                             rng=rng)
+        return out
+
+
+# re-export: the layer implementation already has TF semantics
+# (reference: nn/ops/ResizeBilinearOps.scala wraps nn/ResizeBilinear.scala)
+from bigdl_tpu.nn.shape_ops import ResizeBilinear  # noqa: E402,F401

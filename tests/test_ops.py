@@ -119,3 +119,139 @@ def test_hash_bucket_covers_large_spaces():
 def test_gemm_table_without_c():
     out = _run(ops.Gemm(alpha=2.0), (jnp.ones((2, 3)), jnp.ones((3, 4))))
     np.testing.assert_allclose(out, 6.0)
+
+
+# ------------------------------------------- feature columns + op tail
+def test_bucketized_col():
+    import bigdl_tpu.nn.ops as ops
+    op = ops.BucketizedCol([0.0, 10.0, 100.0])
+    out = op.forward({}, jnp.asarray([[-1.0, 5.0], [10.0, 250.0]]))
+    np.testing.assert_array_equal(np.asarray(out), [[0, 1], [2, 3]])
+
+
+def test_categorical_col_voca_list():
+    import bigdl_tpu.nn.ops as ops
+    op = ops.CategoricalColVocaList(["alpha", "beta", "gamma"],
+                                    num_oov_buckets=2)
+    out = np.asarray(op.forward({}, ["beta,alpha", "zzz", "gamma"]))
+    assert out.shape[0] == 3
+    assert list(out[0][:2]) == [1, 0]
+    assert 3 <= out[1][0] < 5            # oov bucket
+    assert out[2][0] == 2
+    # dropped when no oov and no default
+    op2 = ops.CategoricalColVocaList(["a"], is_set_default=True)
+    out2 = np.asarray(op2.forward({}, ["b"]))
+    assert out2[0][0] == 1               # default id = vocab len
+
+
+def test_cross_col_and_indicator():
+    import bigdl_tpu.nn.ops as ops
+    cross = ops.CrossCol(hash_bucket_size=50)
+    out = np.asarray(cross.forward({}, ["a,b", "c"], ["x", "y"]))
+    assert out.shape == (2, 2)           # row0: a_X_x, b_X_x; row1: c_X_y pad
+    assert (out[0] >= 0).all() and out[1][1] == -1
+    ind = ops.IndicatorCol(fea_len=5, is_count=True)
+    multi = ind.forward({}, jnp.asarray([[1, 1, -1], [4, 2, 0]]))
+    np.testing.assert_allclose(np.asarray(multi),
+                               [[0, 2, 0, 0, 0], [1, 0, 1, 0, 1]])
+    ind2 = ops.IndicatorCol(fea_len=5, is_count=False)
+    np.testing.assert_allclose(
+        np.asarray(ind2.forward({}, jnp.asarray([[1, 1, -1]])))[0],
+        [0, 1, 0, 0, 0])
+
+
+def test_kv2tensor_mkstring_substr():
+    import bigdl_tpu.nn.ops as ops
+    kv = ops.Kv2Tensor(n_cols=4)
+    out = np.asarray(kv.forward({}, ["0:1.5,2:3.0", "1:2.0"]))
+    np.testing.assert_allclose(out, [[1.5, 0, 3.0, 0], [0, 2.0, 0, 0]])
+    mk = ops.MkString("|")
+    assert mk.forward({}, np.asarray([[1, 2], [3, 4]])) == ["1|2", "3|4"]
+    sub = ops.Substr(1, 2)
+    assert sub.forward({}, ["hello", "ab"]) == ["el", "b"]
+
+
+def test_tensor_op_chain_and_module_to_operation():
+    import bigdl_tpu.nn.ops as ops
+    chain = ops.TensorOp.exp().then(ops.TensorOp.log())
+    x = jnp.asarray([1.0, 2.0, 3.0])
+    np.testing.assert_allclose(np.asarray(chain.forward({}, x)),
+                               np.asarray(x), rtol=1e-6)
+    import bigdl_tpu.nn as nn
+    m2o = ops.ModuleToOperation(nn.ReLU())
+    np.testing.assert_allclose(
+        np.asarray(m2o.forward({}, jnp.asarray([-1.0, 2.0]))), [0.0, 2.0])
+
+
+def test_numeric_tail_ops():
+    import bigdl_tpu.nn.ops as ops
+    a = jnp.asarray([7.0, -7.0])
+    b = jnp.asarray([3.0, 3.0])
+    np.testing.assert_allclose(
+        np.asarray(ops.TruncateMod().forward({}, a, b)), [1.0, -1.0])
+    np.testing.assert_allclose(
+        np.asarray(ops.FloorMod().forward({}, a, b)), [1.0, 2.0])
+    assert float(ops.L2Loss().forward({}, jnp.asarray([3.0, 4.0]))) == 12.5
+    np.testing.assert_array_equal(
+        np.asarray(ops.ApproximateEqual(0.5).forward(
+            {}, jnp.asarray([1.0]), jnp.asarray([1.2]))), [True])
+    np.testing.assert_array_equal(
+        np.asarray(ops.Compare("ge").forward(
+            {}, jnp.asarray([1.0, 2.0]), jnp.asarray([2.0, 2.0]))),
+        [False, True])
+    seg = ops.SegmentSum(2)
+    np.testing.assert_allclose(
+        np.asarray(seg.forward({}, jnp.arange(8.0).reshape(4, 2),
+                               jnp.asarray([0, 0, 1, 1]))),
+        [[2, 4], [10, 12]])
+    np.testing.assert_allclose(
+        np.asarray(ops.RangeOps(1, 7, 2).forward({})), [1, 3, 5])
+    xe = ops.CrossEntropy()
+    logits = jnp.asarray([[2.0, 0.0]])
+    labels = jnp.asarray([[1.0, 0.0]])
+    want = -np.log(np.exp(2) / (np.exp(2) + 1))
+    np.testing.assert_allclose(np.asarray(xe.forward({}, logits, labels)),
+                               [want], rtol=1e-6)
+
+
+def test_depthwise_and_dilation_ops():
+    import bigdl_tpu.nn.ops as ops
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.rand(1, 6, 6, 2).astype(np.float32))
+    w = jnp.asarray(r.rand(3, 3, 2, 1).astype(np.float32))
+    out = ops.DepthwiseConv2D().forward({}, x, w)
+    assert out.shape == (1, 6, 6, 2)     # SAME, stride 1, mult 1
+    d = ops.Dilation2D(padding="VALID")
+    wd = jnp.asarray(r.rand(2, 2, 2).astype(np.float32))
+    out2 = d.forward({}, x, wd)
+    assert out2.shape == (1, 5, 5, 2)
+    # dilation of a constant image = const + max(filter)
+    xc = jnp.ones((1, 4, 4, 1))
+    wc = jnp.asarray([[[0.1], [0.4]], [[0.2], [0.3]]])
+    np.testing.assert_allclose(
+        np.asarray(ops.Dilation2D(padding="VALID").forward({}, xc, wc)),
+        np.full((1, 3, 3, 1), 1.4, np.float32), rtol=1e-6)
+
+
+def test_module_to_operation_stateful_and_empty_crosscol():
+    import bigdl_tpu.nn.ops as ops
+    import bigdl_tpu.nn as nn
+    m2o = ops.ModuleToOperation(nn.BatchNormalization(4))
+    params, state = m2o.init(jax.random.PRNGKey(0))
+    x = jnp.ones((2, 4))
+    out, ns = m2o.apply(params, state, x, training=False)
+    assert out.shape == (2, 4) and "m" in ns
+    # empty batch: CrossCol returns (0, 1), no crash
+    empty = ops.CrossCol(10).forward({}, [], [])
+    assert empty.shape == (0, 1)
+
+
+def test_depthwise_pad_gate():
+    import bigdl_tpu.nn.ops as ops
+    r = np.random.RandomState(1)
+    x = jnp.asarray(r.rand(1, 5, 5, 2).astype(np.float32))
+    w = jnp.asarray(r.rand(3, 3, 2, 1).astype(np.float32))
+    # pad_w explicit but pad_h default: must fall back to SAME, never
+    # negative padding
+    out = ops.DepthwiseConv2D(pad_w=1).forward({}, x, w)
+    assert out.shape == (1, 5, 5, 2)
