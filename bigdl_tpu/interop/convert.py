@@ -77,9 +77,14 @@ def convert(input_path: str, output_path: str, module_path: str = None):
             from bigdl_tpu.interop import torchfile
             params = _table_to_params(torchfile.load(input_path), params)
 
-    if dst in ("onnx", "tf"):
-        raise ValueError(f"{dst} is an import-only format (like the "
-                         f"reference's onnx_loader / TensorflowLoader)")
+    if dst == "onnx":
+        raise ValueError("onnx is an import-only format (like the "
+                         "reference's onnx_loader)")
+    if dst == "tf":
+        from bigdl_tpu.interop.tf_saver import save_model as save_tf
+        save_tf(output_path, module, params, state)
+        print(f"converted {input_path} ({src}) -> {output_path} (tf)")
+        return
     if dst == "bigdl":
         save_module(output_path, module, params, state)
     elif dst == "caffe":

@@ -385,7 +385,7 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
         return mk(nn.Unsqueeze(int(np.asarray(axis))))
     if op == "ConcatV2":
         axis = _const_value(graph, node.inputs[-1])
-        return mk(nn.JoinTable(int(np.asarray(axis))))
+        return mk(nn.JoinTable(int(np.asarray(axis).reshape(()))))
     if op == "Mean":
         axes = const(1)
         if axes is None:

@@ -60,7 +60,7 @@ def _encode_feature(value: FeatureValue) -> bytes:
     if isinstance(value, (float, np.floating)):
         return pw.field_bytes(2, _float_list([value]))
     arr = np.asarray(value)
-    if arr.dtype.kind in "iu":
+    if arr.dtype.kind in "iub":        # bools ride Int64List, as in TF
         return pw.field_bytes(3, _int64_list(arr.reshape(-1)))
     if arr.dtype.kind == "f":
         return pw.field_bytes(2, _float_list(arr.reshape(-1)))

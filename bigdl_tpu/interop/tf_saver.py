@@ -1,0 +1,246 @@
+"""Export a model to a frozen TensorFlow GraphDef (reference:
+utils/tf/TensorflowSaver.scala — per-layer `saveGraph` emitting NodeDefs;
+here the same idea over interop/tensorflow.make_node).
+
+Weights are frozen into Const nodes (the reference saves frozen inference
+graphs too). The exported bytes re-import through our own converter
+(interop/tf_convert.load_model) and through any stock GraphDef reader —
+NHWC layouts match TF natively, so no transposes are inserted.
+
+Supported vocabulary: the zoo models' layer set (Linear, Conv2D, BN,
+pooling, activations, reshape/concat/add, dropout-as-identity, LRN,
+global average pooling). Unsupported layers raise with the layer name,
+mirroring TensorflowSaver's unsupported-layer error.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.core.container import Graph, Input, Sequential
+from bigdl_tpu.core.module import Module
+from bigdl_tpu.interop.tensorflow import make_node
+
+import bigdl_tpu.nn as nn
+
+
+class _Emitter:
+    def __init__(self):
+        self.nodes: List[bytes] = []
+        self._used = set()
+
+    def fresh(self, base: str) -> str:
+        name, i = base, 1
+        while name in self._used:
+            name, i = f"{base}_{i}", i + 1
+        self._used.add(name)
+        return name
+
+    def emit(self, name: str, op: str, inputs: Sequence[str] = (), **kw):
+        self.nodes.append(make_node(name, op, inputs, **kw))
+        return name
+
+    def const(self, base: str, arr) -> str:
+        return self.emit(self.fresh(base), "Const",
+                         tensor=np.asarray(arr))
+
+
+def _same_or_pads(e: _Emitter, x: str, ph: int, pw: int) -> (str, str):
+    """Return (input name, padding attr). Explicit pads become a Pad node
+    (TF has no per-side conv padding attr)."""
+    if ph == -1 or pw == -1:
+        return x, "SAME"
+    if ph == 0 and pw == 0:
+        return x, "VALID"
+    pads = e.const("paddings", np.asarray(
+        [[0, 0], [ph, ph], [pw, pw], [0, 0]], np.int32))
+    return e.emit(e.fresh("pad"), "Pad", [x, pads]), "VALID"
+
+
+def _emit_layer(e: _Emitter, m: Module, params: Dict, state: Dict,
+                ins: List[str]) -> str:
+    """One module → NodeDef(s); returns the output node name."""
+    x = ins[0] if ins else None
+    nm = lambda base: e.fresh(base)
+
+    if isinstance(m, nn.Linear):
+        w = e.const("weight", params["weight"])
+        out = e.emit(nm("matmul"), "MatMul", [x, w])
+        if m.bias:
+            b = e.const("bias", params["bias"])
+            out = e.emit(nm("bias_add"), "BiasAdd", [out, b])
+        return out
+    if isinstance(m, nn.SpatialConvolution) and type(m) in (
+            nn.SpatialConvolution, nn.SpatialShareConvolution):
+        if m.groups != 1:
+            raise NotImplementedError(
+                "TF export: grouped SpatialConvolution (use "
+                "DepthwiseConv2dNative manually)")
+        x2, pad = _same_or_pads(e, x, m.ph, m.pw)
+        w = e.const("filter", params["weight"])
+        out = e.emit(nm("conv2d"), "Conv2D", [x2, w],
+                     ints={"strides": [1, m.sh, m.sw, 1]},
+                     strs={"padding": pad})
+        if m.bias:
+            b = e.const("bias", params["bias"])
+            out = e.emit(nm("bias_add"), "BiasAdd", [out, b])
+        return out
+    if isinstance(m, nn.BatchNormalization):     # covers Spatial subclass
+        scale = e.const("gamma", params["weight"] if m.affine
+                        else np.ones(m.n_output, np.float32))
+        offset = e.const("beta", params["bias"] if m.affine
+                         else np.zeros(m.n_output, np.float32))
+        mean = e.const("moving_mean", state["running_mean"])
+        var = e.const("moving_variance", state["running_var"])
+        return e.emit(nm("batchnorm"), "FusedBatchNorm",
+                      [x, scale, offset, mean, var],
+                      scalars={"epsilon": float(m.eps)})
+    if isinstance(m, nn.SpatialMaxPooling) or \
+            isinstance(m, nn.SpatialAveragePooling):
+        op = "MaxPool" if isinstance(m, nn.SpatialMaxPooling) else "AvgPool"
+        x2, pad = _same_or_pads(e, x, m.ph, m.pw)
+        return e.emit(nm(op.lower()), op, [x2],
+                      ints={"ksize": [1, m.kh, m.kw, 1],
+                            "strides": [1, m.dh, m.dw, 1]},
+                      strs={"padding": pad})
+    _UNARY = {nn.ReLU: "Relu", nn.ReLU6: "Relu6", nn.Sigmoid: "Sigmoid",
+              nn.Tanh: "Tanh", nn.ELU: "Elu", nn.SELU: "Selu",
+              nn.SoftPlus: "Softplus", nn.SoftSign: "Softsign"}
+    for cls, op in _UNARY.items():
+        if type(m) is cls:
+            return e.emit(nm(op.lower()), op, [x])
+    if isinstance(m, nn.SoftMax):
+        return e.emit(nm("softmax"), "Softmax", [x])
+    if isinstance(m, nn.LogSoftMax):
+        return e.emit(nm("log_softmax"), "LogSoftmax", [x])
+    if isinstance(m, nn.Dropout):
+        return x                                  # inference export
+    if isinstance(m, nn.Flatten):
+        # needs the static feature count — handled by the sequential
+        # walker via example_input (_emit_flatten)
+        raise NotImplementedError(
+            "TF export: Flatten outside a Sequential with example_input")
+    if isinstance(m, nn.JoinTable):
+        axis = e.const("axis", np.asarray(m.axis, np.int32))
+        return e.emit(nm("concat"), "ConcatV2", ins + [axis])
+    if isinstance(m, nn.CAddTable):
+        if len(ins) == 2:
+            return e.emit(nm("add"), "Add", ins)
+        return e.emit(nm("add_n"), "AddN", ins)
+    if isinstance(m, nn.CMulTable):
+        return e.emit(nm("mul"), "Mul", ins)
+    if isinstance(m, nn.SpatialCrossMapLRN):
+        # TF alpha is per-element; ours follows torch (alpha/size applied)
+        return e.emit(nm("lrn"), "LRN", [x],
+                      scalars={"depth_radius": (m.size - 1) // 2,
+                               "alpha": float(m.alpha) / m.size,
+                               "beta": float(m.beta),
+                               "bias": float(m.k)})
+    if isinstance(m, nn.GlobalAveragePooling2D):
+        axes = e.const("axes", np.asarray([1, 2], np.int32))
+        return e.emit(nm("mean"), "Mean", [x, axes],
+                      scalars={"keep_dims": False})
+    if isinstance(m, nn.Identity):
+        return x
+    raise NotImplementedError(
+        f"TF export: no NodeDef emitter for {type(m).__name__} "
+        f"(reference: utils/tf/TensorflowSaver.scala unsupported-layer)")
+
+
+def _emit_flatten(e: _Emitter, x: str, n_features: int) -> str:
+    shape = e.const("shape", np.asarray([-1, n_features], np.int32))
+    return e.emit(e.fresh("reshape"), "Reshape", [x, shape])
+
+
+def save_graphdef(module: Module, params: Dict, state: Dict,
+                  input_names: Optional[Sequence[str]] = None,
+                  example_input=None) -> bytes:
+    """Model → frozen GraphDef bytes.
+
+    `example_input` (a numpy/jax array or tuple) is required when the model
+    contains shape-dependent layers (Flatten/Reshape) — it is traced
+    host-side to recover static feature counts, the way the reference's
+    saver takes an input shape argument.
+    """
+    seq: List[Module]
+    if isinstance(module, Sequential):
+        seq = [module[i] for i in range(len(module))]
+        return _save_sequential(seq, params, state, input_names,
+                                example_input)
+    if isinstance(module, Graph):
+        return _save_graph(module, params, state, input_names)
+    # single layer
+    return _save_sequential([module], {"0": params} if "weight" in params
+                            else params, state, input_names, example_input)
+
+
+def _shapes_along(seq, params, state, example_input):
+    """Host-trace the sequential to learn each intermediate shape."""
+    shapes = []
+    if example_input is None:
+        return None
+    import jax
+    x = example_input
+    for i, m in enumerate(seq):
+        shapes.append(np.asarray(x).shape if not isinstance(x, tuple)
+                      else None)
+        x, _ = m.apply(params.get(str(i), {}), state.get(str(i), {}), x)
+    shapes.append(np.asarray(x).shape)
+    return shapes
+
+
+def _save_sequential(seq, params, state, input_names, example_input):
+    e = _Emitter()
+    inp = (input_names or ["input"])[0]
+    e._used.add(inp)
+    e.emit(inp, "Placeholder")
+    shapes = _shapes_along(seq, params, state, example_input)
+    cur = inp
+    for i, m in enumerate(seq):
+        p = params.get(str(i), {})
+        s = state.get(str(i), {})
+        if isinstance(m, nn.Flatten):
+            if shapes is None:
+                raise ValueError("TF export of Flatten needs example_input "
+                                 "to fix the feature count")
+            n_features = int(np.prod(shapes[i][1:]))
+            cur = _emit_flatten(e, cur, n_features)
+            continue
+        if isinstance(m, nn.Reshape):
+            tgt = ([-1] + list(m.size)) if m.batch_mode else list(m.size)
+            shape = e.const("shape", np.asarray(tgt, np.int32))
+            cur = e.emit(e.fresh("reshape"), "Reshape", [cur, shape])
+            continue
+        cur = _emit_layer(e, m, p, s, [cur])
+    return b"".join(e.nodes)
+
+
+def _save_graph(g: Graph, params, state, input_names):
+    e = _Emitter()
+    names: Dict[int, str] = {}
+    wanted = list(input_names or [])
+    for i, node in enumerate(g.input_nodes):
+        nm = wanted[i] if i < len(wanted) else f"input_{i}"
+        e._used.add(nm)
+        e.emit(nm, "Placeholder")
+        names[id(node)] = nm
+    for node in g._order:
+        if node.module is None:
+            continue
+        key = g._node_key[id(node)]
+        ins = [names[id(p)] for p in node.parents]
+        if isinstance(node.module, nn.Flatten):
+            raise ValueError("TF export of Flatten inside Graph is not "
+                             "supported — use Reshape with explicit size")
+        names[id(node)] = _emit_layer(e, node.module, params.get(key, {}),
+                                      state.get(key, {}), ins)
+    return b"".join(e.nodes)
+
+
+def save_model(path: str, module: Module, params: Dict, state: Dict,
+               **kw) -> None:
+    """Write a frozen GraphDef .pb file."""
+    with open(path, "wb") as fh:
+        fh.write(save_graphdef(module, params, state, **kw))

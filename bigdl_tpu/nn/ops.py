@@ -690,7 +690,7 @@ class CrossCol(Operation):
         import itertools
         import zlib
         if len(cols) == 1 and isinstance(cols[0], (tuple, list)) \
-                and isinstance(cols[0][0], (tuple, list)):
+                and cols[0] and isinstance(cols[0][0], (tuple, list)):
             cols = tuple(cols[0])
         rows = len(cols[0])
         out = []
@@ -747,7 +747,7 @@ class Kv2Tensor(Operation):
         out = np.zeros((len(parsed), width), np.float32)
         for i, kv in enumerate(parsed):
             for k, v in kv.items():
-                if k < width:
+                if 0 <= k < width:
                     out[i, k] = v
         return jnp.asarray(out)
 

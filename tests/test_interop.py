@@ -184,3 +184,10 @@ def test_tf_example_negative_int64():
         {"label": -1, "ids": np.asarray([-5, 3], np.int64)}))
     np.testing.assert_array_equal(dec["label"], [-1])
     np.testing.assert_array_equal(dec["ids"], [-5, 3])
+
+
+def test_tf_example_bool_array():
+    from bigdl_tpu.interop import tf_example as te
+    dec = te.decode_example(te.encode_example(
+        {"flags": np.asarray([True, False, True])}))
+    np.testing.assert_array_equal(dec["flags"], [1, 0, 1])

@@ -255,3 +255,10 @@ def test_depthwise_pad_gate():
     # negative padding
     out = ops.DepthwiseConv2D(pad_w=1).forward({}, x, w)
     assert out.shape == (1, 5, 5, 2)
+
+
+def test_kv2tensor_negative_key_and_crosscol_single_empty():
+    import bigdl_tpu.nn.ops as ops
+    out = np.asarray(ops.Kv2Tensor(n_cols=4).forward({}, ["-2:9.0,0:1.0"]))
+    np.testing.assert_allclose(out, [[1.0, 0, 0, 0]])   # -2 dropped
+    assert ops.CrossCol(10).forward({}, []).shape == (0, 1)

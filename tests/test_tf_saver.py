@@ -1,0 +1,91 @@
+"""TF GraphDef export (reference: utils/tf/TensorflowSaver.scala) —
+round-trip through our own importer proves the emitted NodeDefs are
+well-formed and numerically faithful."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.container import Graph, Input, Sequential
+from bigdl_tpu.interop.tf_convert import to_module
+from bigdl_tpu.interop.tensorflow import load_graphdef
+from bigdl_tpu.interop.tf_saver import save_graphdef
+
+
+def _roundtrip(model, params, state, x, **kw):
+    buf = save_graphdef(model, params, state, **kw)
+    g = load_graphdef(buf)
+    mod, p, s, _ = to_module(g)
+    want, _ = model.apply(params, state, x)
+    got, _ = mod.apply(p, s, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+    return buf
+
+
+def test_cnn_export_roundtrip():
+    model = Sequential(
+        nn.SpatialConvolution(3, 8, 3, 3, pad_w=-1, pad_h=-1),
+        nn.SpatialBatchNormalization(8),
+        nn.ReLU(),
+        nn.SpatialMaxPooling(2, 2),
+        nn.SpatialCrossMapLRN(5, alpha=1e-3, beta=0.75, k=1.0),
+        nn.Flatten(),
+        nn.Linear(8 * 4 * 4, 10),
+        nn.LogSoftMax())
+    params, state = model.init(jax.random.PRNGKey(0))
+    r = np.random.RandomState(0)
+    x = r.randn(2, 8, 8, 3).astype(np.float32)
+    # BN with non-trivial running stats
+    _, state = model.apply(params, state, jnp.asarray(x), training=True)
+    _roundtrip(model, params, state, x, example_input=jnp.asarray(x))
+
+
+def test_mlp_and_explicit_pad_export():
+    model = Sequential(
+        nn.SpatialConvolution(1, 4, 3, 3, pad_w=1, pad_h=1),  # explicit pad
+        nn.ReLU6(),
+        nn.SpatialAveragePooling(2, 2),
+        nn.Reshape((4 * 3 * 3,), batch_mode=True),
+        nn.Linear(36, 6),
+        nn.Tanh(),
+        nn.Linear(6, 3, bias=False),
+        nn.SoftMax())
+    params, state = model.init(jax.random.PRNGKey(1))
+    x = np.random.RandomState(1).randn(2, 6, 6, 1).astype(np.float32)
+    _roundtrip(model, params, state, x)
+
+
+def test_graph_export_with_residual_and_concat():
+    inp = Input()
+    a = nn.Linear(8, 8)(inp)
+    b = nn.ReLU()(a)
+    add = nn.CAddTable()(inp, b)
+    j = nn.JoinTable(1)(add, b)
+    out = nn.Linear(16, 4)(j)
+    model = Graph([inp], [out])
+    params, state = model.init(jax.random.PRNGKey(2))
+    x = np.random.RandomState(2).randn(3, 8).astype(np.float32)
+    _roundtrip(model, params, state, x)
+
+
+def test_dropout_exports_as_identity_and_unsupported_raises():
+    model = Sequential(nn.Linear(4, 4), nn.Dropout(0.5), nn.Sigmoid())
+    params, state = model.init(jax.random.PRNGKey(3))
+    x = np.random.RandomState(3).randn(2, 4).astype(np.float32)
+    _roundtrip(model, params, state, x)
+
+    bad = Sequential(nn.LSTM(4, 4))
+    p, s = bad.init(jax.random.PRNGKey(0))
+    with pytest.raises(NotImplementedError, match="LSTM"):
+        save_graphdef(bad, p, s)
+
+
+def test_flatten_without_example_input_raises():
+    model = Sequential(nn.Flatten(), nn.Linear(4, 2))
+    params, state = model.init(jax.random.PRNGKey(4))
+    with pytest.raises(ValueError, match="example_input"):
+        save_graphdef(model, params, state)
