@@ -25,7 +25,9 @@ class ImageFeature(dict):
     FLOATS = "floats"          # HWC float32 image
     LABEL = "label"
     ORIGINAL_SIZE = "originalSize"
-    BOXES = "boxes"            # (N, 4) xyxy
+    BOXES = "boxes"            # (N, 4) xyxy, absolute pixels
+    CLASSES = "classes"        # (N,) int per-box labels
+    MASKS = "masks"            # (N, H, W) binary instance masks
     URI = "uri"
 
     def __init__(self, floats: Optional[np.ndarray] = None, label=None,
@@ -210,15 +212,69 @@ def resize_bilinear(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
     return top * (1 - wy) + bot * wy
 
 
+# ---------------------------------------------------- ROI label plumbing
+# (reference: transform/vision/image/label/roi/ — RoiNormalize, RoiHFlip,
+# RoiResize, RoiProject. Here the geometric transforms themselves keep
+# BOXES/MASKS consistent whenever the feature carries them, and the
+# explicit Roi* stages below cover normalization/filtering.)
+def _scale_rois(f, sy: float, sx: float):
+    if ImageFeature.BOXES in f:
+        b = np.asarray(f[ImageFeature.BOXES], np.float32)
+        f[ImageFeature.BOXES] = b * np.asarray([sx, sy, sx, sy], np.float32)
+    if ImageFeature.MASKS in f:
+        m = np.asarray(f[ImageFeature.MASKS])
+        if m.size:
+            nh = int(round(m.shape[1] * sy))
+            nw = int(round(m.shape[2] * sx))
+            ys = np.clip((np.arange(nh) / sy).astype(int), 0, m.shape[1] - 1)
+            xs = np.clip((np.arange(nw) / sx).astype(int), 0, m.shape[2] - 1)
+            f[ImageFeature.MASKS] = m[:, ys][:, :, xs]   # nearest neighbour
+
+
+def _crop_rois(f, y: int, x: int, ch: int, cw: int,
+               min_overlap: float = 1e-3):
+    """Shift boxes/masks into crop coords, clip, drop boxes left with no
+    area (reference: label/roi/RoiProject semantics)."""
+    keep = None
+    if ImageFeature.BOXES in f:
+        b = np.asarray(f[ImageFeature.BOXES], np.float32) - \
+            np.asarray([x, y, x, y], np.float32)
+        b[:, 0::2] = b[:, 0::2].clip(0, cw)
+        b[:, 1::2] = b[:, 1::2].clip(0, ch)
+        keep = ((b[:, 2] - b[:, 0]) > min_overlap) & \
+            ((b[:, 3] - b[:, 1]) > min_overlap)
+        f[ImageFeature.BOXES] = b[keep]
+        if ImageFeature.CLASSES in f:
+            f[ImageFeature.CLASSES] = \
+                np.asarray(f[ImageFeature.CLASSES])[keep]
+    if ImageFeature.MASKS in f:
+        m = np.asarray(f[ImageFeature.MASKS])
+        if m.size:
+            # the crop window may exceed the mask on ANY side (e.g. a
+            # padded crop) — pad all four before slicing so the output is
+            # always exactly (N, ch, cw)
+            pt, pl = max(0, -y), max(0, -x)
+            pb = max(0, y + ch - m.shape[1])
+            pr = max(0, x + cw - m.shape[2])
+            if pt or pl or pb or pr:
+                m = np.pad(m, ((0, 0), (pt, pb), (pl, pr)))
+                y, x = y + pt, x + pl
+            m = m[:, y:y + ch, x:x + cw]
+            f[ImageFeature.MASKS] = m[keep] if keep is not None else m
+
+
 class Resize(FeatureTransformer):
-    """(reference: augmentation/Resize.scala)."""
+    """(reference: augmentation/Resize.scala; boxes/masks follow,
+    label/roi/RoiResize)."""
 
     def __init__(self, height: int, width: int, seed=None):
         super().__init__(seed)
         self.h, self.w = height, width
 
     def transform(self, f, rng):
+        h, w = f.floats.shape[:2]
         f.floats = resize_bilinear(f.floats, self.h, self.w)
+        _scale_rois(f, self.h / h, self.w / w)
         return f
 
 
@@ -236,8 +292,9 @@ class AspectScale(FeatureTransformer):
         ratio = self.scale / short
         if long * ratio > self.max_size:
             ratio = self.max_size / long
-        f.floats = resize_bilinear(f.floats, int(round(h * ratio)),
-                                   int(round(w * ratio)))
+        nh, nw = int(round(h * ratio)), int(round(w * ratio))
+        f.floats = resize_bilinear(f.floats, nh, nw)
+        _scale_rois(f, nh / h, nw / w)
         return f
 
 
@@ -254,6 +311,7 @@ class CenterCrop(FeatureTransformer):
         y = max(0, (h - self.ch) // 2)
         x = max(0, (w - self.cw) // 2)
         f.floats = f.floats[y:y + self.ch, x:x + self.cw]
+        _crop_rois(f, y, x, self.ch, self.cw)
         return f
 
 
@@ -269,6 +327,7 @@ class RandomCrop(FeatureTransformer):
         y = rng.randint(0, max(1, h - self.ch + 1))
         x = rng.randint(0, max(1, w - self.cw + 1))
         f.floats = f.floats[y:y + self.ch, x:x + self.cw]
+        _crop_rois(f, y, x, self.ch, self.cw)
         return f
 
 
@@ -287,6 +346,7 @@ class PaddedRandomCrop(FeatureTransformer):
         y = rng.randint(0, h - self.ch + 1)
         x = rng.randint(0, w - self.cw + 1)
         f.floats = img[y:y + self.ch, x:x + self.cw]
+        _crop_rois(f, y - self.pad, x - self.pad, self.ch, self.cw)
         return f
 
 
@@ -301,6 +361,14 @@ class HFlip(FeatureTransformer):
     def transform(self, f, rng):
         if rng.rand() < self.p:
             f.floats = f.floats[:, ::-1]
+            w = f.floats.shape[1]
+            if ImageFeature.BOXES in f:   # (ref: label/roi/RoiHFlip)
+                b = np.asarray(f[ImageFeature.BOXES], np.float32)
+                f[ImageFeature.BOXES] = np.stack(
+                    [w - b[:, 2], b[:, 1], w - b[:, 0], b[:, 3]], axis=1)
+            if ImageFeature.MASKS in f:
+                f[ImageFeature.MASKS] = \
+                    np.asarray(f[ImageFeature.MASKS])[:, :, ::-1]
         return f
 
 
@@ -322,6 +390,51 @@ class Expand(FeatureTransformer):
         x = rng.randint(0, nw - w + 1)
         canvas[y:y + h, x:x + w] = f.floats
         f.floats = canvas
+        if ImageFeature.BOXES in f:
+            f[ImageFeature.BOXES] = \
+                np.asarray(f[ImageFeature.BOXES], np.float32) + \
+                np.asarray([x, y, x, y], np.float32)
+        if ImageFeature.MASKS in f:
+            m = np.asarray(f[ImageFeature.MASKS])
+            f[ImageFeature.MASKS] = np.pad(
+                m, ((0, 0), (y, nh - h - y), (x, nw - w - x)))
+        return f
+
+
+class RoiNormalize(FeatureTransformer):
+    """Boxes → [0,1] relative coords (reference: label/roi/RoiNormalize)."""
+
+    def transform(self, f, rng):
+        if ImageFeature.BOXES in f:
+            h, w = f.floats.shape[:2]
+            f[ImageFeature.BOXES] = \
+                np.asarray(f[ImageFeature.BOXES], np.float32) / \
+                np.asarray([w, h, w, h], np.float32)
+        return f
+
+
+class RoiFilter(FeatureTransformer):
+    """Drop boxes (and their classes/masks) smaller than min_size pixels
+    on either side (reference: the minimum-size screening of
+    label/roi/RoiProject)."""
+
+    def __init__(self, min_size: float = 1.0, seed=None):
+        super().__init__(seed)
+        self.min_size = min_size
+
+    def transform(self, f, rng):
+        if ImageFeature.BOXES not in f:
+            return f
+        b = np.asarray(f[ImageFeature.BOXES], np.float32)
+        keep = ((b[:, 2] - b[:, 0]) >= self.min_size) & \
+            ((b[:, 3] - b[:, 1]) >= self.min_size)
+        f[ImageFeature.BOXES] = b[keep]
+        if ImageFeature.CLASSES in f:
+            f[ImageFeature.CLASSES] = np.asarray(f[ImageFeature.CLASSES])[keep]
+        if ImageFeature.MASKS in f:
+            m = np.asarray(f[ImageFeature.MASKS])
+            if m.size:
+                f[ImageFeature.MASKS] = m[keep]
         return f
 
 

@@ -53,7 +53,8 @@ def _table_to_params(table, skeleton):
     return root
 
 
-def convert(input_path: str, output_path: str, module_path: str = None):
+def convert(input_path: str, output_path: str, module_path: str = None,
+            example_shape=None):
     from bigdl_tpu.utils.serializer import load_module, save_module
     src, dst = _fmt(input_path), _fmt(output_path)
 
@@ -81,8 +82,11 @@ def convert(input_path: str, output_path: str, module_path: str = None):
         raise ValueError("onnx is an import-only format (like the "
                          "reference's onnx_loader)")
     if dst == "tf":
+        import numpy as np
         from bigdl_tpu.interop.tf_saver import save_model as save_tf
-        save_tf(output_path, module, params, state)
+        example = (np.zeros(tuple(example_shape), np.float32)
+                   if example_shape else None)
+        save_tf(output_path, module, params, state, example_input=example)
         print(f"converted {input_path} ({src}) -> {output_path} (tf)")
         return
     if dst == "bigdl":
@@ -104,8 +108,14 @@ def main(argv=None):
     ap.add_argument("--output", required=True)
     ap.add_argument("--module", default=None,
                     help="topology .bigdl-tpu when importing caffe/t7")
+    ap.add_argument("--example-shape", default=None,
+                    help="comma-separated input shape (incl. batch) used "
+                         "to resolve Flatten feature counts on tf export, "
+                         "e.g. 1,28,28,1")
     args = ap.parse_args(argv)
-    convert(args.input, args.output, args.module)
+    shape = ([int(d) for d in args.example_shape.split(",")]
+             if args.example_shape else None)
+    convert(args.input, args.output, args.module, example_shape=shape)
 
 
 if __name__ == "__main__":

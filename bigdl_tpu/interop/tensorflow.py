@@ -223,7 +223,8 @@ def make_node(name: str, op: str, inputs: Sequence[str] = (),
               tensor: Optional[np.ndarray] = None,
               ints: Optional[Dict[str, List[int]]] = None,
               strs: Optional[Dict[str, str]] = None,
-              scalars: Optional[Dict[str, object]] = None) -> bytes:
+              scalars: Optional[Dict[str, object]] = None,
+              types: Optional[Dict[str, int]] = None) -> bytes:
     """Encode one NodeDef (used by the exporter/tests — the analogue of
     TensorflowSaver, utils/tf/TensorflowSaver.scala)."""
     body = pw.field_str(1, name) + pw.field_str(2, op)
@@ -258,4 +259,8 @@ def make_node(name: str, op: str, inputs: Sequence[str] = (),
             body += attr(key, pw.field_float(4, v))
         else:
             raise ValueError(f"unsupported scalar attr {key}={v!r}")
+    for key, dt in (types or {}).items():
+        # AttrValue.type (DataType enum, field 6) — the attrs stock TF
+        # requires without defaults (Placeholder dtype, op T)
+        body += attr(key, pw.field_varint(6, dt))
     return pw.field_bytes(1, body)

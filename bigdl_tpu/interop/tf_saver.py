@@ -4,8 +4,11 @@ here the same idea over interop/tensorflow.make_node).
 
 Weights are frozen into Const nodes (the reference saves frozen inference
 graphs too). The exported bytes re-import through our own converter
-(interop/tf_convert.load_model) and through any stock GraphDef reader —
-NHWC layouts match TF natively, so no transposes are inserted.
+(interop/tf_convert.load_model); for stock GraphDef readers the emitter
+writes the attrs TF requires without defaults (Placeholder dtype, per-op T,
+variadic N) — NHWC layouts match TF natively, so no transposes are
+inserted. Attrs with defaults (data_format, transpose_a/b, Tidx...) are
+left to the reader's defaults.
 
 Supported vocabulary: the zoo models' layer set (Linear, Conv2D, BN,
 pooling, activations, reshape/concat/add, dropout-as-identity, LRN,
@@ -21,7 +24,7 @@ import numpy as np
 
 from bigdl_tpu.core.container import Graph, Input, Sequential
 from bigdl_tpu.core.module import Module
-from bigdl_tpu.interop.tensorflow import make_node
+from bigdl_tpu.interop.tensorflow import DT_FLOAT, make_node
 
 import bigdl_tpu.nn as nn
 
@@ -39,7 +42,19 @@ class _Emitter:
         return name
 
     def emit(self, name: str, op: str, inputs: Sequence[str] = (), **kw):
-        self.nodes.append(make_node(name, op, inputs, **kw))
+        # attrs stock TF requires without defaults: Placeholder's dtype,
+        # the element type T elsewhere, N on variadic ops
+        types = dict(kw.pop("types", {}))
+        if op == "Placeholder":
+            types.setdefault("dtype", DT_FLOAT)
+        elif op != "Const":
+            types.setdefault("T", DT_FLOAT)
+        scalars = dict(kw.pop("scalars", {}))
+        if op in ("ConcatV2", "AddN"):
+            n = len(inputs) - (1 if op == "ConcatV2" else 0)
+            scalars.setdefault("N", n)
+        self.nodes.append(make_node(name, op, inputs, types=types,
+                                    scalars=scalars, **kw))
         return name
 
     def const(self, base: str, arr) -> str:
@@ -171,9 +186,10 @@ def save_graphdef(module: Module, params: Dict, state: Dict,
                                 example_input)
     if isinstance(module, Graph):
         return _save_graph(module, params, state, input_names)
-    # single layer
-    return _save_sequential([module], {"0": params} if "weight" in params
-                            else params, state, input_names, example_input)
+    # bare single layer: treat as a sequential of one (params AND state
+    # both re-keyed under "0")
+    return _save_sequential([module], {"0": params}, {"0": state},
+                            input_names, example_input)
 
 
 def _shapes_along(seq, params, state, example_input):
@@ -181,7 +197,6 @@ def _shapes_along(seq, params, state, example_input):
     shapes = []
     if example_input is None:
         return None
-    import jax
     x = example_input
     for i, m in enumerate(seq):
         shapes.append(np.asarray(x).shape if not isinstance(x, tuple)

@@ -165,3 +165,86 @@ def test_mt_batch_pipeline():
     got = list(mt(items))
     assert len(got) == 2
     assert got[0][0].shape == (4, 3, 3)
+
+
+# --------------------------------------------- ROI label transforms
+def test_resize_and_hflip_adjust_boxes_and_masks():
+    from bigdl_tpu.dataset.vision import (HFlip, ImageFeature, Resize,
+                                          RoiNormalize)
+    img = np.zeros((10, 20, 3), np.float32)
+    boxes = np.asarray([[2.0, 1.0, 6.0, 5.0]], np.float32)
+    masks = np.zeros((1, 10, 20), np.uint8)
+    masks[0, 1:5, 2:6] = 1
+    f = ImageFeature(img)
+    f[ImageFeature.BOXES] = boxes
+    f[ImageFeature.MASKS] = masks
+
+    f = Resize(20, 40).transform(f, np.random.RandomState(0))
+    np.testing.assert_allclose(f[ImageFeature.BOXES], [[4, 2, 12, 10]])
+    assert f[ImageFeature.MASKS].shape == (1, 20, 40)
+    assert f[ImageFeature.MASKS][0, 4, 5] == 1   # scaled content follows
+
+    flip = HFlip(p=1.1)                          # always flips
+    f = flip.transform(f, np.random.RandomState(0))
+    np.testing.assert_allclose(f[ImageFeature.BOXES], [[28, 2, 36, 10]])
+    assert f[ImageFeature.MASKS][0, 4, 40 - 6] == 1
+
+    f = RoiNormalize().transform(f, np.random.RandomState(0))
+    np.testing.assert_allclose(f[ImageFeature.BOXES],
+                               [[28 / 40, 2 / 20, 36 / 40, 10 / 20]])
+
+
+def test_crop_shifts_clips_and_drops_boxes():
+    from bigdl_tpu.dataset.vision import CenterCrop, ImageFeature
+    img = np.zeros((20, 20, 3), np.float32)
+    f = ImageFeature(img)
+    # one box inside the center crop, one fully outside
+    f[ImageFeature.BOXES] = np.asarray(
+        [[6.0, 6.0, 12.0, 12.0], [0.0, 0.0, 3.0, 3.0]], np.float32)
+    f[ImageFeature.CLASSES] = np.asarray([1, 2])
+    f[ImageFeature.MASKS] = np.ones((2, 20, 20), np.uint8)
+    f = CenterCrop(10, 10).transform(f, np.random.RandomState(0))
+    # crop origin (5,5): first box -> (1,1,7,7); second dropped
+    np.testing.assert_allclose(f[ImageFeature.BOXES], [[1, 1, 7, 7]])
+    np.testing.assert_array_equal(f[ImageFeature.CLASSES], [1])
+    assert f[ImageFeature.MASKS].shape == (1, 10, 10)
+
+
+def test_expand_and_padded_crop_offsets():
+    from bigdl_tpu.dataset.vision import (Expand, ImageFeature,
+                                          PaddedRandomCrop, RoiFilter)
+    r = np.random.RandomState(3)
+    img = np.ones((8, 8, 3), np.float32)
+    f = ImageFeature(img)
+    f[ImageFeature.BOXES] = np.asarray([[1.0, 1.0, 7.0, 7.0]], np.float32)
+    f = Expand(max_ratio=2.0).transform(f, r)
+    b = f[ImageFeature.BOXES][0]
+    assert (b[2] - b[0]) == 6.0 and (b[3] - b[1]) == 6.0   # size preserved
+    h, w = f.floats.shape[:2]
+    assert 0 <= b[0] and b[2] <= w and 0 <= b[1] and b[3] <= h
+
+    f2 = ImageFeature(np.ones((8, 8, 3), np.float32))
+    f2[ImageFeature.BOXES] = np.asarray([[2.0, 2.0, 6.0, 6.0]], np.float32)
+    f2 = PaddedRandomCrop(8, 8, pad=2).transform(f2, np.random.RandomState(0))
+    b2 = f2[ImageFeature.BOXES]
+    assert b2.shape == (1, 4)
+    assert (b2 >= 0).all() and (b2 <= 8).all()
+
+    f3 = ImageFeature(np.ones((8, 8, 3), np.float32))
+    f3[ImageFeature.BOXES] = np.asarray(
+        [[0.0, 0.0, 0.5, 8.0], [1.0, 1.0, 5.0, 5.0]], np.float32)
+    f3 = RoiFilter(min_size=1.0).transform(f3, np.random.RandomState(0))
+    np.testing.assert_allclose(f3[ImageFeature.BOXES], [[1, 1, 5, 5]])
+
+
+def test_padded_crop_mask_stays_aligned():
+    from bigdl_tpu.dataset.vision import ImageFeature, PaddedRandomCrop
+    for seed in range(6):
+        f = ImageFeature(np.ones((8, 8, 3), np.float32))
+        f[ImageFeature.BOXES] = np.asarray([[1.0, 1.0, 7.0, 7.0]],
+                                           np.float32)
+        f[ImageFeature.MASKS] = np.ones((1, 8, 8), np.uint8)
+        f = PaddedRandomCrop(8, 8, pad=2).transform(
+            f, np.random.RandomState(seed))
+        # mask must track the image shape exactly, wherever the crop lands
+        assert f[ImageFeature.MASKS].shape == (1, 8, 8), seed

@@ -89,3 +89,35 @@ def test_flatten_without_example_input_raises():
     params, state = model.init(jax.random.PRNGKey(4))
     with pytest.raises(ValueError, match="example_input"):
         save_graphdef(model, params, state)
+
+
+def test_single_stateful_layer_export():
+    bn = nn.SpatialBatchNormalization(4)
+    params, state = bn.init(jax.random.PRNGKey(5))
+    x = np.random.RandomState(5).randn(2, 6, 6, 4).astype(np.float32)
+    _, state = bn.apply(params, state, jnp.asarray(x), training=True)
+    buf = save_graphdef(bn, params, state)
+    mod, p, s, _ = to_module(load_graphdef(buf))
+    want, _ = bn.apply(params, state, jnp.asarray(x))
+    got, _ = mod.apply(p, s, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_convert_cli_example_shape(tmp_path):
+    from bigdl_tpu.interop import convert as cv
+    from bigdl_tpu.utils.serializer import save_module
+    model = Sequential(nn.SpatialConvolution(1, 2, 3, 3, pad_w=-1,
+                                             pad_h=-1),
+                       nn.Flatten(), nn.Linear(2 * 4 * 4, 3))
+    params, state = model.init(jax.random.PRNGKey(6))
+    src = str(tmp_path / "m.bigdl-tpu")
+    dst = str(tmp_path / "m.pb")
+    save_module(src, model, params, state)
+    cv.main(["--input", src, "--output", dst, "--example-shape", "1,4,4,1"])
+    mod, p, s, _ = to_module(load_graphdef(open(dst, "rb").read()))
+    x = np.random.RandomState(6).randn(2, 4, 4, 1).astype(np.float32)
+    want, _ = model.apply(params, state, jnp.asarray(x))
+    got, _ = mod.apply(p, s, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4,
+                               atol=1e-5)
