@@ -231,16 +231,20 @@ def _scale_rois(f, sy: float, sx: float):
             f[ImageFeature.MASKS] = m[:, ys][:, :, xs]   # nearest neighbour
 
 
-def _crop_rois(f, y: int, x: int, ch: int, cw: int,
-               min_overlap: float = 1e-3):
+def _crop_rois(f, y: int, x: int, min_overlap: float = 1e-3):
     """Shift boxes/masks into crop coords, clip, drop boxes left with no
-    area (reference: label/roi/RoiProject semantics)."""
+    area (reference: label/roi/RoiProject semantics). Must be called AFTER
+    `f.floats` is cropped: the post-crop image shape is the ground truth
+    for both box clipping and mask size (a crop window larger than the
+    image yields a smaller-than-requested image — masks must match it,
+    not the requested window)."""
+    oh, ow = f.floats.shape[:2]
     keep = None
     if ImageFeature.BOXES in f:
         b = np.asarray(f[ImageFeature.BOXES], np.float32) - \
             np.asarray([x, y, x, y], np.float32)
-        b[:, 0::2] = b[:, 0::2].clip(0, cw)
-        b[:, 1::2] = b[:, 1::2].clip(0, ch)
+        b[:, 0::2] = b[:, 0::2].clip(0, ow)
+        b[:, 1::2] = b[:, 1::2].clip(0, oh)
         keep = ((b[:, 2] - b[:, 0]) > min_overlap) & \
             ((b[:, 3] - b[:, 1]) > min_overlap)
         f[ImageFeature.BOXES] = b[keep]
@@ -250,16 +254,15 @@ def _crop_rois(f, y: int, x: int, ch: int, cw: int,
     if ImageFeature.MASKS in f:
         m = np.asarray(f[ImageFeature.MASKS])
         if m.size:
-            # the crop window may exceed the mask on ANY side (e.g. a
-            # padded crop) — pad all four before slicing so the output is
-            # always exactly (N, ch, cw)
+            # window may start before the mask (negative origin from a
+            # padded crop) — pad what's needed, then cut exactly (oh, ow)
             pt, pl = max(0, -y), max(0, -x)
-            pb = max(0, y + ch - m.shape[1])
-            pr = max(0, x + cw - m.shape[2])
+            pb = max(0, y + oh - m.shape[1])
+            pr = max(0, x + ow - m.shape[2])
             if pt or pl or pb or pr:
                 m = np.pad(m, ((0, 0), (pt, pb), (pl, pr)))
                 y, x = y + pt, x + pl
-            m = m[:, y:y + ch, x:x + cw]
+            m = m[:, y:y + oh, x:x + ow]
             f[ImageFeature.MASKS] = m[keep] if keep is not None else m
 
 
@@ -311,7 +314,7 @@ class CenterCrop(FeatureTransformer):
         y = max(0, (h - self.ch) // 2)
         x = max(0, (w - self.cw) // 2)
         f.floats = f.floats[y:y + self.ch, x:x + self.cw]
-        _crop_rois(f, y, x, self.ch, self.cw)
+        _crop_rois(f, y, x)
         return f
 
 
@@ -327,7 +330,7 @@ class RandomCrop(FeatureTransformer):
         y = rng.randint(0, max(1, h - self.ch + 1))
         x = rng.randint(0, max(1, w - self.cw + 1))
         f.floats = f.floats[y:y + self.ch, x:x + self.cw]
-        _crop_rois(f, y, x, self.ch, self.cw)
+        _crop_rois(f, y, x)
         return f
 
 
@@ -346,7 +349,7 @@ class PaddedRandomCrop(FeatureTransformer):
         y = rng.randint(0, h - self.ch + 1)
         x = rng.randint(0, w - self.cw + 1)
         f.floats = img[y:y + self.ch, x:x + self.cw]
-        _crop_rois(f, y - self.pad, x - self.pad, self.ch, self.cw)
+        _crop_rois(f, y - self.pad, x - self.pad)
         return f
 
 

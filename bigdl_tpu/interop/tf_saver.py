@@ -109,9 +109,12 @@ def _emit_layer(e: _Emitter, m: Module, params: Dict, state: Dict,
                          else np.zeros(m.n_output, np.float32))
         mean = e.const("moving_mean", state["running_mean"])
         var = e.const("moving_variance", state["running_var"])
+        # is_training defaults to TRUE in stock TF — must be pinned false
+        # or readers ignore the exported moving statistics
         return e.emit(nm("batchnorm"), "FusedBatchNorm",
                       [x, scale, offset, mean, var],
-                      scalars={"epsilon": float(m.eps)})
+                      scalars={"epsilon": float(m.eps),
+                               "is_training": False})
     if isinstance(m, nn.SpatialMaxPooling) or \
             isinstance(m, nn.SpatialAveragePooling):
         op = "MaxPool" if isinstance(m, nn.SpatialMaxPooling) else "AvgPool"

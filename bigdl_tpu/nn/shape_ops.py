@@ -329,5 +329,24 @@ class ResizeBilinear(Module):
 
     def forward(self, params, x, **_):
         import jax.image
-        return jax.image.resize(
-            x, (x.shape[0], self.out_h, self.out_w, x.shape[3]), "bilinear")
+        if not self.align:
+            return jax.image.resize(
+                x, (x.shape[0], self.out_h, self.out_w, x.shape[3]),
+                "bilinear")
+        # align_corners=True: corners map to corners — sample positions are
+        # linspace(0, in-1, out), not half-pixel centers
+        # (reference: nn/ResizeBilinear.scala alignCorners branch)
+        h, w = x.shape[1], x.shape[2]
+        ys = jnp.linspace(0.0, h - 1.0, self.out_h) if self.out_h > 1 \
+            else jnp.zeros((1,))
+        xs = jnp.linspace(0.0, w - 1.0, self.out_w) if self.out_w > 1 \
+            else jnp.zeros((1,))
+        y0 = jnp.floor(ys).astype(jnp.int32)
+        x0 = jnp.floor(xs).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, h - 1)
+        x1 = jnp.minimum(x0 + 1, w - 1)
+        wy = (ys - y0)[None, :, None, None]
+        wx = (xs - x0)[None, None, :, None]
+        top = x[:, y0][:, :, x0] * (1 - wx) + x[:, y0][:, :, x1] * wx
+        bot = x[:, y1][:, :, x0] * (1 - wx) + x[:, y1][:, :, x1] * wx
+        return top * (1 - wy) + bot * wy

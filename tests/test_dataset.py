@@ -248,3 +248,15 @@ def test_padded_crop_mask_stays_aligned():
             f, np.random.RandomState(seed))
         # mask must track the image shape exactly, wherever the crop lands
         assert f[ImageFeature.MASKS].shape == (1, 8, 8), seed
+
+
+def test_crop_larger_than_image_keeps_masks_aligned():
+    from bigdl_tpu.dataset.vision import CenterCrop, ImageFeature
+    f = ImageFeature(np.ones((8, 8, 3), np.float32))
+    f[ImageFeature.BOXES] = np.asarray([[1.0, 1.0, 7.0, 7.0]], np.float32)
+    f[ImageFeature.MASKS] = np.ones((1, 8, 8), np.uint8)
+    f = CenterCrop(10, 10).transform(f, np.random.RandomState(0))
+    # image can't grow: stays 8x8; masks must match it exactly
+    assert f.floats.shape == (8, 8, 3)
+    assert f[ImageFeature.MASKS].shape == (1, 8, 8)
+    np.testing.assert_allclose(f[ImageFeature.BOXES], [[1, 1, 7, 7]])
