@@ -161,6 +161,15 @@ def child_main():
     force_cpu_if_requested()
     import jax
     import jax.numpy as jnp
+    try:
+        # persistent compile cache: the driver's end-of-round run pays the
+        # ResNet-50 compile only once per image lifetime
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(os.path.dirname(
+                              os.path.abspath(__file__)), ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception:
+        pass                                    # older jax — cache optional
 
     which = sys.argv[1] if len(sys.argv) > 1 else "resnet50"
     dev = jax.devices()[0]

@@ -90,7 +90,13 @@ class DLModel:
         x = _col(df, self.features_col).reshape(
             (-1,) + self.feature_size).astype(np.float32)
         out = self._post(self._predict(x))
-        res = {k: np.asarray(df[k]) for k in df.keys()} \
+
+        def passthrough(v):
+            try:                       # ragged columns (e.g. raw image
+                return np.asarray(v)   # lists) stay as python lists
+            except ValueError:
+                return v
+        res = {k: passthrough(df[k]) for k in df.keys()} \
             if hasattr(df, "keys") else {}
         res[self.prediction_col] = out
         return res
@@ -114,3 +120,65 @@ class DLClassifierModel(DLModel):
 
     def _post(self, out):
         return np.argmax(out, axis=-1).astype(np.int32)
+
+
+class DLImageReader:
+    """Read an image folder into a columnar frame (reference:
+    dlframes/DLImageReader.scala — `readImages(path)` producing a DataFrame
+    of image rows with origin/height/width/nChannels/data).
+
+    Returns a dict of parallel lists/arrays: origin (path), height, width,
+    n_channels, data (HWC float32, raw 0..255)."""
+
+    @staticmethod
+    def read_images(path: str, recursive: bool = True) -> Dict[str, list]:
+        import os
+        from PIL import Image
+        exts = (".jpg", ".jpeg", ".png", ".bmp", ".gif")
+        paths = []
+        if os.path.isfile(path):
+            paths = [path]
+        else:
+            for root, _dirs, files in os.walk(path):
+                paths.extend(os.path.join(root, f) for f in files
+                             if f.lower().endswith(exts))
+                if not recursive:
+                    break
+        frame = {"origin": [], "height": [], "width": [],
+                 "n_channels": [], "data": []}
+        for p in sorted(paths):
+            with Image.open(p) as im:
+                arr = np.asarray(im.convert("RGB"), np.float32)
+            frame["origin"].append(p)
+            frame["height"].append(arr.shape[0])
+            frame["width"].append(arr.shape[1])
+            frame["n_channels"].append(arr.shape[2])
+            frame["data"].append(arr)
+        return frame
+
+
+class DLImageTransformer:
+    """Apply a vision FeatureTransformer pipeline to an image frame column
+    (reference: dlframes/DLImageTransformer.scala — runs a
+    FeatureTransformer over the image column, emitting `output_col`)."""
+
+    def __init__(self, transformer, input_col: str = "data",
+                 output_col: str = "features", seed=None):
+        from bigdl_tpu.dataset.vision import Pipeline
+        stages = transformer if isinstance(transformer, (list, tuple)) \
+            else [transformer]
+        # one shared, seeded-once rng across images and calls — per-image
+        # fresh rngs would make every "random" augmentation deterministic
+        self.pipeline = Pipeline(*stages, seed=seed)
+        self.input_col, self.output_col = input_col, output_col
+
+    def transform(self, frame: Dict) -> Dict:
+        from bigdl_tpu.dataset.vision import ImageFeature
+        out = dict(frame)
+        feats = []
+        for img in frame[self.input_col]:
+            f = ImageFeature(np.asarray(img, np.float32))
+            f = self.pipeline.transform(f, self.pipeline._rng)
+            feats.append(f.floats)
+        out[self.output_col] = feats
+        return out

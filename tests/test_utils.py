@@ -105,3 +105,44 @@ def test_optimize_with_retry_recovers(tmp_path, monkeypatch):
     opt._maybe_validate = flaky
     params, state = opt.optimize_with_retry(retries=2, window_s=60)
     assert opt.state["epoch"] >= 3   # completed after recovery
+
+
+def test_dl_image_reader_and_transformer(tmp_path):
+    from PIL import Image
+    import numpy as np
+    from bigdl_tpu.dlframes import DLImageReader, DLImageTransformer
+    from bigdl_tpu.dataset.vision import ChannelNormalize, Resize
+    d = tmp_path / "imgs"
+    d.mkdir()
+    for i in range(3):
+        arr = np.random.RandomState(i).randint(
+            0, 255, (8 + i, 10, 3), np.uint8)
+        Image.fromarray(arr).save(str(d / f"im{i}.png"))
+    frame = DLImageReader.read_images(str(d))
+    assert len(frame["origin"]) == 3
+    assert frame["height"] == [8, 9, 10]
+    assert frame["n_channels"] == [3, 3, 3]
+    tr = DLImageTransformer([Resize(4, 4),
+                             ChannelNormalize((127.5,) * 3, (127.5,) * 3)])
+    out = tr.transform(frame)
+    assert len(out["features"]) == 3
+    assert all(f.shape == (4, 4, 3) for f in out["features"])
+    assert max(max(abs(float(f.max())), abs(float(f.min())))
+               for f in out["features"]) <= 1.0 + 1e-5
+
+
+def test_dl_image_transformer_randomness_varies_per_image(tmp_path):
+    from PIL import Image
+    import numpy as np
+    from bigdl_tpu.dlframes import DLImageReader, DLImageTransformer
+    from bigdl_tpu.dataset.vision import RandomCrop
+    d = tmp_path / "imgs2"
+    d.mkdir()
+    arr = np.arange(20 * 20 * 3, dtype=np.uint8).reshape(20, 20, 3)
+    for i in range(6):
+        Image.fromarray(arr).save(str(d / f"a{i}.png"))
+    tr = DLImageTransformer(RandomCrop(8, 8), seed=0)
+    out = tr.transform(DLImageReader.read_images(str(d)))
+    crops = [f.tobytes() for f in out["features"]]
+    # identical inputs + random crop: offsets must differ across images
+    assert len(set(crops)) > 1
