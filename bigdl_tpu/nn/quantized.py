@@ -51,11 +51,14 @@ class QuantizedLinear(Module):
     """(reference: nn/quantized/Linear.scala:79-90)."""
 
     def __init__(self, in_features: int, out_features: int, bias: bool = True,
-                 input_scale: Optional[float] = None, name=None):
+                 input_scale: Optional[float] = None,
+                 use_pallas: Optional[bool] = None, name=None):
         super().__init__(name or "QuantizedLinear")
         self.in_features, self.out_features = in_features, out_features
         self.has_bias = bias
         self.input_scale = input_scale      # static (calibrated) or dynamic
+        # None = auto: the fused Pallas kernel on TPU, XLA dot elsewhere
+        self.use_pallas = use_pallas
 
     @classmethod
     def from_float(cls, layer: Linear, params: Dict,
@@ -70,7 +73,19 @@ class QuantizedLinear(Module):
             qp["bias"] = jnp.asarray(params["bias"], jnp.float32)
         return m, qp
 
+    def _pallas_enabled(self) -> bool:
+        if self.use_pallas is not None:
+            return self.use_pallas
+        return jax.default_backend() == "tpu"
+
     def forward(self, params, x, **_):
+        if self._pallas_enabled():
+            from bigdl_tpu.kernels.quantized_matmul import \
+                quantized_linear_forward
+            return quantized_linear_forward(
+                x, params["weight_q"], params["weight_scale"],
+                bias=params.get("bias") if self.has_bias else None,
+                input_scale=self.input_scale)
         orig_dtype = x.dtype
         x = jnp.asarray(x, jnp.float32)
         if self.input_scale is not None:

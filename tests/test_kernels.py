@@ -87,3 +87,55 @@ def test_flash_as_mha_backend():
     ref, _ = ref_mha.apply(params, state, x, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+# ------------------------------------------------ int8 matmul kernel
+def test_int8_matmul_matches_dot_general():
+    from bigdl_tpu.kernels.quantized_matmul import int8_matmul
+    r = np.random.RandomState(0)
+    m, k, n = 70, 96, 50                    # deliberately non-block-multiple
+    xq = r.randint(-127, 128, (m, k)).astype(np.int8)
+    wq = r.randint(-127, 128, (k, n)).astype(np.int8)
+    sx = (r.rand(m, 1).astype(np.float32) + 0.5) / 100
+    sw = (r.rand(1, n).astype(np.float32) + 0.5) / 100
+    got = int8_matmul(jnp.asarray(xq), jnp.asarray(wq), jnp.asarray(sx),
+                      jnp.asarray(sw), block_m=32, block_n=32, block_k=32,
+                      interpret=True)
+    want = (xq.astype(np.int64) @ wq.astype(np.int64)).astype(np.float32) \
+        * sx * sw
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+
+def test_quantized_linear_pallas_matches_xla_path():
+    from bigdl_tpu.nn.quantized import QuantizedLinear
+    from bigdl_tpu.nn.linear import Linear
+    import jax
+    r = np.random.RandomState(1)
+    lin = Linear(40, 24)
+    params, _ = lin.init(jax.random.PRNGKey(0))
+    x = jnp.asarray(r.randn(6, 40).astype(np.float32))
+
+    qlin, qp = QuantizedLinear.from_float(lin, params)
+    qlin.use_pallas = False
+    ref = qlin.forward(qp, x)
+
+    from bigdl_tpu.kernels.quantized_matmul import quantized_linear_forward
+    got = quantized_linear_forward(x, qp["weight_q"], qp["weight_scale"],
+                                   bias=qp["bias"], interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_quantized_linear_forward_3d_batch():
+    from bigdl_tpu.kernels.quantized_matmul import quantized_linear_forward
+    r = np.random.RandomState(2)
+    x = jnp.asarray(r.randn(2, 5, 16).astype(np.float32))
+    wq = jnp.asarray(r.randint(-127, 128, (16, 8)).astype(np.int8))
+    sw = jnp.asarray((r.rand(1, 8).astype(np.float32) + 0.5) / 50)
+    out = quantized_linear_forward(x, wq, sw, interpret=True)
+    assert out.shape == (2, 5, 8)
+    # leading dims flatten correctly: row 0 of batch 1 == flat row 5
+    flat = quantized_linear_forward(x.reshape(10, 16), wq, sw,
+                                    interpret=True)
+    np.testing.assert_allclose(np.asarray(out).reshape(10, 8),
+                               np.asarray(flat), rtol=1e-6)
