@@ -1,0 +1,61 @@
+"""Train a TensorFlow graph directly (reference: utils/tf/Session.scala:43-132
+`BigDLSessionImpl.train` — takes a parsed TF graph plus endpoint names,
+builds the BigDL model from it, wires the input pipeline, and runs the
+distributed optimizer).
+
+Here the converter (interop/tf_convert) already yields a trainable
+`nn.Graph`; the session facade binds endpoint names to a dataset and the
+optimizer, so a frozen GraphDef can be fine-tuned in three lines:
+
+    sess = TFTrainingSession("model.pb", inputs=["x"], outputs=["logits"],
+                             criterion=nn.CrossEntropyCriterion())
+    params, state = sess.train(dataset, SGD(0.01), Trigger.max_epoch(5))
+    preds = sess.predict(x_batch)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+class TFTrainingSession:
+    def __init__(self, graphdef, inputs: Optional[Sequence[str]] = None,
+                 outputs: Optional[Sequence[str]] = None, criterion=None):
+        from bigdl_tpu.interop.tf_convert import load_model, to_module
+        from bigdl_tpu.interop.tensorflow import TFGraph
+        if isinstance(graphdef, TFGraph):
+            self.module, self.params, self.state, self.name_map = \
+                to_module(graphdef, inputs, outputs)
+        else:                               # path or bytes
+            self.module, self.params, self.state, self.name_map = \
+                load_model(graphdef, inputs, outputs)
+        self.criterion = criterion
+        self._optimizer = None
+
+    def train(self, dataset, method=None, end_trigger=None, **optimizer_kw):
+        """Fine-tune the imported graph on `dataset` (any bigdl_tpu
+        DataSet). Returns (params, state) and keeps them on the session
+        (reference: Session.scala train -> trained Graph)."""
+        from bigdl_tpu.optim.local import Optimizer
+        from bigdl_tpu.optim.method import SGD
+        from bigdl_tpu.optim.trigger import Trigger
+        if self.criterion is None:
+            raise ValueError("TFTrainingSession needs a criterion to train")
+        opt = Optimizer(self.module, dataset, self.criterion,
+                        method or SGD(1e-2), **optimizer_kw)
+        opt.set_initial(self.params, self.state)
+        opt.set_end_when(end_trigger or Trigger.max_epoch(1))
+        self._optimizer = opt
+        self.params, self.state = opt.optimize()
+        self._predictor = None              # weights changed — re-jit once
+        return self.params, self.state
+
+    def predict(self, x, batch_size: int = 128):
+        from bigdl_tpu.optim.predictor import Predictor
+        # cache the predictor: a fresh one per call would re-jit (and
+        # recompile) the forward every time
+        if getattr(self, "_predictor", None) is None \
+                or self._predictor.batch_size != batch_size:
+            self._predictor = Predictor(self.module, self.params,
+                                        self.state, batch_size=batch_size)
+        return self._predictor.predict(x)

@@ -112,8 +112,7 @@ class KerasModel:
                                shuffle=False)
             opt.set_validation(Trigger.every_epoch(), vds, self.metrics)
         if self.params is not None:
-            opt._resume_trees = {"params": self.params,
-                                 "model_state": self.model_state}
+            opt.set_initial(self.params, self.model_state)
         self.params, self.model_state = opt.optimize()
         return self
 

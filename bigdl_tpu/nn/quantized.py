@@ -84,7 +84,7 @@ class QuantizedLinear(Module):
                 quantized_linear_forward
             return quantized_linear_forward(
                 x, params["weight_q"], params["weight_scale"],
-                bias=params.get("bias") if self.has_bias else None,
+                bias=params["bias"] if self.has_bias else None,
                 input_scale=self.input_scale)
         orig_dtype = x.dtype
         x = jnp.asarray(x, jnp.float32)

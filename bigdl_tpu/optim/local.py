@@ -227,13 +227,33 @@ class Optimizer:
         log.info("resumed from %s at %s", snap, meta)
         return True
 
+    def set_initial(self, params, model_state=None) -> "Optimizer":
+        """Start training from given (imported / pre-trained) trees instead
+        of fresh init — the facade for fine-tuning importer outputs
+        (reference: Optimizer takes the user's model instance with its
+        current weights).
+
+        The trees are copied: the jitted step donates its inputs, and
+        donating the caller's own buffers would delete them out from
+        under the caller."""
+        copy = lambda t: jax.tree.map(lambda a: jnp.array(a), t)  # noqa: E731
+        self._initial_trees = {"params": copy(params),
+                               "model_state": copy(model_state or {})}
+        self._resume_trees = dict(self._initial_trees)
+        return self
+
     # -------------------------------------------------------------- optimize
     def optimize(self) -> Tuple[Dict, Dict]:
         rng = jax.random.PRNGKey(self.seed)
         if hasattr(self, "_resume_trees"):
-            params = self._resume_trees["params"]
-            model_state = self._resume_trees["model_state"]
-            slots = self._resume_trees.get("slots", self.method.init_slots(params))
+            # copy before handing to the donating step: _resume_trees (and
+            # any caller alias of it) must survive the donation
+            copy = lambda t: jax.tree.map(lambda a: jnp.array(a), t)  # noqa: E731
+            params = copy(self._resume_trees["params"])
+            model_state = copy(self._resume_trees["model_state"])
+            slots = copy(self._resume_trees["slots"]) \
+                if "slots" in self._resume_trees \
+                else self.method.init_slots(params)
         else:
             params, model_state = self.model.init(
                 jax.random.fold_in(rng, 0xBD1))
@@ -490,10 +510,19 @@ class Optimizer:
                             "checkpoint", e, len(failures), retries)
                 if not self.resume(self.ckpt_path):
                     # no snapshot yet — discard the mutated counters from the
-                    # failed run so triggers/progress restart from scratch
-                    log.warning("no snapshot found; retrying from scratch")
+                    # failed run so triggers/progress restart from scratch;
+                    # user-supplied initial trees (set_initial) are restored,
+                    # NOT thrown away — a pre-snapshot failure must not turn
+                    # fine-tuning into from-scratch training
+                    log.warning("no snapshot found; retrying from %s",
+                                "initial trees"
+                                if hasattr(self, "_initial_trees")
+                                else "scratch")
                     self.state = {"epoch": 0, "neval": 0, "records": 0}
-                    self.__dict__.pop("_resume_trees", None)
+                    if hasattr(self, "_initial_trees"):
+                        self._resume_trees = dict(self._initial_trees)
+                    else:
+                        self.__dict__.pop("_resume_trees", None)
                     self.__dict__.pop("_last_val_neval", None)
                     self.__dict__.pop("_last_ckpt_neval", None)
 

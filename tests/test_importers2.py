@@ -267,3 +267,38 @@ def test_caffe_v1_layers_spelling(tmp_path):
     x = jnp.asarray(np.random.RandomState(0).randn(1, 6, 6, 2), jnp.float32)
     out, _ = cn.module.apply(cn.params, cn.state, x, training=False)
     assert out.shape == (1, 6, 6, 3)
+
+
+def test_tf_training_session_fine_tunes_imported_graph():
+    """(reference: utils/tf/Session.scala BigDLSessionImpl.train)."""
+    import numpy as np
+    import jax.numpy as jnp
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import ArrayDataSet
+    from bigdl_tpu.interop.tensorflow import make_node
+    from bigdl_tpu.interop.tf_session import TFTrainingSession
+    from bigdl_tpu.optim.method import SGD
+    from bigdl_tpu.optim.trigger import Trigger
+
+    r = np.random.RandomState(0)
+    w = (0.1 * r.randn(6, 2)).astype(np.float32)
+    b = np.zeros(2, np.float32)
+    graph = b"".join([
+        make_node("x", "Placeholder"),
+        make_node("w", "Const", tensor=w),
+        make_node("mm", "MatMul", ["x", "w"]),
+        make_node("b", "Const", tensor=b),
+        make_node("logits", "BiasAdd", ["mm", "b"]),
+    ])
+    x = r.randn(256, 6).astype(np.float32)
+    y = (x[:, 0] - x[:, 1] > 0).astype(np.int32)
+
+    sess = TFTrainingSession(graph, inputs=["x"], outputs=["logits"],
+                             criterion=nn.CrossEntropyCriterion())
+    before = np.asarray(sess.predict(x))
+    acc0 = float((np.argmax(before, 1) == y).mean())
+    sess.train(ArrayDataSet(x, y, 32, drop_last=True), SGD(0.5),
+               Trigger.max_epoch(10))
+    after = np.asarray(sess.predict(x))
+    acc1 = float((np.argmax(after, 1) == y).mean())
+    assert acc1 > 0.95 and acc1 > acc0

@@ -220,3 +220,49 @@ def test_predictor_empty_and_bucket():
     assert svc._bucket(200) == 100
     out = svc.predict(np.zeros((0, 4), np.float32))
     assert out.shape == (0, 3)
+
+
+def test_set_initial_survives_donation_and_retry(tmp_path):
+    """set_initial trees must survive the donating step and a pre-snapshot
+    retry (fine-tuning must never silently restart from scratch)."""
+    import numpy as np
+    import jax
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import ArrayDataSet
+    from bigdl_tpu.optim.local import Optimizer
+    from bigdl_tpu.optim.method import SGD
+    from bigdl_tpu.optim.trigger import Trigger
+
+    r = np.random.RandomState(0)
+    x = r.randn(32, 4).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int32)
+    model = nn.Sequential(nn.Linear(4, 2), nn.LogSoftMax())
+    init_p, init_s = model.init(jax.random.PRNGKey(7))
+    # make the supplied trees unmistakable: huge weights that one epoch of
+    # lr-0.1 SGD cannot move anywhere near a random re-init (~0.x scale)
+    init_p = {"0": {"weight": init_p["0"]["weight"] + 5.0,
+                    "bias": init_p["0"]["bias"]}, "1": {}}
+    marker = float(np.asarray(init_p["0"]["weight"])[0, 0])
+
+    ds = ArrayDataSet(x, y, 8, drop_last=True)
+    opt = Optimizer(model, ds, nn.ClassNLLCriterion(), SGD(0.1))
+    opt.set_initial(init_p, init_s)
+    opt.set_end_when(Trigger.max_epoch(1))
+    opt.set_checkpoint(str(tmp_path), Trigger.every_epoch())
+
+    # inject a failure on the FIRST validate call (before any snapshot)
+    calls = {"n": 0}
+    real = opt._maybe_validate
+
+    def flaky(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("injected pre-snapshot fault")
+        return real(*a, **kw)
+    opt._maybe_validate = flaky
+    opt.optimize_with_retry(retries=2, window_s=60)
+    # caller's trees are intact (not donated away)
+    assert float(np.asarray(init_p["0"]["weight"])[0, 0]) == marker
+    # the retry restarted from the supplied trees, not a random re-init:
+    # weights remain at the "huge" scale of the initial trees
+    assert float(np.abs(np.asarray(opt.params["0"]["weight"])).mean()) > 2.0
