@@ -233,12 +233,14 @@ class Optimizer:
         (reference: Optimizer takes the user's model instance with its
         current weights).
 
-        The trees are copied: the jitted step donates its inputs, and
-        donating the caller's own buffers would delete them out from
-        under the caller."""
-        copy = lambda t: jax.tree.map(lambda a: jnp.array(a), t)  # noqa: E731
-        self._initial_trees = {"params": copy(params),
-                               "model_state": copy(model_state or {})}
+        Donation safety: optimize() copies these trees before handing them
+        to the donating jitted step, so the caller's buffers survive.
+        With `model_state` omitted, a fresh state skeleton is initialised
+        from the model (containers index per-child state — an empty dict
+        would KeyError at the first forward)."""
+        if model_state is None:
+            _, model_state = self.model.init(jax.random.PRNGKey(self.seed))
+        self._initial_trees = {"params": params, "model_state": model_state}
         self._resume_trees = dict(self._initial_trees)
         return self
 

@@ -266,3 +266,25 @@ def test_set_initial_survives_donation_and_retry(tmp_path):
     # the retry restarted from the supplied trees, not a random re-init:
     # weights remain at the "huge" scale of the initial trees
     assert float(np.abs(np.asarray(opt.params["0"]["weight"])).mean()) > 2.0
+
+
+def test_set_initial_without_state_builds_skeleton():
+    import numpy as np
+    import jax
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import ArrayDataSet
+    from bigdl_tpu.optim.local import Optimizer
+    from bigdl_tpu.optim.method import SGD
+    from bigdl_tpu.optim.trigger import Trigger
+    r = np.random.RandomState(0)
+    x = r.randn(16, 4).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int32)
+    model = nn.Sequential(nn.Linear(4, 8), nn.BatchNormalization(8),
+                          nn.ReLU(), nn.Linear(8, 2), nn.LogSoftMax())
+    p, _ = model.init(jax.random.PRNGKey(0))
+    opt = Optimizer(model, ArrayDataSet(x, y, 8, drop_last=True),
+                    nn.ClassNLLCriterion(), SGD(0.1))
+    opt.set_initial(p)               # no model_state: skeleton generated
+    opt.set_end_when(Trigger.max_epoch(1))
+    params, state = opt.optimize()   # must not KeyError on container state
+    assert "1" in state and "running_mean" in state["1"]
