@@ -156,6 +156,59 @@ def _bench_lenet(batch_size=512, warmup=3, iters=20):
     return batch_size / sec
 
 
+def _bench_lm(which="transformer", batch_size=None, seq_len=None,
+              warmup=None, iters=None):
+    """Tokens/sec for the PTB LM configs (BASELINE: LSTM PTB; the
+    transformer is the parity-plus long-context variant)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu.models import rnn as rnn_zoo
+    from bigdl_tpu.nn.criterion import (ClassNLLCriterion,
+                                        CrossEntropyCriterion)
+    from bigdl_tpu.optim.method import Adam
+
+    on_tpu = jax.default_backend() != "cpu"
+    batch_size = batch_size or (32 if on_tpu else 4)
+    seq_len = seq_len or (128 if on_tpu else 32)
+    warmup = warmup or (2 if on_tpu else 1)
+    iters = iters or (10 if on_tpu else 2)
+    vocab = 10000
+
+    if which == "lstm":
+        model = rnn_zoo.build_lstm(vocab)
+        criterion = ClassNLLCriterion()
+    else:
+        model = rnn_zoo.build_transformer(vocab)
+        criterion = CrossEntropyCriterion()
+    method = Adam(1e-3)
+    params, state = model.init(jax.random.PRNGKey(0))
+    slots = method.init_slots(params)
+
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.randint(0, vocab, (batch_size, seq_len)), jnp.int32)
+    y = jnp.asarray(r.randint(0, vocab, (batch_size, seq_len)), jnp.int32)
+
+    def step(params, slots, model_state, x, y):
+        def loss_fn(p):
+            out, ns = model.apply(p, model_state, x, training=True,
+                                  rng=jax.random.PRNGKey(3))
+            return criterion.forward(out.reshape(-1, vocab),
+                                     y.reshape(-1)), ns
+        (loss, ns), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        new_p, new_s = method.update(params, grads, slots, jnp.float32(1e-3),
+                                     jnp.int32(0))
+        return new_p, new_s, ns, loss
+
+    jitted = jax.jit(step, donate_argnums=(0, 1, 2))
+    compiled = jitted.lower(params, slots, state, x, y).compile()
+    sec = _time_steps(lambda c: compiled(c[0], c[1], c[2], x, y),
+                      (params, slots, state, jnp.float32(0.0)),
+                      warmup, iters)
+    return batch_size * seq_len / sec
+
+
 def child_main():
     from bigdl_tpu.utils.platform import force_cpu_if_requested
     force_cpu_if_requested()
@@ -183,6 +236,16 @@ def child_main():
             "metric": "lenet_mnist_train_throughput",
             "value": round(ips, 1),
             "unit": "images/sec",
+            "vs_baseline": 1.0,
+            "backend": backend,
+        }))
+        return
+    if which in ("lstm", "transformer"):
+        tps = _bench_lm(which)
+        print(json.dumps({
+            "metric": f"{which}_ptb_train_throughput",
+            "value": round(tps, 1),
+            "unit": "tokens/sec",
             "vs_baseline": 1.0,
             "backend": backend,
         }))
@@ -266,11 +329,17 @@ def parent_main():
         tail = (r.stderr or r.stdout or "")[-500:].replace("\n", " | ")
         errors.append(f"{name}: rc={r.returncode} {tail}")
     which = sys.argv[1] if len(sys.argv) > 1 else "resnet50"
+    metrics = {
+        "lenet": ("lenet_mnist_train_throughput", "images/sec"),
+        "lstm": ("lstm_ptb_train_throughput", "tokens/sec"),
+        "transformer": ("transformer_ptb_train_throughput", "tokens/sec"),
+    }
+    metric, unit = metrics.get(
+        which, ("resnet50_imagenet_train_throughput_per_chip", "images/sec"))
     print(json.dumps({
-        "metric": ("lenet_mnist_train_throughput" if which == "lenet"
-                   else "resnet50_imagenet_train_throughput_per_chip"),
+        "metric": metric,
         "value": 0.0,
-        "unit": "images/sec",
+        "unit": unit,
         "vs_baseline": 0.0,
         "error": "; ".join(errors)[:2000],
     }))

@@ -1,0 +1,140 @@
+"""Golden-model parity, part 4 — attention vs torch MultiheadAttention
+(weight-for-weight), similarity layers, lookup/shape ops (analogue of the
+reference's Torch7 golden specs)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax                                                   # noqa: E402
+import jax.numpy as jnp                                      # noqa: E402
+
+import bigdl_tpu.nn as nn                                    # noqa: E402
+
+
+def _j2t(x):
+    return torch.from_numpy(np.asarray(x).copy())
+
+
+def test_multihead_attention_matches_torch():
+    d, h, t, b = 16, 4, 6, 2
+    r = np.random.RandomState(0)
+    m = nn.MultiHeadAttention(d, h)
+    params, state = m.init(jax.random.PRNGKey(0))
+    x = r.randn(b, t, d).astype(np.float32)
+    out, _ = m.apply(params, state, jnp.asarray(x))
+
+    tm = torch.nn.MultiheadAttention(d, h, batch_first=True, bias=False)
+    with torch.no_grad():
+        # torch packs in_proj as rows [q; k; v], each (d, d) with y = W x
+        # (left-multiply); ours are (d, d) right-multiply -> transpose
+        packed = np.concatenate([np.asarray(params["wq"]).T,
+                                 np.asarray(params["wk"]).T,
+                                 np.asarray(params["wv"]).T], axis=0)
+        tm.in_proj_weight.copy_(_j2t(packed))
+        tm.out_proj.weight.copy_(_j2t(np.asarray(params["wo"]).T))
+    want, _ = tm(_j2t(x), _j2t(x), _j2t(x), need_weights=False)
+    np.testing.assert_allclose(np.asarray(out), want.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_multihead_attention_causal_matches_torch():
+    d, h, t = 8, 2, 5
+    r = np.random.RandomState(1)
+    m = nn.MultiHeadAttention(d, h)
+    params, state = m.init(jax.random.PRNGKey(1))
+    x = r.randn(1, t, d).astype(np.float32)
+    out, _ = m.apply(params, state, jnp.asarray(x), causal=True)
+
+    tm = torch.nn.MultiheadAttention(d, h, batch_first=True, bias=False)
+    with torch.no_grad():
+        packed = np.concatenate([np.asarray(params["wq"]).T,
+                                 np.asarray(params["wk"]).T,
+                                 np.asarray(params["wv"]).T], axis=0)
+        tm.in_proj_weight.copy_(_j2t(packed))
+        tm.out_proj.weight.copy_(_j2t(np.asarray(params["wo"]).T))
+    causal = torch.triu(torch.ones(t, t, dtype=torch.bool), diagonal=1)
+    want, _ = tm(_j2t(x), _j2t(x), _j2t(x), attn_mask=causal,
+                 need_weights=False)
+    np.testing.assert_allclose(np.asarray(out), want.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_cosine_and_euclidean_layers():
+    # Cosine: per-class cosine similarity to weight rows
+    # (reference: nn/Cosine.scala); Euclidean: distances (nn/Euclidean.scala)
+    r = np.random.RandomState(2)
+    x = r.randn(4, 6).astype(np.float32)
+    cos = nn.Cosine(6, 3)
+    p, _ = cos.init(jax.random.PRNGKey(2))
+    out = np.asarray(cos.forward(p, jnp.asarray(x)))
+    w = np.asarray(p["weight"])         # (out, in) or (in, out)?
+    if w.shape == (3, 6):
+        wm = w
+    else:
+        wm = w.T
+    want = np.stack([
+        (x @ wm[k]) / np.maximum(np.linalg.norm(x, axis=1)
+                                 * np.linalg.norm(wm[k]), 1e-12)
+        for k in range(3)], axis=1)
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+    euc = nn.Euclidean(6, 3)
+    p2, _ = euc.init(jax.random.PRNGKey(3))
+    out2 = np.asarray(euc.forward(p2, jnp.asarray(x)))
+    w2 = np.asarray(p2["weight"])
+    wm2 = w2 if w2.shape == (3, 6) else w2.T
+    want2 = np.stack([np.linalg.norm(x - wm2[k], axis=1) for k in range(3)],
+                     axis=1)
+    np.testing.assert_allclose(out2, want2, rtol=1e-4, atol=1e-5)
+
+
+def test_lookup_table_matches_torch_embedding():
+    r = np.random.RandomState(3)
+    m = nn.LookupTable(10, 5)
+    p, _ = m.init(jax.random.PRNGKey(4))
+    idx = r.randint(0, 10, (4, 7)).astype(np.int32)
+    out = np.asarray(m.forward(p, jnp.asarray(idx)))
+    te = torch.nn.Embedding(10, 5)
+    with torch.no_grad():
+        te.weight.copy_(_j2t(p["weight"]))
+    want = te(_j2t(idx).long()).detach().numpy()
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def test_mm_mv_dot_match_torch():
+    r = np.random.RandomState(4)
+    a = r.randn(2, 3, 4).astype(np.float32)
+    b = r.randn(2, 4, 5).astype(np.float32)
+    out = np.asarray(nn.MM().forward({}, (jnp.asarray(a), jnp.asarray(b))))
+    np.testing.assert_allclose(out, np.matmul(a, b), rtol=1e-5)
+    v = r.randn(2, 4).astype(np.float32)
+    out2 = np.asarray(nn.MV().forward({}, (jnp.asarray(a), jnp.asarray(v))))
+    want2 = np.einsum("bij,bj->bi", a, v)
+    np.testing.assert_allclose(out2, want2, rtol=1e-5)
+    d1 = r.randn(3, 8).astype(np.float32)
+    d2 = r.randn(3, 8).astype(np.float32)
+    out3 = np.asarray(nn.DotProduct().forward({}, (jnp.asarray(d1),
+                                                   jnp.asarray(d2))))
+    np.testing.assert_allclose(out3, (d1 * d2).sum(1), rtol=1e-5)
+
+
+def test_gaussian_noise_and_dropout_statistics():
+    r = jax.random.PRNGKey(0)
+    x = jnp.ones((2000, 8))
+    gn = nn.GaussianNoise(stddev=0.5)
+    out, _ = gn.apply({}, {}, x, training=True, rng=r)
+    noise = np.asarray(out) - 1.0
+    assert abs(float(noise.mean())) < 0.02
+    assert abs(float(noise.std()) - 0.5) < 0.02
+    # eval mode: identity
+    out_eval, _ = gn.apply({}, {}, x, training=False)
+    np.testing.assert_allclose(np.asarray(out_eval), np.asarray(x))
+
+    gd = nn.GaussianDropout(rate=0.3)
+    out2, _ = gd.apply({}, {}, x, training=True, rng=r)
+    mult = np.asarray(out2)
+    # multiplicative noise with mean 1, std sqrt(rate/(1-rate))
+    assert abs(float(mult.mean()) - 1.0) < 0.03
+    assert abs(float(mult.std()) - np.sqrt(0.3 / 0.7)) < 0.05
