@@ -31,6 +31,16 @@ import time
 PROXY_BASELINE_IPS = 50.0     # fp32 ResNet-50, 2-socket Xeon proxy (see above)
 _CHILD_FLAG = "_BIGDL_TPU_BENCH_CHILD"
 
+# one table for BOTH the child's success JSON and the parent's failure
+# JSON — the metric names must never drift between the two paths
+_METRICS = {
+    "resnet50": ("resnet50_imagenet_train_throughput_per_chip",
+                 "images/sec"),
+    "lenet": ("lenet_mnist_train_throughput", "images/sec"),
+    "lstm": ("lstm_ptb_train_throughput", "tokens/sec"),
+    "transformer": ("transformer_ptb_train_throughput", "tokens/sec"),
+}
+
 # bf16 peak FLOPs/sec per chip, keyed by substring of device_kind
 _PEAK_FLOPS = [
     ("v6", 918e12), ("v5p", 459e12), ("v5", 197e12),
@@ -232,20 +242,22 @@ def child_main():
 
     if which == "lenet":
         ips = _bench_lenet()
+        metric, unit = _METRICS["lenet"]
         print(json.dumps({
-            "metric": "lenet_mnist_train_throughput",
+            "metric": metric,
             "value": round(ips, 1),
-            "unit": "images/sec",
+            "unit": unit,
             "vs_baseline": 1.0,
             "backend": backend,
         }))
         return
     if which in ("lstm", "transformer"):
         tps = _bench_lm(which)
+        metric, unit = _METRICS[which]
         print(json.dumps({
-            "metric": f"{which}_ptb_train_throughput",
+            "metric": metric,
             "value": round(tps, 1),
-            "unit": "tokens/sec",
+            "unit": unit,
             "vs_baseline": 1.0,
             "backend": backend,
         }))
@@ -329,13 +341,7 @@ def parent_main():
         tail = (r.stderr or r.stdout or "")[-500:].replace("\n", " | ")
         errors.append(f"{name}: rc={r.returncode} {tail}")
     which = sys.argv[1] if len(sys.argv) > 1 else "resnet50"
-    metrics = {
-        "lenet": ("lenet_mnist_train_throughput", "images/sec"),
-        "lstm": ("lstm_ptb_train_throughput", "tokens/sec"),
-        "transformer": ("transformer_ptb_train_throughput", "tokens/sec"),
-    }
-    metric, unit = metrics.get(
-        which, ("resnet50_imagenet_train_throughput_per_chip", "images/sec"))
+    metric, unit = _METRICS.get(which, _METRICS["resnet50"])
     print(json.dumps({
         "metric": metric,
         "value": 0.0,

@@ -69,11 +69,8 @@ def test_cosine_and_euclidean_layers():
     cos = nn.Cosine(6, 3)
     p, _ = cos.init(jax.random.PRNGKey(2))
     out = np.asarray(cos.forward(p, jnp.asarray(x)))
-    w = np.asarray(p["weight"])         # (out, in) or (in, out)?
-    if w.shape == (3, 6):
-        wm = w
-    else:
-        wm = w.T
+    wm = np.asarray(p["weight"])
+    assert wm.shape == (3, 6)           # (n_out, n_in), misc.py layout
     want = np.stack([
         (x @ wm[k]) / np.maximum(np.linalg.norm(x, axis=1)
                                  * np.linalg.norm(wm[k]), 1e-12)
@@ -83,8 +80,8 @@ def test_cosine_and_euclidean_layers():
     euc = nn.Euclidean(6, 3)
     p2, _ = euc.init(jax.random.PRNGKey(3))
     out2 = np.asarray(euc.forward(p2, jnp.asarray(x)))
-    w2 = np.asarray(p2["weight"])
-    wm2 = w2 if w2.shape == (3, 6) else w2.T
+    wm2 = np.asarray(p2["weight"])
+    assert wm2.shape == (3, 6)
     want2 = np.stack([np.linalg.norm(x - wm2[k], axis=1) for k in range(3)],
                      axis=1)
     np.testing.assert_allclose(out2, want2, rtol=1e-4, atol=1e-5)
