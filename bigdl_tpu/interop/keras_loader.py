@@ -672,6 +672,217 @@ def _b_layernorm(cfg, shapes):
     return m, shapes[0], adapter
 
 
+# ----------------------------------------------- keras-1 tail builders
+def _reject_weights(label):
+    """Weight adapter that refuses HDF5 weights — loader policy is to
+    raise rather than silently keep random init."""
+    def adapter(wts):
+        if wts:
+            raise NotImplementedError(
+                f"{label}: HDF5 weight import is not supported (the keras "
+                f"kernel layout has no registered mapping); constructor-API "
+                f"use (no weights) is fine")
+        return {}, {}
+    return adapter
+
+
+def _b_cropping1d(cfg, shapes):
+    b_, t, c = shapes[0]
+    if t is None:
+        raise NotImplementedError(
+            "Cropping1D needs a known time dimension (Narrow is static)")
+    a, b = _pair(cfg.get("cropping", (1, 1)))
+    return (nn.Narrow(1, a, t - a - b), (b_, t - a - b, c), _NO_W)
+
+
+def _norm_crop2(crop):
+    if isinstance(crop, int):
+        return (crop, crop), (crop, crop)
+    if isinstance(crop[0], (list, tuple)):
+        return tuple(crop[0]), tuple(crop[1])
+    return (crop[0], crop[0]), (crop[1], crop[1])
+
+
+def _b_cropping2d(cfg, shapes):
+    b_, h, w, c = shapes[0]
+    (t, bo), (l, r) = _norm_crop2(cfg.get("cropping", ((0, 0), (0, 0))))
+    sub = lambda v, d: None if v is None else v - d  # noqa: E731
+    return (nn.Cropping2D((t, bo), (l, r)),
+            (b_, sub(h, t + bo), sub(w, l + r), c), _NO_W)
+
+
+def _b_cropping3d(cfg, shapes):
+    b_, d, h, w, c = shapes[0]
+    crop = cfg.get("cropping", ((1, 1), (1, 1), (1, 1)))
+    (d0, d1), (h0, h1), (w0, w1) = crop
+    return (nn.Cropping3D((d0, d1), (h0, h1), (w0, w1)),
+            (b_, d - d0 - d1, h - h0 - h1, w - w0 - w1, c), _NO_W)
+
+
+def _b_pool3d(cls):
+    def build(cfg, shapes):
+        b_, d, h, w, c = shapes[0]
+        kd, kh, kw = cfg.get("pool_size", (2, 2, 2))
+        st = cfg.get("strides") or (kd, kh, kw)
+        sd, sh, sw = st
+        if cfg.get("padding", "valid") == "same":
+            raise NotImplementedError(f"{cls}Pooling3D: SAME padding")
+        m = (nn.VolumetricMaxPooling if cls == "max"
+             else nn.VolumetricAveragePooling)(kd, kw, kh, sd, sw, sh)
+        out = (b_, (d - kd) // sd + 1, (h - kh) // sh + 1,
+               (w - kw) // sw + 1, c)
+        return m, out, _NO_W
+    return build
+
+
+def _b_avgpool1d(cfg, shapes):
+    b_, t, c = shapes[0]
+    k = cfg.get("pool_size", 2)
+    k = k[0] if isinstance(k, (list, tuple)) else k
+    s = cfg.get("strides") or k
+    s = s[0] if isinstance(s, (list, tuple)) else s
+    if cfg.get("padding", "valid") == "same":
+        raise NotImplementedError("AveragePooling1D: SAME padding")
+    ot = None if t is None else (t - k) // s + 1
+    return nn.TemporalAveragePooling(k, s), (b_, ot, c), _NO_W
+
+
+class _GlobalPool3D(Module):
+    def __init__(self, mode):
+        super().__init__()
+        self._mode = mode
+
+    def forward(self, params, x, **_):
+        fn = jnp.mean if self._mode == "avg" else jnp.max
+        return fn(x, axis=(1, 2, 3))
+
+
+def _b_upsample1d(cfg, shapes):
+    b_, t, c = shapes[0]
+    n = cfg.get("size", 2)
+    n = n[0] if isinstance(n, (list, tuple)) else n
+    return nn.UpSampling1D(n), (b_, None if t is None else t * n, c), _NO_W
+
+
+def _b_upsample3d(cfg, shapes):
+    b_, d, h, w, c = shapes[0]
+    sd, sh, sw = cfg.get("size", (2, 2, 2))
+    return (nn.UpSampling3D((sd, sh, sw)),
+            (b_, d * sd, h * sh, w * sw, c), _NO_W)
+
+
+def _b_zeropad1d(cfg, shapes):
+    b_, t, c = shapes[0]
+    a, b = _pair(cfg.get("padding", 1))
+    m = nn.Sequential(nn.Padding(1, -a), nn.Padding(1, b)) if a else \
+        nn.Padding(1, b)
+    return m, (b_, None if t is None else t + a + b, c), _NO_W
+
+
+def _b_zeropad3d(cfg, shapes):
+    b_, d, h, w, c = shapes[0]
+    pd, ph, pw = cfg.get("padding", (1, 1, 1))
+    m = nn.Sequential(nn.Padding(1, -pd), nn.Padding(1, pd),
+                      nn.Padding(2, -ph), nn.Padding(2, ph),
+                      nn.Padding(3, -pw), nn.Padding(3, pw))
+    return m, (b_, d + 2 * pd, h + 2 * ph, w + 2 * pw, c), _NO_W
+
+
+def _b_thresholded_relu(cfg, shapes):
+    theta = cfg.get("theta", 1.0)
+    return nn.Threshold(theta, 0.0), shapes[0], _NO_W
+
+
+def _b_gaussian(cls):
+    # keras-1 spellings (sigma/p) are renamed by _canon_cfg before dispatch
+    def build(cfg, shapes):
+        if cls == "noise":
+            return nn.GaussianNoise(cfg.get("stddev", 1.0)), shapes[0], _NO_W
+        return nn.GaussianDropout(cfg.get("rate", 0.5)), shapes[0], _NO_W
+    return build
+
+
+def _b_conv3d(cfg, shapes):
+    # keras-1 fields (kernel_dim*/nb_filter/subsample/border_mode/bias) are
+    # renamed by _canon_cfg before dispatch
+    b_, d, h, w, cin = shapes[0]
+    kd, kh, kw = cfg["kernel_size"]
+    sd, sh, sw = cfg.get("strides", (1, 1, 1))
+    if cfg.get("padding", "valid") == "same":
+        raise NotImplementedError("Conv3D: SAME padding (pad explicitly)")
+    filters = cfg["filters"]
+    use_bias = cfg.get("use_bias", True)
+    m = nn.VolumetricConvolution(cin, filters, kd, kw, kh, sd, sw, sh,
+                                 bias=use_bias)
+
+    def adapter(wts):
+        p = {"weight": wts[0]}
+        if len(wts) > 1:
+            p["bias"] = wts[1]
+        return p, {}
+    out = (b_, (d - kd) // sd + 1, (h - kh) // sh + 1,
+           (w - kw) // sw + 1, filters)
+    m, adapter = _maybe_act(m, cfg, adapter)
+    return m, out, adapter
+
+
+def _b_locally_connected2d(cfg, shapes):
+    b_, h, w, cin = shapes[0]
+    kh, kw = _pair(cfg["kernel_size"])
+    sh, sw = _pair(cfg.get("strides", 1))
+    if cfg.get("padding", "valid") == "same":
+        raise NotImplementedError("LocallyConnected2D: SAME padding")
+    filters = cfg["filters"]
+    m = nn.LocallyConnected2D(cin, w, h, filters, kw, kh, sw, sh,
+                              bias=cfg.get("use_bias", True))
+    out = (b_, (h - kh) // sh + 1, (w - kw) // sw + 1, filters)
+    m, adapter = _maybe_act(m, cfg, _reject_weights("LocallyConnected2D"))
+    return m, out, adapter
+
+
+def _b_locally_connected1d(cfg, shapes):
+    b_, t, cin = shapes[0]
+    k = cfg["kernel_size"]
+    k = k[0] if isinstance(k, (list, tuple)) else k
+    s = cfg.get("strides", 1)
+    s = s[0] if isinstance(s, (list, tuple)) else s
+    filters = cfg["filters"]
+    m = nn.LocallyConnected1D(t, cin, filters, k, s,
+                              bias=cfg.get("use_bias", True))
+    out = (b_, (t - k) // s + 1, filters)
+    m, adapter2 = _maybe_act(m, cfg, _reject_weights("LocallyConnected1D"))
+    return m, out, adapter2
+
+
+def _b_convlstm2d(cfg, shapes):
+    b_, t, h, w, cin = shapes[0]
+    k = cfg["kernel_size"]
+    if isinstance(k, (list, tuple)):
+        if len(set(k)) != 1:
+            raise NotImplementedError(
+                f"ConvLSTM2D: non-square kernel {k}")
+        k = k[0]
+    st = cfg.get("strides", 1)
+    st = st if isinstance(st, int) else st[0] if len(set(st)) == 1 else None
+    if st != 1:
+        raise NotImplementedError("ConvLSTM2D: strides != 1")
+    if cfg.get("padding", "same") != "same":
+        raise NotImplementedError(
+            "ConvLSTM2D: only SAME padding (the cell keeps spatial dims)")
+    act = cfg.get("activation", "tanh")
+    if act not in (None, "tanh"):
+        raise NotImplementedError(f"ConvLSTM2D: activation {act!r}")
+    filters = cfg["filters"]
+    # keras ConvLSTM2D has no peepholes — default off; the reference's
+    # BigDL-flavored peephole variant stays available via the flag
+    cell = nn.ConvLSTMPeephole(cin, filters, k, (h, w),
+                               peephole=cfg.get("peephole", False))
+    ret_seq = cfg.get("return_sequences", False)
+    m = nn.Recurrent(cell, return_sequences=ret_seq)
+    out = (b_, t, h, w, filters) if ret_seq else (b_, h, w, filters)
+    return m, out, _reject_weights("ConvLSTM2D")
+
+
 _BUILDERS: Dict[str, Callable] = {
     "InputLayer": _b_input,
     "Dense": _b_dense,
@@ -716,11 +927,75 @@ _BUILDERS: Dict[str, Callable] = {
     "Softmax": _b_softmax_layer,
     "SpatialDropout1D": _b_spatialdropout(nn.SpatialDropout1D),
     "SpatialDropout2D": _b_spatialdropout(nn.SpatialDropout2D),
+    "SpatialDropout3D": _b_spatialdropout(nn.SpatialDropout3D),
     "Masking": _b_masking,
     "Highway": _b_highway,
     "MaxoutDense": _b_maxoutdense,
     "SReLU": _b_srelu,
+    # keras-1 tail
+    "Cropping1D": _b_cropping1d,
+    "Cropping2D": _b_cropping2d,
+    "Cropping3D": _b_cropping3d,
+    "MaxPooling3D": _b_pool3d("max"),
+    "AveragePooling3D": _b_pool3d("avg"),
+    "AveragePooling1D": _b_avgpool1d,
+    "GlobalAveragePooling3D": lambda c, s: (
+        _GlobalPool3D("avg"), (s[0][0], s[0][-1]), _NO_W),
+    "GlobalMaxPooling3D": lambda c, s: (
+        _GlobalPool3D("max"), (s[0][0], s[0][-1]), _NO_W),
+    "UpSampling1D": _b_upsample1d,
+    "UpSampling3D": _b_upsample3d,
+    "ZeroPadding1D": _b_zeropad1d,
+    "ZeroPadding3D": _b_zeropad3d,
+    "ThresholdedReLU": _b_thresholded_relu,
+    "GaussianNoise": _b_gaussian("noise"),
+    "GaussianDropout": _b_gaussian("dropout"),
+    "Conv3D": _b_conv3d, "Convolution3D": _b_conv3d,
+    "Deconvolution2D": _b_conv2d_transpose,
+    "AtrousConvolution2D": _b_conv2d,
+    "AtrousConvolution1D": _b_conv1d,
+    "SeparableConvolution2D": _b_sepconv2d,
+    "LocallyConnected1D": _b_locally_connected1d,
+    "LocallyConnected2D": _b_locally_connected2d,
+    "ConvLSTM2D": _b_convlstm2d,
 }
+
+
+# keras-1 → keras-2 config field names (the reference targets keras 1.2.2,
+# pyspark/bigdl/keras/converter.py; our builders read keras-2 names).
+# Unambiguous renames apply everywhere; names that keras-2 still uses with
+# a different meaning elsewhere (output_dim on Embedding, p, length...)
+# rename only for the classes that had the keras-1 spelling.
+_K1_FIELDS = {"nb_filter": "filters", "border_mode": "padding",
+              "subsample": "strides", "subsample_length": "strides",
+              "bias": "use_bias", "atrous_rate": "dilation_rate",
+              "filter_length": "kernel_size", "pool_length": "pool_size"}
+_K1_CLASS_FIELDS = {
+    "output_dim": ("units", {"Dense", "Highway", "MaxoutDense",
+                             "TimeDistributedDense"}),
+    "p": ("rate", {"Dropout", "SpatialDropout1D", "SpatialDropout2D",
+                   "SpatialDropout3D", "GaussianDropout"}),
+    "sigma": ("stddev", {"GaussianNoise"}),
+    "length": ("size", {"UpSampling1D"}),
+    "stride": ("strides", {"MaxPooling1D", "AveragePooling1D"}),
+}
+
+
+def _canon_cfg(class_name: str, cfg: dict) -> dict:
+    out = dict(cfg)
+    for old, new in _K1_FIELDS.items():
+        if old in out and new not in out:
+            out[new] = out.pop(old)
+    for old, (new, classes) in _K1_CLASS_FIELDS.items():
+        if class_name in classes and old in out and new not in out:
+            out[new] = out.pop(old)
+    if "nb_row" in out and "kernel_size" not in out:
+        out["kernel_size"] = (out.pop("nb_row"), out.pop("nb_col"))
+    if "kernel_dim1" in out and "kernel_size" not in out:
+        out["kernel_size"] = (out.pop("kernel_dim1"),
+                              out.pop("kernel_dim2"),
+                              out.pop("kernel_dim3"))
+    return out
 
 
 def _build_layer(class_name: str, cfg: dict, in_shapes: List[Shape]):
@@ -728,7 +1003,7 @@ def _build_layer(class_name: str, cfg: dict, in_shapes: List[Shape]):
         raise NotImplementedError(
             f"keras layer {class_name!r} has no converter "
             f"(reference: converter.py LayerConverter.create)")
-    return _BUILDERS[class_name](cfg, in_shapes)
+    return _BUILDERS[class_name](_canon_cfg(class_name, cfg), in_shapes)
 
 
 # ----------------------------------------------------------- model assembly

@@ -518,3 +518,115 @@ class Model(KerasModel):
 # Model.load cannot reconstruct the symbolic graph; return a plain
 # KerasModel (module tree + weights round-trip, like Sequential.load)
 Model.load = classmethod(lambda cls, path: KerasModel.load(path))
+
+
+# ------------------------------------------------------- keras-1 tail
+def Cropping1D(cropping=(1, 1), input_shape=None, name=None):
+    return _cfg("Cropping1D", input_shape, name, cropping=cropping)
+
+
+def Cropping2D(cropping=((0, 0), (0, 0)), input_shape=None, name=None):
+    return _cfg("Cropping2D", input_shape, name, cropping=cropping)
+
+
+def Cropping3D(cropping=((1, 1), (1, 1), (1, 1)), input_shape=None,
+               name=None):
+    return _cfg("Cropping3D", input_shape, name, cropping=cropping)
+
+
+def MaxPooling3D(pool_size=(2, 2, 2), strides=None, input_shape=None,
+                 name=None):
+    return _cfg("MaxPooling3D", input_shape, name, pool_size=pool_size,
+                strides=strides)
+
+
+def AveragePooling3D(pool_size=(2, 2, 2), strides=None, input_shape=None,
+                     name=None):
+    return _cfg("AveragePooling3D", input_shape, name, pool_size=pool_size,
+                strides=strides)
+
+
+def AveragePooling1D(pool_size=2, strides=None, input_shape=None, name=None):
+    return _cfg("AveragePooling1D", input_shape, name, pool_size=pool_size,
+                strides=strides)
+
+
+def GlobalAveragePooling3D(input_shape=None, name=None):
+    return _cfg("GlobalAveragePooling3D", input_shape, name)
+
+
+def GlobalMaxPooling3D(input_shape=None, name=None):
+    return _cfg("GlobalMaxPooling3D", input_shape, name)
+
+
+def UpSampling1D(size=2, input_shape=None, name=None):
+    return _cfg("UpSampling1D", input_shape, name, size=size)
+
+
+def UpSampling3D(size=(2, 2, 2), input_shape=None, name=None):
+    return _cfg("UpSampling3D", input_shape, name, size=size)
+
+
+def ZeroPadding1D(padding=1, input_shape=None, name=None):
+    return _cfg("ZeroPadding1D", input_shape, name, padding=padding)
+
+
+def ZeroPadding3D(padding=(1, 1, 1), input_shape=None, name=None):
+    return _cfg("ZeroPadding3D", input_shape, name, padding=padding)
+
+
+def ThresholdedReLU(theta=1.0, input_shape=None, name=None):
+    return _cfg("ThresholdedReLU", input_shape, name, theta=theta)
+
+
+def GaussianNoise(stddev, input_shape=None, name=None):
+    return _cfg("GaussianNoise", input_shape, name, stddev=stddev)
+
+
+def GaussianDropout(rate, input_shape=None, name=None):
+    return _cfg("GaussianDropout", input_shape, name, rate=rate)
+
+
+def SpatialDropout3D(rate, input_shape=None, name=None):
+    return _cfg("SpatialDropout3D", input_shape, name, rate=rate)
+
+
+def Conv3D(filters, kernel_size, strides=(1, 1, 1), activation=None,
+           use_bias=True, input_shape=None, name=None):
+    return _cfg("Conv3D", input_shape, name, filters=filters,
+                kernel_size=kernel_size, strides=strides,
+                activation=activation, use_bias=use_bias)
+
+
+def LocallyConnected1D(filters, kernel_size, strides=1, activation=None,
+                       use_bias=True, input_shape=None, name=None):
+    return _cfg("LocallyConnected1D", input_shape, name, filters=filters,
+                kernel_size=kernel_size, strides=strides,
+                activation=activation, use_bias=use_bias)
+
+
+def LocallyConnected2D(filters, kernel_size, strides=1, activation=None,
+                       use_bias=True, input_shape=None, name=None):
+    return _cfg("LocallyConnected2D", input_shape, name, filters=filters,
+                kernel_size=kernel_size, strides=strides,
+                activation=activation, use_bias=use_bias)
+
+
+def ConvLSTM2D(filters, kernel_size, return_sequences=False, peephole=True,
+               input_shape=None, name=None):
+    return _cfg("ConvLSTM2D", input_shape, name, filters=filters,
+                kernel_size=kernel_size, return_sequences=return_sequences,
+                peephole=peephole)
+
+
+# keras-1 constructor aliases (reference targets keras 1.2.2)
+Convolution1D = Conv1D
+Convolution2D = Conv2D
+Convolution3D = Conv3D
+Deconvolution2D = Conv2DTranspose
+AtrousConvolution1D = Conv1D
+AtrousConvolution2D = Conv2D
+SeparableConvolution2D = SeparableConv2D
+
+
+SoftMax = Softmax                       # keras-1 spelling (nn/keras/SoftMax)

@@ -12,7 +12,8 @@ from bigdl_tpu.nn.conv import (SpatialConvolution, SpatialDilatedConvolution,
                                LocallyConnected2D, TemporalConvolution,
                                VolumetricConvolution, VolumetricFullConvolution)
 from bigdl_tpu.nn.pooling import (SpatialMaxPooling, SpatialAveragePooling,
-                                  TemporalMaxPooling, VolumetricMaxPooling,
+                                  TemporalMaxPooling, TemporalAveragePooling,
+                                  VolumetricMaxPooling,
                                   VolumetricAveragePooling,
                                   SpatialAdaptiveMaxPooling, GlobalAveragePooling2D)
 from bigdl_tpu.nn.activation import (ReLU, ReLU6, Tanh, Sigmoid, ELU, SELU, GELU,

@@ -129,6 +129,22 @@ class TemporalMaxPooling(Module):
                                  (1, self.dw, 1), "VALID")
 
 
+class TemporalAveragePooling(Module):
+    """1D average pool over (N, T, C) — the keras AveragePooling1D
+    counterpart of TemporalMaxPooling (reference: nn/keras/Pooling1D.scala
+    average branch)."""
+
+    def __init__(self, k_w: int, d_w: Optional[int] = None,
+                 name: Optional[str] = None):
+        super().__init__(name=name)
+        self.kw, self.dw = k_w, d_w or k_w
+
+    def forward(self, params, x, **_):
+        s = lax.reduce_window(x, 0.0, lax.add, (1, self.kw, 1),
+                              (1, self.dw, 1), "VALID")
+        return s / self.kw
+
+
 class VolumetricMaxPooling(Module):
     """3D max pool over (N, D, H, W, C) (reference:
     nn/VolumetricMaxPooling.scala)."""

@@ -168,3 +168,71 @@ def test_functional_model_save_load(tmp_path):
     xv = np.random.RandomState(8).randn(2, 4).astype(np.float32)
     np.testing.assert_allclose(lm.predict(xv, batch_size=2),
                                m.predict(xv, batch_size=2), atol=1e-6)
+
+
+def test_keras1_tail_layers_forward():
+    """Every new keras-1-tail constructor builds and runs a forward pass
+    with the inferred shapes (reference: nn/keras/ layer files)."""
+    model = kl.Sequential(
+        kl.ZeroPadding1D(2, input_shape=(10, 4)),
+        kl.Cropping1D((1, 1)),
+        kl.Convolution1D(8, 3),                # keras-1 alias
+        kl.AveragePooling1D(2),
+        kl.UpSampling1D(2),
+        kl.GaussianNoise(0.1),
+        kl.ThresholdedReLU(0.0),
+        kl.GlobalMaxPooling1D(),
+        kl.Dense(3))
+    model.build()
+    x = np.random.RandomState(0).randn(2, 10, 4).astype(np.float32)
+    out = model.predict(x)
+    assert out.shape == (2, 3)
+
+
+def test_keras1_3d_stack():
+    model = kl.Sequential(
+        kl.ZeroPadding3D((1, 1, 1), input_shape=(4, 6, 6, 2)),
+        kl.Conv3D(4, (3, 3, 3)),
+        kl.MaxPooling3D((2, 2, 2)),
+        kl.UpSampling3D((2, 2, 2)),
+        kl.Cropping3D(((0, 0), (1, 1), (1, 1))),
+        kl.GlobalAveragePooling3D(),
+        kl.Dense(2))
+    model.build()
+    x = np.random.RandomState(1).randn(2, 4, 6, 6, 2).astype(np.float32)
+    out = model.predict(x)
+    assert out.shape == (2, 2)
+
+
+def test_locally_connected_and_convlstm():
+    model = kl.Sequential(
+        kl.LocallyConnected2D(4, (3, 3), activation="relu",
+                              input_shape=(8, 8, 2)),
+        kl.GlobalMaxPooling2D(),
+        kl.Dense(2))
+    model.build()
+    x = np.random.RandomState(2).randn(2, 8, 8, 2).astype(np.float32)
+    assert model.predict(x).shape == (2, 2)
+
+    m2 = kl.Sequential(
+        kl.ConvLSTM2D(3, 3, input_shape=(5, 6, 6, 2)),
+        kl.GlobalAveragePooling2D(),
+        kl.Dense(2))
+    m2.build()
+    x2 = np.random.RandomState(3).randn(2, 5, 6, 6, 2).astype(np.float32)
+    assert m2.predict(x2).shape == (2, 2)
+
+
+def test_keras1_field_name_canonicalization():
+    # keras-1 JSON configs (nb_filter/nb_row/nb_col/border_mode/subsample)
+    # resolve through the same builders
+    from bigdl_tpu.interop.keras_loader import _build_layer
+    m, out, _ = _build_layer("Convolution2D",
+                             {"nb_filter": 6, "nb_row": 3, "nb_col": 3,
+                              "border_mode": "same",
+                              "subsample": (1, 1), "bias": True},
+                             [(None, 8, 8, 3)])
+    assert out == (None, 8, 8, 6)
+    m2, out2, _ = _build_layer("Dense", {"output_dim": 7},
+                               [(None, 4)])
+    assert out2 == (None, 7)

@@ -345,3 +345,39 @@ def test_keras1_highway_maxout_srelu(tmp_path):
     y = np.where(mx < tl, tl + al * (mx - tl), mx)
     y = np.where(mx > tr, tr + ar * (mx - tr), y)
     np.testing.assert_allclose(np.asarray(got), y, atol=1e-5)
+
+
+def test_keras1_tail_guardrails():
+    """Unsupported configs raise; weightless use works; None time dims
+    propagate (reference policy: raise, never silently-wrong numerics)."""
+    import pytest
+    from bigdl_tpu.interop.keras_loader import _build_layer
+
+    # None time dim propagates through the shape pass
+    _, out, _ = _build_layer("UpSampling1D", {"size": 3},
+                             [(None, None, 4)])
+    assert out == (None, None, 4)
+    _, out2, _ = _build_layer("ZeroPadding1D", {"padding": 2},
+                              [(None, None, 4)])
+    assert out2 == (None, None, 4)
+    with pytest.raises(NotImplementedError, match="Cropping1D"):
+        _build_layer("Cropping1D", {"cropping": (1, 1)}, [(None, None, 4)])
+
+    # int cropping normalizes
+    _, out3, _ = _build_layer("Cropping2D", {"cropping": 2},
+                              [(None, 10, 10, 3)])
+    assert out3 == (None, 6, 6, 3)
+
+    # ConvLSTM2D refuses architecture it cannot honor
+    with pytest.raises(NotImplementedError, match="padding"):
+        _build_layer("ConvLSTM2D", {"filters": 2, "kernel_size": 3,
+                                    "padding": "valid"},
+                     [(None, 4, 6, 6, 2)])
+    # LocallyConnected2D refuses HDF5 weights instead of dropping them
+    import numpy as np
+    _, _, adapter = _build_layer(
+        "LocallyConnected2D",
+        {"filters": 2, "kernel_size": (3, 3)}, [(None, 8, 8, 2)])
+    with pytest.raises(NotImplementedError, match="LocallyConnected2D"):
+        adapter([np.zeros((36, 18, 2), np.float32)])
+    assert adapter([]) == ({}, {})
