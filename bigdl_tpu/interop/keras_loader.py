@@ -711,16 +711,28 @@ def _b_cropping2d(cfg, shapes):
             (b_, sub(h, t + bo), sub(w, l + r), c), _NO_W)
 
 
+def _norm_crop3(crop):
+    """int | (a,b,c) | ((a0,a1),(b0,b1),(c0,c1)) → three pairs."""
+    if isinstance(crop, int):
+        return ((crop, crop),) * 3
+    if all(isinstance(v, int) for v in crop):
+        return tuple((v, v) for v in crop)
+    return tuple(tuple(p) for p in crop)
+
+
 def _b_cropping3d(cfg, shapes):
     b_, d, h, w, c = shapes[0]
-    crop = cfg.get("cropping", ((1, 1), (1, 1), (1, 1)))
-    (d0, d1), (h0, h1), (w0, w1) = crop
+    (d0, d1), (h0, h1), (w0, w1) = _norm_crop3(
+        cfg.get("cropping", ((1, 1), (1, 1), (1, 1))))
+    sub = lambda v, k: None if v is None else v - k  # noqa: E731
     return (nn.Cropping3D((d0, d1), (h0, h1), (w0, w1)),
-            (b_, d - d0 - d1, h - h0 - h1, w - w0 - w1, c), _NO_W)
+            (b_, sub(d, d0 + d1), sub(h, h0 + h1), sub(w, w0 + w1), c),
+            _NO_W)
 
 
 def _b_pool3d(cls):
     def build(cfg, shapes):
+        _reject_unsupported(cfg, f"{cls}Pooling3D")
         b_, d, h, w, c = shapes[0]
         kd, kh, kw = cfg.get("pool_size", (2, 2, 2))
         st = cfg.get("strides") or (kd, kh, kw)
@@ -781,11 +793,19 @@ def _b_zeropad1d(cfg, shapes):
 
 def _b_zeropad3d(cfg, shapes):
     b_, d, h, w, c = shapes[0]
-    pd, ph, pw = cfg.get("padding", (1, 1, 1))
-    m = nn.Sequential(nn.Padding(1, -pd), nn.Padding(1, pd),
-                      nn.Padding(2, -ph), nn.Padding(2, ph),
-                      nn.Padding(3, -pw), nn.Padding(3, pw))
-    return m, (b_, d + 2 * pd, h + 2 * ph, w + 2 * pw, c), _NO_W
+    # accepts keras-1 (pd, ph, pw) ints AND keras-2 serialized pairs
+    (d0, d1), (h0, h1), (w0, w1) = _norm_crop3(
+        cfg.get("padding", (1, 1, 1)))
+    stages = []
+    for axis, (lo, hi) in ((1, (d0, d1)), (2, (h0, h1)), (3, (w0, w1))):
+        if lo:
+            stages.append(nn.Padding(axis, -lo))
+        if hi:
+            stages.append(nn.Padding(axis, hi))
+    m = nn.Sequential(*stages) if stages else nn.Identity()
+    add = lambda v, k: None if v is None else v + k  # noqa: E731
+    return (m, (b_, add(d, d0 + d1), add(h, h0 + h1), add(w, w0 + w1), c),
+            _NO_W)
 
 
 def _b_thresholded_relu(cfg, shapes):
@@ -805,6 +825,7 @@ def _b_gaussian(cls):
 def _b_conv3d(cfg, shapes):
     # keras-1 fields (kernel_dim*/nb_filter/subsample/border_mode/bias) are
     # renamed by _canon_cfg before dispatch
+    _reject_unsupported(cfg, "Conv3D", ("dilation_rate", 1), ("groups", 1))
     b_, d, h, w, cin = shapes[0]
     kd, kh, kw = cfg["kernel_size"]
     sd, sh, sw = cfg.get("strides", (1, 1, 1))
@@ -827,6 +848,7 @@ def _b_conv3d(cfg, shapes):
 
 
 def _b_locally_connected2d(cfg, shapes):
+    _reject_unsupported(cfg, "LocallyConnected2D")
     b_, h, w, cin = shapes[0]
     kh, kw = _pair(cfg["kernel_size"])
     sh, sw = _pair(cfg.get("strides", 1))
@@ -841,6 +863,7 @@ def _b_locally_connected2d(cfg, shapes):
 
 
 def _b_locally_connected1d(cfg, shapes):
+    _reject_unsupported(cfg, "LocallyConnected1D")
     b_, t, cin = shapes[0]
     k = cfg["kernel_size"]
     k = k[0] if isinstance(k, (list, tuple)) else k
@@ -855,6 +878,7 @@ def _b_locally_connected1d(cfg, shapes):
 
 
 def _b_convlstm2d(cfg, shapes):
+    _reject_unsupported(cfg, "ConvLSTM2D", ("dilation_rate", 1))
     b_, t, h, w, cin = shapes[0]
     k = cfg["kernel_size"]
     if isinstance(k, (list, tuple)):

@@ -619,13 +619,73 @@ def ConvLSTM2D(filters, kernel_size, return_sequences=False, peephole=True,
                 peephole=peephole)
 
 
-# keras-1 constructor aliases (reference targets keras 1.2.2)
-Convolution1D = Conv1D
-Convolution2D = Conv2D
-Convolution3D = Conv3D
-Deconvolution2D = Conv2DTranspose
-AtrousConvolution1D = Conv1D
-AtrousConvolution2D = Conv2D
+# keras-1 constructors (reference targets keras 1.2.2) — these take the
+# keras-1 POSITIONAL signatures (nb_filter, nb_row, nb_col, ...); plain
+# aliases would misbind nb_col into `strides`
+def Convolution2D(nb_filter, nb_row, nb_col=None, activation=None,
+                  border_mode="valid", subsample=(1, 1), bias=True,
+                  input_shape=None, name=None):
+    if nb_col is None:                  # keras-2 style: Conv2D(f, (3, 3))
+        return Conv2D(nb_filter, nb_row, activation=activation,
+                      padding=border_mode, strides=subsample, use_bias=bias,
+                      input_shape=input_shape, name=name)
+    return Conv2D(nb_filter, (nb_row, nb_col), strides=subsample,
+                  padding=border_mode, activation=activation, use_bias=bias,
+                  input_shape=input_shape, name=name)
+
+
+def Convolution1D(nb_filter, filter_length, activation=None,
+                  border_mode="valid", subsample_length=1, bias=True,
+                  input_shape=None, name=None):
+    return Conv1D(nb_filter, filter_length, strides=subsample_length,
+                  padding=border_mode, activation=activation, use_bias=bias,
+                  input_shape=input_shape, name=name)
+
+
+def Convolution3D(nb_filter, kernel_dim1, kernel_dim2=None, kernel_dim3=None,
+                  activation=None, border_mode="valid", subsample=(1, 1, 1),
+                  bias=True, input_shape=None, name=None):
+    if kernel_dim2 is None:             # keras-2 style: Conv3D(f, (k,k,k))
+        ks = kernel_dim1
+    else:
+        ks = (kernel_dim1, kernel_dim2, kernel_dim3)
+    return Conv3D(nb_filter, ks, strides=subsample, activation=activation,
+                  use_bias=bias, input_shape=input_shape, name=name)
+
+
+def Deconvolution2D(nb_filter, nb_row, nb_col=None, activation=None,
+                    border_mode="valid", subsample=(1, 1), bias=True,
+                    input_shape=None, name=None):
+    ks = nb_row if nb_col is None else (nb_row, nb_col)
+    return Conv2DTranspose(nb_filter, ks, strides=subsample,
+                           padding=border_mode, activation=activation,
+                           use_bias=bias, input_shape=input_shape, name=name)
+
+
+def AtrousConvolution2D(nb_filter, nb_row, nb_col=None, atrous_rate=(1, 1),
+                        activation=None, border_mode="valid",
+                        subsample=(1, 1), bias=True, input_shape=None,
+                        name=None):
+    ks = nb_row if nb_col is None else (nb_row, nb_col)
+    cfg = Conv2D(nb_filter, ks, strides=subsample, padding=border_mode,
+                 activation=activation, use_bias=bias,
+                 input_shape=input_shape, name=name)
+    cfg["config"]["dilation_rate"] = tuple(atrous_rate) \
+        if isinstance(atrous_rate, (list, tuple)) else (atrous_rate,) * 2
+    return cfg
+
+
+def AtrousConvolution1D(nb_filter, filter_length, atrous_rate=1,
+                        activation=None, border_mode="valid",
+                        subsample_length=1, bias=True, input_shape=None,
+                        name=None):
+    cfg = Conv1D(nb_filter, filter_length, strides=subsample_length,
+                 padding=border_mode, activation=activation, use_bias=bias,
+                 input_shape=input_shape, name=name)
+    cfg["config"]["dilation_rate"] = atrous_rate
+    return cfg
+
+
 SeparableConvolution2D = SeparableConv2D
 
 

@@ -23,10 +23,16 @@ from bigdl_tpu.nn.linear import Linear
 from bigdl_tpu.nn.detection import Anchor, Pooler, decode_boxes, nms
 
 
-def _normal_init(std):
-    def _init(rng, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
-        return std * jax.random.normal(rng, shape, dtype)
-    return _init
+class _normal_init:
+    """Gaussian init with fixed std — a class (not a closure) so modules
+    holding it stay picklable for the durable model format."""
+
+    def __init__(self, std):
+        self.std = std
+
+    def __call__(self, rng, shape, dtype=jnp.float32, fan_in=None,
+                 fan_out=None):
+        return self.std * jax.random.normal(rng, shape, dtype)
 
 
 class RegionProposal(Module):

@@ -236,3 +236,53 @@ def test_keras1_field_name_canonicalization():
     m2, out2, _ = _build_layer("Dense", {"output_dim": 7},
                                [(None, 4)])
     assert out2 == (None, 7)
+
+
+def test_keras1_positional_signatures():
+    """Convolution2D(64, 3, 3) is the canonical keras-1 call: nb_col must
+    become kernel width, never a stride."""
+    cfg = kl.Convolution2D(8, 3, 3)
+    assert cfg["config"]["kernel_size"] in ([3, 3], (3, 3), 3)
+    assert cfg["config"].get("strides", (1, 1)) in ([1, 1], (1, 1), 1)
+    model = kl.Sequential(
+        kl.Convolution2D(8, 3, 3, border_mode="same", activation="relu",
+                         input_shape=(8, 8, 3)),
+        kl.GlobalAveragePooling2D(), kl.Dense(2))
+    model.build()
+    x = np.random.RandomState(0).randn(2, 8, 8, 3).astype(np.float32)
+    assert model.predict(x).shape == (2, 2)
+    # deconv + atrous spellings
+    m2 = kl.Sequential(
+        kl.AtrousConvolution2D(4, 3, 3, atrous_rate=(2, 2),
+                               border_mode="same", input_shape=(8, 8, 2)),
+        kl.Deconvolution2D(2, 2, 2, subsample=(2, 2)),
+        kl.GlobalMaxPooling2D(), kl.Dense(2))
+    m2.build()
+    assert m2.predict(np.random.RandomState(1).randn(
+        2, 8, 8, 2).astype(np.float32)).shape == (2, 2)
+
+
+def test_zeropad3d_and_cropping3d_forms():
+    from bigdl_tpu.interop.keras_loader import _build_layer
+    # keras-2 serialized pairs
+    _, out, _ = _build_layer("ZeroPadding3D",
+                             {"padding": [[1, 1], [2, 2], [3, 3]]},
+                             [(None, 4, 6, 6, 2)])
+    assert out == (None, 6, 10, 12, 2)
+    # keras-1 int triple
+    _, out2, _ = _build_layer("ZeroPadding3D", {"padding": (1, 1, 1)},
+                              [(None, 4, 6, 6, 2)])
+    assert out2 == (None, 6, 8, 8, 2)
+    # cropping int / triple / pairs
+    for crop, want in [(1, (None, 2, 4, 4, 2)),
+                       ((1, 1, 1), (None, 2, 4, 4, 2)),
+                       (((0, 1), (1, 0), (2, 2)), (None, 3, 5, 2, 2))]:
+        _, o, _ = _build_layer("Cropping3D", {"cropping": crop},
+                               [(None, 4, 6, 6, 2)])
+        assert o == want, (crop, o)
+    # Conv3D refuses dilation instead of silently ignoring it
+    import pytest
+    with pytest.raises(NotImplementedError, match="dilation"):
+        _build_layer("Conv3D", {"filters": 2, "kernel_size": (3, 3, 3),
+                                "dilation_rate": (2, 2, 2)},
+                     [(None, 8, 8, 8, 2)])

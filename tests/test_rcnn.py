@@ -130,3 +130,26 @@ def test_region_proposal_min_size_filters_degenerate_boxes():
     feats = (jnp.ones((1, 8, 8, 4)),)
     (props, valid), _ = rp.apply(params, state, feats, (64, 64))
     assert not bool(valid.any())
+
+
+def test_new_modules_serializer_roundtrip(tmp_path):
+    """Round-2 modules must survive the durable format (a closure-based
+    initializer once made the heads unpicklable)."""
+    from bigdl_tpu.utils.serializer import load_module, save_module
+    for i, build in enumerate([
+        lambda: nn.BoxHead(4, 4, (0.25,), 2, 0.0, 0.5, 4, 16, 3),
+        lambda: nn.RegionProposal(4, (32,), (0.5, 1.0), (8,), 16, 8),
+        lambda: nn.MaskHead(4, 4, (0.25,), 2, (8,), 1, 3),
+        lambda: nn.TableOperation(nn.CMulTable()),
+    ]):
+        m = build()
+        p, s = m.init(jax.random.PRNGKey(i))
+        path = str(tmp_path / f"m{i}.bigdl-tpu")
+        save_module(path, m, p, s)
+        m2, p2, s2 = load_module(path)
+        assert type(m2).__name__ == type(m).__name__
+        l1 = jax.tree.leaves(p)
+        l2 = jax.tree.leaves(p2)
+        assert len(l1) == len(l2)
+        for a, b in zip(l1, l2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b))
