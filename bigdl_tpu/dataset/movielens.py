@@ -1,0 +1,57 @@
+"""MovieLens-1M loader (reference: pyspark/bigdl/dataset/movielens.py —
+read_data_sets returning the (user, item[, rating]) int array used by the
+NCF/recommender examples scored with HitRatio/NDCG).
+
+Zero-egress environment: parses an on-disk `ml-1m/ratings.dat`
+(user::item::rating::timestamp) when present; otherwise generates a
+synthetic preference matrix with block structure (user and item latent
+groups) so recommender pipelines stay runnable and learnable.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+
+def read_data_sets(data_dir: Optional[str] = None,
+                   n_users: int = 400, n_items: int = 200,
+                   n_synthetic: int = 20000, seed: int = 0) -> np.ndarray:
+    """(N, 3) int array of [user, item, rating], 1-based ids like the
+    reference (movielens.py read_data_sets)."""
+    if data_dir:
+        path = os.path.join(data_dir, "ml-1m", "ratings.dat")
+        if not os.path.exists(path):
+            path = os.path.join(data_dir, "ratings.dat")
+        if os.path.exists(path):
+            rows = []
+            with open(path, encoding="latin-1") as fh:
+                for line in fh:
+                    parts = line.strip().split("::")
+                    if len(parts) >= 3:
+                        rows.append((int(parts[0]), int(parts[1]),
+                                     int(parts[2])))
+            return np.asarray(rows, np.int32)
+
+    r = np.random.RandomState(seed)
+    users = r.randint(1, n_users + 1, n_synthetic)
+    items = r.randint(1, n_items + 1, n_synthetic)
+    # block preference structure: user group g likes item group g
+    ug = (users - 1) % 4
+    ig = (items - 1) % 4
+    base = np.where(ug == ig, 4.0, 2.0)
+    ratings = np.clip(np.round(base + r.randn(n_synthetic) * 0.8), 1, 5)
+    return np.stack([users, items, ratings.astype(np.int32)], 1) \
+        .astype(np.int32)
+
+
+def get_id_pairs(data_dir: Optional[str] = None, **kw) -> np.ndarray:
+    """(N, 2) [user, item] pairs (reference: get_id_pairs)."""
+    return read_data_sets(data_dir, **kw)[:, :2]
+
+
+def get_id_ratings(data_dir: Optional[str] = None, **kw) -> np.ndarray:
+    """(N, 3) [user, item, rating] (reference: get_id_ratings)."""
+    return read_data_sets(data_dir, **kw)

@@ -1,0 +1,82 @@
+"""20-Newsgroups text-classification loader (reference:
+pyspark/bigdl/dataset/news20.py — download_news20/get_news20 returning
+[(text, label)] pairs, plus GloVe embedding loading for the
+textclassification example).
+
+Zero-egress environment: reads an on-disk `20news-18828`-style folder tree
+(one subdirectory per newsgroup, one file per post) when present; otherwise
+generates a synthetic corpus with per-class vocabulary structure so the
+text-classification pipeline stays runnable end to end.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+CLASS_NUM = 20
+
+_TOPIC_STEMS = [
+    "atheism", "graphics", "windows", "ibm", "mac", "xorg", "forsale",
+    "autos", "motorcycles", "baseball", "hockey", "crypto", "electronics",
+    "medicine", "space", "christian", "guns", "mideast", "politics",
+    "religion",
+]
+
+
+def get_news20(source_dir: Optional[str] = None, n_synthetic: int = 2000,
+               seed: int = 0) -> List[Tuple[str, int]]:
+    """[(text, 1-based label)] like the reference's get_news20
+    (pyspark/bigdl/dataset/news20.py get_news20: label = 1-based class
+    index from the sorted category dirs)."""
+    if source_dir and os.path.isdir(source_dir):
+        cats = sorted(d for d in os.listdir(source_dir)
+                      if os.path.isdir(os.path.join(source_dir, d)))
+        out = []
+        for li, cat in enumerate(cats, start=1):
+            cdir = os.path.join(source_dir, cat)
+            for fname in sorted(os.listdir(cdir)):
+                path = os.path.join(cdir, fname)
+                try:
+                    with open(path, "rb") as fh:
+                        out.append((fh.read().decode("latin-1"), li))
+                except OSError:
+                    continue
+        return out
+
+    r = np.random.RandomState(seed)
+    out = []
+    for i in range(n_synthetic):
+        label = i % CLASS_NUM
+        stem = _TOPIC_STEMS[label]
+        words = [f"{stem}{r.randint(40)}" for _ in range(30)]
+        words += [f"common{r.randint(100)}" for _ in range(10)]
+        r.shuffle(words)
+        out.append((" ".join(words), label + 1))
+    return out
+
+
+def get_glove_w2v(source_dir: Optional[str] = None, dim: int = 50,
+                  vocab: Optional[List[str]] = None,
+                  seed: int = 0) -> Dict[str, np.ndarray]:
+    """word → vector dict like the reference's get_glove_w2v. Reads a
+    glove.6B.<dim>d.txt when present; otherwise deterministic random
+    vectors for `vocab` (hash-seeded per word, so repeated calls agree)."""
+    if source_dir:
+        path = os.path.join(source_dir, f"glove.6B.{dim}d.txt")
+        if os.path.exists(path):
+            table = {}
+            with open(path, encoding="utf-8") as fh:
+                for line in fh:
+                    parts = line.rstrip().split(" ")
+                    table[parts[0]] = np.asarray(parts[1:], np.float32)
+            return table
+    import zlib
+    out = {}
+    for w in (vocab or []):
+        r = np.random.RandomState((zlib.crc32(w.encode()) + seed)
+                                  & 0x7FFFFFFF)
+        out[w] = r.randn(dim).astype(np.float32) * 0.1
+    return out

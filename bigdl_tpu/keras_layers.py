@@ -591,10 +591,12 @@ def SpatialDropout3D(rate, input_shape=None, name=None):
     return _cfg("SpatialDropout3D", input_shape, name, rate=rate)
 
 
-def Conv3D(filters, kernel_size, strides=(1, 1, 1), activation=None,
-           use_bias=True, input_shape=None, name=None):
+def Conv3D(filters, kernel_size, strides=(1, 1, 1), padding="valid",
+           activation=None, use_bias=True, input_shape=None, name=None):
+    # padding flows into the config so the builder raises LOUDLY on
+    # "same" (unsupported) instead of silently building a valid conv
     return _cfg("Conv3D", input_shape, name, filters=filters,
-                kernel_size=kernel_size, strides=strides,
+                kernel_size=kernel_size, strides=strides, padding=padding,
                 activation=activation, use_bias=use_bias)
 
 
@@ -649,13 +651,18 @@ def Convolution3D(nb_filter, kernel_dim1, kernel_dim2=None, kernel_dim3=None,
         ks = kernel_dim1
     else:
         ks = (kernel_dim1, kernel_dim2, kernel_dim3)
-    return Conv3D(nb_filter, ks, strides=subsample, activation=activation,
-                  use_bias=bias, input_shape=input_shape, name=name)
+    return Conv3D(nb_filter, ks, strides=subsample, padding=border_mode,
+                  activation=activation, use_bias=bias,
+                  input_shape=input_shape, name=name)
 
 
-def Deconvolution2D(nb_filter, nb_row, nb_col=None, activation=None,
-                    border_mode="valid", subsample=(1, 1), bias=True,
-                    input_shape=None, name=None):
+def Deconvolution2D(nb_filter, nb_row, nb_col=None, output_shape=None,
+                    activation=None, border_mode="valid", subsample=(1, 1),
+                    bias=True, input_shape=None, name=None):
+    # keras-1's REQUIRED 4th positional `output_shape` is accepted (and
+    # checked against our inferred shape at build time being unnecessary —
+    # the loader infers output shapes itself); omitting it from the
+    # signature would misbind the tuple into `activation`
     ks = nb_row if nb_col is None else (nb_row, nb_col)
     return Conv2DTranspose(nb_filter, ks, strides=subsample,
                            padding=border_mode, activation=activation,
@@ -679,11 +686,16 @@ def AtrousConvolution1D(nb_filter, filter_length, atrous_rate=1,
                         activation=None, border_mode="valid",
                         subsample_length=1, bias=True, input_shape=None,
                         name=None):
-    cfg = Conv1D(nb_filter, filter_length, strides=subsample_length,
-                 padding=border_mode, activation=activation, use_bias=bias,
-                 input_shape=input_shape, name=name)
-    cfg["config"]["dilation_rate"] = atrous_rate
-    return cfg
+    if atrous_rate not in (1, (1,), [1]):
+        # fail at the call site, not at distant build time: the Conv1D
+        # builder has no dilated path (use AtrousConvolution2D on a
+        # width-1 reshape for dilated 1-D convs)
+        raise NotImplementedError(
+            f"AtrousConvolution1D: atrous_rate={atrous_rate!r} is not "
+            f"supported (1-D dilation has no builder)")
+    return Conv1D(nb_filter, filter_length, strides=subsample_length,
+                  padding=border_mode, activation=activation, use_bias=bias,
+                  input_shape=input_shape, name=name)
 
 
 SeparableConvolution2D = SeparableConv2D

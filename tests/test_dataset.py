@@ -260,3 +260,37 @@ def test_crop_larger_than_image_keeps_masks_aligned():
     assert f.floats.shape == (8, 8, 3)
     assert f[ImageFeature.MASKS].shape == (1, 8, 8)
     np.testing.assert_allclose(f[ImageFeature.BOXES], [[1, 1, 7, 7]])
+
+
+def test_news20_and_movielens_loaders(tmp_path):
+    from bigdl_tpu.dataset import movielens, news20
+    # synthetic path: structured, learnable, reference-shaped outputs
+    texts = news20.get_news20(n_synthetic=40)
+    assert len(texts) == 40
+    assert all(isinstance(t, str) and 1 <= l <= 20 for t, l in texts)
+    vocab = sorted({w for t, _ in texts for w in t.split()})[:50]
+    w2v = news20.get_glove_w2v(vocab=vocab, dim=16)
+    assert set(w2v) == set(vocab)
+    assert all(v.shape == (16,) for v in w2v.values())
+    # deterministic per word
+    again = news20.get_glove_w2v(vocab=vocab, dim=16)
+    np.testing.assert_array_equal(w2v[vocab[0]], again[vocab[0]])
+
+    data = movielens.get_id_ratings(n_synthetic=500)
+    assert data.shape == (500, 3)
+    assert data[:, 2].min() >= 1 and data[:, 2].max() <= 5
+    # block structure is learnable: matched groups rate higher on average
+    ug, ig = (data[:, 0] - 1) % 4, (data[:, 1] - 1) % 4
+    assert data[ug == ig, 2].mean() > data[ug != ig, 2].mean() + 1
+
+    # on-disk parsers
+    d = tmp_path / "news"; (d / "alt.atheism").mkdir(parents=True)
+    (d / "alt.atheism" / "1.txt").write_text("hello world")
+    (d / "sci.space").mkdir(); (d / "sci.space" / "2.txt").write_text("rocket")
+    disk = news20.get_news20(str(d))
+    assert disk == [("hello world", 1), ("rocket", 2)]
+
+    ml = tmp_path / "ml-1m"; ml.mkdir()
+    (ml / "ratings.dat").write_text("1::10::5::123\n2::20::3::456\n")
+    arr = movielens.read_data_sets(str(tmp_path))
+    np.testing.assert_array_equal(arr, [[1, 10, 5], [2, 20, 3]])

@@ -286,3 +286,19 @@ def test_zeropad3d_and_cropping3d_forms():
         _build_layer("Conv3D", {"filters": 2, "kernel_size": (3, 3, 3),
                                 "dilation_rate": (2, 2, 2)},
                      [(None, 8, 8, 8, 2)])
+
+
+def test_keras1_wrapper_guardrails():
+    import pytest
+    # Convolution3D 'same' raises loudly at build (no silent valid conv)
+    m = kl.Sequential(kl.Convolution3D(4, 3, 3, 3, border_mode="same",
+                                       input_shape=(8, 8, 8, 2)))
+    with pytest.raises(NotImplementedError, match="SAME"):
+        m.build()
+    # Deconvolution2D's keras-1 4th positional output_shape doesn't
+    # misbind into activation
+    cfg = kl.Deconvolution2D(8, 3, 3, (None, 14, 14, 8), subsample=(2, 2))
+    assert cfg["config"].get("activation") is None
+    # AtrousConvolution1D fails at the CALL SITE for unsupported rates
+    with pytest.raises(NotImplementedError, match="atrous_rate"):
+        kl.AtrousConvolution1D(4, 3, atrous_rate=2)
