@@ -80,6 +80,30 @@ class Predictor:
     def predict_class(self, inputs) -> np.ndarray:
         return np.argmax(self.predict(inputs), axis=-1)
 
+    def predict_image(self, frame):
+        """Run inference over an ImageFrame: materialize its transform
+        pipeline, batch the float images, and store each prediction back
+        on its ImageFeature under the "predict" key (reference:
+        AbstractModule.predictImage → Predictor.predictImage,
+        optim/Predictor.scala:35-260). Returns the materialized frame.
+
+        All images must share one post-transform shape (static shapes —
+        put a Resize in the pipeline for mixed-size folders)."""
+        from bigdl_tpu.dataset.vision import ImageFrame
+        feats = list(frame) if not isinstance(frame, list) else frame
+        if isinstance(frame, ImageFrame):
+            # transforms mutate the features in place; clear the consumed
+            # pipeline so a later iteration of the source frame doesn't
+            # re-apply it to already-transformed images
+            frame._pipeline = []
+        if not feats:
+            return ImageFrame([])
+        x = np.stack([np.asarray(f.floats, np.float32) for f in feats])
+        preds = self.predict(x)
+        for f, p in zip(feats, preds):
+            f["predict"] = np.asarray(p)
+        return ImageFrame(feats)
+
 
 LocalPredictor = Predictor
 

@@ -121,3 +121,48 @@ def test_inception_v2():
     p, _ = m.init(jax.random.PRNGKey(0))
     n = sum(int(l.size) for l in jax.tree.leaves(p))
     assert 10_500_000 < n < 12_000_000, n
+
+
+def test_predict_image_over_frame():
+    """(reference: AbstractModule.predictImage over an ImageFrame)."""
+    import numpy as np
+    import jax
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset.vision import ImageFrame, Resize
+    from bigdl_tpu.optim.predictor import Predictor
+
+    r = np.random.RandomState(0)
+    # mixed-size images; the frame pipeline resizes to a common shape
+    frame = ImageFrame.from_arrays(
+        [r.rand(10 + i, 12, 3).astype(np.float32) for i in range(4)],
+        labels=[0, 1, 0, 1])
+    frame.transform(Resize(8, 8))
+
+    model = nn.Sequential(nn.Flatten(), nn.Linear(8 * 8 * 3, 2),
+                          nn.SoftMax())
+    params, state = model.init(jax.random.PRNGKey(0))
+    out = Predictor(model, params, state).predict_image(frame)
+    feats = out.features
+    assert len(feats) == 4
+    for f in feats:
+        assert f["predict"].shape == (2,)
+        np.testing.assert_allclose(f["predict"].sum(), 1.0, rtol=1e-5)
+
+
+def test_predict_image_consumes_pipeline_once():
+    import numpy as np
+    import jax
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset.vision import ChannelNormalize, ImageFrame
+    from bigdl_tpu.optim.predictor import Predictor
+    frame = ImageFrame.from_arrays(
+        [np.full((4, 4, 3), 100.0, np.float32)])
+    frame.transform(ChannelNormalize((50.0,) * 3, (1.0,) * 3))
+    model = nn.Sequential(nn.Flatten(), nn.Linear(4 * 4 * 3, 2))
+    params, state = model.init(jax.random.PRNGKey(0))
+    out = Predictor(model, params, state).predict_image(frame)
+    first = np.asarray(out.features[0].floats).copy()
+    np.testing.assert_allclose(first, 50.0)    # normalized once
+    # iterating the SOURCE frame again must not re-normalize
+    again = [f for f in frame]
+    np.testing.assert_allclose(np.asarray(again[0].floats), 50.0)
