@@ -550,5 +550,13 @@ class ImageFrame:
             it = t.apply(it)
         return it
 
+    def materialize(self) -> "ImageFrame":
+        """Apply the registered pipeline once and clear it — transforms
+        mutate features in place, so re-iterating an un-cleared pipeline
+        would apply them twice. Returns self."""
+        self.features = list(self)
+        self._pipeline = []
+        return self
+
     def to_samples(self) -> List[Sample]:
         return [f.to_sample() for f in self]

@@ -839,3 +839,79 @@ class ModuleToOperation(Operation):
 # re-export: the layer implementation already has TF semantics
 # (reference: nn/ops/ResizeBilinearOps.scala wraps nn/ResizeBilinear.scala)
 from bigdl_tpu.nn.shape_ops import ResizeBilinear  # noqa: E402,F401
+
+
+# ------------------------------------- TF input-pipeline boundary ops
+# (reference: nn/tf/ParsingOps.scala ParseExample/ParseSingleExample,
+# nn/tf/ImageOps.scala DecodeJpeg/DecodePng/DecodeRaw — host-side by
+# design here: decode/parse feed the pipeline, the device sees tensors)
+class DecodeRaw(Operation):
+    """bytes → numpy array of `out_type` (reference: ImageOps DecodeRaw)."""
+
+    def __init__(self, out_type="float32", little_endian: bool = True,
+                 name=None):
+        super().__init__(name)
+        import numpy as np
+        self.wire_dtype = np.dtype(out_type).newbyteorder(
+            "<" if little_endian else ">")
+
+    def forward(self, params, raw, **_):
+        import numpy as np
+
+        def one(r):
+            # byte-swap to native order like TF DecodeRaw — big-endian
+            # dtypes are not valid JAX array types
+            return np.frombuffer(r, dtype=self.wire_dtype).astype(
+                self.wire_dtype.newbyteorder("="))
+        if isinstance(raw, (list, tuple)):
+            return [one(r) for r in raw]
+        return one(raw)
+
+
+class DecodeImage(Operation):
+    """Encoded image bytes → (H, W, C) uint8 array via PIL (reference:
+    ImageOps DecodeJpeg/DecodePng — one op here; PIL sniffs the codec)."""
+
+    def __init__(self, channels: int = 3, name=None):
+        super().__init__(name)
+        if channels not in (0, 1, 3, 4):
+            raise ValueError(f"channels must be 0 (native), 1, 3, or 4; "
+                             f"got {channels}")
+        self.channels = channels
+
+    def forward(self, params, raw, **_):
+        import io
+        import numpy as np
+        from PIL import Image
+        def one(buf):
+            with Image.open(io.BytesIO(buf)) as im:
+                if self.channels == 0:     # TF default: the file's channels
+                    return np.asarray(im)
+                mode = {1: "L", 3: "RGB", 4: "RGBA"}[self.channels]
+                return np.asarray(im.convert(mode))
+        if isinstance(raw, (list, tuple)):
+            return [one(r) for r in raw]
+        return one(raw)
+
+
+DecodeJpeg = DecodeImage
+DecodePng = DecodeImage
+
+
+class ParseSingleExample(Operation):
+    """Serialized tf.train.Example bytes → feature dict (reference:
+    nn/tf/ParsingOps.scala ParseSingleExample; wire codec shared with
+    interop/tf_example)."""
+
+    def forward(self, params, raw, **_):
+        from bigdl_tpu.interop.tf_example import decode_example
+        return decode_example(raw)
+
+
+class ParseExample(Operation):
+    """Batch of serialized Examples → list of feature dicts (reference:
+    nn/tf/ParsingOps.scala ParseExample)."""
+
+    def forward(self, params, raws, **_):
+        from bigdl_tpu.interop.tf_example import decode_example
+        return [decode_example(r) for r in raws]

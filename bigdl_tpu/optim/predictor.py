@@ -90,12 +90,10 @@ class Predictor:
         All images must share one post-transform shape (static shapes —
         put a Resize in the pipeline for mixed-size folders)."""
         from bigdl_tpu.dataset.vision import ImageFrame
-        feats = list(frame) if not isinstance(frame, list) else frame
         if isinstance(frame, ImageFrame):
-            # transforms mutate the features in place; clear the consumed
-            # pipeline so a later iteration of the source frame doesn't
-            # re-apply it to already-transformed images
-            frame._pipeline = []
+            feats = frame.materialize().features
+        else:
+            feats = list(frame)
         if not feats:
             return ImageFrame([])
         x = np.stack([np.asarray(f.floats, np.float32) for f in feats])

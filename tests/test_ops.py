@@ -262,3 +262,44 @@ def test_kv2tensor_negative_key_and_crosscol_single_empty():
     out = np.asarray(ops.Kv2Tensor(n_cols=4).forward({}, ["-2:9.0,0:1.0"]))
     np.testing.assert_allclose(out, [[1.0, 0, 0, 0]])   # -2 dropped
     assert ops.CrossCol(10).forward({}, []).shape == (0, 1)
+
+
+def test_tf_pipeline_boundary_ops(tmp_path):
+    import io
+    import bigdl_tpu.nn.ops as ops
+    from PIL import Image
+    from bigdl_tpu.interop.tf_example import encode_example
+
+    raw = np.arange(6, dtype="<f4").tobytes()
+    out = ops.DecodeRaw("float32").forward({}, raw)
+    np.testing.assert_allclose(out, np.arange(6, dtype=np.float32))
+
+    arr = np.random.RandomState(0).randint(0, 255, (5, 7, 3), np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    dec = ops.DecodeImage(3).forward({}, buf.getvalue())
+    np.testing.assert_array_equal(dec, arr)
+
+    ex = encode_example({"label": 3, "v": np.asarray([1.5], np.float32)})
+    one = ops.ParseSingleExample().forward({}, ex)
+    np.testing.assert_array_equal(one["label"], [3])
+    many = ops.ParseExample().forward({}, [ex, ex])
+    assert len(many) == 2 and float(many[1]["v"][0]) == 1.5
+
+
+def test_decode_ops_tf_semantics():
+    import io
+    import bigdl_tpu.nn.ops as ops
+    from PIL import Image
+    # channels=0: native mode, no convert
+    arr = np.random.RandomState(1).randint(0, 255, (4, 5), np.uint8)
+    buf = io.BytesIO(); Image.fromarray(arr, "L").save(buf, format="PNG")
+    dec = ops.DecodeImage(0).forward({}, buf.getvalue())
+    np.testing.assert_array_equal(dec, arr)
+    # big-endian DecodeRaw swaps to native order (jax-compatible)
+    be = np.arange(4, dtype=">f4").tobytes()
+    out = ops.DecodeRaw("float32", little_endian=False).forward({}, be)
+    assert out.dtype == np.float32 and out.dtype.isnative
+    np.testing.assert_allclose(out, [0, 1, 2, 3])
+    import jax.numpy as jnp
+    jnp.asarray(out)          # must be a valid jax input
