@@ -135,3 +135,46 @@ def test_gaussian_noise_and_dropout_statistics():
     # multiplicative noise with mean 1, std sqrt(rate/(1-rate))
     assert abs(float(mult.mean()) - 1.0) < 0.03
     assert abs(float(mult.std()) - np.sqrt(0.3 / 0.7)) < 0.05
+
+
+def test_optimizers_match_torch_step_for_step():
+    """Trajectory parity on a quadratic: our Adam/RMSprop/Adagrad/SGD
+    match torch.optim step for step (reference oracle pattern,
+    test/.../optim/*Spec.scala). Our SGD defaults dampening=momentum like
+    the reference (SGD.scala:65) — torch semantics need dampening=0."""
+    from bigdl_tpu.optim.method import SGD, Adam, Adagrad, RMSprop
+
+    w0 = np.asarray([1.0, -2.0, 3.0], np.float32)
+
+    def grad(w):
+        return 2 * w + 0.5
+
+    cases = [
+        (SGD(0.1, momentum=0.9, dampening=0.0, weight_decay=0.01), 0.1,
+         lambda p: torch.optim.SGD([p], lr=0.1, momentum=0.9,
+                                   weight_decay=0.01), 1e-6),
+        (SGD(0.1, momentum=0.9, dampening=0.0, nesterov=True), 0.1,
+         lambda p: torch.optim.SGD([p], lr=0.1, momentum=0.9,
+                                   nesterov=True), 1e-6),
+        (Adam(0.05), 0.05,
+         lambda p: torch.optim.Adam([p], lr=0.05), 1e-5),
+        (RMSprop(0.05), 0.05,
+         lambda p: torch.optim.RMSprop([p], lr=0.05), 1e-6),
+        (Adagrad(0.05), 0.05,
+         lambda p: torch.optim.Adagrad([p], lr=0.05), 1e-6),
+    ]
+    for ours, lr, make_torch, tol in cases:
+        p = {"w": jnp.asarray(w0)}
+        slots = ours.init_slots(p)
+        tp = torch.nn.Parameter(torch.from_numpy(w0.copy()))
+        topt = make_torch(tp)
+        for t in range(10):
+            g = {"w": jnp.asarray(grad(np.asarray(p["w"])))}
+            p, slots = ours.update(p, g, slots, jnp.float32(lr),
+                                   jnp.int32(t))
+            topt.zero_grad()
+            tp.grad = torch.from_numpy(grad(tp.detach().numpy()))
+            topt.step()
+        np.testing.assert_allclose(np.asarray(p["w"]),
+                                   tp.detach().numpy(), atol=tol,
+                                   err_msg=type(ours).__name__)
