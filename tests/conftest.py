@@ -20,6 +20,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "examples: subprocess-runs examples/*.py (slow; deselect with "
+        "-m 'not examples' for the inner loop)")
+
+
 @pytest.fixture
 def rng():
     return jax.random.PRNGKey(0)
