@@ -138,7 +138,7 @@ class TFGraph:
             if tb is not None and tb.int(5):
                 b = b.T
             return a @ b
-        if op in ("Add", "AddV2", "BiasAdd"):
+        if op in ("Add", "AddV2", "BiasAdd", "BiasAddV1"):
             return ins[0] + ins[1]
         if op == "Sub":
             return ins[0] - ins[1]
@@ -195,10 +195,15 @@ class TFGraph:
         if op == "Pad":
             pads = np.asarray(ins[1])
             return jnp.pad(ins[0], [(int(a), int(b)) for a, b in pads])
+        if op == "PadV2":
+            pads = np.asarray(ins[1])
+            cval = float(np.asarray(ins[2]).reshape(-1)[0])
+            return jnp.pad(ins[0], [(int(a), int(b)) for a, b in pads],
+                           constant_values=cval)
         if op == "ConcatV2":
             axis = int(np.asarray(ins[-1]))
             return jnp.concatenate(ins[:-1], axis=axis)
-        if op == "FusedBatchNorm" or op == "FusedBatchNormV3":
+        if op in ("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3"):
             x, scale, offset, mean, var = ins
             a = node.attrs.get("epsilon")
             eps = a.float(4, 1e-3) if a is not None else 1e-3

@@ -42,15 +42,16 @@ class BiasAdd(Module):
 
 
 class ConstPad(Module):
-    """Fixed zero padding from a TF Pad const operand."""
+    """Fixed constant padding from a TF Pad/PadV2 const operand."""
 
-    def __init__(self, pads: Sequence[Tuple[int, int]],
+    def __init__(self, pads: Sequence[Tuple[int, int]], value: float = 0.0,
                  name: Optional[str] = None):
         super().__init__(name=name)
         self.pads = [tuple(int(v) for v in p) for p in pads]
+        self.value = float(value)
 
     def forward(self, params, x, **_):
-        return jnp.pad(x, self.pads)
+        return jnp.pad(x, self.pads, constant_values=self.value)
 
 
 class ReduceMean(Module):
@@ -266,6 +267,16 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
         a = node.attrs.get(key)
         return _sint(a.int(3, default)) if a is not None else default
 
+    def const_binary(fn, label):
+        """Binary op with exactly one const operand (closed over)."""
+        c = _const_value(graph, node.inputs[0])
+        cf = c is not None
+        if not cf:
+            c = _const_value(graph, node.inputs[1])
+        if c is None:
+            raise NotImplementedError(f"{label} {node.name}: missing operand")
+        return mk(ConstBinary(fn, np.asarray(c), const_first=cf, label=label))
+
     def mixed(n: int):
         """Resolve the first n inputs position-by-position: consts are
         closed over, symbolic inputs pass through — `Graph` only wires
@@ -330,9 +341,13 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
         b = np.asarray(b).reshape(-1)
         return mk(BiasAdd(b.shape[0]), {"bias": b})
     if op in ("Add", "AddV2"):
-        return mk(nn.CAddTable())
+        if len(data_ins) == 2:
+            return mk(nn.CAddTable())
+        return const_binary(jnp.add, "add")
     if op == "Mul":
-        return mk(nn.CMulTable())
+        if len(data_ins) == 2:
+            return mk(nn.CMulTable())
+        return const_binary(jnp.multiply, "mul")
     if op in ("FusedBatchNorm", "FusedBatchNormV2", "FusedBatchNormV3"):
         scale = const(1)
         offset = const(2)
@@ -396,11 +411,18 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
         if axes == (1, 2) and not keepdims:
             return mk(nn.GlobalAveragePooling2D())
         return mk(ReduceMean(axes, keepdims))
-    if op == "Pad":
+    if op in ("Pad", "PadV2"):
         pads = const(1)
         if pads is None:
-            raise NotImplementedError(f"Pad {node.name}: dynamic paddings")
-        return mk(ConstPad(np.asarray(pads).tolist()))
+            raise NotImplementedError(f"{op} {node.name}: dynamic paddings")
+        value = 0.0
+        if op == "PadV2":
+            cv = const(2)
+            if cv is None:
+                raise NotImplementedError(
+                    f"PadV2 {node.name}: dynamic constant_values")
+            value = float(np.asarray(cv).reshape(-1)[0])
+        return mk(ConstPad(np.asarray(pads).tolist(), value))
     # ------------------------------------------------------- elementwise
     if op in _UNARY_OPS:
         return mk(Lambda(_UNARY_OPS[op], op.lower()))
@@ -421,12 +443,7 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
         fn = _BINARY_OPS[op]
         if len(data_ins) == 2:
             return mk(Lambda(fn, op.lower(), n_in=2))
-        ci = 0 if node.inputs and _const_value(graph, node.inputs[0]) \
-            is not None else 1
-        c = _const_value(graph, node.inputs[ci])
-        if c is None:
-            raise NotImplementedError(f"{op} {node.name}: missing operand")
-        return mk(ConstBinary(fn, c, const_first=(ci == 0), label=op.lower()))
+        return const_binary(fn, op.lower())
     if op == "AddN":
         wrap, parents = mixed(len(node.inputs))
         return mk(Lambda(wrap(lambda *xs: sum(xs[1:], xs[0])), "add_n",

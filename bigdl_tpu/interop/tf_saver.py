@@ -74,8 +74,65 @@ def _same_or_pads(e: _Emitter, x: str, ph: int, pw: int) -> (str, str):
     return e.emit(e.fresh("pad"), "Pad", [x, pads]), "VALID"
 
 
+_NEG_FLT_MAX = float(np.finfo(np.float32).min)
+
+
+def _emit_pool(e: _Emitter, m: Module, x: str, in_shape) -> str:
+    """MaxPool/AvgPool with the layer's torch-rule semantics (ceil_mode,
+    count_include_pad — nn/pooling.py). Ceil-mode windows become an
+    asymmetric extra pad (needs the static input shape); MaxPool pads with
+    -FLT_MAX via PadV2 so zero padding can never win over negative
+    activations. Unrepresentable divisor semantics raise, mirroring
+    TensorflowSaver's unsupported-construct error."""
+    from bigdl_tpu.nn.pooling import _ceil_extra
+    is_max = isinstance(m, nn.SpatialMaxPooling)
+    op = "MaxPool" if is_max else "AvgPool"
+    ints = {"ksize": [1, m.kh, m.kw, 1], "strides": [1, m.dh, m.dw, 1]}
+    if getattr(m, "global_pooling", False):
+        axes = e.const("axes", np.asarray([1, 2], np.int32))
+        return e.emit(e.fresh("mean"), "Mean", [x, axes],
+                      scalars={"keep_dims": True})
+    if m.ph == -1 or m.pw == -1:
+        # TF's SAME attr matches both layers' SAME paths (AvgPool SAME
+        # divides by valid-cell counts on both sides)
+        return e.emit(e.fresh(op.lower()), op, [x], ints=ints,
+                      strs={"padding": "SAME"})
+    ph, pw = m.ph, m.pw
+    eh = ew = 0
+    if m.ceil_mode:
+        if in_shape is None or len(in_shape) != 4:
+            raise NotImplementedError(
+                "TF export: ceil_mode pooling needs the static input shape "
+                "— export a Sequential with example_input")
+        eh = _ceil_extra(in_shape[1], m.kh, m.dh, ph)
+        ew = _ceil_extra(in_shape[2], m.kw, m.dw, pw)
+    if is_max:
+        if ph or pw or eh or ew:
+            pads = e.const("paddings", np.asarray(
+                [[0, 0], [ph, ph + eh], [pw, pw + ew], [0, 0]], np.int32))
+            cval = e.const("pad_value", np.float32(_NEG_FLT_MAX))
+            x = e.emit(e.fresh("pad"), "PadV2", [x, pads, cval])
+        return e.emit(e.fresh("maxpool"), "MaxPool", [x], ints=ints,
+                      strs={"padding": "VALID"})
+    if eh or ew:
+        raise NotImplementedError(
+            "TF export: ceil-mode AvgPool whose last window overflows the "
+            "input — the overflow cells are excluded from the divisor "
+            "(nn/pooling.py), which Pad+AvgPool cannot reproduce")
+    if ph or pw:
+        if not m.include_pad:
+            raise NotImplementedError(
+                "TF export: AvgPool count_include_pad=False with explicit "
+                "padding has no stock-TF node equivalent")
+        pads = e.const("paddings", np.asarray(
+            [[0, 0], [ph, ph], [pw, pw], [0, 0]], np.int32))
+        x = e.emit(e.fresh("pad"), "Pad", [x, pads])
+    return e.emit(e.fresh("avgpool"), "AvgPool", [x], ints=ints,
+                  strs={"padding": "VALID"})
+
+
 def _emit_layer(e: _Emitter, m: Module, params: Dict, state: Dict,
-                ins: List[str]) -> str:
+                ins: List[str], in_shape=None) -> str:
     """One module → NodeDef(s); returns the output node name."""
     x = ins[0] if ins else None
     nm = lambda base: e.fresh(base)
@@ -102,7 +159,7 @@ def _emit_layer(e: _Emitter, m: Module, params: Dict, state: Dict,
             b = e.const("bias", params["bias"])
             out = e.emit(nm("bias_add"), "BiasAdd", [out, b])
         return out
-    if isinstance(m, nn.BatchNormalization):     # covers Spatial subclass
+    if isinstance(m, nn.SpatialBatchNormalization):
         scale = e.const("gamma", params["weight"] if m.affine
                         else np.ones(m.n_output, np.float32))
         offset = e.const("beta", params["bias"] if m.affine
@@ -115,14 +172,24 @@ def _emit_layer(e: _Emitter, m: Module, params: Dict, state: Dict,
                       [x, scale, offset, mean, var],
                       scalars={"epsilon": float(m.eps),
                                "is_training": False})
+    if isinstance(m, nn.BatchNormalization):
+        # plain (2-D input) BN: stock TF only accepts FusedBatchNorm on
+        # 4-D NHWC, so fold the statistics into Mul/Add consts:
+        # y = x * gamma/sqrt(var+eps) + (beta - mean*gamma/sqrt(var+eps))
+        g = (np.asarray(params["weight"], np.float32) if m.affine
+             else np.ones(m.n_output, np.float32))
+        b = (np.asarray(params["bias"], np.float32) if m.affine
+             else np.zeros(m.n_output, np.float32))
+        mean = np.asarray(state["running_mean"], np.float32)
+        var = np.asarray(state["running_var"], np.float32)
+        k = g / np.sqrt(var + float(m.eps))
+        scale = e.const("bn_scale", k)
+        offset = e.const("bn_offset", b - mean * k)
+        out = e.emit(nm("bn_mul"), "Mul", [x, scale])
+        return e.emit(nm("bn_add"), "Add", [out, offset])
     if isinstance(m, nn.SpatialMaxPooling) or \
             isinstance(m, nn.SpatialAveragePooling):
-        op = "MaxPool" if isinstance(m, nn.SpatialMaxPooling) else "AvgPool"
-        x2, pad = _same_or_pads(e, x, m.ph, m.pw)
-        return e.emit(nm(op.lower()), op, [x2],
-                      ints={"ksize": [1, m.kh, m.kw, 1],
-                            "strides": [1, m.dh, m.dw, 1]},
-                      strs={"padding": pad})
+        return _emit_pool(e, m, x, in_shape)
     _UNARY = {nn.ReLU: "Relu", nn.ReLU6: "Relu6", nn.Sigmoid: "Sigmoid",
               nn.Tanh: "Tanh", nn.ELU: "Elu", nn.SELU: "Selu",
               nn.SoftPlus: "Softplus", nn.SoftSign: "Softsign"}
@@ -231,7 +298,8 @@ def _save_sequential(seq, params, state, input_names, example_input):
             shape = e.const("shape", np.asarray(tgt, np.int32))
             cur = e.emit(e.fresh("reshape"), "Reshape", [cur, shape])
             continue
-        cur = _emit_layer(e, m, p, s, [cur])
+        cur = _emit_layer(e, m, p, s, [cur],
+                          in_shape=shapes[i] if shapes else None)
     return b"".join(e.nodes)
 
 

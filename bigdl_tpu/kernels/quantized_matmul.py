@@ -42,9 +42,11 @@ def _qmm_kernel(xq_ref, wq_ref, sx_ref, sw_ref, o_ref, acc_ref):
 
     @pl.when(kb == nk - 1)
     def _dequant():
-        # per-row input scale x per-column weight scale epilogue
+        # per-row input scale x per-column weight scale epilogue; the
+        # scale blocks are lane/sublane-padded (see int8_matmul), so take
+        # the one meaningful row/column
         o_ref[:] = (acc_ref[:].astype(jnp.float32) *
-                    sx_ref[:] * sw_ref[:]).astype(o_ref.dtype)
+                    sx_ref[:, 0:1] * sw_ref[0:1, :]).astype(o_ref.dtype)
 
 
 def _pad_to(x, mult, axis):
@@ -56,27 +58,47 @@ def _pad_to(x, mult, axis):
     return jnp.pad(x, widths)
 
 
+def _round_up(v: int, mult: int) -> int:
+    return -(-v // mult) * mult
+
+
+# Mosaic tiling floor: int8 operands tile as (32, 128) in VMEM, fp32/int32
+# as (8, 128). Every block dimension must round UP to these — clamping a
+# block to a raw dim (e.g. K=40) hands Mosaic an untileable ref and the
+# TPU lowering fails, even though interpret=True on CPU happily accepts it.
+_SUBLANE_I8 = 32
+_LANE = 128
+
+
 def int8_matmul(xq, wq, x_scale, w_scale, *,
                 block_m: int = 256, block_n: int = 256, block_k: int = 256,
                 interpret: bool = False) -> jnp.ndarray:
     """(M, K) int8 @ (K, N) int8 → (M, N) fp32, dequantized by
     `x_scale` (M, 1) fp32 and `w_scale` (1, N) fp32.
 
-    Shapes are padded up to block multiples internally (zero padding is
-    exact for the int32 accumulate)."""
+    Shapes are padded up to hardware-tile-aligned block multiples
+    internally (zero padding is exact for the int32 accumulate)."""
     m, k = xq.shape
     k2, n = wq.shape
     assert k == k2, (xq.shape, wq.shape)
-    x_scale = jnp.broadcast_to(jnp.asarray(x_scale, jnp.float32), (m, 1))
-    w_scale = jnp.broadcast_to(jnp.asarray(w_scale, jnp.float32), (1, n))
 
-    bm, bn, bk = min(block_m, m), min(block_n, n), min(block_k, k)
+    # tile-aligned blocks: never larger than requested, never smaller
+    # than the hardware tile, and always a tile multiple
+    bm = _round_up(min(block_m, _round_up(m, _SUBLANE_I8)), _SUBLANE_I8)
+    bn = _round_up(min(block_n, _round_up(n, _LANE)), _LANE)
+    bk = _round_up(min(block_k, _round_up(k, _LANE)), _LANE)
+
     xq_p = _pad_to(_pad_to(xq, bm, 0), bk, 1)
     wq_p = _pad_to(_pad_to(wq, bk, 0), bn, 1)
-    sx_p = _pad_to(x_scale, bm, 0)
-    sw_p = _pad_to(w_scale, bn, 1)
     mp, kp = xq_p.shape
     np_ = wq_p.shape[1]
+    # scale vectors ride in full-tile blocks (a width-1 lane dim is not
+    # tileable): x_scale broadcast across one lane tile, w_scale across
+    # one fp32 sublane tile — negligible HBM next to the int8 operands
+    sx = jnp.broadcast_to(jnp.asarray(x_scale, jnp.float32), (m, 1))
+    sx_p = _pad_to(jnp.broadcast_to(sx, (m, _LANE)), bm, 0)
+    sw = jnp.broadcast_to(jnp.asarray(w_scale, jnp.float32), (1, n))
+    sw_p = _pad_to(jnp.broadcast_to(sw, (8, n)), bn, 1)
     grid = (mp // bm, np_ // bn, kp // bk)
 
     if pltpu is None:
@@ -90,8 +112,8 @@ def int8_matmul(xq, wq, x_scale, w_scale, *,
         in_specs=[
             pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
             pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
-            pl.BlockSpec((bm, 1), lambda i, j, kk: (i, 0)),
-            pl.BlockSpec((1, bn), lambda i, j, kk: (0, j)),
+            pl.BlockSpec((bm, _LANE), lambda i, j, kk: (i, 0)),
+            pl.BlockSpec((8, bn), lambda i, j, kk: (0, j)),
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.int32)],

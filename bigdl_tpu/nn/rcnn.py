@@ -35,6 +35,17 @@ class _normal_init:
         return self.std * jax.random.normal(rng, shape, dtype)
 
 
+def _clip_hw(image_hw):
+    """(h, w) for box clipping: static python ints when `image_hw` is a
+    host-side tuple/array, traced scalars when it arrives as a jit operand
+    — `jnp.clip` accepts either, so the detector keeps its one-XLA-program
+    promise even with a traced im_info."""
+    h, w = image_hw[0], image_hw[1]
+    if isinstance(h, jax.core.Tracer) or isinstance(w, jax.core.Tracer):
+        return h, w
+    return int(h), int(w)
+
+
 class RegionProposal(Module):
     """Multi-level RPN: shared conv head over FPN features + per-level
     anchor decode + joint top-k/NMS proposal selection (reference:
@@ -91,7 +102,7 @@ class RegionProposal(Module):
             features, image_hw = features
         if isinstance(features, jnp.ndarray):
             features = (features,)
-        img_h, img_w = int(image_hw[0]), int(image_hw[1])
+        img_h, img_w = _clip_hw(image_hw)
 
         all_scores, all_boxes = [], []
         for lvl, feat in enumerate(features):
@@ -160,7 +171,7 @@ class Proposal(Module):
             cls_prob, bbox_pred, im_info = cls_prob
         b, fh, fw, a2 = cls_prob.shape
         na = self.anchor.num
-        img_h, img_w = int(im_info[0]), int(im_info[1])
+        img_h, img_w = _clip_hw(im_info)
         anchors = self.anchor.generate(fh, fw, self.stride)
         # foreground scores are the second half of the 2A channel block
         # (reference Proposal.scala: narrow on channel A+1..2A)
@@ -245,8 +256,7 @@ class BoxHead(Module):
         n = proposals.shape[0]
         deltas = deltas.reshape(n, self.num_classes, 4) / \
             jnp.asarray(self.DECODE_W)
-        clip = (int(image_hw[0]), int(image_hw[1])) \
-            if image_hw is not None else None
+        clip = _clip_hw(image_hw) if image_hw is not None else None
         boxes_c = decode_boxes(proposals[:, None, :], deltas, clip)  # (N,C,4)
 
         def per_class(c):
@@ -350,8 +360,7 @@ class DetectionOutputFrcnn(Module):
             cls_prob, bbox_pred, rois, im_info = cls_prob
         n = rois.shape[0]
         deltas = bbox_pred.reshape(n, self.n_classes, 4)
-        clip = (int(im_info[0]), int(im_info[1])) if im_info is not None \
-            else None
+        clip = _clip_hw(im_info) if im_info is not None else None
         boxes_c = decode_boxes(rois[:, None, :], deltas, clip)
 
         def per_class(c):

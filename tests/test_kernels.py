@@ -139,3 +139,54 @@ def test_quantized_linear_forward_3d_batch():
                                     interpret=True)
     np.testing.assert_allclose(np.asarray(out).reshape(10, 8),
                                np.asarray(flat), rtol=1e-6)
+
+
+def test_int8_matmul_unaligned_shapes_tile_padded():
+    """ADVICE r2: K=40/N=24 must produce tile-aligned Pallas blocks
+    ((32,128) for int8), not raw-dim blocks that Mosaic rejects on TPU.
+    interpret=True checks numerics; the block-shape assertion is static."""
+    from bigdl_tpu.kernels import quantized_matmul as qmm
+    assert qmm._round_up(40, 128) == 128
+    assert qmm._round_up(24, 128) == 128
+    assert qmm._round_up(6, 32) == 32
+    r = np.random.RandomState(3)
+    xq = jnp.asarray(r.randint(-127, 128, (6, 40)).astype(np.int8))
+    wq = jnp.asarray(r.randint(-127, 128, (40, 24)).astype(np.int8))
+    sx = jnp.asarray((r.rand(6, 1).astype(np.float32) + 0.5) / 60)
+    sw = jnp.asarray((r.rand(1, 24).astype(np.float32) + 0.5) / 60)
+    got = qmm.int8_matmul(xq, wq, sx, sw, interpret=True)
+    ref = (np.asarray(xq, np.int32) @ np.asarray(wq, np.int32)
+           ).astype(np.float32) * np.asarray(sx) * np.asarray(sw)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_int8_matmul_on_real_tpu_no_interpret():
+    """Non-interpret Mosaic lowering smoke (ADVICE r2): only runs when a
+    real TPU backend is live; the CI CPU mesh skips it."""
+    import jax
+    import pytest
+    if jax.default_backend() != "tpu":
+        pytest.skip("needs a live TPU backend (Mosaic lowering)")
+    from bigdl_tpu.kernels.quantized_matmul import int8_matmul
+    r = np.random.RandomState(4)
+    xq = jnp.asarray(r.randint(-127, 128, (6, 40)).astype(np.int8))
+    wq = jnp.asarray(r.randint(-127, 128, (40, 24)).astype(np.int8))
+    sx = jnp.asarray((r.rand(6, 1).astype(np.float32) + 0.5) / 60)
+    sw = jnp.asarray((r.rand(1, 24).astype(np.float32) + 0.5) / 60)
+    got = int8_matmul(xq, wq, sx, sw, interpret=False)
+    ref = (np.asarray(xq, np.int32) @ np.asarray(wq, np.int32)
+           ).astype(np.float32) * np.asarray(sx) * np.asarray(sw)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_int8_matmul_scalar_per_tensor_scales():
+    """Scalar (per-tensor) scales stay accepted — the docstring's
+    'broadcastable' contract."""
+    from bigdl_tpu.kernels.quantized_matmul import int8_matmul
+    r = np.random.RandomState(5)
+    xq = jnp.asarray(r.randint(-127, 128, (4, 16)).astype(np.int8))
+    wq = jnp.asarray(r.randint(-127, 128, (16, 8)).astype(np.int8))
+    got = int8_matmul(xq, wq, 0.02, 0.01, interpret=True)
+    ref = (np.asarray(xq, np.int32) @ np.asarray(wq, np.int32)
+           ).astype(np.float32) * 0.02 * 0.01
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5, atol=1e-6)

@@ -121,3 +121,53 @@ def test_convert_cli_example_shape(tmp_path):
     got, _ = mod.apply(p, s, jnp.asarray(x))
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4,
                                atol=1e-5)
+
+
+def test_ceil_mode_maxpool_export_roundtrip():
+    """ADVICE r2: ceil_mode pooling must export the asymmetric extra pad
+    (and MaxPool must pad -FLT_MAX, not zero — all-negative input checks
+    that zero padding can never win a window)."""
+    model = Sequential(
+        nn.SpatialMaxPooling(3, 3, 2, 2, ceil_mode=True),  # 6 -> ceil 3
+        nn.SpatialMaxPooling(2, 2, 2, 2, pad_w=1, pad_h=1))
+    params, state = model.init(jax.random.PRNGKey(0))
+    from bigdl_tpu.nn.pooling import _ceil_extra
+    assert _ceil_extra(6, 3, 2, 0) == 1      # the overflow pad is exercised
+    x = -1.0 - np.random.RandomState(0).rand(2, 6, 6, 3).astype(np.float32)
+    _roundtrip(model, params, state, x, example_input=jnp.asarray(x))
+
+
+def test_ceil_mode_avgpool_unrepresentable_raises():
+    model = Sequential(nn.SpatialAveragePooling(3, 3, 2, 2, ceil_mode=True))
+    params, state = model.init(jax.random.PRNGKey(0))
+    x = np.random.RandomState(0).rand(1, 6, 6, 2).astype(np.float32)
+    with pytest.raises(NotImplementedError, match="ceil-mode AvgPool"):
+        save_graphdef(model, params, state, example_input=jnp.asarray(x))
+    # but ceil_mode whose windows happen to tile exactly exports fine
+    model2 = Sequential(nn.SpatialAveragePooling(2, 2, 2, 2, ceil_mode=True))
+    p2, s2 = model2.init(jax.random.PRNGKey(0))
+    x2 = np.random.RandomState(1).rand(1, 8, 8, 2).astype(np.float32)
+    _roundtrip(model2, p2, s2, x2, example_input=jnp.asarray(x2))
+
+
+def test_avgpool_exclude_pad_raises():
+    model = Sequential(nn.SpatialAveragePooling(
+        3, 3, 1, 1, pad_w=1, pad_h=1, count_include_pad=False))
+    params, state = model.init(jax.random.PRNGKey(0))
+    x = np.random.RandomState(0).rand(1, 6, 6, 2).astype(np.float32)
+    with pytest.raises(NotImplementedError, match="count_include_pad"):
+        save_graphdef(model, params, state, example_input=jnp.asarray(x))
+
+
+def test_plain_batchnorm_2d_exports_mul_add():
+    """ADVICE r2: 2-D BatchNorm must not emit FusedBatchNorm (stock TF
+    rejects it on non-4D) — folded Mul/Add instead."""
+    model = Sequential(nn.Linear(6, 4), nn.BatchNormalization(4), nn.ReLU())
+    params, state = model.init(jax.random.PRNGKey(0))
+    x = np.random.RandomState(0).randn(5, 6).astype(np.float32)
+    _, state = model.apply(params, state, jnp.asarray(x), training=True)
+    buf = _roundtrip(model, params, state, x)
+    g = load_graphdef(buf)
+    ops = [g.nodes[n].op for n in g.order]
+    assert "FusedBatchNorm" not in ops
+    assert "Mul" in ops and "Add" in ops
