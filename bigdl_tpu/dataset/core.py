@@ -170,7 +170,7 @@ class ArrayDataSet(DataSet):
         self.features, self.labels = features, labels
         self.batch_size, self.shuffle, self.drop_last = \
             batch_size, shuffle, drop_last
-        self._rng = np.random.RandomState(seed)
+        self.seed = seed
         self._epoch = 0
 
     def __len__(self):
@@ -183,10 +183,17 @@ class ArrayDataSet(DataSet):
     def size(self) -> int:
         return len(self.features)
 
+    def set_epoch(self, epoch: int):
+        """Pin the shuffle epoch. The permutation is stateless in
+        (seed, epoch), so a resumed process reproduces the interrupted
+        epoch's batch order exactly (reference: dataset/DataSet.scala
+        index-array shuffle is likewise re-derivable per epoch)."""
+        self._epoch = epoch
+
     def _raw_iter(self):
         idx = np.arange(len(self.features))
         if self.shuffle:
-            self._rng.shuffle(idx)
+            np.random.RandomState(self.seed + self._epoch).shuffle(idx)
         self._epoch += 1
         bs = self.batch_size
         end = len(idx) - (len(idx) % bs) if self.drop_last else len(idx)

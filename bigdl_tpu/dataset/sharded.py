@@ -178,6 +178,8 @@ class ShardedRecordDataset(DataSet):
                  shuffle_buffer: int = 1024, queue_depth: int = 256):
         super().__init__()
         if isinstance(shards, str):
+            if os.path.isdir(shards):      # directory → all its .rec shards
+                shards = os.path.join(shards, "*.rec")
             shards = sorted(_glob.glob(shards)) or [shards]
         self.shards = list(shards)
         missing = [s for s in self.shards if not os.path.exists(s)]
@@ -193,12 +195,19 @@ class ShardedRecordDataset(DataSet):
         self.queue_depth = queue_depth
         self._epoch = 0
         self._num_records: Optional[int] = None
+        self._shard_counts: dict = {}
+        self._skip_records = 0
+
+    def _shard_count(self, path: str) -> int:
+        if path not in self._shard_counts:
+            self._shard_counts[path] = sum(1 for _ in read_shard(path))
+        return self._shard_counts[path]
 
     # records per epoch (scans once, cached)
     def num_records(self) -> int:
         if self._num_records is None:
-            self._num_records = sum(
-                sum(1 for _ in read_shard(p)) for p in self.shards)
+            self._num_records = sum(self._shard_count(p)
+                                    for p in self.shards)
         return self._num_records
 
     def __len__(self):
@@ -208,18 +217,43 @@ class ShardedRecordDataset(DataSet):
         return n
 
     def set_epoch(self, epoch: int):
-        """Force the epoch counter (mid-epoch resume replays from here)."""
+        """Force the epoch counter (mid-epoch resume picks up from here)."""
         self._epoch = epoch
 
-    def _sample_stream(self, epoch: int) -> Iterator:
+    def fast_forward_batches(self, n_batches: int):
+        """Arrange for the NEXT epoch iteration to skip `n_batches` worth of
+        records at the record-reader level — whole shards are dropped from
+        the epoch's work queue and the remainder is skipped before decode,
+        so a late-epoch resume costs frame scans, not a re-decode of the
+        trained prefix (reference: DistriOptimizer.scala:124-134
+        `recordsProcessedThisEpoch` fast-forward).
+
+        With multi-threaded decode the stream interleaving is not
+        reproducible anyway, so the contract is record-count based: the
+        resumed epoch yields exactly (epoch_batches - n_batches) batches of
+        not-yet-seen-this-epoch shard data."""
+        self._skip_records = n_batches * self.batch_size
+
+    def _sample_stream(self, epoch: int, skip_records: int = 0) -> Iterator:
         order = list(self.shards)
         if self.shuffle:
             order = [order[i] for i in
                      np.random.RandomState(self.seed + epoch)
                      .permutation(len(order))]
-        shard_q: "queue.Queue" = queue.Queue()
+        work = []                        # (path, records_to_skip_in_shard)
         for p in order:
-            shard_q.put(p)
+            if skip_records > 0:
+                c = self._shard_count(p)
+                if skip_records >= c:
+                    skip_records -= c    # drop the whole shard
+                    continue
+                work.append((p, skip_records))
+                skip_records = 0
+            else:
+                work.append((p, 0))
+        shard_q: "queue.Queue" = queue.Queue()
+        for item in work:
+            shard_q.put(item)
         out_q: "queue.Queue" = queue.Queue(maxsize=self.queue_depth)
         _END = object()
         errors: list = []
@@ -238,10 +272,12 @@ class ShardedRecordDataset(DataSet):
             try:
                 while not stop.is_set():
                     try:
-                        path = shard_q.get_nowait()
+                        path, shard_skip = shard_q.get_nowait()
                     except queue.Empty:
                         return
-                    for payload in read_shard(path):
+                    for i, payload in enumerate(read_shard(path)):
+                        if i < shard_skip:
+                            continue        # frame-scan only, no decode
                         img, label = decode_record(payload)
                         item = (self.transform(img, label)
                                 if self.transform else (img, label))
@@ -276,6 +312,7 @@ class ShardedRecordDataset(DataSet):
     def _raw_iter(self):
         epoch = self._epoch
         self._epoch += 1
+        skip_records, self._skip_records = self._skip_records, 0
         rng = np.random.RandomState(self.seed * 7919 + epoch)
         buf: List = []
         xs: List = []
@@ -294,7 +331,7 @@ class ShardedRecordDataset(DataSet):
                 return batch
             return None
 
-        for item in self._sample_stream(epoch):
+        for item in self._sample_stream(epoch, skip_records):
             if self.shuffle and self.shuffle_buffer > 1:
                 if len(buf) < self.shuffle_buffer:
                     buf.append(item)

@@ -100,7 +100,8 @@ class Optimizer:
         self.ckpt_trigger: Optional[Trigger] = None
         self.grad_processors: List[GradientProcessor] = []
         self.seed = seed
-        self.state: Dict = {"epoch": 0, "neval": 0, "records": 0}
+        self.state: Dict = {"epoch": 0, "neval": 0, "records": 0,
+                            "batch_in_epoch": 0}
         from bigdl_tpu.utils import config as _config
         self._log_every = max(1, _config.get("LOG_THROUGHPUT_EVERY"))
         self._summary = None
@@ -212,7 +213,12 @@ class Optimizer:
     # --------------------------------------------------------------- resume
     def resume(self, path: str) -> bool:
         """Load latest snapshot under `path` (mid-epoch counters included) —
-        reference: DistriOptimizer retry/recovery (:886-963)."""
+        reference: DistriOptimizer retry/recovery (:886-963). The
+        within-epoch batch cursor (`batch_in_epoch`) rides the snapshot
+        meta, so optimize() fast-forwards the epoch's iterator instead of
+        replaying finished iterations (reference:
+        optim/DistriOptimizer.scala:124-134,466-474
+        `recordsProcessedThisEpoch` resume)."""
         snap = ckpt.latest_checkpoint(path)
         if snap is None:
             return False
@@ -247,6 +253,10 @@ class Optimizer:
     # -------------------------------------------------------------- optimize
     def optimize(self) -> Tuple[Dict, Dict]:
         rng = jax.random.PRNGKey(self.seed)
+        # disjoint key namespace from the 0xBD1 init fold below — a step
+        # key derived straight from (rng, neval) would collide with the
+        # init key at iteration 0xBD1
+        step_rng = jax.random.fold_in(rng, 0x57E9)
         if hasattr(self, "_resume_trees"):
             # copy before handing to the donating step: _resume_trees (and
             # any caller alias of it) must survive the donation
@@ -281,9 +291,30 @@ class Optimizer:
             epoch_start = time.time()
             epoch_records = 0
             ended_mid_epoch = False
-            for x, y in self.dataset:
+            # keep the dataset's shuffle epoch in lockstep with the trainer
+            # (a freshly constructed dataset starts at epoch 0; after a
+            # resume the permutation must match the interrupted epoch)
+            if hasattr(self.dataset, "set_epoch"):
+                self.dataset.set_epoch(st["epoch"])
+            # mid-epoch resume: skip the already-trained batches instead of
+            # replaying them (the per-step rng is derived from neval, so
+            # the surviving iterations see the same stream a crash-free run
+            # would). Datasets exposing fast_forward_batches skip at the
+            # record-reader level (no decode); others consume and discard.
+            skip = st.get("batch_in_epoch", 0)
+            if skip > 0:
+                log.info("mid-epoch resume: fast-forwarding %d batches of "
+                         "epoch %d", skip, st["epoch"])
+                if hasattr(self.dataset, "fast_forward_batches"):
+                    self.dataset.fast_forward_batches(skip)
+                    skip = 0
+            epoch_iter = iter(self.dataset)
+            for _ in range(skip):
+                if next(epoch_iter, None) is None:
+                    break
+            for x, y in epoch_iter:
                 lr = self.method.current_lr(st)
-                rng, sub = jax.random.split(rng)
+                sub = jax.random.fold_in(step_rng, st["neval"])
                 xd, yd = self._place_batch(x, y)
                 if self._param_summary_enabled():
                     # batch refs only (never donated) — lets the Parameters
@@ -295,6 +326,7 @@ class Optimizer:
                 n = x.shape[0]
                 st["neval"] += 1
                 st["records"] += n
+                st["batch_in_epoch"] = st.get("batch_in_epoch", 0) + 1
                 # st["loss"] stays the last *flushed* float — storing the
                 # device value here would let loss-based triggers force a
                 # per-step sync. min_loss stopping granularity is therefore
@@ -313,9 +345,10 @@ class Optimizer:
             self._flush_metrics(st)
             if ended_mid_epoch:
                 # partial epoch: don't advance counters or fire per-epoch
-                # triggers — resume must replay the unfinished epoch
+                # triggers — a resume picks the epoch up at batch_in_epoch
                 break
             st["epoch"] += 1
+            st["batch_in_epoch"] = 0
             st["epoch_finished"] = True
             dur = time.time() - epoch_start
             log.info("epoch %d done: %d records in %.1fs (%.1f rec/s)",
@@ -520,7 +553,8 @@ class Optimizer:
                                 "initial trees"
                                 if hasattr(self, "_initial_trees")
                                 else "scratch")
-                    self.state = {"epoch": 0, "neval": 0, "records": 0}
+                    self.state = {"epoch": 0, "neval": 0, "records": 0,
+                                  "batch_in_epoch": 0}
                     if hasattr(self, "_initial_trees"):
                         self._resume_trees = dict(self._initial_trees)
                     else:

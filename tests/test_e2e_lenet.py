@@ -77,3 +77,112 @@ def test_resume_continues(tmp_path, data):
     params, _ = opt2.optimize()
     assert opt2.state["neval"] == 2 * it1
     assert opt2.state["epoch"] == 2
+
+
+def test_mid_epoch_resume_no_replay(tmp_path, data):
+    """VERDICT r2 missing #2: crash at iteration k mid-epoch, resume, and
+    the total records consumed must equal a crash-free run — the epoch is
+    picked up at its batch cursor, not replayed (reference:
+    optim/DistriOptimizer.scala:124-134,466-474)."""
+    x, y, _, _ = data
+    n_batches = 16
+    bs = 32
+    ds = ArrayDataSet(x[:n_batches * bs], y[:n_batches * bs],
+                      batch_size=bs, seed=1)
+    model = lenet.build(10)
+    crit = nn.ClassNLLCriterion()
+
+    # crash-free run: 2 epochs
+    free = (optim.Optimizer(model, ds, crit, optim.SGD(0.05), seed=11)
+            .set_end_when(optim.Trigger.max_epoch(2)))
+    free.optimize()
+    free_records = free.state["records"]
+    assert free_records == 2 * n_batches * bs
+
+    # "crash" 10 iterations into epoch 1 (mid-second-epoch), snapshotting
+    # every 2 iterations
+    k = n_batches + 10
+    ds2 = ArrayDataSet(x[:n_batches * bs], y[:n_batches * bs],
+                       batch_size=bs, seed=1)
+    opt1 = (optim.Optimizer(lenet.build(10), ds2, crit, optim.SGD(0.05),
+                            seed=11)
+            .set_end_when(optim.Trigger.max_iteration(k))
+            .set_checkpoint(str(tmp_path / "ck3"),
+                            optim.Trigger.several_iteration(2)))
+    opt1.optimize()
+    assert opt1.state["neval"] == k
+    assert opt1.state["batch_in_epoch"] == 10
+
+    ds3 = ArrayDataSet(x[:n_batches * bs], y[:n_batches * bs],
+                       batch_size=bs, seed=1)
+    opt2 = (optim.Optimizer(lenet.build(10), ds3, crit, optim.SGD(0.05),
+                            seed=11)
+            .set_end_when(optim.Trigger.max_epoch(2)))
+    assert opt2.resume(str(tmp_path / "ck3"))
+    assert opt2.state["batch_in_epoch"] == 10
+    opt2.optimize()
+    # resumed run finishes epoch 1 with exactly the 6 remaining batches:
+    # totals line up with the crash-free run, nothing replayed
+    assert opt2.state["neval"] == 2 * n_batches
+    assert opt2.state["records"] == free_records
+    assert opt2.state["epoch"] == 2
+
+
+def test_mid_epoch_resume_sample_coverage(tmp_path):
+    """The resumed epoch must train exactly the samples the crashed run
+    did NOT train that epoch — no duplicates, none missing. ArrayDataSet's
+    stateless (seed, epoch) permutation + the optimizer's set_epoch call
+    make the interrupted epoch's order reproducible in a fresh process."""
+    import numpy as np
+
+    n, bs = 512, 32
+    x = np.zeros((n, 8), np.float32)
+    x[:, 0] = np.arange(n)               # sample id rides feature column 0
+    y = (np.arange(n) % 4).astype(np.int32)
+
+    class Recording:
+        def __init__(self):
+            self.ds = ArrayDataSet(x, y, batch_size=bs, seed=13,
+                                   shuffle=True, drop_last=True)
+            self.seen = []
+
+        def set_epoch(self, e):
+            self.ds.set_epoch(e)
+
+        def __iter__(self):
+            for xb, yb in self.ds:
+                self.seen.append(np.asarray(xb[:, 0]).astype(int))
+                yield xb, yb
+
+    import bigdl_tpu.nn as _nn
+    from bigdl_tpu.core.container import Sequential as Seq
+
+    def mk_model():
+        return Seq(_nn.Linear(8, 16), _nn.ReLU(), _nn.Linear(16, 4),
+                   _nn.LogSoftMax())
+
+    crit = _nn.ClassNLLCriterion()
+    k = 16 + 10                          # crash 10 batches into epoch 1
+    rec1 = Recording()
+    opt1 = (optim.Optimizer(mk_model(), rec1, crit, optim.SGD(0.05), seed=3)
+            .set_end_when(optim.Trigger.max_iteration(k))
+            .set_checkpoint(str(tmp_path / "ck"),
+                            optim.Trigger.several_iteration(1)))
+    opt1.optimize()
+    crashed_epoch1 = np.concatenate(rec1.seen[16:])
+    assert crashed_epoch1.size == 10 * bs
+
+    rec2 = Recording()
+    opt2 = (optim.Optimizer(mk_model(), rec2, crit, optim.SGD(0.05), seed=3)
+            .set_end_when(optim.Trigger.max_epoch(2)))
+    assert opt2.resume(str(tmp_path / "ck"))
+    opt2.optimize()
+    # the wrapper sees all 16 batches (10 fast-forwarded + 6 trained);
+    # the fast-forwarded prefix must be EXACTLY the crashed run's trained
+    # prefix — same permutation, so nothing is double-trained or missed
+    assert len(rec2.seen) == 16
+    skipped = np.concatenate(rec2.seen[:10])
+    np.testing.assert_array_equal(skipped, crashed_epoch1)
+    trained = np.concatenate(rec2.seen[10:])
+    together = np.sort(np.concatenate([crashed_epoch1, trained]))
+    np.testing.assert_array_equal(together, np.arange(n))

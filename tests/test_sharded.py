@@ -145,3 +145,44 @@ def test_train_cli_on_shards(tmp_path):
             "--num-classes", "4", "--batch-size", "8", "--max-iter", "2",
             "--depth", "18", "--crop", "32"]
     assert T.main(argv) is not None
+
+
+def test_fast_forward_batches_skips_at_record_level(tmp_path):
+    """fast_forward_batches drops whole shards / skips records before
+    decode; the resumed epoch yields exactly the remaining batch count and
+    only records that weren't skipped."""
+    import numpy as np
+    from bigdl_tpu.dataset.sharded import (ShardedRecordDataset,
+                                           write_shards)
+
+    n, bs = 96, 8
+    # label == sample id so skipped-vs-seen sets are checkable
+    samples = [(np.full((4, 4, 3), i % 251, np.uint8), i) for i in range(n)]
+    write_shards(iter(samples), str(tmp_path), 6)
+
+    decoded = []
+
+    def spy_transform(img, label):
+        decoded.append(int(label))
+        return img.astype(np.float32), label
+
+    ds = ShardedRecordDataset(str(tmp_path / "*.rec"), batch_size=bs,
+                              shuffle=True, seed=4, transform=spy_transform)
+    ds.set_epoch(2)
+    ds.fast_forward_batches(7)           # 56 of 96 records skipped
+    batches = list(ds)
+    assert len(batches) == (n - 7 * bs) // bs == 5
+    # the skipped records were never decoded (frame-scan only)
+    assert len(decoded) == n - 7 * bs
+    # and what we did see this epoch is a subset of all ids, no dupes
+    assert len(set(decoded)) == len(decoded)
+
+
+def test_directory_path_resolves_to_shards(tmp_path):
+    import numpy as np
+    from bigdl_tpu.dataset.sharded import ShardedRecordDataset, write_shards
+    samples = [(np.zeros((2, 2, 3), np.uint8), i) for i in range(8)]
+    write_shards(iter(samples), str(tmp_path), 2)
+    ds = ShardedRecordDataset(str(tmp_path), batch_size=4, shuffle=False)
+    assert len(ds.shards) == 2
+    assert sum(1 for _ in ds) == 2
