@@ -389,6 +389,15 @@ def load(prototxt_path: str, caffemodel_path: Optional[str] = None,
             mk(top, nn.Dropout(ratio), parent, in_shape, lname=lname)
         elif ltype in ("Softmax", "SoftmaxWithLoss"):
             mk(top, nn.SoftMax(axis=-1), parent, in_shape, lname=lname)
+        elif ltype == "Log":
+            p = layer.msg("log_param")
+            if (float(p.one("base", -1.0)) != -1.0
+                    or float(p.one("scale", 1.0)) != 1.0
+                    or float(p.one("shift", 0.0)) != 0.0):
+                raise NotImplementedError(
+                    f"caffe Log layer {lname}: non-default log_param "
+                    f"(base/scale/shift) is not supported")
+            mk(top, nn.Log(), parent, in_shape, lname=lname)
         elif ltype == "LRN":
             p = layer.msg("lrn_param")
             size = _first_int(p, "local_size", 5)
@@ -413,7 +422,9 @@ def load(prototxt_path: str, caffemodel_path: Optional[str] = None,
             mk(top, m, parent, in_shape, lname=lname)
         elif ltype == "BatchNorm":
             ic = in_shape[-1]
-            m = nn.SpatialBatchNormalization(ic, eps=1e-5, affine=False)
+            p = layer.msg("batch_norm_param")
+            eps = float(p.one("eps", 1e-5))
+            m = nn.SpatialBatchNormalization(ic, eps=eps, affine=False)
             s_over = {}
             mean_b, var_b, sf = (blob_w(lname, 0), blob_w(lname, 1),
                                  blob_w(lname, 2))

@@ -1,0 +1,356 @@
+"""Caffe topology + weight export (reference:
+utils/caffe/CaffePersister.scala — `saveCaffe` emits prototxt AND
+caffemodel; per-layer mapping mirrors utils/caffe/Converter.scala in
+reverse).
+
+`save_caffe(prototxt, caffemodel, model, params, state, example_input)`
+walks a Sequential / Graph / bare layer and writes
+
+  * the net definition in protobuf text format (the dialect
+    interop/caffe_proto.py reads back), and
+  * the binary caffemodel with layer names matching the prototxt and
+    weight layouts converted to Caffe's (conv OIHW; InnerProduct rows
+    indexing a CHW flatten — Caffe is NCHW, this framework NHWC, so the
+    first FC after a feature map gets its input dim permuted).
+
+Caffe-representability rules (unsupported constructs raise, like the
+reference persister's unsupported-layer error):
+  * pooling is always ceil-mode in Caffe — floor-mode pooling exports
+    only when the traced shapes prove ceil == floor;
+  * average pooling must count_include_pad;
+  * Flatten/rank-flattening Reshape must feed a Linear (merged into the
+    InnerProduct, which is where Caffe hides its flatten);
+  * LogSoftMax exports as Softmax + Log.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from bigdl_tpu.core.container import Graph, Sequential
+from bigdl_tpu.core.module import Module
+from bigdl_tpu.interop import protowire as pw
+from bigdl_tpu.interop.caffe_proto import Scale
+from bigdl_tpu.nn.pooling import ceil_pool_out
+
+import bigdl_tpu.nn as nn
+
+
+def _txt(key, val):
+    if isinstance(val, bool):
+        return f"{key}: {'true' if val else 'false'}"
+    if isinstance(val, str):
+        return f'{key}: "{val}"'
+    if isinstance(val, float):
+        return f"{key}: {val:g}"
+    return f"{key}: {val}"
+
+
+class _Saver:
+    def __init__(self, net_name: str):
+        self.net_name = net_name
+        self.text: List[str] = []
+        self.weights: List[tuple] = []       # (layer_name, [blobs])
+        self._used = set()
+
+    def fresh(self, base: str) -> str:
+        name, i = base, 1
+        while name in self._used:
+            name, i = f"{base}{i}", i + 1
+        self._used.add(name)
+        return name
+
+    def layer(self, name: str, ltype: str, bottoms, top: str,
+              param_block: str = ""):
+        lines = [f'layer {{', f'  name: "{name}"', f'  type: "{ltype}"']
+        for b in bottoms:
+            lines.append(f'  bottom: "{b}"')
+        lines.append(f'  top: "{top}"')
+        if param_block:
+            lines.append(param_block)
+        lines.append("}")
+        self.text.append("\n".join(lines))
+
+    def blobs(self, name: str, arrays: List[np.ndarray]):
+        self.weights.append((name, [np.asarray(a, np.float32)
+                                    for a in arrays]))
+
+
+def _base(m: Module, default: str) -> str:
+    """Prototxt layer name base: the module's explicit name when the user
+    set one (so name-matching reimport via caffe.load_caffe works on the
+    exported pair), else a short generated base."""
+    nm = getattr(m, "name", None)
+    return nm if nm and nm != type(m).__name__ else default
+
+
+def _conv_param(m, dilation: int = 0) -> str:
+    if m.ph == -1 or m.pw == -1:
+        raise NotImplementedError(
+            "caffe export: SAME padding has no Caffe equivalent — "
+            "use explicit pads")
+    fields = [_txt("num_output", m.nout),
+              _txt("kernel_h", m.kh), _txt("kernel_w", m.kw),
+              _txt("stride_h", m.sh), _txt("stride_w", m.sw),
+              _txt("pad_h", m.ph), _txt("pad_w", m.pw)]
+    if getattr(m, "groups", 1) != 1:
+        fields.append(_txt("group", m.groups))
+    if dilation:
+        fields.append(_txt("dilation", dilation))
+    if not m.bias:
+        fields.append(_txt("bias_term", False))
+    return "  convolution_param { " + " ".join(fields) + " }"
+
+
+def _pool_param(m, pool: str) -> str:
+    fields = [f"pool: {pool}",
+              _txt("kernel_h", m.kh), _txt("kernel_w", m.kw),
+              _txt("stride_h", m.dh), _txt("stride_w", m.dw),
+              _txt("pad_h", m.ph), _txt("pad_w", m.pw)]
+    return "  pooling_param { " + " ".join(fields) + " }"
+
+
+def _check_pool(m, in_shape):
+    """Caffe pooling is ceil-mode; floor-mode exports only when provably
+    identical on the traced shape."""
+    if m.ph == -1 or m.pw == -1:
+        raise NotImplementedError(
+            "caffe export: SAME-padded pooling has no Caffe equivalent")
+    if not getattr(m, "ceil_mode", True):
+        if in_shape is None or len(in_shape) != 4:
+            raise NotImplementedError(
+                "caffe export: floor-mode pooling needs example_input to "
+                "prove ceil == floor (Caffe pools are always ceil-mode)")
+        for size, k, d, p in ((in_shape[1], m.kh, m.dh, m.ph),
+                              (in_shape[2], m.kw, m.dw, m.pw)):
+            if ceil_pool_out(size, k, d, p) != (size + 2 * p - k) // d + 1:
+                raise NotImplementedError(
+                    "caffe export: floor-mode pooling differs from Caffe's "
+                    "ceil-mode on this shape")
+
+
+def _emit(s: _Saver, m: Module, p: Dict, st: Dict, bottoms: List[str],
+          in_shape, pending_flat) -> tuple:
+    """One module → prototxt layer(s) + weight blobs. Returns
+    (top_blob, pending_flatten_shape)."""
+    bot = bottoms[0] if bottoms else None
+
+    if isinstance(m, (nn.Flatten, nn.Reshape)):
+        if isinstance(m, nn.Reshape) and (not m.batch_mode
+                                          or len(m.size) != 1):
+            raise NotImplementedError(
+                "caffe export: only rank-flattening Reshape is supported")
+        if in_shape is None or len(in_shape) != 4:
+            raise NotImplementedError(
+                "caffe export: Flatten needs example_input for the "
+                "NHWC→CHW InnerProduct permutation")
+        return bot, in_shape[1:]             # defer to the next Linear
+    if pending_flat is not None and not isinstance(m, nn.Linear):
+        raise NotImplementedError(
+            "caffe export: Flatten must feed a Linear (Caffe flattens "
+            "inside InnerProduct)")
+
+    if isinstance(m, nn.Linear):
+        name = s.fresh(_base(m, "fc"))
+        w = np.asarray(p["weight"])          # ours (in, out)
+        if pending_flat is not None:
+            h, wd, c = pending_flat
+            # rows of the caffe blob index a CHW flatten
+            w = (w.reshape(h, wd, c, -1).transpose(2, 0, 1, 3)
+                 .reshape(h * wd * c, -1))
+        fields = [_txt("num_output", m.out_features)]
+        if not m.bias:
+            fields.append(_txt("bias_term", False))
+        s.layer(name, "InnerProduct", [bot], name,
+                "  inner_product_param { " + " ".join(fields) + " }")
+        blobs = [w.T]                        # caffe (out, in)
+        if m.bias:
+            blobs.append(p["bias"])
+        s.blobs(name, blobs)
+        return name, None
+    if isinstance(m, nn.SpatialDilatedConvolution):
+        if m.dw != m.dh:
+            raise NotImplementedError(
+                "caffe export: anisotropic dilation (caffe_proto reads a "
+                "single dilation value)")
+        name = s.fresh(_base(m, "conv"))
+        s.layer(name, "Convolution", [bot], name,
+                _conv_param(m, dilation=m.dh))
+        blobs = [np.transpose(np.asarray(p["weight"]), (3, 2, 0, 1))]
+        if m.bias:
+            blobs.append(p["bias"])
+        s.blobs(name, blobs)
+        return name, None
+    if isinstance(m, nn.SpatialConvolution) and type(m) in (
+            nn.SpatialConvolution, nn.SpatialShareConvolution):
+        name = s.fresh(_base(m, "conv"))
+        s.layer(name, "Convolution", [bot], name, _conv_param(m))
+        blobs = [np.transpose(np.asarray(p["weight"]), (3, 2, 0, 1))]
+        if m.bias:
+            blobs.append(p["bias"])
+        s.blobs(name, blobs)
+        return name, None
+    if isinstance(m, nn.SpatialMaxPooling):
+        _check_pool(m, in_shape)
+        name = s.fresh("pool")
+        s.layer(name, "Pooling", [bot], name, _pool_param(m, "MAX"))
+        return name, None
+    if isinstance(m, nn.SpatialAveragePooling):
+        if getattr(m, "global_pooling", False):
+            name = s.fresh("pool")
+            s.layer(name, "Pooling", [bot], name,
+                    "  pooling_param { pool: AVE global_pooling: true }")
+            return name, None
+        if not m.include_pad:
+            raise NotImplementedError(
+                "caffe export: AVE pooling with count_include_pad=False "
+                "has no Caffe equivalent")
+        _check_pool(m, in_shape)
+        name = s.fresh("pool")
+        s.layer(name, "Pooling", [bot], name, _pool_param(m, "AVE"))
+        return name, None
+    if isinstance(m, nn.GlobalAveragePooling2D):
+        name = s.fresh("pool")
+        s.layer(name, "Pooling", [bot], name,
+                "  pooling_param { pool: AVE global_pooling: true }")
+        return name, None
+    if isinstance(m, nn.SpatialBatchNormalization) or \
+            (type(m) is nn.BatchNormalization):
+        name = s.fresh(_base(m, "bn"))
+        s.layer(name, "BatchNorm", [bot], name,
+                "  batch_norm_param { " + _txt("eps", float(m.eps)) + " }")
+        s.blobs(name, [np.asarray(st["running_mean"]),
+                       np.asarray(st["running_var"]),
+                       np.asarray([1.0], np.float32)])
+        if m.affine:
+            sname = s.fresh("scale")
+            s.layer(sname, "Scale", [name], sname,
+                    "  scale_param { bias_term: true }")
+            s.blobs(sname, [np.asarray(p["weight"]), np.asarray(p["bias"])])
+            return sname, None
+        return name, None
+    if isinstance(m, Scale):
+        name = s.fresh(_base(m, "scale"))
+        s.layer(name, "Scale", [bot], name,
+                "  scale_param { " + _txt("bias_term", m.bias) + " }")
+        blobs = [np.asarray(p["weight"])]
+        if m.bias:
+            blobs.append(np.asarray(p["bias"]))
+        s.blobs(name, blobs)
+        return name, None
+    if isinstance(m, nn.SpatialCrossMapLRN):
+        name = s.fresh("lrn")
+        s.layer(name, "LRN", [bot], name,
+                "  lrn_param { " + " ".join(
+                    [_txt("local_size", m.size), _txt("alpha", m.alpha),
+                     _txt("beta", m.beta), _txt("k", m.k)]) + " }")
+        return name, None
+    if isinstance(m, nn.LogSoftMax):
+        sm = s.fresh("prob")
+        s.layer(sm, "Softmax", [bot], sm)
+        name = s.fresh("logprob")
+        s.layer(name, "Log", [sm], name)
+        return name, None
+    if isinstance(m, nn.SoftMax):
+        name = s.fresh("prob")
+        s.layer(name, "Softmax", [bot], name)
+        return name, None
+    if isinstance(m, nn.Dropout):
+        name = s.fresh("drop")
+        s.layer(name, "Dropout", [bot], name,
+                "  dropout_param { " + _txt("dropout_ratio", m.p) + " }")
+        return name, None
+    if isinstance(m, nn.JoinTable):
+        if m.axis not in (-1, 3):
+            raise NotImplementedError(
+                "caffe export: JoinTable only over channels (Caffe Concat "
+                "axis 1 == NHWC channel axis)")
+        name = s.fresh("concat")
+        s.layer(name, "Concat", bottoms, name)
+        return name, None
+    if isinstance(m, (nn.CAddTable, nn.CMulTable, nn.CMaxTable)):
+        op = {"CAddTable": "SUM", "CMulTable": "PROD",
+              "CMaxTable": "MAX"}[type(m).__name__]
+        name = s.fresh("eltwise")
+        s.layer(name, "Eltwise", bottoms, name,
+                f"  eltwise_param {{ operation: {op} }}")
+        return name, None
+    _UNARY = {nn.ReLU: "ReLU", nn.Sigmoid: "Sigmoid", nn.Tanh: "TanH"}
+    for cls, ltype in _UNARY.items():
+        if type(m) is cls:
+            name = s.fresh(ltype.lower())
+            s.layer(name, ltype, [bot], name)
+            return name, None
+    if isinstance(m, nn.Identity):
+        return bot, None
+    raise NotImplementedError(
+        f"caffe export: no Caffe mapping for {type(m).__name__} "
+        f"(reference: utils/caffe/CaffePersister.scala unsupported-layer)")
+
+
+def _write_caffemodel(path: str, net_name: str, weights: List[tuple]):
+    with open(path, "wb") as fh:
+        fh.write(pw.field_str(1, net_name))
+        for lname, blobs in weights:
+            body = pw.field_str(1, lname)
+            for b in blobs:
+                blob = pw.field_bytes(7, pw.field_packed_ints(
+                    1, list(b.shape))) + \
+                    pw.field_packed_floats(5, b.reshape(-1).tolist())
+                body += pw.field_bytes(7, blob)
+            fh.write(pw.field_bytes(100, body))
+
+
+def save_caffe(prototxt_path: str, caffemodel_path: Optional[str],
+               model: Module, params: Dict, state: Dict,
+               example_input=None, net_name: str = "net") -> None:
+    """Write prototxt topology (+ caffemodel weights when a path is given).
+
+    `example_input` (NHWC array) drives the shape trace needed for the
+    InnerProduct flatten permutation and the pooling ceil/floor proof."""
+    s = _Saver(net_name)
+    header = [f'name: "{net_name}"', 'input: "data"']
+    s._used.add("data")
+
+    if isinstance(model, Sequential):
+        seq = [model[i] for i in range(len(model))]
+        params = {str(i): params.get(str(i), {}) for i in range(len(seq))}
+        state = {str(i): state.get(str(i), {}) for i in range(len(seq))}
+    elif isinstance(model, Graph):
+        raise NotImplementedError(
+            "caffe export: Graph topologies are not supported yet — "
+            "export the Sequential form, or use the TF/.t7 exporters")
+    else:
+        seq = [model]
+        params, state = {"0": params}, {"0": state}
+
+    shapes = None
+    if example_input is not None:
+        shapes, x = [], example_input
+        for i, m in enumerate(seq):
+            shapes.append(np.asarray(x).shape)
+            x, _ = m.apply(params[str(i)], state[str(i)], x)
+        in_shape = shapes[0]
+        if len(in_shape) == 4:
+            header += [_txt("input_dim", 1), _txt("input_dim", in_shape[3]),
+                       _txt("input_dim", in_shape[1]),
+                       _txt("input_dim", in_shape[2])]
+        else:
+            header += [_txt("input_dim", 1), _txt("input_dim", in_shape[1]),
+                       _txt("input_dim", 1), _txt("input_dim", 1)]
+
+    cur, pending = "data", None
+    for i, m in enumerate(seq):
+        cur, pending = _emit(
+            s, m, params[str(i)], state[str(i)], [cur],
+            shapes[i] if shapes else None, pending)
+    if pending is not None:
+        raise NotImplementedError(
+            "caffe export: trailing Flatten with no following Linear")
+
+    with open(prototxt_path, "w") as fh:
+        fh.write("\n".join(header) + "\n" + "\n".join(s.text) + "\n")
+    if caffemodel_path:
+        _write_caffemodel(caffemodel_path, net_name, s.weights)

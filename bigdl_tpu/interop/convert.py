@@ -5,9 +5,10 @@
     python -m bigdl_tpu.interop.convert --input m.bigdl-tpu --output w.t7
 
 Formats are inferred from extensions: .bigdl-tpu (full module+weights),
-.caffemodel (weights by layer name), .t7 (weight table). Caffe/t7 exports
-carry weights only — importing them back requires the module definition
-(a .bigdl-tpu file or code), like the reference requires the prototxt."""
+.caffemodel (weights; a .prototxt topology is written next to it on
+export and used automatically on import when present), .t7 (weight
+table — importing it back requires the module definition via --module,
+like the reference requires the model code)."""
 
 from __future__ import annotations
 
@@ -67,22 +68,35 @@ def convert(input_path: str, output_path: str, module_path: str = None,
         from bigdl_tpu.interop.tf_convert import load_model as load_tf
         module, params, state, _ = load_tf(input_path)
     else:
-        if not module_path:
+        import os
+        sibling_proto = input_path[:-len(".caffemodel")] + ".prototxt" \
+            if src == "caffe" else None
+        if not module_path and sibling_proto and os.path.exists(
+                sibling_proto):
+            # the pair our own caffe export writes: topology comes from
+            # the prototxt, no module skeleton needed
+            from bigdl_tpu.interop import caffe_proto
+            net = caffe_proto.load(sibling_proto, input_path)
+            module, params, state = net.module, net.params, net.state
+        elif not module_path:
             raise ValueError(f"importing from {src} needs --module "
-                             f"(a .bigdl-tpu file providing the topology)")
-        module, params, state = load_module(module_path)
-        if src == "caffe":
-            from bigdl_tpu.interop.caffe import load_caffe
-            params = load_caffe(module, params, input_path)
-        elif src == "torch":
-            from bigdl_tpu.interop import torchfile
-            params = _table_to_params(torchfile.load(input_path), params)
+                             f"(a .bigdl-tpu file providing the topology)"
+                             + (f" or a sibling {sibling_proto}"
+                                if sibling_proto else ""))
+        else:
+            module, params, state = load_module(module_path)
+            if src == "caffe":
+                from bigdl_tpu.interop.caffe import load_caffe
+                params = load_caffe(module, params, input_path)
+            elif src == "torch":
+                from bigdl_tpu.interop import torchfile
+                params = _table_to_params(torchfile.load(input_path),
+                                          params)
 
     if dst == "onnx":
         raise ValueError("onnx is an import-only format (like the "
                          "reference's onnx_loader)")
     if dst == "tf":
-        import numpy as np
         from bigdl_tpu.interop.tf_saver import save_model as save_tf
         example = (np.zeros(tuple(example_shape), np.float32)
                    if example_shape else None)
@@ -92,8 +106,14 @@ def convert(input_path: str, output_path: str, module_path: str = None,
     if dst == "bigdl":
         save_module(output_path, module, params, state)
     elif dst == "caffe":
-        from bigdl_tpu.interop.caffe import save_caffemodel
-        save_caffemodel(output_path, module, params)
+        # full persist: prototxt topology next to the caffemodel
+        # (reference: utils/caffe/CaffePersister.scala saveCaffe)
+        from bigdl_tpu.interop.caffe_saver import save_caffe
+        proto_path = output_path[:-len(".caffemodel")] + ".prototxt"
+        example = (np.zeros(tuple(example_shape), np.float32)
+                   if example_shape else None)
+        save_caffe(proto_path, output_path, module, params, state,
+                   example_input=example)
     elif dst == "torch":
         from bigdl_tpu.interop import torchfile
         torchfile.save(output_path, _params_to_table(params))
@@ -110,8 +130,8 @@ def main(argv=None):
                     help="topology .bigdl-tpu when importing caffe/t7")
     ap.add_argument("--example-shape", default=None,
                     help="comma-separated input shape (incl. batch) used "
-                         "to resolve Flatten feature counts on tf export, "
-                         "e.g. 1,28,28,1")
+                         "to resolve Flatten feature counts on tf/caffe "
+                         "export, e.g. 1,28,28,1")
     args = ap.parse_args(argv)
     shape = ([int(d) for d in args.example_shape.split(",")]
              if args.example_shape else None)

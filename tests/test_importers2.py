@@ -302,3 +302,167 @@ def test_tf_training_session_fine_tunes_imported_graph():
     after = np.asarray(sess.predict(x))
     acc1 = float((np.argmax(after, 1) == y).mean())
     assert acc1 > 0.95 and acc1 > acc0
+
+
+def test_caffe_persister_roundtrip_lenet(tmp_path):
+    """VERDICT r2 #8 (missing #3): full CaffePersister parity — export
+    prototxt + caffemodel, re-import from the files alone, identical
+    outputs (reference: utils/caffe/CaffePersister.scala saveCaffe +
+    CaffeLoader round trip)."""
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.core.container import Sequential
+    from bigdl_tpu.interop import caffe_proto
+    from bigdl_tpu.interop.caffe_saver import save_caffe
+
+    model = Sequential(
+        nn.SpatialConvolution(1, 6, 5, 5, pad_w=2, pad_h=2), nn.Tanh(),
+        nn.SpatialMaxPooling(2, 2),
+        nn.SpatialConvolution(6, 16, 5, 5), nn.Tanh(),
+        nn.SpatialMaxPooling(2, 2),
+        nn.Flatten(), nn.Linear(16 * 5 * 5, 120), nn.Tanh(),
+        nn.Linear(120, 84), nn.Tanh(), nn.Linear(84, 10), nn.LogSoftMax())
+    params, state = model.init(jax.random.PRNGKey(0))
+    r = np.random.RandomState(0)
+    x = r.randn(3, 28, 28, 1).astype(np.float32)
+
+    proto = str(tmp_path / "lenet.prototxt")
+    weights = str(tmp_path / "lenet.caffemodel")
+    save_caffe(proto, weights, model, params, state,
+               example_input=jnp.asarray(x))
+
+    net = caffe_proto.load(proto, weights)
+    got, _ = net.module.apply(net.params, net.state, jnp.asarray(x),
+                              training=False)
+    want, _ = model.apply(params, state, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_caffe_persister_bn_lrn_globalpool(tmp_path):
+    """BatchNorm+Scale pair, LRN, dropout, and global average pooling
+    survive the prototxt+caffemodel round trip."""
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.core.container import Sequential
+    from bigdl_tpu.interop import caffe_proto
+    from bigdl_tpu.interop.caffe_saver import save_caffe
+
+    model = Sequential(
+        nn.SpatialConvolution(3, 8, 3, 3, pad_w=1, pad_h=1),
+        nn.SpatialBatchNormalization(8), nn.ReLU(),
+        nn.SpatialCrossMapLRN(5, alpha=1e-3, beta=0.75, k=1.0),
+        nn.Dropout(0.4),
+        nn.GlobalAveragePooling2D(),
+        nn.Linear(8, 4), nn.SoftMax())
+    params, state = model.init(jax.random.PRNGKey(1))
+    r = np.random.RandomState(1)
+    x = r.randn(2, 8, 8, 3).astype(np.float32)
+    # non-trivial BN stats
+    _, state = model.apply(params, state, jnp.asarray(x), training=True,
+                           rng=jax.random.PRNGKey(2))
+
+    proto = str(tmp_path / "net.prototxt")
+    weights = str(tmp_path / "net.caffemodel")
+    save_caffe(proto, weights, model, params, state,
+               example_input=jnp.asarray(x))
+    net = caffe_proto.load(proto, weights)
+    got, _ = net.module.apply(net.params, net.state, jnp.asarray(x),
+                              training=False)
+    want, _ = model.apply(params, state, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_caffe_persister_unrepresentable_raises(tmp_path):
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.core.container import Sequential
+    from bigdl_tpu.interop.caffe_saver import save_caffe
+
+    model = Sequential(nn.SpatialConvolution(3, 4, 3, 3, pad_w=-1,
+                                             pad_h=-1))
+    params, state = model.init(jax.random.PRNGKey(0))
+    with pytest.raises(NotImplementedError, match="SAME"):
+        save_caffe(str(tmp_path / "a.prototxt"), None, model, params, state)
+
+    model2 = Sequential(nn.SpatialAveragePooling(
+        3, 3, 1, 1, pad_w=1, pad_h=1, count_include_pad=False))
+    p2, s2 = model2.init(jax.random.PRNGKey(0))
+    x = np.zeros((1, 6, 6, 2), np.float32)
+    with pytest.raises(NotImplementedError, match="count_include_pad"):
+        save_caffe(str(tmp_path / "b.prototxt"), None, model2, p2, s2,
+                   example_input=jnp.asarray(x))
+
+
+def test_convert_cli_any_to_caffe_roundtrip(tmp_path):
+    """convert() writes prototxt next to the caffemodel; importing from
+    the pair reproduces the source model."""
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.core.container import Sequential
+    from bigdl_tpu.interop import caffe_proto
+    from bigdl_tpu.interop.convert import convert
+    from bigdl_tpu.utils.serializer import save_module
+
+    model = Sequential(
+        nn.SpatialConvolution(1, 4, 3, 3), nn.ReLU(),
+        nn.SpatialMaxPooling(2, 2),
+        nn.Flatten(), nn.Linear(4 * 5 * 5, 10), nn.SoftMax())
+    params, state = model.init(jax.random.PRNGKey(3))
+    src = str(tmp_path / "m.bigdl-tpu")
+    save_module(src, model, params, state)
+
+    dst = str(tmp_path / "m.caffemodel")
+    convert(src, dst, example_shape=(1, 12, 12, 1))
+    assert (tmp_path / "m.prototxt").exists()
+
+    net = caffe_proto.load(str(tmp_path / "m.prototxt"), dst)
+    r = np.random.RandomState(2)
+    x = r.randn(2, 12, 12, 1).astype(np.float32)
+    got, _ = net.module.apply(net.params, net.state, jnp.asarray(x),
+                              training=False)
+    want, _ = model.apply(params, state, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_caffe_persister_bn_eps_and_reverse_cli(tmp_path):
+    """Non-default BN eps survives the round trip (batch_norm_param), and
+    convert() imports a caffemodel via its sibling prototxt with no
+    --module skeleton."""
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.core.container import Sequential
+    from bigdl_tpu.interop.caffe_saver import save_caffe
+    from bigdl_tpu.interop.convert import convert
+    from bigdl_tpu.utils.serializer import load_module
+
+    model = Sequential(
+        nn.SpatialConvolution(1, 4, 3, 3),
+        nn.SpatialBatchNormalization(4, eps=1e-2), nn.ReLU(),
+        nn.GlobalAveragePooling2D(), nn.Linear(4, 3), nn.SoftMax())
+    params, state = model.init(jax.random.PRNGKey(5))
+    r = np.random.RandomState(5)
+    x = r.randn(2, 9, 9, 1).astype(np.float32)
+    _, state = model.apply(params, state, jnp.asarray(x), training=True)
+
+    proto = str(tmp_path / "m.prototxt")
+    weights = str(tmp_path / "m.caffemodel")
+    save_caffe(proto, weights, model, params, state,
+               example_input=jnp.asarray(x))
+    assert "batch_norm_param" in open(proto).read()
+
+    out = str(tmp_path / "back.bigdl-tpu")
+    convert(weights, out)                # no module_path: sibling prototxt
+    mod2, p2, s2 = load_module(out)
+    got, _ = mod2.apply(p2, s2, jnp.asarray(x), training=False)
+    want, _ = model.apply(params, state, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_caffe_persister_anisotropic_dilation_raises(tmp_path):
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.core.container import Sequential
+    from bigdl_tpu.interop.caffe_saver import save_caffe
+    m = Sequential(nn.SpatialDilatedConvolution(1, 2, 3, 3, dilation_w=2,
+                                                dilation_h=1))
+    p, s = m.init(jax.random.PRNGKey(0))
+    with pytest.raises(NotImplementedError, match="anisotropic"):
+        save_caffe(str(tmp_path / "d.prototxt"), None, m, p, s)
