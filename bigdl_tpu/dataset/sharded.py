@@ -61,6 +61,10 @@ def decode_record(payload: bytes):
     magic, ver, label, h, w, c, enc = _HEADER.unpack_from(payload)
     if magic != _MAGIC:
         raise ValueError("not a BDLR image record")
+    if ver != 1:
+        raise ValueError(
+            f"BDLR record version {ver} is not an image/label record "
+            f"(detection records decode via decode_detection_record)")
     body = payload[_HEADER.size:]
     if enc == ENC_RAW:
         n = h * w * c
@@ -73,6 +77,123 @@ def decode_record(payload: bytes):
         img = np.asarray(Image.open(io.BytesIO(body)).convert("RGB"))
         return img, label
     raise ValueError(f"unknown record encoding id {enc}")
+
+
+# --------------------------------------------- v2: detection/segmentation
+# The scale ingestion path for detection training (reference:
+# models/utils/COCOSeqFileGenerator.scala — COCO seq-files with boxes,
+# classes, iscrowd, and RLE masks per image). Layout after the v2 header:
+#   boxes   float32 (n, 4) xyxy
+#   classes int32   (n,)
+#   iscrowd uint8   (n,)
+#   masks   per object: uint32 count_len + int32 RLE counts for the (h, w)
+#           canvas (count_len 0 = no mask), only when mask_flag
+#   image   raw HWC uint8 or a JPEG stream
+_DET_HEADER = struct.Struct("<4sBHHBBBH")  # magic ver h w c enc mask n_obj
+_DET_VERSION = 2
+
+
+def encode_detection_record(image, boxes, classes, masks=None,
+                            iscrowd=None, encoding: str = "raw") -> bytes:
+    """image: HWC uint8 (raw) or compressed bytes (jpeg, with h/w passed
+    via the image itself being decodable); boxes (n, 4) float32 xyxy;
+    classes (n,) ints; masks: optional list of n binary (h, w) arrays or
+    RLE count lists (None entries allowed)."""
+    from bigdl_tpu.dataset.segmentation import rle_encode
+    boxes = np.asarray(boxes, np.float32).reshape(-1, 4)
+    classes = np.asarray(classes, np.int32).reshape(-1)
+    n = boxes.shape[0]
+    assert classes.shape[0] == n, (boxes.shape, classes.shape)
+    iscrowd = (np.zeros(n, np.uint8) if iscrowd is None
+               else np.asarray(iscrowd, np.uint8).reshape(-1))
+    assert iscrowd.shape[0] == n, (iscrowd.shape, n)
+
+    if encoding == "raw":
+        arr = np.ascontiguousarray(image, np.uint8)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        h, w, c = arr.shape
+        img_bytes, enc = arr.tobytes(), ENC_RAW
+    elif encoding == "jpeg":
+        if not isinstance(image, (bytes, bytearray)):
+            from PIL import Image
+            buf = io.BytesIO()
+            Image.fromarray(np.asarray(image, np.uint8)).save(
+                buf, format="JPEG", quality=90)
+            h, w = np.asarray(image).shape[:2]
+            image = buf.getvalue()
+        else:
+            from PIL import Image
+            h, w = np.asarray(
+                Image.open(io.BytesIO(bytes(image)))).shape[:2]
+        c, img_bytes, enc = 3, bytes(image), ENC_JPEG
+    else:
+        raise ValueError(f"unknown encoding {encoding!r}")
+
+    out = [_DET_HEADER.pack(_MAGIC, _DET_VERSION, h, w, c, enc,
+                            1 if masks is not None else 0, n),
+           boxes.tobytes(), classes.tobytes(), iscrowd.tobytes()]
+    if masks is not None:
+        assert len(masks) == n, (len(masks), n)
+        for m in masks:
+            if m is None:
+                counts = []
+            elif isinstance(m, np.ndarray):
+                counts = rle_encode(np.asarray(m, bool))
+            else:
+                counts = list(m)
+            out.append(struct.pack("<I", len(counts)))
+            out.append(np.asarray(counts, np.int32).tobytes())
+    out.append(img_bytes)
+    return b"".join(out)
+
+
+def decode_detection_record(payload: bytes, decode_masks: bool = True):
+    """Returns (image HWC uint8, target dict with 'boxes' (n,4) float32,
+    'classes' (n,) int32, 'iscrowd' (n,) uint8, and 'masks' — a list of
+    (h, w) bool arrays / None per object when the record carries masks
+    (None when it doesn't)."""
+    from bigdl_tpu.dataset.segmentation import rle_decode
+    magic, ver, h, w, c, enc, has_masks, n = _DET_HEADER.unpack_from(payload)
+    if magic != _MAGIC or ver != _DET_VERSION:
+        raise ValueError("not a BDLR v2 detection record")
+    off = _DET_HEADER.size
+    boxes = np.frombuffer(payload, np.float32, 4 * n, off).reshape(n, 4)
+    off += 16 * n
+    classes = np.frombuffer(payload, np.int32, n, off)
+    off += 4 * n
+    iscrowd = np.frombuffer(payload, np.uint8, n, off)
+    off += n
+    masks = None
+    if has_masks:
+        masks = []
+        for _ in range(n):
+            (clen,) = struct.unpack_from("<I", payload, off)
+            off += 4
+            counts = np.frombuffer(payload, np.int32, clen, off)
+            off += 4 * clen
+            if decode_masks:
+                masks.append(rle_decode(counts.tolist(), h, w)
+                             if clen else None)
+            else:
+                masks.append(counts.tolist() if clen else None)
+    body = payload[off:]
+    if enc == ENC_RAW:
+        img = np.frombuffer(body, np.uint8, h * w * c).reshape(h, w, c)
+    else:
+        from PIL import Image
+        img = np.asarray(Image.open(io.BytesIO(body)).convert("RGB"))
+    target = {"boxes": boxes.copy(), "classes": classes.copy(),
+              "iscrowd": iscrowd.copy(), "masks": masks}
+    return img, target
+
+
+def record_version(payload: bytes) -> int:
+    """1 for image/label records, 2 for detection records."""
+    magic, ver = struct.unpack_from("<4sB", payload)
+    if magic != _MAGIC:
+        raise ValueError("not a BDLR record")
+    return ver
 
 
 # ----------------------------------------------------------------- writers
@@ -278,9 +399,7 @@ class ShardedRecordDataset(DataSet):
                     for i, payload in enumerate(read_shard(path)):
                         if i < shard_skip:
                             continue        # frame-scan only, no decode
-                        img, label = decode_record(payload)
-                        item = (self.transform(img, label)
-                                if self.transform else (img, label))
+                        item = self._decode_sample(payload)
                         if not put(item):
                             return
             except BaseException as e:      # surfaced on the consumer side
@@ -309,25 +428,31 @@ class ShardedRecordDataset(DataSet):
         finally:
             stop.set()      # unblock workers if the consumer exits early
 
+    # ---- decode / batch hooks (ShardedDetectionDataset overrides both)
+    def _decode_sample(self, payload: bytes):
+        img, label = decode_record(payload)
+        return self.transform(img, label) if self.transform \
+            else (img, label)
+
+    def _make_batch(self, samples: List) -> MiniBatch:
+        xs = [np.asarray(s[0]) for s in samples]
+        ys = [None if s[1] is None else np.asarray(s[1]) for s in samples]
+        return MiniBatch(np.stack(xs),
+                         None if ys[0] is None else np.stack(ys))
+
     def _raw_iter(self):
         epoch = self._epoch
         self._epoch += 1
         skip_records, self._skip_records = self._skip_records, 0
         rng = np.random.RandomState(self.seed * 7919 + epoch)
         buf: List = []
-        xs: List = []
-        ys: List = []
+        pending: List = []
 
         def emit(sample):
-            x, y = sample
-            xs.append(np.asarray(x))
-            ys.append(None if y is None else np.asarray(y))
-            if len(xs) == self.batch_size:
-                batch = MiniBatch(
-                    np.stack(xs),
-                    None if ys[0] is None else np.stack(ys))
-                xs.clear()
-                ys.clear()
+            pending.append(sample)
+            if len(pending) == self.batch_size:
+                batch = self._make_batch(pending)
+                pending.clear()
                 return batch
             return None
 
@@ -348,9 +473,8 @@ class ShardedRecordDataset(DataSet):
             b = emit(item)
             if b is not None:
                 yield b
-        if xs and not self.drop_last:
-            yield MiniBatch(np.stack(xs),
-                            None if ys[0] is None else np.stack(ys))
+        if pending and not self.drop_last:
+            yield self._make_batch(pending)
 
 
 # ------------------------------------------------- standard image pipelines
@@ -470,3 +594,126 @@ def _main(argv=None):
 if __name__ == "__main__":
     import sys
     sys.exit(_main())
+
+
+class ShardedDetectionDataset(ShardedRecordDataset):
+    """Detection/segmentation training over v2 record shards — the scale
+    path the reference builds with COCO seq-files
+    (models/utils/COCOSeqFileGenerator.scala writes them;
+    transform/vision/image/MTImageFeatureToBatch.scala batches with
+    fixed-size padded GT tensors).
+
+    Batches are fixed-shape for XLA: targets are padded to `max_objects`
+    with a `valid` mask —
+        x                    (B, H, W, C) float32
+        target["boxes"]      (B, M, 4)  xyxy
+        target["classes"]    (B, M)     int32
+        target["valid"]      (B, M)     bool
+        target["iscrowd"]    (B, M)     bool
+        target["masks"]      (B, M, H, W) uint8, only when with_masks
+
+    Images carrying MORE than `max_objects` annotations are truncated to
+    the first `max_objects` (COCO has images with 90+); the running count
+    is exposed as `dropped_objects` and the first truncation logs a
+    warning — size `max_objects` for the dataset's tail, not its mean.
+
+    `transform(img, target) -> (img, target)` runs per sample in the
+    worker pool (use dataset.vision's ROI-aware augmentations — boxes and
+    masks must follow any geometry change); every transformed image must
+    share one (H, W, C)."""
+
+    def __init__(self, shards, batch_size: int, max_objects: int = 32,
+                 with_masks: bool = False, transform=None, **kw):
+        super().__init__(shards, batch_size, transform=transform, **kw)
+        self.max_objects = max_objects
+        self.with_masks = with_masks
+        self.dropped_objects = 0
+
+    def _decode_sample(self, payload: bytes):
+        img, target = decode_detection_record(
+            payload, decode_masks=self.with_masks)
+        if self.transform is not None:
+            img, target = self.transform(img, target)
+        return img, target
+
+    def _make_batch(self, samples: List) -> MiniBatch:
+        m = self.max_objects
+        xs, boxes, classes, valid, iscrowd, masks = [], [], [], [], [], []
+        for img, t in samples:
+            img = np.asarray(img)
+            n = min(len(t["boxes"]), m)
+            if len(t["boxes"]) > m:
+                if not self.dropped_objects:
+                    import logging
+                    logging.getLogger("bigdl_tpu").warning(
+                        "ShardedDetectionDataset: image with %d objects "
+                        "truncated to max_objects=%d (counted in "
+                        ".dropped_objects)", len(t["boxes"]), m)
+                self.dropped_objects += len(t["boxes"]) - m
+            b = np.zeros((m, 4), np.float32)
+            c = np.zeros((m,), np.int32)
+            v = np.zeros((m,), bool)
+            ic = np.zeros((m,), bool)
+            b[:n] = np.asarray(t["boxes"], np.float32)[:n]
+            c[:n] = np.asarray(t["classes"], np.int32)[:n]
+            v[:n] = True
+            ic[:n] = np.asarray(t["iscrowd"], bool)[:n]
+            xs.append(img)
+            boxes.append(b)
+            classes.append(c)
+            valid.append(v)
+            iscrowd.append(ic)
+            if self.with_masks:
+                mk = np.zeros((m,) + img.shape[:2], np.uint8)
+                if t["masks"] is not None:
+                    for i, mask in enumerate(t["masks"][:n]):
+                        if mask is not None:
+                            mk[i] = np.asarray(mask, np.uint8)
+                masks.append(mk)
+        target = {"boxes": np.stack(boxes), "classes": np.stack(classes),
+                  "valid": np.stack(valid), "iscrowd": np.stack(iscrowd)}
+        if self.with_masks:
+            target["masks"] = np.stack(masks)
+        return MiniBatch(np.stack(xs).astype(np.float32), target)
+
+
+def generate_synthetic_detection(out_dir: str, n: int, num_shards: int = 4,
+                                 height: int = 64, width: int = 64,
+                                 classes: int = 3, max_objects: int = 4,
+                                 with_masks: bool = True, seed: int = 0
+                                 ) -> List[str]:
+    """Synthetic detection shards: rectangles of distinct intensity per
+    class drawn on noise — learnable by a small detector, for benchmarks
+    and tests (the hermetic stand-in for COCOSeqFileGenerator output)."""
+    r = np.random.RandomState(seed)
+
+    def gen():
+        for _ in range(n):
+            img = r.randint(0, 40, (height, width, 3), np.uint8)
+            k = int(r.randint(1, max_objects + 1))
+            boxes, cls, masks = [], [], []
+            for _ in range(k):
+                bw = int(r.randint(width // 8, width // 2))
+                bh = int(r.randint(height // 8, height // 2))
+                x0 = int(r.randint(0, width - bw))
+                y0 = int(r.randint(0, height - bh))
+                cat = int(r.randint(0, classes))
+                img[y0:y0 + bh, x0:x0 + bw] = 80 + 60 * cat
+                boxes.append([x0, y0, x0 + bw, y0 + bh])
+                cls.append(cat)
+                mask = np.zeros((height, width), bool)
+                mask[y0:y0 + bh, x0:x0 + bw] = True
+                masks.append(mask)
+            yield encode_detection_record(
+                img, boxes, cls, masks if with_masks else None)
+
+    os.makedirs(out_dir, exist_ok=True)
+    paths = shard_paths(out_dir, num_shards)
+    writers = [recordio.RecordWriter(p) for p in paths]
+    try:
+        for i, payload in enumerate(gen()):
+            writers[i % num_shards].write(payload)
+    finally:
+        for w in writers:
+            w.close()
+    return paths

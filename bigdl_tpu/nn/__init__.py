@@ -91,7 +91,9 @@ from bigdl_tpu.nn.misc import (ActivityRegularization, BifurcateSplitTable,
 
 from bigdl_tpu.nn import detection, ops, quantized, sparse
 from bigdl_tpu.nn.detection import (Anchor, DetectionOutputSSD, FPN, Nms,
-                                    Pooler, PriorBox, RoiAlign, RoiPooling)
+                                    Pooler, PriorBox, RoiAlign, RoiPooling,
+                                    assign_anchor_targets, rpn_loss,
+                                    smooth_l1)
 from bigdl_tpu.nn.rcnn import (BoxHead, DetectionOutputFrcnn, MaskHead,
                                Proposal, RegionProposal)
 from bigdl_tpu.nn.sparse import (DenseToSparse, LookupTableSparse, SparseCOO,

@@ -98,6 +98,71 @@ def encode_boxes(anchors, gt):
                       jnp.log(gw / aw), jnp.log(gh / ah)], -1)
 
 
+def assign_anchor_targets(anchors, gt_boxes, gt_valid,
+                          pos_iou: float = 0.7, neg_iou: float = 0.3):
+    """RPN anchor-target assignment for ONE image — static shapes, so it
+    vmaps over the batch inside a jitted train step (reference:
+    nn/AnchorTargetLayer.scala: IoU matching with positive/negative
+    thresholds, best-anchor-per-gt force-positive, bbox encode targets).
+
+    anchors (A, 4); gt_boxes (M, 4) padded; gt_valid (M,) bool.
+    Returns (labels (A,) int32: 1 pos / 0 neg / -1 ignore,
+             bbox_targets (A, 4) toward each anchor's best gt)."""
+    iou = box_iou(anchors, gt_boxes)                      # (A, M)
+    iou = jnp.where(gt_valid[None, :], iou, -1.0)
+    best_gt = jnp.argmax(iou, axis=1)                     # (A,)
+    best_iou = jnp.max(iou, axis=1)
+    labels = jnp.where(best_iou >= pos_iou, 1,
+                       jnp.where(best_iou < neg_iou, 0, -1))
+    # force-positive the highest-IoU anchor of every valid gt (a gt none
+    # of whose anchors clears pos_iou would otherwise never be learned)
+    best_anchor = jnp.argmax(iou, axis=0)                 # (M,)
+    has_overlap = jnp.max(iou, axis=0) > 0
+    # padded gt columns all argmax to anchor 0 — an OR-scatter (`max`)
+    # keeps a valid gt's True from being clobbered by their False writes
+    force = jnp.zeros(anchors.shape[0], bool).at[best_anchor].max(
+        gt_valid & has_overlap)
+    labels = jnp.where(force, 1, labels)
+    targets = encode_boxes(anchors, gt_boxes[best_gt])
+    # padded gt rows can have zero extent → encode produced nan/inf; those
+    # anchors are never positive, but the values must not poison grads
+    targets = jnp.where(jnp.isfinite(targets), targets, 0.0)
+    return labels.astype(jnp.int32), targets
+
+
+def smooth_l1(x, beta: float = 1.0 / 9.0):
+    """(reference: nn/SmoothL1Criterion.scala — the Fast-RCNN box loss)."""
+    ax = jnp.abs(x)
+    return jnp.where(ax < beta, 0.5 * ax * ax / beta, ax - 0.5 * beta)
+
+
+def rpn_loss(logits, deltas, anchors, gt_boxes, gt_valid,
+             pos_iou: float = 0.7, neg_iou: float = 0.3,
+             box_weight: float = 1.0):
+    """Batched RPN objectness + box-regression loss (reference:
+    the RPN branch losses wired in nn/RegionProposal.scala's training
+    path: sigmoid cross-entropy over sampled anchors + smooth-L1 on
+    positives). Fully static: ignore-labels are masked, not gathered.
+
+    logits (B, A); deltas (B, A, 4); anchors (A, 4);
+    gt_boxes (B, M, 4); gt_valid (B, M)."""
+    labels, targets = jax.vmap(
+        lambda gb, gv: assign_anchor_targets(anchors, gb, gv,
+                                             pos_iou, neg_iou))(
+        gt_boxes, gt_valid)
+    pos = labels == 1
+    neg = labels == 0
+    # sigmoid BCE, numerically stable form
+    z = jnp.clip(logits, -30, 30)
+    bce = jnp.maximum(z, 0) - z * pos + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    n_cls = jnp.maximum(jnp.sum(pos | neg), 1)
+    cls_loss = jnp.sum(jnp.where(pos | neg, bce, 0.0)) / n_cls
+    l1 = smooth_l1(deltas - targets).sum(-1)
+    n_pos = jnp.maximum(jnp.sum(pos), 1)
+    box_loss = jnp.sum(jnp.where(pos, l1, 0.0)) / n_pos
+    return cls_loss + box_weight * box_loss, (cls_loss, box_loss)
+
+
 def decode_boxes(anchors, deltas, clip_shape: Optional[Tuple[int, int]] = None):
     """Inverse of encode_boxes (reference: BboxUtil decode / Proposal)."""
     aw = anchors[..., 2] - anchors[..., 0]

@@ -69,9 +69,76 @@ def score_detector():
           f"{coco.batch(outputs, targets).result:.4f}")
 
 
+def train_from_shards():
+    """Detection training over the v2 sharded record path (reference:
+    COCOSeqFileGenerator.scala seq-files feeding distributed detection
+    training): synthetic detection shards → ShardedDetectionDataset with
+    padded fixed-shape GT batches → RPN head trained with
+    assign_anchor_targets/rpn_loss inside one jitted step."""
+    import tempfile
+
+    from bigdl_tpu.dataset.sharded import (
+        ShardedDetectionDataset, generate_synthetic_detection)
+    from bigdl_tpu.nn import SpatialConvolution
+    from bigdl_tpu.nn.detection import Anchor, rpn_loss
+
+    tmp = tempfile.mkdtemp()
+    generate_synthetic_detection(tmp, n=64, num_shards=4, height=48,
+                                 width=48, classes=2, seed=0)
+    ds = ShardedDetectionDataset(tmp, batch_size=8, max_objects=8,
+                                 shuffle=True, seed=1,
+                                 transform=lambda im, t:
+                                 (im.astype(np.float32) / 255.0, t))
+
+    stride = 8
+    anchor = Anchor(ratios=(0.5, 1.0, 2.0), scales=(2.0, 4.0))
+    na = anchor.num
+    # tiny two-stage backbone to the stride-8 map + RPN heads
+    bb1 = SpatialConvolution(3, 16, 5, 5, 4, 4, 2, 2)
+    bb2 = SpatialConvolution(16, 32, 3, 3, 2, 2, 1, 1)
+    head_cls = SpatialConvolution(32, na, 1, 1)
+    head_box = SpatialConvolution(32, na * 4, 1, 1)
+    rng = jax.random.PRNGKey(0)
+    params = {}
+    for name, mod in (("bb1", bb1), ("bb2", bb2), ("cls", head_cls),
+                      ("box", head_box)):
+        rng, sub = jax.random.split(rng)
+        params[name], _ = mod.init(sub)
+    anchors = anchor.generate(6, 6, stride)              # 48/8 = 6
+
+    @jax.jit
+    def step(params, x, boxes, valid):
+        def loss_fn(p):
+            f = jax.nn.relu(bb1.forward(p["bb1"], x))
+            f = jax.nn.relu(bb2.forward(p["bb2"], f))
+            logits = head_cls.forward(p["cls"], f).reshape(x.shape[0], -1)
+            deltas = head_box.forward(p["box"], f).reshape(
+                x.shape[0], -1, 4)
+            loss, (cl, bl) = rpn_loss(logits, deltas, anchors, boxes,
+                                      valid, pos_iou=0.5, neg_iou=0.2)
+            return loss, (cl, bl)
+        (loss, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        params = jax.tree.map(lambda p, gg: p - 0.05 * gg, params, g)
+        return params, loss, aux
+
+    first = last = None
+    for epoch in range(18):
+        for x, t in ds:
+            params, loss, (cl, bl) = step(
+                params, jnp.asarray(x),
+                jnp.asarray(t["boxes"]), jnp.asarray(t["valid"]))
+            if first is None:
+                first = float(loss)
+            last = float(loss)
+    print(f"[shards] RPN trained from v2 record shards: "
+          f"loss {first:.3f} -> {last:.3f}")
+    assert last < 0.5 * first, (first, last)
+
+
 def main():
     run_maskrcnn()
     score_detector()
+    train_from_shards()
     print("detection tour complete (COCO json + RLE utilities: "
           "bigdl_tpu/dataset/segmentation.py)")
 

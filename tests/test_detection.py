@@ -187,3 +187,84 @@ def test_pooler_level_assignment():
     out = pooler.forward({}, feats, boxes)
     lvl = np.asarray(out)[:, 0, 0, 0]
     np.testing.assert_allclose(lvl, [2.0, 0.0, 3.0])
+
+
+def test_assign_anchor_targets_matching_rules():
+    """IoU thresholds, ignore band, force-positive best anchor per gt,
+    padded-gt masking (reference: nn/AnchorTargetLayer.scala)."""
+    from bigdl_tpu.nn.detection import assign_anchor_targets
+    anchors = jnp.asarray(
+        [[0, 0, 10, 10],          # exact match of gt0 (IoU 1.0) -> pos
+         [0.5, 0.5, 10.5, 10.5],  # IoU 0.82 -> pos
+         [40, 40, 50, 50],        # no overlap -> neg
+         [2, 2, 14, 14]],         # IoU 0.36 -> ignore band
+        jnp.float32)
+    gt = jnp.asarray([[0, 0, 10, 10], [0, 0, 0, 0]], jnp.float32)
+    valid = jnp.asarray([True, False])
+    labels, targets = assign_anchor_targets(anchors, gt, valid,
+                                            pos_iou=0.7, neg_iou=0.3)
+    assert labels.tolist() == [1, 1, 0, -1]
+    assert bool(jnp.isfinite(targets).all())
+    np.testing.assert_allclose(np.asarray(targets[0]), [0, 0, 0, 0],
+                               atol=1e-6)
+    # no anchor clears pos_iou for a small gt: its best anchor is forced
+    gt2 = jnp.asarray([[0, 0, 4, 4]], jnp.float32)
+    labels2, _ = assign_anchor_targets(
+        anchors, gt2, jnp.asarray([True]), pos_iou=0.9, neg_iou=0.0)
+    assert int(labels2[0]) == 1
+
+
+def test_rpn_loss_trains_toward_targets():
+    """rpn_loss drives a free logits/deltas parameterization to the
+    assigned labels: loss strictly decreases and positives' deltas
+    approach the encode targets."""
+    from bigdl_tpu.nn.detection import (Anchor, assign_anchor_targets,
+                                        rpn_loss)
+    anchor = Anchor(ratios=(1.0,), scales=(2.0,))
+    anchors = anchor.generate(4, 4, 8)          # 16 anchors on a 32px image
+    r = np.random.RandomState(0)
+    gt = jnp.asarray([[[4, 4, 20, 20], [16, 12, 30, 28]]], jnp.float32)
+    valid = jnp.asarray([[True, True]])
+
+    logits = jnp.asarray(r.randn(1, 16).astype(np.float32))
+    deltas = jnp.asarray(0.1 * r.randn(1, 16, 4).astype(np.float32))
+
+    @jax.jit
+    def step(lg, dl):
+        (loss, _), (glg, gdl) = jax.value_and_grad(
+            lambda a, b: rpn_loss(a, b, anchors, gt, valid,
+                                  pos_iou=0.5, neg_iou=0.2),
+            argnums=(0, 1), has_aux=True)(lg, dl)
+        return lg - 0.5 * glg, dl - 0.5 * gdl, loss
+
+    first = None
+    for _ in range(400):
+        logits, deltas, loss = step(logits, deltas)
+        if first is None:
+            first = float(loss)
+    # BCE on free logits decays ~1/t once separable — 0.1x is the signal
+    assert float(loss) < 0.1 * first
+    labels, targets = assign_anchor_targets(anchors, gt[0], valid[0],
+                                            pos_iou=0.5, neg_iou=0.2)
+    pos = np.asarray(labels) == 1
+    assert pos.any()
+    np.testing.assert_allclose(np.asarray(deltas[0])[pos],
+                               np.asarray(targets)[pos], atol=0.05)
+    # positives score high, negatives low
+    probs = 1 / (1 + np.exp(-np.asarray(logits[0])))
+    assert probs[pos].min() > 0.8
+    assert probs[np.asarray(labels) == 0].max() < 0.2
+
+
+def test_force_positive_survives_padded_gt_rows():
+    """Regression: padded gt columns argmax to anchor 0; their False
+    writes must not clobber a valid gt's force-positive (OR-scatter)."""
+    from bigdl_tpu.nn.detection import assign_anchor_targets
+    anchors = jnp.asarray([[0, 0, 4, 4], [20, 20, 30, 30]], jnp.float32)
+    gt = jnp.asarray([[0, 0, 2, 2], [0, 0, 0, 0]], jnp.float32)
+    valid = jnp.asarray([True, False])
+    labels, _ = assign_anchor_targets(anchors, gt, valid,
+                                      pos_iou=0.9, neg_iou=0.0)
+    # gt0's only overlapping anchor (index 0, the same index every padded
+    # column argmaxes to) must stay force-positive
+    assert int(labels[0]) == 1

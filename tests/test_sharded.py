@@ -186,3 +186,74 @@ def test_directory_path_resolves_to_shards(tmp_path):
     ds = ShardedRecordDataset(str(tmp_path), batch_size=4, shuffle=False)
     assert len(ds.shards) == 2
     assert sum(1 for _ in ds) == 2
+
+
+def test_detection_record_codec_roundtrip():
+    """v2 record: boxes, classes, iscrowd, RLE masks survive encode/decode
+    (VERDICT r2 #7 — the COCOSeqFileGenerator record analogue)."""
+    import numpy as np
+    from bigdl_tpu.dataset.sharded import (decode_detection_record,
+                                           encode_detection_record,
+                                           record_version, encode_record)
+
+    r = np.random.RandomState(0)
+    img = r.randint(0, 256, (32, 40, 3), np.uint8)
+    boxes = np.asarray([[1, 2, 20, 30], [5, 5, 38, 18]], np.float32)
+    classes = [2, 7]
+    m0 = np.zeros((32, 40), bool)
+    m0[2:30, 1:20] = True
+    payload = encode_detection_record(img, boxes, classes,
+                                      masks=[m0, None], iscrowd=[0, 1])
+    assert record_version(payload) == 2
+    assert record_version(encode_record(img, 3)) == 1
+
+    img2, t = decode_detection_record(payload)
+    np.testing.assert_array_equal(img2, img)
+    np.testing.assert_allclose(t["boxes"], boxes)
+    np.testing.assert_array_equal(t["classes"], [2, 7])
+    np.testing.assert_array_equal(t["iscrowd"], [0, 1])
+    np.testing.assert_array_equal(t["masks"][0], m0)
+    assert t["masks"][1] is None
+    # jpeg image variant
+    p2 = encode_detection_record(img, boxes, classes, encoding="jpeg")
+    img3, t2 = decode_detection_record(p2)
+    assert img3.shape == img.shape and t2["masks"] is None
+
+
+def test_sharded_detection_dataset_batches(tmp_path):
+    from bigdl_tpu.dataset.sharded import (ShardedDetectionDataset,
+                                           generate_synthetic_detection)
+
+    generate_synthetic_detection(str(tmp_path), n=24, num_shards=3,
+                                 height=32, width=32, classes=2,
+                                 max_objects=3, seed=1)
+    ds = ShardedDetectionDataset(str(tmp_path), batch_size=8,
+                                 max_objects=5, with_masks=True,
+                                 shuffle=True, seed=2)
+    batches = list(ds)
+    assert len(batches) == 3
+    x, t = batches[0]
+    assert x.shape == (8, 32, 32, 3) and x.dtype == np.float32
+    assert t["boxes"].shape == (8, 5, 4)
+    assert t["classes"].shape == (8, 5)
+    assert t["valid"].shape == (8, 5) and t["valid"].any()
+    assert t["masks"].shape == (8, 5, 32, 32)
+    # mask pixels only inside their boxes; padding slots all-empty
+    for i in range(8):
+        for j in range(5):
+            if not t["valid"][i, j]:
+                assert t["masks"][i, j].sum() == 0
+            else:
+                x0, y0, x1, y1 = t["boxes"][i, j].astype(int)
+                assert t["masks"][i, j][y0:y1, x0:x1].all()
+
+
+def test_detection_dataset_rides_fast_forward(tmp_path):
+    from bigdl_tpu.dataset.sharded import (ShardedDetectionDataset,
+                                           generate_synthetic_detection)
+    generate_synthetic_detection(str(tmp_path), n=24, num_shards=3,
+                                 height=16, width=16, seed=3)
+    ds = ShardedDetectionDataset(str(tmp_path), batch_size=4,
+                                 max_objects=4, shuffle=False)
+    ds.fast_forward_batches(3)
+    assert len(list(ds)) == 3          # 6 batches - 3 skipped
