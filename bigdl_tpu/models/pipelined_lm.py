@@ -1,0 +1,133 @@
+"""Pipeline-parallel Transformer LM — the zoo config that actually trains
+with pipeline parallelism (parity-plus: SURVEY §2.13 marks PP absent in
+the reference; the LM itself mirrors nn/Transformer.scala:53 wired into
+example/languagemodel/PTBWordLM.scala).
+
+Layout follows the production-TPU rule the Pipeline class imposes: the
+embedding (tied with the softmax head) and the final LayerNorm live
+OUTSIDE the pipeline on every device; the `num_layers` causal blocks are
+grouped into `n_stages` pipeline stages, one stage per device on the
+'pipe' mesh axis, trained with the 1F1B schedule end to end
+(`Pipeline.train_step_full` streams dL/dx back out for the embedding and
+accumulates head gradients on the last stage).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from bigdl_tpu.core.module import Module
+from bigdl_tpu.nn.attention import TransformerLayer, positional_encoding
+from bigdl_tpu.nn.normalization import LayerNormalization
+from bigdl_tpu.parallel.pipeline import Pipeline
+
+
+class CausalBlocks(Module):
+    """A pipeline stage: k pre-norm causal transformer blocks. Exists so
+    the generic stage invocation (`stage.apply(p, s, h)`) runs causal
+    self-attention without the Pipeline knowing about attention kwargs."""
+
+    def __init__(self, d_model: int, num_heads: int, d_ff: int, k: int,
+                 dropout: float = 0.0, name=None):
+        super().__init__(name)
+        self.k = k
+        for i in range(k):
+            self.add_child(f"b{i}", TransformerLayer(
+                d_model, num_heads, d_ff, dropout=dropout))
+
+    def _apply(self, params, state, x, training=False, rng=None):
+        new_state = dict(state)
+        rngs = (jax.random.split(rng, self.k) if rng is not None
+                else (None,) * self.k)
+        for i in range(self.k):
+            x, new_state[f"b{i}"] = self.children()[f"b{i}"].apply(
+                params[f"b{i}"], state.get(f"b{i}", {}), x, causal=True,
+                training=training, rng=rngs[i])
+        return x, new_state
+
+
+class PipelinedLM:
+    """Decoder-only LM with the block stack pipelined over the 'pipe'
+    axis. Usage:
+
+        mesh = create_mesh(pipe=4, drop_trivial_axes=True)
+        lm = PipelinedLM(vocab, n_stages=4, n_microbatches=8)
+        st = lm.init(jax.random.PRNGKey(0), mesh)
+        st, loss = lm.train_step(st, tokens_x, tokens_y, mesh, lr=1e-3)
+        logits = lm.apply(st, tokens_x, mesh)
+    """
+
+    def __init__(self, vocab_size: int, d_model: int = 128,
+                 num_heads: int = 4, d_ff: Optional[int] = None,
+                 num_layers: int = 4, n_stages: int = 4,
+                 n_microbatches: int = 8, max_len: int = 512):
+        if num_layers % n_stages:
+            raise ValueError(f"num_layers {num_layers} must divide by "
+                             f"n_stages {n_stages}")
+        self.vocab_size, self.d_model = vocab_size, d_model
+        self.max_len = max_len
+        d_ff = d_ff or 4 * d_model
+        per = num_layers // n_stages
+        self.pipe = Pipeline(
+            [CausalBlocks(d_model, num_heads, d_ff, per)
+             for _ in range(n_stages)],
+            n_microbatches=n_microbatches)
+        self.final_ln = LayerNormalization(d_model)
+
+    # --------------------------------------------------------------- state
+    def init(self, rng, mesh: Mesh):
+        k_emb, k_pipe, k_ln = jax.random.split(rng, 3)
+        emb = (jax.random.normal(k_emb, (self.vocab_size, self.d_model))
+               * self.d_model ** -0.5)
+        ln_p, _ = self.final_ln.init(k_ln)
+        pv = self.pipe.shard(self.pipe.init(k_pipe), mesh)
+        return {"emb": emb, "ln": ln_p, "pv": pv}
+
+    # ------------------------------------------------------------- pieces
+    def _embed(self, emb, tokens):
+        x = emb[tokens] * math.sqrt(self.d_model)
+        return x + positional_encoding(tokens.shape[1], self.d_model,
+                                       x.dtype)
+
+    def _loss_fn(self):
+        final_ln = self.final_ln
+
+        def loss(h_mb, y_mb, lp):
+            h, _ = final_ln.apply(lp["ln"], {}, h_mb)
+            logits = h @ lp["emb"].T                 # tied softmax
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.mean(jnp.take_along_axis(
+                logp, y_mb[..., None], axis=-1))
+        return loss
+
+    # -------------------------------------------------------------- steps
+    def train_step(self, st, x_tokens, y_tokens, mesh: Mesh,
+                   lr: float = 1e-3, rng=None):
+        """One end-to-end 1F1B SGD step; returns (new_state, loss)."""
+        if not hasattr(self, "_loss"):
+            self._loss = self._loss_fn()
+        emb = st["emb"]
+        h, pull = jax.vjp(lambda e: self._embed(e, x_tokens), emb)
+        lp = {"emb": emb, "ln": st["ln"]}
+        loss, g_stage, d_x, d_lp, pv = self.pipe.train_step_full(
+            st["pv"], h, y_tokens, self._loss, mesh, rng=rng,
+            loss_params=lp)
+        (d_emb_in,) = pull(d_x)
+        d_emb = d_emb_in + d_lp["emb"]               # tied weights
+        new_pv = {"flat": pv["flat"] - lr * g_stage, "state": pv["state"]}
+        return ({"emb": emb - lr * d_emb,
+                 "ln": jax.tree.map(lambda p, g: p - lr * g,
+                                    st["ln"], d_lp["ln"]),
+                 "pv": new_pv}, float(loss))
+
+    def apply(self, st, tokens, mesh: Mesh):
+        """(B, T) tokens → (B, T, vocab) logits."""
+        h = self._embed(st["emb"], tokens)
+        h = self.pipe.apply(st["pv"], h, mesh)
+        h, _ = self.final_ln.apply(st["ln"], {}, h)
+        return h @ st["emb"].T

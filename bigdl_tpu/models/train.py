@@ -263,6 +263,8 @@ def train_ptb(args):
             yield MiniBatch(xs[i], ys[i])
 
     ds = IteratorDataSet(epoch)
+    if args.pipeline_stages and args.pipeline_stages > 1:
+        return _train_ptb_pipelined(args, d, xs, ys)
     if args.model == "transformer":
         model = rnn.build_transformer(d.vocab_size, d_model=args.hidden,
                                       num_heads=4, d_ff=args.hidden * 4,
@@ -281,6 +283,56 @@ def train_ptb(args):
     params, state = _finish(opt, args, model, f"ptb-{args.model}")
     print(f"ptb perplexity ~ {np.exp(opt.state['loss']):.1f}")
     return params, state
+
+
+def _train_ptb_pipelined(args, d, xs, ys):
+    """PTB transformer with the block stack pipeline-parallel over the
+    'pipe' mesh axis (models/pipelined_lm.py; 1F1B end to end). Uses its
+    own step loop — pipeline training updates the boundary params with
+    gradients the Pipeline streams out, which the Optimizer facade's
+    single-tree step does not model."""
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu.models.pipelined_lm import PipelinedLM
+    from bigdl_tpu.parallel.mesh import create_mesh
+
+    S = args.pipeline_stages
+    if args.model != "transformer":
+        raise SystemExit("--pipeline-stages needs --model transformer "
+                         "(the LSTM's recurrence does not pipeline)")
+    bs = args.batch_size or 20
+    micro = 2 * S
+    if bs % micro:
+        raise SystemExit(
+            f"--pipeline-stages {S} runs {micro} microbatches (2x stages); "
+            f"--batch-size {bs} must be a multiple of {micro}")
+    mesh = create_mesh(pipe=S, drop_trivial_axes=True)
+    lm = PipelinedLM(d.vocab_size, d_model=args.hidden, num_heads=4,
+                     num_layers=args.layers, n_stages=S,
+                     n_microbatches=micro)
+    rng = jax.random.PRNGKey(0)
+    st = lm.init(rng, mesh)
+    lr = args.learning_rate or 1e-3
+    max_iter = args.max_iter or (xs.shape[0] * (args.max_epoch or 1))
+    first = last = None
+    it = 0
+    while it < max_iter:
+        for i in range(xs.shape[0]):
+            rng, sub = jax.random.split(rng)
+            st, loss = lm.train_step(st, jnp.asarray(xs[i]),
+                                     jnp.asarray(ys[i]), mesh, lr=lr,
+                                     rng=sub)
+            first = loss if first is None else first
+            last = loss
+            it += 1
+            if it % 10 == 0 or it == max_iter:
+                print(f"pipelined-ptb iter {it} loss {loss:.4f} "
+                      f"(ppl ~ {np.exp(loss):.1f})")
+            if it >= max_iter:
+                break
+    print(f"ptb pipelined x{S}: loss {first:.3f} -> {last:.3f}, "
+          f"perplexity ~ {np.exp(last):.1f}")
+    return st, None
 
 
 def main(argv=None):
@@ -313,6 +365,10 @@ def main(argv=None):
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--num-steps", type=int, default=20)
     p.add_argument("--vocab-size", type=int, default=10000)
+    p.add_argument("--pipeline-stages", type=int, default=0,
+                   help="train the transformer body pipeline-parallel "
+                        "over a 'pipe' mesh axis of this size (1F1B; "
+                        "embedding/head replicated outside the pipe)")
 
     args = ap.parse_args(argv)
     fn = {"lenet": train_lenet, "resnet": train_resnet,

@@ -369,30 +369,57 @@ class Pipeline:
         over the microbatch). Returns (mean_loss, grads, new_pv) where
         grads matches pv["flat"] (S, L) — each device's row holds its own
         stage's gradient, ready for a pipe-sharded optimizer update."""
+        loss, grads, _, _, new_pv = self._train_common(
+            pv, x, y, loss_fn, mesh, rng, None, full=False)
+        return loss, grads, new_pv
+
+    def train_step_full(self, pv, x, y, loss_fn: Callable, mesh: Mesh,
+                        rng=None, loss_params=None):
+        """End-to-end 1F1B: like train_step, but ALSO differentiates the
+        pipeline boundary so embedding/head living outside the pipe train
+        too. `loss_fn(h_mb, y_mb, loss_params) -> scalar`.
+
+        Returns (mean_loss, stage_grads, d_x, d_loss_params, new_pv):
+          d_x            — gradient wrt the pipeline input x (same shape),
+                           produced by stage 0's backward and streamed out;
+                           feed it to the embedding's VJP.
+          d_loss_params  — gradient of the head/loss parameter pytree,
+                           accumulated on the last stage and psum-shared.
+        """
+        if loss_params is None:
+            raise ValueError("train_step_full needs loss_params (use "
+                             "train_step when the loss has no parameters)")
+        return self._train_common(pv, x, y, loss_fn, mesh, rng,
+                                  loss_params, full=True)
+
+    def _train_common(self, pv, x, y, loss_fn, mesh, rng, loss_params,
+                      full):
         S, M = self.n_stages, self.n_microbatches
         xs, mb = self._prep(x)
         ys = y.reshape((S, M // S, mb) + y.shape[1:])
         base_key = rng if rng is not None else jax.random.PRNGKey(0)
-        sig = ("train", xs.shape, str(x.dtype), ys.shape, str(y.dtype),
-               loss_fn, mesh)
+        lp = loss_params if full else jnp.zeros((), jnp.float32)
+        sig = ("train", full, xs.shape, str(x.dtype), ys.shape,
+               str(y.dtype), loss_fn, mesh)
         fn = self._compiled.get(sig)
         if fn is None:
             self._check(xs.shape[2:], x.dtype)
-            fn = self._build_train(x.dtype, y.dtype, loss_fn, mesh)
+            fn = self._build_train(x.dtype, y.dtype, loss_fn, mesh, full)
             self._compiled[sig] = fn
-        loss, grads, new_state = fn(pv["flat"], pv["state"], xs, ys,
-                                    base_key)
-        return (loss[0], grads,
+        loss, grads, new_state, dx, dlp = fn(pv["flat"], pv["state"], xs,
+                                             ys, base_key, lp)
+        d_x = (dx[0].reshape(x.shape) if full else None)
+        return (loss[0], grads, d_x, (dlp if full else None),
                 {"flat": pv["flat"], "state": new_state})
 
-    def _build_train(self, x_dtype, y_dtype, loss_fn, mesh):
+    def _build_train(self, x_dtype, y_dtype, loss_fn, mesh, full=False):
         S, M = self.n_stages, self.n_microbatches
         fwd_branches = self._fwd_branches(True)
         vjp_branches = self._vjp_branches()
         per_dev = M // S
         ring = 2 * S
 
-        def shard_fn(flat, state, xs, ys, key):
+        def shard_fn(flat, state, xs, ys, key, lp):
             prow, srow = flat[0], state[0]
             local_x, local_y = xs[0], ys[0]
             d = lax.axis_index(PIPE_AXIS)
@@ -406,7 +433,7 @@ class Pipeline:
 
             def tick(t, carry):
                 (h_buf, g_buf, in_tb, lb_tb, srow, act_ring, st_ring,
-                 grad_acc, loss_acc) = carry
+                 grad_acc, loss_acc, dx_buf, lp_acc) = carry
                 # --- input streaming toward stage 0
                 m_in = t + d
                 li = jnp.clip(m_in - d * per_dev, 0, per_dev - 1)
@@ -440,7 +467,15 @@ class Pipeline:
                 srow = jnp.where(act_f, new_srow, srow)
                 # --- last stage: per-microbatch loss + grad seed
                 is_last = d == S - 1
-                loss_m, g_seed = jax.value_and_grad(loss_fn)(h, lb_tb)
+                if full:
+                    (loss_m, (g_seed, g_lp)) = jax.value_and_grad(
+                        loss_fn, argnums=(0, 2))(h, lb_tb, lp)
+                    lp_acc = jax.tree.map(
+                        lambda acc, g: acc + jnp.where(act_f & is_last,
+                                                       g, 0.0),
+                        lp_acc, g_lp)
+                else:
+                    loss_m, g_seed = jax.value_and_grad(loss_fn)(h, lb_tb)
                 loss_acc = loss_acc + jnp.where(act_f & is_last, loss_m, 0.0)
                 # --- backward sub-step: bwd(m_b, d) at tick 2(S-1)-d+m_b
                 m_b = t - 2 * (S - 1) + d
@@ -456,28 +491,46 @@ class Pipeline:
                 grad_acc = grad_acc + jnp.where(act_b, d_row,
                                                 jnp.zeros_like(d_row))
                 d_h = jnp.where(act_b, d_h, jnp.zeros_like(d_h))
+                if full:
+                    # stage 0's input gradient IS dL/dx for microbatch m_b
+                    slot_x = jnp.clip(m_b, 0, M - 1)
+                    cur_dx = lax.dynamic_index_in_dim(dx_buf, slot_x,
+                                                      keepdims=False)
+                    dx_buf = lax.dynamic_update_index_in_dim(
+                        dx_buf, jnp.where(act_b & (d == 0), d_h, cur_dx),
+                        slot_x, 0)
                 # --- rotate transit buffers
                 h_buf = lax.ppermute(h, PIPE_AXIS, _ring_fwd(S))
                 g_buf = lax.ppermute(d_h, PIPE_AXIS, _ring_bwd(S))
                 in_tb = lax.ppermute(in_tb, PIPE_AXIS, _ring_bwd(S))
                 lb_tb = lax.ppermute(lb_tb, PIPE_AXIS, _ring_fwd(S))
                 return (h_buf, g_buf, in_tb, lb_tb, srow, act_ring, st_ring,
-                        grad_acc, loss_acc)
+                        grad_acc, loss_acc, dx_buf, lp_acc)
 
             z = jnp.zeros(h_shape, x_dtype)
             carry0 = (z, z, z, jnp.zeros(y_shape, y_dtype), srow,
                       jnp.zeros((ring,) + h_shape, x_dtype),
                       jnp.zeros((ring,) + srow.shape, srow.dtype),
-                      jnp.zeros_like(prow), jnp.asarray(0.0, jnp.float32))
+                      jnp.zeros_like(prow), jnp.asarray(0.0, jnp.float32),
+                      # dx collection buffer only exists in the full path
+                      jnp.zeros(((M if full else 1),) + h_shape, x_dtype),
+                      jax.tree.map(jnp.zeros_like, lp))
             out = lax.fori_loop(0, ticks, tick, carry0)
             srow, grad_acc, loss_acc = out[4], out[7], out[8]
+            dx_buf, lp_acc = out[9], out[10]
             loss = lax.psum(loss_acc, PIPE_AXIS) / M
-            return loss[None], grad_acc[None] / M, srow[None]
+            # only stage 0 filled dx_buf / only the last stage lp_acc —
+            # psum shares them (all other shards contribute zeros)
+            dx = lax.psum(dx_buf, PIPE_AXIS) / M
+            d_lp = jax.tree.map(lambda g: lax.psum(g, PIPE_AXIS) / M,
+                                lp_acc)
+            return (loss[None], grad_acc[None] / M, srow[None], dx[None],
+                    d_lp)
 
         return jax.jit(shard_map(
             shard_fn, mesh=mesh,
             in_specs=(P(PIPE_AXIS, None), P(PIPE_AXIS, None), P(PIPE_AXIS),
-                      P(PIPE_AXIS), P()),
+                      P(PIPE_AXIS), P(), P()),
             out_specs=(P(PIPE_AXIS), P(PIPE_AXIS, None),
-                       P(PIPE_AXIS, None)),
+                       P(PIPE_AXIS, None), P(PIPE_AXIS), P()),
             check_vma=False))

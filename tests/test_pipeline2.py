@@ -168,3 +168,68 @@ def test_pipelined_transformer_lm_converges():
         flat = flat - 0.5 * grads
         losses.append(float(loss))
     assert losses[-1] < 0.5 * losses[0], losses
+
+
+def test_train_step_full_matches_unpipelined_grads():
+    """train_step_full's boundary gradients (d_x -> embedding, head/ln
+    grads) and stage grads must equal the same math computed without the
+    pipeline — 1F1B end to end is an exact program transform."""
+    from bigdl_tpu.models.pipelined_lm import PipelinedLM
+    vocab, dm, T, B, M, S = 13, 8, 6, 8, 4, 2
+    mesh = _mesh(S)
+    lm = PipelinedLM(vocab, d_model=dm, num_heads=2, num_layers=2,
+                     n_stages=S, n_microbatches=M)
+    st = lm.init(jax.random.PRNGKey(0), mesh)
+    r = np.random.RandomState(0)
+    xt = jnp.asarray(r.randint(0, vocab, (B, T)))
+    yt = jnp.asarray(r.randint(0, vocab, (B, T)))
+
+    pv = st["pv"]
+    h, pull = jax.vjp(lambda e: lm._embed(e, xt), st["emb"])
+    lp = {"emb": st["emb"], "ln": st["ln"]}
+    loss, g_stage, d_x, d_lp, _ = lm.pipe.train_step_full(
+        pv, h, yt, lm._loss_fn(), mesh, loss_params=lp)
+
+    def ref(flat, emb, ln):
+        hh = lm._embed(emb, xt)
+        for i, stage in enumerate(lm.pipe.stages):
+            p = lm.pipe._p_meta[i].unflatten(flat[i])
+            s = lm.pipe._s_meta[i].unflatten(pv["state"][i])
+            hh, _ = stage.apply(p, s, hh, training=True)
+        hh, _ = lm.final_ln.apply(ln, {}, hh)
+        logp = jax.nn.log_softmax(hh @ emb.T, -1)
+        return -jnp.mean(jnp.take_along_axis(logp, yt[..., None], -1))
+
+    ref_loss, (g_flat, g_emb, g_ln) = jax.value_and_grad(
+        ref, argnums=(0, 1, 2))(pv["flat"], st["emb"], st["ln"])
+    assert abs(float(loss) - float(ref_loss)) < 1e-5
+    np.testing.assert_allclose(np.asarray(g_stage), np.asarray(g_flat),
+                               rtol=1e-4, atol=1e-5)
+    (d_emb_in,) = pull(d_x)
+    np.testing.assert_allclose(np.asarray(d_emb_in + d_lp["emb"]),
+                               np.asarray(g_emb), rtol=1e-4, atol=1e-5)
+    for a, b in zip(jax.tree.leaves(d_lp["ln"]), jax.tree.leaves(g_ln)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_pipelined_lm_zoo_model_converges():
+    """The zoo PipelinedLM (VERDICT r2 #9): embedding+head train together
+    with the pipelined body; next-token loss drops on learnable data."""
+    from bigdl_tpu.models.pipelined_lm import PipelinedLM
+    vocab, T, B = 17, 8, 16
+    mesh = _mesh(4)
+    lm = PipelinedLM(vocab, d_model=32, num_heads=2, num_layers=4,
+                     n_stages=4, n_microbatches=8)
+    st = lm.init(jax.random.PRNGKey(1), mesh)
+    toks = np.stack([(np.arange(T + 1) + i) % vocab for i in range(B)])
+    xt, yt = jnp.asarray(toks[:, :-1]), jnp.asarray(toks[:, 1:])
+    losses = []
+    for i in range(40):
+        st, loss = lm.train_step(st, xt, yt, mesh, lr=0.05)
+        losses.append(loss)
+    assert losses[-1] < 0.4 * losses[0], (losses[0], losses[-1])
+    # inference path agrees with what training optimized
+    logits = lm.apply(st, xt, mesh)
+    acc = float((jnp.argmax(logits, -1) == yt).mean())
+    assert acc > 0.5, acc
