@@ -26,7 +26,9 @@ import numpy as np
 from jax import lax
 
 from bigdl_tpu.core.module import Module
-from bigdl_tpu.nn.conv import SpatialConvolution, _DN_2D, _same_or_pad
+from bigdl_tpu.nn.conv import (SpatialConvolution,
+                               SpatialDilatedConvolution, _DN_2D,
+                               _same_or_pad)
 from bigdl_tpu.nn.linear import Linear
 
 
@@ -103,7 +105,8 @@ class QuantizedLinear(Module):
 
 
 class QuantizedSpatialConvolution(Module):
-    """(reference: nn/quantized/SpatialConvolution.scala:197)."""
+    """(reference: nn/quantized/SpatialConvolution.scala:197; dilation
+    covers nn/quantized/SpatialDilatedConvolution.scala too)."""
 
     def __init__(self, conv: SpatialConvolution,
                  input_scale: Optional[float] = None, name=None):
@@ -112,6 +115,7 @@ class QuantizedSpatialConvolution(Module):
         self.nin, self.nout = conv.nin, conv.nout
         self.sw, self.sh = conv.sw, conv.sh
         self.pw, self.ph = conv.pw, conv.ph
+        self.dw, self.dh = getattr(conv, "dw", 1), getattr(conv, "dh", 1)
         self.groups, self.has_bias = conv.groups, conv.bias
         self.input_scale = input_scale
 
@@ -139,6 +143,7 @@ class QuantizedSpatialConvolution(Module):
         acc = lax.conv_general_dilated(
             xq, params["weight_q"], window_strides=(self.sh, self.sw),
             padding=_same_or_pad(self.ph, self.pw), dimension_numbers=_DN_2D,
+            rhs_dilation=(self.dh, self.dw),
             feature_group_count=self.groups,
             preferred_element_type=jnp.int32)
         y = acc.astype(jnp.float32) * sx * params["weight_scale"]
@@ -148,7 +153,8 @@ class QuantizedSpatialConvolution(Module):
 
 
 _QUANTIZABLE = {Linear: QuantizedLinear,
-                SpatialConvolution: QuantizedSpatialConvolution}
+                SpatialConvolution: QuantizedSpatialConvolution,
+                SpatialDilatedConvolution: QuantizedSpatialConvolution}
 
 
 def quantize(module: Module, params: Dict,

@@ -1,0 +1,76 @@
+"""Golden parity part 5 — remaining torch-comparable layers
+(reference analogues: test/.../torch/VolumetricAveragePoolingSpec.scala,
+VolumetricFullConvolutionSpec.scala, HardShrinkSpec, SoftShrinkSpec)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax                                                    # noqa: E402
+import jax.numpy as jnp                                       # noqa: E402
+
+import bigdl_tpu.nn as nn                                     # noqa: E402
+
+
+def _j2t(x):
+    return torch.from_numpy(np.asarray(x).copy())
+
+
+def test_volumetric_avgpool_matches_torch():
+    r = np.random.RandomState(0)
+    x = r.randn(2, 6, 8, 8, 3).astype(np.float32)     # NDHWC
+    for pads, include in (((0, 0, 0), True), ((1, 1, 1), True),
+                          ((1, 1, 1), False)):
+        layer = nn.VolumetricAveragePooling(
+            2, 2, 2, 2, 2, 2, pad_t=pads[0], pad_w=pads[1], pad_h=pads[2],
+            count_include_pad=include)
+        ours = layer.forward({}, jnp.asarray(x))
+        tl = torch.nn.AvgPool3d(2, 2, padding=pads,
+                                count_include_pad=include)
+        want = tl(_j2t(x).permute(0, 4, 1, 2, 3)) \
+            .permute(0, 2, 3, 4, 1).numpy()
+        np.testing.assert_allclose(np.asarray(ours), want, rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_volumetric_full_convolution_matches_torch():
+    r = np.random.RandomState(1)
+    x = r.randn(2, 4, 5, 5, 3).astype(np.float32)
+    layer = nn.VolumetricFullConvolution(3, 6, 3, 3, 3, 2, 2, 2,
+                                         pad_t=1, pad_w=1, pad_h=1,
+                                         adj_t=1, adj_w=1, adj_h=1)
+    params, state = layer.init(jax.random.PRNGKey(0))
+    ours, _ = layer.apply(params, state, jnp.asarray(x))
+
+    tl = torch.nn.ConvTranspose3d(3, 6, 3, stride=2, padding=1,
+                                  output_padding=1)
+    with torch.no_grad():
+        # ours (kt, kh, kw, cin, cout) -> torch (cin, cout, kt, kh, kw)
+        tl.weight.copy_(_j2t(params["weight"]).permute(3, 4, 0, 1, 2))
+        tl.bias.copy_(_j2t(params["bias"]))
+    want = tl(_j2t(x).permute(0, 4, 1, 2, 3)) \
+        .permute(0, 2, 3, 4, 1).detach().numpy()
+    assert np.asarray(ours).shape == want.shape
+    np.testing.assert_allclose(np.asarray(ours), want, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_shrink_activations_match_torch():
+    r = np.random.RandomState(2)
+    x = (r.randn(4, 9) * 2).astype(np.float32)
+    pairs = [
+        (nn.HardShrink(0.5), torch.nn.Hardshrink(0.5)),
+        (nn.SoftShrink(0.5), torch.nn.Softshrink(0.5)),
+    ]
+    for ours_l, torch_l in pairs:
+        ours = ours_l.forward({}, jnp.asarray(x))
+        want = torch_l(_j2t(x)).numpy()
+        np.testing.assert_allclose(np.asarray(ours), want, rtol=1e-6,
+                                   atol=1e-7)
+        # gradients too
+        g = jax.grad(lambda a: ours_l.forward({}, a).sum())(jnp.asarray(x))
+        xt = _j2t(x).requires_grad_(True)
+        torch_l(xt).sum().backward()
+        np.testing.assert_allclose(np.asarray(g), xt.grad.numpy(),
+                                   rtol=1e-6, atol=1e-7)

@@ -122,3 +122,24 @@ def test_quantize_graph_model():
     out, _ = qmodel.apply(qparams, state, x)
     assert (np.argmax(np.asarray(out), 1) ==
             np.argmax(np.asarray(ref), 1)).mean() == 1.0
+
+
+def test_quantize_dilated_conv():
+    """Dilated conv quantizes too (reference:
+    nn/quantized/SpatialDilatedConvolution.scala) — geometry preserved,
+    int8 output tracks the float layer."""
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.nn.quantized import quantize
+
+    layer = nn.SpatialDilatedConvolution(3, 8, 3, 3, pad_w=2, pad_h=2,
+                                         dilation_w=2, dilation_h=2)
+    params, state = layer.init(jax.random.PRNGKey(0))
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 12, 12, 3)
+                    .astype(np.float32))
+    ref = layer.forward(params, x)
+    qm, qp = quantize(layer, params)
+    got = qm.forward(qp, x)
+    assert got.shape == ref.shape
+    err = float(jnp.abs(got - ref).max())
+    scale = float(jnp.abs(ref).max())
+    assert err < 0.05 * scale, (err, scale)
