@@ -26,33 +26,45 @@ def ones(rng, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
     return jnp.ones(shape, dtype)
 
 
-def const(value: float) -> Initializer:
-    def _init(rng, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
-        return jnp.full(shape, value, dtype)
-    return _init
+class const:
+    """Constant fill. A class, not a closure, so modules holding it stay
+    picklable for the durable model format (serializer sweep)."""
+
+    def __init__(self, value: float):
+        self.value = value
+
+    def __call__(self, rng, shape, dtype=jnp.float32, fan_in=None,
+                 fan_out=None):
+        return jnp.full(shape, self.value, dtype)
 
 
-def random_uniform(lower: float = None, upper: float = None) -> Initializer:
+class random_uniform:
     """RandomUniform; with no bounds, uses the Torch default 1/sqrt(fan_in)
     (reference: nn/InitializationMethod.scala RandomUniform)."""
-    if (lower is None) != (upper is None):
-        raise ValueError("random_uniform needs both bounds or neither, got "
-                         f"lower={lower}, upper={upper}")
 
-    def _init(rng, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
-        if lower is None:
+    def __init__(self, lower: float = None, upper: float = None):
+        if (lower is None) != (upper is None):
+            raise ValueError("random_uniform needs both bounds or neither, "
+                             f"got lower={lower}, upper={upper}")
+        self.lower, self.upper = lower, upper
+
+    def __call__(self, rng, shape, dtype=jnp.float32, fan_in=None,
+                 fan_out=None):
+        if self.lower is None:
             bound = 1.0 / math.sqrt(max(1, fan_in if fan_in else shape[-1]))
             lo, hi = -bound, bound
         else:
-            lo, hi = lower, upper
+            lo, hi = self.lower, self.upper
         return jax.random.uniform(rng, shape, dtype, lo, hi)
-    return _init
 
 
-def random_normal(mean: float = 0.0, stdv: float = 1.0) -> Initializer:
-    def _init(rng, shape, dtype=jnp.float32, fan_in=None, fan_out=None):
-        return mean + stdv * jax.random.normal(rng, shape, dtype)
-    return _init
+class random_normal:
+    def __init__(self, mean: float = 0.0, stdv: float = 1.0):
+        self.mean, self.stdv = mean, stdv
+
+    def __call__(self, rng, shape, dtype=jnp.float32, fan_in=None,
+                 fan_out=None):
+        return self.mean + self.stdv * jax.random.normal(rng, shape, dtype)
 
 
 def xavier(rng, shape, dtype=jnp.float32, fan_in=None, fan_out=None):

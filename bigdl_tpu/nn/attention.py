@@ -198,11 +198,20 @@ class MultiHeadAttention(Module):
         return out, state
 
 
+def _ffn_relu(x):
+    """Module-level default activation — `jax.nn.relu` itself does not
+    pickle (its qualname points inside jax._src), which would break the
+    durable model format for every Transformer."""
+    return jax.nn.relu(x)
+
+
 class FeedForwardNetwork(Module):
     """Position-wise FFN (reference: nn/FeedForwardNetwork.scala):
-    Linear(d, d_ff) -> activation -> Linear(d_ff, d)."""
+    Linear(d, d_ff) -> activation -> Linear(d_ff, d). A custom
+    `activation` must be picklable (a module-level function or a class
+    instance) for save_module."""
 
-    def __init__(self, d_model: int, d_ff: int, activation=jax.nn.relu,
+    def __init__(self, d_model: int, d_ff: int, activation=_ffn_relu,
                  dropout: float = 0.0, name=None):
         super().__init__(name)
         self.w1 = self.add_child("w1", Linear(d_model, d_ff))

@@ -342,10 +342,17 @@ class Euclidean(Module):
         return jnp.sqrt(jnp.maximum(d2, 1e-12))
 
 
-class Highway(Module):
-    """y = T(x)·H(x) + (1-T(x))·x (reference: nn/Highway.scala)."""
+def _tanh(x):
+    """Module-level default — `jnp.tanh` itself does not pickle (qualname
+    points inside jax._src), which would break save_module."""
+    return jnp.tanh(x)
 
-    def __init__(self, size: int, activation=jnp.tanh,
+
+class Highway(Module):
+    """y = T(x)·H(x) + (1-T(x))·x (reference: nn/Highway.scala). A custom
+    `activation` must be picklable for the durable model format."""
+
+    def __init__(self, size: int, activation=_tanh,
                  name: Optional[str] = None):
         super().__init__(name=name)
         self.size = size

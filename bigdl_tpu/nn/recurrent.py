@@ -54,12 +54,19 @@ class Cell(Module):
         return (out, new_hidden), state
 
 
+def _tanh(x):
+    """Module-level default — `jnp.tanh` itself does not pickle (qualname
+    points inside jax._src), which would break save_module."""
+    return jnp.tanh(x)
+
+
 class RnnCell(Cell):
     """Vanilla RNN cell: h' = act(W_x x + W_h h + b)
-    (reference: nn/RNN.scala RnnCell)."""
+    (reference: nn/RNN.scala RnnCell). A custom `activation` must be
+    picklable for the durable model format."""
 
-    def __init__(self, input_size: int, hidden_size: int, activation=jnp.tanh,
-                 name=None):
+    def __init__(self, input_size: int, hidden_size: int,
+                 activation=_tanh, name=None):
         super().__init__(name)
         self.input_size, self.hidden_size = input_size, hidden_size
         self.activation = activation

@@ -1,0 +1,102 @@
+"""Numeric gradient checker (reference: test/.../nn/GradientChecker.scala
+and GradientCheckerRNN.scala — central-difference the loss wrt inputs and
+weights, compare against the framework's backward within tolerance).
+
+With autodiff the analytic side is rarely wrong for plain jnp code; what
+this catches is everything with a HAND-WRITTEN backward or masked/
+piecewise gradient: Pallas custom-VJP kernels (flash attention), the 1F1B
+pipeline's recompute-VJP, where()-gated activations, clip/top-k
+selections. Used by tests/test_gradcheck.py's layer sweep.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def numeric_grad(fn: Callable, x: jnp.ndarray, eps: float = 1e-3,
+                 max_entries: int = 64, seed: int = 0) -> np.ndarray:
+    """Central-difference gradient of scalar `fn` at `x`, evaluated on a
+    random subsample of at most `max_entries` coordinates (the reference's
+    checker perturbs every entry; sampling keeps big layers cheap). The
+    unsampled coordinates are returned as NaN — compare with a mask."""
+    x = np.asarray(x, np.float64)
+    flat = x.reshape(-1)
+    idx = np.arange(flat.size)
+    if flat.size > max_entries:
+        idx = np.random.RandomState(seed).choice(flat.size, max_entries,
+                                                 replace=False)
+    g = np.full(flat.size, np.nan)
+    for i in idx:
+        bump = np.zeros_like(flat)
+        bump[i] = eps
+        hi = float(fn(jnp.asarray((flat + bump).reshape(x.shape),
+                                  jnp.float32)))
+        lo = float(fn(jnp.asarray((flat - bump).reshape(x.shape),
+                                  jnp.float32)))
+        g[i] = (hi - lo) / (2 * eps)
+    return g.reshape(x.shape)
+
+
+def check_gradients(fn: Callable, x: jnp.ndarray, eps: float = 1e-3,
+                    rtol: float = 5e-2, atol: float = 5e-3,
+                    max_entries: int = 64, seed: int = 0) -> float:
+    """Assert autodiff(fn) matches numeric_grad(fn) at `x` on the sampled
+    coordinates; returns the max abs deviation. `fn` must be scalar-valued
+    and accept one array.
+
+    The absolute tolerance is scale-aware: fp32 central differences carry
+    ~(machine_eps·|f|)/eps of noise, so entries whose true gradient is
+    tiny next to the layer's largest gradients cannot be resolved more
+    finely than a fraction of that largest magnitude. Structural errors
+    (missing/sign-flipped/mis-scaled gradients) remain far outside it."""
+    auto = np.asarray(jax.grad(lambda a: fn(a))(jnp.asarray(x, jnp.float32)),
+                      np.float64)
+    num = numeric_grad(fn, x, eps=eps, max_entries=max_entries, seed=seed)
+    mask = ~np.isnan(num)
+    scale = float(np.max(np.abs(auto))) if auto.size else 0.0
+    atol_eff = max(atol, 2e-3 * scale)
+    np.testing.assert_allclose(auto[mask], num[mask], rtol=rtol,
+                               atol=atol_eff)
+    return float(np.max(np.abs(auto[mask] - num[mask]))) if mask.any() \
+        else 0.0
+
+
+def check_module_gradients(module, x, *, params=None, state=None,
+                           against_params: bool = True, rng=None,
+                           eps: float = 1e-3, rtol: float = 5e-2,
+                           atol: float = 5e-3, max_entries: int = 64,
+                           seed: int = 0):
+    """Gradient-check a Module: wrt its input and (optionally) each param
+    leaf, with sum-of-squares as the scalar objective (smooth, exercises
+    the whole output)."""
+    if params is None or state is None:
+        params, state = module.init(rng if rng is not None
+                                    else jax.random.PRNGKey(0))
+
+    def obj_input(a):
+        out, _ = module.apply(params, state, a)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    check_gradients(obj_input, x, eps=eps, rtol=rtol, atol=atol,
+                    max_entries=max_entries, seed=seed)
+
+    if against_params:
+        leaves, treedef = jax.tree.flatten(params)
+        for li, leaf in enumerate(leaves):
+            if not jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+                continue
+
+            def obj_leaf(a, li=li):
+                ls = list(leaves)
+                ls[li] = a
+                out, _ = module.apply(jax.tree.unflatten(treedef, ls),
+                                      state, x)
+                return jnp.sum(out.astype(jnp.float32) ** 2)
+
+            check_gradients(obj_leaf, leaf, eps=eps, rtol=rtol, atol=atol,
+                            max_entries=max_entries, seed=seed)
