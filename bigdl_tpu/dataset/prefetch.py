@@ -18,18 +18,22 @@ import numpy as np
 
 
 def prefetch_to_device(it: Iterable, size: Optional[int] = None,
-                       sharding=None) -> Iterator:
+                       sharding=None, place_fn=None) -> Iterator:
     """Wrap a host batch iterator; yields device-resident batches.
 
     `sharding` (optional jax.sharding.Sharding or pytree of them) places each
     batch directly into its distributed layout — the device_put does the
-    host-split + per-device transfer in one call. `size` defaults to the
+    host-split + per-device transfer in one call. `place_fn` overrides the
+    placement entirely (the trainers pass their own `_place_batch`, which
+    also covers multi-host array assembly). `size` defaults to the
     BIGDL_TPU_PREFETCH_SIZE knob (utils/config.py)."""
     if size is None:
         from bigdl_tpu.utils import config
         size = config.get("PREFETCH_SIZE")
 
     def place(batch):
+        if place_fn is not None:
+            return place_fn(batch)
         if sharding is None:
             return jax.tree_util.tree_map(
                 lambda a: jax.device_put(np.asarray(a))
@@ -39,25 +43,45 @@ def prefetch_to_device(it: Iterable, size: Optional[int] = None,
     q: "queue.Queue" = queue.Queue(maxsize=size)
     _END = object()
     err: list = []
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def worker():
         try:
             for batch in it:
-                q.put(place(batch))
+                if stop.is_set() or not _put(place(batch)):
+                    return                  # consumer abandoned the epoch
         except BaseException as e:          # surfaced on the consumer side
             err.append(e)
         finally:
-            q.put(_END)
+            _put(_END)
 
     t = threading.Thread(target=worker, daemon=True)
     t.start()
-    while True:
-        item = q.get()
-        if item is _END:
-            if err:
-                raise err[0]
-            return
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    finally:
+        # a trainer breaking mid-epoch (max_iteration, early stop, retry
+        # after a failure) must not leave a placement thread iterating
+        # the shared dataset while the caller re-enters it — signal and
+        # wait briefly (bounded: a device_put wedged on a dead chip must
+        # not hang the trainer's control path; the thread is daemonic)
+        stop.set()
+        t.join(timeout=2.0)
 
 
 class PrefetchDataSet:

@@ -206,6 +206,20 @@ class Optimizer:
     def _place_batch(self, x, y):
         return jnp.asarray(x), jnp.asarray(y)
 
+    def _batch_iter(self, epoch_iter):
+        """Stream (x, y) batches through host→device prefetch so the H2D
+        copy of batch k+1 overlaps step k's compute (the reference keeps
+        the chip fed with cached partitions + Engine.default data threads;
+        here it is one background placement thread —
+        dataset/prefetch.py). BIGDL_TPU_PREFETCH_SIZE=0 disables."""
+        from bigdl_tpu.utils import config
+        size = config.get("PREFETCH_SIZE")
+        if not size or size <= 0:
+            return (self._place_batch(x, y) for x, y in epoch_iter)
+        from bigdl_tpu.dataset.prefetch import prefetch_to_device
+        return prefetch_to_device(
+            epoch_iter, size, place_fn=lambda b: self._place_batch(*b))
+
     def _build_eval_fn(self):
         return jax.jit(
             lambda p, s, x: self.model.apply(p, s, x, training=False)[0])
@@ -312,10 +326,9 @@ class Optimizer:
             for _ in range(skip):
                 if next(epoch_iter, None) is None:
                     break
-            for x, y in epoch_iter:
+            for xd, yd in self._batch_iter(epoch_iter):
                 lr = self.method.current_lr(st)
                 sub = jax.random.fold_in(step_rng, st["neval"])
-                xd, yd = self._place_batch(x, y)
                 if self._param_summary_enabled():
                     # batch refs only (never donated) — lets the Parameters
                     # summary recompute gradients on its cadence
@@ -323,7 +336,12 @@ class Optimizer:
                 params, model_state, slots, loss = step(
                     params, model_state, slots, xd, yd,
                     jnp.float32(lr), jnp.int32(st["neval"]), sub)
-                n = x.shape[0]
+                # GLOBAL batch dim (multi-host _place_batch assembles the
+                # global array): records/throughput count the whole job's
+                # progress, the reference's recordsProcessedThisEpoch
+                # semantic — and every process agrees on the count, so
+                # triggers fire in lockstep
+                n = xd.shape[0]
                 st["neval"] += 1
                 st["records"] += n
                 st["batch_in_epoch"] = st.get("batch_in_epoch", 0) + 1
