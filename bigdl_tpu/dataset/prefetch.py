@@ -40,6 +40,13 @@ def prefetch_to_device(it: Iterable, size: Optional[int] = None,
                 if isinstance(a, np.ndarray) else a, batch)
         return jax.device_put(batch, sharding)
 
+    if size is None or size <= 0:
+        # disabled: synchronous placement, no thread (0 must never mean
+        # queue.Queue(maxsize=0) == unbounded read-ahead)
+        for batch in it:
+            yield place(batch)
+        return
+
     q: "queue.Queue" = queue.Queue(maxsize=size)
     _END = object()
     err: list = []
@@ -82,6 +89,12 @@ def prefetch_to_device(it: Iterable, size: Optional[int] = None,
         # not hang the trainer's control path; the thread is daemonic)
         stop.set()
         t.join(timeout=2.0)
+        if t.is_alive():
+            import logging
+            logging.getLogger("bigdl_tpu").warning(
+                "prefetch worker still running 2s after cancellation "
+                "(blocked in dataset read or device_put) — do not "
+                "re-iterate the same dataset until it exits")
 
 
 class PrefetchDataSet:

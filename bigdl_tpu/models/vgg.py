@@ -22,10 +22,18 @@ def _conv_relu(nin, nout, bn=False):
 
 
 def build(depth: int = 16, class_num: int = 1000,
-          batch_norm: bool = False) -> nn.Sequential:
-    """ImageNet VGG-16/19. Input NHWC (B, 224, 224, 3)."""
+          batch_norm: bool = False, spatial: int = 224,
+          width_mult: float = 1.0) -> nn.Sequential:
+    """ImageNet VGG-16/19. Input NHWC (B, spatial, spatial, 3).
+
+    `width_mult` scales every channel count (and the 4096 head) — the
+    full 13/16-conv topology at a fraction of the FLOPs, for hermetic
+    CPU pipelines (examples/quantized_inference.py); 1.0 is the paper
+    model. `spatial` sizes the first FC (must be a multiple of 32)."""
     reps = _CFG[depth]
-    widths = [64, 128, 256, 512, 512]
+    scale = lambda w: max(8, int(w * width_mult))
+    widths = [scale(w) for w in (64, 128, 256, 512, 512)]
+    fc_w = scale(4096)
     layers = []
     nin = 3
     for rep, width in zip(reps, widths):
@@ -33,11 +41,13 @@ def build(depth: int = 16, class_num: int = 1000,
             layers += _conv_relu(nin, width, bn=batch_norm)
             nin = width
         layers.append(nn.SpatialMaxPooling(2, 2, 2, 2))
+    final = spatial // 32
     layers += [
         nn.Flatten(),
-        nn.Linear(512 * 7 * 7, 4096, name="fc6"), nn.ReLU(), nn.Dropout(0.5),
-        nn.Linear(4096, 4096, name="fc7"), nn.ReLU(), nn.Dropout(0.5),
-        nn.Linear(4096, class_num, name="fc8"),
+        nn.Linear(widths[-1] * final * final, fc_w, name="fc6"), nn.ReLU(),
+        nn.Dropout(0.5),
+        nn.Linear(fc_w, fc_w, name="fc7"), nn.ReLU(), nn.Dropout(0.5),
+        nn.Linear(fc_w, class_num, name="fc8"),
         nn.LogSoftMax(),
     ]
     return nn.Sequential(*layers, name=f"VGG{depth}")

@@ -212,11 +212,15 @@ class Optimizer:
         the chip fed with cached partitions + Engine.default data threads;
         here it is one background placement thread —
         dataset/prefetch.py). BIGDL_TPU_PREFETCH_SIZE=0 disables."""
+        from bigdl_tpu.dataset.prefetch import (PrefetchDataSet,
+                                                prefetch_to_device)
         from bigdl_tpu.utils import config
         size = config.get("PREFETCH_SIZE")
-        if not size or size <= 0:
+        if (not size or size <= 0
+                or isinstance(self.dataset, PrefetchDataSet)):
+            # disabled, or the dataset already prefetches — a second
+            # layer would double-buffer and double-place every batch
             return (self._place_batch(x, y) for x, y in epoch_iter)
-        from bigdl_tpu.dataset.prefetch import prefetch_to_device
         return prefetch_to_device(
             epoch_iter, size, place_fn=lambda b: self._place_batch(*b))
 
