@@ -34,6 +34,9 @@ for i in $(seq 1 200); do
       echo "}"
     } > BENCH_EARLY_r03.json.tmp && mv BENCH_EARLY_r03.json.tmp BENCH_EARLY_r03.json
     echo "$(date -u +%FT%TZ) bench battery done (see BENCH_EARLY_r03.json)" >> "$LOG"
+    timeout 1800 python tools/capture_tpu_profile.py tpu_profile_r03 \
+        >> "$LOG" 2>&1
+    echo "$(date -u +%FT%TZ) profile capture attempted (tpu_profile_r03/)" >> "$LOG"
     captured=1
     # chip is alive — stop polling aggressively; builder takes over
     touch /tmp/tpu_alive_now
