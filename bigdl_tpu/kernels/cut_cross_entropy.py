@@ -1,0 +1,234 @@
+"""Cut cross-entropy — the LM loss computed WITHOUT materializing the
+(N, V) logits (parity-plus: no reference equivalent; the reference's LM
+path materializes full (B·T, V) log-probs through LogSoftMax +
+ClassNLLCriterion, models/rnn/PTBModel.scala).
+
+For a tied-embedding LM head the logits matrix is the single largest
+activation: N=B·T rows by V vocab columns (a 8k×50k fp32 tensor is
+1.6 GB, plus the same again for its gradient). This kernel fuses the
+head matmul `h @ w.T` with an online logsumexp so HBM traffic is just
+h, w, and the (N,) outputs; the backward recomputes the blockwise
+softmax from the saved logsumexp (the flash-attention
+rematerialization trade — ~3× head-matmul FLOPs, MXU-bound, for an
+O(N·V) → O(N+V·D) activation-memory cut).
+
+All label handling stays OUTSIDE the kernels (per-row label logit is a
+rowwise gather-dot; the backward's one-hot terms are a gather and a
+scatter-add, each O(N·D)), so the Pallas kernels are pure
+online-softmax matmuls with no integer refs to tile.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:                                    # pltpu only imports on TPU builds
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:                       # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30
+
+
+def _round_up(v: int, m: int) -> int:
+    return -(-v // m) * m
+
+
+# ----------------------------------------------------------- forward (lse)
+def _lse_kernel(h_ref, w_ref, lse_ref, m_ref, s_ref, *, block_v: int,
+                v_total: int):
+    vb = pl.program_id(1)
+    nv = pl.num_programs(1)
+
+    @pl.when(vb == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        s_ref[:] = jnp.zeros_like(s_ref)
+
+    logits = jax.lax.dot_general(
+        h_ref[:], w_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)          # (bn, bv)
+    # vocab rows beyond the true V are padding — mask to -inf
+    col = vb * block_v + jax.lax.broadcasted_iota(
+        jnp.int32, logits.shape, 1)
+    logits = jnp.where(col < v_total, logits, NEG_INF)
+
+    m_prev = m_ref[:]                                # (bn, 1)
+    m_new = jnp.maximum(m_prev, jnp.max(logits, axis=1, keepdims=True))
+    s_ref[:] = (s_ref[:] * jnp.exp(m_prev - m_new)
+                + jnp.sum(jnp.exp(logits - m_new), axis=1, keepdims=True))
+    m_ref[:] = m_new
+
+    @pl.when(vb == nv - 1)
+    def _finish():
+        lse_ref[:] = m_ref[:] + jnp.log(jnp.maximum(s_ref[:], 1e-30))
+
+
+def _lse(h, w, block_n, block_v, v_total, interpret):
+    n, d = h.shape
+    grid = (n // block_n, _round_up(w.shape[0], block_v) // block_v)
+    return pl.pallas_call(
+        functools.partial(_lse_kernel, block_v=block_v, v_total=v_total),
+        out_shape=jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_n, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_v, d), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
+        scratch_shapes=[pltpu.VMEM((block_n, 1), jnp.float32),
+                        pltpu.VMEM((block_n, 1), jnp.float32)],
+        interpret=interpret,
+    )(h, w)
+
+
+# ------------------------------------------------------------ backward dh
+def _dh_kernel(h_ref, w_ref, lse_ref, g_ref, dh_ref, acc_ref, *,
+               block_v: int, v_total: int):
+    vb = pl.program_id(1)
+    nv = pl.num_programs(1)
+
+    @pl.when(vb == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    logits = jax.lax.dot_general(
+        h_ref[:], w_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    col = vb * block_v + jax.lax.broadcasted_iota(
+        jnp.int32, logits.shape, 1)
+    p = jnp.where(col < v_total,
+                  jnp.exp(logits - lse_ref[:]), 0.0) * g_ref[:]
+    acc_ref[:] += jax.lax.dot_general(
+        p.astype(w_ref.dtype), w_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(vb == nv - 1)
+    def _finish():
+        dh_ref[:] = acc_ref[:].astype(dh_ref.dtype)
+
+
+def _dh(h, w, lse, g, block_n, block_v, v_total, interpret):
+    n, d = h.shape
+    grid = (n // block_n, _round_up(w.shape[0], block_v) // block_v)
+    return pl.pallas_call(
+        functools.partial(_dh_kernel, block_v=block_v, v_total=v_total),
+        out_shape=jax.ShapeDtypeStruct((n, d), h.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_n, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_v, d), lambda i, j: (j, 0)),
+            pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_n, d), lambda i, j: (i, 0)),
+        scratch_shapes=[pltpu.VMEM((block_n, d), jnp.float32)],
+        interpret=interpret,
+    )(h, w, lse, g)
+
+
+# ------------------------------------------------------------ backward dw
+def _dw_kernel(w_ref, h_ref, lse_ref, g_ref, dw_ref, acc_ref, *,
+               block_v: int, v_total: int):
+    nb = pl.program_id(1)
+    nn_ = pl.num_programs(1)
+    vb = pl.program_id(0)
+
+    @pl.when(nb == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    logits = jax.lax.dot_general(
+        h_ref[:], w_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)          # (bn, bv)
+    col = vb * block_v + jax.lax.broadcasted_iota(
+        jnp.int32, logits.shape, 1)
+    p = jnp.where(col < v_total,
+                  jnp.exp(logits - lse_ref[:]), 0.0) * g_ref[:]
+    acc_ref[:] += jax.lax.dot_general(                # (bv, d)
+        p.astype(h_ref.dtype), h_ref[:], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(nb == nn_ - 1)
+    def _finish():
+        dw_ref[:] = acc_ref[:].astype(dw_ref.dtype)
+
+
+def _dw(h, w, lse, g, block_n, block_v, v_total, interpret):
+    n, d = h.shape
+    vp = _round_up(w.shape[0], block_v)
+    grid = (vp // block_v, n // block_n)
+    return pl.pallas_call(
+        functools.partial(_dw_kernel, block_v=block_v, v_total=v_total),
+        out_shape=jax.ShapeDtypeStruct((w.shape[0], d), w.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_v, d), lambda j, i: (j, 0)),
+            pl.BlockSpec((block_n, d), lambda j, i: (i, 0)),
+            pl.BlockSpec((block_n, 1), lambda j, i: (i, 0)),
+            pl.BlockSpec((block_n, 1), lambda j, i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_v, d), lambda j, i: (j, 0)),
+        scratch_shapes=[pltpu.VMEM((block_v, d), jnp.float32)],
+        interpret=interpret,
+    )(w, h, lse, g)
+
+
+# ------------------------------------------------------------- public API
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def cut_cross_entropy(h, w, labels, block_n: int = 128,
+                      block_v: int = 512, interpret: bool = False):
+    """Per-row negative log-likelihood of `labels` under the logits
+    `h @ w.T`, without ever materializing them.
+
+    h (N, D) activations; w (V, D) head rows (tied embedding);
+    labels (N,) int32. Returns (N,) fp32. N must divide block_n; V is
+    padded internally; D rides whole in VMEM (keep D ≤ ~2048).
+    `interpret=True` runs on CPU for tests."""
+    loss, _ = _cce_fwd(h, w, labels, block_n, block_v, interpret)
+    return loss
+
+
+def _cce_fwd(h, w, labels, block_n, block_v, interpret):
+    if pltpu is None:
+        raise RuntimeError(
+            "jax.experimental.pallas.tpu is unavailable in this JAX build")
+    n, d = h.shape
+    v = w.shape[0]
+    block_n = min(block_n, n)
+    if n % block_n:
+        raise ValueError(f"N={n} must be a multiple of block_n={block_n}")
+    wp = jnp.pad(w, ((0, _round_up(v, block_v) - v), (0, 0)))
+    lse = _lse(h, wp, block_n, block_v, v, interpret)[:, 0]
+    label_logit = jnp.sum(h.astype(jnp.float32)
+                          * w[labels].astype(jnp.float32), axis=-1)
+    loss = lse - label_logit
+    return loss, (h, w, labels, lse)
+
+
+def _cce_bwd(block_n, block_v, interpret, res, g):
+    h, w, labels, lse = res
+    n, d = h.shape
+    v = w.shape[0]
+    block_n = min(block_n, n)              # mirror the forward's clamp
+    wp = jnp.pad(w, ((0, _round_up(v, block_v) - v), (0, 0)))
+    g2 = jnp.asarray(g, jnp.float32).reshape(n, 1)
+    lse2 = lse.reshape(n, 1)
+    # softmax part from the kernels; the -onehot part is a cheap gather /
+    # scatter-add outside (O(N·D))
+    dh = _dh(h, wp, lse2, g2, block_n, block_v, v, interpret)
+    dh = dh - g2.astype(h.dtype) * w[labels]
+    dw = _dw(h, wp, lse2, g2, block_n, block_v, v, interpret)[:v]
+    dw = dw.at[labels].add(-(g2 * h.astype(jnp.float32)).astype(w.dtype))
+    return dh.astype(h.dtype), dw.astype(w.dtype), None
+
+
+def _cce_fwd_vjp(h, w, labels, block_n, block_v, interpret):
+    return _cce_fwd(h, w, labels, block_n, block_v, interpret)
+
+
+cut_cross_entropy.defvjp(_cce_fwd_vjp, _cce_bwd)

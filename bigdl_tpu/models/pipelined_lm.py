@@ -65,12 +65,21 @@ class PipelinedLM:
     def __init__(self, vocab_size: int, d_model: int = 128,
                  num_heads: int = 4, d_ff: Optional[int] = None,
                  num_layers: int = 4, n_stages: int = 4,
-                 n_microbatches: int = 8, max_len: int = 512):
+                 n_microbatches: int = 8, max_len: int = 512,
+                 fused_loss: bool = False,
+                 fused_interpret: bool = False):
         if num_layers % n_stages:
             raise ValueError(f"num_layers {num_layers} must divide by "
                              f"n_stages {n_stages}")
         self.vocab_size, self.d_model = vocab_size, d_model
         self.max_len = max_len
+        # fused_loss: compute the head loss with the cut cross-entropy
+        # kernel — the (microbatch·T, V) logits are never materialized
+        # on the last pipeline stage (kernels/cut_cross_entropy.py);
+        # fused_interpret runs the kernel in the Pallas interpreter
+        # (CPU meshes/tests)
+        self.fused_loss = fused_loss
+        self.fused_interpret = fused_interpret
         d_ff = d_ff or 4 * d_model
         per = num_layers // n_stages
         self.pipe = Pipeline(
@@ -96,6 +105,26 @@ class PipelinedLM:
 
     def _loss_fn(self):
         final_ln = self.final_ln
+        if self.fused_loss:
+            from bigdl_tpu.kernels.cut_cross_entropy import \
+                cut_cross_entropy
+            interpret = self.fused_interpret
+            d = self.d_model
+
+            def loss(h_mb, y_mb, lp):
+                h, _ = final_ln.apply(lp["ln"], {}, h_mb)
+                hf = h.reshape(-1, d)
+                yf = y_mb.reshape(-1)
+                n = hf.shape[0]
+                pad = (-n) % 128           # kernel rows ride 128-blocks
+                if pad:
+                    hf = jnp.pad(hf, ((0, pad), (0, 0)))
+                    yf = jnp.pad(yf, ((0, pad),))
+                # padded rows are sliced off before the mean, so their
+                # cotangent is zero and they contribute no gradients
+                return cut_cross_entropy(
+                    hf, lp["emb"], yf, interpret=interpret)[:n].mean()
+            return loss
 
         def loss(h_mb, y_mb, lp):
             h, _ = final_ln.apply(lp["ln"], {}, h_mb)

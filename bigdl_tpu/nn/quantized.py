@@ -27,7 +27,8 @@ from jax import lax
 
 from bigdl_tpu.core.module import Module
 from bigdl_tpu.nn.conv import (SpatialConvolution,
-                               SpatialDilatedConvolution, _DN_2D,
+                               SpatialDilatedConvolution,
+                               SpatialShareConvolution, _DN_2D,
                                _same_or_pad)
 from bigdl_tpu.nn.linear import Linear
 
@@ -154,6 +155,7 @@ class QuantizedSpatialConvolution(Module):
 
 _QUANTIZABLE = {Linear: QuantizedLinear,
                 SpatialConvolution: QuantizedSpatialConvolution,
+                SpatialShareConvolution: QuantizedSpatialConvolution,
                 SpatialDilatedConvolution: QuantizedSpatialConvolution}
 
 

@@ -216,3 +216,117 @@ def test_flash_attention_on_real_tpu_no_interpret():
                                                   causal=True).sum())(q)
     np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
                                rtol=2e-2, atol=2e-3)
+
+
+def _cce_ref(h, w, labels):
+    logits = h @ w.T
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+
+
+def test_cut_cross_entropy_matches_dense():
+    """Fused head-matmul + online logsumexp == dense log_softmax NLL,
+    including a vocab size that does not divide the block."""
+    import jax
+    from bigdl_tpu.kernels.cut_cross_entropy import cut_cross_entropy
+    r = np.random.RandomState(0)
+    n, d, v = 16, 32, 37                  # v deliberately unaligned
+    h = jnp.asarray(r.randn(n, d).astype(np.float32))
+    w = jnp.asarray(r.randn(v, d).astype(np.float32) * 0.3)
+    labels = jnp.asarray(r.randint(0, v, n), jnp.int32)
+    got = cut_cross_entropy(h, w, labels, block_n=8, block_v=16,
+                            interpret=True)
+    want = _cce_ref(h, w, labels)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_cut_cross_entropy_gradients_match_dense():
+    """Blockwise-recomputed backward == autodiff of the dense loss for
+    BOTH h and the tied head w (the scatter-add one-hot term included)."""
+    import jax
+    from bigdl_tpu.kernels.cut_cross_entropy import cut_cross_entropy
+    r = np.random.RandomState(1)
+    n, d, v = 16, 24, 29
+    h = jnp.asarray(r.randn(n, d).astype(np.float32))
+    w = jnp.asarray(r.randn(v, d).astype(np.float32) * 0.3)
+    labels = jnp.asarray(r.randint(0, v, n), jnp.int32)
+    # non-uniform upstream gradient exercises the g scaling
+    gvec = jnp.asarray(r.rand(n).astype(np.float32) + 0.5)
+
+    def fused(h, w):
+        return jnp.sum(cut_cross_entropy(h, w, labels, block_n=8,
+                                         block_v=8, interpret=True) * gvec)
+
+    def dense(h, w):
+        return jnp.sum(_cce_ref(h, w, labels) * gvec)
+
+    (dh_f, dw_f) = jax.grad(fused, argnums=(0, 1))(h, w)
+    (dh_d, dw_d) = jax.grad(dense, argnums=(0, 1))(h, w)
+    np.testing.assert_allclose(np.asarray(dh_f), np.asarray(dh_d),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dw_f), np.asarray(dw_d),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_cut_cross_entropy_trains_a_tied_lm_head():
+    """End-to-end: a tiny tied-embedding LM trained with the fused loss
+    reaches the same ballpark loss as the dense-loss twin."""
+    import jax
+    from bigdl_tpu.kernels.cut_cross_entropy import cut_cross_entropy
+    r = np.random.RandomState(2)
+    n, d, v = 32, 16, 21
+    x = jnp.asarray(r.randn(n, d).astype(np.float32))
+    labels = jnp.asarray(np.arange(n) % v, jnp.int32)
+
+    def train(loss_kind):
+        w = jnp.asarray(r.randn(v, d).astype(np.float32) * 0.1)
+        proj = jnp.eye(d, dtype=jnp.float32)
+
+        @jax.jit
+        def step(w, proj):
+            def loss_fn(w, proj):
+                hh = x @ proj
+                if loss_kind == "fused":
+                    return cut_cross_entropy(hh, w, labels, block_n=8,
+                                             block_v=8,
+                                             interpret=True).mean()
+                return _cce_ref(hh, w, labels).mean()
+            l, (gw, gp) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+                w, proj)
+            return w - 0.5 * gw, proj - 0.5 * gp, l
+
+        for _ in range(60):
+            w, proj, l = step(w, proj)
+        return float(l)
+
+    r = np.random.RandomState(2)
+    lf = train("fused")
+    r = np.random.RandomState(2)
+    ld = train("dense")
+    assert abs(lf - ld) < 1e-3, (lf, ld)
+    assert lf < 1.0
+
+
+def test_cut_cross_entropy_on_real_tpu_no_interpret():
+    """Non-interpret Mosaic lowering smoke — runs only with a live TPU
+    backend (the CI CPU mesh skips)."""
+    import jax
+    import pytest
+    if jax.default_backend() != "tpu":
+        pytest.skip("needs a live TPU backend (Mosaic lowering)")
+    from bigdl_tpu.kernels.cut_cross_entropy import cut_cross_entropy
+    r = np.random.RandomState(3)
+    n, d, v = 256, 128, 1000
+    h = jnp.asarray(r.randn(n, d).astype(np.float32))
+    w = jnp.asarray(r.randn(v, d).astype(np.float32) * 0.1)
+    labels = jnp.asarray(r.randint(0, v, n), jnp.int32)
+    got = cut_cross_entropy(h, w, labels, interpret=False)
+    want = _cce_ref(h, w, labels)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-3, atol=1e-3)
+    dh = jax.grad(lambda h: cut_cross_entropy(
+        h, w, labels, interpret=False).sum())(h)
+    dh_ref = jax.grad(lambda h: _cce_ref(h, w, labels).sum())(h)
+    np.testing.assert_allclose(np.asarray(dh), np.asarray(dh_ref),
+                               rtol=1e-3, atol=1e-3)
