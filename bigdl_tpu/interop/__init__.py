@@ -2,5 +2,6 @@
 (reference: utils/caffe/, utils/tf/, utils/TorchFile.scala,
 utils/ConvertModel.scala, pyspark/bigdl/contrib/onnx/; SURVEY.md §2.8)."""
 
-from bigdl_tpu.interop import (caffe, keras_loader, onnx, protowire,
-                               tensorflow, tf_example, torchfile)
+from bigdl_tpu.interop import (caffe, caffe_saver, huggingface,
+                               keras_loader, onnx, protowire, tensorflow,
+                               tf_example, torchfile)

@@ -1,0 +1,143 @@
+"""HuggingFace `transformers` bridge (parity-plus: the reference predates
+the HF ecosystem; its closest analogue is the Keras/TF importer surface,
+§2.8). Converts a torch `transformers` model's weights onto this
+framework's own primitives — no torch at inference time.
+
+Currently: GPT-2 family (`GPT2Model`/`GPT2LMHeadModel`). The returned
+module is assembled from nn.TransformerLayer blocks (pre-norm, biased
+projections, tanh-gelu FFN — exactly GPT-2's block wiring), learned
+token+position LookupTables, a final LayerNorm, and the tied LM head.
+
+    from transformers import GPT2LMHeadModel
+    from bigdl_tpu.interop.huggingface import from_gpt2
+    module, params, state = from_gpt2(GPT2LMHeadModel(config))
+    logits, _ = module.apply(params, state, tokens)   # (B, T, vocab)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.core.module import Module
+from bigdl_tpu.nn.attention import TransformerLayer
+from bigdl_tpu.nn.normalization import LayerNormalization
+
+
+def _gelu_tanh(x):
+    """GPT-2's `gelu_new` (tanh approximation) — module-level so the
+    converted model stays picklable for the durable format."""
+    return jax.nn.gelu(x, approximate=True)
+
+
+class GPT2LM(Module):
+    """GPT-2 rebuilt on this framework's primitives. apply(params, state,
+    tokens (B, T) int32) → (B, T, vocab) logits (head tied to the token
+    embedding unless `tied=False`, which adds an `lm_head` param)."""
+
+    def __init__(self, vocab_size: int, n_positions: int, d_model: int,
+                 num_heads: int, num_layers: int, ln_eps: float = 1e-5,
+                 dropout: float = 0.0, tied: bool = True, name=None):
+        super().__init__(name or "GPT2LM")
+        self.vocab_size, self.n_positions = vocab_size, n_positions
+        self.d_model, self.num_layers = d_model, num_layers
+        self.tied = tied
+        for i in range(num_layers):
+            self.add_child(f"h{i}", TransformerLayer(
+                d_model, num_heads, 4 * d_model, bias=True,
+                activation=_gelu_tanh, ln_eps=ln_eps, dropout=dropout))
+        self.add_child("ln_f", LayerNormalization(d_model, eps=ln_eps))
+
+    def param_specs(self):
+        from bigdl_tpu.core.module import ParamSpec
+        from bigdl_tpu.core import init as initializers
+        specs = {
+            "wte": ParamSpec((self.vocab_size, self.d_model),
+                             initializers.random_normal(0.0, 0.02)),
+            "wpe": ParamSpec((self.n_positions, self.d_model),
+                             initializers.random_normal(0.0, 0.01)),
+        }
+        if not self.tied:
+            specs["lm_head"] = ParamSpec(
+                (self.vocab_size, self.d_model),
+                initializers.random_normal(0.0, 0.02))
+        return specs
+
+    def _apply(self, params, state, tokens, *, training=False, rng=None):
+        t = tokens.shape[1]
+        if t > self.n_positions:
+            raise ValueError(f"sequence {t} > n_positions "
+                             f"{self.n_positions}")
+        x = params["wte"][tokens] + params["wpe"][jnp.arange(t)]
+        new_state = dict(state)
+        rngs = (jax.random.split(rng, self.num_layers)
+                if rng is not None else (None,) * self.num_layers)
+        for i in range(self.num_layers):
+            x, new_state[f"h{i}"] = self.children()[f"h{i}"].apply(
+                params[f"h{i}"], state.get(f"h{i}", {}), x, causal=True,
+                training=training, rng=rngs[i])
+        x, new_state["ln_f"] = self.children()["ln_f"].apply(
+            params["ln_f"], state.get("ln_f", {}), x)
+        head = params["wte"] if self.tied else params["lm_head"]
+        return x @ head.T, new_state
+
+
+def _t(x) -> np.ndarray:
+    return np.asarray(x.detach().cpu().numpy(), np.float32)
+
+
+def from_gpt2(hf_model):
+    """`transformers` GPT2Model / GPT2LMHeadModel → (module, params,
+    state). Weight layout notes: HF Conv1D stores (in, out) — the same
+    orientation as our `x @ w` projections, so c_attn's (D, 3D) splits
+    column-wise into wq|wk|wv. Untied LM heads are carried as their own
+    param. Fine-tuning caveat: `resid_pdrop` maps onto the block's
+    sublayer dropout; HF's separate attention-probability and embedding
+    dropouts are not replicated (inference is exact either way)."""
+    tf = getattr(hf_model, "transformer", hf_model)   # LMHead wraps it
+    cfg = hf_model.config
+    d = cfg.n_embd
+    lm_head = getattr(hf_model, "lm_head", None)
+    tied = (lm_head is None
+            or lm_head.weight.data_ptr() == tf.wte.weight.data_ptr())
+    model = GPT2LM(cfg.vocab_size, cfg.n_positions, d, cfg.n_head,
+                   cfg.n_layer, ln_eps=cfg.layer_norm_epsilon,
+                   dropout=float(getattr(cfg, "resid_pdrop", 0.0)),
+                   tied=tied)
+    # every leaf is assigned from the checkpoint below — build a zeroed
+    # skeleton instead of paying a full random init for nothing
+    p_shape, s_shape = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    params = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), p_shape)
+    state = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), s_shape)
+    if not tied:
+        params["lm_head"] = jnp.asarray(_t(lm_head.weight))
+    params["wte"] = jnp.asarray(_t(tf.wte.weight))
+    params["wpe"] = jnp.asarray(_t(tf.wpe.weight))
+    for i, block in enumerate(tf.h):
+        p = params[f"h{i}"]
+        p["ln1"] = {"weight": jnp.asarray(_t(block.ln_1.weight)),
+                    "bias": jnp.asarray(_t(block.ln_1.bias))}
+        p["ln2"] = {"weight": jnp.asarray(_t(block.ln_2.weight)),
+                    "bias": jnp.asarray(_t(block.ln_2.bias))}
+        ca_w = _t(block.attn.c_attn.weight)           # (D, 3D)
+        ca_b = _t(block.attn.c_attn.bias)             # (3D,)
+        p["attn"] = {
+            "wq": jnp.asarray(ca_w[:, :d]),
+            "wk": jnp.asarray(ca_w[:, d:2 * d]),
+            "wv": jnp.asarray(ca_w[:, 2 * d:]),
+            "bq": jnp.asarray(ca_b[:d]),
+            "bk": jnp.asarray(ca_b[d:2 * d]),
+            "bv": jnp.asarray(ca_b[2 * d:]),
+            "wo": jnp.asarray(_t(block.attn.c_proj.weight)),
+            "bo": jnp.asarray(_t(block.attn.c_proj.bias)),
+        }
+        p["ffn"] = {
+            "w1": {"weight": jnp.asarray(_t(block.mlp.c_fc.weight)),
+                   "bias": jnp.asarray(_t(block.mlp.c_fc.bias))},
+            "w2": {"weight": jnp.asarray(_t(block.mlp.c_proj.weight)),
+                   "bias": jnp.asarray(_t(block.mlp.c_proj.bias))},
+        }
+    params["ln_f"] = {"weight": jnp.asarray(_t(tf.ln_f.weight)),
+                      "bias": jnp.asarray(_t(tf.ln_f.bias))}
+    return model, params, state

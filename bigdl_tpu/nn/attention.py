@@ -143,9 +143,12 @@ class MultiHeadAttention(Module):
     'dense' (default), or 'blockwise' with `block_size` for long sequences.
     """
 
+    bias = False          # class default: pickles from before the bias
+                          # option existed must keep loading
+
     def __init__(self, d_model: int, num_heads: int, *,
                  dropout: float = 0.0, attn_impl="dense",
-                 block_size: int = 512, name=None):
+                 block_size: int = 512, bias: bool = False, name=None):
         super().__init__(name)
         if d_model % num_heads:
             raise ValueError(f"d_model {d_model} % heads {num_heads} != 0")
@@ -157,12 +160,19 @@ class MultiHeadAttention(Module):
         self.head_dim = d_model // num_heads
         self.dropout = dropout
         self.attn_impl, self.block_size = attn_impl, block_size
+        # bias=True adds projection biases (GPT-family checkpoints carry
+        # them; the reference's Attention.scala denses are bias-free)
+        self.bias = bias
 
     def param_specs(self):
         d = self.d_model
         spec = lambda: ParamSpec((d, d), initializers.xavier, fan_in=d,
                                  fan_out=d)
-        return {"wq": spec(), "wk": spec(), "wv": spec(), "wo": spec()}
+        specs = {"wq": spec(), "wk": spec(), "wv": spec(), "wo": spec()}
+        if self.bias:
+            for b in ("bq", "bk", "bv", "bo"):
+                specs[b] = ParamSpec((d,), initializers.zeros)
+        return specs
 
     def _split(self, x):
         B, T, _ = x.shape
@@ -187,13 +197,19 @@ class MultiHeadAttention(Module):
     def _apply(self, params, state, x, memory=None, *, mask=None,
                causal: bool = False, training=False, rng=None):
         kv_src = memory if memory is not None else x
-        q = self._split(x @ params["wq"])
-        k = self._split(kv_src @ params["wk"])
-        v = self._split(kv_src @ params["wv"])
+        q = x @ params["wq"]
+        k = kv_src @ params["wk"]
+        v = kv_src @ params["wv"]
+        if self.bias:
+            q, k, v = (q + params["bq"], k + params["bk"],
+                       v + params["bv"])
+        q, k, v = self._split(q), self._split(k), self._split(v)
         out = self._attend(q, k, v, mask, causal)
         B, H, T, hd = out.shape
         out = out.transpose(0, 2, 1, 3).reshape(B, T, H * hd)
         out = out @ params["wo"]
+        if self.bias:
+            out = out + params["bo"]
         out = _inline_dropout(out, self.dropout, training, rng, self)
         return out, state
 
@@ -234,21 +250,27 @@ class TransformerLayer(Module):
 
     def __init__(self, d_model: int, num_heads: int, d_ff: int, *,
                  dropout: float = 0.0, cross: bool = False,
-                 attn_impl: str = "dense", block_size: int = 512, name=None):
+                 attn_impl: str = "dense", block_size: int = 512,
+                 bias: bool = False, activation=None, ln_eps: float = 1e-6,
+                 name=None):
         super().__init__(name)
         self.cross = cross
         self.dropout = dropout
-        self.ln1 = self.add_child("ln1", LayerNormalization(d_model))
+        self.ln1 = self.add_child("ln1", LayerNormalization(d_model,
+                                                            eps=ln_eps))
         self.attn = self.add_child("attn", MultiHeadAttention(
             d_model, num_heads, dropout=dropout, attn_impl=attn_impl,
-            block_size=block_size))
+            block_size=block_size, bias=bias))
         if cross:
-            self.ln_x = self.add_child("ln_x", LayerNormalization(d_model))
+            self.ln_x = self.add_child("ln_x", LayerNormalization(
+                d_model, eps=ln_eps))
             self.xattn = self.add_child("xattn", MultiHeadAttention(
-                d_model, num_heads, dropout=dropout))
-        self.ln2 = self.add_child("ln2", LayerNormalization(d_model))
+                d_model, num_heads, dropout=dropout, bias=bias))
+        self.ln2 = self.add_child("ln2", LayerNormalization(d_model,
+                                                            eps=ln_eps))
+        ffn_kw = {} if activation is None else {"activation": activation}
         self.ffn = self.add_child("ffn", FeedForwardNetwork(
-            d_model, d_ff, dropout=dropout))
+            d_model, d_ff, dropout=dropout, **ffn_kw))
 
     def _apply(self, params, state, x, memory=None, *, mask=None,
                memory_mask=None, causal=False, training=False, rng=None):

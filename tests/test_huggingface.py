@@ -1,0 +1,116 @@
+"""HuggingFace bridge goldens — GPT-2 weights onto our primitives, logits
+parity vs the torch `transformers` forward (parity-plus interop; weights
+are random-init because the environment has no network, which exercises
+the exact same conversion path as pretrained checkpoints)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax                                                    # noqa: E402
+import jax.numpy as jnp                                       # noqa: E402
+
+from bigdl_tpu.interop.huggingface import from_gpt2           # noqa: E402
+
+
+def _tiny_gpt2(seed=0, **kw):
+    from transformers import GPT2Config, GPT2LMHeadModel
+    torch.manual_seed(seed)
+    cfg = GPT2Config(vocab_size=101, n_positions=32, n_embd=48,
+                     n_layer=3, n_head=4, resid_pdrop=0.0,
+                     embd_pdrop=0.0, attn_pdrop=0.0, **kw)
+    return GPT2LMHeadModel(cfg).eval()
+
+
+def test_gpt2_logits_parity():
+    hf = _tiny_gpt2()
+    module, params, state = from_gpt2(hf)
+    toks = np.random.RandomState(0).randint(0, 101, (2, 16))
+    with torch.no_grad():
+        want = hf(torch.from_numpy(toks)).logits.numpy()
+    got, _ = module.apply(params, state, jnp.asarray(toks),
+                          training=False)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_gpt2_bare_model_and_serialization(tmp_path):
+    """GPT2Model (no LM head wrapper) converts too, and the converted
+    module survives the durable format."""
+    from transformers import GPT2Config, GPT2Model
+    from bigdl_tpu.utils.serializer import load_module, save_module
+    torch.manual_seed(1)
+    cfg = GPT2Config(vocab_size=67, n_positions=16, n_embd=32, n_layer=2,
+                     n_head=2, resid_pdrop=0.0, embd_pdrop=0.0,
+                     attn_pdrop=0.0)
+    hf = GPT2Model(cfg).eval()
+    module, params, state = from_gpt2(hf)
+    toks = np.random.RandomState(1).randint(0, 67, (1, 8))
+    want, _ = module.apply(params, state, jnp.asarray(toks))
+
+    path = str(tmp_path / "gpt2.bigdl-tpu")
+    save_module(path, module, params, state)
+    m2, p2, s2 = load_module(path)
+    got, _ = m2.apply(p2, s2, jnp.asarray(toks))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6)
+
+
+def test_gpt2_fine_tunes_with_optimizer():
+    """The imported model is trainable through the standard facade
+    (set_initial + Optimizer), like every other importer output."""
+    from bigdl_tpu import optim
+    from bigdl_tpu.dataset.core import IteratorDataSet, MiniBatch
+    import bigdl_tpu.nn as nn
+
+    hf = _tiny_gpt2(seed=2)
+    module, params, state = from_gpt2(hf)
+    r = np.random.RandomState(2)
+    toks = np.stack([(np.arange(17) + i) % 101 for i in range(8)])
+    x, y = toks[:, :-1].astype(np.int32), toks[:, 1:].astype(np.int32)
+
+    def epoch():
+        yield MiniBatch(x, y)
+
+    crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion(),
+                                       size_average=True)
+    opt = (optim.Optimizer(module, IteratorDataSet(epoch), crit,
+                           optim.Adam(3e-3), seed=4)
+           .set_initial(params, state)
+           .set_end_when(optim.Trigger.max_iteration(30)))
+    p2, _ = opt.optimize()
+    assert opt.state["loss"] < 3.0, opt.state["loss"]
+
+
+def test_gpt2_untied_head_converts():
+    from transformers import GPT2Config, GPT2LMHeadModel
+    torch.manual_seed(3)
+    cfg = transformers.GPT2Config(
+        vocab_size=53, n_positions=16, n_embd=32, n_layer=2, n_head=2,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        tie_word_embeddings=False)
+    hf = GPT2LMHeadModel(cfg).eval()
+    with torch.no_grad():                 # make head visibly != wte
+        hf.lm_head.weight.add_(0.5)
+    module, params, state = from_gpt2(hf)
+    assert not module.tied and "lm_head" in params
+    toks = np.random.RandomState(3).randint(0, 53, (2, 8))
+    with torch.no_grad():
+        want = hf(torch.from_numpy(toks)).logits.numpy()
+    got, _ = module.apply(params, state, jnp.asarray(toks))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_old_pickle_without_bias_attr_still_loads():
+    """Class-level bias default keeps pre-bias-option pickles working."""
+    from bigdl_tpu.nn.attention import MultiHeadAttention
+    m = MultiHeadAttention(16, 2)
+    del m.__dict__["bias"]                # simulate an old pickle
+    params, state = m.init(jax.random.PRNGKey(0))
+    assert set(params) == {"wq", "wk", "wv", "wo"}
+    out, _ = m.apply(params, state,
+                     jnp.zeros((1, 4, 16), jnp.float32))
+    assert out.shape == (1, 4, 16)
