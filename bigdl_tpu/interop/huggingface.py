@@ -21,7 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from bigdl_tpu.core.module import Module
-from bigdl_tpu.nn.attention import TransformerLayer
+from bigdl_tpu.nn.attention import (FeedForwardNetwork,
+                                    MultiHeadAttention, TransformerLayer)
 from bigdl_tpu.nn.normalization import LayerNormalization
 
 
@@ -83,8 +84,97 @@ class GPT2LM(Module):
         return x @ head.T, new_state
 
 
+def _gelu_exact(x):
+    """BERT's exact erf gelu — module-level for picklability."""
+    return jax.nn.gelu(x, approximate=False)
+
+
+class BertEncoder(Module):
+    """BERT rebuilt on this framework's primitives — post-LN blocks
+    (x = LN(x + attn(x)); x = LN(x + ffn(x)), the original-Transformer
+    wiring, vs GPT-2's pre-LN), learned word/position/type embeddings
+    with an embedding LayerNorm. apply(params, state, tokens,
+    attention_mask=None, token_type_ids=None) → (B, T, D) last hidden
+    state."""
+
+    def __init__(self, vocab_size: int, n_positions: int, type_vocab: int,
+                 d_model: int, num_heads: int, num_layers: int,
+                 d_ff: int, ln_eps: float = 1e-12, dropout: float = 0.0,
+                 name=None):
+        super().__init__(name or "BertEncoder")
+        self.vocab_size, self.n_positions = vocab_size, n_positions
+        self.type_vocab, self.d_model = type_vocab, d_model
+        self.num_layers, self.num_heads = num_layers, num_heads
+        # hidden_dropout_prob: applied to each sublayer output before the
+        # residual add (HF BertSelfOutput/BertOutput); the attention-
+        # probability dropout is not replicated
+        self.dropout = dropout
+        self.add_child("emb_ln", LayerNormalization(d_model, eps=ln_eps))
+        for i in range(num_layers):
+            self.add_child(f"attn{i}", MultiHeadAttention(
+                d_model, num_heads, bias=True))
+            self.add_child(f"attn_ln{i}", LayerNormalization(d_model,
+                                                             eps=ln_eps))
+            self.add_child(f"ffn{i}", FeedForwardNetwork(
+                d_model, d_ff, activation=_gelu_exact))
+            self.add_child(f"ffn_ln{i}", LayerNormalization(d_model,
+                                                            eps=ln_eps))
+
+    def param_specs(self):
+        from bigdl_tpu.core.module import ParamSpec
+        from bigdl_tpu.core import init as initializers
+        n = initializers.random_normal(0.0, 0.02)
+        return {"word": ParamSpec((self.vocab_size, self.d_model), n),
+                "pos": ParamSpec((self.n_positions, self.d_model), n),
+                "type": ParamSpec((self.type_vocab, self.d_model), n)}
+
+    def _apply(self, params, state, tokens, attention_mask=None,
+               token_type_ids=None, *, training=False, rng=None):
+        t = tokens.shape[1]
+        if t > self.n_positions:
+            raise ValueError(f"sequence {t} > max_position_embeddings "
+                             f"{self.n_positions} (a clamped gather would "
+                             f"silently reuse the last position row)")
+        x = params["word"][tokens] + params["pos"][jnp.arange(t)]
+        tt = (jnp.zeros_like(tokens) if token_type_ids is None
+              else token_type_ids)
+        x = x + params["type"][tt]
+        ch = self.children()
+        x, _ = ch["emb_ln"].apply(params["emb_ln"], {}, x)
+        mask = None
+        if attention_mask is not None:
+            # (B, T) 1/0 padding mask → (B, 1, 1, T) broadcast over heads
+            mask = attention_mask[:, None, None, :] != 0
+
+        def drop(h, key):
+            if not training or self.dropout <= 0.0 or key is None:
+                return h
+            keep = jax.random.bernoulli(key, 1.0 - self.dropout, h.shape)
+            return jnp.where(keep, h / (1.0 - self.dropout), 0.0)
+
+        rngs = (jax.random.split(rng, 2 * self.num_layers)
+                if rng is not None else (None,) * (2 * self.num_layers))
+        for i in range(self.num_layers):
+            a, _ = ch[f"attn{i}"].apply(params[f"attn{i}"], {}, x,
+                                        mask=mask)
+            x, _ = ch[f"attn_ln{i}"].apply(params[f"attn_ln{i}"], {},
+                                           x + drop(a, rngs[2 * i]))
+            f, _ = ch[f"ffn{i}"].apply(params[f"ffn{i}"], {}, x)
+            x, _ = ch[f"ffn_ln{i}"].apply(params[f"ffn_ln{i}"], {},
+                                          x + drop(f, rngs[2 * i + 1]))
+        return x, state
+
+
 def _t(x) -> np.ndarray:
     return np.asarray(x.detach().cpu().numpy(), np.float32)
+
+
+def _zero_skeleton(model):
+    """Shaped zero trees for (params, state) — every leaf is overwritten
+    with checkpoint weights, so skip the random init entirely."""
+    p_shape, s_shape = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    zeros = lambda s: jnp.zeros(s.shape, s.dtype)
+    return jax.tree.map(zeros, p_shape), jax.tree.map(zeros, s_shape)
 
 
 def from_gpt2(hf_model):
@@ -105,11 +195,7 @@ def from_gpt2(hf_model):
                    cfg.n_layer, ln_eps=cfg.layer_norm_epsilon,
                    dropout=float(getattr(cfg, "resid_pdrop", 0.0)),
                    tied=tied)
-    # every leaf is assigned from the checkpoint below — build a zeroed
-    # skeleton instead of paying a full random init for nothing
-    p_shape, s_shape = jax.eval_shape(model.init, jax.random.PRNGKey(0))
-    params = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), p_shape)
-    state = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), s_shape)
+    params, state = _zero_skeleton(model)
     if not tied:
         params["lm_head"] = jnp.asarray(_t(lm_head.weight))
     params["wte"] = jnp.asarray(_t(tf.wte.weight))
@@ -140,4 +226,63 @@ def from_gpt2(hf_model):
         }
     params["ln_f"] = {"weight": jnp.asarray(_t(tf.ln_f.weight)),
                       "bias": jnp.asarray(_t(tf.ln_f.bias))}
+    return model, params, state
+
+
+def from_bert(hf_model):
+    """`transformers` BertModel → (module, params, state). HF's
+    torch.nn.Linear stores (out, in) — transposed into our `x @ w`
+    orientation. Pooler/task heads are not converted (the encoder's last
+    hidden state is the output)."""
+    bert = getattr(hf_model, "bert", hf_model)        # task heads wrap it
+    cfg = hf_model.config
+    pet = getattr(cfg, "position_embedding_type", "absolute")
+    if pet != "absolute":
+        raise ValueError(
+            f"from_bert: position_embedding_type={pet!r} is not "
+            f"representable (only absolute learned positions)")
+    if getattr(cfg, "is_decoder", False) or getattr(
+            cfg, "add_cross_attention", False):
+        raise ValueError("from_bert: decoder/cross-attention BERT "
+                         "variants are not supported")
+    model = BertEncoder(cfg.vocab_size, cfg.max_position_embeddings,
+                        cfg.type_vocab_size, cfg.hidden_size,
+                        cfg.num_attention_heads, cfg.num_hidden_layers,
+                        cfg.intermediate_size,
+                        ln_eps=cfg.layer_norm_eps,
+                        dropout=float(getattr(cfg, "hidden_dropout_prob",
+                                              0.0)))
+    params, state = _zero_skeleton(model)
+
+    emb = bert.embeddings
+    params["word"] = jnp.asarray(_t(emb.word_embeddings.weight))
+    params["pos"] = jnp.asarray(_t(emb.position_embeddings.weight))
+    params["type"] = jnp.asarray(_t(emb.token_type_embeddings.weight))
+    params["emb_ln"] = {"weight": jnp.asarray(_t(emb.LayerNorm.weight)),
+                        "bias": jnp.asarray(_t(emb.LayerNorm.bias))}
+    for i, layer in enumerate(bert.encoder.layer):
+        att = layer.attention
+        params[f"attn{i}"] = {
+            "wq": jnp.asarray(_t(att.self.query.weight).T),
+            "bq": jnp.asarray(_t(att.self.query.bias)),
+            "wk": jnp.asarray(_t(att.self.key.weight).T),
+            "bk": jnp.asarray(_t(att.self.key.bias)),
+            "wv": jnp.asarray(_t(att.self.value.weight).T),
+            "bv": jnp.asarray(_t(att.self.value.bias)),
+            "wo": jnp.asarray(_t(att.output.dense.weight).T),
+            "bo": jnp.asarray(_t(att.output.dense.bias)),
+        }
+        params[f"attn_ln{i}"] = {
+            "weight": jnp.asarray(_t(att.output.LayerNorm.weight)),
+            "bias": jnp.asarray(_t(att.output.LayerNorm.bias))}
+        params[f"ffn{i}"] = {
+            "w1": {"weight": jnp.asarray(_t(layer.intermediate.dense
+                                            .weight).T),
+                   "bias": jnp.asarray(_t(layer.intermediate.dense.bias))},
+            "w2": {"weight": jnp.asarray(_t(layer.output.dense.weight).T),
+                   "bias": jnp.asarray(_t(layer.output.dense.bias))},
+        }
+        params[f"ffn_ln{i}"] = {
+            "weight": jnp.asarray(_t(layer.output.LayerNorm.weight)),
+            "bias": jnp.asarray(_t(layer.output.LayerNorm.bias))}
     return model, params, state

@@ -114,3 +114,34 @@ def test_old_pickle_without_bias_attr_still_loads():
     out, _ = m.apply(params, state,
                      jnp.zeros((1, 4, 16), jnp.float32))
     assert out.shape == (1, 4, 16)
+
+
+def test_bert_last_hidden_state_parity():
+    """BERT (post-LN encoder) parity incl. a real padding mask and token
+    types."""
+    from transformers import BertConfig, BertModel
+    from bigdl_tpu.interop.huggingface import from_bert
+    torch.manual_seed(4)
+    cfg = BertConfig(vocab_size=71, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=2, intermediate_size=64,
+                     max_position_embeddings=24, type_vocab_size=2,
+                     hidden_dropout_prob=0.0,
+                     attention_probs_dropout_prob=0.0)
+    hf = BertModel(cfg).eval()
+    module, params, state = from_bert(hf)
+
+    r = np.random.RandomState(4)
+    toks = r.randint(0, 71, (2, 12))
+    mask = np.ones((2, 12), np.int32)
+    mask[0, 8:] = 0                       # padded tail on row 0
+    types = r.randint(0, 2, (2, 12))
+    with torch.no_grad():
+        want = hf(torch.from_numpy(toks),
+                  attention_mask=torch.from_numpy(mask),
+                  token_type_ids=torch.from_numpy(types)
+                  ).last_hidden_state.numpy()
+    got, _ = module.apply(params, state, jnp.asarray(toks),
+                          jnp.asarray(mask), jnp.asarray(types))
+    # positions attending only to real tokens must match everywhere
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4,
+                               atol=1e-4)
