@@ -263,8 +263,15 @@ def train_ptb(args):
             yield MiniBatch(xs[i], ys[i])
 
     ds = IteratorDataSet(epoch)
+    if (args.pipeline_stages and args.pipeline_stages > 1
+            and args.seq_parallel and args.seq_parallel > 1):
+        raise SystemExit("--pipeline-stages and --seq-parallel are "
+                         "mutually exclusive (pick one parallelism for "
+                         "this CLI; compose them via the library API)")
     if args.pipeline_stages and args.pipeline_stages > 1:
         return _train_ptb_pipelined(args, d, xs, ys)
+    if args.seq_parallel and args.seq_parallel > 1:
+        return _train_ptb_seq_parallel(args, d, xs, ys)
     if args.model == "transformer":
         model = rnn.build_transformer(d.vocab_size, d_model=args.hidden,
                                       num_heads=4, d_ff=args.hidden * 4,
@@ -335,6 +342,51 @@ def _train_ptb_pipelined(args, d, xs, ys):
     return st, None
 
 
+def _train_ptb_seq_parallel(args, d, xs, ys):
+    """PTB transformer with the sequence dimension sharded over a 'seq'
+    mesh axis and ring attention (models/long_context_lm.py) — the
+    long-context configuration; each device holds T/N of every
+    activation."""
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu.models.long_context_lm import SeqParallelLM
+    from bigdl_tpu.parallel.mesh import create_mesh
+
+    S = args.seq_parallel
+    if args.model != "transformer":
+        raise SystemExit("--seq-parallel needs --model transformer")
+    if args.num_steps % S:
+        raise SystemExit(f"--num-steps {args.num_steps} must divide by "
+                         f"--seq-parallel {S} (sequence sharding)")
+    if len(jax.devices()) < S:
+        raise SystemExit(f"--seq-parallel {S} needs {S} devices, have "
+                         f"{len(jax.devices())} (on CPU set XLA_FLAGS="
+                         f"--xla_force_host_platform_device_count={S})")
+    mesh = create_mesh(jax.devices()[:S], seq=S, drop_trivial_axes=True)
+    lm = SeqParallelLM(d.vocab_size, d_model=args.hidden, num_heads=4,
+                      num_layers=args.layers)
+    params = lm.init(jax.random.PRNGKey(0))
+    lr = args.learning_rate or 1e-3
+    max_iter = args.max_iter or (xs.shape[0] * (args.max_epoch or 1))
+    first = last = None
+    it = 0
+    while it < max_iter:
+        for i in range(xs.shape[0]):
+            params, loss = lm.train_step(params, jnp.asarray(xs[i]),
+                                         jnp.asarray(ys[i]), mesh, lr=lr)
+            first = loss if first is None else first
+            last = loss
+            it += 1
+            if it % 10 == 0 or it >= max_iter:
+                print(f"seq-parallel-ptb iter {it} loss {loss:.4f} "
+                      f"(ppl ~ {np.exp(loss):.1f})")
+            if it >= max_iter:
+                break
+    print(f"ptb seq-parallel x{S} (ring attention): loss {first:.3f} -> "
+          f"{last:.3f}, perplexity ~ {np.exp(last):.1f}")
+    return params, None
+
+
 def main(argv=None):
     force_cpu_if_requested()
     logging.basicConfig(level=logging.INFO,
@@ -369,6 +421,9 @@ def main(argv=None):
                    help="train the transformer body pipeline-parallel "
                         "over a 'pipe' mesh axis of this size (1F1B; "
                         "embedding/head replicated outside the pipe)")
+    p.add_argument("--seq-parallel", type=int, default=0,
+                   help="shard the sequence over a 'seq' mesh axis of "
+                        "this size with ring attention (long-context)")
 
     args = ap.parse_args(argv)
     fn = {"lenet": train_lenet, "resnet": train_resnet,
