@@ -94,8 +94,12 @@ class SeqParallelLM:
     # --------------------------------------------------------------- steps
     def _build(self, mesh: Mesh, what: str):
         from jax import shard_map
+        from bigdl_tpu.parallel.mesh import DATA_AXIS
         n = mesh.shape[self.seq_axis]
-        tok_spec = P(None, self.seq_axis)
+        # compose with data parallelism when the mesh carries a 'data'
+        # axis: batch over 'data', sequence over 'seq'
+        batch_axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
+        tok_spec = P(batch_axis, self.seq_axis)
 
         if what == "apply":
             def fwd(params, xt):
@@ -103,7 +107,14 @@ class SeqParallelLM:
                 return h @ params["emb"].T
             return jax.jit(shard_map(
                 fwd, mesh=mesh, in_specs=(P(), tok_spec),
-                out_specs=P(None, self.seq_axis, None), check_vma=False))
+                out_specs=P(batch_axis, self.seq_axis, None),
+                check_vma=False))
+
+        axes = tuple(a for a in (batch_axis, self.seq_axis)
+                     if a is not None)
+        world = 1
+        for a in axes:
+            world *= mesh.shape[a]
 
         def step(params, xt, yt):
             def loss_fn(p):
@@ -114,12 +125,12 @@ class SeqParallelLM:
                 # this shard's CONTRIBUTION to the global token mean —
                 # differentiating a psum'd value instead would scale every
                 # cotangent by N (psum's VJP is itself a psum)
-                return jnp.sum(nll) / (nll.size * n)
+                return jnp.sum(nll) / (nll.size * world)
             local_loss, grads = jax.value_and_grad(loss_fn)(params)
-            loss = jax.lax.psum(local_loss, self.seq_axis)
-            # replicated params ← psum of each shard's gradient
-            grads = jax.tree.map(
-                lambda g: jax.lax.psum(g, self.seq_axis), grads)
+            loss = jax.lax.psum(local_loss, axes)
+            # replicated params ← psum over every shard's gradient (the
+            # dp all-reduce and the sp gradient reduction in one)
+            grads = jax.tree.map(lambda g: jax.lax.psum(g, axes), grads)
             return loss, grads
         return jax.jit(shard_map(
             step, mesh=mesh, in_specs=(P(), tok_spec, tok_spec),
