@@ -159,6 +159,38 @@ class MoE(Module):
         return (tokens + y).reshape(b, t, d), new_state
 
 
+def expert_parallel_forward(moe: MoE, params_local, x_local,
+                            axis_name: str = EXPERT_AXIS):
+    """The shard-level expert-parallel MoE forward — runs INSIDE a
+    shard_map with `axis_name` bound (expert_parallel_apply wraps it; a
+    model whose whole train step lives in one shard_map, e.g.
+    models/moe_lm.py, calls it directly). x_local (B_local, T, d) with
+    batch sharded over `axis_name`; expert params sharded on their
+    leading E axis; gate replicated. Returns (out_local, aux) with aux
+    pmean'd over the axis. Differentiable end to end (the all_to_alls
+    transpose to all_to_alls)."""
+    b, t, d = x_local.shape
+    tokens = x_local.reshape(b * t, d)
+    probs, logits = router_probs(tokens, params_local["gate"])
+    cap = moe.capacity(b * t)
+    dispatch, combine, aux = moe._dispatch(probs, cap)
+    xe = jnp.einsum("td,tec->ecd", tokens, dispatch)     # (E, C, d)
+    # (E, C, d) -> (E/n, n*C, d): this device's expert group's queues
+    # from every device
+    xe = lax.all_to_all(xe, axis_name, split_axis=0, concat_axis=1,
+                        tiled=True)
+    ye = moe._experts(params_local, xe)
+    ye = lax.all_to_all(ye, axis_name, split_axis=1, concat_axis=0,
+                        tiled=True)
+    y = jnp.einsum("ecd,tec->td", ye, combine)
+    z_loss = jnp.mean(jax.scipy.special.logsumexp(logits, axis=-1) ** 2)
+    aux_out = {
+        "load_balance": lax.pmean(aux, axis_name),
+        "z_loss": lax.pmean(z_loss, axis_name),
+    }
+    return (tokens + y).reshape(b, t, d), aux_out
+
+
 def expert_parallel_apply(moe: MoE, params, x, mesh: Mesh,
                           axis_name: str = EXPERT_AXIS):
     """Run the MoE layer with BOTH tokens and experts sharded over
@@ -185,26 +217,8 @@ def expert_parallel_apply(moe: MoE, params, x, mesh: Mesh,
     p_spec = {"gate": P(), "w_up": P(axis_name), "w_down": P(axis_name)}
 
     def shard_fn(params_local, x_local):
-        b, t, d = x_local.shape
-        tokens = x_local.reshape(b * t, d)
-        probs, logits = router_probs(tokens, params_local["gate"])
-        cap = moe.capacity(b * t)
-        dispatch, combine, aux = moe._dispatch(probs, cap)
-        xe = jnp.einsum("td,tec->ecd", tokens, dispatch)     # (E, C, d)
-        # (E, C, d) -> (E/n, n*C, d): this device's expert group's queues
-        # from every device
-        xe = lax.all_to_all(xe, axis_name, split_axis=0, concat_axis=1,
-                            tiled=True)
-        ye = moe._experts(params_local, xe)
-        ye = lax.all_to_all(ye, axis_name, split_axis=1, concat_axis=0,
-                            tiled=True)
-        y = jnp.einsum("ecd,tec->td", ye, combine)
-        z_loss = jnp.mean(jax.scipy.special.logsumexp(logits, axis=-1) ** 2)
-        aux_out = {
-            "load_balance": lax.pmean(aux, axis_name),
-            "z_loss": lax.pmean(z_loss, axis_name),
-        }
-        return (tokens + y).reshape(b, t, d), aux_out
+        return expert_parallel_forward(moe, params_local, x_local,
+                                       axis_name)
 
     fn = shard_map(shard_fn, mesh=mesh,
                    in_specs=(p_spec, P(axis_name)),
