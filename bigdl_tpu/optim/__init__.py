@@ -3,7 +3,7 @@ trainers (reference: optim/, SURVEY.md §2.6)."""
 
 from bigdl_tpu.optim.method import (OptimMethod, SGD, Adam, AdamW, Adamax,
                                     Adadelta, Adagrad, RMSprop, Ftrl, LarsSGD,
-                                    LBFGS, ParallelAdam)
+                                    LBFGS, OptaxMethod, ParallelAdam)
 from bigdl_tpu.optim.schedule import (LearningRateSchedule, Default, Poly, Step,
                                       MultiStep, EpochStep, EpochDecay,
                                       Exponential, NaturalExp, Warmup, Plateau,

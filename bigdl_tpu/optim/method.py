@@ -474,3 +474,35 @@ class LBFGS(OptimMethod):
 
 
 ParallelAdam = Adam  # reference's thread-parallel variant; see module docstring
+
+
+class OptaxMethod(OptimMethod):
+    """Adapter: any `optax.GradientTransformation` as an OptimMethod —
+    the bridge for users arriving from the JAX ecosystem (parity-plus;
+    the closest reference analogue is OptimMethod's pluggability,
+    optim/OptimMethod.scala).
+
+        from bigdl_tpu.optim.method import OptaxMethod
+        import optax
+        method = OptaxMethod(optax.adamw(1e-3), learning_rate=1e-3)
+        Optimizer(model, ds, criterion, method).optimize()
+
+    The wrapped transformation owns the actual update math (including
+    its own schedule if you built one in); `learning_rate` here only
+    feeds the trainer's logging/`current_lr`. Works with the local and
+    distributed trainers — the optax state rides the slots pytree, so
+    ZeRO-1 sharding applies to it like any other slot tree."""
+
+    def __init__(self, transformation, learning_rate: float = 1e-3,
+                 learning_rate_schedule=None):
+        super().__init__(learning_rate, learning_rate_schedule)
+        self.tx = transformation
+
+    def init_slots(self, params):
+        return self.tx.init(params)
+
+    def update(self, params, grads, slots, lr, step):
+        updates, new_slots = self.tx.update(grads, slots, params)
+        import jax as _jax
+        new_params = _jax.tree.map(lambda p, u: p + u, params, updates)
+        return new_params, new_slots
