@@ -39,11 +39,13 @@ class GPT2LM(Module):
 
     def __init__(self, vocab_size: int, n_positions: int, d_model: int,
                  num_heads: int, num_layers: int, ln_eps: float = 1e-5,
-                 dropout: float = 0.0, tied: bool = True, name=None):
+                 dropout: float = 0.0, tied: bool = True,
+                 eos_id=None, name=None):
         super().__init__(name or "GPT2LM")
         self.vocab_size, self.n_positions = vocab_size, n_positions
         self.d_model, self.num_layers = d_model, num_layers
         self.tied = tied
+        self.eos_id = eos_id          # generate()'s default stop token
         for i in range(num_layers):
             self.add_child(f"h{i}", TransformerLayer(
                 d_model, num_heads, 4 * d_model, bias=True,
@@ -65,7 +67,7 @@ class GPT2LM(Module):
                 initializers.random_normal(0.0, 0.02))
         return specs
 
-    def _apply(self, params, state, tokens, *, training=False, rng=None):
+    def _hidden(self, params, state, tokens, training=False, rng=None):
         t = tokens.shape[1]
         if t > self.n_positions:
             raise ValueError(f"sequence {t} > n_positions "
@@ -80,8 +82,62 @@ class GPT2LM(Module):
                 training=training, rng=rngs[i])
         x, new_state["ln_f"] = self.children()["ln_f"].apply(
             params["ln_f"], state.get("ln_f", {}), x)
-        head = params["wte"] if self.tied else params["lm_head"]
-        return x @ head.T, new_state
+        return x, new_state
+
+    def _head(self, params):
+        return params["wte"] if self.tied else params["lm_head"]
+
+    def _apply(self, params, state, tokens, *, training=False, rng=None):
+        x, new_state = self._hidden(params, state, tokens, training, rng)
+        return x @ self._head(params).T, new_state
+
+    def generate(self, params, state, prompt, max_new_tokens: int,
+                 beam_size: int = 4, eos_id=None, alpha: float = 0.0):
+        """Beam-search continuation of `prompt` (B, P) int32 →
+        (sequences (B, K, P+max_new), scores (B, K)). Full-prefix
+        recompute per step (no KV cache) — the causal mask makes the
+        zero-padded tail invisible, so the buffer stays fixed-shape for
+        `lax.scan` (same recipe as examples/language_model.py). Only the
+        decode position's hidden row hits the LM head, so per-step head
+        cost is (B·K, d)·(d, V), not L times that. `eos_id` defaults to
+        the converted config's eos_token_id."""
+        from bigdl_tpu.nn.recurrent import beam_search, tile_beam
+        if eos_id is None:
+            eos_id = self.eos_id
+        if eos_id is None:
+            raise ValueError("generate: pass eos_id (the model carries "
+                             "none — config eos_token_id was absent or "
+                             "out of vocabulary)")
+        B, P = prompt.shape
+        L = P + max_new_tokens
+        if L > self.n_positions:
+            raise ValueError(f"prompt+new = {L} > n_positions "
+                             f"{self.n_positions}")
+        buf0 = jnp.zeros((B, L), jnp.int32).at[:, :P - 1].set(
+            prompt[:, :-1])
+        # beam_search reorders state leaves along the beam dim, so `pos`
+        # rides as a per-row vector (identical entries)
+        st0 = tile_beam((buf0, jnp.full((B,), P - 1, jnp.int32)),
+                        beam_size)
+
+        def step_fn(tokens_last, st):
+            buf, pos = st
+            p = pos[0]
+            buf = jax.lax.dynamic_update_slice_in_dim(
+                buf, tokens_last[:, None], p, axis=1)
+            h, _ = self._hidden(params, state, buf)
+            h_p = jax.lax.dynamic_index_in_dim(h, p, axis=1,
+                                               keepdims=False)
+            step_logits = h_p @ self._head(params).T
+            return step_logits, (buf, pos + 1)
+
+        seqs, scores = beam_search(
+            step_fn, st0, prompt[:, -1], beam_size=beam_size,
+            vocab_size=self.vocab_size, max_len=max_new_tokens,
+            eos_id=eos_id, alpha=alpha)
+        full = jnp.concatenate(
+            [jnp.repeat(prompt[:, None], beam_size, axis=1), seqs], -1)
+        return full, scores
 
 
 def _gelu_exact(x):
@@ -191,10 +247,13 @@ def from_gpt2(hf_model):
     lm_head = getattr(hf_model, "lm_head", None)
     tied = (lm_head is None
             or lm_head.weight.data_ptr() == tf.wte.weight.data_ptr())
+    eos = getattr(cfg, "eos_token_id", None)
+    if eos is not None and not (0 <= eos < cfg.vocab_size):
+        eos = None                       # e.g. tiny test vocabs
     model = GPT2LM(cfg.vocab_size, cfg.n_positions, d, cfg.n_head,
                    cfg.n_layer, ln_eps=cfg.layer_norm_epsilon,
                    dropout=float(getattr(cfg, "resid_pdrop", 0.0)),
-                   tied=tied)
+                   tied=tied, eos_id=eos)
     params, state = _zero_skeleton(model)
     if not tied:
         params["lm_head"] = jnp.asarray(_t(lm_head.weight))
