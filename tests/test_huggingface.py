@@ -178,3 +178,20 @@ def test_gpt2_generate_beam1_matches_greedy_rollout():
                              num_beams=1, pad_token_id=0)
     np.testing.assert_array_equal(np.asarray(seqs[:, 0]),
                                   hf_out.numpy().astype(np.int32))
+
+
+def test_gpt2_generate_kv_cache_matches_recompute():
+    """KV-cached decoding is an exact program transform: sequences AND
+    beam scores match the full-recompute path, beams > 1 included (the
+    cache tensors reorder per beam through beam_search's state)."""
+    hf = _tiny_gpt2(seed=6, eos_token_id=100)
+    module, params, state = from_gpt2(hf)
+    prompt = np.random.RandomState(6).randint(1, 100, (2, 5)).astype(np.int32)
+    for K in (1, 3):
+        s_a, sc_a = module.generate(params, state, jnp.asarray(prompt), 7,
+                                    beam_size=K, kv_cache=False)
+        s_b, sc_b = module.generate(params, state, jnp.asarray(prompt), 7,
+                                    beam_size=K, kv_cache=True)
+        np.testing.assert_array_equal(np.asarray(s_a), np.asarray(s_b))
+        np.testing.assert_allclose(np.asarray(sc_a), np.asarray(sc_b),
+                                   rtol=1e-4, atol=1e-5)
