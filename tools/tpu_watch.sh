@@ -26,7 +26,7 @@ for i in $(seq 1 200); do
       echo "{"
       echo "\"captured_at\": \"$(date -u +%FT%TZ)\","
       echo "\"device\": \"$(echo "$out" | sed 's/ALIVE //')\","
-      for m in resnet50 lenet lstm transformer; do
+      for m in resnet50 lenet lstm transformer kernels; do
         j=$(timeout 1800 python bench.py "$m" 2>>"$LOG" | tail -1)
         echo "\"$m\": ${j:-null},"
       done
