@@ -16,7 +16,7 @@ token+position LookupTables, a final LayerNorm, and the tied LM head.
 
 from __future__ import annotations
 
-import math
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -94,56 +94,24 @@ class GPT2LM(Module):
         return x @ self._head(params).T, new_state
 
     # ------------------------------------------------- KV-cached decoding
-    def _block_cached(self, blk, bp, x, ck, cv, start):
-        """One pre-norm block over `x` (N, T, d) attending to the KV
-        cache; writes this chunk's K/V at [start, start+T). LayerNorms
-        and the FFN run through the real modules; only the attention is
-        hand-rolled (that IS the cache). Numerically identical to
-        TransformerLayer's full forward (asserted in tests).
-
-        `blk` is the TransformerLayer; ck/cv (N, L, H, hd); `start` may
-        be traced."""
-        N, T, d = x.shape
-        H = blk.attn.num_heads
-        hd = d // H
-        at = bp["attn"]
-        h, _ = blk.ln1.apply(bp["ln1"], {}, x)
-        q = (h @ at["wq"] + at["bq"]).reshape(N, T, H, hd)
-        k = (h @ at["wk"] + at["bk"]).reshape(N, T, H, hd)
-        v = (h @ at["wv"] + at["bv"]).reshape(N, T, H, hd)
-        ck = jax.lax.dynamic_update_slice(ck, k, (0, start, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v, (0, start, 0, 0))
-        L = ck.shape[1]
-        logits = jnp.einsum("nthd,nshd->nhts", q, ck) / math.sqrt(hd)
-        q_pos = start + jnp.arange(T)[:, None]        # (T, 1)
-        k_pos = jnp.arange(L)[None, :]                # (1, L)
-        mask = k_pos <= q_pos                         # causal + cache tail
-        logits = jnp.where(mask[None, None], logits.astype(jnp.float32),
-                           -1e30)
-        w = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
-        a = jnp.einsum("nhts,nshd->nthd", w, cv).reshape(N, T, d)
-        x = x + a @ at["wo"] + at["bo"]
-        f, _ = blk.ffn.apply(bp["ffn"], {},
-                             blk.ln2.apply(bp["ln2"], {}, x)[0])
-        return x + f, ck, cv
-
-    def _cached_forward(self, params, tokens, cks, cvs, start):
-        """tokens (N, T) at absolute positions [start, start+T); cks/cvs
-        are per-layer tuples of (N, L, H, hd) — N leading so
+    def _cached_forward(self, params, tokens, caches, start):
+        """tokens (N, T) at absolute positions [start, start+T); caches =
+        (cks, cvs) per-layer tuples of (N, L, H, hd) — N leading so
         beam_search's per-beam state reorder maps over the leaves.
         Returns (logits at the LAST position (N, V), new caches)."""
+        cks, cvs = caches
         x = params["wte"][tokens] + params["wpe"][start + jnp.arange(
             tokens.shape[1])]
         new_ck, new_cv = [], []
         for i in range(self.num_layers):
             blk = self.children()[f"h{i}"]
-            x, ck_i, cv_i = self._block_cached(
-                blk, params[f"h{i}"], x, cks[i], cvs[i], start)
+            x, ck_i, cv_i = blk.cached_step(
+                params[f"h{i}"], x, cks[i], cvs[i], start)
             new_ck.append(ck_i)
             new_cv.append(cv_i)
         x, _ = self.children()["ln_f"].apply(params["ln_f"], {}, x)
-        return (x[:, -1] @ self._head(params).T, tuple(new_ck),
-                tuple(new_cv))
+        return (x[:, -1] @ self._head(params).T,
+                (tuple(new_ck), tuple(new_cv)))
 
     def generate(self, params, state, prompt, max_new_tokens: int,
                  beam_size: int = 4, eos_id=None, alpha: float = 0.0,
@@ -206,36 +174,21 @@ class GPT2LM(Module):
         attends over the cache — O(L) per step instead of the full-prefix
         O(L²) recompute. Output is asserted identical to the recompute
         path in tests."""
-        from bigdl_tpu.nn.recurrent import beam_search, tile_beam
+        from bigdl_tpu.nn.recurrent import cached_beam_generate
         B, P = prompt.shape
-        K = beam_size
         H = self.children()["h0"].attn.num_heads
         hd = self.d_model // H
-        zeros = lambda: jnp.zeros((B, L, H, hd), jnp.float32)  # noqa: E731
-        cks = tuple(zeros() for _ in range(self.num_layers))
-        cvs = tuple(zeros() for _ in range(self.num_layers))
-        if P > 1:
-            # prime the caches on the prompt prefix ONCE per batch row —
-            # the K beam copies are identical, so tile after the O(P²)
-            # prefill, not before
-            _, cks, cvs = self._cached_forward(
-                params, prompt[:, :P - 1], cks, cvs, 0)
-        cks, cvs = tile_beam((cks, cvs), K)
-        pos0 = jnp.full((B * K,), P - 1, jnp.int32)
+        dtype = params["wte"].dtype
 
-        def step_fn(tokens_last, st):
-            cks, cvs, pos = st
-            logits, cks, cvs = self._cached_forward(
-                params, tokens_last[:, None], cks, cvs, pos[0])
-            return logits, (cks, cvs, pos + 1)
+        def make_caches():
+            zeros = lambda: jnp.zeros((B, L, H, hd), dtype)  # noqa: E731
+            return (tuple(zeros() for _ in range(self.num_layers)),
+                    tuple(zeros() for _ in range(self.num_layers)))
 
-        seqs, scores = beam_search(
-            step_fn, (cks, cvs, pos0), prompt[:, -1],
-            beam_size=beam_size, vocab_size=self.vocab_size,
-            max_len=max_new_tokens, eos_id=eos_id, alpha=alpha)
-        full = jnp.concatenate(
-            [jnp.repeat(prompt[:, None], beam_size, axis=1), seqs], -1)
-        return full, scores
+        return cached_beam_generate(
+            functools.partial(self._cached_forward, params), make_caches,
+            prompt, max_new_tokens=max_new_tokens, beam_size=beam_size,
+            vocab_size=self.vocab_size, eos_id=eos_id, alpha=alpha)
 
 
 def _gelu_exact(x):

@@ -29,18 +29,8 @@ from bigdl_tpu.parallel.mesh import SEQ_AXIS
 from bigdl_tpu.parallel.ring import RingAttention
 
 
-def positional_encoding_at(positions, d: int, dtype=jnp.float32):
-    """Sinusoidal signal evaluated at arbitrary (possibly shard-offset)
-    positions — the sequence-sharded form of
-    nn.attention.positional_encoding."""
-    pos = positions.astype(jnp.float32)[:, None]
-    half = d // 2
-    freq = jnp.exp(-math.log(10000.0) * jnp.arange(half) / max(1, half - 1))
-    angles = pos * freq[None, :]
-    enc = jnp.concatenate([jnp.sin(angles), jnp.cos(angles)], axis=-1)
-    if enc.shape[-1] < d:
-        enc = jnp.pad(enc, ((0, 0), (0, d - enc.shape[-1])))
-    return enc.astype(dtype)
+# canonical home moved to nn.attention; re-exported for compatibility
+from bigdl_tpu.nn.attention import positional_encoding_at  # noqa: E402,F401
 
 
 class SeqParallelLM:

@@ -272,6 +272,50 @@ class TransformerLayer(Module):
         self.ffn = self.add_child("ffn", FeedForwardNetwork(
             d_model, d_ff, dropout=dropout, **ffn_kw))
 
+    def cached_step(self, params, x, ck, cv, start):
+        """Incremental-decode forward: run this block over `x` (N, T, d)
+        attending to the KV cache, writing this chunk's K/V at
+        [start, start+T). LayerNorms/FFN run through the child modules;
+        the attention is hand-rolled because the cache IS the point.
+        Numerically identical to the full forward with causal=True over
+        the prefix (asserted by the generation parity tests). `start`
+        may be traced. Self-attention blocks only (cross=False).
+
+        ck/cv (N, L, H, hd) → returns (out, new_ck, new_cv)."""
+        if self.cross:
+            raise ValueError("cached_step supports self-attention "
+                             "decoder blocks only")
+        N, T, d = x.shape
+        H = self.attn.num_heads
+        hd = d // H
+        at = params["attn"]
+        h, _ = self.ln1.apply(params["ln1"], {}, x)
+        q = h @ at["wq"]
+        k = h @ at["wk"]
+        v = h @ at["wv"]
+        if self.attn.bias:
+            q, k, v = q + at["bq"], k + at["bk"], v + at["bv"]
+        q = q.reshape(N, T, H, hd)
+        k = k.reshape(N, T, H, hd)
+        v = v.reshape(N, T, H, hd)
+        ck = jax.lax.dynamic_update_slice(ck, k, (0, start, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v, (0, start, 0, 0))
+        L = ck.shape[1]
+        logits = jnp.einsum("nthd,nshd->nhts", q, ck) / math.sqrt(hd)
+        mask = (jnp.arange(L)[None, :] <=
+                (start + jnp.arange(T))[:, None])   # causal + cache tail
+        logits = jnp.where(mask[None, None], logits.astype(jnp.float32),
+                           -1e30)
+        w = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        a = jnp.einsum("nhts,nshd->nthd", w, cv).reshape(N, T, d)
+        a = a @ at["wo"]
+        if self.attn.bias:
+            a = a + at["bo"]
+        x = x + a
+        f, _ = self.ffn.apply(params["ffn"], {},
+                              self.ln2.apply(params["ln2"], {}, x)[0])
+        return x + f, ck, cv
+
     def _apply(self, params, state, x, memory=None, *, mask=None,
                memory_mask=None, causal=False, training=False, rng=None):
         rngs = jax.random.split(rng, 3) if rng is not None else (None,) * 3
@@ -299,10 +343,10 @@ class TransformerLayer(Module):
         return x + f, new_state
 
 
-def positional_encoding(t: int, d: int, dtype=jnp.float32):
-    """Sinusoidal position signal (reference: TransformerOperation.scala
-    addTimingSignal)."""
-    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+def positional_encoding_at(positions, d: int, dtype=jnp.float32):
+    """Sinusoidal signal at arbitrary (possibly traced / shard-offset)
+    positions — used by sequence-parallel shards and KV-cached decoding."""
+    pos = positions.astype(jnp.float32)[:, None]
     half = d // 2
     freq = jnp.exp(-math.log(10000.0) * jnp.arange(half) / max(1, half - 1))
     angles = pos * freq[None, :]
@@ -310,6 +354,12 @@ def positional_encoding(t: int, d: int, dtype=jnp.float32):
     if enc.shape[-1] < d:
         enc = jnp.pad(enc, ((0, 0), (0, d - enc.shape[-1])))
     return enc.astype(dtype)
+
+
+def positional_encoding(t: int, d: int, dtype=jnp.float32):
+    """Sinusoidal position signal (reference: TransformerOperation.scala
+    addTimingSignal)."""
+    return positional_encoding_at(jnp.arange(t), d, dtype)
 
 
 class Transformer(Module):
@@ -391,6 +441,56 @@ class Transformer(Module):
                     rng=rngs[self.num_layers + i])
         x = run("dec_ln", x)
         return x @ params["embedding"].T, new_state
+
+
+    def generate(self, params, state, prompt, max_new_tokens: int,
+                 beam_size: int = 4, eos_id: int = 0, alpha: float = 0.0):
+        """KV-cached beam-search continuation for the LM mode: one
+        token's QKV per step attending over per-layer caches
+        (`TransformerLayer.cached_step`), prompt prefill once per batch
+        row. prompt (B, P) int32 → (sequences (B, K, P+max_new),
+        scores (B, K)). The reference pairs its Transformer with
+        SequenceBeamSearch (nn/SequenceBeamSearch.scala); this is that
+        wiring with incremental decode."""
+        from bigdl_tpu.nn.recurrent import cached_beam_generate
+        if self.mode != "lm":
+            raise ValueError("generate() requires mode='lm'")
+        B, P = prompt.shape
+        L = P + max_new_tokens
+        if L > self.max_len:
+            raise ValueError(f"prompt+new = {L} > max_len {self.max_len}")
+        d = self.d_model
+        H = self.children()["dec0"].attn.num_heads
+        hd = d // H
+        scale = math.sqrt(d)
+        dtype = params["embedding"].dtype      # bf16 params → bf16 caches
+
+        def fwd(tokens, caches, start):
+            cks, cvs = caches
+            x = (params["embedding"][tokens] * scale
+                 + positional_encoding_at(
+                     start + jnp.arange(tokens.shape[1]), d, dtype))
+            new_ck, new_cv = [], []
+            for i in range(self.num_layers):
+                blk = self.children()[f"dec{i}"]
+                x, ck_i, cv_i = blk.cached_step(
+                    params[f"dec{i}"], x, cks[i], cvs[i], start)
+                new_ck.append(ck_i)
+                new_cv.append(cv_i)
+            x, _ = self.children()["dec_ln"].apply(
+                params["dec_ln"], {}, x)
+            logits = x[:, -1] @ params["embedding"].T
+            return logits, (tuple(new_ck), tuple(new_cv))
+
+        def make_caches():
+            zeros = lambda: jnp.zeros((B, L, H, hd), dtype)  # noqa: E731
+            return (tuple(zeros() for _ in range(self.num_layers)),
+                    tuple(zeros() for _ in range(self.num_layers)))
+
+        return cached_beam_generate(
+            fwd, make_caches, prompt, max_new_tokens=max_new_tokens,
+            beam_size=beam_size, vocab_size=self.vocab_size,
+            eos_id=eos_id, alpha=alpha)
 
 
 class Attention(MultiHeadAttention):

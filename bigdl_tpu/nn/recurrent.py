@@ -581,3 +581,35 @@ class BinaryTreeLSTM(TreeLSTM):
         (_, _), hs = lax.scan(step, (c_buf, h_buf),
                               jnp.arange(n_nodes, dtype=jnp.int32))
         return jnp.swapaxes(hs, 0, 1)             # (B, N, H)
+
+
+def cached_beam_generate(fwd, make_caches, prompt, *, max_new_tokens: int,
+                         beam_size: int, vocab_size: int, eos_id: int,
+                         alpha: float = 0.0):
+    """Shared KV-cached beam-decode wiring (used by nn.Transformer.generate
+    and interop.huggingface.GPT2LM): prefill the prompt ONCE per batch row,
+    tile caches to beams, then beam_search over single-token steps.
+
+        fwd(tokens (N, T), caches, start) -> (last_logits (N, V), caches)
+        make_caches() -> cache pytree with leading batch dim B
+
+    Returns (sequences (B, K, P+max_new), scores (B, K))."""
+    B, P = prompt.shape
+    caches = make_caches()
+    if P > 1:
+        _, caches = fwd(prompt[:, :P - 1], caches, 0)
+    caches = tile_beam(caches, beam_size)
+    pos0 = jnp.full((B * beam_size,), P - 1, jnp.int32)
+
+    def step_fn(tokens_last, st):
+        caches, pos = st
+        logits, caches = fwd(tokens_last[:, None], caches, pos[0])
+        return logits, (caches, pos + 1)
+
+    seqs, scores = beam_search(
+        step_fn, (caches, pos0), prompt[:, -1], beam_size=beam_size,
+        vocab_size=vocab_size, max_len=max_new_tokens, eos_id=eos_id,
+        alpha=alpha)
+    full = jnp.concatenate(
+        [jnp.repeat(prompt[:, None], beam_size, axis=1), seqs], -1)
+    return full, scores
