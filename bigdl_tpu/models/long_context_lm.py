@@ -132,10 +132,20 @@ class SeqParallelLM:
             self._compiled[key] = self._build(mesh, what)
         return self._compiled[key]
 
+    @staticmethod
+    def _placed(arr, sh):
+        """device_put host arrays; pass through already-global jax.Arrays
+        (multi-host callers assemble them with
+        jax.make_array_from_process_local_data — a device_put of those
+        would try to materialize remote shards locally)."""
+        if isinstance(arr, jax.Array) and arr.sharding == sh:
+            return arr
+        return jax.device_put(arr, sh)
+
     def loss_and_grads(self, params, x_tokens, y_tokens, mesh: Mesh):
         sh = NamedSharding(mesh, P(None, self.seq_axis))
-        xt = jax.device_put(x_tokens, sh)
-        yt = jax.device_put(y_tokens, sh)
+        xt = self._placed(x_tokens, sh)
+        yt = self._placed(y_tokens, sh)
         return self._fn(mesh, "step")(params, xt, yt)
 
     def train_step(self, params, x_tokens, y_tokens, mesh: Mesh,
@@ -146,5 +156,4 @@ class SeqParallelLM:
 
     def apply(self, params, tokens, mesh: Mesh):
         sh = NamedSharding(mesh, P(None, self.seq_axis))
-        return self._fn(mesh, "apply")(params,
-                                       jax.device_put(tokens, sh))
+        return self._fn(mesh, "apply")(params, self._placed(tokens, sh))

@@ -285,6 +285,14 @@ class TransformerLayer(Module):
         if self.cross:
             raise ValueError("cached_step supports self-attention "
                              "decoder blocks only")
+        if callable(self.attn.attn_impl):
+            # a custom kernel computes logits its own way; decoding
+            # through the dense core here would silently diverge from
+            # apply() — refuse instead
+            raise ValueError(
+                "cached_step decodes through the dense attention core; "
+                "this layer was built with a custom attn_impl whose "
+                "numerics it cannot reproduce")
         N, T, d = x.shape
         H = self.attn.num_heads
         hd = d // H
@@ -301,13 +309,14 @@ class TransformerLayer(Module):
         ck = jax.lax.dynamic_update_slice(ck, k, (0, start, 0, 0))
         cv = jax.lax.dynamic_update_slice(cv, v, (0, start, 0, 0))
         L = ck.shape[1]
-        logits = jnp.einsum("nthd,nshd->nhts", q, ck) / math.sqrt(hd)
         mask = (jnp.arange(L)[None, :] <=
                 (start + jnp.arange(T))[:, None])   # causal + cache tail
-        logits = jnp.where(mask[None, None], logits.astype(jnp.float32),
-                           -1e30)
-        w = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
-        a = jnp.einsum("nhts,nshd->nthd", w, cv).reshape(N, T, d)
+        # one numerical core: the same scale/mask/softmax chain apply()
+        # uses ((N, H, T, hd) layout; mask broadcasts over N, H)
+        a = dot_product_attention(q.transpose(0, 2, 1, 3),
+                                  ck.transpose(0, 2, 1, 3),
+                                  cv.transpose(0, 2, 1, 3), mask)
+        a = a.transpose(0, 2, 1, 3).reshape(N, T, d)
         a = a @ at["wo"]
         if self.attn.bias:
             a = a + at["bo"]
@@ -444,17 +453,21 @@ class Transformer(Module):
 
 
     def generate(self, params, state, prompt, max_new_tokens: int,
-                 beam_size: int = 4, eos_id: int = 0, alpha: float = 0.0):
+                 beam_size: int = 4, eos_id=None, alpha: float = 0.0):
         """KV-cached beam-search continuation for the LM mode: one
         token's QKV per step attending over per-layer caches
         (`TransformerLayer.cached_step`), prompt prefill once per batch
         row. prompt (B, P) int32 → (sequences (B, K, P+max_new),
         scores (B, K)). The reference pairs its Transformer with
         SequenceBeamSearch (nn/SequenceBeamSearch.scala); this is that
-        wiring with incremental decode."""
+        wiring with incremental decode. `eos_id` is required — guessing
+        a stop token would silently freeze beams that emit it."""
         from bigdl_tpu.nn.recurrent import cached_beam_generate
         if self.mode != "lm":
             raise ValueError("generate() requires mode='lm'")
+        if eos_id is None:
+            raise ValueError("generate: pass eos_id (your vocabulary's "
+                             "end-of-sequence token)")
         B, P = prompt.shape
         L = P + max_new_tokens
         if L > self.max_len:

@@ -83,6 +83,30 @@ def main():
     report["ckpt_ok"] = bool(same_batch and same_params
                              and meta["neval"] == 7)
 
+    # ---- sequence parallelism ACROSS the two hosts: ring attention's
+    # K/V rotation rides the cross-process collective backend (the DCN
+    # analogue of the reference's BlockManager fetches)
+    from jax.sharding import Mesh
+    from bigdl_tpu.models.long_context_lm import SeqParallelLM
+    smesh = Mesh(np.asarray(jax.devices()).reshape(4), ("seq",))
+    vocab, B, T = 13, 2, 8                   # 4 seq shards of 2 tokens
+    lm = SeqParallelLM(vocab, d_model=16, num_heads=2, num_layers=1)
+    sp = lm.init(jax.random.PRNGKey(1))
+    toks = np.stack([(np.arange(T) * 3 + i) % vocab for i in range(B)])
+    ytok = np.roll(toks, -1, axis=1)
+    tok_sh = NamedSharding(smesh, P(None, "seq"))
+    # each process contributes its LOCAL half of the sequence dim
+    lo, hi = pid * (T // 2), (pid + 1) * (T // 2)
+    xg = jax.make_array_from_process_local_data(tok_sh, toks[:, lo:hi])
+    yg = jax.make_array_from_process_local_data(tok_sh, ytok[:, lo:hi])
+    sp_loss = None
+    for _ in range(3):
+        loss, grads = lm.loss_and_grads(sp, xg, yg, smesh)
+        sp = jax.tree.map(lambda p, g: p - 0.1 * g, sp, grads)
+        sp_loss = float(loss)
+    report["sp_loss"] = sp_loss
+    report["sp_ok"] = bool(np.isfinite(sp_loss))
+
     print("REPORT " + json.dumps(report), flush=True)
 
 

@@ -60,3 +60,8 @@ def test_two_process_training(tmp_path):
         assert rep["ckpt_ok"], rep
     # both processes ran the same SPMD program → identical final loss
     assert abs(reports[0]["final_loss"] - reports[1]["final_loss"]) < 1e-5
+    # cross-host sequence parallelism: ring attention's ppermute spanned
+    # the two processes and both saw the same loss
+    for rep in reports:
+        assert rep["sp_ok"], rep
+    assert abs(reports[0]["sp_loss"] - reports[1]["sp_loss"]) < 1e-5
