@@ -219,6 +219,15 @@ class Pipeline:
 
     def shard(self, pv, mesh: Mesh):
         spec = NamedSharding(mesh, P(PIPE_AXIS, None))
+        if jax.process_count() > 1:
+            # multi-host: device_put cannot address remote shards — feed
+            # each process's stage rows and assemble the global array
+            # (host processes all hold identical pv from init)
+            local = np.asarray(
+                [d.process_index == jax.process_index()
+                 for d in mesh.devices.reshape(-1)])
+            return {k: jax.make_array_from_process_local_data(
+                spec, np.asarray(v)[local]) for k, v in pv.items()}
         return {k: jax.device_put(v, spec) for k, v in pv.items()}
 
     def stage_params(self, pv, i: int):
@@ -268,6 +277,28 @@ class Pipeline:
         xs = x.reshape((S, M // S, mb) + x.shape[1:])
         return xs, mb
 
+    @staticmethod
+    def _globalize(arr, mesh):
+        """Multi-host: a host array with a stage-major leading dim cannot
+        be device_put onto remote shards — assemble the global array from
+        this process's stage rows (all processes hold identical data)."""
+        if jax.process_count() == 1:
+            return arr
+        spec = NamedSharding(mesh, P(PIPE_AXIS,
+                                     *([None] * (arr.ndim - 1))))
+        local = np.asarray([d.process_index == jax.process_index()
+                            for d in mesh.devices.reshape(-1)])
+        return jax.make_array_from_process_local_data(
+            spec, np.asarray(arr)[local])
+
+    @staticmethod
+    def _row0(arr):
+        """First row of a stage-sharded output — via a locally-addressable
+        shard under multi-host (every row holds the same psum'd value)."""
+        if jax.process_count() > 1:
+            return np.asarray(arr.addressable_shards[0].data)[0]
+        return arr[0]
+
     def _check(self, mb_shape, dtype):
         sd = jax.ShapeDtypeStruct(mb_shape, dtype)
         for i, (stage, pm, sm) in enumerate(
@@ -298,7 +329,8 @@ class Pipeline:
             self._check(xs.shape[2:], x.dtype)
             fn = self._build_apply(xs, x.dtype, mesh, training)
             self._compiled[sig] = fn
-        outs, new_state = fn(pv["flat"], pv["state"], xs, base_key)
+        outs, new_state = fn(pv["flat"], pv["state"],
+                             self._globalize(xs, mesh), base_key)
         out = outs[-1].reshape((x.shape[0],) + xs.shape[3:])
         if training:
             return out, {"flat": pv["flat"], "state": new_state}
@@ -406,10 +438,11 @@ class Pipeline:
             self._check(xs.shape[2:], x.dtype)
             fn = self._build_train(x.dtype, y.dtype, loss_fn, mesh, full)
             self._compiled[sig] = fn
-        loss, grads, new_state, dx, dlp = fn(pv["flat"], pv["state"], xs,
-                                             ys, base_key, lp)
+        loss, grads, new_state, dx, dlp = fn(
+            pv["flat"], pv["state"], self._globalize(xs, mesh),
+            self._globalize(ys, mesh), base_key, lp)
         d_x = (dx[0].reshape(x.shape) if full else None)
-        return (loss[0], grads, d_x, (dlp if full else None),
+        return (self._row0(loss), grads, d_x, (dlp if full else None),
                 {"flat": pv["flat"], "state": new_state})
 
     def _build_train(self, x_dtype, y_dtype, loss_fn, mesh, full=False):

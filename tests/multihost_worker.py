@@ -107,6 +107,25 @@ def main():
     report["sp_loss"] = sp_loss
     report["sp_ok"] = bool(np.isfinite(sp_loss))
 
+    # ---- pipeline parallelism ACROSS the two hosts: 1F1B with stage
+    # weights sharded over the 4-device pipe axis, activations hopping
+    # between processes via ppermute
+    from bigdl_tpu.parallel.pipeline import Pipeline
+    pmesh = Mesh(np.asarray(jax.devices()).reshape(4), ("pipe",))
+    pipe = Pipeline(nn.Linear(6, 6), n_stages=4, n_microbatches=4)
+    pv = pipe.shard(pipe.init(jax.random.PRNGKey(2)), pmesh)
+    xp = np.random.RandomState(2).randn(8, 6).astype(np.float32)
+    yp = np.random.RandomState(3).randn(8, 6).astype(np.float32)
+    pp_loss = None
+    for _ in range(3):
+        loss, grads, pv = pipe.train_step(
+            pv, jnp.asarray(xp), jnp.asarray(yp),
+            lambda h, t: jnp.mean((h - t) ** 2), pmesh)
+        pv = {"flat": pv["flat"] - 0.1 * grads, "state": pv["state"]}
+        pp_loss = float(loss)
+    report["pp_loss"] = pp_loss
+    report["pp_ok"] = bool(np.isfinite(pp_loss))
+
     print("REPORT " + json.dumps(report), flush=True)
 
 

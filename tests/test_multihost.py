@@ -65,3 +65,7 @@ def test_two_process_training(tmp_path):
     for rep in reports:
         assert rep["sp_ok"], rep
     assert abs(reports[0]["sp_loss"] - reports[1]["sp_loss"]) < 1e-5
+    # cross-host 1F1B pipeline: stage hops spanned the processes
+    for rep in reports:
+        assert rep["pp_ok"], rep
+    assert abs(reports[0]["pp_loss"] - reports[1]["pp_loss"]) < 1e-5
