@@ -20,6 +20,7 @@ from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -224,9 +225,21 @@ def expert_parallel_apply(moe: MoE, params, x, mesh: Mesh,
                    in_specs=(p_spec, P(axis_name)),
                    out_specs=(P(axis_name), P()),
                    check_vma=False)
-    sharded_params = {
-        k: jax.device_put(v, NamedSharding(mesh, p_spec[k]))
-        for k, v in params.items()}
-    xs = jax.device_put(x, NamedSharding(
-        mesh, P(axis_name, *([None] * (x.ndim - 1)))))
+
+    def place(v, spec):
+        sh = NamedSharding(mesh, spec)
+        if jax.process_count() > 1 and spec != P():
+            # multi-host: feed this process's rows; device_put cannot
+            # address remote shards (all processes hold identical host
+            # values)
+            local = np.asarray([d.process_index == jax.process_index()
+                                for d in mesh.devices.reshape(-1)])
+            rows = np.asarray(v).reshape(
+                (n, -1) + v.shape[1:])[local].reshape(
+                (-1,) + v.shape[1:])
+            return jax.make_array_from_process_local_data(sh, rows)
+        return jax.device_put(v, sh)
+
+    sharded_params = {k: place(v, p_spec[k]) for k, v in params.items()}
+    xs = place(x, P(axis_name, *([None] * (x.ndim - 1))))
     return fn(sharded_params, xs)

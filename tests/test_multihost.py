@@ -69,3 +69,7 @@ def test_two_process_training(tmp_path):
     for rep in reports:
         assert rep["pp_ok"], rep
     assert abs(reports[0]["pp_loss"] - reports[1]["pp_loss"]) < 1e-5
+    # cross-host expert parallelism: all_to_all queues crossed processes
+    # and reproduced the unsharded MoE exactly on every local shard
+    for rep in reports:
+        assert rep["ep_ok"], rep
