@@ -80,6 +80,32 @@ def create_mesh(devices: Optional[Sequence[jax.Device]] = None, *,
     return Mesh(grid, names)
 
 
+def host_rows_to_global(arr, mesh, axis_name: str):
+    """Place a host array whose LEADING dim shards over `axis_name`
+    (a 1-D mesh axis) — multi-host safe: under one process this is a
+    device_put; across processes each feeds its own rows to
+    `jax.make_array_from_process_local_data` (device_put cannot address
+    remote shards). Every process must hold identical host values.
+    Shared by Pipeline.shard/_globalize and expert_parallel_apply."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    spec = P(axis_name, *([None] * (arr.ndim - 1)))
+    sh = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sh)
+    if mesh.devices.ndim != 1:
+        raise NotImplementedError(
+            "host_rows_to_global assumes a 1-D mesh (the device→row "
+            "mapping below walks mesh.devices in axis order)")
+    n = mesh.shape[axis_name]
+    local = np.asarray([d.process_index == jax.process_index()
+                        for d in mesh.devices.reshape(-1)])
+    arr = np.asarray(arr)
+    rows = arr.reshape((n, -1) + arr.shape[1:])[local].reshape(
+        (-1,) + arr.shape[1:])
+    return jax.make_array_from_process_local_data(sh, rows)
+
+
 class Engine:
     """Process-level runtime singleton (reference: utils/Engine.scala).
 

@@ -226,19 +226,12 @@ def expert_parallel_apply(moe: MoE, params, x, mesh: Mesh,
                    out_specs=(P(axis_name), P()),
                    check_vma=False)
 
+    from bigdl_tpu.parallel.mesh import host_rows_to_global
+
     def place(v, spec):
-        sh = NamedSharding(mesh, spec)
-        if jax.process_count() > 1 and spec != P():
-            # multi-host: feed this process's rows; device_put cannot
-            # address remote shards (all processes hold identical host
-            # values)
-            local = np.asarray([d.process_index == jax.process_index()
-                                for d in mesh.devices.reshape(-1)])
-            rows = np.asarray(v).reshape(
-                (n, -1) + v.shape[1:])[local].reshape(
-                (-1,) + v.shape[1:])
-            return jax.make_array_from_process_local_data(sh, rows)
-        return jax.device_put(v, sh)
+        if spec == P():
+            return jax.device_put(v, NamedSharding(mesh, spec))
+        return host_rows_to_global(np.asarray(v), mesh, axis_name)
 
     sharded_params = {k: place(v, p_spec[k]) for k, v in params.items()}
     xs = place(x, P(axis_name, *([None] * (x.ndim - 1))))

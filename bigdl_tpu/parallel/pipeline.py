@@ -218,17 +218,9 @@ class Pipeline:
         return {"flat": jnp.stack(rows_p), "state": jnp.stack(rows_s)}
 
     def shard(self, pv, mesh: Mesh):
-        spec = NamedSharding(mesh, P(PIPE_AXIS, None))
-        if jax.process_count() > 1:
-            # multi-host: device_put cannot address remote shards — feed
-            # each process's stage rows and assemble the global array
-            # (host processes all hold identical pv from init)
-            local = np.asarray(
-                [d.process_index == jax.process_index()
-                 for d in mesh.devices.reshape(-1)])
-            return {k: jax.make_array_from_process_local_data(
-                spec, np.asarray(v)[local]) for k, v in pv.items()}
-        return {k: jax.device_put(v, spec) for k, v in pv.items()}
+        from bigdl_tpu.parallel.mesh import host_rows_to_global
+        return {k: host_rows_to_global(np.asarray(v), mesh, PIPE_AXIS)
+                for k, v in pv.items()}
 
     def stage_params(self, pv, i: int):
         """Unpack stage i's param tree from the row matrix (host-side)."""
@@ -279,25 +271,12 @@ class Pipeline:
 
     @staticmethod
     def _globalize(arr, mesh):
-        """Multi-host: a host array with a stage-major leading dim cannot
-        be device_put onto remote shards — assemble the global array from
-        this process's stage rows (all processes hold identical data)."""
+        """Multi-host-safe placement of a stage-major host array (see
+        parallel.mesh.host_rows_to_global)."""
         if jax.process_count() == 1:
-            return arr
-        spec = NamedSharding(mesh, P(PIPE_AXIS,
-                                     *([None] * (arr.ndim - 1))))
-        local = np.asarray([d.process_index == jax.process_index()
-                            for d in mesh.devices.reshape(-1)])
-        return jax.make_array_from_process_local_data(
-            spec, np.asarray(arr)[local])
-
-    @staticmethod
-    def _row0(arr):
-        """First row of a stage-sharded output — via a locally-addressable
-        shard under multi-host (every row holds the same psum'd value)."""
-        if jax.process_count() > 1:
-            return np.asarray(arr.addressable_shards[0].data)[0]
-        return arr[0]
+            return arr                     # jit's in_specs place it
+        from bigdl_tpu.parallel.mesh import host_rows_to_global
+        return host_rows_to_global(np.asarray(arr), mesh, PIPE_AXIS)
 
     def _check(self, mb_shape, dtype):
         sd = jax.ShapeDtypeStruct(mb_shape, dtype)
@@ -331,7 +310,7 @@ class Pipeline:
             self._compiled[sig] = fn
         outs, new_state = fn(pv["flat"], pv["state"],
                              self._globalize(xs, mesh), base_key)
-        out = outs[-1].reshape((x.shape[0],) + xs.shape[3:])
+        out = outs.reshape((x.shape[0],) + xs.shape[3:])
         if training:
             return out, {"flat": pv["flat"], "state": new_state}
         return out
@@ -385,13 +364,16 @@ class Pipeline:
             outs0 = jnp.zeros((M,) + h_shape, dtype)
             _, _, srow, outs = lax.fori_loop(
                 0, ticks, tick, (z, z, srow, outs0))
-            return outs[None], srow[None]
+            # only the last stage filled outs — psum broadcasts it so the
+            # result is replicated (and host-readable under multi-host,
+            # where a stage-sharded output's first rows live remotely)
+            return lax.psum(outs, PIPE_AXIS), srow[None]
 
         return jax.jit(shard_map(
             shard_fn, mesh=mesh,
             in_specs=(P(PIPE_AXIS, None), P(PIPE_AXIS, None), P(PIPE_AXIS),
                       P()),
-            out_specs=(P(PIPE_AXIS), P(PIPE_AXIS, None)),
+            out_specs=(P(), P(PIPE_AXIS, None)),
             check_vma=False))
 
     # ------------------------------------------------- 1F1B training step
@@ -441,8 +423,8 @@ class Pipeline:
         loss, grads, new_state, dx, dlp = fn(
             pv["flat"], pv["state"], self._globalize(xs, mesh),
             self._globalize(ys, mesh), base_key, lp)
-        d_x = (dx[0].reshape(x.shape) if full else None)
-        return (self._row0(loss), grads, d_x, (dlp if full else None),
+        d_x = (dx.reshape(x.shape) if full else None)
+        return (loss, grads, d_x, (dlp if full else None),
                 {"flat": pv["flat"], "state": new_state})
 
     def _build_train(self, x_dtype, y_dtype, loss_fn, mesh, full=False):
@@ -557,13 +539,14 @@ class Pipeline:
             dx = lax.psum(dx_buf, PIPE_AXIS) / M
             d_lp = jax.tree.map(lambda g: lax.psum(g, PIPE_AXIS) / M,
                                 lp_acc)
-            return (loss[None], grad_acc[None] / M, srow[None], dx[None],
-                    d_lp)
+            # loss/dx/d_lp are psum'd → uniform across shards → returned
+            # replicated, so they stay host-readable under multi-host
+            return (loss, grad_acc[None] / M, srow[None], dx, d_lp)
 
         return jax.jit(shard_map(
             shard_fn, mesh=mesh,
             in_specs=(P(PIPE_AXIS, None), P(PIPE_AXIS, None), P(PIPE_AXIS),
                       P(PIPE_AXIS), P(), P()),
-            out_specs=(P(PIPE_AXIS), P(PIPE_AXIS, None),
-                       P(PIPE_AXIS, None), P(PIPE_AXIS), P()),
+            out_specs=(P(), P(PIPE_AXIS, None),
+                       P(PIPE_AXIS, None), P(), P()),
             check_vma=False))

@@ -116,11 +116,13 @@ def main():
     pv = pipe.shard(pipe.init(jax.random.PRNGKey(2)), pmesh)
     xp = np.random.RandomState(2).randn(8, 6).astype(np.float32)
     yp = np.random.RandomState(3).randn(8, 6).astype(np.float32)
+    mse = lambda h, t: jnp.mean((h - t) ** 2)  # noqa: E731 — hoisted:
+    # Pipeline._compiled keys on loss_fn identity, a fresh lambda per
+    # iteration would recompile the tick schedule every step
     pp_loss = None
     for _ in range(3):
         loss, grads, pv = pipe.train_step(
-            pv, jnp.asarray(xp), jnp.asarray(yp),
-            lambda h, t: jnp.mean((h - t) ** 2), pmesh)
+            pv, jnp.asarray(xp), jnp.asarray(yp), mse, pmesh)
         pv = {"flat": pv["flat"] - 0.1 * grads, "state": pv["state"]}
         pp_loss = float(loss)
     report["pp_loss"] = pp_loss
