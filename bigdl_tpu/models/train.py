@@ -263,15 +263,18 @@ def train_ptb(args):
             yield MiniBatch(xs[i], ys[i])
 
     ds = IteratorDataSet(epoch)
-    if (args.pipeline_stages and args.pipeline_stages > 1
-            and args.seq_parallel and args.seq_parallel > 1):
-        raise SystemExit("--pipeline-stages and --seq-parallel are "
-                         "mutually exclusive (pick one parallelism for "
-                         "this CLI; compose them via the library API)")
+    chosen = [f for f in ("pipeline_stages", "seq_parallel", "moe_experts")
+              if getattr(args, f, 0) and getattr(args, f) > 1]
+    if len(chosen) > 1:
+        raise SystemExit(f"--{' / --'.join(c.replace('_', '-') for c in chosen)} "
+                         f"are mutually exclusive (pick one parallelism "
+                         f"for this CLI; compose them via the library API)")
     if args.pipeline_stages and args.pipeline_stages > 1:
         return _train_ptb_pipelined(args, d, xs, ys)
     if args.seq_parallel and args.seq_parallel > 1:
         return _train_ptb_seq_parallel(args, d, xs, ys)
+    if args.moe_experts and args.moe_experts > 1:
+        return _train_ptb_moe(args, d, xs, ys)
     if args.model == "transformer":
         model = rnn.build_transformer(d.vocab_size, d_model=args.hidden,
                                       num_heads=4, d_ff=args.hidden * 4,
@@ -290,6 +293,29 @@ def train_ptb(args):
     params, state = _finish(opt, args, model, f"ptb-{args.model}")
     print(f"ptb perplexity ~ {np.exp(opt.state['loss']):.1f}")
     return params, state
+
+
+def _ptb_loop(args, xs, ys, step, tag, summary):
+    """Shared step loop for the custom-parallelism PTB paths.
+    `step(xb, yb, lr) -> (loss, suffix)`; prints every 10 iters."""
+    import jax.numpy as jnp
+    lr = args.learning_rate or 1e-3
+    max_iter = args.max_iter or (xs.shape[0] * (args.max_epoch or 1))
+    first = last = None
+    it = 0
+    while it < max_iter:
+        for i in range(xs.shape[0]):
+            loss, suffix = step(jnp.asarray(xs[i]), jnp.asarray(ys[i]), lr)
+            first = loss if first is None else first
+            last = loss
+            it += 1
+            if it % 10 == 0 or it >= max_iter:
+                print(f"{tag} iter {it} loss {loss:.4f} "
+                      f"(ppl ~ {np.exp(loss):.1f}{suffix})")
+            if it >= max_iter:
+                break
+    print(f"{summary}: loss {first:.3f} -> {last:.3f}, "
+          f"perplexity ~ {np.exp(last):.1f}")
 
 
 def _train_ptb_pipelined(args, d, xs, ys):
@@ -319,27 +345,16 @@ def _train_ptb_pipelined(args, d, xs, ys):
                      n_microbatches=micro)
     rng = jax.random.PRNGKey(0)
     st = lm.init(rng, mesh)
-    lr = args.learning_rate or 1e-3
-    max_iter = args.max_iter or (xs.shape[0] * (args.max_epoch or 1))
-    first = last = None
-    it = 0
-    while it < max_iter:
-        for i in range(xs.shape[0]):
-            rng, sub = jax.random.split(rng)
-            st, loss = lm.train_step(st, jnp.asarray(xs[i]),
-                                     jnp.asarray(ys[i]), mesh, lr=lr,
-                                     rng=sub)
-            first = loss if first is None else first
-            last = loss
-            it += 1
-            if it % 10 == 0 or it == max_iter:
-                print(f"pipelined-ptb iter {it} loss {loss:.4f} "
-                      f"(ppl ~ {np.exp(loss):.1f})")
-            if it >= max_iter:
-                break
-    print(f"ptb pipelined x{S}: loss {first:.3f} -> {last:.3f}, "
-          f"perplexity ~ {np.exp(last):.1f}")
-    return st, None
+    holder = {"st": st, "rng": rng}
+
+    def step(xb, yb, lr):
+        holder["rng"], sub = jax.random.split(holder["rng"])
+        holder["st"], loss = lm.train_step(holder["st"], xb, yb, mesh,
+                                           lr=lr, rng=sub)
+        return loss, ""
+    _ptb_loop(args, xs, ys, step, "pipelined-ptb",
+              f"ptb pipelined x{S}")
+    return holder["st"], None
 
 
 def _train_ptb_seq_parallel(args, d, xs, ys):
@@ -366,25 +381,49 @@ def _train_ptb_seq_parallel(args, d, xs, ys):
     lm = SeqParallelLM(d.vocab_size, d_model=args.hidden, num_heads=4,
                       num_layers=args.layers)
     params = lm.init(jax.random.PRNGKey(0))
-    lr = args.learning_rate or 1e-3
-    max_iter = args.max_iter or (xs.shape[0] * (args.max_epoch or 1))
-    first = last = None
-    it = 0
-    while it < max_iter:
-        for i in range(xs.shape[0]):
-            params, loss = lm.train_step(params, jnp.asarray(xs[i]),
-                                         jnp.asarray(ys[i]), mesh, lr=lr)
-            first = loss if first is None else first
-            last = loss
-            it += 1
-            if it % 10 == 0 or it >= max_iter:
-                print(f"seq-parallel-ptb iter {it} loss {loss:.4f} "
-                      f"(ppl ~ {np.exp(loss):.1f})")
-            if it >= max_iter:
-                break
-    print(f"ptb seq-parallel x{S} (ring attention): loss {first:.3f} -> "
-          f"{last:.3f}, perplexity ~ {np.exp(last):.1f}")
-    return params, None
+    holder = {"p": params}
+
+    def step(xb, yb, lr):
+        holder["p"], loss = lm.train_step(holder["p"], xb, yb, mesh, lr=lr)
+        return loss, ""
+    _ptb_loop(args, xs, ys, step, "seq-parallel-ptb",
+              f"ptb seq-parallel x{S} (ring attention)")
+    return holder["p"], None
+
+
+def _train_ptb_moe(args, d, xs, ys):
+    """PTB transformer with Switch-style MoE FFNs, experts (and the
+    batch) sharded over an 'expert' mesh axis (models/moe_lm.py)."""
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu.models.moe_lm import MoELM
+    from bigdl_tpu.parallel.mesh import create_mesh
+
+    E = args.moe_experts
+    if args.model != "transformer":
+        raise SystemExit("--moe-experts needs --model transformer")
+    if len(jax.devices()) < E:
+        raise SystemExit(f"--moe-experts {E} needs {E} devices, have "
+                         f"{len(jax.devices())} (on CPU set XLA_FLAGS="
+                         f"--xla_force_host_platform_device_count={E})")
+    bs = args.batch_size or 20
+    if bs % E:
+        raise SystemExit(f"--batch-size {bs} must divide by "
+                         f"--moe-experts {E} (batch rides the expert "
+                         f"axis)")
+    mesh = create_mesh(jax.devices()[:E], expert=E, drop_trivial_axes=True)
+    lm = MoELM(d.vocab_size, d_model=args.hidden, num_heads=4,
+               num_layers=args.layers, n_experts=E)
+    params = lm.init(jax.random.PRNGKey(0))
+    holder = {"p": params}
+
+    def step(xb, yb, lr):
+        holder["p"], ce, aux = lm.train_step(holder["p"], xb, yb, mesh,
+                                             lr=lr)
+        return ce, f", lb {aux['load_balance']:.2f}"
+    _ptb_loop(args, xs, ys, step, "moe-ptb",
+              f"ptb moe x{E} experts")
+    return holder["p"], None
 
 
 def main(argv=None):
@@ -424,6 +463,9 @@ def main(argv=None):
     p.add_argument("--seq-parallel", type=int, default=0,
                    help="shard the sequence over a 'seq' mesh axis of "
                         "this size with ring attention (long-context)")
+    p.add_argument("--moe-experts", type=int, default=0,
+                   help="Switch-style MoE FFNs with this many experts, "
+                        "expert-parallel over an 'expert' mesh axis")
 
     args = ap.parse_args(argv)
     fn = {"lenet": train_lenet, "resnet": train_resnet,
