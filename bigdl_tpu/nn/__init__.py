@@ -50,7 +50,8 @@ from bigdl_tpu.nn.recurrent import (Cell, RnnCell, LSTM, LSTMPeephole, GRU,
                                     BiRecurrent, RecurrentDecoder,
                                     BinaryTreeLSTM, TreeLSTM,
                                     TimeDistributed, SequenceBeamSearch,
-                                    beam_search, tile_beam)
+                                    beam_search, cached_beam_generate,
+                                    tile_beam)
 from bigdl_tpu.nn.criterion import (ClassNLLCriterion, CrossEntropyCriterion,
                                     MSECriterion, AbsCriterion, SmoothL1Criterion,
                                     SmoothL1CriterionWithWeights, BCECriterion,
