@@ -149,10 +149,20 @@ class SeqParallelLM:
         return self._fn(mesh, "step")(params, xt, yt)
 
     def train_step(self, params, x_tokens, y_tokens, mesh: Mesh,
-                   lr: float = 1e-3):
+                   lr: float = 1e-3, method=None, slots=None):
+        """One step. Default plain SGD at `lr`; pass any
+        `optim.OptimMethod` (Adam, OptaxMethod, ...) with `slots` from
+        `optim.method.init_update_slots(method, params)` — the method's
+        own learning_rate/schedule then drive the rate and the step
+        counter advances inside the slots. Returns (params, loss) or
+        (params, loss, slots)."""
+        from bigdl_tpu.optim.method import apply_update
         loss, grads = self.loss_and_grads(params, x_tokens, y_tokens, mesh)
-        return (jax.tree.map(lambda p, g: p - lr * g, params, grads),
-                float(loss))
+        new_p, new_slots = apply_update(method, params, grads, slots,
+                                        sgd_lr=lr)
+        if method is None:
+            return new_p, float(loss)
+        return new_p, float(loss), new_slots
 
     def apply(self, params, tokens, mesh: Mesh):
         sh = NamedSharding(mesh, P(None, self.seq_axis))

@@ -191,11 +191,22 @@ class MoELM:
                                    jax.device_put(y_tokens, sh))
 
     def train_step(self, params, x_tokens, y_tokens, mesh: Mesh,
-                   lr: float = 1e-3):
+                   lr: float = 1e-3, method=None, slots=None):
+        """One step. Default plain SGD at `lr`; pass any
+        `optim.OptimMethod` with `slots` from
+        `optim.method.init_update_slots(method, params)` (expert-sharded
+        leaves' slots shard alongside them via sharding propagation; the
+        method's own lr/schedule and step counter apply). Returns
+        (params, ce, aux) or (params, ce, aux, slots)."""
+        from bigdl_tpu.optim.method import apply_update
         loss, ce, aux, grads = self.loss_and_grads(params, x_tokens,
                                                    y_tokens, mesh)
-        new_p = jax.tree.map(lambda p, g: p - lr * g, params, grads)
-        return new_p, float(ce), {k: float(v) for k, v in aux.items()}
+        aux_f = {k: float(v) for k, v in aux.items()}
+        new_p, new_slots = apply_update(method, params, grads, slots,
+                                        sgd_lr=lr)
+        if method is None:
+            return new_p, float(ce), aux_f
+        return new_p, float(ce), aux_f, new_slots
 
     def dense_objective(self, params, x_tokens, y_tokens):
         """Single-device reference (same math, no mesh) for tests."""

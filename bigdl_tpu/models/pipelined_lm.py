@@ -136,8 +136,15 @@ class PipelinedLM:
 
     # -------------------------------------------------------------- steps
     def train_step(self, st, x_tokens, y_tokens, mesh: Mesh,
-                   lr: float = 1e-3, rng=None):
-        """One end-to-end 1F1B SGD step; returns (new_state, loss)."""
+                   lr: float = 1e-3, rng=None, method=None, slots=None):
+        """One end-to-end 1F1B step; returns (new_state, loss) with the
+        default plain SGD at `lr`, or (new_state, loss, slots) when
+        `method` is an `optim.OptimMethod` — init `slots` via
+        `optim.method.init_update_slots(method, {"emb": ..., "ln": ...,
+        "flat": st["pv"]["flat"]})`; the method's own lr/schedule and
+        step counter apply, and stage-sharded slot rows follow the flat
+        rows via sharding propagation."""
+        from bigdl_tpu.optim.method import apply_update
         if not hasattr(self, "_loss"):
             self._loss = self._loss_fn()
         emb = st["emb"]
@@ -148,11 +155,15 @@ class PipelinedLM:
             loss_params=lp)
         (d_emb_in,) = pull(d_x)
         d_emb = d_emb_in + d_lp["emb"]               # tied weights
-        new_pv = {"flat": pv["flat"] - lr * g_stage, "state": pv["state"]}
-        return ({"emb": emb - lr * d_emb,
-                 "ln": jax.tree.map(lambda p, g: p - lr * g,
-                                    st["ln"], d_lp["ln"]),
-                 "pv": new_pv}, float(loss))
+        p_tree = {"emb": emb, "ln": st["ln"], "flat": pv["flat"]}
+        g_tree = {"emb": d_emb, "ln": d_lp["ln"], "flat": g_stage}
+        new_p, new_slots = apply_update(method, p_tree, g_tree, slots,
+                                        sgd_lr=lr)
+        new_st = {"emb": new_p["emb"], "ln": new_p["ln"],
+                  "pv": {"flat": new_p["flat"], "state": pv["state"]}}
+        if method is None:
+            return new_st, float(loss)
+        return new_st, float(loss), new_slots
 
     def apply(self, st, tokens, mesh: Mesh):
         """(B, T) tokens → (B, T, vocab) logits."""

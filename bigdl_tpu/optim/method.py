@@ -506,3 +506,30 @@ class OptaxMethod(OptimMethod):
         import jax as _jax
         new_params = _jax.tree.map(lambda p, u: p + u, params, updates)
         return new_params, new_slots
+
+
+def init_update_slots(method: OptimMethod, params):
+    """Slots for `apply_update`: the method's own slot tree plus the step
+    counter (so callers cannot forget to advance it — Adam-family bias
+    correction frozen at t=0 silently mis-scales every update)."""
+    import jax.numpy as _jnp
+    return (method.init_slots(params), _jnp.int32(0))
+
+
+def apply_update(method, params, grads, slots, sgd_lr: float = 1e-3):
+    """One optimizer update outside the trainer facades (the parallel zoo
+    models' step loops). `method=None` → plain SGD at `sgd_lr`.
+    Otherwise the METHOD's configured learning_rate + schedule drive the
+    rate (matching the Optimizer facade's current_lr contract) and the
+    step counter advances inside `slots` (from `init_update_slots`).
+    Returns (new_params, new_slots)."""
+    import jax as _jax
+    import jax.numpy as _jnp
+    if method is None:
+        return (_jax.tree.map(lambda p, g: p - sgd_lr * g, params, grads),
+                slots)
+    inner, t = slots
+    lr = method.current_lr({"neval": int(t), "epoch": 0})
+    new_p, new_inner = method.update(params, grads, inner,
+                                     _jnp.float32(lr), t)
+    return new_p, (new_inner, t + 1)
