@@ -224,10 +224,9 @@ def _b_conv2d(cfg, shapes):
     use_bias = cfg.get("use_bias", True)
     pad = -1 if same else 0
     if (dh, dw) != (1, 1):
-        if groups != 1:
-            raise NotImplementedError("Conv2D: dilated grouped conv")
         m = nn.SpatialDilatedConvolution(cin, filters, kw, kh, sw, sh,
-                                         pad, pad, dw, dh, bias=use_bias)
+                                         pad, pad, dw, dh, bias=use_bias,
+                                         n_group=groups)
         ke_h, ke_w = (kh - 1) * dh + 1, (kw - 1) * dw + 1
     else:
         m = nn.SpatialConvolution(cin, filters, kw, kh, sw, sh, pad, pad,
@@ -385,10 +384,9 @@ def _b_maxpool1d(cfg, shapes):
     k = k[0] if isinstance(k, (list, tuple)) else k
     s = cfg.get("strides") or k
     s = s[0] if isinstance(s, (list, tuple)) else s
-    if cfg.get("padding", "valid") == "same":
-        raise NotImplementedError("MaxPooling1D padding='same'")
-    return (nn.TemporalMaxPooling(k, s), (b_, _conv_out(t, k, s, False), c),
-            _NO_W)
+    same = cfg.get("padding", "valid") == "same"
+    return (nn.TemporalMaxPooling(k, s, pad_w=-1 if same else 0),
+            (b_, _conv_out(t, k, s, same), c), _NO_W)
 
 
 def _b_batchnorm(cfg, shapes):
@@ -573,17 +571,25 @@ def _b_elu_layer(cfg, shapes):
 
 
 def _b_prelu(cfg, shapes):
-    shared = cfg.get("shared_axes") or []
+    shared = [int(a) for a in (cfg.get("shared_axes") or [])]
     rank = len(shapes[0])
-    if shared and sorted(shared) != list(range(1, rank - 1)):
-        raise NotImplementedError("PReLU with partial shared_axes")
-    n = shapes[0][-1] if shared or rank == 2 else None
-    if n is None and rank > 2:
-        raise NotImplementedError("PReLU with full alpha map — use "
-                                  "shared_axes over spatial dims")
-    m = nn.PReLU(n_output_plane=n)
+    if (rank == 2 and not shared) or \
+            (shared and sorted(shared) == list(range(1, rank - 1))):
+        # per-feature / fully-spatially-shared → per-channel slope vector
+        m = nn.PReLU(n_output_plane=shapes[0][-1])
+        return m, shapes[0], lambda wts: (
+            {"weight": np.asarray(wts[0]).reshape(-1)}, {})
+    # partial shared_axes or full alpha map: keras stores alpha with the
+    # shared axes collapsed to 1 — keep exactly that broadcastable shape
+    alpha_shape = tuple(1 if (i + 1) in shared else dim
+                        for i, dim in enumerate(shapes[0][1:]))
+    if any(d is None for d in alpha_shape):
+        raise NotImplementedError(
+            "PReLU alpha over a dynamic (None) axis — declare the input "
+            "shape or share that axis")
+    m = nn.PReLU(alpha_shape=alpha_shape)
     return m, shapes[0], lambda wts: (
-        {"weight": np.asarray(wts[0]).reshape(-1)}, {})
+        {"weight": np.asarray(wts[0]).reshape(alpha_shape)}, {})
 
 
 def _b_softmax_layer(cfg, shapes):
@@ -642,11 +648,19 @@ def _b_maxoutdense(cfg, shapes):
 def _b_srelu(cfg, shapes):
     """(reference: converter.py convert_srelu — weights
     [t_left, a_left, t_right, a_right])."""
-    shared = cfg.get("shared_axes") or []
+    shared = [int(a) for a in (cfg.get("shared_axes") or [])]
     rank = len(shapes[0])
     if shared and sorted(shared) != list(range(1, rank - 1)):
-        raise NotImplementedError("SReLU with partial shared_axes")
-    shape = (shapes[0][-1],) if shared or rank == 2 else shapes[0][1:]
+        # partial sharing: keras stores params with shared axes as 1 —
+        # SReLU broadcasts any such shape natively
+        shape = tuple(1 if (i + 1) in shared else dim
+                      for i, dim in enumerate(shapes[0][1:]))
+        if any(d is None for d in shape):
+            raise NotImplementedError(
+                "SReLU params over a dynamic (None) axis — declare the "
+                "input shape or share that axis")
+    else:
+        shape = (shapes[0][-1],) if shared or rank == 2 else shapes[0][1:]
     m = nn.SReLU(shape)
     def adapter(wts):
         tl = np.asarray(wts[0]).reshape(shape)
@@ -737,12 +751,13 @@ def _b_pool3d(cls):
         kd, kh, kw = cfg.get("pool_size", (2, 2, 2))
         st = cfg.get("strides") or (kd, kh, kw)
         sd, sh, sw = st
-        if cfg.get("padding", "valid") == "same":
-            raise NotImplementedError(f"{cls}Pooling3D: SAME padding")
+        same = cfg.get("padding", "valid") == "same"
+        p = -1 if same else 0
         m = (nn.VolumetricMaxPooling if cls == "max"
-             else nn.VolumetricAveragePooling)(kd, kw, kh, sd, sw, sh)
-        out = (b_, (d - kd) // sd + 1, (h - kh) // sh + 1,
-               (w - kw) // sw + 1, c)
+             else nn.VolumetricAveragePooling)(kd, kw, kh, sd, sw, sh,
+                                               p, p, p)
+        out = (b_, _conv_out(d, kd, sd, same), _conv_out(h, kh, sh, same),
+               _conv_out(w, kw, sw, same), c)
         return m, out, _NO_W
     return build
 
@@ -753,10 +768,9 @@ def _b_avgpool1d(cfg, shapes):
     k = k[0] if isinstance(k, (list, tuple)) else k
     s = cfg.get("strides") or k
     s = s[0] if isinstance(s, (list, tuple)) else s
-    if cfg.get("padding", "valid") == "same":
-        raise NotImplementedError("AveragePooling1D: SAME padding")
-    ot = None if t is None else (t - k) // s + 1
-    return nn.TemporalAveragePooling(k, s), (b_, ot, c), _NO_W
+    same = cfg.get("padding", "valid") == "same"
+    return (nn.TemporalAveragePooling(k, s, pad_w=-1 if same else 0),
+            (b_, _conv_out(t, k, s, same), c), _NO_W)
 
 
 class _GlobalPool3D(Module):
@@ -829,20 +843,20 @@ def _b_conv3d(cfg, shapes):
     b_, d, h, w, cin = shapes[0]
     kd, kh, kw = cfg["kernel_size"]
     sd, sh, sw = cfg.get("strides", (1, 1, 1))
-    if cfg.get("padding", "valid") == "same":
-        raise NotImplementedError("Conv3D: SAME padding (pad explicitly)")
+    same = cfg.get("padding", "valid") == "same"
+    p = -1 if same else 0
     filters = cfg["filters"]
     use_bias = cfg.get("use_bias", True)
     m = nn.VolumetricConvolution(cin, filters, kd, kw, kh, sd, sw, sh,
-                                 bias=use_bias)
+                                 p, p, p, bias=use_bias)
 
     def adapter(wts):
         p = {"weight": wts[0]}
         if len(wts) > 1:
             p["bias"] = wts[1]
         return p, {}
-    out = (b_, (d - kd) // sd + 1, (h - kh) // sh + 1,
-           (w - kw) // sw + 1, filters)
+    out = (b_, _conv_out(d, kd, sd, same), _conv_out(h, kh, sh, same),
+           _conv_out(w, kw, sw, same), filters)
     m, adapter = _maybe_act(m, cfg, adapter)
     return m, out, adapter
 
@@ -888,23 +902,50 @@ def _b_convlstm2d(cfg, shapes):
         k = k[0]
     st = cfg.get("strides", 1)
     st = st if isinstance(st, int) else st[0] if len(set(st)) == 1 else None
-    if st != 1:
-        raise NotImplementedError("ConvLSTM2D: strides != 1")
+    if st is None:
+        raise NotImplementedError("ConvLSTM2D: non-square strides")
     if cfg.get("padding", "same") != "same":
         raise NotImplementedError(
             "ConvLSTM2D: only SAME padding (the cell keeps spatial dims)")
     act = cfg.get("activation", "tanh")
     if act not in (None, "tanh"):
         raise NotImplementedError(f"ConvLSTM2D: activation {act!r}")
+    # keras defaults recurrent_activation to hard_sigmoid — honor it
+    # exactly (the cell supports both) rather than approximating
+    rec_act = cfg.get("recurrent_activation", "hard_sigmoid")
+    if rec_act not in ("sigmoid", "hard_sigmoid"):
+        raise NotImplementedError(
+            f"ConvLSTM2D: recurrent_activation {rec_act!r}")
     filters = cfg["filters"]
+    # strides downsample the per-step input conv (SAME/ceil); the
+    # recurrent conv runs at the downsampled hidden resolution
+    oh = None if h is None else -(-h // st)
+    ow = None if w is None else -(-w // st)
+    if st != 1 and (oh is None or ow is None):
+        raise NotImplementedError(
+            "ConvLSTM2D with strides needs static spatial dims")
     # keras ConvLSTM2D has no peepholes — default off; the reference's
     # BigDL-flavored peephole variant stays available via the flag
-    cell = nn.ConvLSTMPeephole(cin, filters, k, (h, w),
-                               peephole=cfg.get("peephole", False))
+    cell = nn.ConvLSTMPeephole(cin, filters, k, (oh, ow),
+                               peephole=cfg.get("peephole", False),
+                               stride=st, rec_act=rec_act)
     ret_seq = cfg.get("return_sequences", False)
     m = nn.Recurrent(cell, return_sequences=ret_seq)
-    out = (b_, t, h, w, filters) if ret_seq else (b_, h, w, filters)
-    return m, out, _reject_weights("ConvLSTM2D")
+    out = (b_, t, oh, ow, filters) if ret_seq else (b_, oh, ow, filters)
+
+    def adapter(wts):
+        # keras weights: kernel (k,k,cin,4f), recurrent (k,k,f,4f),
+        # bias (4f,); keras gate order i,f,c,o == this cell's i,f,g,o
+        if not wts:
+            return {}, {}
+        p = {"w_i": np.asarray(wts[0]), "w_h": np.asarray(wts[1])}
+        p["bias"] = (np.asarray(wts[2]).reshape(-1) if len(wts) > 2
+                     else np.zeros(4 * filters, np.float32))
+        if cfg.get("peephole", False):
+            for g in ("peep_i", "peep_f", "peep_o"):
+                p[g] = np.zeros((oh, ow, filters), np.float32)
+        return {"cell": p}, {"cell": {}}
+    return m, out, adapter
 
 
 _BUILDERS: Dict[str, Callable] = {

@@ -134,15 +134,23 @@ class LeakyReLU(Module):
 
 class PReLU(Module):
     """Learned per-channel slope (reference: nn/PReLU.scala).
-    `n_output_plane`=0 → one shared slope."""
+    `n_output_plane`=0 → one shared slope. `alpha_shape` overrides with an
+    arbitrary broadcastable slope shape (keras PReLU with partial
+    shared_axes — e.g. share H only on NHWC input → (1, W, C))."""
 
-    def __init__(self, n_output_plane: int = 0, name: Optional[str] = None):
+    alpha_shape = None    # class default: pickles from before the option
+
+    def __init__(self, n_output_plane: int = 0, alpha_shape=None,
+                 name: Optional[str] = None):
         super().__init__(name=name)
         self.nout = n_output_plane
+        self.alpha_shape = None if alpha_shape is None else \
+            tuple(alpha_shape)
 
     def param_specs(self):
-        n = max(1, self.nout)
-        return {"weight": ParamSpec((n,), initializers.const(0.25))}
+        shape = self.alpha_shape if self.alpha_shape is not None \
+            else (max(1, self.nout),)
+        return {"weight": ParamSpec(shape, initializers.const(0.25))}
 
     def forward(self, params, x, **_):
         w = params["weight"]

@@ -75,21 +75,26 @@ class SpatialConvolution(Module):
 
 
 class SpatialDilatedConvolution(SpatialConvolution):
-    """Atrous conv (reference: nn/SpatialDilatedConvolution.scala)."""
+    """Atrous conv (reference: nn/SpatialDilatedConvolution.scala).
+    `n_group` goes beyond the reference (it has no grouped dilated conv)
+    to cover keras Conv2D's dilation×groups combination — XLA takes
+    rhs_dilation and feature_group_count together natively."""
 
     def __init__(self, n_input_plane, n_output_plane, kernel_w, kernel_h,
                  stride_w=1, stride_h=1, pad_w=0, pad_h=0,
                  dilation_w: int = 1, dilation_h: int = 1, bias: bool = True,
-                 name: Optional[str] = None):
+                 n_group: int = 1, name: Optional[str] = None):
         super().__init__(n_input_plane, n_output_plane, kernel_w, kernel_h,
-                         stride_w, stride_h, pad_w, pad_h, 1, bias, name=name)
+                         stride_w, stride_h, pad_w, pad_h, n_group, bias,
+                         name=name)
         self.dw, self.dh = dilation_w, dilation_h
 
     def forward(self, params, x, **_):
         y = lax.conv_general_dilated(
             x, params["weight"], window_strides=(self.sh, self.sw),
             padding=_same_or_pad(self.ph, self.pw),
-            rhs_dilation=(self.dh, self.dw), dimension_numbers=_DN_2D)
+            rhs_dilation=(self.dh, self.dw), dimension_numbers=_DN_2D,
+            feature_group_count=self.groups)
         if self.bias:
             y = y + params["bias"]
         return y
@@ -214,9 +219,10 @@ class VolumetricConvolution(Module):
         return specs
 
     def forward(self, params, x, **_):
+        pad = "SAME" if -1 in self.p else [(p, p) for p in self.p]
         y = lax.conv_general_dilated(
             x, params["weight"], window_strides=self.s,
-            padding=[(p, p) for p in self.p],
+            padding=pad,
             dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
         if self.bias:
             y = y + params["bias"]

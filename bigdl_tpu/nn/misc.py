@@ -71,28 +71,37 @@ class LogSigmoid(Module):
         return jax.nn.log_sigmoid(x)
 
 
+from functools import partial as _partial
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _grad_reverse(x, lam):
+    return x
+
+
+def _grad_reverse_fwd(x, lam):
+    return x, None
+
+
+def _grad_reverse_bwd(lam, _, g):
+    return (-lam * g,)
+
+
+_grad_reverse.defvjp(_grad_reverse_fwd, _grad_reverse_bwd)
+
+
 class GradientReversal(Module):
     """Identity forward, -λ·grad backward (reference:
-    nn/GradientReversal.scala — domain-adversarial training)."""
+    nn/GradientReversal.scala — domain-adversarial training). The
+    custom_vjp lives at module level (λ as a nondiff arg) so instances
+    pickle through the durable model format."""
 
     def __init__(self, lambda_: float = 1.0, name: Optional[str] = None):
         super().__init__(name=name)
         self.l = lambda_
 
-        @jax.custom_vjp
-        def rev(x):
-            return x
-
-        def fwd(x):
-            return x, None
-
-        def bwd(_, g):
-            return (-self.l * g,)
-        rev.defvjp(fwd, bwd)
-        self._rev = rev
-
     def forward(self, params, x, **_):
-        return self._rev(x)
+        return _grad_reverse(x, self.l)
 
 
 # ---------------------------------------------------- penalties/regularizers

@@ -117,31 +117,49 @@ class SpatialAveragePooling(Module):
 
 
 class TemporalMaxPooling(Module):
-    """1D max pool over (N, T, C) (reference: nn/TemporalMaxPooling.scala)."""
+    """1D max pool over (N, T, C) (reference: nn/TemporalMaxPooling.scala).
+    `pad_w=-1` → SAME (keras Pooling1D padding='same')."""
+
+    pw = 0          # class default: pickles from before the pad option
 
     def __init__(self, k_w: int, d_w: Optional[int] = None,
-                 name: Optional[str] = None):
+                 pad_w: int = 0, name: Optional[str] = None):
         super().__init__(name=name)
         self.kw, self.dw = k_w, d_w or k_w
+        self.pw = pad_w
 
     def forward(self, params, x, **_):
+        pad = "SAME" if self.pw == -1 else \
+            [(0, 0), (self.pw, self.pw), (0, 0)]
         return lax.reduce_window(x, -jnp.inf, lax.max, (1, self.kw, 1),
-                                 (1, self.dw, 1), "VALID")
+                                 (1, self.dw, 1), pad)
 
 
 class TemporalAveragePooling(Module):
     """1D average pool over (N, T, C) — the keras AveragePooling1D
     counterpart of TemporalMaxPooling (reference: nn/keras/Pooling1D.scala
-    average branch)."""
+    average branch). `pad_w=-1` → SAME with the keras/TF divisor (only
+    valid elements counted)."""
+
+    pw = 0
 
     def __init__(self, k_w: int, d_w: Optional[int] = None,
-                 name: Optional[str] = None):
+                 pad_w: int = 0, name: Optional[str] = None):
         super().__init__(name=name)
         self.kw, self.dw = k_w, d_w or k_w
+        self.pw = pad_w
 
     def forward(self, params, x, **_):
+        if self.pw == -1:
+            s = lax.reduce_window(x, 0.0, lax.add, (1, self.kw, 1),
+                                  (1, self.dw, 1), "SAME")
+            counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add,
+                                       (1, self.kw, 1), (1, self.dw, 1),
+                                       "SAME")
+            return s / jnp.maximum(counts, 1.0)
+        pad = [(0, 0), (self.pw, self.pw), (0, 0)]
         s = lax.reduce_window(x, 0.0, lax.add, (1, self.kw, 1),
-                              (1, self.dw, 1), "VALID")
+                              (1, self.dw, 1), pad)
         return s / self.kw
 
 
@@ -157,7 +175,8 @@ class VolumetricMaxPooling(Module):
         self.p = (pad_t, pad_h, pad_w)
 
     def forward(self, params, x, **_):
-        pad = [(0, 0)] + [(p, p) for p in self.p] + [(0, 0)]
+        pad = "SAME" if -1 in self.p else \
+            [(0, 0)] + [(p, p) for p in self.p] + [(0, 0)]
         return lax.reduce_window(x, -jnp.inf, lax.max, (1,) + self.k + (1,),
                                  (1,) + self.s + (1,), pad)
 
@@ -213,6 +232,12 @@ class VolumetricAveragePooling(Module):
     def forward(self, params, x, **_):
         window = (1,) + self.k + (1,)
         strides = (1,) + self.s + (1,)
+        if -1 in self.p:        # SAME: keras/TF divisor (valid cells only)
+            summed = lax.reduce_window(x, 0.0, lax.add, window, strides,
+                                       "SAME")
+            counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add,
+                                       window, strides, "SAME")
+            return summed / jnp.maximum(counts, 1.0)
         pad = [(0, 0)] + [(p, p) for p in self.p] + [(0, 0)]
         summed = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
         if self.include_pad:

@@ -212,13 +212,32 @@ class ConvLSTMPeephole(Cell):
     peepholes). `spatial` fixes the map size so hidden state shapes are
     static for XLA."""
 
+    stride = 1            # class defaults: pickles from before the options
+    rec_act = "sigmoid"
+
     def __init__(self, input_channels: int, hidden_channels: int,
                  kernel: int, spatial: Tuple[int, int], peephole: bool = True,
-                 name=None):
+                 stride: int = 1, rec_act: str = "sigmoid", name=None):
         super().__init__(name)
         self.input_channels, self.hidden_channels = input_channels, hidden_channels
         self.kernel, self.spatial, self.peephole = kernel, spatial, peephole
+        # `spatial` is the HIDDEN map size; with stride>1 the input conv
+        # downsamples each step's (stride*H, stride*W)-ish input to it
+        # (keras ConvLSTM2D strides semantics: SAME pad, ceil division)
+        self.stride = stride
+        # gate nonlinearity: 'sigmoid' (reference cell) or 'hard_sigmoid'
+        # (keras ConvLSTM2D default recurrent_activation)
+        if rec_act not in ("sigmoid", "hard_sigmoid"):
+            raise ValueError(f"rec_act must be sigmoid|hard_sigmoid, "
+                             f"got {rec_act!r}")
+        self.rec_act = rec_act
         self.hidden_size = hidden_channels
+
+    def _gate(self, z):
+        if getattr(self, "rec_act", "sigmoid") == "hard_sigmoid":
+            # keras hard_sigmoid: clip(0.2x + 0.5, 0, 1)
+            return jnp.clip(0.2 * z + 0.5, 0.0, 1.0)
+        return jax.nn.sigmoid(z)
 
     def param_specs(self):
         k, ci, ch = self.kernel, self.input_channels, self.hidden_channels
@@ -241,25 +260,27 @@ class ConvLSTMPeephole(Cell):
         shape = (batch, h, w, self.hidden_channels)
         return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
 
-    def _conv(self, x, w):
+    def _conv(self, x, w, stride: int = 1):
         return jax.lax.conv_general_dilated(
-            x, w, window_strides=(1, 1), padding="SAME",
+            x, w, window_strides=(stride, stride), padding="SAME",
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
     def step(self, params, hidden, x):
         h_prev, c_prev = hidden
-        gates = (self._conv(x, params["w_i"]) + self._conv(h_prev, params["w_h"])
+        s = getattr(self, "stride", 1)
+        gates = (self._conv(x, params["w_i"], s)
+                 + self._conv(h_prev, params["w_h"])
                  + params["bias"])
         i, f, g, o = jnp.split(gates, 4, axis=-1)
         if self.peephole:
             i = i + params["peep_i"] * c_prev
             f = f + params["peep_f"] * c_prev
-        i, f = jax.nn.sigmoid(i), jax.nn.sigmoid(f)
+        i, f = self._gate(i), self._gate(f)
         g = jnp.tanh(g)
         c = f * c_prev + i * g
         if self.peephole:
             o = o + params["peep_o"] * c
-        o = jax.nn.sigmoid(o)
+        o = self._gate(o)
         h = o * jnp.tanh(c)
         return h, (h, c)
 

@@ -236,7 +236,14 @@ class Optimizer:
         meta, so optimize() fast-forwards the epoch's iterator instead of
         replaying finished iterations (reference:
         optim/DistriOptimizer.scala:124-134,466-474
-        `recordsProcessedThisEpoch` resume)."""
+        `recordsProcessedThisEpoch` resume).
+
+        Exactness caveat: the cursor is a RECORD COUNT. For single-threaded
+        unshuffled streams the skipped prefix is exactly the records the
+        crashed run trained on; under shuffle or multi-worker decode the
+        stream order differs run-to-run, so the resumed epoch may re-see
+        some trained records and miss others (same contract as
+        ShardedDataset.fast_forward_batches — see its docstring)."""
         snap = ckpt.latest_checkpoint(path)
         if snap is None:
             return False
@@ -327,9 +334,21 @@ class Optimizer:
                     self.dataset.fast_forward_batches(skip)
                     skip = 0
             epoch_iter = iter(self.dataset)
-            for _ in range(skip):
-                if next(epoch_iter, None) is None:
-                    break
+            if skip > 0:
+                # consume-and-discard fallback: decodes every skipped
+                # batch, so a late-epoch resume can cost close to a full
+                # epoch replay — datasets wanting cheap resume implement
+                # fast_forward_batches (record-level skip, no decode)
+                t_ff = time.time()
+                skipped = 0
+                for _ in range(skip):
+                    try:
+                        next(epoch_iter)
+                    except StopIteration:
+                        break
+                    skipped += 1
+                log.info("fast-forward consumed %d/%d batches in %.1fs",
+                         skipped, skip, time.time() - t_ff)
             for xd, yd in self._batch_iter(epoch_iter):
                 lr = self.method.current_lr(st)
                 sub = jax.random.fold_in(step_rng, st["neval"])

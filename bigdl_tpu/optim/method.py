@@ -522,14 +522,35 @@ def apply_update(method, params, grads, slots, sgd_lr: float = 1e-3):
     Otherwise the METHOD's configured learning_rate + schedule drive the
     rate (matching the Optimizer facade's current_lr contract) and the
     step counter advances inside `slots` (from `init_update_slots`).
-    Returns (new_params, new_slots)."""
+    Returns (new_params, new_slots).
+
+    jit-safety: the LR schedule runs on the HOST (schedules are arbitrary
+    Python, reference: optim/SGD.scala:200-565), so with a non-default
+    schedule the slot step counter must be a concrete value — call this
+    eagerly, or close over a host-side step and jit only method.update.
+    With the default (constant) schedule the whole call is jittable."""
     import jax as _jax
     import jax.numpy as _jnp
     if method is None:
         return (_jax.tree.map(lambda p, g: p - sgd_lr * g, params, grads),
                 slots)
     inner, t = slots
-    lr = method.current_lr({"neval": int(t), "epoch": 0})
+    from bigdl_tpu.optim.schedule import Default
+    sched = getattr(method, "schedule", None)
+    if sched is None or (isinstance(sched, Default)
+                         and getattr(sched, "lr_decay", 0.0) == 0.0):
+        lr = method.learning_rate          # constant: no host sync needed
+    else:
+        try:
+            step = int(t)
+        except (TypeError, _jax.errors.TracerIntegerConversionError) \
+                as exc:
+            raise TypeError(
+                "apply_update with a non-constant LR schedule runs the "
+                "schedule on the host and cannot be traced by jax.jit — "
+                "call it eagerly, or jit only method.update with the lr "
+                "computed outside") from exc
+        lr = method.current_lr({"neval": step, "epoch": 0})
     new_p, new_inner = method.update(params, grads, inner,
                                      _jnp.float32(lr), t)
     return new_p, (new_inner, t + 1)

@@ -290,11 +290,11 @@ def test_zeropad3d_and_cropping3d_forms():
 
 def test_keras1_wrapper_guardrails():
     import pytest
-    # Convolution3D 'same' raises loudly at build (no silent valid conv)
+    # Convolution3D 'same' builds a SAME-padded conv (round-4: supported)
     m = kl.Sequential(kl.Convolution3D(4, 3, 3, 3, border_mode="same",
                                        input_shape=(8, 8, 8, 2)))
-    with pytest.raises(NotImplementedError, match="SAME"):
-        m.build()
+    m.build()
+    assert m.output_shape == (None, 8, 8, 8, 4)
     # Deconvolution2D's keras-1 4th positional output_shape doesn't
     # misbind into activation
     cfg = kl.Deconvolution2D(8, 3, 3, (None, 14, 14, 8), subsample=(2, 2))

@@ -1,0 +1,133 @@
+"""Layer-coverage CLOSURE meta-test (the round-4 analogue of the
+reference's test inventory: 374 layer specs + 132 Torch goldens +
+per-layer serialization tests under spark/dl/src/test/).
+
+Asserts that EVERY public Module/Criterion class in `bigdl_tpu.nn` is
+covered by BOTH:
+  1. a numeric oracle — a torch-golden test (tests/test_golden_torch*.py,
+     test_golden_models.py) or a numeric gradient check
+     (test_gradcheck.py or the catalog sweep in test_gradcheck2.py), and
+  2. the serialization sweep (layer_catalog ser entries or the original
+     test_serializer_sweep.py).
+
+Coverage is computed structurally where possible (building each catalog
+entry and walking its module tree, so `Recurrent(LSTM(...))` covers both
+classes) and textually for the hand-written golden files. New layers that
+are exported without a catalog entry fail here by name — the failure
+message is the TODO list.
+"""
+
+import inspect
+import pathlib
+import re
+
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.module import Criterion, Module
+from layer_catalog import CRITERIA, EXEMPT, MODULES
+
+HERE = pathlib.Path(__file__).parent
+
+# Hand-written numeric-oracle files (torch goldens + finite-difference
+# checks). test_gradcheck2 contributes structurally via the catalog, but
+# its dedicated non-catalog tests (GradientReversal) count textually.
+ORACLE_FILES = sorted(HERE.glob("test_golden_torch*.py")) + [
+    HERE / "test_golden_models.py",
+    HERE / "test_golden_oracle.py",
+    HERE / "test_gradcheck.py",
+    HERE / "test_gradcheck2.py",
+]
+SER_FILES = [HERE / "test_serializer_sweep.py"]
+
+
+def _public_classes():
+    """name -> class for every public Module/Criterion export."""
+    out = {}
+    for name in dir(nn):
+        if name.startswith("_"):
+            continue
+        obj = getattr(nn, name)
+        if inspect.isclass(obj) and issubclass(obj, (Module, Criterion)):
+            out[name] = obj
+    return out
+
+
+def _walk_criterion(crit):
+    stack, seen = [crit], []
+    while stack:
+        c = stack.pop()
+        seen.append(type(c))
+        inner = getattr(c, "criterion", None)
+        if inner is not None:
+            stack.append(inner)
+        stack.extend(getattr(c, "criterions", []) or [])
+    return seen
+
+
+def _structural_cover(entries, want_flag):
+    ids = set()
+    for name, e in entries.items():
+        if not getattr(e, want_flag):
+            continue
+        obj = e.build()
+        if isinstance(obj, Module):
+            for m in obj.modules():
+                ids.add(id(type(m)))
+        else:
+            for c in _walk_criterion(obj):
+                ids.add(id(c))
+    return ids
+
+
+def _textual_cover(files, classes):
+    src = "\n".join(p.read_text() for p in files if p.exists())
+    ids = set()
+    for name, cls in classes.items():
+        if re.search(r"\b%s\s*\(" % re.escape(name), src):
+            ids.add(id(cls))
+    return ids
+
+
+def test_exemption_list_is_small():
+    assert len(EXEMPT) <= 10, EXEMPT
+
+
+def test_every_layer_has_numeric_oracle():
+    classes = _public_classes()
+    covered = (_structural_cover(MODULES, "grad")
+               | _structural_cover(CRITERIA, "grad")
+               | _textual_cover(ORACLE_FILES, classes))
+    missing = sorted(n for n, c in classes.items()
+                     if n not in EXEMPT and id(c) not in covered)
+    assert not missing, (
+        f"{len(missing)} classes lack a numeric oracle (golden torch test "
+        f"or gradient check): {missing}")
+
+
+def test_every_layer_in_serializer_sweep():
+    classes = _public_classes()
+    covered = (_structural_cover(MODULES, "ser")
+               | _structural_cover(CRITERIA, "ser")
+               | _textual_cover(SER_FILES, classes))
+    missing = sorted(n for n, c in classes.items()
+                     if n not in EXEMPT and id(c) not in covered)
+    assert not missing, (
+        f"{len(missing)} classes missing from the serialization sweep: "
+        f"{missing}")
+
+
+def test_exempt_names_exist():
+    """The exemption list must not rot: every name on it is still a real
+    export (or a documented abstract base)."""
+    classes = _public_classes()
+    for name in EXEMPT:
+        assert name in classes, f"stale exemption: {name}"
+
+
+def test_catalog_entries_are_public():
+    classes = _public_classes()
+    for name in list(MODULES) + list(CRITERIA):
+        base = name.split("_")[0] if name.endswith("_alias") else name
+        if base not in classes and not name.endswith("_alias"):
+            pytest.fail(f"catalog entry {name} is not a public nn export")

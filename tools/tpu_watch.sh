@@ -4,7 +4,7 @@
 # The axon chip tunnel is intermittently alive; when wedged, jax backend
 # init hangs forever (no error). This watcher probes in a throwaway
 # subprocess with a hard timeout; the moment the chip answers, it runs the
-# full bench battery + an XLA profile and writes BENCH_EARLY_r03.json
+# full bench battery + an XLA profile and writes BENCH_EARLY_r04.json
 # into the repo, then keeps re-probing (the chip may come back later with
 # better code to measure).
 #
@@ -32,11 +32,11 @@ for i in $(seq 1 200); do
       done
       echo "\"watcher_iteration\": $i"
       echo "}"
-    } > BENCH_EARLY_r03.json.tmp && mv BENCH_EARLY_r03.json.tmp BENCH_EARLY_r03.json
-    echo "$(date -u +%FT%TZ) bench battery done (see BENCH_EARLY_r03.json)" >> "$LOG"
-    timeout 1800 python tools/capture_tpu_profile.py tpu_profile_r03 \
+    } > BENCH_EARLY_r04.json.tmp && mv BENCH_EARLY_r04.json.tmp BENCH_EARLY_r04.json
+    echo "$(date -u +%FT%TZ) bench battery done (see BENCH_EARLY_r04.json)" >> "$LOG"
+    timeout 1800 python tools/capture_tpu_profile.py tpu_profile_r04 \
         >> "$LOG" 2>&1
-    echo "$(date -u +%FT%TZ) profile capture attempted (tpu_profile_r03/)" >> "$LOG"
+    echo "$(date -u +%FT%TZ) profile capture attempted (tpu_profile_r04/)" >> "$LOG"
     captured=1
     # chip is alive — stop polling aggressively; builder takes over
     touch /tmp/tpu_alive_now
