@@ -26,17 +26,30 @@ from jax import lax
 from bigdl_tpu.interop import protowire as pw
 
 DT_FLOAT, DT_INT32 = 1, 3
+DT_STRING, DT_INT64, DT_UINT8 = 7, 9, 4
+
+# DataType enum → numpy (the types the pipeline/decode ops traffic in)
+NP_OF_DT = {1: np.float32, 2: np.float64, 3: np.int32, 4: np.uint8,
+            5: np.int16, 6: np.int8, 9: np.int64, 10: np.bool_}
 
 
 def _parse_tensor(t: pw.Msg) -> np.ndarray:
     dtype = t.int(1, DT_FLOAT)
     dims = [d.int(1) for d in t.msg(2).msgs(2)] if t.has(2) else []
+    if dtype == DT_STRING:
+        vals = t._vals(8)                   # TensorProto.string_val
+        arr = np.empty(len(vals), object)
+        arr[:] = vals
+        return arr.reshape(dims) if dims else arr
     content = t.bytes_(4)
-    np_dtype = np.float32 if dtype == DT_FLOAT else np.int32
+    np_dtype = NP_OF_DT.get(dtype, np.float32)
     if content:
         arr = np.frombuffer(content, np_dtype)
     elif dtype == DT_FLOAT:
         arr = np.asarray(t.floats(5), np.float32)
+    elif dtype == DT_INT64:
+        arr = np.asarray([v - (1 << 64) if v >= (1 << 63) else v
+                          for v in t.ints(10)], np.int64)
     else:
         arr = np.asarray(t.ints(7), np.int32)
     if dims:
@@ -79,6 +92,16 @@ class TFNode:
     def attr_str(self, key, default="") -> str:
         a = self.attrs.get(key)
         return a.str(2, default) if a is not None else default
+
+    def attr_strs(self, key) -> List[str]:
+        """AttrValue.list.s — repeated string attr."""
+        a = self.attrs.get(key)
+        return a.msg(1).strs(2) if a is not None and a.has(1) else []
+
+    def attr_type(self, key, default: int = 0) -> int:
+        """AttrValue.type (DataType enum)."""
+        a = self.attrs.get(key)
+        return a.int(6, default) if a is not None else default
 
 
 def _pool(fn, init):
@@ -229,9 +252,13 @@ def make_node(name: str, op: str, inputs: Sequence[str] = (),
               ints: Optional[Dict[str, List[int]]] = None,
               strs: Optional[Dict[str, str]] = None,
               scalars: Optional[Dict[str, object]] = None,
-              types: Optional[Dict[str, int]] = None) -> bytes:
+              types: Optional[Dict[str, int]] = None,
+              strings: Optional[Sequence[bytes]] = None,
+              str_lists: Optional[Dict[str, Sequence[str]]] = None) -> bytes:
     """Encode one NodeDef (used by the exporter/tests — the analogue of
-    TensorflowSaver, utils/tf/TensorflowSaver.scala)."""
+    TensorflowSaver, utils/tf/TensorflowSaver.scala). `strings` emits a
+    DT_STRING Const tensor (filename lists, Example feature keys);
+    `str_lists` emits AttrValue.list.s attrs (ParseSingleExample keys)."""
     body = pw.field_str(1, name) + pw.field_str(2, op)
     for i in inputs:
         body += pw.field_str(3, i)
@@ -240,7 +267,13 @@ def make_node(name: str, op: str, inputs: Sequence[str] = (),
         return pw.field_bytes(5, pw.field_str(1, key) +
                               pw.field_bytes(2, value))
 
-    if tensor is not None:
+    if strings is not None:
+        shape = pw.field_bytes(2, pw.field_varint(1, len(strings)))
+        tp = pw.field_varint(1, DT_STRING) + pw.field_bytes(2, shape) + \
+            b"".join(pw.field_bytes(8, bytes(s)) for s in strings)
+        body += attr("value", pw.field_bytes(8, tp))
+        body += attr("dtype", pw.field_varint(6, DT_STRING))
+    elif tensor is not None:
         t = np.asarray(tensor)
         dt = DT_FLOAT if t.dtype.kind == "f" else DT_INT32
         t = t.astype(np.float32 if dt == DT_FLOAT else np.int32)
@@ -250,6 +283,9 @@ def make_node(name: str, op: str, inputs: Sequence[str] = (),
             pw.field_bytes(4, t.tobytes())
         body += attr("value", pw.field_bytes(8, tp))
         body += attr("dtype", pw.field_varint(6, dt))
+    for key, vals in (str_lists or {}).items():
+        body += attr(key, pw.field_bytes(
+            1, b"".join(pw.field_str(2, v) for v in vals)))
     for key, vals in (ints or {}).items():
         body += attr(key, pw.field_bytes(1, pw.field_packed_ints(3, vals)))
     for key, s in (strs or {}).items():

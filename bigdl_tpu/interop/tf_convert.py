@@ -183,9 +183,22 @@ def to_module(graph: TFGraph, inputs: Optional[Sequence[str]] = None,
     def is_data(name: str) -> bool:
         return name in sym
 
-    for name in input_names:
-        sym[name] = Input()
-        name_of_node.append((name, sym[name]))
+    input_node_of: Dict[str, Node] = {}    # spec ("name" or "name:port") → Input
+    for spec in input_names:
+        nm, _, port = spec.partition(":")
+        inp = Input()
+        input_node_of[spec] = inp
+        # a port-suffixed spec cuts the graph at one output of a
+        # multi-output node (e.g. a QueueDequeueManyV2 component) —
+        # consumers resolve it through sym_ports. A None marker keeps nm
+        # "data" for is_data while leaving port 0 unbound (resolve raises
+        # on port-0 consumers instead of feeding them port-k data).
+        if port and int(port):
+            sym_ports[(nm, int(port))] = inp
+            sym.setdefault(nm, None)
+        else:
+            sym[nm] = inp
+        name_of_node.append((spec, inp))
 
     for name in graph.order:
         if name in sym:
@@ -217,7 +230,7 @@ def to_module(graph: TFGraph, inputs: Optional[Sequence[str]] = None,
     missing = [o for o in output_names if out_node(o) is None]
     if missing:
         raise ValueError(f"outputs {missing} were not converted")
-    g = Graph([sym[i] for i in input_names],
+    g = Graph([input_node_of[i] for i in input_names],
               [out_node(o) for o in output_names])
     params, state = g.init(rng if rng is not None else jax.random.PRNGKey(0))
     for n, p_over, s_over in weights:
@@ -252,7 +265,16 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
                     f"{graph.nodes[nm].op if nm in graph.nodes else nm!r} "
                     f"has no converted output port {port}")
             return tap
-        return sym[nm]
+        tap = sym[nm]
+        if tap is None:
+            # nm was cut only at port>0 inputs (to_module input specs);
+            # feeding its port-0 consumers the port-k Input would be
+            # silent data corruption
+            raise NotImplementedError(
+                f"{node.name} consumes {nm}:0, but only port-suffixed "
+                f"outputs of {nm} were declared as inputs — add "
+                f"'{nm}' or '{nm}:0' to the inputs list")
+        return tap
 
     parent = [resolve(nm, pt) for nm, pt in node.input_ports
               if nm in sym]

@@ -59,6 +59,13 @@ def _encode_feature(value: FeatureValue) -> bytes:
         return pw.field_bytes(3, _int64_list([value]))
     if isinstance(value, (float, np.floating)):
         return pw.field_bytes(2, _float_list([value]))
+    if isinstance(value, (list, tuple)):
+        if not value:
+            return b""      # kind-less Feature; decodes back as []
+        if all(isinstance(v, (bytes, bytearray)) for v in value):
+            # handled BEFORE np.asarray: converting a bytes list to a
+            # numpy 'S' array silently strips trailing NUL bytes
+            return pw.field_bytes(1, _bytes_list([bytes(v) for v in value]))
     arr = np.asarray(value)
     if arr.dtype.kind in "iub":        # bools ride Int64List, as in TF
         return pw.field_bytes(3, _int64_list(arr.reshape(-1)))

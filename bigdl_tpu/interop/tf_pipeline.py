@@ -1,0 +1,395 @@
+"""Queue-runner input-pipeline extraction: train a TF graph that carries
+its OWN input pipeline (reference: utils/tf/Session.scala:43-132 —
+`BigDLSessionImpl` walks the queue-runner subgraph backward from the
+training endpoints, turns the TFRecord reader + decode ops into an RDD
+pipeline, and feeds the remaining model graph; its per-op loaders for the
+pipeline family live in utils/tf/loaders/DecodeJpeg.scala, DecodeRaw.scala,
+ParseExample.scala, QueueDequeueManyV2 handling in Session.scala:150+).
+
+TPU-native mapping: the pipeline ops (readers, queues, ParseExample,
+image decodes) are HOST-side work — they become a python dataset that
+replays the graph's own decode subgraph per record (numpy/PIL), while the
+model subgraph after the dequeue cut lowers to XLA via interop.tf_convert.
+That split mirrors how TPU input pipelines actually run (host CPU feeds
+the chip), instead of emulating TF queues on device.
+
+Layout handled (the classic TF-1.x canonical pipeline):
+
+    Const(filenames) → [RandomShuffle] → filename queue ← enqueue
+    TFRecordReaderV2 + ReaderReadV2(reader, filename_queue) → serialized
+    ParseSingleExample / ParseExample → DecodeRaw/DecodeJpeg/... → Cast/
+    Reshape/normalize → example queue ← QueueEnqueueV2
+    QueueDequeueManyV2(queue, batch) → model...
+
+`extract_input_pipeline` finds the dequeue cut, splits its components into
+model inputs vs labels by reachability to the requested outputs, and
+returns a `TFRecordPipeline` dataset yielding (features, labels) batches.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.interop.tensorflow import NP_OF_DT, TFGraph, TFNode
+
+log = logging.getLogger("bigdl_tpu.tf_pipeline")
+
+QUEUE_OPS = {"FIFOQueueV2", "FIFOQueue", "RandomShuffleQueueV2",
+             "RandomShuffleQueue", "PaddingFIFOQueueV2", "PaddingFIFOQueue"}
+DEQUEUE_OPS = {"QueueDequeueManyV2", "QueueDequeueMany",
+               "QueueDequeueUpToV2", "QueueDequeueV2", "QueueDequeue"}
+ENQUEUE_OPS = {"QueueEnqueueV2", "QueueEnqueue", "QueueEnqueueManyV2",
+               "QueueEnqueueMany"}
+READER_READ_OPS = {"ReaderReadV2", "ReaderRead"}
+PIPELINE_OPS = (QUEUE_OPS | DEQUEUE_OPS | ENQUEUE_OPS | READER_READ_OPS
+                | {"TFRecordReaderV2", "TFRecordReader", "RandomShuffle",
+                   "QueueCloseV2", "QueueSizeV2"})
+
+
+# ---------------------------------------------------------- host evaluator
+class HostEval:
+    """Evaluate the decode subgraph for ONE record on the host with numpy
+    semantics (the per-record work Session.scala runs inside its RDD map).
+    `env` seeds node outputs, e.g. the ReaderReadV2 (key, value) ports."""
+
+    def __init__(self, graph: TFGraph,
+                 env: Optional[Dict[Tuple[str, int], object]] = None):
+        self.g = graph
+        self.memo: Dict[str, tuple] = {}
+        self.env = dict(env or {})
+
+    def get(self, spec: str):
+        name, _, port = spec.partition(":")
+        p = int(port) if port else 0
+        if (name, p) in self.env:
+            return self.env[(name, p)]
+        outs = self._node(name)
+        return outs[p]
+
+    def _node(self, name: str) -> tuple:
+        if name in self.memo:
+            return self.memo[name]
+        node = self.g.nodes[name]
+        ins = [self.get(f"{nm}:{pt}" if pt else nm)
+               for nm, pt in node.input_ports]
+        outs = self._exec(node, ins)
+        self.memo[name] = outs
+        return outs
+
+    def _exec(self, node: TFNode, ins) -> tuple:
+        op = node.op
+        if op == "Const":
+            return (node.attr_tensor("value"),)
+        if op in ("Identity", "StopGradient", "Snapshot"):
+            return (ins[0],)
+        if op in READER_READ_OPS:
+            key = self.env.get((node.name, 0))
+            val = self.env.get((node.name, 1))
+            if val is None:
+                raise ValueError(
+                    f"ReaderRead {node.name} has no record bound — the "
+                    f"pipeline driver must seed env[({node.name!r}, 1)]")
+            return (key, val)
+        if op == "RandomShuffle":
+            return (ins[0],)        # extraction-time: order handled by
+            #                         the dataset's own shuffle
+        if op == "DecodeRaw":
+            dt = NP_OF_DT.get(node.attr_type("out_type"), np.uint8)
+            buf = ins[0]
+            if isinstance(buf, np.ndarray):      # bytes scalar array
+                buf = buf.reshape(-1)[0]
+            arr = np.frombuffer(bytes(buf), dt)
+            le = node.attrs.get("little_endian")  # bool attr (field 5)
+            if le is not None and le.int(5, 1) == 0:
+                arr = arr.byteswap()
+            return (arr,)
+        if op in ("DecodeJpeg", "DecodePng", "DecodeBmp", "DecodeGif",
+                  "DecodeImage"):
+            from PIL import Image
+            buf = ins[0]
+            if isinstance(buf, np.ndarray):
+                buf = buf.reshape(-1)[0]
+            img = Image.open(io.BytesIO(bytes(buf)))
+            channels = 0
+            a = node.attrs.get("channels")
+            if a is not None:
+                channels = a.int(3, 0)
+            if channels == 1:
+                img = img.convert("L")
+                arr = np.asarray(img, np.uint8)[:, :, None]
+            else:
+                img = img.convert("RGB")
+                arr = np.asarray(img, np.uint8)
+            return (arr,)
+        if op in ("ParseSingleExample", "ParseExample"):
+            return self._parse_example(node, ins)
+        if op == "Cast":
+            dt = NP_OF_DT.get(node.attr_type("DstT"), np.float32)
+            return (np.asarray(ins[0]).astype(dt),)
+        if op == "Reshape":
+            return (np.asarray(ins[0]).reshape(
+                [int(d) for d in np.asarray(ins[1]).reshape(-1)]),)
+        if op == "ExpandDims":
+            return (np.expand_dims(np.asarray(ins[0]),
+                                   int(np.asarray(ins[1]))),)
+        if op == "Squeeze":
+            dims = node.attr_ints("squeeze_dims")
+            return (np.squeeze(np.asarray(ins[0]),
+                               axis=tuple(dims) if dims else None),)
+        if op in ("Add", "AddV2"):
+            return (np.asarray(ins[0]) + np.asarray(ins[1]),)
+        if op == "Sub":
+            return (np.asarray(ins[0]) - np.asarray(ins[1]),)
+        if op == "Mul":
+            return (np.asarray(ins[0]) * np.asarray(ins[1]),)
+        if op in ("RealDiv", "Div"):
+            return (np.asarray(ins[0]) / np.asarray(ins[1]),)
+        if op == "Pack":
+            a = node.attrs.get("axis")
+            axis = a.int(3, 0) if a is not None else 0
+            return (np.stack([np.asarray(i) for i in ins], axis=axis),)
+        if op == "Transpose":
+            return (np.transpose(np.asarray(ins[0]),
+                                 [int(d) for d in np.asarray(ins[1])]),)
+        raise NotImplementedError(
+            f"host pipeline op {op!r} (node {node.name}) is not in the "
+            f"supported decode set")
+
+    def _parse_example(self, node: TFNode, ins) -> tuple:
+        """Dense features of ParseSingleExample / ParseExample (sparse
+        outputs are materialized empty — the zoo pipelines are dense)."""
+        from bigdl_tpu.interop.tf_example import decode_example
+        serialized = ins[0]
+        if isinstance(serialized, np.ndarray):
+            serialized = serialized.reshape(-1)[0]
+        feats = decode_example(bytes(serialized))
+        if node.op == "ParseSingleExample":
+            ns = 0
+            a = node.attrs.get("num_sparse")
+            if a is not None:
+                ns = a.int(3, 0)
+            dense_keys = node.attr_strs("dense_keys")
+            n_defaults_off = 1
+        else:                                   # ParseExample (v1 layout)
+            a = node.attrs.get("Nsparse")
+            ns = a.int(3, 0) if a is not None else 0
+            a = node.attrs.get("Ndense")
+            nd = a.int(3, 0) if a is not None else 0
+            # inputs: serialized, names, sparse_keys×ns, dense_keys×nd,
+            # dense_defaults×nd
+            key_ins = ins[2 + ns:2 + ns + nd]
+            dense_keys = [bytes(np.asarray(k).reshape(-1)[0]).decode()
+                          if not isinstance(k, (bytes, str))
+                          else (k.decode() if isinstance(k, bytes) else k)
+                          for k in key_ins]
+            n_defaults_off = 2 + ns + nd
+        if ns:
+            raise NotImplementedError(
+                f"{node.op} with sparse features (node {node.name})")
+        dense = []
+        for i, key in enumerate(dense_keys):
+            v = feats.get(key)
+            if v is None or (isinstance(v, (list, np.ndarray))
+                             and len(v) == 0):
+                v = ins[n_defaults_off + i]     # dense default
+            if isinstance(v, list):             # BytesList
+                v = v[0] if len(v) == 1 else np.asarray(v, object)
+            dense.append(v)
+        # output ports: 3*ns sparse ports first, then dense values
+        return tuple([None] * (3 * ns) + dense)
+
+
+# ------------------------------------------------------------- extraction
+class ExtractedPipeline:
+    """What extract_input_pipeline found: the dequeue cut + how to replay
+    the per-record decode."""
+
+    def __init__(self, graph, dequeue: str, batch_size: int,
+                 record_specs: List[str], reader_node: str,
+                 files: List[str], shuffle: bool,
+                 feature_ports: List[int], label_ports: List[int]):
+        self.graph = graph
+        self.dequeue = dequeue
+        self.batch_size = batch_size
+        self.record_specs = record_specs      # enqueue value specs, per port
+        self.reader_node = reader_node
+        self.files = files
+        self.shuffle = shuffle
+        self.feature_ports = feature_ports
+        self.label_ports = label_ports
+
+    @property
+    def model_input_specs(self) -> List[str]:
+        return [f"{self.dequeue}:{p}" if p else self.dequeue
+                for p in self.feature_ports]
+
+    def dataset(self, batch_size: Optional[int] = None, seed: int = 0,
+                shuffle: Optional[bool] = None) -> "TFRecordPipeline":
+        return TFRecordPipeline(self, batch_size or self.batch_size,
+                                seed=seed,
+                                shuffle=self.shuffle if shuffle is None
+                                else shuffle)
+
+
+def _ancestors(graph: TFGraph, roots: Sequence[str]) -> set:
+    seen, stack = set(), [r.partition(":")[0] for r in roots]
+    while stack:
+        n = stack.pop()
+        if n in seen or n not in graph.nodes:
+            continue
+        seen.add(n)
+        stack.extend(graph.nodes[n].inputs)
+        stack.extend(graph.nodes[n].control_inputs)
+    return seen
+
+
+def extract_input_pipeline(graph: TFGraph,
+                           outputs: Optional[Sequence[str]] = None
+                           ) -> Optional[ExtractedPipeline]:
+    """Walk the queue-runner subgraph backward from the model outputs
+    (reference: Session.scala:43-132). Returns None when the graph has no
+    dequeue-fed input (plain placeholder graphs)."""
+    dequeues = [n for n in graph.order if graph.nodes[n].op in DEQUEUE_OPS]
+    if not dequeues:
+        return None
+    if outputs:
+        anc = _ancestors(graph, outputs)
+        dequeues = [d for d in dequeues if d in anc] or dequeues
+    if len(dequeues) > 1:
+        raise NotImplementedError(
+            f"multiple dequeue endpoints {dequeues} — pass explicit inputs")
+    deq = graph.nodes[dequeues[0]]
+    queue = deq.inputs[0]
+
+    # batch size: DequeueMany/UpTo second input is the count const
+    batch = 1
+    if deq.op in ("QueueDequeueManyV2", "QueueDequeueMany",
+                  "QueueDequeueUpToV2"):
+        cnt = graph.nodes.get(deq.inputs[1])
+        if cnt is None or cnt.op != "Const":
+            raise NotImplementedError(
+                f"{deq.name}: dequeue count must be a Const")
+        batch = int(np.asarray(cnt.attr_tensor("value")).reshape(-1)[0])
+
+    enqueues = [n for n in graph.order
+                if graph.nodes[n].op in ENQUEUE_OPS
+                and graph.nodes[n].inputs[0] == queue]
+    if not enqueues:
+        raise ValueError(f"queue {queue} has no enqueue op")
+    enq = graph.nodes[enqueues[0]]
+    record_specs = [f"{nm}:{pt}" if pt else nm
+                    for nm, pt in enq.input_ports[1:]]
+
+    # the reader feeding the decode subgraph
+    dec_anc = _ancestors(graph, record_specs)
+    readers = [n for n in dec_anc
+               if graph.nodes[n].op in READER_READ_OPS]
+    if len(readers) != 1:
+        raise NotImplementedError(
+            f"expected exactly one ReaderRead in the decode subgraph, "
+            f"found {readers}")
+    reader_read = readers[0]
+
+    # filenames: enqueue into the reader's filename queue ← Const strings
+    fq = graph.nodes[reader_read].inputs[1]
+    fq_enqs = [n for n in graph.order
+               if graph.nodes[n].op in ENQUEUE_OPS
+               and graph.nodes[n].inputs[0] == fq]
+    if not fq_enqs:
+        raise ValueError(f"filename queue {fq} has no enqueue")
+    fname_spec = graph.nodes[fq_enqs[0]].input_ports[1]
+    fname_val = HostEval(graph).get(
+        f"{fname_spec[0]}:{fname_spec[1]}" if fname_spec[1]
+        else fname_spec[0])
+    files = [v.decode() if isinstance(v, bytes) else str(v)
+             for v in np.asarray(fname_val, object).reshape(-1)]
+
+    # shuffle if either queue is a shuffle queue or a RandomShuffle sits
+    # in the filename path
+    shuffle = any(graph.nodes[q].op.startswith("RandomShuffle")
+                  for q in (queue, fq) if q in graph.nodes)
+    shuffle = shuffle or any(
+        graph.nodes[n].op == "RandomShuffle"
+        for n in _ancestors(graph, [fq_enqs[0]]) if n in graph.nodes)
+
+    # feature vs label split: ports consumed on the path to the outputs
+    n_comp = len(record_specs)
+    feature_ports, label_ports = [], []
+    out_anc = _ancestors(graph, outputs) if outputs else set(graph.order)
+    consumed = set()
+    for n in out_anc:
+        if n == deq.name or n not in graph.nodes:
+            continue
+        for nm, pt in graph.nodes[n].input_ports:
+            if nm == deq.name:
+                consumed.add(pt)
+    for p in range(n_comp):
+        (feature_ports if p in consumed else label_ports).append(p)
+    if not feature_ports:                    # nothing reachable → all feats
+        feature_ports, label_ports = list(range(n_comp)), []
+
+    return ExtractedPipeline(graph, deq.name, batch, record_specs,
+                             reader_read, files, shuffle, feature_ports,
+                             label_ports)
+
+
+class TFRecordPipeline:
+    """Host dataset replaying the graph's own decode subgraph per TFRecord
+    (the RDD stage of Session.scala, as a python iterable). Yields
+    (features, labels) — each a single array or a tuple, following the
+    extracted port split."""
+
+    def __init__(self, ex: ExtractedPipeline, batch_size: int,
+                 seed: int = 0, shuffle: bool = False):
+        self.ex = ex
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self._seed = seed
+        self._epoch = 0
+
+    def set_epoch(self, epoch: int):
+        self._epoch = epoch
+
+    def _records(self):
+        from bigdl_tpu.utils.recordio import RecordReader
+        files = list(self.ex.files)
+        if self.shuffle:
+            np.random.RandomState(
+                (self._seed << 16) + self._epoch).shuffle(files)
+        for path in files:
+            for payload in RecordReader(path):
+                yield payload
+
+    def _decode(self, payload: bytes):
+        ev = HostEval(self.ex.graph,
+                      env={(self.ex.reader_node, 0): b"",
+                           (self.ex.reader_node, 1): payload})
+        return [np.asarray(ev.get(s)) for s in self.ex.record_specs]
+
+    def __iter__(self):
+        # shuffle granularity is file-level (see _records); record-level
+        # shuffling belongs to the writer's shard interleave
+        comps: List[List[np.ndarray]] = [[] for _ in self.ex.record_specs]
+        for payload in self._records():
+            vals = self._decode(payload)
+            for buf, v in zip(comps, vals):
+                buf.append(v)
+            if len(comps[0]) == self.batch_size:
+                yield self._emit(comps)
+                comps = [[] for _ in self.ex.record_specs]
+        self._epoch += 1
+
+    def _emit(self, comps):
+        stacked = [np.stack(c) for c in comps]
+
+        def pick(ports):
+            vals = [stacked[p] for p in ports]
+            return vals[0] if len(vals) == 1 else tuple(vals)
+
+        if self.ex.label_ports:
+            return pick(self.ex.feature_ports), pick(self.ex.label_ports)
+        return (pick(self.ex.feature_ports),)

@@ -11,6 +11,16 @@ optimizer, so a frozen GraphDef can be fine-tuned in three lines:
                              criterion=nn.CrossEntropyCriterion())
     params, state = sess.train(dataset, SGD(0.01), Trigger.max_epoch(5))
     preds = sess.predict(x_batch)
+
+Graphs that carry their OWN queue-runner input pipeline (TFRecord reader
++ decode + batch queue, Session.scala's main case) need no dataset at
+all: the pipeline is extracted automatically (interop/tf_pipeline), the
+model is cut at the dequeue, and train() replays the graph's decode ops
+host-side while the model subgraph runs on the accelerator:
+
+    sess = TFTrainingSession("pipeline.pb", outputs=["logits"],
+                             criterion=nn.CrossEntropyCriterion())
+    params, state = sess.train()       # dataset comes from the graph
 """
 
 from __future__ import annotations
@@ -21,26 +31,42 @@ from typing import Optional, Sequence
 class TFTrainingSession:
     def __init__(self, graphdef, inputs: Optional[Sequence[str]] = None,
                  outputs: Optional[Sequence[str]] = None, criterion=None):
-        from bigdl_tpu.interop.tf_convert import load_model, to_module
-        from bigdl_tpu.interop.tensorflow import TFGraph
-        if isinstance(graphdef, TFGraph):
-            self.module, self.params, self.state, self.name_map = \
-                to_module(graphdef, inputs, outputs)
-        else:                               # path or bytes
-            self.module, self.params, self.state, self.name_map = \
-                load_model(graphdef, inputs, outputs)
+        from bigdl_tpu.interop.tensorflow import TFGraph, load_graphdef
+        from bigdl_tpu.interop.tf_convert import to_module
+        from bigdl_tpu.interop.tf_pipeline import extract_input_pipeline
+        graph = graphdef if isinstance(graphdef, TFGraph) \
+            else load_graphdef(graphdef)
+        self.pipeline = None
+        if inputs is None:
+            # no explicit cut: prefer placeholders; otherwise look for a
+            # queue-runner pipeline to extract (Session.scala:43-132)
+            if not graph.placeholders:
+                self.pipeline = extract_input_pipeline(graph, outputs)
+                if self.pipeline is not None:
+                    inputs = self.pipeline.model_input_specs
+        self.module, self.params, self.state, self.name_map = \
+            to_module(graph, inputs, outputs)
         self.criterion = criterion
         self._optimizer = None
 
-    def train(self, dataset, method=None, end_trigger=None, **optimizer_kw):
+    def train(self, dataset=None, method=None, end_trigger=None,
+              **optimizer_kw):
         """Fine-tune the imported graph on `dataset` (any bigdl_tpu
-        DataSet). Returns (params, state) and keeps them on the session
-        (reference: Session.scala train -> trained Graph)."""
+        DataSet); with a graph-extracted pipeline, `dataset=None` replays
+        the graph's own input pipeline. Returns (params, state) and keeps
+        them on the session (reference: Session.scala train -> trained
+        Graph)."""
         from bigdl_tpu.optim.local import Optimizer
         from bigdl_tpu.optim.method import SGD
         from bigdl_tpu.optim.trigger import Trigger
         if self.criterion is None:
             raise ValueError("TFTrainingSession needs a criterion to train")
+        if dataset is None:
+            if self.pipeline is None:
+                raise ValueError(
+                    "no dataset given and the graph has no extractable "
+                    "queue-runner input pipeline")
+            dataset = self.pipeline.dataset()
         opt = Optimizer(self.module, dataset, self.criterion,
                         method or SGD(1e-2), **optimizer_kw)
         opt.set_initial(self.params, self.state)

@@ -1,0 +1,324 @@
+"""Queue-runner pipeline ingestion (reference: utils/tf/Session.scala:43-132
+and the utils/tf/loaders decode/queue/parse family): a GraphDef that
+carries its OWN TFRecord+decode input pipeline imports, the pipeline is
+extracted into a host dataset, and the model fine-tunes end to end with
+no user-supplied data."""
+
+import io
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.interop import protowire as pw
+from bigdl_tpu.interop.tensorflow import (DT_FLOAT, DT_INT32, TFGraph,
+                                          TFNode, make_node)
+from bigdl_tpu.interop.tf_example import encode_example, write_example_file
+from bigdl_tpu.interop.tf_pipeline import (HostEval,
+                                           extract_input_pipeline)
+
+DT_UINT8, DT_INT64 = 4, 9
+
+R = np.random.RandomState(11)
+
+
+def _graph(nodes_bytes):
+    gd = pw.Msg(b"".join(nodes_bytes))
+    return TFGraph([TFNode(m) for m in gd.msgs(1)])
+
+
+def _write_records(tmp_path, n_files=2, per_file=32, dim=16):
+    """Linearly separable raw-bytes examples: image uint8[dim], int64
+    label = (mean > 127)."""
+    files, all_x, all_y = [], [], []
+    for fi in range(n_files):
+        path = str(tmp_path / f"train-{fi}.tfrecord")
+        exs = []
+        while len(exs) < per_file:
+            img = R.randint(0, 256, dim).astype(np.uint8)
+            if abs(img.mean() - 127.5) < 10:    # zero-margin samples make
+                continue                         # the threshold unlearnable
+            label = int(img.mean() > 127.5)
+            exs.append({"image": [img.tobytes()],
+                        "label": np.asarray([label], np.int64)})
+            all_x.append(img)
+            all_y.append(label)
+        write_example_file(path, exs)
+        files.append(path)
+    return files, np.stack(all_x), np.asarray(all_y)
+
+
+def _pipeline_graphdef(files, dim=16, batch=8, n_classes=2, seed=0):
+    """The canonical TF-1.x input pipeline + a linear model:
+    Const(files) → filename queue → TFRecordReader → ParseSingleExample →
+    DecodeRaw → Cast → normalize → example queue → DequeueMany → logits."""
+    r = np.random.RandomState(seed)
+    w = (r.randn(dim, n_classes) * 0.05).astype(np.float32)
+    b = np.zeros(n_classes, np.float32)
+    nodes = [
+        make_node("files", "Const", strings=[f.encode() for f in files]),
+        make_node("fq", "FIFOQueueV2"),
+        make_node("fq_enq", "QueueEnqueueManyV2", ["fq", "files"]),
+        make_node("reader", "TFRecordReaderV2"),
+        make_node("read", "ReaderReadV2", ["reader", "fq"]),
+        make_node("img_def", "Const", strings=[b""]),
+        make_node("lab_def", "Const", tensor=np.asarray([0], np.int32)),
+        make_node("parse", "ParseSingleExample",
+                  ["read:1", "img_def", "lab_def"],
+                  scalars={"num_sparse": 0},
+                  str_lists={"dense_keys": ["image", "label"]}),
+        make_node("decode", "DecodeRaw", ["parse"],
+                  types={"out_type": DT_UINT8}),
+        make_node("castf", "Cast", ["decode"], types={"DstT": DT_FLOAT}),
+        make_node("scale_c", "Const",
+                  tensor=np.asarray(1.0 / 255.0, np.float32)),
+        make_node("scaled", "Mul", ["castf", "scale_c"]),
+        make_node("lab_shape", "Const", tensor=np.asarray([], np.int32)),
+        make_node("lab_scalar", "Reshape", ["parse:1", "lab_shape"]),
+        make_node("lab32", "Cast", ["lab_scalar"], types={"DstT": DT_INT32}),
+        make_node("eq", "FIFOQueueV2"),
+        make_node("eq_enq", "QueueEnqueueV2", ["eq", "scaled", "lab32"]),
+        make_node("batch_n", "Const", tensor=np.asarray(batch, np.int32)),
+        make_node("deq", "QueueDequeueManyV2", ["eq", "batch_n"]),
+        make_node("w", "Const", tensor=w),
+        make_node("mm", "MatMul", ["deq", "w"]),
+        make_node("bias", "Const", tensor=b),
+        make_node("logits", "BiasAdd", ["mm", "bias"]),
+    ]
+    return _graph(nodes)
+
+
+def test_extraction_finds_the_pipeline(tmp_path):
+    files, _, _ = _write_records(tmp_path)
+    g = _pipeline_graphdef(files)
+    ex = extract_input_pipeline(g, outputs=["logits"])
+    assert ex is not None
+    assert ex.batch_size == 8
+    assert ex.files == files
+    assert ex.feature_ports == [0] and ex.label_ports == [1]
+    assert ex.model_input_specs == ["deq"]
+    assert not ex.shuffle
+
+
+def test_pipeline_dataset_replays_decode_subgraph(tmp_path):
+    files, all_x, all_y = _write_records(tmp_path)
+    g = _pipeline_graphdef(files)
+    ds = extract_input_pipeline(g, outputs=["logits"]).dataset()
+    xs, ys = [], []
+    for xb, yb in ds:
+        assert xb.shape == (8, 16) and xb.dtype == np.float32
+        assert yb.shape == (8,) and yb.dtype == np.int32
+        xs.append(xb)
+        ys.append(yb)
+    xs, ys = np.concatenate(xs), np.concatenate(ys)
+    np.testing.assert_allclose(xs, all_x.astype(np.float32) / 255.0,
+                               rtol=1e-6)
+    np.testing.assert_array_equal(ys, all_y)
+
+
+def test_train_from_graph_pipeline_end_to_end(tmp_path):
+    """The headline: import a GraphDef containing its own TFRecord+decode
+    input pipeline and fine-tune it with NO user dataset."""
+    from bigdl_tpu.interop.tf_session import TFTrainingSession
+    from bigdl_tpu.optim.method import SGD
+    from bigdl_tpu.optim.trigger import Trigger
+    files, all_x, all_y = _write_records(tmp_path, per_file=64)
+    g = _pipeline_graphdef(files)
+    sess = TFTrainingSession(g, outputs=["logits"],
+                             criterion=nn.CrossEntropyCriterion())
+    assert sess.pipeline is not None
+    sess.train(method=SGD(0.5), end_trigger=Trigger.max_epoch(30))
+    logits = sess.predict(jnp.asarray(all_x.astype(np.float32) / 255.0))
+    acc = float((np.asarray(logits).argmax(-1) == all_y).mean())
+    assert acc > 0.95, acc
+
+
+def test_host_eval_decode_jpeg():
+    from PIL import Image
+    img = R.randint(0, 256, (5, 7, 3)).astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="PNG")   # lossless
+    g = _graph([make_node("in", "Placeholder"),
+                make_node("dec", "DecodeJpeg", ["in"])])
+    out = HostEval(g, env={("in", 0): buf.getvalue()}).get("dec")
+    np.testing.assert_array_equal(np.asarray(out), img)
+
+
+def test_host_eval_decode_grayscale_channels():
+    from PIL import Image
+    img = R.randint(0, 256, (4, 6)).astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="PNG")
+    g = _graph([make_node("in", "Placeholder"),
+                make_node("dec", "DecodePng", ["in"],
+                          scalars={"channels": 1})])
+    out = HostEval(g, env={("in", 0): buf.getvalue()}).get("dec")
+    assert np.asarray(out).shape == (4, 6, 1)
+    np.testing.assert_array_equal(np.asarray(out)[:, :, 0], img)
+
+
+def test_host_eval_parse_example_v1_layout():
+    """ParseExample (v1): keys arrive as Const string inputs, not attrs."""
+    ex = encode_example({"a": np.asarray([1.5, 2.5], np.float32),
+                         "b": np.asarray([7], np.int64)})
+    g = _graph([
+        make_node("ser", "Placeholder"),
+        make_node("names", "Const", strings=[b""]),
+        make_node("ka", "Const", strings=[b"a"]),
+        make_node("kb", "Const", strings=[b"b"]),
+        make_node("da", "Const", tensor=np.zeros(2, np.float32)),
+        make_node("db", "Const", tensor=np.asarray([0], np.int32)),
+        make_node("parse", "ParseExample",
+                  ["ser", "names", "ka", "kb", "da", "db"],
+                  scalars={"Nsparse": 0, "Ndense": 2}),
+    ])
+    ev = HostEval(g, env={("ser", 0): ex})
+    np.testing.assert_allclose(np.asarray(ev.get("parse")), [1.5, 2.5])
+    np.testing.assert_array_equal(np.asarray(ev.get("parse:1")), [7])
+
+
+def test_host_eval_dense_default_used_when_feature_absent():
+    ex = encode_example({"present": np.asarray([3.0], np.float32)})
+    g = _graph([
+        make_node("ser", "Placeholder"),
+        make_node("d0", "Const", tensor=np.asarray([9.0], np.float32)),
+        make_node("d1", "Const", tensor=np.asarray([42], np.int32)),
+        make_node("parse", "ParseSingleExample", ["ser", "d0", "d1"],
+                  scalars={"num_sparse": 0},
+                  str_lists={"dense_keys": ["present", "missing"]}),
+    ])
+    ev = HostEval(g, env={("ser", 0): ex})
+    np.testing.assert_allclose(np.asarray(ev.get("parse")), [3.0])
+    np.testing.assert_array_equal(np.asarray(ev.get("parse:1")), [42])
+
+
+def test_jpeg_decode_pipeline_trains(tmp_path):
+    """Variant with DecodeJpeg(PNG bytes) images instead of DecodeRaw —
+    the reference's image-pipeline case (loaders/DecodeJpeg.scala)."""
+    from PIL import Image
+    from bigdl_tpu.interop.tf_session import TFTrainingSession
+    from bigdl_tpu.optim.method import SGD
+    from bigdl_tpu.optim.trigger import Trigger
+    d = 4
+    exs, all_imgs, all_y = [], [], []
+    while len(exs) < 48:
+        img = R.randint(0, 256, (d, d, 3)).astype(np.uint8)
+        if abs(img.mean() - 127.5) < 12:        # drop zero-margin samples
+            continue
+        buf = io.BytesIO()
+        Image.fromarray(img).save(buf, format="PNG")
+        label = int(img.mean() > 127.5)
+        exs.append({"png": [buf.getvalue()],
+                    "label": np.asarray([label], np.int64)})
+        all_imgs.append(img)
+        all_y.append(label)
+    path = str(tmp_path / "imgs.tfrecord")
+    write_example_file(path, exs)
+
+    r = np.random.RandomState(0)
+    w = (r.randn(d * d * 3, 2) * 0.05).astype(np.float32)
+    nodes = [
+        make_node("files", "Const", strings=[path.encode()]),
+        make_node("fq", "FIFOQueueV2"),
+        make_node("fq_enq", "QueueEnqueueManyV2", ["fq", "files"]),
+        make_node("reader", "TFRecordReaderV2"),
+        make_node("read", "ReaderReadV2", ["reader", "fq"]),
+        make_node("img_def", "Const", strings=[b""]),
+        make_node("lab_def", "Const", tensor=np.asarray([0], np.int32)),
+        make_node("parse", "ParseSingleExample",
+                  ["read:1", "img_def", "lab_def"],
+                  scalars={"num_sparse": 0},
+                  str_lists={"dense_keys": ["png", "label"]}),
+        make_node("dec", "DecodeJpeg", ["parse"]),
+        make_node("castf", "Cast", ["dec"], types={"DstT": DT_FLOAT}),
+        make_node("sc", "Const", tensor=np.asarray(1 / 255.0, np.float32)),
+        make_node("scaled", "Mul", ["castf", "sc"]),
+        make_node("flat_shape", "Const",
+                  tensor=np.asarray([d * d * 3], np.int32)),
+        make_node("flat", "Reshape", ["scaled", "flat_shape"]),
+        make_node("lab_shape", "Const", tensor=np.asarray([], np.int32)),
+        make_node("lab_scalar", "Reshape", ["parse:1", "lab_shape"]),
+        make_node("lab32", "Cast", ["lab_scalar"], types={"DstT": DT_INT32}),
+        make_node("eq", "FIFOQueueV2"),
+        make_node("eq_enq", "QueueEnqueueV2", ["eq", "flat", "lab32"]),
+        make_node("bn", "Const", tensor=np.asarray(8, np.int32)),
+        make_node("deq", "QueueDequeueManyV2", ["eq", "bn"]),
+        make_node("w", "Const", tensor=w),
+        make_node("logits", "MatMul", ["deq", "w"]),
+    ]
+    g = _graph(nodes)
+    sess = TFTrainingSession(g, outputs=["logits"],
+                             criterion=nn.CrossEntropyCriterion())
+    sess.train(method=SGD(1.0), end_trigger=Trigger.max_epoch(100))
+    x = np.stack(all_imgs).astype(np.float32).reshape(48, -1) / 255.0
+    logits = sess.predict(jnp.asarray(x))
+    acc = float((np.asarray(logits).argmax(-1) == np.asarray(all_y)).mean())
+    assert acc > 0.9, acc
+
+
+def test_parse_example_v1_default_substitution():
+    """Regression: ParseExample (v1) dense defaults live AFTER the
+    dense_keys inputs (offset 2+ns+nd) — a wrong offset substituted the
+    key string for a missing feature."""
+    ex = encode_example({"a": np.asarray([1.0], np.float32)})
+    g = _graph([
+        make_node("ser", "Placeholder"),
+        make_node("names", "Const", strings=[b""]),
+        make_node("ka", "Const", strings=[b"a"]),
+        make_node("kb", "Const", strings=[b"b"]),
+        make_node("da", "Const", tensor=np.asarray([0.0], np.float32)),
+        make_node("db", "Const", tensor=np.asarray([5.5], np.float32)),
+        make_node("parse", "ParseExample",
+                  ["ser", "names", "ka", "kb", "da", "db"],
+                  scalars={"Nsparse": 0, "Ndense": 2}),
+    ])
+    ev = HostEval(g, env={("ser", 0): ex})
+    np.testing.assert_allclose(np.asarray(ev.get("parse")), [1.0])
+    np.testing.assert_allclose(np.asarray(ev.get("parse:1")), [5.5])
+
+
+def test_pipeline_dataset_seed_controls_shuffle(tmp_path):
+    files, _, _ = _write_records(tmp_path, n_files=4, per_file=4)
+    g = _pipeline_graphdef(files, batch=4)
+    ex = extract_input_pipeline(g, outputs=["logits"])
+    ex.shuffle = True
+    orders = []
+    for seed in (0, 7):
+        ds = ex.dataset(seed=seed)
+        orders.append([yb.tolist() for _, yb in ds])
+    assert orders[0] != orders[1], "seed must change the file order"
+
+
+def test_port_only_input_cut_rejects_port0_consumers():
+    """Regression: cutting a multi-output node at port 1 only must not
+    silently feed its port-0 consumers the port-1 Input."""
+    from bigdl_tpu.interop.tf_convert import to_module
+    g = _graph([
+        make_node("src", "Placeholder"),     # stands in for a 2-port op
+        make_node("w", "Const", tensor=np.eye(3, dtype=np.float32)),
+        make_node("m0", "MatMul", ["src", "w"]),      # consumes port 0
+        make_node("m1", "MatMul", ["src:1", "w"]),    # consumes port 1
+    ])
+    with pytest.raises(NotImplementedError, match="port-suffixed"):
+        to_module(g, inputs=["src:1"], outputs=["m0"])
+
+
+def test_example_bytes_feature_keeps_trailing_nul():
+    """Regression: encode_example routed [bytes] lists through np.asarray,
+    whose 'S' dtype silently strips trailing 0x00 — any raw-bytes image
+    ending in a zero byte came back one byte short."""
+    from bigdl_tpu.interop.tf_example import decode_example
+    payload = b"\x01\x02\x00\x00"
+    out = decode_example(encode_example({"img": [payload]}))
+    assert bytes(out["img"][0]) == payload
+
+
+def test_plain_placeholder_graph_has_no_pipeline():
+    g = _graph([
+        make_node("x", "Placeholder"),
+        make_node("w", "Const", tensor=np.eye(4, dtype=np.float32)),
+        make_node("y", "MatMul", ["x", "w"]),
+    ])
+    assert extract_input_pipeline(g, outputs=["y"]) is None
