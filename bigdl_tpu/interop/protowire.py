@@ -158,3 +158,10 @@ def field_packed_floats(field: int, vals) -> bytes:
 
 def field_packed_ints(field: int, vals) -> bytes:
     return field_bytes(field, b"".join(write_varint(v) for v in vals))
+
+
+def sign64(v: int) -> int:
+    """Sign-extend a uint64 varint to int64 (proto int64 fields arrive as
+    unsigned varints on the wire). One home for the idiom every protowire
+    consumer (TF attrs/tensors, Example int64 lists, ONNX attrs) needs."""
+    return v - (1 << 64) if v >= (1 << 63) else v

@@ -48,8 +48,7 @@ def _parse_tensor(t: pw.Msg) -> np.ndarray:
     elif dtype == DT_FLOAT:
         arr = np.asarray(t.floats(5), np.float32)
     elif dtype == DT_INT64:
-        arr = np.asarray([v - (1 << 64) if v >= (1 << 63) else v
-                          for v in t.ints(10)], np.int64)
+        arr = np.asarray([pw.sign64(v) for v in t.ints(10)], np.int64)
     else:
         arr = np.asarray(t.ints(7), np.int32)
     if dims:
@@ -87,7 +86,7 @@ class TFNode:
             return []
         raw = a.msg(1).ints(3) if a.has(1) else a.ints(3)
         # varints are unsigned on the wire; TF attr ints are int64
-        return [v - (1 << 64) if v >= (1 << 63) else v for v in raw]
+        return [pw.sign64(v) for v in raw]
 
     def attr_str(self, key, default="") -> str:
         a = self.attrs.get(key)

@@ -246,7 +246,7 @@ def to_module(graph: TFGraph, inputs: Optional[Sequence[str]] = None,
 
 def _sint(v: int) -> int:
     """Sign-extend a uint64 varint (TF attr ints are int64)."""
-    return v - (1 << 64) if v >= (1 << 63) else v
+    return pw.sign64(v)
 
 
 def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],

@@ -45,7 +45,7 @@ def _int64_list(vals) -> bytes:
 
 
 def _sign64(v: int) -> int:
-    return v - (1 << 64) if v >= (1 << 63) else v
+    return pw.sign64(v)
 
 
 def _encode_feature(value: FeatureValue) -> bytes:

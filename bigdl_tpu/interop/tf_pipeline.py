@@ -211,7 +211,8 @@ class ExtractedPipeline:
     def __init__(self, graph, dequeue: str, batch_size: int,
                  record_specs: List[str], reader_node: str,
                  files: List[str], shuffle: bool,
-                 feature_ports: List[int], label_ports: List[int]):
+                 feature_ports: List[int], label_ports: List[int],
+                 enqueue_many: bool = False):
         self.graph = graph
         self.dequeue = dequeue
         self.batch_size = batch_size
@@ -221,6 +222,7 @@ class ExtractedPipeline:
         self.shuffle = shuffle
         self.feature_ports = feature_ports
         self.label_ports = label_ports
+        self.enqueue_many = enqueue_many
 
     @property
     def model_input_specs(self) -> List[str]:
@@ -281,6 +283,9 @@ def extract_input_pipeline(graph: TFGraph,
     if not enqueues:
         raise ValueError(f"queue {queue} has no enqueue op")
     enq = graph.nodes[enqueues[0]]
+    # EnqueueMany rows are split into individual queue elements by TF —
+    # the dataset mirrors that by splitting the leading axis per record
+    enqueue_many = enq.op in ("QueueEnqueueManyV2", "QueueEnqueueMany")
     record_specs = [f"{nm}:{pt}" if pt else nm
                     for nm, pt in enq.input_ports[1:]]
 
@@ -334,7 +339,7 @@ def extract_input_pipeline(graph: TFGraph,
 
     return ExtractedPipeline(graph, deq.name, batch, record_specs,
                              reader_read, files, shuffle, feature_ports,
-                             label_ports)
+                             label_ports, enqueue_many=enqueue_many)
 
 
 class TFRecordPipeline:
@@ -374,13 +379,31 @@ class TFRecordPipeline:
         # shuffle granularity is file-level (see _records); record-level
         # shuffling belongs to the writer's shard interleave
         comps: List[List[np.ndarray]] = [[] for _ in self.ex.record_specs]
+        emitted = 0
         for payload in self._records():
             vals = self._decode(payload)
-            for buf, v in zip(comps, vals):
-                buf.append(v)
-            if len(comps[0]) == self.batch_size:
-                yield self._emit(comps)
-                comps = [[] for _ in self.ex.record_specs]
+            if self.ex.enqueue_many:
+                # TF splits EnqueueMany rows into individual elements
+                for buf, v in zip(comps, vals):
+                    buf.extend(np.asarray(v))
+            else:
+                for buf, v in zip(comps, vals):
+                    buf.append(v)
+            while len(comps[0]) >= self.batch_size:
+                head = [c[:self.batch_size] for c in comps]
+                comps = [c[self.batch_size:] for c in comps]
+                yield self._emit(head)
+                emitted += 1
+        if comps[0]:
+            # trailing partial batch: delivered, like QueueDequeueUpToV2
+            # (dropping it would silently skip records every epoch, and a
+            # sub-batch_size dataset would train zero steps)
+            yield self._emit(comps)
+            emitted += 1
+        if emitted == 0:
+            raise ValueError(
+                f"pipeline produced no batches — no records found in "
+                f"{self.ex.files}")
         self._epoch += 1
 
     def _emit(self, comps):

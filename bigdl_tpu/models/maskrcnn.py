@@ -92,6 +92,8 @@ class MaskRCNN(Module):
                  max_detections: int = 32,
                  mask_resolution: int = 14,
                  score_thresh: float = 0.05,
+                 backbone: Optional[Module] = None,
+                 anchor_scales: Sequence[float] = (4.0,),
                  name: Optional[str] = None):
         super().__init__(name)
         self.num_classes = num_classes
@@ -100,8 +102,15 @@ class MaskRCNN(Module):
         self.max_detections = max_detections
         self.score_thresh = score_thresh
         self.strides = (4, 8, 16, 32)
-        self.anchor = Anchor(ratios=(0.5, 1.0, 2.0), scales=(4.0,))
-        self.add_child("backbone", _Backbone(backbone_channels))
+        self.anchor = Anchor(ratios=(0.5, 1.0, 2.0),
+                             scales=tuple(anchor_scales))
+        if backbone is not None:
+            # any module emitting (C2, C3, C4, C5) with a `channels` list
+            # — e.g. models.resnet.Trunk, the reference's real trunk
+            backbone_channels = tuple(backbone.channels)
+            self.add_child("backbone", backbone)
+        else:
+            self.add_child("backbone", _Backbone(backbone_channels))
         self.add_child("fpn", FPN(backbone_channels, fpn_channels))
         self.add_child("rpn", _RPNHead(fpn_channels, self.anchor.num))
         self.add_child("pooler", Pooler((7, 7),
@@ -124,6 +133,41 @@ class MaskRCNN(Module):
             fpn_channels, num_classes, 1, 1))
 
     # ---------------------------------------------------------- stages
+    def _box_head(self, params, state, pyr, boxes, box_indices):
+        """Pooled ROI → fc×2 → (class logits (N, C+1), box deltas
+        (N, C+1, 4)). Shared by inference and the training losses so the
+        served network is exactly the trained one."""
+        ch = self.children()
+        rois, _ = ch["pooler"].apply(params["pooler"], state["pooler"],
+                                     (list(pyr), boxes, box_indices))
+        h, _ = ch["box_fc1"].apply(params["box_fc1"], state["box_fc1"],
+                                   rois.reshape(rois.shape[0], -1))
+        h = jax.nn.relu(h)
+        h, _ = ch["box_fc2"].apply(params["box_fc2"], state["box_fc2"], h)
+        h = jax.nn.relu(h)
+        cls, _ = ch["cls_score"].apply(params["cls_score"],
+                                       state["cls_score"], h)
+        bdeltas, _ = ch["bbox_pred"].apply(params["bbox_pred"],
+                                           state["bbox_pred"], h)
+        return cls, bdeltas.reshape(-1, self.num_classes + 1, 4)
+
+    def _mask_tower(self, params, state, pyr, boxes, box_indices):
+        """Mask-pooled ROI → conv×2 → deconv → per-class mask logits
+        (N, 2R, 2R, C). Shared by inference and the training losses."""
+        ch = self.children()
+        m, _ = ch["mask_pooler"].apply(
+            params["mask_pooler"], state["mask_pooler"],
+            (list(pyr), boxes, box_indices))
+        for key in ("mask_conv1", "mask_conv2"):
+            m, _ = ch[key].apply(params[key], state[key], m)
+            m = jax.nn.relu(m)
+        m, _ = ch["mask_deconv"].apply(params["mask_deconv"],
+                                       state["mask_deconv"], m)
+        m = jax.nn.relu(m)
+        mlogits, _ = ch["mask_logits"].apply(params["mask_logits"],
+                                             state["mask_logits"], m)
+        return mlogits
+
     def _proposals(self, params, state, feats, img_hw):
         """Top-scoring decoded anchors across levels → NMS → proposals."""
         ch = self.children()
@@ -159,19 +203,8 @@ class MaskRCNN(Module):
         proposals, prop_valid = self._proposals(params, state, pyr, img_hw)
 
         zeros = jnp.zeros((proposals.shape[0],), jnp.int32)
-        rois, _ = ch["pooler"].apply(params["pooler"], state["pooler"],
-                                     (list(pyr), proposals, zeros))
-        flat = rois.reshape(rois.shape[0], -1)
-        h, _ = ch["box_fc1"].apply(params["box_fc1"], state["box_fc1"], flat)
-        h = jax.nn.relu(h)
-        h, _ = ch["box_fc2"].apply(params["box_fc2"], state["box_fc2"], h)
-        h = jax.nn.relu(h)
-        cls, _ = ch["cls_score"].apply(params["cls_score"],
-                                       state["cls_score"], h)
+        cls, bdeltas = self._box_head(params, state, pyr, proposals, zeros)
         probs = jax.nn.softmax(cls, -1)                  # (P, C+1); 0 = bg
-        bdeltas, _ = ch["bbox_pred"].apply(params["bbox_pred"],
-                                           state["bbox_pred"], h)
-        bdeltas = bdeltas.reshape(-1, self.num_classes + 1, 4)
 
         fg = probs[:, 1:]                                # (P, C)
         best = jnp.argmax(fg, -1)                        # (P,)
@@ -187,21 +220,9 @@ class MaskRCNN(Module):
         out_labels = best[keep]
         out_valid = keep_valid & (out_scores > self.score_thresh)
 
-        mrois, _ = ch["mask_pooler"].apply(
-            params["mask_pooler"], state["mask_pooler"],
-            (list(pyr), out_boxes, jnp.zeros((out_boxes.shape[0],),
-                                             jnp.int32)))
-        m, _ = ch["mask_conv1"].apply(params["mask_conv1"],
-                                      state["mask_conv1"], mrois)
-        m = jax.nn.relu(m)
-        m, _ = ch["mask_conv2"].apply(params["mask_conv2"],
-                                      state["mask_conv2"], m)
-        m = jax.nn.relu(m)
-        m, _ = ch["mask_deconv"].apply(params["mask_deconv"],
-                                       state["mask_deconv"], m)
-        m = jax.nn.relu(m)
-        mlogits, _ = ch["mask_logits"].apply(params["mask_logits"],
-                                             state["mask_logits"], m)
+        mlogits = self._mask_tower(
+            params, state, pyr, out_boxes,
+            jnp.zeros((out_boxes.shape[0],), jnp.int32))
         # (N, 2R, 2R, C) → per-detection mask of its predicted class
         masks = jax.nn.sigmoid(jnp.take_along_axis(
             mlogits, out_labels[:, None, None, None].astype(jnp.int32), 3)
@@ -211,6 +232,262 @@ class MaskRCNN(Module):
                 "valid": out_valid}, state
 
 
-def build(num_classes: int = 80, **kw) -> MaskRCNN:
-    """(reference: models/maskrcnn/MaskRCNN.scala `apply`)."""
+    # ------------------------------------------------------------ training
+    def losses(self, params, state, images, gt_boxes, gt_labels, gt_valid,
+               gt_masks, rng, jitters: int = 3, pos_iou: float = 0.5):
+        """Training losses: RPN (objectness + box) + box-head
+        (classification + regression) + mask-head BCE — the loss wiring
+        of the reference's training configuration (the zoo entry is
+        inference-only there too; losses follow nn/RegionProposal.scala's
+        RPN branch and the Fast-RCNN head recipe with ground-truth
+        jittered proposals, all static shapes for one jitted step).
+
+        images (B, H, W, 3); gt_boxes (B, M, 4); gt_labels (B, M) int
+        [0, num_classes); gt_valid (B, M) bool; gt_masks (B, M, H, W)
+        {0,1} float. Returns (total, dict of components)."""
+        from bigdl_tpu.nn.detection import (box_iou, encode_boxes,
+                                            roi_align, rpn_loss)
+        ch = self.children()
+        B, H, W = images.shape[0], images.shape[1], images.shape[2]
+        M = gt_boxes.shape[1]
+        feats, _ = ch["backbone"].apply(params["backbone"],
+                                        state["backbone"], images,
+                                        training=False)
+        pyr, _ = ch["fpn"].apply(params["fpn"], state["fpn"], feats)
+
+        # ---- RPN loss across pyramid levels
+        logits_all, deltas_all, anchors_all = [], [], []
+        for feat, stride in zip(pyr, self.strides):
+            (lg, dl), _ = ch["rpn"].apply(params["rpn"], state["rpn"],
+                                          feat)
+            fh, fw = feat.shape[1], feat.shape[2]
+            logits_all.append(lg.reshape(B, -1))
+            deltas_all.append(dl.reshape(B, -1, 4))
+            anchors_all.append(self.anchor.generate(fh, fw, stride))
+        rpn_total, (rpn_cls, rpn_box) = rpn_loss(
+            jnp.concatenate(logits_all, 1),
+            jnp.concatenate(deltas_all, 1),
+            jnp.concatenate(anchors_all, 0), gt_boxes, gt_valid,
+            pos_iou=0.5, neg_iou=0.3)
+
+        # ---- proposals: gt + jittered copies at widening noise scales,
+        # plus uniform random boxes so the classifier learns BACKGROUND —
+        # without them every junk RPN proposal scores as foreground at
+        # inference (static (B, P, 4))
+        keys = jax.random.split(rng, 3)
+        reps = 1 + jitters
+        wh = jnp.concatenate([gt_boxes[..., 2:] - gt_boxes[..., :2]] * 2,
+                             -1)                                # (B, M, 4)
+        scales = jnp.asarray([0.0, 0.1, 0.25, 0.5][:reps]
+                             + [0.5] * max(0, reps - 4))
+        noise = jax.random.normal(keys[0], (reps, B, M, 4)) \
+            * scales[:, None, None, None]
+        props_jit = (gt_boxes[None] + noise * wh[None]) \
+            .transpose(1, 0, 2, 3).reshape(B, reps * M, 4)
+        jit_valid = jnp.tile(gt_valid, (1, reps))
+        K = reps * M
+        cxy = jax.random.uniform(keys[1], (B, K, 2)) \
+            * jnp.asarray([W, H], jnp.float32)
+        rwh = jax.random.uniform(keys[2], (B, K, 2), minval=0.08,
+                                 maxval=0.6) * jnp.asarray(
+                                     [W, H], jnp.float32)
+        props_rand = jnp.concatenate([cxy - rwh / 2, cxy + rwh / 2], -1)
+        props = jnp.concatenate([props_jit, props_rand], 1)
+        src_valid = jnp.concatenate(
+            [jit_valid, jnp.ones((B, K), bool)], 1)
+        lo = jnp.zeros((4,), jnp.float32)
+        hi = jnp.asarray([W, H, W, H], jnp.float32)
+        props = jnp.clip(props, lo, hi)
+        P = props.shape[1]
+
+        # ---- match proposals to gts per image
+        def match(props_i, boxes_i, valid_i, labels_i):
+            iou = box_iou(props_i, boxes_i)
+            iou = jnp.where(valid_i[None, :], iou, -1.0)
+            best = jnp.argmax(iou, 1)
+            best_iou = jnp.max(iou, 1)
+            pos = best_iou >= pos_iou
+            cls_t = jnp.where(pos, labels_i[best] + 1, 0)  # 0 = background
+            reg_t = encode_boxes(props_i, boxes_i[best])
+            reg_t = jnp.where(jnp.isfinite(reg_t), reg_t, 0.0)
+            return cls_t, reg_t, pos, best
+
+        cls_t, reg_t, pos, best_gt = jax.vmap(match)(
+            props, gt_boxes, gt_valid, gt_labels)
+        pos = pos & src_valid
+
+        flat_props = props.reshape(B * P, 4)
+        img_idx = jnp.repeat(jnp.arange(B), P)
+        cls_logits, bdeltas = self._box_head(params, state, pyr,
+                                             flat_props, img_idx)
+
+        cls_t_f = cls_t.reshape(-1)
+        w_valid = src_valid.reshape(-1).astype(jnp.float32)
+        logp = jax.nn.log_softmax(cls_logits, -1)
+        cls_loss = -jnp.take_along_axis(logp, cls_t_f[:, None], 1)[:, 0]
+        cls_loss = jnp.sum(cls_loss * w_valid) / jnp.maximum(
+            jnp.sum(w_valid), 1.0)
+
+        pos_f = pos.reshape(-1).astype(jnp.float32)
+        sel = jnp.take_along_axis(
+            bdeltas, cls_t_f[:, None, None].repeat(4, 2), 1)[:, 0]
+        d = sel - reg_t.reshape(-1, 4)
+        ad = jnp.abs(d)
+        sl1 = jnp.where(ad < 1.0, 0.5 * d * d, ad - 0.5).sum(-1)
+        box_loss = jnp.sum(sl1 * pos_f) / jnp.maximum(jnp.sum(pos_f), 1.0)
+
+        # ---- mask loss on positive proposals
+        mlogits = self._mask_tower(params, state, pyr, flat_props,
+                                   img_idx)
+        # logits of each proposal's TARGET class (bg proposals masked out)
+        cls_ix = jnp.clip(cls_t_f - 1, 0, self.num_classes - 1)
+        mlog = jnp.take_along_axis(
+            mlogits, cls_ix[:, None, None, None].repeat(
+                mlogits.shape[1], 1).repeat(mlogits.shape[2], 2), 3)[..., 0]
+        # target: gt mask of the matched gt, cropped to the proposal grid
+        flat_masks = gt_masks.reshape(B * M, H, W)[..., None]
+        mask_idx = (img_idx * M
+                    + best_gt.reshape(-1)).astype(jnp.int32)
+        tgt = roi_align(flat_masks, flat_props, mask_idx,
+                        (mlogits.shape[1], mlogits.shape[2]))[..., 0]
+        tgt = jnp.clip(tgt, 0.0, 1.0)
+        z = jnp.clip(mlog, -30, 30)
+        bce = jnp.maximum(z, 0) - z * tgt + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        bce = bce.mean((1, 2))
+        mask_loss = jnp.sum(bce * pos_f) / jnp.maximum(jnp.sum(pos_f), 1.0)
+
+        total = rpn_total + cls_loss + box_loss + mask_loss
+        return total, {"rpn_cls": rpn_cls, "rpn_box": rpn_box,
+                       "cls": cls_loss, "box": box_loss,
+                       "mask": mask_loss}
+
+
+def finetune(model: MaskRCNN, dataset, *, epochs: int = 20,
+             lr: float = 2e-3, rng=None, log_every: int = 0):
+    """Train all MaskRCNN heads end to end over a
+    ShardedDetectionDataset (with_masks=True) — one jitted Adam step per
+    batch via :meth:`MaskRCNN.losses`. Returns
+    (params, state, (first_loss, last_loss))."""
+    import logging
+
+    from bigdl_tpu.optim.method import (Adam, apply_update,
+                                        init_update_slots)
+    log = logging.getLogger("bigdl_tpu.maskrcnn")
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    rng, init_key = jax.random.split(rng)
+    params, state = model.init(init_key)
+    method = Adam(learning_rate=lr)
+    slots = init_update_slots(method, params)
+
+    @jax.jit
+    def step(params, slots, imgs, boxes, labels, valid, masks, key):
+        def loss_fn(p):
+            return model.losses(p, state, imgs, boxes, labels, valid,
+                                masks, key)
+        (l, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        params, slots = apply_update(method, params, g, slots)
+        return params, slots, l, aux
+
+    first = last = None
+    for epoch in range(epochs):
+        for x, t in dataset:
+            rng, key = jax.random.split(rng)
+            params, slots, loss, aux = step(
+                params, slots, jnp.asarray(x), jnp.asarray(t["boxes"]),
+                jnp.asarray(t["classes"]), jnp.asarray(t["valid"]),
+                jnp.asarray(t["masks"], jnp.float32), key)
+            if first is None:
+                first = float(loss)
+            last = float(loss)
+        if log_every and epoch % log_every == 0:
+            log.info("maskrcnn epoch %d loss %.3f (%s)", epoch, last,
+                     " ".join(f"{k}={float(v):.3f}"
+                              for k, v in aux.items()))
+    return params, state, (first, last)
+
+
+def evaluate_map(model: MaskRCNN, params, state, images, targets,
+                 image_hw, num_classes: int):
+    """Full-pipeline inference over `images` and box+mask mAP against
+    `targets` = list of (gt_boxes, gt_labels, gt_masks) per image
+    (reference: optim/ValidationMethod.scala:230-756 MAP family wired to
+    the MaskRCNN outputs). Returns (box_map, mask_map)."""
+    import numpy as np
+
+    from bigdl_tpu.dataset.segmentation import rle_encode
+    from bigdl_tpu.optim.detection_metrics import (
+        MaskMeanAveragePrecision, MeanAveragePrecision)
+    fwd = jax.jit(lambda p, s, x: model.apply(p, s, x))
+    outs, tgts, mouts, mtgts = [], [], [], []
+    for img, (gtb, gtl, gtm) in zip(images, targets):
+        out, _ = fwd(params, state, jnp.asarray(img)[None])
+        v = np.asarray(out["valid"])
+        boxes = np.asarray(out["boxes"])[v]
+        scores = np.asarray(out["scores"])[v]
+        labels = np.asarray(out["labels"])[v]
+        outs.append((boxes, scores, labels))
+        tgts.append((np.asarray(gtb), np.asarray(gtl)))
+        pasted = paste_masks(np.asarray(out["masks"])[v], boxes,
+                             image_hw) > 0.5
+        mouts.append(([rle_encode(m) for m in pasted], scores, labels))
+        mtgts.append(([rle_encode(np.asarray(m, bool)) for m in gtm],
+                      np.asarray(gtl)))
+    box_map = MeanAveragePrecision(num_classes=num_classes,
+                                   iou=0.5).batch(outs, tgts).result
+    mask_map = MaskMeanAveragePrecision(
+        num_classes=num_classes, size=image_hw,
+        coco=False).batch(mouts, mtgts).result
+    return float(box_map), float(mask_map)
+
+
+def paste_masks(masks, boxes, image_hw):
+    """Paste (N, 2R, 2R) ROI masks into full (N, H, W) image masks —
+    the inference post-step the reference runs in
+    models/maskrcnn/MaskRCNN.scala's mask branch (bilinear resize into
+    the box rectangle)."""
+    import numpy as np
+    H, W = image_hw
+    masks = np.asarray(masks)
+    boxes = np.asarray(boxes)
+    out = np.zeros((masks.shape[0], H, W), np.float32)
+    for i, (m, b) in enumerate(zip(masks, boxes)):
+        x0, y0, x1, y1 = [float(v) for v in b]
+        x0i, y0i = max(int(np.floor(x0)), 0), max(int(np.floor(y0)), 0)
+        x1i, y1i = min(int(np.ceil(x1)), W), min(int(np.ceil(y1)), H)
+        if x1i <= x0i or y1i <= y0i:
+            continue
+        ys = (np.arange(y0i, y1i) + 0.5 - y0) / max(y1 - y0, 1e-6) \
+            * m.shape[0] - 0.5
+        xs = (np.arange(x0i, x1i) + 0.5 - x0) / max(x1 - x0, 1e-6) \
+            * m.shape[1] - 0.5
+        ys = np.clip(ys, 0, m.shape[0] - 1)
+        xs = np.clip(xs, 0, m.shape[1] - 1)
+        y0f = np.floor(ys).astype(int)
+        x0f = np.floor(xs).astype(int)
+        y1f = np.minimum(y0f + 1, m.shape[0] - 1)
+        x1f = np.minimum(x0f + 1, m.shape[1] - 1)
+        wy = (ys - y0f)[:, None]
+        wx = (xs - x0f)[None, :]
+        patch = (m[np.ix_(y0f, x0f)] * (1 - wy) * (1 - wx)
+                 + m[np.ix_(y0f, x1f)] * (1 - wy) * wx
+                 + m[np.ix_(y1f, x0f)] * wy * (1 - wx)
+                 + m[np.ix_(y1f, x1f)] * wy * wx)
+        out[i, y0i:y1i, x0i:x1i] = patch
+    return out
+
+
+def build(num_classes: int = 80, backbone: str = "small",
+          **kw) -> MaskRCNN:
+    """(reference: models/maskrcnn/MaskRCNN.scala `apply`).
+
+    backbone="resnet50" uses the zoo ResNet-50 trunk + FPN (the
+    reference's full-fidelity configuration; fpn_channels defaults to
+    256 to match); "small" keeps the lightweight strided trunk for
+    tests/CI."""
+    if backbone == "resnet50":
+        from bigdl_tpu.models import resnet
+        kw.setdefault("fpn_channels", 256)
+        return MaskRCNN(num_classes, backbone=resnet.trunk(50), **kw)
+    if backbone != "small":
+        raise ValueError(f"unknown backbone {backbone!r}")
     return MaskRCNN(num_classes, **kw)

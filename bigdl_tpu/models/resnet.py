@@ -111,6 +111,51 @@ _IMAGENET_CFG = {
 }
 
 
+class Trunk(Module):
+    """ImageNet-ResNet feature trunk emitting (C2, C3, C4, C5) at strides
+    4/8/16/32 — the Mask R-CNN backbone (reference:
+    models/maskrcnn/MaskRCNN.scala builds its FPN on the ResNet-50 trunk;
+    same blocks as :func:`build`, with the classifier head dropped)."""
+
+    def __init__(self, depth: int = 50, name=None):
+        super().__init__(name or f"ResNet{depth}-trunk")
+        kind, reps = _IMAGENET_CFG[depth]
+        block = _basic_block if kind == "basic" else _bottleneck
+        expansion = 1 if kind == "basic" else 4
+        self.add_child("stem", nn.Sequential(
+            *_conv_bn(3, 64, 7, 2, 3, relu=True, name="stem"),
+            nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1)))
+        nin = 64
+        self.channels = []
+        for stage, (width, rep) in enumerate(zip([64, 128, 256, 512],
+                                                 reps)):
+            blocks = []
+            for i in range(rep):
+                stride = 2 if (stage > 0 and i == 0) else 1
+                blocks.append(block(nin, width, stride,
+                                    name=f"s{stage}b{i}"))
+                nin = width * expansion
+            self.add_child(f"layer{stage}", nn.Sequential(*blocks))
+            self.channels.append(nin)
+
+    def _apply(self, params, state, x, *, training=False, rng=None):
+        new_state = {}
+        x, new_state["stem"] = self.children()["stem"].apply(
+            params["stem"], state["stem"], x, training=training)
+        outs = []
+        for stage in range(4):
+            key = f"layer{stage}"
+            x, new_state[key] = self.children()[key].apply(
+                params[key], state[key], x, training=training)
+            outs.append(x)
+        return tuple(outs), new_state
+
+
+def trunk(depth: int = 50) -> Trunk:
+    """C2..C5 pyramid trunk (Mask R-CNN / FPN backbone)."""
+    return Trunk(depth)
+
+
 def build(depth: int = 50, class_num: int = 1000) -> nn.Sequential:
     """ImageNet ResNet (reference: ResNet.scala ImageNet branch,
     TrainImageNet.scala uses ResNet-50). Input NHWC (B, 224, 224, 3)."""

@@ -1,0 +1,89 @@
+"""PredictionService under concurrency (VERDICT r3 next #8; reference:
+optim/PredictionService.scala:56-66 — a BlockingQueue of `instanceNum`
+shallow model copies serves concurrent requests; here pure jitted
+functions are reentrant, so the contract to prove is: many threads with
+mixed batch sizes all get THEIR OWN correct rows back, and the
+power-of-two bucketing keeps the compile count bounded)."""
+
+import threading
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.optim.predictor import PredictionService
+
+MAX_BATCH = 64
+
+
+def _service():
+    model = nn.Sequential(nn.Linear(12, 32), nn.Tanh(), nn.Linear(32, 5))
+    params, state = model.init(jax.random.PRNGKey(0))
+    svc = PredictionService(model, params, state, instance_num=4,
+                            max_batch=MAX_BATCH)
+    ref = jax.jit(lambda x: model.apply(params, state, x,
+                                        training=False)[0])
+    return svc, ref
+
+
+def test_threaded_stress_mixed_batch_sizes():
+    svc, ref = _service()
+    r = np.random.RandomState(0)
+    n_threads, per_thread = 8, 25
+    requests = [[r.randn(int(r.randint(1, 41)), 12).astype(np.float32)
+                 for _ in range(per_thread)] for _ in range(n_threads)]
+    expected = [[np.asarray(ref(jnp.asarray(q))) for q in qs]
+                for qs in requests]
+
+    errors = []
+    results = [[None] * per_thread for _ in range(n_threads)]
+
+    def client(ti):
+        try:
+            for qi, q in enumerate(requests[ti]):
+                results[ti][qi] = svc.predict(q)
+        except Exception as exc:           # surfaced after join
+            errors.append((ti, repr(exc)))
+
+    threads = [threading.Thread(target=client, args=(ti,))
+               for ti in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+
+    for ti in range(n_threads):
+        for qi in range(per_thread):
+            got = results[ti][qi]
+            want = expected[ti][qi]
+            assert got.shape == want.shape
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6,
+                                       err_msg=f"thread {ti} req {qi}")
+
+
+def test_compile_count_stays_bounded():
+    """Power-of-two padding means at most log2(max_batch)+1 distinct
+    shapes ever reach XLA, no matter what request sizes arrive."""
+    svc, _ = _service()
+    r = np.random.RandomState(1)
+    for _ in range(50):
+        svc.predict(r.randn(int(r.randint(1, MAX_BATCH + 1)), 12)
+                    .astype(np.float32))
+    # jax's jit cache counts one entry per distinct padded shape
+    n_compiles = svc._fn._cache_size()
+    import math
+    assert n_compiles <= int(math.log2(MAX_BATCH)) + 1, n_compiles
+
+
+def test_oversized_request_chunks_correctly():
+    """Requests larger than max_batch stream through in max_batch chunks
+    and still return every row."""
+    svc, ref = _service()
+    r = np.random.RandomState(2)
+    x = r.randn(3 * MAX_BATCH + 7, 12).astype(np.float32)
+    got = svc.predict(x)
+    want = np.asarray(ref(jnp.asarray(x)))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)

@@ -305,6 +305,77 @@ def test_port_only_input_cut_rejects_port0_consumers():
         to_module(g, inputs=["src:1"], outputs=["m0"])
 
 
+def test_partial_trailing_batch_is_delivered(tmp_path):
+    """Regression: records not divisible by the dequeue batch must still
+    all train (QueueDequeueUpToV2 semantics) — and a sub-batch-size
+    dataset must not silently yield zero batches."""
+    files, all_x, _ = _write_records(tmp_path, n_files=1, per_file=10)
+    g = _pipeline_graphdef(files, batch=8)
+    ds = extract_input_pipeline(g, outputs=["logits"]).dataset()
+    sizes = [xb.shape[0] for xb, _ in ds]
+    assert sizes == [8, 2]
+    # fewer records than one batch: one partial batch, not zero
+    (tmp_path / "sub").mkdir(exist_ok=True)
+    files2, _, _ = _write_records(tmp_path / "sub", n_files=1, per_file=3)
+    g2 = _pipeline_graphdef(files2, batch=8)
+    sizes2 = [xb.shape[0] for xb, _ in
+              extract_input_pipeline(g2, outputs=["logits"]).dataset()]
+    assert sizes2 == [3]
+
+
+def test_enqueue_many_rows_are_split(tmp_path):
+    """QueueEnqueueManyV2 into the example queue: each decoded row is an
+    individual element (TF semantics), not a rank+1 pseudo-example."""
+    files, all_x, all_y = _write_records(tmp_path, n_files=1, per_file=8)
+    # same pipeline but each record's tensors get a leading length-1 axis
+    # and the enqueue becomes EnqueueMany
+    g = _pipeline_graphdef(files, batch=4)
+    nodes = []
+    for name in g.order:
+        nodes.append(g.nodes[name])
+    import copy
+    # rebuild graphdef with ExpandDims before an EnqueueMany
+    base = [make_node("files", "Const", strings=[f.encode()
+                                                 for f in files]),
+            make_node("fq", "FIFOQueueV2"),
+            make_node("fq_enq", "QueueEnqueueManyV2", ["fq", "files"]),
+            make_node("reader", "TFRecordReaderV2"),
+            make_node("read", "ReaderReadV2", ["reader", "fq"]),
+            make_node("img_def", "Const", strings=[b""]),
+            make_node("lab_def", "Const", tensor=np.asarray([0], np.int32)),
+            make_node("parse", "ParseSingleExample",
+                      ["read:1", "img_def", "lab_def"],
+                      scalars={"num_sparse": 0},
+                      str_lists={"dense_keys": ["image", "label"]}),
+            make_node("decode", "DecodeRaw", ["parse"],
+                      types={"out_type": 4}),
+            make_node("castf", "Cast", ["decode"],
+                      types={"DstT": DT_FLOAT}),
+            make_node("axis0", "Const", tensor=np.asarray(0, np.int32)),
+            make_node("img_row", "ExpandDims", ["castf", "axis0"]),
+            make_node("lab32", "Cast", ["parse:1"],
+                      types={"DstT": DT_INT32}),
+            make_node("eq", "FIFOQueueV2"),
+            make_node("eq_enq", "QueueEnqueueManyV2",
+                      ["eq", "img_row", "lab32"]),
+            make_node("bn", "Const", tensor=np.asarray(4, np.int32)),
+            make_node("deq", "QueueDequeueManyV2", ["eq", "bn"]),
+            make_node("w", "Const",
+                      tensor=np.zeros((16, 2), np.float32)),
+            make_node("logits", "MatMul", ["deq", "w"])]
+    del copy, nodes
+    g2 = _graph(base)
+    ex = extract_input_pipeline(g2, outputs=["logits"])
+    assert ex.enqueue_many
+    batches = list(ex.dataset())
+    assert [b[0].shape for b in batches] == [(4, 16), (4, 16)]
+    got = np.concatenate([b[0] for b in batches])
+    np.testing.assert_allclose(got, np.stack(all_x).astype(np.float32),
+                               rtol=1e-6)
+    np.testing.assert_array_equal(
+        np.concatenate([b[1] for b in batches]), all_y)
+
+
 def test_example_bytes_feature_keeps_trailing_nul():
     """Regression: encode_example routed [bytes] lists through np.asarray,
     whose 'S' dtype silently strips trailing 0x00 — any raw-bytes image
