@@ -111,10 +111,31 @@ class MoELM:
         return ce + reg, (ce, aux)
 
     # -------------------------------------------------------------- steps
+    def _dp(self, mesh: Mesh):
+        """Composed data axis: batch shards over (data, expert) together
+        (dp×ep — each data group runs its own all_to_all expert exchange
+        over 'expert'; experts replicate across 'data')."""
+        from bigdl_tpu.parallel.mesh import composed_data_axis
+        return composed_data_axis(mesh)
+
+    def _batch_axes(self, mesh: Mesh):
+        dp = self._dp(mesh)
+        return (self.expert_axis,) if dp is None \
+            else (dp, self.expert_axis)
+
+    def _world(self, mesh: Mesh) -> int:
+        world = 1
+        for a in self._batch_axes(mesh):
+            world *= mesh.shape[a]
+        return world
+
     def _build_step(self, mesh: Mesh):
         from jax import shard_map
         ax = self.expert_axis
-        n = mesh.shape[ax]
+        dp = self._dp(mesh)
+        baxes = self._batch_axes(mesh)
+        world = self._world(mesh)
+        batch_spec = P(baxes, None)
 
         specs = self._param_specs()
 
@@ -122,29 +143,34 @@ class MoELM:
             def loss_fn(p):
                 # local contribution (see long_context_lm.py on why the
                 # psum happens after differentiation)
-                return self._objective(p, xt, yt, True, n)
+                return self._objective(p, xt, yt, True, world)
             (local_loss, (ce, aux)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
-            loss = jax.lax.psum(local_loss, ax)
-            ce = jax.lax.psum(ce, ax)
-            # REPLICATED params' grads all-reduce; expert-SHARDED leaves
-            # (w_up/w_down) do not — each expert's gradient is computed
-            # entirely on its owner device, and a psum would add
+            loss = jax.lax.psum(local_loss, baxes)
+            ce = jax.lax.psum(ce, baxes)
+            # REPLICATED params' grads all-reduce over every batch axis;
+            # expert-SHARDED leaves (w_up/w_down) all-reduce only over
+            # 'data' (replicated there) — a psum over 'expert' would add
             # different experts' grads into each other's slots
             out = {}
             for k, g in grads.items():
                 s = specs[k]
                 if isinstance(s, dict):
-                    out[k] = {kk: (jax.lax.psum(gg, ax) if s[kk] == P()
-                                   else gg)
-                              for kk, gg in g.items()}
+                    out[k] = {}
+                    for kk, gg in g.items():
+                        if s[kk] == P():
+                            out[k][kk] = jax.lax.psum(gg, baxes)
+                        elif dp is not None:
+                            out[k][kk] = jax.lax.psum(gg, dp)
+                        else:
+                            out[k][kk] = gg
                 else:
                     out[k] = jax.tree.map(
-                        lambda a: jax.lax.psum(a, ax), g)
+                        lambda a: jax.lax.psum(a, baxes), g)
             return loss, ce, aux, out
         return jax.jit(shard_map(
             step, mesh=mesh,
-            in_specs=(self._param_specs(), P(ax, None), P(ax, None)),
+            in_specs=(self._param_specs(), batch_spec, batch_spec),
             out_specs=(P(), P(), P(), self._param_specs()),
             check_vma=False))
 
@@ -160,35 +186,37 @@ class MoELM:
         return specs
 
     def _place(self, params, mesh):
+        from bigdl_tpu.parallel.mesh import host_array_to_global
         specs = self._param_specs()
         out = {}
         for k, v in params.items():
             s = specs[k]
             if isinstance(s, dict):
-                out[k] = {kk: jax.device_put(
-                    vv, NamedSharding(mesh, s[kk]))
-                    for kk, vv in v.items()}
+                out[k] = {kk: host_array_to_global(vv, mesh, s[kk])
+                          for kk, vv in v.items()}
             else:
                 out[k] = jax.tree.map(
-                    lambda a, sh=s: jax.device_put(
-                        a, NamedSharding(mesh, sh)), v)
+                    lambda a, sh=s: host_array_to_global(a, mesh, sh), v)
         return out
 
     def loss_and_grads(self, params, x_tokens, y_tokens, mesh: Mesh):
+        from bigdl_tpu.parallel.mesh import host_array_to_global
         n = mesh.shape[self.expert_axis]
+        world = self._world(mesh)
         if self.moes[0].n_experts % n:
             raise ValueError(f"expert-axis size {n} must divide expert "
                              f"count {self.moes[0].n_experts}")
-        if x_tokens.shape[0] % n:
-            raise ValueError(f"expert-axis size {n} must divide batch "
+        if x_tokens.shape[0] % world:
+            raise ValueError(f"batch axes size {world} must divide batch "
                              f"{x_tokens.shape[0]}")
         key = mesh
         if key not in self._compiled:
             self._compiled[key] = self._build_step(mesh)
         params = self._place(params, mesh)
-        sh = NamedSharding(mesh, P(self.expert_axis, None))
-        return self._compiled[key](params, jax.device_put(x_tokens, sh),
-                                   jax.device_put(y_tokens, sh))
+        spec = P(self._batch_axes(mesh), None)
+        return self._compiled[key](
+            params, host_array_to_global(x_tokens, mesh, spec),
+            host_array_to_global(y_tokens, mesh, spec))
 
     def train_step(self, params, x_tokens, y_tokens, mesh: Mesh,
                    lr: float = 1e-3, method=None, slots=None):

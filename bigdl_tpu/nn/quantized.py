@@ -50,33 +50,65 @@ def _dynamic_input_scale(x, sample_axes) -> jnp.ndarray:
     return jnp.maximum(amax, 1e-12) / 127.0
 
 
+def quantize_weight_blocked(w, block: int
+                            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-window int8 for (in, out) weights: one scale per `block` input
+    rows per output channel — BigQuant's finer min/max window granularity
+    (reference: tensor/QuantizedTensor.scala per-window descriptors,
+    nn/quantized/Desc.scala). Returns (q (nb, block, out),
+    scales (nb, 1, out)); the in-dim is zero-padded to a block multiple."""
+    w = np.asarray(w, np.float32)
+    n_in, n_out = w.shape
+    nb = -(-n_in // block)
+    pad = nb * block - n_in
+    if pad:
+        w = np.concatenate([w, np.zeros((pad, n_out), np.float32)], 0)
+    wb = w.reshape(nb, block, n_out)
+    amax = np.abs(wb).max(axis=1, keepdims=True)
+    scale = np.maximum(amax, 1e-12) / 127.0
+    q = np.clip(np.round(wb / scale), -127, 127).astype(np.int8)
+    return jnp.asarray(q), jnp.asarray(scale, jnp.float32)
+
+
 class QuantizedLinear(Module):
-    """(reference: nn/quantized/Linear.scala:79-90)."""
+    """(reference: nn/quantized/Linear.scala:79-90). `weight_block`
+    switches from per-output-channel scales to BigQuant-granularity
+    per-window scales (one per `weight_block` input rows per channel)."""
+
+    weight_block = None   # class default: pickles from before the option
 
     def __init__(self, in_features: int, out_features: int, bias: bool = True,
                  input_scale: Optional[float] = None,
-                 use_pallas: Optional[bool] = None, name=None):
+                 use_pallas: Optional[bool] = None,
+                 weight_block: Optional[int] = None, name=None):
         super().__init__(name or "QuantizedLinear")
         self.in_features, self.out_features = in_features, out_features
         self.has_bias = bias
         self.input_scale = input_scale      # static (calibrated) or dynamic
         # None = auto: the fused Pallas kernel on TPU, XLA dot elsewhere
         self.use_pallas = use_pallas
+        self.weight_block = weight_block
 
     @classmethod
     def from_float(cls, layer: Linear, params: Dict,
-                   input_scale: Optional[float] = None
+                   input_scale: Optional[float] = None,
+                   weight_block: Optional[int] = None
                    ) -> Tuple["QuantizedLinear", Dict]:
         m = cls(layer.in_features, layer.out_features,
                 bias="bias" in params, input_scale=input_scale,
-                name=layer.name)
-        qw, sw = quantize_weight(params["weight"], axis=1)   # (in, out)
+                weight_block=weight_block, name=layer.name)
+        if weight_block:
+            qw, sw = quantize_weight_blocked(params["weight"], weight_block)
+        else:
+            qw, sw = quantize_weight(params["weight"], axis=1)  # (in, out)
         qp = {"weight_q": qw, "weight_scale": sw}
         if "bias" in params:
             qp["bias"] = jnp.asarray(params["bias"], jnp.float32)
         return m, qp
 
     def _pallas_enabled(self) -> bool:
+        if self.weight_block:
+            return False        # the fused kernel is per-channel only
         if self.use_pallas is not None:
             return self.use_pallas
         return jax.default_backend() == "tpu"
@@ -96,10 +128,24 @@ class QuantizedLinear(Module):
         else:
             sx = _dynamic_input_scale(x, sample_axes=(-1,))
         xq = jnp.clip(jnp.round(x / sx), -127, 127).astype(jnp.int8)
-        acc = lax.dot_general(
-            xq, params["weight_q"], (((x.ndim - 1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32)
-        y = acc.astype(jnp.float32) * sx * params["weight_scale"][0]
+        if self.weight_block:
+            wq, sw = params["weight_q"], params["weight_scale"]
+            nb, bs = wq.shape[0], wq.shape[1]
+            pad = nb * bs - xq.shape[-1]
+            if pad:
+                xq = jnp.concatenate(
+                    [xq, jnp.zeros(xq.shape[:-1] + (pad,), jnp.int8)], -1)
+            xb = xq.reshape(xq.shape[:-1] + (nb, bs))
+            # per-block int32 accumulation, per-window dequant, then sum
+            acc = jnp.einsum("...nk,nko->...no", xb, wq,
+                             preferred_element_type=jnp.int32)
+            y = jnp.sum(acc.astype(jnp.float32) * sw[:, 0, :], axis=-2)
+            y = y * sx      # (…, 1) dynamic or scalar static — broadcasts
+        else:
+            acc = lax.dot_general(
+                xq, params["weight_q"], (((x.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            y = acc.astype(jnp.float32) * sx * params["weight_scale"][0]
         if self.has_bias:
             y = y + params["bias"]
         return y.astype(orig_dtype)
@@ -161,20 +207,24 @@ _QUANTIZABLE = {Linear: QuantizedLinear,
 
 def quantize(module: Module, params: Dict,
              input_scales: Optional[Dict[str, float]] = None,
-             _path: str = "") -> Tuple[Module, Dict]:
+             _path: str = "",
+             weight_block: Optional[int] = None) -> Tuple[Module, Dict]:
     """Walk the module tree replacing supported layers with int8 versions and
     converting their params (reference: nn/quantized/Quantizer.scala:27-129).
     Containers are rebuilt in place structurally (children swapped); modules
     with exotic `_apply` overrides keep their float children untouched.
 
     `input_scales` maps '/'-joined child paths to calibrated static input
-    scales (see `calibrate`)."""
+    scales (see `calibrate`). `weight_block` turns on per-window weight
+    scales for Linear layers (BigQuant granularity)."""
     import copy
     input_scales = input_scales or {}
     cls = type(module)
     if cls in _QUANTIZABLE:
-        return _QUANTIZABLE[cls].from_float(
-            module, params, input_scale=input_scales.get(_path))
+        kw = {"input_scale": input_scales.get(_path)}
+        if _QUANTIZABLE[cls] is QuantizedLinear and weight_block:
+            kw["weight_block"] = weight_block
+        return _QUANTIZABLE[cls].from_float(module, params, **kw)
     from bigdl_tpu.core.container import Graph, Input as GraphInput, Node
     if isinstance(module, Graph):
         # Graph executes node.module, not _children — rebuild the DAG with
@@ -185,7 +235,7 @@ def quantize(module: Module, params: Dict,
         for key, child in module.children().items():
             cpath = f"{_path}/{key}" if _path else key
             qmods[key], new_params[key] = quantize(
-                child, params[key], input_scales, cpath)
+                child, params[key], input_scales, cpath, weight_block)
         mapping: Dict[int, Node] = {}
         for node in module._order:          # parents precede children
             parents = [mapping[id(p)] for p in node.parents]
@@ -205,7 +255,8 @@ def quantize(module: Module, params: Dict,
     new_params = dict(params)
     for cname, child in module.children().items():
         cpath = f"{_path}/{cname}" if _path else cname
-        qm, qp = quantize(child, params[cname], input_scales, cpath)
+        qm, qp = quantize(child, params[cname], input_scales, cpath,
+                          weight_block)
         new_mod._children[cname] = qm
         new_params[cname] = qp
         # keep attribute aliases (e.g. self.inner) pointing at the new child

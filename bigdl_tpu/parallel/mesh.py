@@ -80,30 +80,50 @@ def create_mesh(devices: Optional[Sequence[jax.Device]] = None, *,
     return Mesh(grid, names)
 
 
-def host_rows_to_global(arr, mesh, axis_name: str):
-    """Place a host array whose LEADING dim shards over `axis_name`
-    (a 1-D mesh axis) — multi-host safe: under one process this is a
-    device_put; across processes each feeds its own rows to
-    `jax.make_array_from_process_local_data` (device_put cannot address
-    remote shards). Every process must hold identical host values.
-    Shared by Pipeline.shard/_globalize and expert_parallel_apply."""
+def composed_data_axis(mesh) -> "Optional[str]":
+    """The composed batch axis, when the mesh carries one — the dp×pp /
+    dp×ep / dp×sp composition rule shared by Pipeline, MoELM and
+    SeqParallelLM: batch shards over DATA_AXIS while the subsystem's own
+    axis carries its collectives."""
+    return DATA_AXIS if DATA_AXIS in mesh.axis_names else None
+
+
+def host_array_to_global(arr, mesh, spec):
+    """Place a host array (identical on every process) as a global array
+    sharded by `spec` over `mesh` — multi-host safe for ANY mesh rank:
+    under one process this is a device_put; across processes each feeds
+    its addressable shards via `jax.make_array_from_callback` (device_put
+    cannot address remote shards). Arrays ALREADY carrying the target
+    sharding pass through untouched (so a train loop's second step does
+    not round-trip every param through the host)."""
     import numpy as np
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    spec = P(axis_name, *([None] * (arr.ndim - 1)))
+    from jax.sharding import NamedSharding
     sh = NamedSharding(mesh, spec)
+    if isinstance(arr, jax.Array) and hasattr(arr, "sharding"):
+        if arr.sharding.is_equivalent_to(sh, arr.ndim):
+            return arr
+        if not arr.is_fully_addressable:
+            raise ValueError(
+                f"cannot re-place a cross-host array from sharding "
+                f"{arr.sharding} to {sh} on the host — reshard it inside "
+                f"a jitted computation instead")
+    arr = np.asarray(arr)
     if jax.process_count() == 1:
         return jax.device_put(arr, sh)
-    if mesh.devices.ndim != 1:
-        raise NotImplementedError(
-            "host_rows_to_global assumes a 1-D mesh (the device→row "
-            "mapping below walks mesh.devices in axis order)")
-    n = mesh.shape[axis_name]
-    local = np.asarray([d.process_index == jax.process_index()
-                        for d in mesh.devices.reshape(-1)])
+    return jax.make_array_from_callback(arr.shape, sh,
+                                        lambda idx: arr[idx])
+
+
+def host_rows_to_global(arr, mesh, axis_name: str):
+    """Place a host array whose LEADING dim shards over `axis_name`;
+    other mesh axes (if any) replicate. Every process must hold identical
+    host values. Shared by Pipeline.shard/_globalize and
+    expert_parallel_apply."""
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
     arr = np.asarray(arr)
-    rows = arr.reshape((n, -1) + arr.shape[1:])[local].reshape(
-        (-1,) + arr.shape[1:])
-    return jax.make_array_from_process_local_data(sh, rows)
+    spec = P(axis_name, *([None] * (arr.ndim - 1)))
+    return host_array_to_global(arr, mesh, spec)
 
 
 class Engine:

@@ -270,13 +270,26 @@ class Pipeline:
         return xs, mb
 
     @staticmethod
-    def _globalize(arr, mesh):
-        """Multi-host-safe placement of a stage-major host array (see
-        parallel.mesh.host_rows_to_global)."""
-        if jax.process_count() == 1:
+    def _dp(mesh) -> Optional[str]:
+        """The composed data axis, when the mesh carries one — batch
+        (microbatch rows) shards over it while stages shard over 'pipe'
+        (dp×pp, the hierarchical layout real slices use: dp over DCN,
+        pp over ICI)."""
+        from bigdl_tpu.parallel.mesh import composed_data_axis
+        return composed_data_axis(mesh)
+
+    @classmethod
+    def _globalize(cls, arr, mesh):
+        """Multi-host-safe placement of a stage-major host array: stage
+        dim over 'pipe', microbatch rows over 'data' when composed."""
+        if jax.process_count() == 1 and mesh.devices.ndim == 1:
             return arr                     # jit's in_specs place it
-        from bigdl_tpu.parallel.mesh import host_rows_to_global
-        return host_rows_to_global(np.asarray(arr), mesh, PIPE_AXIS)
+        from bigdl_tpu.parallel.mesh import host_array_to_global
+        dp = cls._dp(mesh)
+        arr = np.asarray(arr)
+        spec = P(PIPE_AXIS, None, dp,
+                 *([None] * (arr.ndim - 3)))
+        return host_array_to_global(arr, mesh, spec)
 
     def _check(self, mb_shape, dtype):
         sd = jax.ShapeDtypeStruct(mb_shape, dtype)
@@ -364,16 +377,21 @@ class Pipeline:
             outs0 = jnp.zeros((M,) + h_shape, dtype)
             _, _, srow, outs = lax.fori_loop(
                 0, ticks, tick, (z, z, srow, outs0))
+            if training and dp is not None:
+                # same reduction the train path does: each dp group saw
+                # different rows, so state (e.g. BN stats) must agree
+                srow = lax.pmean(srow, dp)
             # only the last stage filled outs — psum broadcasts it so the
             # result is replicated (and host-readable under multi-host,
             # where a stage-sharded output's first rows live remotely)
             return lax.psum(outs, PIPE_AXIS), srow[None]
 
+        dp = self._dp(mesh)
         return jax.jit(shard_map(
             shard_fn, mesh=mesh,
-            in_specs=(P(PIPE_AXIS, None), P(PIPE_AXIS, None), P(PIPE_AXIS),
-                      P()),
-            out_specs=(P(), P(PIPE_AXIS, None)),
+            in_specs=(P(PIPE_AXIS, None), P(PIPE_AXIS, None),
+                      P(PIPE_AXIS, None, dp), P()),
+            out_specs=(P(None, dp), P(PIPE_AXIS, None)),
             check_vma=False))
 
     # ------------------------------------------------- 1F1B training step
@@ -539,14 +557,30 @@ class Pipeline:
             dx = lax.psum(dx_buf, PIPE_AXIS) / M
             d_lp = jax.tree.map(lambda g: lax.psum(g, PIPE_AXIS) / M,
                                 lp_acc)
+            grads = grad_acc[None] / M
+            if dp is not None:
+                # dp×pp composition: loss_fn saw only the local microbatch
+                # rows — average loss/grads/head-grads over the data axis.
+                # dx stays data-sharded (each group owns its rows) but the
+                # per-row scale must match the GLOBAL-mean loss: the local
+                # mean over mb/n_dp rows makes each row's grad n_dp× too
+                # large.
+                n_dp = lax.psum(1, dp)
+                loss = lax.pmean(loss, dp)
+                grads = lax.pmean(grads, dp)
+                d_lp = jax.tree.map(lambda g: lax.pmean(g, dp), d_lp)
+                srow = lax.pmean(srow, dp)
+                dx = dx / n_dp
             # loss/dx/d_lp are psum'd → uniform across shards → returned
             # replicated, so they stay host-readable under multi-host
-            return (loss, grad_acc[None] / M, srow[None], dx, d_lp)
+            return (loss, grads, srow[None], dx, d_lp)
 
+        dp = self._dp(mesh)
         return jax.jit(shard_map(
             shard_fn, mesh=mesh,
-            in_specs=(P(PIPE_AXIS, None), P(PIPE_AXIS, None), P(PIPE_AXIS),
-                      P(PIPE_AXIS), P(), P()),
+            in_specs=(P(PIPE_AXIS, None), P(PIPE_AXIS, None),
+                      P(PIPE_AXIS, None, dp), P(PIPE_AXIS, None, dp),
+                      P(), P()),
             out_specs=(P(), P(PIPE_AXIS, None),
-                       P(PIPE_AXIS, None), P(), P()),
+                       P(PIPE_AXIS, None), P(None, dp), P()),
             check_vma=False))

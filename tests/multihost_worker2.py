@@ -1,0 +1,160 @@
+"""Worker for the 4-process composed-mesh test (launched by
+tests/test_multihost.py::test_four_process_composed). 4 processes × 2
+devices = 8 global devices; every composed axis SPANS processes
+(reference: the multi-node sync of optim/DistriOptimizer.scala §3.2 —
+here the collectives ride the jax.distributed CPU backend the way
+ICI/DCN carry them on a real slice):
+
+  * dp×pp (2×4): Pipeline 1F1B with batch over 'data' and stages over
+    'pipe', loss + stage grads asserted EQUAL to a locally computed
+    dense reference
+  * dp×ep (2×4): MoELM with batch over ('data','expert') and experts
+    over 'expert', loss + every grad leaf asserted equal to the local
+    dense objective (regularizers off — per-shard stats otherwise)
+  * dp×sp (2×4): SeqParallelLM batch over 'data', sequence over 'seq'
+  * a DistriOptimizer run on the full 8-device dp mesh + checkpoint
+    (consumed by the elastic-resume phase, which reloads it under TWO
+    processes — reference: driver retry re-init,
+    optim/DistriOptimizer.scala:886-963)
+
+Prints one JSON line the launcher asserts on."""
+
+import json
+import os
+import sys
+
+
+def main():
+    port, pid, tmpdir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=2")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from bigdl_tpu.parallel.mesh import Engine
+    Engine.init(coordinator_address=f"127.0.0.1:{port}",
+                num_processes=4, process_id=pid)
+    report = {"pid": pid, "process_count": jax.process_count(),
+              "device_count": jax.device_count()}
+    devices = np.asarray(jax.devices())
+
+    import bigdl_tpu.nn as nn
+
+    # ---------- dp×pp across 4 processes
+    from bigdl_tpu.parallel.pipeline import Pipeline
+    mesh_dp_pp = Mesh(devices.reshape(2, 4), ("data", "pipe"))
+    pipe = Pipeline(nn.Linear(6, 6), n_stages=4, n_microbatches=4)
+    pv_host = pipe.init(jax.random.PRNGKey(2))
+    pv = pipe.shard(pv_host, mesh_dp_pp)
+    r = np.random.RandomState(2)
+    x = jnp.asarray(r.randn(8, 6), jnp.float32)
+    y = jnp.asarray(r.randn(8, 6), jnp.float32)
+
+    def mse(h, t):
+        return jnp.mean((h - t) ** 2)
+
+    loss, grads, _ = pipe.train_step(pv, x, y, mse, mesh_dp_pp)
+
+    def ref_loss(flat):
+        M = pipe.n_microbatches
+        mb = x.shape[0] // M
+        total = 0.0
+        for m in range(M):
+            h = x[m * mb:(m + 1) * mb]
+            for i, stage in enumerate(pipe.stages):
+                p = pipe._p_meta[i].unflatten(flat[i])
+                s = pipe._s_meta[i].unflatten(pv_host["state"][i])
+                h, _ = stage.apply(p, s, h, training=True,
+                                   rng=jax.random.PRNGKey(0))
+            total = total + mse(h, y[m * mb:(m + 1) * mb])
+        return total / M
+
+    def shards_match(garr, want, rtol=1e-3, atol=1e-5):
+        """Cross-host sharded arrays aren't host-fetchable — compare the
+        rows THIS process owns against the reference."""
+        return all(np.allclose(np.asarray(s.data), want[s.index],
+                               rtol=rtol, atol=atol)
+                   for s in garr.addressable_shards)
+
+    want_loss = float(ref_loss(pv_host["flat"]))
+    want_grads = np.asarray(jax.grad(ref_loss)(
+        jnp.asarray(pv_host["flat"])))
+    report["dp_pp_loss"] = float(loss)
+    report["dp_pp_ok"] = bool(
+        abs(float(loss) - want_loss) < 1e-4
+        and shards_match(grads, want_grads))
+
+    # ---------- dp×ep across 4 processes
+    from bigdl_tpu.models.moe_lm import MoELM
+    lm = MoELM(13, d_model=16, num_heads=2, num_layers=1, n_experts=4,
+               dropless=True, lb_coef=0.0, z_coef=0.0)
+    params = lm.init(jax.random.PRNGKey(6))
+    toks = np.random.RandomState(6).randint(0, 13, (8, 6))
+    xt = jnp.asarray(toks)
+    yt = jnp.asarray(np.roll(toks, -1, axis=1))
+    mesh_dp_ep = Mesh(devices.reshape(2, 4), ("data", "expert"))
+    l2, ce2, _, g2 = lm.loss_and_grads(params, xt, yt, mesh_dp_ep)
+    dense_loss, _ = lm.dense_objective(params, xt, yt)
+    g_dense = jax.grad(lambda p: lm.dense_objective(p, xt, yt)[0])(params)
+    grads_ok = all(
+        shards_match(a, np.asarray(b))
+        for a, b in zip(jax.tree.leaves(g2), jax.tree.leaves(g_dense)))
+    report["dp_ep_loss"] = float(l2)
+    report["dp_ep_ok"] = bool(
+        abs(float(l2) - float(dense_loss)) < 1e-4 and grads_ok)
+
+    # ---------- dp×sp across 4 processes
+    from bigdl_tpu.models.long_context_lm import SeqParallelLM
+    from bigdl_tpu.parallel.mesh import create_mesh
+    mesh_dp_sp = create_mesh(jax.devices(), seq=4)     # data=2 × seq=4
+    slm = SeqParallelLM(13, d_model=16, num_heads=2, num_layers=1)
+    sp = slm.init(jax.random.PRNGKey(1))
+    stoks = np.random.RandomState(5).randint(0, 13, (4, 8))
+    sp_losses = []
+    for _ in range(3):
+        sp, sloss = slm.train_step(
+            sp, jnp.asarray(stoks), jnp.asarray(np.roll(stoks, -1, 1)),
+            mesh_dp_sp, lr=0.05)
+        sp_losses.append(float(sloss))
+    report["dp_sp_ok"] = bool(np.isfinite(sp_losses[-1])
+                              and sp_losses[-1] < sp_losses[0])
+
+    # ---------- 8-device dp training + checkpoint for elastic resume
+    from bigdl_tpu.nn.criterion import ClassNLLCriterion
+    from bigdl_tpu.optim.method import SGD
+    from bigdl_tpu.optim.trigger import Trigger
+    from bigdl_tpu.parallel.distri import DistriOptimizer
+    from bigdl_tpu.dataset import ArrayDataSet
+    from bigdl_tpu.parallel.mesh import create_mesh as _cm
+
+    dmesh = _cm(jax.devices())                         # pure dp over 8
+    r2 = np.random.RandomState(0)
+    X = r2.randn(128, 8).astype(np.float32)
+    Y = (X[:, :4].sum(1) > X[:, 4:].sum(1)).astype(np.int32)
+    per = 128 // 4
+    Xl, Yl = X[pid * per:(pid + 1) * per], Y[pid * per:(pid + 1) * per]
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2),
+                          nn.LogSoftMax())
+    ds = ArrayDataSet(Xl, Yl, batch_size=16, shuffle=False,
+                      drop_last=True)
+    opt = DistriOptimizer(model, ds, ClassNLLCriterion(), SGD(0.3),
+                          mesh=dmesh)
+    opt.set_end_when(Trigger.max_epoch(6))
+    params4, _ = opt.optimize()
+    report["train_loss"] = float(opt.state["loss"])
+    report["neval"] = int(opt.state["neval"])
+
+    from bigdl_tpu.utils import checkpoint as ckpt
+    ck = os.path.join(tmpdir, "elastic")
+    ckpt.save_checkpoint(ck, {"params": params4},
+                         dict(opt.state))
+    report["ckpt_saved"] = True
+
+    print("REPORT " + json.dumps(report), flush=True)
+
+
+if __name__ == "__main__":
+    main()

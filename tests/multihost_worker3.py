@@ -1,0 +1,69 @@
+"""Elastic-resume worker: TWO processes (4 devices) pick up the
+checkpoint the FOUR-process run of multihost_worker2.py wrote, rebuild
+the optimizer on the smaller mesh, and keep training (reference: the
+driver retry loop re-initializing from the latest snapshot with whatever
+resources remain, optim/DistriOptimizer.scala:886-963; SURVEY §5
+checkpoint-restart on slice reconfiguration)."""
+
+import json
+import os
+import sys
+
+
+def main():
+    port, pid, tmpdir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=2")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from bigdl_tpu.parallel.mesh import Engine, create_mesh
+    Engine.init(coordinator_address=f"127.0.0.1:{port}",
+                num_processes=2, process_id=pid)
+    report = {"pid": pid, "process_count": jax.process_count(),
+              "device_count": jax.device_count()}
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.nn.criterion import ClassNLLCriterion
+    from bigdl_tpu.optim.method import SGD
+    from bigdl_tpu.optim.trigger import Trigger
+    from bigdl_tpu.parallel.distri import DistriOptimizer
+    from bigdl_tpu.dataset import ArrayDataSet
+    from bigdl_tpu.utils import checkpoint as ckpt
+
+    trees, meta = ckpt.load_checkpoint(os.path.join(tmpdir, "elastic"))
+    report["resumed_neval"] = int(meta["neval"])
+    report["resumed_loss"] = float(meta["loss"])
+
+    # same global dataset, now split across TWO processes (the surviving
+    # resources see all the data, just fewer shards)
+    r = np.random.RandomState(0)
+    X = r.randn(128, 8).astype(np.float32)
+    Y = (X[:, :4].sum(1) > X[:, 4:].sum(1)).astype(np.int32)
+    per = 128 // 2
+    Xl, Yl = X[pid * per:(pid + 1) * per], Y[pid * per:(pid + 1) * per]
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2),
+                          nn.LogSoftMax())
+    ds = ArrayDataSet(Xl, Yl, batch_size=32, shuffle=False,
+                      drop_last=True)
+    mesh = create_mesh(jax.devices())                   # 4-device dp
+    opt = DistriOptimizer(model, ds, ClassNLLCriterion(), SGD(0.3),
+                          mesh=mesh)
+    opt.set_initial(trees["params"])
+    opt.state.update({k: meta[k] for k in ("neval", "epoch", "records")
+                      if k in meta})
+    start_neval = int(opt.state["neval"])
+    opt.set_end_when(Trigger.max_epoch(int(meta.get("epoch", 0)) + 4))
+    params, _ = opt.optimize()
+    report["final_loss"] = float(opt.state["loss"])
+    report["final_neval"] = int(opt.state["neval"])
+    report["continued"] = bool(report["final_neval"] > start_neval)
+    # resumed training must not regress: it continues from the 4-process
+    # run's weights, so loss stays at/below where that run ended + noise
+    report["loss_ok"] = report["final_loss"] <= report["resumed_loss"] + 0.1
+    print("REPORT " + json.dumps(report), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -20,6 +20,62 @@ def _free_port():
     return port
 
 
+def _launch(worker_name, n_procs, tmp_path, port):
+    worker = os.path.join(os.path.dirname(__file__), worker_name)
+    repo = os.path.dirname(os.path.dirname(worker))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)               # worker sets its own
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(port), str(pid), str(tmp_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        cwd=repo)
+        for pid in range(n_procs)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=480)
+            outs.append(out.decode())
+    finally:
+        for p in procs:                      # no orphans on deadlock
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    reports = []
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        lines = [l for l in out.splitlines() if l.startswith("REPORT ")]
+        assert lines, f"no report in:\n{out}"
+        reports.append(json.loads(lines[0][len("REPORT "):]))
+    return reports
+
+
+def test_four_process_composed_and_elastic_resume(tmp_path):
+    """4 processes × 2 devices: dp×pp, dp×ep, dp×sp composed meshes all
+    spanning processes with dense-parity assertions, then the SAME
+    checkpoint resumed under 2 processes (elastic, reference:
+    optim/DistriOptimizer.scala:886-963)."""
+    reports = _launch("multihost_worker2.py", 4, tmp_path, _free_port())
+    for rep in reports:
+        assert rep["process_count"] == 4
+        assert rep["device_count"] == 8
+        assert rep["dp_pp_ok"], rep
+        assert rep["dp_ep_ok"], rep
+        assert rep["dp_sp_ok"], rep
+        assert rep["ckpt_saved"], rep
+        assert rep["train_loss"] < 0.4, rep
+
+    # elastic: resume the 4-process snapshot under 2 processes
+    reports2 = _launch("multihost_worker3.py", 2, tmp_path, _free_port())
+    for rep in reports2:
+        assert rep["process_count"] == 2
+        assert rep["device_count"] == 4
+        assert rep["resumed_neval"] == reports[0]["neval"]
+        assert rep["continued"], rep
+        assert rep["loss_ok"], rep
+
+
 def test_two_process_training(tmp_path):
     port = _free_port()
     worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
