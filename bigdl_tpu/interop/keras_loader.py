@@ -443,10 +443,38 @@ def _rnn_cell(cls: str, cfg, cin: int):
             return p
         return cell, adapt
     if cls == "GRU":
-        if cfg.get("reset_after", False):
+        if cfg.get("activation", "tanh") not in (None, "tanh") or \
+                cfg.get("recurrent_activation", "sigmoid") != "sigmoid":
             raise NotImplementedError(
-                "GRU reset_after=True (keras 2.x CuDNN variant) — the "
-                "recurrent bias cannot fold into the packed-gate cell")
+                "GRU with non-default activations (cell hardcodes "
+                "tanh/sigmoid; keras<2.3 hard_sigmoid would silently "
+                "diverge)")
+        if cfg.get("reset_after", False):
+            # keras 2.x / CuDNN variant: reset multiplies after the
+            # recurrent matmul, separate recurrent bias (2, 3h)
+            cell = nn.GRU(cin, units, reset_after=True)
+
+            def adapt(wts):
+                p = {"w_i": _gru_reorder(np.asarray(wts[0]), units),
+                     "w_h": _gru_reorder(np.asarray(wts[1]), units)}
+                if len(wts) > 2:
+                    b = np.asarray(wts[2])
+                    if b.size == 6 * units:      # [input_bias, rec_bias]
+                        b = b.reshape(2, 3 * units)
+                        p["bias"] = _gru_reorder(b[0], units)
+                        p["rbias"] = _gru_reorder(b[1], units)
+                    elif b.size == 3 * units:    # input bias only
+                        p["bias"] = _gru_reorder(b.reshape(-1), units)
+                        p["rbias"] = np.zeros(3 * units, np.float32)
+                    else:
+                        raise ValueError(
+                            f"GRU reset_after bias has {b.size} values; "
+                            f"expected {3 * units} or {6 * units}")
+                else:
+                    p["bias"] = np.zeros(3 * units, np.float32)
+                    p["rbias"] = np.zeros(3 * units, np.float32)
+                return p
+            return cell, adapt
         cell = nn.GRU(cin, units)
         def adapt(wts):
             ki = _gru_reorder(np.asarray(wts[0]), units)
@@ -559,6 +587,35 @@ def _b_upsample2d(cfg, shapes):
     out = (b_, None if h is None else h * sh,
            None if w is None else w * sw, c)
     return nn.UpSampling2D((sh, sw)), out, _NO_W
+
+
+class _KerasReLU(Module):
+    """keras.layers.ReLU with its full parameterization:
+    f(x) = max_value-capped relu above `threshold`, negative_slope·
+    (x − threshold) below (covers ReLU/ReLU6/LeakyReLU-at-threshold)."""
+
+    def __init__(self, max_value=None, negative_slope=0.0,
+                 threshold=0.0, name=None):
+        super().__init__(name=name or "KerasReLU")
+        self.max_value = max_value
+        self.negative_slope = negative_slope
+        self.threshold = threshold
+
+    def forward(self, params, x, **_):
+        above = jnp.maximum(x, self.threshold)
+        if self.max_value is not None:
+            above = jnp.minimum(above, self.max_value)
+        below = self.negative_slope * (x - self.threshold)
+        return jnp.where(x >= self.threshold, above, below)
+
+
+def _b_relu_layer(cfg, shapes):
+    mx = cfg.get("max_value")
+    neg = cfg.get("negative_slope", 0.0) or 0.0
+    th = cfg.get("threshold", 0.0) or 0.0
+    if mx is None and neg == 0.0 and th == 0.0:
+        return nn.ReLU(), shapes[0], _NO_W
+    return (_KerasReLU(mx, neg, th), shapes[0], _NO_W)
 
 
 def _b_leakyrelu(cfg, shapes):
@@ -987,6 +1044,7 @@ _BUILDERS: Dict[str, Callable] = {
     "ZeroPadding2D": _b_zeropad2d,
     "UpSampling2D": _b_upsample2d,
     "LeakyReLU": _b_leakyrelu,
+    "ReLU": _b_relu_layer,
     "ELU": _b_elu_layer,
     "PReLU": _b_prelu,
     "Softmax": _b_softmax_layer,

@@ -174,14 +174,30 @@ class LSTMPeephole(Cell):
 class GRU(Cell):
     """GRU cell (reference: nn/GRU.scala). Packed reset/update gates; the
     candidate uses the reset-gated hidden state (standard GRU, matching the
-    reference's p=0 dense path)."""
+    reference's p=0 dense path). `reset_after=True` switches to the keras
+    2.x / CuDNN variant — the reset gate multiplies AFTER the recurrent
+    matmul, with its own recurrent bias: cand = tanh(x·Wc + b_c +
+    r·(h·Whc + rb_c))."""
 
-    def __init__(self, input_size: int, hidden_size: int, name=None):
+    reset_after = False   # class default: pickles from before the option
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 reset_after: bool = False, name=None):
         super().__init__(name)
         self.input_size, self.hidden_size = input_size, hidden_size
+        self.reset_after = reset_after
 
     def param_specs(self):
         i, h = self.input_size, self.hidden_size
+        if self.reset_after:
+            return {
+                "w_i": ParamSpec((i, 3 * h), initializers.xavier,
+                                 fan_in=i, fan_out=3 * h),
+                "w_h": ParamSpec((h, 3 * h), initializers.xavier,
+                                 fan_in=h, fan_out=3 * h),
+                "bias": ParamSpec((3 * h,), initializers.zeros),
+                "rbias": ParamSpec((3 * h,), initializers.zeros),
+            }
         return {
             "w_i": ParamSpec((i, 3 * h), initializers.xavier,
                              fan_in=i, fan_out=3 * h),
@@ -198,10 +214,17 @@ class GRU(Cell):
     def step(self, params, hidden, x):
         h = self.hidden_size
         xi = x @ params["w_i"] + params["bias"]
-        hr_hu = hidden @ params["w_h"]
-        r = jax.nn.sigmoid(xi[..., :h] + hr_hu[..., :h])
-        u = jax.nn.sigmoid(xi[..., h:2 * h] + hr_hu[..., h:])
-        cand = jnp.tanh(xi[..., 2 * h:] + (r * hidden) @ params["w_hc"])
+        if getattr(self, "reset_after", False):
+            hh = hidden @ params["w_h"] + params["rbias"]
+            r = jax.nn.sigmoid(xi[..., :h] + hh[..., :h])
+            u = jax.nn.sigmoid(xi[..., h:2 * h] + hh[..., h:2 * h])
+            cand = jnp.tanh(xi[..., 2 * h:] + r * hh[..., 2 * h:])
+        else:
+            hr_hu = hidden @ params["w_h"]
+            r = jax.nn.sigmoid(xi[..., :h] + hr_hu[..., :h])
+            u = jax.nn.sigmoid(xi[..., h:2 * h] + hr_hu[..., h:])
+            cand = jnp.tanh(xi[..., 2 * h:]
+                            + (r * hidden) @ params["w_hc"])
         h_new = u * hidden + (1.0 - u) * cand
         return h_new, h_new
 
