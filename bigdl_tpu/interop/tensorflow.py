@@ -230,6 +230,22 @@ class TFGraph:
             a = node.attrs.get("epsilon")
             eps = a.float(4, 1e-3) if a is not None else 1e-3
             return (x - mean) / jnp.sqrt(var + eps) * scale + offset
+        if op == "Range":
+            # numpy scalars keep their dtype — float Range stays float
+            s, l, d = (np.asarray(v).reshape(-1)[0] for v in ins)
+            return jnp.arange(s, l, d)
+        if op == "RandomUniform":
+            # shape from input; dtype/seed from attrs. The VALUES cannot
+            # match TF's Philox stream — only shape/bounds/dtype contract
+            # (reference loader RandomUniform.scala has the same caveat:
+            # its RNG is the JVM's, not TF's).
+            shape = tuple(int(v) for v in np.asarray(ins[0]).reshape(-1))
+            seed = node.attrs.get("seed")
+            key = jax.random.PRNGKey(
+                pw.sign64(seed.int(3, 0)) if seed is not None else 0)
+            dt = NP_OF_DT.get(node.attr_type("dtype", DT_FLOAT),
+                              np.float32)
+            return jax.random.uniform(key, shape, jnp.float32).astype(dt)
         raise NotImplementedError(
             f"TF op {op!r} (node {node.name}) is not in the supported set")
 

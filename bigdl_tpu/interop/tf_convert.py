@@ -23,6 +23,7 @@ import bigdl_tpu.nn as nn
 from bigdl_tpu.core.container import Graph, Input, Node
 from bigdl_tpu.core.module import Module, ParamSpec
 from bigdl_tpu.core import init as initializers
+from bigdl_tpu.interop import protowire as pw
 from bigdl_tpu.interop.tensorflow import TFGraph, TFNode
 
 

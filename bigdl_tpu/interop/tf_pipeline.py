@@ -155,6 +155,27 @@ class HostEval:
         if op == "Transpose":
             return (np.transpose(np.asarray(ins[0]),
                                  [int(d) for d in np.asarray(ins[1])]),)
+        if op == "Substr":
+            # string slice on the record path (reference loader
+            # utils/tf/loaders — string ops run host-side here).
+            # tf.strings.substr semantics: negative pos counts from the
+            # end; pos past the end is an error, not an empty string
+            s, pos, ln = ins
+            if isinstance(s, np.ndarray):
+                s = s.reshape(-1)[0]
+            s = bytes(s)
+            pos = int(np.asarray(pos).reshape(-1)[0])
+            ln = int(np.asarray(ln).reshape(-1)[0])
+            if pos < 0:
+                pos += len(s)
+            if pos < 0 or pos > len(s):
+                raise ValueError(
+                    f"Substr pos {pos} out of range for a "
+                    f"{len(s)}-byte string (node {node.name})")
+            return (s[pos:pos + ln],)
+        if op == "Range":
+            s, l, d = (np.asarray(v).reshape(-1)[0] for v in ins)
+            return (np.arange(s, l, d),)
         raise NotImplementedError(
             f"host pipeline op {op!r} (node {node.name}) is not in the "
             f"supported decode set")

@@ -130,6 +130,74 @@ def test_tfrecord_framing_against_real_tf(tmp_path):
     assert got == payloads
 
 
+def test_range_and_random_uniform_ops():
+    """Range matches real TF; RandomUniform honors the shape/bounds/dtype
+    contract (values intentionally differ — TF's Philox stream is not
+    reproducible outside TF, same caveat as the reference's loader)."""
+    from bigdl_tpu.interop import protowire as pw
+    from bigdl_tpu.interop.tensorflow import TFGraph, TFNode, make_node
+
+    g = TFGraph([TFNode(m) for m in pw.Msg(b"".join([
+        make_node("s", "Const", tensor=np.asarray(2, np.int32)),
+        make_node("l", "Const", tensor=np.asarray(11, np.int32)),
+        make_node("d", "Const", tensor=np.asarray(3, np.int32)),
+        make_node("r", "Range", ["s", "l", "d"]),
+    ])).msgs(1)])
+    want = tf.range(2, 11, 3).numpy()
+    np.testing.assert_array_equal(np.asarray(g.run({}, ["r"])), want)
+
+    g2 = TFGraph([TFNode(m) for m in pw.Msg(b"".join([
+        make_node("shape", "Const", tensor=np.asarray([3, 5], np.int32)),
+        make_node("u", "RandomUniform", ["shape"],
+                  scalars={"seed": 7}, types={"dtype": 1}),
+    ])).msgs(1)])
+    out = np.asarray(g2.run({}, ["u"]))
+    assert out.shape == (3, 5) and out.dtype == np.float32
+    assert (out >= 0).all() and (out < 1).all()
+
+
+def test_substr_against_real_tf():
+    from bigdl_tpu.interop import protowire as pw
+    from bigdl_tpu.interop.tensorflow import TFGraph, TFNode, make_node
+    from bigdl_tpu.interop.tf_pipeline import HostEval
+
+    s = b"hello world bytes"
+    for pos in (3, -5):                  # negative pos counts from the end
+        g = TFGraph([TFNode(m) for m in pw.Msg(b"".join([
+            make_node("in", "Placeholder"),
+            make_node("pos", "Const", tensor=np.asarray(pos, np.int32)),
+            make_node("len", "Const", tensor=np.asarray(5, np.int32)),
+            make_node("sub", "Substr", ["in", "pos", "len"]),
+        ])).msgs(1)])
+        ours = HostEval(g, env={("in", 0): s}).get("sub")
+        want = tf.strings.substr(s, pos, 5).numpy()
+        assert bytes(ours) == want, (pos, ours, want)
+    # pos past the end raises (TF errors too) instead of silently
+    # feeding an empty record downstream
+    g = TFGraph([TFNode(m) for m in pw.Msg(b"".join([
+        make_node("in", "Placeholder"),
+        make_node("pos", "Const", tensor=np.asarray(99, np.int32)),
+        make_node("len", "Const", tensor=np.asarray(5, np.int32)),
+        make_node("sub", "Substr", ["in", "pos", "len"]),
+    ])).msgs(1)])
+    with pytest.raises(ValueError, match="out of range"):
+        HostEval(g, env={("in", 0): s}).get("sub")
+
+
+def test_float_range_matches_real_tf():
+    from bigdl_tpu.interop import protowire as pw
+    from bigdl_tpu.interop.tensorflow import TFGraph, TFNode, make_node
+    g = TFGraph([TFNode(m) for m in pw.Msg(b"".join([
+        make_node("s", "Const", tensor=np.asarray(0.0, np.float32)),
+        make_node("l", "Const", tensor=np.asarray(1.0, np.float32)),
+        make_node("d", "Const", tensor=np.asarray(0.25, np.float32)),
+        make_node("r", "Range", ["s", "l", "d"]),
+    ])).msgs(1)])
+    want = tf.range(0.0, 1.0, 0.25).numpy()
+    np.testing.assert_allclose(np.asarray(g.run({}, ["r"])), want,
+                               rtol=1e-6)
+
+
 def test_pipeline_decode_ops_against_real_tf():
     """HostEval's DecodeRaw/DecodePng match real tf.io ops bit for bit."""
     from bigdl_tpu.interop import protowire as pw
