@@ -744,19 +744,6 @@ def _b_layernorm(cfg, shapes):
 
 
 # ----------------------------------------------- keras-1 tail builders
-def _reject_weights(label):
-    """Weight adapter that refuses HDF5 weights — loader policy is to
-    raise rather than silently keep random init."""
-    def adapter(wts):
-        if wts:
-            raise NotImplementedError(
-                f"{label}: HDF5 weight import is not supported (the keras "
-                f"kernel layout has no registered mapping); constructor-API "
-                f"use (no weights) is fine")
-        return {}, {}
-    return adapter
-
-
 def _b_cropping1d(cfg, shapes):
     b_, t, c = shapes[0]
     if t is None:
@@ -925,16 +912,39 @@ def _b_locally_connected2d(cfg, shapes):
     sh, sw = _pair(cfg.get("strides", 1))
     if cfg.get("padding", "valid") == "same":
         raise NotImplementedError("LocallyConnected2D: SAME padding")
+    if cfg.get("implementation", 1) != 1:
+        raise NotImplementedError(
+            "LocallyConnected2D weights: only implementation=1 (patch-"
+            "matrix kernel layout) imports; impl 2/3 store full/sparse "
+            "kernels")
     filters = cfg["filters"]
     m = nn.LocallyConnected2D(cin, w, h, filters, kw, kh, sw, sh,
                               bias=cfg.get("use_bias", True))
-    out = (b_, (h - kh) // sh + 1, (w - kw) // sw + 1, filters)
-    m, adapter = _maybe_act(m, cfg, _reject_weights("LocallyConnected2D"))
+    oh, ow = (h - kh) // sh + 1, (w - kw) // sw + 1
+    out = (b_, oh, ow, filters)
+
+    def adapter(wts):
+        # keras kernel (oh*ow, kh*kw*cin, filters) [impl 1] or
+        # (oh, ow, kh, kw, cin, filters) [impl 2]; patch order (kh, kw,
+        # cin) matches LocallyConnected2D._patches. bias (oh, ow, filters)
+        if not wts:
+            return {}, {}
+        k = np.asarray(wts[0])
+        p = {"weight": k.reshape(oh, ow, kh * kw * cin, filters)}
+        if len(wts) > 1:
+            p["bias"] = np.asarray(wts[1]).reshape(oh, ow, filters)
+        return p, {}
+    m, adapter = _maybe_act(m, cfg, adapter)
     return m, out, adapter
 
 
 def _b_locally_connected1d(cfg, shapes):
     _reject_unsupported(cfg, "LocallyConnected1D")
+    if cfg.get("implementation", 1) != 1:
+        raise NotImplementedError(
+            "LocallyConnected1D weights: only implementation=1 (patch-"
+            "matrix kernel layout) imports; impl 2/3 store full/sparse "
+            "kernels")
     b_, t, cin = shapes[0]
     k = cfg["kernel_size"]
     k = k[0] if isinstance(k, (list, tuple)) else k
@@ -943,8 +953,19 @@ def _b_locally_connected1d(cfg, shapes):
     filters = cfg["filters"]
     m = nn.LocallyConnected1D(t, cin, filters, k, s,
                               bias=cfg.get("use_bias", True))
-    out = (b_, (t - k) // s + 1, filters)
-    m, adapter2 = _maybe_act(m, cfg, _reject_weights("LocallyConnected1D"))
+    ot = (t - k) // s + 1
+    out = (b_, ot, filters)
+
+    def adapter(wts):
+        # keras kernel (ot, k*cin, filters) — patch order (k, cin)
+        # matches LocallyConnected1D; bias (ot, filters)
+        if not wts:
+            return {}, {}
+        p = {"weight": np.asarray(wts[0]).reshape(ot, k * cin, filters)}
+        if len(wts) > 1:
+            p["bias"] = np.asarray(wts[1]).reshape(ot, filters)
+        return p, {}
+    m, adapter2 = _maybe_act(m, cfg, adapter)
     return m, out, adapter2
 
 

@@ -105,6 +105,13 @@ CASES = [
         L.Dense(12, input_shape=(8,)),
         L.LayerNormalization(),
         L.Dense(4)]), (3, 8)),
+    # untied (per-position) weights — round-3 verdict's no-oracle list
+    ("locally_connected_1d", lambda: keras.Sequential([
+        L.LocallyConnected1D(5, 3, strides=2, input_shape=(9, 4)),
+        L.ReLU()]), (2, 9, 4)),
+    ("locally_connected_2d", lambda: keras.Sequential([
+        L.LocallyConnected2D(4, 3, input_shape=(6, 7, 2))]),
+     (2, 6, 7, 2)),
 ]
 
 
@@ -146,6 +153,40 @@ def test_real_keras_spatial_dropout_inference(tmp_path):
         L.SpatialDropout1D(0.5),
         L.GlobalAveragePooling1D()])
     _golden(model, R.rand(2, 8, 3).astype(np.float32), tmp_path)
+
+
+def test_real_keras_vgg16_import_and_int8(tmp_path):
+    """The actual VGG-16 topology (BASELINE config 5) built by real
+    Keras at 64×64: import parity, then calibrated int8 with full argmax
+    agreement — the whitepaper.md:192-196 pipeline against the real
+    oracle."""
+    from bigdl_tpu.nn.quantized import calibrate, quantize
+    keras.utils.set_random_seed(0)   # int8 argmax agreement needs the
+    #                                  same random weights every run
+    cfg = [64, 64, "p", 128, 128, "p", 256, 256, 256, "p",
+           512, 512, 512, "p", 512, 512, 512, "p"]
+    stack = []
+    for c in cfg:
+        stack.append(L.MaxPooling2D(2) if c == "p"
+                     else L.Conv2D(c, 3, padding="same",
+                                   activation="relu"))
+    model = keras.Sequential(
+        [keras.Input((64, 64, 3))] + stack
+        + [L.Flatten(), L.Dense(256, activation="relu"),
+           L.Dense(10, activation="softmax")])
+    x = R.rand(2, 64, 64, 3).astype(np.float32)
+    want = np.asarray(model(x))
+    path = str(tmp_path / "vgg.h5")
+    model.save_weights(path)
+    mod, params, state = load_keras(model.to_json(), path)
+    got, _ = mod.apply(params, state, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-3,
+                               atol=1e-5)
+
+    scales = calibrate(mod, params, state, [x], percentile=99.9)
+    qm, qp = quantize(mod, params, input_scales=scales)
+    qout, _ = qm.apply(qp, state, jnp.asarray(x))
+    assert (np.asarray(qout).argmax(-1) == want.argmax(-1)).all()
 
 
 def test_real_keras_vgg_style_deep_stack(tmp_path):

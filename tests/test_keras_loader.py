@@ -373,11 +373,20 @@ def test_keras1_tail_guardrails():
         _build_layer("ConvLSTM2D", {"filters": 2, "kernel_size": 3,
                                     "padding": "valid"},
                      [(None, 4, 6, 6, 2)])
-    # LocallyConnected2D refuses HDF5 weights instead of dropping them
+    # LocallyConnected2D imports impl-1 weights (round 4; real-keras
+    # golden in test_golden_keras_real.py); impl 2/3 layouts refuse
     import numpy as np
     _, _, adapter = _build_layer(
         "LocallyConnected2D",
         {"filters": 2, "kernel_size": (3, 3)}, [(None, 8, 8, 2)])
-    with pytest.raises(NotImplementedError, match="LocallyConnected2D"):
-        adapter([np.zeros((36, 18, 2), np.float32)])
+    p, _ = adapter([np.zeros((36, 18, 2), np.float32)])
+    assert p["weight"].shape == (6, 6, 18, 2)
     assert adapter([]) == ({}, {})
+    with pytest.raises(NotImplementedError, match="implementation"):
+        _build_layer("LocallyConnected2D",
+                     {"filters": 2, "kernel_size": (3, 3),
+                      "implementation": 2}, [(None, 8, 8, 2)])
+    with pytest.raises(NotImplementedError, match="implementation"):
+        _build_layer("LocallyConnected1D",
+                     {"filters": 2, "kernel_size": 3,
+                      "implementation": 3}, [(None, 8, 2)])
