@@ -912,11 +912,6 @@ def _b_locally_connected2d(cfg, shapes):
     sh, sw = _pair(cfg.get("strides", 1))
     if cfg.get("padding", "valid") == "same":
         raise NotImplementedError("LocallyConnected2D: SAME padding")
-    if cfg.get("implementation", 1) != 1:
-        raise NotImplementedError(
-            "LocallyConnected2D weights: only implementation=1 (patch-"
-            "matrix kernel layout) imports; impl 2/3 store full/sparse "
-            "kernels")
     filters = cfg["filters"]
     m = nn.LocallyConnected2D(cin, w, h, filters, kw, kh, sw, sh,
                               bias=cfg.get("use_bias", True))
@@ -929,6 +924,11 @@ def _b_locally_connected2d(cfg, shapes):
         # cin) matches LocallyConnected2D._patches. bias (oh, ow, filters)
         if not wts:
             return {}, {}
+        if cfg.get("implementation", 1) != 1:
+            raise NotImplementedError(
+                "LocallyConnected2D weights: only implementation=1 "
+                "(patch-matrix kernel layout) imports; impl 2/3 store "
+                "full/sparse kernels")
         k = np.asarray(wts[0])
         p = {"weight": k.reshape(oh, ow, kh * kw * cin, filters)}
         if len(wts) > 1:
@@ -940,11 +940,6 @@ def _b_locally_connected2d(cfg, shapes):
 
 def _b_locally_connected1d(cfg, shapes):
     _reject_unsupported(cfg, "LocallyConnected1D")
-    if cfg.get("implementation", 1) != 1:
-        raise NotImplementedError(
-            "LocallyConnected1D weights: only implementation=1 (patch-"
-            "matrix kernel layout) imports; impl 2/3 store full/sparse "
-            "kernels")
     b_, t, cin = shapes[0]
     k = cfg["kernel_size"]
     k = k[0] if isinstance(k, (list, tuple)) else k
@@ -961,6 +956,11 @@ def _b_locally_connected1d(cfg, shapes):
         # matches LocallyConnected1D; bias (ot, filters)
         if not wts:
             return {}, {}
+        if cfg.get("implementation", 1) != 1:
+            raise NotImplementedError(
+                "LocallyConnected1D weights: only implementation=1 "
+                "(patch-matrix kernel layout) imports; impl 2/3 store "
+                "full/sparse kernels")
         p = {"weight": np.asarray(wts[0]).reshape(ot, k * cin, filters)}
         if len(wts) > 1:
             p["bias"] = np.asarray(wts[1]).reshape(ot, filters)

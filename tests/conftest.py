@@ -5,17 +5,26 @@ with local[1] Spark, test/.../optim/DistriOptimizerSpec.scala:46)."""
 
 import os
 
-if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+# BIGDL_TPU_REAL_CHIP=1 runs the suite against the real TPU backend instead
+# of the virtual CPU mesh — used for the TPU-gated Mosaic-lowering smokes
+# (test_kernels.py::test_*_on_real_tpu_no_interpret) when the chip tunnel
+# is alive.
+_REAL_CHIP = os.environ.get("BIGDL_TPU_REAL_CHIP") == "1"
+
+if not _REAL_CHIP and "--xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                                + " --xla_force_host_platform_device_count=8")
-os.environ["JAX_PLATFORMS"] = "cpu"
+if not _REAL_CHIP:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 
 # The axon TPU plugin (this image's tunnel to the real chip) overrides the
 # JAX_PLATFORMS env var; the config knob still wins, so force CPU here before
 # any backend is initialized.
-jax.config.update("jax_platforms", "cpu")
+if not _REAL_CHIP:
+    jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

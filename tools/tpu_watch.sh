@@ -1,49 +1,75 @@
 #!/bin/bash
-# TPU opportunistic bench capture (VERDICT r2 "Next round" #1).
+# TPU opportunistic bench capture (VERDICT r3 "Next round" #1).
 #
-# The axon chip tunnel is intermittently alive; when wedged, jax backend
-# init hangs forever (no error). This watcher probes in a throwaway
-# subprocess with a hard timeout; the moment the chip answers, it runs the
-# full bench battery + an XLA profile and writes BENCH_EARLY_r04.json
-# into the repo, then keeps re-probing (the chip may come back later with
-# better code to measure).
+# The axon chip tunnel is intermittently alive — observed windows can be as
+# short as ~40s. This watcher probes with a hard timeout; the moment the
+# chip answers it runs, IN PRIORITY ORDER, (1) the non-interpret Pallas
+# Mosaic-lowering smokes, (2) the ResNet-50 bf16 MFU bench (the headline),
+# (3) the Pallas-vs-XLA kernel table, (4) the rest of the battery, (5) an
+# XLA profile — writing each result to BENCH_EARLY_r04.json INCREMENTALLY
+# so a mid-battery wedge still leaves evidence. Then keeps re-probing.
 #
 # Usage: nohup bash tools/tpu_watch.sh &   (logs to /tmp/tpu_watch.log)
 cd "$(dirname "$0")/.." || exit 1
 LOG=/tmp/tpu_watch.log
+OUT=BENCH_EARLY_r04.json
 PROBE='import jax, jax.numpy as jnp
 d = jax.devices()
 assert d[0].platform != "cpu", d
 x = (jnp.ones((1024,1024), jnp.bfloat16) @ jnp.ones((1024,1024), jnp.bfloat16)).block_until_ready()
 print("ALIVE", getattr(d[0], "device_kind", "?"))'
 
-captured=0
-for i in $(seq 1 200); do
-  out=$(timeout 240 python -c "$PROBE" 2>>"$LOG")
+merge_result() {  # merge_result <key> <json-or-empty>
+  BENCH_OUT="$OUT" python - "$1" "$2" <<'EOF'
+import json, os, sys, time
+key, val = sys.argv[1], sys.argv[2].strip()
+path = os.environ["BENCH_OUT"]
+try:
+    doc = json.load(open(path))
+except Exception:
+    doc = {}
+try:
+    parsed = json.loads(val) if val else None
+except Exception:
+    parsed = {"raw": val[:500]}
+# never downgrade: a good result from an earlier chip window must not be
+# clobbered by a failed/empty pass from a later, shorter window
+bad = parsed is None or (isinstance(parsed, dict) and "raw" in parsed) \
+    or (isinstance(parsed, str)
+        and any(w in parsed.lower() for w in ("failed", "error", "wedge")))
+if bad and doc.get(key) is not None:
+    sys.exit(0)
+doc[key] = parsed
+doc["updated_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+json.dump(doc, open(path + ".tmp", "w"), indent=1)
+os.replace(path + ".tmp", path)
+EOF
+}
+
+for i in $(seq 1 100000); do
+  out=$(timeout 150 python -c "$PROBE" 2>>"$LOG")
   if echo "$out" | grep -q ALIVE; then
-    echo "$(date -u +%FT%TZ) probe $i: $out -> running bench battery" >> "$LOG"
-    {
-      echo "{"
-      echo "\"captured_at\": \"$(date -u +%FT%TZ)\","
-      echo "\"device\": \"$(echo "$out" | sed 's/ALIVE //')\","
-      for m in resnet50 lenet lstm transformer kernels; do
-        j=$(timeout 1800 python bench.py "$m" 2>>"$LOG" | tail -1)
-        echo "\"$m\": ${j:-null},"
-      done
-      echo "\"watcher_iteration\": $i"
-      echo "}"
-    } > BENCH_EARLY_r04.json.tmp && mv BENCH_EARLY_r04.json.tmp BENCH_EARLY_r04.json
-    echo "$(date -u +%FT%TZ) bench battery done (see BENCH_EARLY_r04.json)" >> "$LOG"
-    timeout 1800 python tools/capture_tpu_profile.py tpu_profile_r04 \
-        >> "$LOG" 2>&1
-    echo "$(date -u +%FT%TZ) profile capture attempted (tpu_profile_r04/)" >> "$LOG"
-    captured=1
-    # chip is alive — stop polling aggressively; builder takes over
+    echo "$(date -u +%FT%TZ) probe $i: $out -> battery" >> "$LOG"
     touch /tmp/tpu_alive_now
-    sleep 1800
+    merge_result "device" "\"$(echo "$out" | sed 's/ALIVE //')\""
+    # 1. Mosaic-lowering smokes first — even 20s of chip life proves them
+    smoke=$(BIGDL_TPU_REAL_CHIP=1 timeout 300 python -m pytest \
+        tests/test_kernels.py -q -k real_tpu 2>&1 | tail -1)
+    echo "$(date -u +%FT%TZ) smokes: $smoke" >> "$LOG"
+    merge_result "pallas_smokes" "\"$smoke\""
+    # 2..5 battery, headline first, each result written immediately
+    for m in resnet50 kernels lstm transformer lenet; do
+      j=$(timeout 900 python bench.py "$m" 2>>"$LOG" | tail -1)
+      echo "$(date -u +%FT%TZ) bench $m: $j" >> "$LOG"
+      merge_result "$m" "$j"
+    done
+    timeout 600 python tools/capture_tpu_profile.py tpu_profile_r04 \
+        >> "$LOG" 2>&1 && merge_result "profile" "\"tpu_profile_r04/\""
+    echo "$(date -u +%FT%TZ) battery pass done (see $OUT)" >> "$LOG"
+    sleep 600
   else
     echo "$(date -u +%FT%TZ) probe $i: wedged/timeout" >> "$LOG"
     rm -f /tmp/tpu_alive_now
-    sleep 240
+    sleep 90
   fi
 done
