@@ -382,11 +382,18 @@ def test_keras1_tail_guardrails():
     p, _ = adapter([np.zeros((36, 18, 2), np.float32)])
     assert p["weight"].shape == (6, 6, 18, 2)
     assert adapter([]) == ({}, {})
+    # impl 2/3 kernel layouts refuse only when WEIGHTS arrive — the
+    # constructor-API (no-weights) path builds fine since forward math is
+    # identical across keras implementations
+    _, _, ad2 = _build_layer("LocallyConnected2D",
+                             {"filters": 2, "kernel_size": (3, 3),
+                              "implementation": 2}, [(None, 8, 8, 2)])
+    assert ad2([]) == ({}, {})
     with pytest.raises(NotImplementedError, match="implementation"):
-        _build_layer("LocallyConnected2D",
-                     {"filters": 2, "kernel_size": (3, 3),
-                      "implementation": 2}, [(None, 8, 8, 2)])
+        ad2([np.zeros((6, 6, 3, 3, 2, 2), np.float32)])
+    _, _, ad1 = _build_layer("LocallyConnected1D",
+                             {"filters": 2, "kernel_size": 3,
+                              "implementation": 3}, [(None, 8, 2)])
+    assert ad1([]) == ({}, {})
     with pytest.raises(NotImplementedError, match="implementation"):
-        _build_layer("LocallyConnected1D",
-                     {"filters": 2, "kernel_size": 3,
-                      "implementation": 3}, [(None, 8, 2)])
+        ad1([np.zeros((6, 6, 2), np.float32)])
