@@ -114,6 +114,7 @@ _UNARY_OPS = {
     "Erfc": lambda x: 1.0 - jax.scipy.special.erf(x),
     "IsFinite": jnp.isfinite, "IsInf": jnp.isinf, "IsNan": jnp.isnan,
     "LogicalNot": jnp.logical_not,
+    "InvertPermutation": lambda x: jnp.argsort(x).astype(x.dtype),
     "Softplus": jax.nn.softplus, "Softsign": jax.nn.soft_sign,
     "Digamma": jax.scipy.special.digamma,
     "Lgamma": jax.scipy.special.gammaln,
@@ -143,7 +144,13 @@ _ALIAS_OPS = ("Identity", "StopGradient", "Snapshot")
 
 
 def _const_value(g: TFGraph, name: str) -> Optional[np.ndarray]:
-    """Resolve Const (possibly through Identity chains); None if not const."""
+    """Resolve Const (possibly through Identity chains); None if not const.
+
+    Also resolves VariableV2/Variable through its Assign initializer, so
+    UNfrozen GraphDefs (variables + init ops instead of folded consts)
+    import too — the resolved value lands in layer params and stays
+    trainable, matching the reference's Variable loader semantics
+    (utils/tf/loaders/VariableV2.scala)."""
     node = g.nodes.get(name)
     seen = set()
     while node is not None and node.op in _ALIAS_OPS and node.inputs:
@@ -153,7 +160,25 @@ def _const_value(g: TFGraph, name: str) -> Optional[np.ndarray]:
         node = g.nodes.get(node.inputs[0])
     if node is not None and node.op == "Const":
         return node.attr_tensor("value")
+    if node is not None and node.op in ("VariableV2", "Variable"):
+        init = _variable_initializers(g).get(node.name)
+        if init is not None:
+            return _const_value(g, init)
     return None
+
+
+def _variable_initializers(g: TFGraph) -> Dict[str, str]:
+    """var name -> name of the value its Assign initializer writes
+    (cached on the graph)."""
+    cache = getattr(g, "_var_init", None)
+    if cache is None:
+        cache = {}
+        for nm in g.order:
+            n = g.nodes[nm]
+            if n.op == "Assign" and len(n.inputs) == 2:
+                cache.setdefault(n.inputs[0], n.inputs[1])
+        g._var_init = cache
+    return cache
 
 
 def _pad_arg(pad: str) -> int:
@@ -201,10 +226,24 @@ def to_module(graph: TFGraph, inputs: Optional[Sequence[str]] = None,
             sym[nm] = inp
         name_of_node.append((spec, inp))
 
+    from bigdl_tpu.interop import tf_while as _tfw
+    _frames, _member_of, _exit_frame = _tfw.detect_frames(graph)
+
     for name in graph.order:
         if name in sym:
             continue
         node = graph.nodes[name]
+        if name in _member_of:
+            continue                       # interior of a while frame
+        if node.op in _tfw.EXIT_OPS:
+            fr = _exit_frame.get(name)
+            if fr is None:
+                raise NotImplementedError(
+                    f"Exit {name} outside any detected while frame")
+            if not fr.built:
+                _collapse_while_frame(graph, fr, sym, sym_ports, weights,
+                                      name_of_node)
+            continue
         if _const_value(graph, name) is not None:
             continue                       # weight/shape operand, not a layer
         data_ins = [i for i in node.inputs if is_data(i)]
@@ -234,12 +273,24 @@ def to_module(graph: TFGraph, inputs: Optional[Sequence[str]] = None,
     g = Graph([input_node_of[i] for i in input_names],
               [out_node(o) for o in output_names])
     params, state = g.init(rng if rng is not None else jax.random.PRNGKey(0))
+
+    def _assign(dst, k, v):
+        # nested dicts carry whole converted-subgraph params (TFWhile)
+        if isinstance(v, dict):
+            sub = dst.setdefault(k, {})
+            for kk, vv in v.items():
+                _assign(sub, kk, vv)
+        else:
+            dst[k] = jnp.asarray(v)
+
     for n, p_over, s_over in weights:
-        key = g._node_key[id(n)]
+        key = g._node_key.get(id(n))
+        if key is None:
+            continue                      # dead branch pruned by topo sort
         for k, v in p_over.items():
-            params[key][k] = jnp.asarray(v)
+            _assign(params[key], k, v)
         for k, v in s_over.items():
-            state[key][k] = jnp.asarray(v)
+            _assign(state[key], k, v)
     name_map = {nm: g._node_key[id(n)] for nm, n in name_of_node
                 if id(n) in g._node_key}
     return g, params, state, name_map
@@ -248,6 +299,45 @@ def to_module(graph: TFGraph, inputs: Optional[Sequence[str]] = None,
 def _sint(v: int) -> int:
     """Sign-extend a uint64 varint (TF attr ints are int64)."""
     return pw.sign64(v)
+
+
+def _collapse_while_frame(graph: TFGraph, fr, sym, sym_ports, weights,
+                          name_of_node) -> None:
+    """Collapse one while frame into a TFWhile node and register its Exit
+    outputs in `sym` (see interop/tf_while.py for the frame anatomy)."""
+    from bigdl_tpu.interop import tf_while as _tfw
+    spec = _tfw.build_frame_subgraphs(graph, fr)
+    parents: List[Node] = []
+
+    def slot_of(enter):
+        nm, port = enter.input_ports[0]
+        cv = _const_value(graph, nm) if port == 0 else None
+        if cv is not None:
+            return np.asarray(cv)
+        tap = sym_ports.get((nm, port)) if port else sym.get(nm)
+        if tap is None:
+            raise NotImplementedError(
+                f"while frame {fr.name!r}: Enter {enter.name} consumes "
+                f"{nm}:{port}, which is neither const nor converted")
+        parents.append(tap)
+        return None
+
+    init_slots = [slot_of(e) for e in fr.vars]
+    inv_slots = [slot_of(e) for e in fr.invariants]
+    trip = _tfw.static_trip_count(graph, fr, spec, init_slots, inv_slots)
+    wh = _tfw.TFWhile(spec.cond_mod, spec.body_mod, init_slots, inv_slots,
+                      spec.cond_sel, spec.body_sel, trip_count=trip)
+    node = wh(*parents)
+    weights.append((node,
+                    {"cond": spec.cond_params, "body": spec.body_params},
+                    {"cond": spec.cond_state, "body": spec.body_state}))
+    for i, ex in enumerate(fr.exits):
+        if ex is None:
+            continue
+        tap = nn.SelectTable(i)(node)
+        sym[ex.name] = tap
+        name_of_node.append((ex.name, tap))
+    fr.built = True
 
 
 def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
@@ -347,17 +437,31 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
                                   n_group=cin, bias=False)
         return mk(m, {"weight": w.reshape(kh, kw, 1, cin * mult)})
     if op == "MatMul":
+        ta_at = node.attrs.get("transpose_a")
+        tb_at = node.attrs.get("transpose_b")
+        ta = bool(ta_at is not None and ta_at.int(5))
+        tb = bool(tb_at is not None and tb_at.int(5))
         w = const(1)
         if w is None:
+            if len(data_ins) == 2:        # two dynamic operands (e.g. a
+                # loop-invariant matrix inside an imported while body)
+                def mm(a, b, ta=ta, tb=tb):
+                    return (a.T if ta else a) @ (b.T if tb else b)
+                return mk(Lambda(mm, "matmul", n_in=2))
             raise NotImplementedError(f"MatMul {node.name}: non-const weight")
-        tb = node.attrs.get("transpose_b")
-        if tb is not None and tb.int(5):
+        if ta:                             # rare; keep exact semantics
+            def mm_t(a, b, tb=tb):
+                return a.T @ (b.T if tb else b)
+            return mk(ConstBinary(mm_t, w, const_first=False,
+                                  label="matmul"))
+        if tb:
             w = w.T
         m = nn.Linear(w.shape[0], w.shape[1], bias=False)
         return mk(m, {"weight": w})
     if op in ("BiasAdd", "BiasAddV1") \
             or (op in ("Add", "AddV2") and const(1) is not None
-                           and np.asarray(const(1)).ndim <= 1):
+                           and np.asarray(const(1)).ndim <= 1
+                           and np.asarray(const(1)).dtype.kind == "f"):
         b = const(1)
         if b is None:                      # tensor + tensor
             return mk(nn.CAddTable())
@@ -638,6 +742,31 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
                 return tuple(jnp.split(x, b, axis=a))
         src = parent[0]
         tup = Lambda(do_split, op.lower())(src)
+        return {i: nn.SelectTable(i)(tup) for i in range(n_out)}
+
+    if op == "ConcatOffset":
+        # (concat_dim, shape_0..shape_{N-1}) -> N offset vectors: each
+        # output j is all-zero except cumulative size along concat_dim
+        # (reference: utils/tf/loaders/ArrayOps.scala ConcatOffset).
+        # Shapes may be any const/dynamic mix after freezing — mixed()
+        # closes consts over and wires only the dynamic parents.
+        cd = _const_value(graph, node.inputs[0])
+        if cd is None:
+            raise NotImplementedError(
+                f"ConcatOffset {node.name}: dynamic concat_dim")
+        axis = int(np.asarray(cd).reshape(()))
+        n_out = len(node.inputs) - 1
+        wrap, parents = mixed(len(node.inputs))
+
+        def offsets(_dim, *shapes, a=axis):
+            outs, acc = [], None
+            for s in shapes:
+                z = jnp.zeros_like(s)
+                outs.append(z if acc is None else z.at[a].set(acc))
+                acc = s[a] if acc is None else acc + s[a]
+            return tuple(outs)
+        tup = Lambda(wrap(offsets), "concat_offset",
+                     n_in=len(parents))(*parents)
         return {i: nn.SelectTable(i)(tup) for i in range(n_out)}
 
     # ------------------------------------------------------------ spatial

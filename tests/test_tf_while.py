@@ -1,0 +1,254 @@
+"""TF while-loop frame import -> lax.while_loop (interop/tf_while.py).
+
+The reference runs Enter/Merge/Switch/NextIteration/Exit dynamically
+(nn/Scheduler.scala + nn/FrameManager.scala, loaders
+utils/tf/loaders/ControlFlowOps.scala); here each frame statically
+collapses into one compiled XLA While. GraphDefs are hand-assembled the
+way tf.while_loop's graph builder lays them out (TF 1.x canonical frame
+anatomy)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.interop.tensorflow import (DT_FLOAT, DT_INT32,
+                                          load_graphdef, make_node)
+from bigdl_tpu.interop.tf_convert import to_module
+
+FRAME = {"frame_name": "loop/ctx"}
+
+
+def _while_nodes(n_iters=5, mul=1.5, invariant_limit=True):
+    """x' = x * mul; i' = i + 1; while i < n. `x` is a Placeholder loop
+    var, `i` starts from a const Enter, `n` rides an invariant Enter
+    (is_constant=True, no Merge) when invariant_limit else a const
+    inside the cond closure."""
+    nodes = [
+        make_node("x", "Placeholder", types={"dtype": DT_FLOAT}),
+        make_node("zero", "Const", tensor=np.asarray(0, np.int32)),
+        make_node("limit", "Const", tensor=np.asarray(n_iters, np.int32)),
+        make_node("mulc", "Const", tensor=np.asarray(mul, np.float32)),
+        make_node("onec", "Const", tensor=np.asarray(1, np.int32)),
+        make_node("enter_x", "Enter", ["x"], strs=FRAME),
+        make_node("enter_i", "Enter", ["zero"], strs=FRAME),
+        make_node("merge_x", "Merge", ["enter_x", "next_x"]),
+        make_node("merge_i", "Merge", ["enter_i", "next_i"]),
+    ]
+    if invariant_limit:
+        nodes += [make_node("enter_n", "Enter", ["limit"],
+                            strs=FRAME, scalars={"is_constant": True}),
+                  make_node("less", "Less", ["merge_i", "enter_n"])]
+    else:
+        nodes += [make_node("less", "Less", ["merge_i", "limit"])]
+    nodes += [
+        make_node("cond", "LoopCond", ["less"]),
+        make_node("switch_x", "Switch", ["merge_x", "cond"]),
+        make_node("switch_i", "Switch", ["merge_i", "cond"]),
+        make_node("body_mul", "Mul", ["switch_x:1", "mulc"]),
+        make_node("body_add", "AddV2", ["switch_i:1", "onec"]),
+        make_node("next_x", "NextIteration", ["body_mul"]),
+        make_node("next_i", "NextIteration", ["body_add"]),
+        make_node("exit_x", "Exit", ["switch_x"]),
+        make_node("exit_i", "Exit", ["switch_i"]),
+    ]
+    return nodes
+
+
+def _convert(nodes, inputs, outputs):
+    g = load_graphdef(b"".join(nodes))
+    return to_module(g, inputs=inputs, outputs=outputs)
+
+
+def test_while_scalar_loop_matches_python():
+    m, p, s, _ = _convert(_while_nodes(), ["x"], ["exit_x"])
+    x = np.asarray([2.0, -1.0], np.float32)
+    out, _ = m.apply(p, s, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), x * 1.5 ** 5, rtol=1e-6)
+
+
+def test_while_const_cond_limit():
+    """Loop limit folded as a const inside the cond closure (no
+    invariant Enter)."""
+    m, p, s, _ = _convert(_while_nodes(n_iters=3, invariant_limit=False),
+                          ["x"], ["exit_x"])
+    out, _ = m.apply(p, s, jnp.asarray(np.float32(4.0)))
+    np.testing.assert_allclose(np.asarray(out), 4.0 * 1.5 ** 3, rtol=1e-6)
+
+
+def test_while_counter_exit_and_downstream_ops():
+    """The second Exit (loop counter) is independently consumable, and
+    post-loop ops compose on top of Exit outputs."""
+    nodes = _while_nodes(n_iters=7)
+    nodes += [make_node("after", "Cast", ["exit_i"],
+                        types={"DstT": DT_FLOAT}),
+              make_node("doubled", "Mul", ["exit_x", "exit_x"])]
+    m, p, s, _ = _convert(nodes, ["x"], ["after", "doubled"])
+    out, _ = m.apply(p, s, jnp.asarray(np.float32(1.0)))
+    np.testing.assert_allclose(np.asarray(out[0]), 7.0)
+    np.testing.assert_allclose(np.asarray(out[1]), (1.5 ** 7) ** 2,
+                               rtol=1e-5)
+
+
+def test_while_tensor_carry_and_dynamic_invariant():
+    """A vector loop var plus a *dynamic* invariant (Placeholder riding
+    an is_constant Enter): v' = v + dv, repeated n times."""
+    nodes = [
+        make_node("v", "Placeholder", types={"dtype": DT_FLOAT}),
+        make_node("dv", "Placeholder", types={"dtype": DT_FLOAT}),
+        make_node("zero", "Const", tensor=np.asarray(0, np.int32)),
+        make_node("limit", "Const", tensor=np.asarray(4, np.int32)),
+        make_node("onec", "Const", tensor=np.asarray(1, np.int32)),
+        make_node("enter_v", "Enter", ["v"], strs=FRAME),
+        make_node("enter_i", "Enter", ["zero"], strs=FRAME),
+        make_node("enter_dv", "Enter", ["dv"], strs=FRAME,
+                  scalars={"is_constant": True}),
+        make_node("merge_v", "Merge", ["enter_v", "next_v"]),
+        make_node("merge_i", "Merge", ["enter_i", "next_i"]),
+        make_node("less", "Less", ["merge_i", "limit"]),
+        make_node("cond", "LoopCond", ["less"]),
+        make_node("switch_v", "Switch", ["merge_v", "cond"]),
+        make_node("switch_i", "Switch", ["merge_i", "cond"]),
+        make_node("body_add", "AddV2", ["switch_v:1", "enter_dv"]),
+        make_node("i_add", "AddV2", ["switch_i:1", "onec"]),
+        make_node("next_v", "NextIteration", ["body_add"]),
+        make_node("next_i", "NextIteration", ["i_add"]),
+        make_node("exit_v", "Exit", ["switch_v"]),
+    ]
+    m, p, s, _ = _convert(nodes, ["v", "dv"], ["exit_v"])
+    v = np.asarray([1.0, 2.0, 3.0], np.float32)
+    dv = np.asarray([0.5, -1.0, 0.25], np.float32)
+    out, _ = m.apply(p, s, jnp.asarray(v), jnp.asarray(dv))
+    np.testing.assert_allclose(np.asarray(out), v + 4 * dv, rtol=1e-6)
+
+
+def test_while_is_jittable_and_differentiable():
+    """A counted loop (cond depends only on the const-init counter)
+    imports as fixed-length lax.scan: jit-compiles AND grads flow
+    through the carry (d out/d x = mul^n)."""
+    m, p, s, _ = _convert(_while_nodes(n_iters=6), ["x"], ["exit_x"])
+
+    @jax.jit
+    def f(x):
+        out, _ = m.apply(p, s, x)
+        return out
+
+    np.testing.assert_allclose(float(f(jnp.float32(3.0))), 3.0 * 1.5 ** 6,
+                               rtol=1e-6)
+    g = jax.grad(lambda x: f(x).sum())(jnp.float32(3.0))
+    np.testing.assert_allclose(float(g), 1.5 ** 6, rtol=1e-6)
+
+
+def test_data_dependent_cond_falls_back_to_while():
+    """cond reads the data-initialized var (x' = 2x while x < 100): the
+    trip count is data-dependent, so the import stays a lax.while_loop —
+    forward matches Python; reverse-mode raises XLA's own limitation."""
+    nodes = [
+        make_node("x", "Placeholder", types={"dtype": DT_FLOAT}),
+        make_node("limit", "Const", tensor=np.asarray(100.0, np.float32)),
+        make_node("twoc", "Const", tensor=np.asarray(2.0, np.float32)),
+        make_node("enter_x", "Enter", ["x"], strs=FRAME),
+        make_node("merge_x", "Merge", ["enter_x", "next_x"]),
+        make_node("less", "Less", ["merge_x", "limit"]),
+        make_node("cond", "LoopCond", ["less"]),
+        make_node("switch_x", "Switch", ["merge_x", "cond"]),
+        make_node("body_mul", "Mul", ["switch_x:1", "twoc"]),
+        make_node("next_x", "NextIteration", ["body_mul"]),
+        make_node("exit_x", "Exit", ["switch_x"]),
+    ]
+    m, p, s, _ = _convert(nodes, ["x"], ["exit_x"])
+    out, _ = m.apply(p, s, jnp.asarray(np.float32(3.0)))
+    v = 3.0
+    while v < 100.0:
+        v *= 2.0
+    np.testing.assert_allclose(float(out), v)
+    with pytest.raises(ValueError, match="[Rr]everse-mode"):
+        jax.grad(lambda x: m.apply(p, s, x)[0].sum())(jnp.float32(3.0))
+
+
+def test_nested_frames_refuse():
+    """A frame whose body contains another frame's Enter raises the
+    documented NotImplementedError instead of mis-importing."""
+    inner = {"frame_name": "loop/inner"}
+    nodes = _while_nodes(n_iters=2)
+    # graft an inner Enter consuming the outer body value
+    nodes += [make_node("enter_inner", "Enter", ["body_mul"], strs=inner),
+              make_node("merge_inner", "Merge",
+                        ["enter_inner", "ni_inner"]),
+              make_node("less2", "Less", ["merge_inner", "merge_inner"]),
+              make_node("cond2", "LoopCond", ["less2"]),
+              make_node("switch_inner", "Switch", ["merge_inner", "cond2"]),
+              make_node("ni_inner", "NextIteration", ["switch_inner:1"]),
+              make_node("exit_inner", "Exit", ["switch_inner"])]
+    g = load_graphdef(b"".join(nodes))
+    with pytest.raises(NotImplementedError, match="[Nn]ested"):
+        to_module(g, inputs=["x"], outputs=["exit_x"])
+
+
+def test_variable_v2_resolves_through_assign():
+    """Unfrozen GraphDef: VariableV2 + Assign(initial value) imports like
+    the frozen const would (reference: utils/tf/loaders/VariableV2.scala),
+    and the weight lands in trainable params."""
+    r = np.random.RandomState(0)
+    w = r.randn(4, 3).astype(np.float32)
+    b = r.randn(3).astype(np.float32)
+    nodes = [
+        make_node("x", "Placeholder", types={"dtype": DT_FLOAT}),
+        make_node("w", "VariableV2", types={"dtype": DT_FLOAT}),
+        make_node("w_init", "Const", tensor=w),
+        make_node("w_assign", "Assign", ["w", "w_init"]),
+        make_node("w_read", "Identity", ["w"]),
+        make_node("b", "VariableV2", types={"dtype": DT_FLOAT}),
+        make_node("b_init", "Const", tensor=b),
+        make_node("b_assign", "Assign", ["b", "b_init"]),
+        make_node("mm", "MatMul", ["x", "w_read"]),
+        make_node("out", "BiasAdd", ["mm", "b"]),
+    ]
+    g = load_graphdef(b"".join(nodes))
+    m, p, s, name_map = to_module(g, inputs=["x"], outputs=["out"])
+    x = r.randn(5, 4).astype(np.float32)
+    out, _ = m.apply(p, s, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), x @ w + b, rtol=1e-5,
+                               atol=1e-6)
+    leaves = jax.tree.leaves(p)
+    assert any(l.shape == (4, 3) for l in leaves)   # trainable weight
+
+
+def test_invert_permutation_and_concat_offset():
+    perm = np.asarray([2, 0, 3, 1], np.int32)
+    g = load_graphdef(b"".join([
+        make_node("p", "Placeholder", types={"dtype": DT_INT32}),
+        make_node("ip", "InvertPermutation", ["p"])]))
+    m, pp, s, _ = to_module(g, inputs=["p"], outputs=["ip"])
+    out, _ = m.apply(pp, s, jnp.asarray(perm))
+    np.testing.assert_array_equal(np.asarray(out), np.argsort(perm))
+
+    # ConcatOffset over dynamic Shape vectors
+    g2 = load_graphdef(b"".join([
+        make_node("a", "Placeholder", types={"dtype": DT_FLOAT}),
+        make_node("b", "Placeholder", types={"dtype": DT_FLOAT}),
+        make_node("dim", "Const", tensor=np.asarray(0, np.int32)),
+        make_node("sa", "Shape", ["a"]),
+        make_node("sb", "Shape", ["b"]),
+        make_node("off", "ConcatOffset", ["dim", "sa", "sb"]),
+    ]))
+    m2, p2, s2, _ = to_module(g2, inputs=["a", "b"],
+                              outputs=["off", "off:1"])
+    out, _ = m2.apply(p2, s2, jnp.zeros((2, 3)), jnp.zeros((4, 3)))
+    np.testing.assert_array_equal(np.asarray(out[0]), [0, 0])
+    np.testing.assert_array_equal(np.asarray(out[1]), [2, 0])
+
+    # const/dynamic shape mix (post-freezing): const shape folds into the
+    # closure without misaligning the offset outputs
+    g3 = load_graphdef(b"".join([
+        make_node("b", "Placeholder", types={"dtype": DT_FLOAT}),
+        make_node("dim", "Const", tensor=np.asarray(0, np.int32)),
+        make_node("sa", "Const", tensor=np.asarray([5, 3], np.int32)),
+        make_node("sb", "Shape", ["b"]),
+        make_node("off", "ConcatOffset", ["dim", "sa", "sb"]),
+    ]))
+    m3, p3, s3, _ = to_module(g3, inputs=["b"], outputs=["off", "off:1"])
+    out3, _ = m3.apply(p3, s3, jnp.zeros((4, 3)))
+    np.testing.assert_array_equal(np.asarray(out3[0]), [0, 0])
+    np.testing.assert_array_equal(np.asarray(out3[1]), [5, 0])
