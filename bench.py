@@ -40,6 +40,7 @@ _METRICS = {
     "lstm": ("lstm_ptb_train_throughput", "tokens/sec"),
     "transformer": ("transformer_ptb_train_throughput", "tokens/sec"),
     "kernels": ("pallas_kernel_speedups", "ratio"),
+    "resnet50_sweep": ("resnet50_bf16_mfu_best", "mfu"),
 }
 
 # bf16 peak FLOPs/sec per chip, keyed by substring of device_kind
@@ -328,6 +329,43 @@ def child_main():
             "unit": unit,
             "vs_baseline": 1.0,
             "backend": backend,
+        }))
+        return
+    if which == "resnet50_sweep":
+        # bf16 batch sweep for the MFU-optimal point (VERDICT r3 #1b):
+        # per-batch imgs/sec + MFU, headline = best MFU
+        metric, unit = _METRICS[which]
+        if backend == "cpu":
+            print(json.dumps({
+                "metric": metric, "value": 0.0, "unit": unit,
+                "vs_baseline": 0.0, "backend": backend,
+                "skipped": "MFU sweep needs a live TPU backend"}))
+            return
+        rows = {}
+        best = (0.0, None)
+        for bs in (64, 128, 256):
+            try:
+                ips, flops, sec = _bench_resnet50(
+                    compute_dtype=jnp.bfloat16, batch_size=bs)
+            except Exception as e:                      # OOM at 256 etc.
+                rows[f"batch_{bs}"] = {"error": str(e)[:200]}
+                continue
+            mfu = (flops / sec / peak) if peak else None
+            rows[f"batch_{bs}"] = {
+                "imgs_per_sec": round(ips, 1),
+                "mfu": round(mfu, 4) if mfu else None,
+            }
+            if mfu and mfu > best[0]:
+                best = (mfu, bs)
+        print(json.dumps({
+            "metric": metric,
+            "value": round(best[0], 4),
+            "unit": unit,
+            "vs_baseline": 1.0,
+            "backend": backend,
+            "device_kind": getattr(dev, "device_kind", "unknown"),
+            "best_batch": best[1],
+            **rows,
         }))
         return
     if which == "kernels":

@@ -50,7 +50,10 @@ def _parse_tensor(t: pw.Msg) -> np.ndarray:
     elif dtype == DT_INT64:
         arr = np.asarray([pw.sign64(v) for v in t.ints(10)], np.int64)
     else:
-        arr = np.asarray(t.ints(7), np.int32)
+        # int_val varints are unsigned on the wire; negative int32 consts
+        # (e.g. StridedSlice's -1 ends) arrive as 64-bit two's complement
+        arr = np.asarray([pw.sign64(v) for v in t.ints(7)],
+                         np.int64).astype(np.int32)
     if dims:
         if arr.size == 1 and int(np.prod(dims)) > 1:
             arr = np.full(dims, arr.reshape(-1)[0])   # splat encoding
@@ -101,6 +104,44 @@ class TFNode:
         """AttrValue.type (DataType enum)."""
         a = self.attrs.get(key)
         return a.int(6, default) if a is not None else default
+
+    def attr_shape(self, key):
+        """AttrValue.shape (TensorShapeProto, field 7) -> tuple of ints
+        (-1 for unknown dims), or None when absent / unknown rank."""
+        a = self.attrs.get(key)
+        if a is None or not a.has(7):
+            return None
+        sp = a.msg(7)
+        if sp.int(3, 0):
+            return None                    # unknown_rank
+        return tuple(pw.sign64(d.int(1, 0)) for d in sp.msgs(2))
+
+
+def strided_slice_index(node: "TFNode", begin, end, strides):
+    """Decode a StridedSlice node's mask attrs + const operands into a
+    numpy-style index tuple — the ONE implementation shared by the graph
+    executor (TFGraph._exec) and the module converter (tf_convert), so
+    mask semantics can never diverge between the two."""
+    b = [int(v) for v in np.asarray(begin).reshape(-1)]
+    e = [int(v) for v in np.asarray(end).reshape(-1)]
+    st = [int(v) for v in np.asarray(strides).reshape(-1)]
+
+    def mask(key):
+        a = node.attrs.get(key)
+        return pw.sign64(a.int(3, 0)) if a is not None else 0
+    if mask("ellipsis_mask") or mask("new_axis_mask"):
+        raise NotImplementedError(
+            f"StridedSlice {node.name}: ellipsis/new_axis masks")
+    bm, em, sm = (mask("begin_mask"), mask("end_mask"),
+                  mask("shrink_axis_mask"))
+    idx = []
+    for i in range(len(b)):
+        if sm & (1 << i):
+            idx.append(b[i])
+            continue
+        idx.append(slice(None if bm & (1 << i) else b[i],
+                         None if em & (1 << i) else e[i], st[i]))
+    return tuple(idx)
 
 
 def _pool(fn, init):
@@ -230,6 +271,11 @@ class TFGraph:
             a = node.attrs.get("epsilon")
             eps = a.float(4, 1e-3) if a is not None else 1e-3
             return (x - mean) / jnp.sqrt(var + eps) * scale + offset
+        if op == "Shape":
+            return jnp.asarray(ins[0].shape, jnp.int32)
+        if op == "StridedSlice":
+            return ins[0][strided_slice_index(node, ins[1], ins[2],
+                                              ins[3])]
         if op == "Range":
             # numpy scalars keep their dtype — float Range stays float
             s, l, d = (np.asarray(v).reshape(-1)[0] for v in ins)
@@ -269,7 +315,8 @@ def make_node(name: str, op: str, inputs: Sequence[str] = (),
               scalars: Optional[Dict[str, object]] = None,
               types: Optional[Dict[str, int]] = None,
               strings: Optional[Sequence[bytes]] = None,
-              str_lists: Optional[Dict[str, Sequence[str]]] = None) -> bytes:
+              str_lists: Optional[Dict[str, Sequence[str]]] = None,
+              shapes: Optional[Dict[str, Sequence[int]]] = None) -> bytes:
     """Encode one NodeDef (used by the exporter/tests — the analogue of
     TensorflowSaver, utils/tf/TensorflowSaver.scala). `strings` emits a
     DT_STRING Const tensor (filename lists, Example feature keys);
@@ -319,4 +366,11 @@ def make_node(name: str, op: str, inputs: Sequence[str] = (),
         # AttrValue.type (DataType enum, field 6) — the attrs stock TF
         # requires without defaults (Placeholder dtype, op T)
         body += attr(key, pw.field_varint(6, dt))
+    for key, dims in (shapes or {}).items():
+        # AttrValue.shape (TensorShapeProto, field 7); -1 dims encode as
+        # two's-complement varints like every TF int64
+        sp = b"".join(pw.field_bytes(2, pw.field_varint(1,
+                                                        d & ((1 << 64) - 1)))
+                      for d in dims)
+        body += attr(key, pw.field_bytes(7, sp))
     return pw.field_bytes(1, body)

@@ -24,7 +24,8 @@ from bigdl_tpu.core.container import Graph, Input, Node
 from bigdl_tpu.core.module import Module, ParamSpec
 from bigdl_tpu.core import init as initializers
 from bigdl_tpu.interop import protowire as pw
-from bigdl_tpu.interop.tensorflow import TFGraph, TFNode
+from bigdl_tpu.interop.tensorflow import (NP_OF_DT, TFGraph, TFNode,
+                                          strided_slice_index)
 
 
 # ------------------------------------------------ converter-private modules
@@ -141,6 +142,16 @@ _REDUCE_OPS = {"Sum": jnp.sum, "Max": jnp.max, "Min": jnp.min,
 
 # ------------------------------------------------------------ const folding
 _ALIAS_OPS = ("Identity", "StopGradient", "Snapshot")
+# ops with no data inputs that still create graph values (not const/dead)
+_SOURCE_OPS = ("TensorArrayV3",)
+
+
+# never fold these even when inputs are const: placeholders need feeds,
+# random ops must stay per-forward random (freezing one draw would be
+# silent semantic change), control/resource ops are not values
+_NO_FOLD = ("Placeholder", "PlaceholderV2", "PlaceholderWithDefault",
+            "RandomUniform", "RandomStandardNormal", "TruncatedNormal",
+            "RandomShuffle")
 
 
 def _const_value(g: TFGraph, name: str) -> Optional[np.ndarray]:
@@ -150,7 +161,26 @@ def _const_value(g: TFGraph, name: str) -> Optional[np.ndarray]:
     UNfrozen GraphDefs (variables + init ops instead of folded consts)
     import too — the resolved value lands in layer params and stays
     trainable, matching the reference's Variable loader semantics
-    (utils/tf/loaders/VariableV2.scala)."""
+    (utils/tf/loaders/VariableV2.scala).
+
+    Pure ops whose inputs ALL resolve const fold host-side through the
+    TFGraph executor (Range scatter indices, shape arithmetic, packed
+    shape vectors — the reference folds these through its own Session
+    run, utils/tf/TensorflowLoader.scala). Results are cached on the
+    graph; the None pre-fill doubles as a cycle guard for loop back
+    edges."""
+    if name in getattr(g, "_declared_inputs", ()):
+        return None                   # caller-declared input: stays symbolic
+    cache = g.__dict__.setdefault("_const_cache", {})
+    if name in cache:
+        return cache[name]
+    cache[name] = None
+    val = _const_value_uncached(g, name)
+    cache[name] = val
+    return val
+
+
+def _const_value_uncached(g: TFGraph, name: str) -> Optional[np.ndarray]:
     node = g.nodes.get(name)
     seen = set()
     while node is not None and node.op in _ALIAS_OPS and node.inputs:
@@ -158,13 +188,42 @@ def _const_value(g: TFGraph, name: str) -> Optional[np.ndarray]:
             return None
         seen.add(node.name)
         node = g.nodes.get(node.inputs[0])
-    if node is not None and node.op == "Const":
+    if node is None:
+        return None
+    if node.op == "Const":
         return node.attr_tensor("value")
-    if node is not None and node.op in ("VariableV2", "Variable"):
+    if node.op in ("VariableV2", "Variable"):
         init = _variable_initializers(g).get(node.name)
         if init is not None:
             return _const_value(g, init)
-    return None
+        return None
+    if node.op == "Shape" and node.inputs:
+        # static-shape inference: a Shape of a const folds below; a Shape
+        # of a Placeholder with a fully-defined declared shape is static
+        # too (how map_fn's scatter Range bottoms out on real TF graphs)
+        src = g.nodes.get(node.inputs[0])
+        hops = set()
+        while src is not None and src.op in _ALIAS_OPS and src.inputs \
+                and src.name not in hops:
+            hops.add(src.name)
+            src = g.nodes.get(src.inputs[0])
+        if src is not None and src.op.startswith("Placeholder"):
+            shp = src.attr_shape("shape")
+            if shp is not None and all(d >= 0 for d in shp):
+                return np.asarray(shp, np.int32)
+    if node.op in _NO_FOLD or not node.inputs:
+        return None
+    try:
+        ins = []
+        for i in node.inputs:
+            v = _const_value(g, i)
+            if v is None:
+                return None
+            # DT_STRING consts parse as object arrays — not JAX values
+            ins.append(jnp.asarray(v))
+        return np.asarray(g._exec(node, ins, {}))
+    except Exception:
+        return None
 
 
 def _variable_initializers(g: TFGraph) -> Dict[str, str]:
@@ -197,6 +256,13 @@ def to_module(graph: TFGraph, inputs: Optional[Sequence[str]] = None,
     (TensorflowLoader.scala:358).
     """
     input_names = list(inputs) if inputs else graph.placeholders
+    declared_inputs = frozenset(spec.partition(":")[0]
+                                for spec in input_names)
+    # declared inputs must never const-fold (a fed value would be
+    # silently ignored); folds are cached per declared-input set
+    if getattr(graph, "_declared_inputs", None) != declared_inputs:
+        graph._declared_inputs = declared_inputs
+        graph.__dict__.pop("_const_cache", None)
     if not input_names:
         raise ValueError("graph has no Placeholder and no explicit inputs")
     output_names = list(outputs) if outputs else [graph.order[-1]]
@@ -247,10 +313,10 @@ def to_module(graph: TFGraph, inputs: Optional[Sequence[str]] = None,
         if _const_value(graph, name) is not None:
             continue                       # weight/shape operand, not a layer
         data_ins = [i for i in node.inputs if is_data(i)]
-        if not data_ins:
+        if not data_ins and node.op not in _SOURCE_OPS:
             continue                       # dead / const subgraph
         built = _build_layer(graph, node, data_ins, sym, weights,
-                             sym_ports)
+                             sym_ports, declared=declared_inputs)
         if isinstance(built, dict):        # multi-output op (Split/Unpack)
             for port, tap in built.items():
                 sym_ports[(name, port)] = tap
@@ -342,10 +408,18 @@ def _collapse_while_frame(graph: TFGraph, fr, sym, sym_ports, weights,
 
 def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
                  sym: Dict[str, Node], weights,
-                 sym_ports: Optional[Dict] = None):
+                 sym_ports: Optional[Dict] = None,
+                 declared=frozenset()):
     op = node.op
-    const = lambda i: _const_value(graph, node.inputs[i])
     sym_ports = sym_ports or {}
+
+    def _cv(nm: str):
+        # a name the caller DECLARED as a graph input must stay symbolic:
+        # const-folding it (e.g. Shape-of-placeholder) would silently
+        # ignore the fed value
+        return None if nm in declared else _const_value(graph, nm)
+
+    const = lambda i: _cv(node.inputs[i])
 
     def resolve(nm: str, port: int) -> Node:
         if port:
@@ -382,10 +456,10 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
 
     def const_binary(fn, label):
         """Binary op with exactly one const operand (closed over)."""
-        c = _const_value(graph, node.inputs[0])
+        c = _cv(node.inputs[0])
         cf = c is not None
         if not cf:
-            c = _const_value(graph, node.inputs[1])
+            c = _cv(node.inputs[1])
         if c is None:
             raise NotImplementedError(f"{label} {node.name}: missing operand")
         return mk(ConstBinary(fn, np.asarray(c), const_first=cf, label=label))
@@ -396,7 +470,7 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
         symbolic parents, so op handlers must not assume all-dynamic."""
         slots, parents = [], []
         for i in range(n):
-            cv = _const_value(graph, node.inputs[i])
+            cv = _cv(node.inputs[i])
             if cv is not None:
                 slots.append(jnp.asarray(cv))
             else:
@@ -443,11 +517,15 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
         tb = bool(tb_at is not None and tb_at.int(5))
         w = const(1)
         if w is None:
+            def mm(a, b, ta=ta, tb=tb):
+                return (a.T if ta else a) @ (b.T if tb else b)
             if len(data_ins) == 2:        # two dynamic operands (e.g. a
                 # loop-invariant matrix inside an imported while body)
-                def mm(a, b, ta=ta, tb=tb):
-                    return (a.T if ta else a) @ (b.T if tb else b)
                 return mk(Lambda(mm, "matmul", n_in=2))
+            a = const(0)
+            if a is not None:             # const LHS (tf.linalg.matvec)
+                return mk(ConstBinary(mm, a, const_first=True,
+                                      label="matmul"))
             raise NotImplementedError(f"MatMul {node.name}: non-const weight")
         if ta:                             # rare; keep exact semantics
             def mm_t(a, b, tb=tb):
@@ -524,9 +602,9 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
         return mk(nn.Squeeze(tuple(dims) if dims else None))
     if op == "ExpandDims":
         axis = const(1)
-        return mk(nn.Unsqueeze(int(np.asarray(axis))))
+        return mk(nn.Unsqueeze(int(np.asarray(axis).reshape(()))))
     if op == "ConcatV2":
-        axis = _const_value(graph, node.inputs[-1])
+        axis = _cv(node.inputs[-1])
         return mk(nn.JoinTable(int(np.asarray(axis).reshape(()))))
     if op == "Mean":
         axes = const(1)
@@ -619,28 +697,8 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
         if any(v is None for v in (begin, end, strides)):
             raise NotImplementedError(
                 f"StridedSlice {node.name}: dynamic operands")
-        if attr_int("ellipsis_mask", 0) or attr_int("new_axis_mask", 0):
-            raise NotImplementedError(
-                f"StridedSlice {node.name}: ellipsis/new_axis masks")
-        bm = attr_int("begin_mask", 0)
-        em = attr_int("end_mask", 0)
-        sm = attr_int("shrink_axis_mask", 0)
-        b = [int(v) for v in np.asarray(begin).reshape(-1)]
-        e = [int(v) for v in np.asarray(end).reshape(-1)]
-        st = [int(v) for v in np.asarray(strides).reshape(-1)]
-
-        def do_ss(x, b=tuple(b), e=tuple(e), st=tuple(st),
-                  bm=bm, em=em, sm=sm):
-            idx = []
-            for i in range(len(b)):
-                if sm & (1 << i):
-                    idx.append(b[i])
-                    continue
-                lo = None if bm & (1 << i) else b[i]
-                hi = None if em & (1 << i) else e[i]
-                idx.append(slice(lo, hi, st[i]))
-            return x[tuple(idx)]
-        return mk(Lambda(do_ss, "strided_slice"))
+        idx = strided_slice_index(node, begin, end, strides)
+        return mk(Lambda(lambda x, idx=idx: x[idx], "strided_slice"))
     if op == "Transpose":
         perm = const(1)
         if perm is None:
@@ -648,7 +706,7 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
         p = tuple(int(v) for v in np.asarray(perm).reshape(-1))
         return mk(Lambda(lambda x, pp=p: jnp.transpose(x, pp), "transpose"))
     if op in ("Gather", "GatherV2"):
-        data = _const_value(graph, node.inputs[0])
+        data = _cv(node.inputs[0])
         ax = const(2) if len(node.inputs) > 2 else 0
         axis = int(np.asarray(ax).reshape(())) if ax is not None else 0
         if data is not None and data.ndim == 2 and axis == 0:
@@ -712,15 +770,15 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
     # --------------------------------------------- multi-output (ports)
     if op in ("Split", "SplitV", "Unpack"):
         if op == "Split":                  # inputs: (axis, value)
-            ax = _const_value(graph, node.inputs[0])
+            ax = _cv(node.inputs[0])
             if ax is None:
                 raise NotImplementedError(f"Split {node.name}: dynamic axis")
             axis = int(np.asarray(ax).reshape(()))
             n_out = attr_int("num_split", 1)
             bounds = n_out
         elif op == "SplitV":               # (value, size_splits, axis)
-            sizes = _const_value(graph, node.inputs[1])
-            ax = _const_value(graph, node.inputs[2])
+            sizes = _cv(node.inputs[1])
+            ax = _cv(node.inputs[2])
             if sizes is None or ax is None:
                 raise NotImplementedError(
                     f"SplitV {node.name}: dynamic operands")
@@ -750,7 +808,7 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
         # (reference: utils/tf/loaders/ArrayOps.scala ConcatOffset).
         # Shapes may be any const/dynamic mix after freezing — mixed()
         # closes consts over and wires only the dynamic parents.
-        cd = _const_value(graph, node.inputs[0])
+        cd = _cv(node.inputs[0])
         if cd is None:
             raise NotImplementedError(
                 f"ConcatOffset {node.name}: dynamic concat_dim")
@@ -769,6 +827,119 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
                      n_in=len(parents))(*parents)
         return {i: nn.SelectTable(i)(tup) for i in range(n_out)}
 
+    # ------------------------------------------- TensorArray (DataFlowOps)
+    # The reference executes TensorArray* dynamically against a resource
+    # store (utils/tf/loaders/DataFlowOps.scala, nn/tf/DataFlowOps).
+    # Under XLA the array must be a dense value, so the FLOW edge (a
+    # scalar float in TF) is reinterpreted as the buffer itself:
+    # TensorArrayV3 emits the initial (size, *elem) zeros buffer on both
+    # its handle and flow ports, writes/scatters produce new buffers, and
+    # the while-frame collapse threads the buffer through the loop carry
+    # like any other loop var. Static shapes required — the same
+    # constraint XLA puts on TF's own in-loop TensorArrays.
+    if op == "TensorArrayV3":
+        size_c = const(0)
+        if size_c is None:
+            raise NotImplementedError(
+                f"TensorArrayV3 {node.name}: dynamic size")
+        size = int(np.asarray(size_c).reshape(()))
+        dt = NP_OF_DT.get(node.attr_type("dtype", 1), np.float32)
+        eshape = node.attr_shape("element_shape")
+        if eshape is not None and all(d >= 0 for d in eshape):
+            shape = (size,) + tuple(int(d) for d in eshape)
+        else:
+            # sentinel: a Scatter covering every row replaces it wholesale
+            # (the common input-array pattern); Writes need element_shape
+            shape = (size, 0)
+        tap = Lambda(lambda s=shape, d=dt: jnp.zeros(s, d),
+                     "tensor_array", n_in=0)()
+        return {0: tap, 1: tap}
+
+    if op == "TensorArrayReadV3":           # (handle, index, flow)
+        wrap, parents = mixed(3)
+        return mk(Lambda(wrap(lambda h, i, f: lax.dynamic_index_in_dim(
+            f, jnp.asarray(i, jnp.int32).reshape(()), 0, keepdims=False)),
+            "ta_read", n_in=len(parents)), parents=parents)
+
+    if op == "TensorArrayWriteV3":          # (handle, index, value, flow)
+        wrap, parents = mixed(4)
+
+        def ta_write(h, i, v, f):
+            if f.ndim >= 2 and f.shape[-1] == 0 and v.shape[-1:] != (0,):
+                # sentinel (no element_shape): materialize the buffer
+                # from the first written value's shape — TFWhile's
+                # eval_shape fix-up re-seeds the loop carry to match
+                f = jnp.zeros((f.shape[0],) + v.shape, f.dtype)
+            return lax.dynamic_update_index_in_dim(
+                f, v.astype(f.dtype), jnp.asarray(i, jnp.int32).reshape(()),
+                0)
+        return mk(Lambda(wrap(ta_write), "ta_write", n_in=len(parents)),
+                  parents=parents)
+
+    if op == "TensorArrayScatterV3":        # (handle, indices, value, flow)
+        wrap, parents = mixed(4)
+
+        def ta_scatter(h, idx, v, f):
+            if v.shape[0] == f.shape[0]:    # full cover: buffer := v
+                return jnp.take(v, jnp.argsort(idx), axis=0)
+            if f.ndim >= 2 and f.shape[-1] == 0:
+                raise NotImplementedError(
+                    f"TensorArrayScatterV3 {node.name}: partial scatter "
+                    "into an array created without element_shape")
+            return f.at[idx].set(v.astype(f.dtype))
+        return mk(Lambda(wrap(ta_scatter), "ta_scatter",
+                         n_in=len(parents)), parents=parents)
+
+    if op == "TensorArrayGatherV3":         # (handle, indices, flow)
+        wrap, parents = mixed(3)
+        return mk(Lambda(wrap(lambda h, idx, f: jnp.take(
+            f, jnp.asarray(idx, jnp.int32), axis=0)), "ta_gather",
+            n_in=len(parents)), parents=parents)
+
+    if op == "TensorArraySizeV3":           # (handle, flow)
+        wrap, parents = mixed(2)
+        return mk(Lambda(wrap(lambda h, f: jnp.asarray(f.shape[0],
+                                                       jnp.int32)),
+                         "ta_size", n_in=len(parents)), parents=parents)
+
+    if op == "TensorArrayConcatV3":         # (handle, flow) -> value, lengths
+        wrap, parents = mixed(2)
+        val = Lambda(wrap(lambda h, f: f.reshape((-1,) + f.shape[2:])),
+                     "ta_concat", n_in=len(parents))(*parents)
+        # int32, not TF's int64: JAX (x64 disabled) truncates int64 to
+        # int32 with a warning anyway
+        lens = Lambda(wrap(lambda h, f: jnp.full((f.shape[0],), f.shape[1],
+                                                 jnp.int32)),
+                      "ta_concat_lengths", n_in=len(parents))(*parents)
+        return {0: val, 1: lens}
+
+    if op == "TensorArraySplitV3":          # (handle, value, lengths, flow)
+        lc = const(2)
+        if lc is None:
+            raise NotImplementedError(
+                f"TensorArraySplitV3 {node.name}: dynamic lengths")
+        lens = [int(v) for v in np.asarray(lc).reshape(-1)]
+        if len(set(lens)) != 1:
+            raise NotImplementedError(
+                f"TensorArraySplitV3 {node.name}: non-uniform lengths "
+                f"{lens} cannot form a dense (n, len, ...) buffer")
+        wrap, parents = mixed(4)
+        ln = lens[0]
+        return mk(Lambda(wrap(lambda h, v, l, f, n=len(lens), ln=ln:
+                              v.reshape((n, ln) + v.shape[1:])),
+                         "ta_split", n_in=len(parents)), parents=parents)
+
+    if op == "TensorArrayCloseV3":
+        return parent[0] if parent else None
+
+    if op == "TensorArrayGradV3" or op.startswith("Stack"):
+        # Stack push/pop exists only to save forward activations for TF's
+        # hand-built while-loop gradients (nn/tf/DataFlowOps precedent)
+        raise NotImplementedError(
+            f"{op} {node.name}: TF's hand-built gradient machinery is "
+            "unnecessary here — autodiff differentiates through the "
+            "imported loop (counted loops lower to lax.scan)")
+
     # ------------------------------------------------------------ spatial
     if op == "LRN":
         r = node.attrs.get("depth_radius")
@@ -783,8 +954,8 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
             beta.float(4, 0.5) if beta is not None else 0.5,
             bias.float(4, 1.0) if bias is not None else 1.0))
     if op == "Conv2DBackpropInput":
-        out_shape = _const_value(graph, node.inputs[0])
-        w = _const_value(graph, node.inputs[1])
+        out_shape = _cv(node.inputs[0])
+        w = _cv(node.inputs[1])
         if out_shape is None or w is None:
             raise NotImplementedError(
                 f"Conv2DBackpropInput {node.name}: dynamic operands")
@@ -899,8 +1070,8 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
         return mk(Lambda(lambda x, d=d2d, wc=jnp.asarray(w):
                          d.forward({}, x, wc), "dilation2d"))
     if op in ("Conv3DBackpropInput", "Conv3DBackpropInputV2"):
-        out_shape = _const_value(graph, node.inputs[0])
-        w = _const_value(graph, node.inputs[1])
+        out_shape = _cv(node.inputs[0])
+        w = _cv(node.inputs[1])
         if out_shape is None or w is None:
             raise NotImplementedError(
                 f"{op} {node.name}: dynamic operands")

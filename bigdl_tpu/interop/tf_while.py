@@ -404,11 +404,29 @@ class TFWhile(Module):
                                   *[full[i] for i in self.cond_sel])
             return jnp.reshape(out, ()).astype(bool)
 
-        def body_fn(c):
+        def body_raw(c):
             full = tuple(c) + invs
             out, _ = body_g.apply(params["body"], state["body"],
                                   *[full[i] for i in self.body_sel])
-            outs = out if isinstance(out, tuple) else (out,)
+            return out if isinstance(out, tuple) else (out,)
+
+        # TensorArray buffers created without element_shape enter the
+        # loop as (size, 0) sentinels; one abstract body evaluation
+        # reveals the written element shape, and the carry re-seeds with
+        # zeros of the real shape (XLA demands shape-stable carries)
+        if any(c.ndim >= 2 and c.shape[-1] == 0 for c in carry):
+            try:
+                outs = jax.eval_shape(body_raw, carry)
+                carry = tuple(
+                    jnp.zeros(o.shape, o.dtype)
+                    if (c.ndim >= 2 and c.shape[-1] == 0
+                        and o.shape != c.shape) else c
+                    for c, o in zip(carry, outs))
+            except Exception:
+                pass                      # shapes stay; errors surface below
+
+        def body_fn(c):
+            outs = body_raw(c)
             # XLA while carries must be shape/dtype-stable
             return tuple(jnp.asarray(o).astype(ci.dtype).reshape(ci.shape)
                          for o, ci in zip(outs, carry))

@@ -298,3 +298,86 @@ def test_real_tf_while_loop_data_dependent_cond():
                                       inputs=["x"], outputs=["out"])
     got, _ = mod.apply(params, state, jnp.asarray(x))
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+
+def test_real_tf_map_fn_tensorarray_roundtrip():
+    """REAL tf.map_fn (v1 control flow): TensorArray scatter/read/write
+    threading through the while frame — the canonical DataFlowOps
+    pattern (reference: utils/tf/loaders/DataFlowOps.scala) — imports
+    and matches the real session; gradients flow (counted loop ->
+    lax.scan)."""
+    import jax
+
+    A = (0.5 * R.randn(3, 3)).astype(np.float32)
+    x = R.randn(4, 3).astype(np.float32)
+
+    tf.compat.v1.disable_control_flow_v2()
+    try:
+        def build():
+            inp = tf.compat.v1.placeholder(tf.float32, (4, 3), name="x")
+            out = tf.map_fn(
+                lambda row: tf.tanh(tf.linalg.matvec(tf.constant(A), row)),
+                inp)
+            return tf.identity(out, name="out")
+
+        buf, want = _tf1_graphdef_and_output(build, {"x:0": x})
+    finally:
+        tf.compat.v1.enable_control_flow_v2()
+
+    mod, params, state, _ = to_module(load_graphdef(buf),
+                                      inputs=["x"], outputs=["out"])
+    got, _ = mod.apply(params, state, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                               atol=1e-6)
+    g = jax.grad(lambda v: mod.apply(params, state, v)[0].sum())(
+        jnp.asarray(x))
+    want_g = np.asarray(jax.grad(
+        lambda v: jnp.tanh(v @ jnp.asarray(A).T).sum())(jnp.asarray(x)))
+    np.testing.assert_allclose(np.asarray(g), want_g, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_real_tf_recurrent_while_with_tensorarrays():
+    """A dynamic_rnn-shaped REAL graph: input TensorArray unstacked over
+    time, a vanilla-RNN recurrence h' = tanh(x_t W + h U + b) in a
+    tf.while_loop, outputs written to a second TensorArray and stacked —
+    imports and matches the real session."""
+    T, B, F, H = 5, 2, 3, 4
+    Wm = (0.4 * R.randn(F, H)).astype(np.float32)
+    Um = (0.4 * R.randn(H, H)).astype(np.float32)
+    bm = (0.1 * R.randn(H)).astype(np.float32)
+    x = R.randn(T, B, F).astype(np.float32)
+
+    tf.compat.v1.disable_control_flow_v2()
+    try:
+        def build():
+            v1 = tf.compat.v1
+            inp = v1.placeholder(tf.float32, (T, B, F), name="x")
+            ta_in = tf.TensorArray(tf.float32, size=T,
+                                   element_shape=(B, F)).unstack(inp)
+            ta_out = tf.TensorArray(tf.float32, size=T,
+                                    element_shape=(B, H))
+            h0 = tf.zeros((B, H))
+
+            def cond(t, h, ta):
+                return t < T
+
+            def body(t, h, ta):
+                xt = ta_in.read(t)
+                h2 = tf.tanh(xt @ tf.constant(Wm) + h @ tf.constant(Um)
+                             + tf.constant(bm))
+                return t + 1, h2, ta.write(t, h2)
+
+            _, _, ta_fin = tf.while_loop(cond, body,
+                                         [tf.constant(0), h0, ta_out])
+            return tf.identity(ta_fin.stack(), name="out")
+
+        buf, want = _tf1_graphdef_and_output(build, {"x:0": x})
+    finally:
+        tf.compat.v1.enable_control_flow_v2()
+
+    mod, params, state, _ = to_module(load_graphdef(buf),
+                                      inputs=["x"], outputs=["out"])
+    got, _ = mod.apply(params, state, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                               atol=1e-6)

@@ -1,0 +1,170 @@
+"""TensorArray (DataFlowOps) import unit tests — the flow-as-buffer
+representation (interop/tf_convert.py TensorArray handlers; reference:
+utils/tf/loaders/DataFlowOps.scala executes these against a dynamic
+resource store). Real-TF goldens (map_fn, dynamic_rnn-shaped loop) live
+in test_golden_tf_real.py; these cover each op and the refusal edges
+with hand-assembled GraphDefs."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from bigdl_tpu.interop.tensorflow import (DT_FLOAT, DT_INT32,
+                                          load_graphdef, make_node)
+from bigdl_tpu.interop.tf_convert import to_module
+
+
+def _convert(nodes, inputs, outputs):
+    g = load_graphdef(b"".join(nodes))
+    return to_module(g, inputs=inputs, outputs=outputs)
+
+
+def _ta(name, size, eshape=None):
+    kw = {"types": {"dtype": DT_FLOAT}}
+    if eshape is not None:
+        kw["shapes"] = {"element_shape": list(eshape)}
+    return [make_node(f"{name}_size", "Const",
+                      tensor=np.asarray(size, np.int32)),
+            make_node(name, "TensorArrayV3", [f"{name}_size"], **kw)]
+
+
+def test_scatter_gather_roundtrip_with_permutation():
+    """scatter(indices, v)[gather(indices)] == v even for a permuted
+    index vector, element_shape unknown (sentinel full-cover path)."""
+    nodes = [make_node("v", "Placeholder", types={"dtype": DT_FLOAT}),
+             make_node("idx", "Const",
+                       tensor=np.asarray([2, 0, 1], np.int32)),
+             *_ta("ta", 3),
+             make_node("scat", "TensorArrayScatterV3",
+                       ["ta", "idx", "v", "ta:1"]),
+             make_node("gath", "TensorArrayGatherV3",
+                       ["ta", "idx", "scat"]),
+             make_node("all", "TensorArrayGatherV3",
+                       ["ta", "arange", "scat"]),
+             make_node("arange", "Const",
+                       tensor=np.asarray([0, 1, 2], np.int32))]
+    m, p, s, _ = _convert(nodes, ["v"], ["gath", "all"])
+    v = np.arange(12, dtype=np.float32).reshape(3, 4)
+    out, _ = m.apply(p, s, jnp.asarray(v))
+    np.testing.assert_array_equal(np.asarray(out[0]), v)
+    # buffer row idx[k] holds v[k]: rows in storage order are v[argsort]
+    np.testing.assert_array_equal(np.asarray(out[1]), v[[1, 2, 0]])
+
+
+def test_read_write_size_concat():
+    """write -> read back; size from the buffer; concat flattens with
+    uniform lengths on port 1."""
+    nodes = [make_node("v", "Placeholder", types={"dtype": DT_FLOAT}),
+             make_node("i1", "Const", tensor=np.asarray(1, np.int32)),
+             *_ta("ta", 3, eshape=(2,)),
+             make_node("w", "TensorArrayWriteV3",
+                       ["ta", "i1", "v", "ta:1"]),
+             make_node("rd", "TensorArrayReadV3", ["ta", "i1", "w"]),
+             make_node("sz", "TensorArraySizeV3", ["ta", "w"]),
+             make_node("cc", "TensorArrayConcatV3", ["ta", "w"])]
+    m, p, s, _ = _convert(nodes, ["v"], ["rd", "sz", "cc", "cc:1"])
+    v = np.asarray([5.0, -2.0], np.float32)
+    out, _ = m.apply(p, s, jnp.asarray(v))
+    np.testing.assert_array_equal(np.asarray(out[0]), v)
+    assert int(out[1]) == 3
+    np.testing.assert_array_equal(
+        np.asarray(out[2]), np.concatenate([[0, 0], v, [0, 0]]))
+    # lengths (port 1) = each element's leading dim (TF concat contract)
+    np.testing.assert_array_equal(np.asarray(out[3]), [2, 2, 2])
+
+
+def test_split_uniform_and_refusals():
+    """split reshapes to (n, len, ...); non-uniform lengths and dynamic
+    size refuse with actionable messages."""
+    nodes = [make_node("v", "Placeholder", types={"dtype": DT_FLOAT}),
+             make_node("lens", "Const",
+                       tensor=np.asarray([2, 2], np.int32)),
+             *_ta("ta", 2),
+             make_node("sp", "TensorArraySplitV3",
+                       ["ta", "v", "lens", "ta:1"]),
+             make_node("i0", "Const", tensor=np.asarray(0, np.int32)),
+             make_node("rd", "TensorArrayReadV3", ["ta", "i0", "sp"])]
+    m, p, s, _ = _convert(nodes, ["v"], ["rd"])
+    v = np.arange(4, dtype=np.float32)
+    out, _ = m.apply(p, s, jnp.asarray(v))
+    np.testing.assert_array_equal(np.asarray(out), [0.0, 1.0])
+
+    bad = [make_node("v", "Placeholder", types={"dtype": DT_FLOAT}),
+           make_node("lens", "Const", tensor=np.asarray([1, 3], np.int32)),
+           *_ta("ta", 2),
+           make_node("sp", "TensorArraySplitV3",
+                     ["ta", "v", "lens", "ta:1"])]
+    with pytest.raises(NotImplementedError, match="non-uniform"):
+        _convert(bad, ["v"], ["sp"])
+
+    dyn = [make_node("n", "Placeholder", types={"dtype": DT_INT32}),
+           make_node("ta", "TensorArrayV3", ["n"],
+                     types={"dtype": DT_FLOAT}),
+           make_node("i0", "Const", tensor=np.asarray(0, np.int32)),
+           make_node("v", "Placeholder", types={"dtype": DT_FLOAT}),
+           make_node("w", "TensorArrayWriteV3", ["ta", "i0", "v", "ta:1"])]
+    with pytest.raises(NotImplementedError, match="dynamic size"):
+        _convert(dyn, ["n", "v"], ["w"])
+
+
+def test_grad_machinery_refuses():
+    nodes = [make_node("v", "Placeholder", types={"dtype": DT_FLOAT}),
+             *_ta("ta", 2),
+             make_node("g", "TensorArrayGradV3", ["ta", "v"],
+                       strs={"source": "gradients"})]
+    with pytest.raises(NotImplementedError, match="autodiff"):
+        _convert(nodes, ["v"], ["g"])
+
+
+def test_const_subgraph_folding_powers_scatter_indices():
+    """Range(0, Shape(placeholder)[0], 1) folds through the executor —
+    the pattern real map_fn emits for scatter indices."""
+    nodes = [
+        make_node("x", "Placeholder", types={"dtype": DT_FLOAT},
+                  shapes={"shape": [3, 2]}),
+        make_node("sh", "Shape", ["x"]),
+        make_node("b0", "Const", tensor=np.asarray([0], np.int32)),
+        make_node("b1", "Const", tensor=np.asarray([1], np.int32)),
+        make_node("ss", "StridedSlice", ["sh", "b0", "b1", "b1"],
+                  scalars={"shrink_axis_mask": 1}),
+        make_node("start", "Const", tensor=np.asarray(0, np.int32)),
+        make_node("delta", "Const", tensor=np.asarray(1, np.int32)),
+        make_node("rng", "Range", ["start", "ss", "delta"]),
+        *_ta("ta", 3),
+        make_node("scat", "TensorArrayScatterV3",
+                  ["ta", "rng", "x", "ta:1"]),
+        make_node("gath", "TensorArrayGatherV3", ["ta", "rng", "scat"]),
+    ]
+    m, p, s, _ = _convert(nodes, ["x"], ["gath"])
+    x = np.arange(6, dtype=np.float32).reshape(3, 2)
+    out, _ = m.apply(p, s, jnp.asarray(x))
+    np.testing.assert_array_equal(np.asarray(out), x)
+
+
+def test_string_const_input_does_not_crash_folding():
+    """A node consuming a DT_STRING const (Assert messages, Substr) must
+    fold to None quietly, not crash to_module (object arrays are not JAX
+    values)."""
+    from bigdl_tpu.interop.tf_convert import _const_value
+    g = load_graphdef(b"".join([
+        make_node("s", "Const", strings=[b"shape check failed"]),
+        make_node("eq", "Equal", ["s", "s"]),
+    ]))
+    assert _const_value(g, "eq") is None
+
+
+def test_declared_input_is_never_const_folded():
+    """inputs=['x', 'sh'] where sh is Shape(x) (statically foldable):
+    the DECLARED input must stay symbolic — the fed value wins over the
+    static fold."""
+    nodes = [
+        make_node("x", "Placeholder", types={"dtype": DT_FLOAT},
+                  shapes={"shape": [4, 3]}),
+        make_node("sh", "Shape", ["x"]),
+        make_node("one", "Const", tensor=np.asarray(1, np.int32)),
+        make_node("out", "AddV2", ["sh", "one"]),
+    ]
+    m, p, s, _ = _convert(nodes, ["sh"], ["out"])
+    out, _ = m.apply(p, s, jnp.asarray([7, 9], np.int32))
+    np.testing.assert_array_equal(np.asarray(out), [8, 10])
