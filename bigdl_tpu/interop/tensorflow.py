@@ -54,10 +54,17 @@ def _parse_tensor(t: pw.Msg) -> np.ndarray:
         # (e.g. StridedSlice's -1 ends) arrive as 64-bit two's complement
         arr = np.asarray([pw.sign64(v) for v in t.ints(7)],
                          np.int64).astype(np.int32)
+    n_expect = int(np.prod(dims)) if dims else 1
+    if arr.size == 0 and n_expect >= 1:
+        # TF omits the value fields entirely for all-zero tensors
+        # (implicit proto3 defaults): dtype + shape alone mean zeros
+        arr = np.zeros(n_expect, np_dtype)
     if dims:
-        if arr.size == 1 and int(np.prod(dims)) > 1:
+        if arr.size == 1 and n_expect > 1:
             arr = np.full(dims, arr.reshape(-1)[0])   # splat encoding
         arr = arr.reshape(dims)
+    else:
+        arr = arr.reshape(())  if arr.size == 1 else arr
     return arr
 
 

@@ -226,6 +226,38 @@ def _const_value_uncached(g: TFGraph, name: str) -> Optional[np.ndarray]:
         return None
 
 
+def _topo_order(g: TFGraph) -> List[str]:
+    """Topological order over data edges. GraphDefs are usually stored
+    topologically but are not required to be (TF1's cond lowering emits
+    branch nodes before their Switch); while-frame back edges
+    (NextIteration -> Merge) make the graph cyclic, so in-progress nodes
+    are skipped — frame interiors are collapsed separately anyway."""
+    order: List[str] = []
+    state: Dict[str, int] = {}            # 1 = in progress, 2 = done
+    for root in g.order:
+        if state.get(root):
+            continue
+        stack = [(root, 0)]
+        while stack:
+            nm, idx = stack.pop()
+            node = g.nodes.get(nm)
+            if node is None:
+                continue
+            if idx == 0:
+                if state.get(nm) == 2:
+                    continue
+                state[nm] = 1
+            if idx < len(node.inputs):
+                stack.append((nm, idx + 1))
+                child = node.inputs[idx]
+                if state.get(child, 0) == 0 and child in g.nodes:
+                    stack.append((child, 0))
+                continue
+            state[nm] = 2
+            order.append(nm)
+    return order
+
+
 def _variable_initializers(g: TFGraph) -> Dict[str, str]:
     """var name -> name of the value its Assign initializer writes
     (cached on the graph)."""
@@ -295,7 +327,7 @@ def to_module(graph: TFGraph, inputs: Optional[Sequence[str]] = None,
     from bigdl_tpu.interop import tf_while as _tfw
     _frames, _member_of, _exit_frame = _tfw.detect_frames(graph)
 
-    for name in graph.order:
+    for name in _topo_order(graph):
         if name in sym:
             continue
         node = graph.nodes[name]
@@ -313,8 +345,12 @@ def to_module(graph: TFGraph, inputs: Optional[Sequence[str]] = None,
         if _const_value(graph, name) is not None:
             continue                       # weight/shape operand, not a layer
         data_ins = [i for i in node.inputs if is_data(i)]
-        if not data_ins and node.op not in _SOURCE_OPS:
-            continue                       # dead / const subgraph
+        if not data_ins and node.op not in _SOURCE_OPS \
+                and node.op not in ("Merge", "RefMerge"):
+            # dead / const subgraph. Frameless Merges pass through even
+            # with both arms const (cond with two const branches): the
+            # handler wires the select / static branch
+            continue
         built = _build_layer(graph, node, data_ins, sym, weights,
                              sym_ports, declared=declared_inputs)
         if isinstance(built, dict):        # multi-output op (Split/Unpack)
@@ -826,6 +862,114 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
         tup = Lambda(wrap(offsets), "concat_offset",
                      n_in=len(parents))(*parents)
         return {i: nn.SelectTable(i)(tup) for i in range(n_out)}
+
+    # --------------------------------------- lowered tf.cond (frameless)
+    # TF2's freezer (convert_variables_to_constants_v2) lowers If/While
+    # back to v1 control flow; While frames are collapsed by
+    # interop/tf_while.py, and a frameless Switch/Merge pair is a
+    # lowered tf.cond. Both branches are pure dataflow here, so the
+    # import computes both and selects at the Merge with jnp.where —
+    # the reference instead schedules branches dynamically
+    # (nn/Scheduler.scala; utils/tf/loaders/ControlFlowOps.scala).
+    if op in ("Switch", "RefSwitch"):
+        # both output ports forward the data value; the join selects
+        data_tap = resolve(*node.input_ports[0]) \
+            if node.inputs[0] in sym else None
+        if data_tap is None:
+            cv = _cv(node.inputs[0])
+            if cv is None:
+                raise NotImplementedError(
+                    f"Switch {node.name}: unconverted data input")
+            data_tap = Lambda(lambda c=jnp.asarray(cv): c, "switch_const",
+                              n_in=0)()
+        return {0: data_tap, 1: data_tap}
+
+    if op in ("Merge", "RefMerge"):
+        def find_switch(start_nm, start_port):
+            """Chase a branch back to its gating Switch; returns (switch
+            node, port entered through) or (None, None) for a
+            switch-free branch (e.g. a const arm)."""
+            stack, seen = [(start_nm, start_port)], set()
+            while stack:
+                nm, pt = stack.pop()
+                if (nm, pt) in seen:
+                    continue
+                seen.add((nm, pt))
+                nd = graph.nodes.get(nm)
+                if nd is None:
+                    continue
+                if nd.op in ("Switch", "RefSwitch"):
+                    return nd, pt
+                if nd.op in ("Merge", "RefMerge") and nm != node.name:
+                    raise NotImplementedError(
+                        f"Merge {node.name}: nested lowered tf.cond")
+                stack.extend(nd.input_ports)
+                # const arms are gated only by ^control deps on the
+                # pivot's switch_t/switch_f identities — chase those too
+                stack.extend((c, 0) for c in nd.control_inputs)
+            return None, None
+
+        if len(node.inputs) != 2:
+            raise NotImplementedError(
+                f"Merge {node.name}: expected 2 branch inputs, got "
+                f"{len(node.inputs)}")
+        ports = []
+        switch = None
+        for nm, pt in node.input_ports[:2]:
+            sw, p = find_switch(nm, pt)
+            if sw is not None:
+                switch = sw
+            ports.append(None if sw is None else p)
+        if switch is None:
+            raise NotImplementedError(
+                f"Merge {node.name}: no controlling Switch found")
+        if ports[0] is None:
+            ports[0] = 1 - ports[1]
+        if ports[1] is None:
+            ports[1] = 1 - ports[0]
+        if sorted(ports) != [0, 1]:
+            raise NotImplementedError(
+                f"Merge {node.name}: branches enter through ports "
+                f"{ports}, expected one false (0) and one true (1)")
+        true_first = ports[0] == 1
+        pred_const = _cv(switch.inputs[1])
+        if pred_const is not None:
+            # frozen-in predicate (e.g. a Keras learning-phase const):
+            # wire through only the statically-taken branch
+            taken = ports.index(
+                1 if bool(np.asarray(pred_const).reshape(())) else 0)
+            cv = _cv(node.inputs[taken])
+            tap = Lambda(lambda c=jnp.asarray(cv): c, "cond_taken",
+                         n_in=0)() if cv is not None \
+                else resolve(*node.input_ports[taken])
+            vi0 = Lambda(lambda t=jnp.int32(taken): t, "cond_value_index",
+                         n_in=0)()
+            return {0: tap, 1: vi0}
+        pred_tap = resolve(*switch.input_ports[1])
+        slots, parents = [], []
+        for i in range(2):
+            cv = _cv(node.inputs[i])
+            if cv is not None:
+                slots.append(jnp.asarray(cv))
+            else:
+                slots.append(None)
+                parents.append(resolve(*node.input_ports[i]))
+
+        def sel(*xs, slots=tuple(slots), tf_=true_first):
+            it = iter(xs)
+            a, b = [s if s is not None else next(it) for s in slots]
+            p = next(it)
+            t, f = (a, b) if tf_ else (b, a)
+            return jnp.where(p, t, f)
+        out = Lambda(sel, "cond_merge",
+                     n_in=len(parents) + 1)(*parents, pred_tap)
+        # value_index = index of the Merge input that fired: the
+        # true-branch input's index when pred, else the false one's
+        ti = 0 if true_first else 1
+        vi = Lambda(lambda p, ti=ti: jnp.where(
+            jnp.reshape(p, ()), jnp.int32(ti), jnp.int32(1 - ti)),
+            "cond_value_index")(pred_tap)
+        return {0: out, 1: vi}
 
     # ------------------------------------------- TensorArray (DataFlowOps)
     # The reference executes TensorArray* dynamically against a resource

@@ -381,3 +381,108 @@ def test_real_tf_recurrent_while_with_tensorarrays():
     got, _ = mod.apply(params, state, jnp.asarray(x))
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
                                atol=1e-6)
+
+
+def test_real_tf2_function_while_and_cond():
+    """A MODERN TF2 path: tf.function traced, frozen with
+    convert_variables_to_constants_v2 (which lowers v2 While/If to v1
+    Switch/Merge/frames) — the import covers the while frame AND the
+    frameless lowered tf.cond, both branches checked against the real
+    concrete function."""
+    A = np.eye(3, dtype=np.float32) * 0.7
+
+    @tf.function
+    def f(x):
+        def cond(i, v):
+            return i < 5
+
+        def body(i, v):
+            return i + 1, tf.tanh(v @ tf.constant(A))
+        _, v = tf.while_loop(cond, body, [tf.constant(0), x])
+        return tf.cond(tf.reduce_sum(v) > 0,
+                       lambda: v * 2.0, lambda: v - 1.0)
+
+    cf = f.get_concrete_function(tf.TensorSpec((2, 3), tf.float32))
+    from tensorflow.python.framework.convert_to_constants import \
+        convert_variables_to_constants_v2
+    gd = convert_variables_to_constants_v2(cf).graph.as_graph_def()
+    mod, params, state, _ = to_module(load_graphdef(gd.SerializeToString()),
+                                      inputs=["x"], outputs=["Identity"])
+    for seed, sign in ((0, 1.0), (1, -1.0)):        # hit BOTH branches
+        x = (sign * np.abs(np.random.RandomState(seed).randn(2, 3))
+             ).astype(np.float32)
+        want = cf(tf.constant(x)).numpy()
+        got, _ = mod.apply(params, state, jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_real_tf_cond_with_const_arm():
+    """Lowered tf.cond where one branch is a pure constant (no Switch in
+    that arm) — the Merge port assignment must infer the const arm from
+    the switched one."""
+    tf.compat.v1.disable_control_flow_v2()
+    try:
+        def build():
+            v1 = tf.compat.v1
+            inp = v1.placeholder(tf.float32, (2,), name="x")
+            out = tf.cond(tf.reduce_sum(inp) > 0.0,
+                          lambda: inp * 3.0,
+                          lambda: tf.constant([7.0, 7.0]))
+            return tf.identity(out, name="out")
+
+        x_pos = np.asarray([1.0, 2.0], np.float32)
+        buf, want_pos = _tf1_graphdef_and_output(build, {"x:0": x_pos})
+    finally:
+        tf.compat.v1.enable_control_flow_v2()
+
+    mod, params, state, _ = to_module(load_graphdef(buf),
+                                      inputs=["x"], outputs=["out"])
+    got, _ = mod.apply(params, state, jnp.asarray(x_pos))
+    np.testing.assert_allclose(np.asarray(got), want_pos, rtol=1e-6)
+    x_neg = np.asarray([-3.0, -1.0], np.float32)
+    got2, _ = mod.apply(params, state, jnp.asarray(x_neg))
+    np.testing.assert_allclose(np.asarray(got2), [7.0, 7.0])
+
+
+def test_real_tf_cond_both_const_arms_and_frozen_pred():
+    """Two edges of lowered tf.cond: (a) BOTH arms constant (gated only
+    by control deps on the pivot) with a dynamic pred; (b) a pred that
+    froze to a Const — the import must take the static branch."""
+    tf.compat.v1.disable_control_flow_v2()
+    try:
+        def build():
+            v1 = tf.compat.v1
+            inp = v1.placeholder(tf.float32, (2,), name="x")
+            out = tf.cond(tf.reduce_sum(inp) > 0.0,
+                          lambda: tf.constant([1.0, 2.0]),
+                          lambda: tf.constant([9.0, 9.0]))
+            return tf.identity(out, name="out")
+
+        buf, want = _tf1_graphdef_and_output(
+            build, {"x:0": np.asarray([1.0, 1.0], np.float32)})
+
+        def build_frozen_pred():
+            v1 = tf.compat.v1
+            inp = v1.placeholder(tf.float32, (2,), name="x")
+            out = tf.cond(tf.constant(False),
+                          lambda: inp * 2.0,
+                          lambda: inp - 1.0)
+            return tf.identity(out, name="out")
+
+        buf2, want2 = _tf1_graphdef_and_output(
+            build_frozen_pred, {"x:0": np.asarray([5.0, 3.0], np.float32)})
+    finally:
+        tf.compat.v1.enable_control_flow_v2()
+
+    mod, params, state, _ = to_module(load_graphdef(buf),
+                                      inputs=["x"], outputs=["out"])
+    got, _ = mod.apply(params, state, jnp.asarray([1.0, 1.0]))
+    np.testing.assert_allclose(np.asarray(got), want)
+    got_f, _ = mod.apply(params, state, jnp.asarray([-1.0, -1.0]))
+    np.testing.assert_allclose(np.asarray(got_f), [9.0, 9.0])
+
+    mod2, p2, s2, _ = to_module(load_graphdef(buf2),
+                                inputs=["x"], outputs=["out"])
+    got2, _ = mod2.apply(p2, s2, jnp.asarray([5.0, 3.0]))
+    np.testing.assert_allclose(np.asarray(got2), want2)
