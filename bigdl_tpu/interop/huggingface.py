@@ -396,3 +396,177 @@ def from_bert(hf_model):
             "weight": jnp.asarray(_t(layer.output.LayerNorm.weight)),
             "bias": jnp.asarray(_t(layer.output.LayerNorm.bias))}
     return model, params, state
+
+
+class LlamaBlock(Module):
+    """One LLaMA decoder block on this framework's primitives: pre-RMSNorm
+    grouped-query attention with rotary embeddings, then pre-RMSNorm
+    SwiGLU MLP, both residual."""
+
+    def __init__(self, d_model, num_heads, num_kv_heads, d_ff, eps,
+                 rope_theta, name=None):
+        super().__init__(name or "LlamaBlock")
+        from bigdl_tpu.nn.linear import Linear
+        from bigdl_tpu.nn.normalization import RMSNorm
+        self.add_child("ln1", RMSNorm(d_model, eps=eps))
+        self.add_child("attn", MultiHeadAttention(
+            d_model, num_heads, bias=False, num_kv_heads=num_kv_heads,
+            rope_theta=rope_theta))
+        self.add_child("ln2", RMSNorm(d_model, eps=eps))
+        self.add_child("gate", Linear(d_model, d_ff, bias=False))
+        self.add_child("up", Linear(d_model, d_ff, bias=False))
+        self.add_child("down", Linear(d_ff, d_model, bias=False))
+
+    def _apply(self, params, state, x, *, training=False, rng=None):
+        c = self.children()
+        h, _ = c["ln1"].apply(params["ln1"], {}, x)
+        a, _ = c["attn"].apply(params["attn"], {}, h, causal=True,
+                               training=training, rng=rng)
+        x = x + a
+        h, _ = c["ln2"].apply(params["ln2"], {}, x)
+        g, _ = c["gate"].apply(params["gate"], {}, h)
+        u, _ = c["up"].apply(params["up"], {}, h)
+        dn, _ = c["down"].apply(params["down"], {}, jax.nn.silu(g) * u)
+        return x + dn, state
+
+
+class LlamaLM(Module):
+    """LLaMA-architecture causal LM (RMSNorm + RoPE + GQA + SwiGLU) on
+    this framework's primitives — the modern-decoder counterpart of
+    GPT2LM. apply(params, state, tokens (B, T) int32) -> (B, T, vocab)
+    logits."""
+
+    def __init__(self, vocab_size, d_model, num_heads, num_kv_heads,
+                 d_ff, num_layers, eps=1e-6, rope_theta=10000.0,
+                 tied=False, eos_id=None, name=None):
+        super().__init__(name or "LlamaLM")
+        from bigdl_tpu.nn.normalization import RMSNorm
+        self.vocab_size, self.d_model = vocab_size, d_model
+        self.num_layers, self.tied, self.eos_id = num_layers, tied, eos_id
+        for i in range(num_layers):
+            self.add_child(f"l{i}", LlamaBlock(
+                d_model, num_heads, num_kv_heads, d_ff, eps, rope_theta))
+        self.add_child("norm", RMSNorm(d_model, eps=eps))
+
+    def param_specs(self):
+        from bigdl_tpu.core.module import ParamSpec
+        from bigdl_tpu.core import init as initializers
+        specs = {"embed": ParamSpec((self.vocab_size, self.d_model),
+                                    initializers.random_normal(0.0, 0.02))}
+        if not self.tied:
+            specs["lm_head"] = ParamSpec(
+                (self.vocab_size, self.d_model),
+                initializers.random_normal(0.0, 0.02))
+        return specs
+
+    def _apply(self, params, state, tokens, *, training=False, rng=None):
+        x = params["embed"][tokens]
+        rngs = (jax.random.split(rng, self.num_layers)
+                if rng is not None else (None,) * self.num_layers)
+        for i in range(self.num_layers):
+            x, _ = self.children()[f"l{i}"].apply(
+                params[f"l{i}"], state.get(f"l{i}", {}), x,
+                training=training, rng=rngs[i])
+        x, _ = self.children()["norm"].apply(params["norm"], {}, x)
+        head = params["embed"] if self.tied else params["lm_head"]
+        return x @ head.T, state
+
+    def generate(self, params, state, prompt, max_new_tokens: int,
+                 beam_size: int = 4, eos_id=None, alpha: float = 0.0):
+        """Beam-search continuation (same fixed-buffer recompute recipe
+        as GPT2LM.generate's default path — the causal mask hides the
+        zero tail, and RoPE positions are absolute so the prefix's
+        embeddings never shift). Returns (sequences (B, K, P+new),
+        scores (B, K))."""
+        from bigdl_tpu.nn.recurrent import beam_search, tile_beam
+        if eos_id is None:
+            eos_id = self.eos_id
+        if eos_id is None:
+            raise ValueError("generate: pass eos_id (the converted "
+                             "config carried none)")
+        B, P = prompt.shape
+        L = P + max_new_tokens
+        buf0 = jnp.zeros((B, L), jnp.int32).at[:, :P - 1].set(
+            prompt[:, :-1])
+        st0 = tile_beam((buf0, jnp.full((B,), P - 1, jnp.int32)),
+                        beam_size)
+
+        def step_fn(tokens_last, st):
+            buf, pos = st
+            p = pos[0]
+            buf = jax.lax.dynamic_update_slice_in_dim(
+                buf, tokens_last[:, None], p, axis=1)
+            logits, _ = self.apply(params, state, buf)
+            step_logits = jax.lax.dynamic_index_in_dim(
+                logits, p, axis=1, keepdims=False)
+            return step_logits, (buf, pos + 1)
+
+        seqs, scores = beam_search(
+            step_fn, st0, prompt[:, -1], beam_size=beam_size,
+            vocab_size=self.vocab_size, max_len=max_new_tokens,
+            eos_id=eos_id, alpha=alpha)
+        full = jnp.concatenate(
+            [jnp.repeat(prompt[:, None], beam_size, axis=1), seqs], -1)
+        return full, scores
+
+
+def from_llama(hf_model):
+    """`transformers` LlamaModel / LlamaForCausalLM → (module, params,
+    state). torch Linear weights are (out, in) — transposed into the
+    `x @ w` orientation; k/v projections keep their grouped
+    (num_key_value_heads) width. Non-default rope_scaling and explicit
+    head_dim ≠ hidden/heads refuse (rotary math would silently
+    diverge)."""
+    m = getattr(hf_model, "model", hf_model)
+    cfg = hf_model.config
+    d, H = cfg.hidden_size, cfg.num_attention_heads
+    kv = getattr(cfg, "num_key_value_heads", H)
+    hd = getattr(cfg, "head_dim", None)
+    if hd is not None and hd != d // H:
+        raise NotImplementedError(
+            f"from_llama: head_dim {hd} != hidden/heads {d // H}")
+    scaling = getattr(cfg, "rope_scaling", None)
+    if scaling:
+        raise NotImplementedError(
+            f"from_llama: rope_scaling {scaling!r} is not supported")
+    # refuse-loudly for config fields the block doesn't model (Qwen-style
+    # exports set these on LlamaForCausalLM)
+    if getattr(cfg, "attention_bias", False):
+        raise NotImplementedError("from_llama: attention_bias=True")
+    if getattr(cfg, "mlp_bias", False):
+        raise NotImplementedError("from_llama: mlp_bias=True")
+    act = getattr(cfg, "hidden_act", "silu")
+    if act not in ("silu", "swish"):
+        raise NotImplementedError(f"from_llama: hidden_act={act!r} "
+                                  "(only silu/swish)")
+    lm_head = getattr(hf_model, "lm_head", None)
+    tied = (lm_head is None or bool(getattr(
+        cfg, "tie_word_embeddings", False)))
+    eos = getattr(cfg, "eos_token_id", None)
+    if not isinstance(eos, int) or not 0 <= eos < cfg.vocab_size:
+        eos = None
+    model = LlamaLM(cfg.vocab_size, d, H, kv, cfg.intermediate_size,
+                    cfg.num_hidden_layers, eps=cfg.rms_norm_eps,
+                    rope_theta=float(getattr(cfg, "rope_theta", 10000.0)),
+                    tied=tied, eos_id=eos)
+    params, state = _zero_skeleton(model)
+    params["embed"] = jnp.asarray(_t(m.embed_tokens.weight))
+    if not tied:
+        params["lm_head"] = jnp.asarray(_t(lm_head.weight))
+    for i, layer in enumerate(m.layers):
+        p = params[f"l{i}"]
+        p["ln1"] = {"weight": jnp.asarray(_t(layer.input_layernorm.weight))}
+        p["ln2"] = {"weight": jnp.asarray(
+            _t(layer.post_attention_layernorm.weight))}
+        att = layer.self_attn
+        p["attn"] = {
+            "wq": jnp.asarray(_t(att.q_proj.weight).T),
+            "wk": jnp.asarray(_t(att.k_proj.weight).T),
+            "wv": jnp.asarray(_t(att.v_proj.weight).T),
+            "wo": jnp.asarray(_t(att.o_proj.weight).T),
+        }
+        p["gate"] = {"weight": jnp.asarray(_t(layer.mlp.gate_proj.weight).T)}
+        p["up"] = {"weight": jnp.asarray(_t(layer.mlp.up_proj.weight).T)}
+        p["down"] = {"weight": jnp.asarray(_t(layer.mlp.down_proj.weight).T)}
+    params["norm"] = {"weight": jnp.asarray(_t(m.norm.weight))}
+    return model, params, state

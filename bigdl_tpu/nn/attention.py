@@ -137,18 +137,44 @@ def padding_mask(lengths, t: int):
     return (jnp.arange(t)[None, :] < lengths[:, None])[:, None, None, :]
 
 
+def rotary_embedding(x, theta: float = 10000.0, positions=None):
+    """Rotary position embedding, rotate-half convention (LLaMA/HF
+    layout: the head dim splits into two contiguous halves, not
+    interleaved pairs). x: (B, H, T, hd). No reference analogue — RoPE
+    postdates it; standard for modern LMs."""
+    B, H, T, hd = x.shape
+    if positions is None:
+        positions = jnp.arange(T)
+    inv = 1.0 / (theta ** (jnp.arange(0, hd, 2) / hd))       # (hd/2,)
+    ang = positions[:, None] * inv[None, :]                   # (T, hd/2)
+    cos = jnp.concatenate([jnp.cos(ang), jnp.cos(ang)], -1)   # (T, hd)
+    sin = jnp.concatenate([jnp.sin(ang), jnp.sin(ang)], -1)
+    x1, x2 = x[..., : hd // 2], x[..., hd // 2:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    return (x * cos + rotated * sin).astype(x.dtype)
+
+
 class MultiHeadAttention(Module):
     """Multi-head attention (reference: nn/Attention.scala). Packed QKV
     projections; inputs (B, T, d_model). `attn_impl` picks the kernel:
     'dense' (default), or 'blockwise' with `block_size` for long sequences.
+
+    Modern-LM options (no reference analogue): `num_kv_heads` < num_heads
+    enables grouped-query attention — K/V project to num_kv_heads and
+    repeat up to the query heads before the attend, so every attn_impl
+    (dense/blockwise/flash) works unchanged; `rope_theta` applies rotary
+    position embeddings to q and k.
     """
 
     bias = False          # class default: pickles from before the bias
                           # option existed must keep loading
+    num_kv_heads = None   # class defaults: old pickles keep loading
+    rope_theta = None
 
     def __init__(self, d_model: int, num_heads: int, *,
                  dropout: float = 0.0, attn_impl="dense",
-                 block_size: int = 512, bias: bool = False, name=None):
+                 block_size: int = 512, bias: bool = False,
+                 num_kv_heads=None, rope_theta=None, name=None):
         super().__init__(name)
         if d_model % num_heads:
             raise ValueError(f"d_model {d_model} % heads {num_heads} != 0")
@@ -156,6 +182,9 @@ class MultiHeadAttention(Module):
             raise ValueError(
                 f"attn_impl must be 'dense', 'blockwise', or a callable "
                 f"(q, k, v, mask=..., causal=...) -> out; got {attn_impl!r}")
+        if num_kv_heads is not None and num_heads % num_kv_heads:
+            raise ValueError(f"num_heads {num_heads} % num_kv_heads "
+                             f"{num_kv_heads} != 0")
         self.d_model, self.num_heads = d_model, num_heads
         self.head_dim = d_model // num_heads
         self.dropout = dropout
@@ -163,21 +192,27 @@ class MultiHeadAttention(Module):
         # bias=True adds projection biases (GPT-family checkpoints carry
         # them; the reference's Attention.scala denses are bias-free)
         self.bias = bias
+        self.num_kv_heads = num_kv_heads
+        self.rope_theta = rope_theta
 
     def param_specs(self):
         d = self.d_model
-        spec = lambda: ParamSpec((d, d), initializers.xavier, fan_in=d,
-                                 fan_out=d)
-        specs = {"wq": spec(), "wk": spec(), "wv": spec(), "wo": spec()}
+        kv = (self.num_kv_heads or self.num_heads) * self.head_dim
+        spec = lambda n: ParamSpec((d, n), initializers.xavier, fan_in=d,
+                                   fan_out=n)
+        specs = {"wq": spec(d), "wk": spec(kv), "wv": spec(kv),
+                 "wo": spec(d)}
         if self.bias:
-            for b in ("bq", "bk", "bv", "bo"):
-                specs[b] = ParamSpec((d,), initializers.zeros)
+            specs["bq"] = ParamSpec((d,), initializers.zeros)
+            specs["bk"] = ParamSpec((kv,), initializers.zeros)
+            specs["bv"] = ParamSpec((kv,), initializers.zeros)
+            specs["bo"] = ParamSpec((d,), initializers.zeros)
         return specs
 
-    def _split(self, x):
+    def _split(self, x, heads=None):
         B, T, _ = x.shape
-        return x.reshape(B, T, self.num_heads, self.head_dim).transpose(
-            0, 2, 1, 3)
+        return x.reshape(B, T, heads or self.num_heads,
+                         self.head_dim).transpose(0, 2, 1, 3)
 
     def _attend(self, q, k, v, mask, causal):
         if callable(self.attn_impl):
@@ -203,7 +238,17 @@ class MultiHeadAttention(Module):
         if self.bias:
             q, k, v = (q + params["bq"], k + params["bk"],
                        v + params["bv"])
-        q, k, v = self._split(q), self._split(k), self._split(v)
+        kv_heads = self.num_kv_heads or self.num_heads
+        q = self._split(q)
+        k = self._split(k, kv_heads)
+        v = self._split(v, kv_heads)
+        if self.rope_theta:
+            q = rotary_embedding(q, self.rope_theta)
+            k = rotary_embedding(k, self.rope_theta)
+        if kv_heads != self.num_heads:      # GQA: repeat kv to q heads
+            rep = self.num_heads // kv_heads
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
         out = self._attend(q, k, v, mask, causal)
         B, H, T, hd = out.shape
         out = out.transpose(0, 2, 1, 3).reshape(B, T, H * hd)

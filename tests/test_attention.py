@@ -220,3 +220,30 @@ def test_transformer_lm_cached_generate_matches_full_forward():
     assert np.isfinite(np.asarray(scores3)).all()
     # best beam scores at least as well as greedy
     assert float(scores3[:, 0].min()) >= float(scores[:, 0].min()) - 1e-4
+
+
+def test_gqa_rope_composes_with_blockwise_and_flash():
+    """GQA repeat + rotary happen BEFORE the attend, so every attn_impl
+    sees full-head q/k/v: dense, blockwise, and the Pallas flash kernel
+    (interpret mode) must agree bit-for-bit-ish."""
+    import numpy as np
+    from bigdl_tpu.nn.attention import MultiHeadAttention
+    from bigdl_tpu.kernels.flash_attention import PallasFlashAttention
+
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.randn(2, 64, 32).astype(np.float32))
+    outs = {}
+    for impl in ("dense", "blockwise", "flash"):
+        kw = {"attn_impl": "dense" if impl == "dense" else
+              ("blockwise" if impl == "blockwise" else
+               PallasFlashAttention(block_q=32, block_k=32,
+                                    interpret=True))}
+        m = MultiHeadAttention(32, 8, num_kv_heads=2, rope_theta=10000.0,
+                               block_size=32, **kw)
+        p, s = m.init(jax.random.PRNGKey(0))
+        out, _ = m.apply(p, s, x, causal=True)
+        outs[impl] = np.asarray(out)
+    np.testing.assert_allclose(outs["blockwise"], outs["dense"],
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(outs["flash"], outs["dense"],
+                               rtol=1e-4, atol=1e-4)
