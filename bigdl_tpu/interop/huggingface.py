@@ -34,6 +34,65 @@ def _gelu_tanh(x):
     return jax.nn.gelu(x, approximate=True)
 
 
+def _beam_generate(lm, params, state, prompt, max_new_tokens, beam_size,
+                   eos_id, alpha, kv_cache, *, kv_shape, dtype,
+                   n_positions=None):
+    """Shared beam-search decode used by GPT2LM and LlamaLM. The lm must
+    provide `_hidden(params, state, tokens)`, `_head(params)`, and
+    `_cached_forward(params, tokens, caches, start)`; `kv_shape` =
+    (cache heads, head_dim) — grouped-KV models pass the grouped width.
+
+    Recompute path: fixed-shape buffer, only the decode position's
+    hidden row hits the LM head. kv_cache path: per-layer (N, L, H, hd)
+    caches through cached_beam_generate."""
+    from bigdl_tpu.nn.recurrent import (beam_search, cached_beam_generate,
+                                        tile_beam)
+    if eos_id is None:
+        eos_id = lm.eos_id
+    if eos_id is None:
+        raise ValueError("generate: pass eos_id (the model carries none "
+                         "— config eos_token_id was absent or out of "
+                         "vocabulary)")
+    B, P = prompt.shape
+    L = P + max_new_tokens
+    if n_positions is not None and L > n_positions:
+        raise ValueError(f"prompt+new = {L} > n_positions {n_positions}")
+    if kv_cache:
+        H, hd = kv_shape
+
+        def make_caches():
+            zeros = lambda: jnp.zeros((B, L, H, hd), dtype)  # noqa: E731
+            return (tuple(zeros() for _ in range(lm.num_layers)),
+                    tuple(zeros() for _ in range(lm.num_layers)))
+
+        return cached_beam_generate(
+            functools.partial(lm._cached_forward, params), make_caches,
+            prompt, max_new_tokens=max_new_tokens, beam_size=beam_size,
+            vocab_size=lm.vocab_size, eos_id=eos_id, alpha=alpha)
+
+    buf0 = jnp.zeros((B, L), jnp.int32).at[:, :P - 1].set(prompt[:, :-1])
+    # beam_search reorders state leaves along the beam dim, so `pos`
+    # rides as a per-row vector (identical entries)
+    st0 = tile_beam((buf0, jnp.full((B,), P - 1, jnp.int32)), beam_size)
+
+    def step_fn(tokens_last, st):
+        buf, pos = st
+        p = pos[0]
+        buf = jax.lax.dynamic_update_slice_in_dim(
+            buf, tokens_last[:, None], p, axis=1)
+        h, _ = lm._hidden(params, state, buf)
+        h_p = jax.lax.dynamic_index_in_dim(h, p, axis=1, keepdims=False)
+        return h_p @ lm._head(params).T, (buf, pos + 1)
+
+    seqs, scores = beam_search(
+        step_fn, st0, prompt[:, -1], beam_size=beam_size,
+        vocab_size=lm.vocab_size, max_len=max_new_tokens, eos_id=eos_id,
+        alpha=alpha)
+    full = jnp.concatenate(
+        [jnp.repeat(prompt[:, None], beam_size, axis=1), seqs], -1)
+    return full, scores
+
+
 class GPT2LM(Module):
     """GPT-2 rebuilt on this framework's primitives. apply(params, state,
     tokens (B, T) int32) → (B, T, vocab) logits (head tied to the token
@@ -127,68 +186,11 @@ class GPT2LM(Module):
         per-layer caches — O(L) per step instead of O(L²), identical
         outputs (asserted). `eos_id` defaults to the converted config's
         eos_token_id."""
-        from bigdl_tpu.nn.recurrent import beam_search, tile_beam
-        if eos_id is None:
-            eos_id = self.eos_id
-        if eos_id is None:
-            raise ValueError("generate: pass eos_id (the model carries "
-                             "none — config eos_token_id was absent or "
-                             "out of vocabulary)")
-        B, P = prompt.shape
-        L = P + max_new_tokens
-        if L > self.n_positions:
-            raise ValueError(f"prompt+new = {L} > n_positions "
-                             f"{self.n_positions}")
-        if kv_cache:
-            return self._generate_cached(params, prompt, max_new_tokens,
-                                         beam_size, eos_id, alpha, L)
-        buf0 = jnp.zeros((B, L), jnp.int32).at[:, :P - 1].set(
-            prompt[:, :-1])
-        # beam_search reorders state leaves along the beam dim, so `pos`
-        # rides as a per-row vector (identical entries)
-        st0 = tile_beam((buf0, jnp.full((B,), P - 1, jnp.int32)),
-                        beam_size)
-
-        def step_fn(tokens_last, st):
-            buf, pos = st
-            p = pos[0]
-            buf = jax.lax.dynamic_update_slice_in_dim(
-                buf, tokens_last[:, None], p, axis=1)
-            h, _ = self._hidden(params, state, buf)
-            h_p = jax.lax.dynamic_index_in_dim(h, p, axis=1,
-                                               keepdims=False)
-            step_logits = h_p @ self._head(params).T
-            return step_logits, (buf, pos + 1)
-
-        seqs, scores = beam_search(
-            step_fn, st0, prompt[:, -1], beam_size=beam_size,
-            vocab_size=self.vocab_size, max_len=max_new_tokens,
-            eos_id=eos_id, alpha=alpha)
-        full = jnp.concatenate(
-            [jnp.repeat(prompt[:, None], beam_size, axis=1), seqs], -1)
-        return full, scores
-
-    def _generate_cached(self, params, prompt, max_new_tokens, beam_size,
-                         eos_id, alpha, L):
-        """KV-cached decode: each step computes one token's QKV and
-        attends over the cache — O(L) per step instead of the full-prefix
-        O(L²) recompute. Output is asserted identical to the recompute
-        path in tests."""
-        from bigdl_tpu.nn.recurrent import cached_beam_generate
-        B, P = prompt.shape
         H = self.children()["h0"].attn.num_heads
-        hd = self.d_model // H
-        dtype = params["wte"].dtype
-
-        def make_caches():
-            zeros = lambda: jnp.zeros((B, L, H, hd), dtype)  # noqa: E731
-            return (tuple(zeros() for _ in range(self.num_layers)),
-                    tuple(zeros() for _ in range(self.num_layers)))
-
-        return cached_beam_generate(
-            functools.partial(self._cached_forward, params), make_caches,
-            prompt, max_new_tokens=max_new_tokens, beam_size=beam_size,
-            vocab_size=self.vocab_size, eos_id=eos_id, alpha=alpha)
+        return _beam_generate(
+            self, params, state, prompt, max_new_tokens, beam_size,
+            eos_id, alpha, kv_cache, kv_shape=(H, self.d_model // H),
+            dtype=params["wte"].dtype, n_positions=self.n_positions)
 
 
 def _gelu_exact(x):
@@ -429,6 +431,47 @@ class LlamaBlock(Module):
         dn, _ = c["down"].apply(params["down"], {}, jax.nn.silu(g) * u)
         return x + dn, state
 
+    def cached_step(self, params, x, ck, cv, start):
+        """Incremental decode (see TransformerLayer.cached_step): x
+        (N, T, d) at absolute positions [start, start+T); ck/cv hold the
+        GROUPED kv heads (N, L, KV, hd) — the repeat to query heads
+        happens at the attend, exactly like apply(). RoPE uses absolute
+        positions, so cached entries never shift. Returns
+        (out, new_ck, new_cv)."""
+        from bigdl_tpu.nn.attention import (dot_product_attention,
+                                            rotary_embedding)
+        c = self.children()
+        attn = c["attn"]
+        N, T, d = x.shape
+        H, hd = attn.num_heads, attn.head_dim
+        KV = attn.num_kv_heads or H
+        at = params["attn"]
+        h, _ = c["ln1"].apply(params["ln1"], {}, x)
+        pos = start + jnp.arange(T)
+        q = (h @ at["wq"]).reshape(N, T, H, hd)
+        k = (h @ at["wk"]).reshape(N, T, KV, hd)
+        v = (h @ at["wv"]).reshape(N, T, KV, hd)
+        q = rotary_embedding(q.transpose(0, 2, 1, 3), attn.rope_theta,
+                             pos)
+        k = rotary_embedding(k.transpose(0, 2, 1, 3), attn.rope_theta,
+                             pos).transpose(0, 2, 1, 3)
+        ck = jax.lax.dynamic_update_slice(ck, k, (0, start, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v, (0, start, 0, 0))
+        L = ck.shape[1]
+        rep = H // KV
+        fk = jnp.repeat(ck.transpose(0, 2, 1, 3), rep, axis=1)
+        fv = jnp.repeat(cv.transpose(0, 2, 1, 3), rep, axis=1)
+        mask = (jnp.arange(L)[None, :] <=
+                (start + jnp.arange(T))[:, None])
+        a = dot_product_attention(q, fk, fv, mask)
+        a = a.transpose(0, 2, 1, 3).reshape(N, T, d)
+        x = x + a @ at["wo"]
+        h, _ = c["ln2"].apply(params["ln2"], {}, x)
+        g, _ = c["gate"].apply(params["gate"], {}, h)
+        u, _ = c["up"].apply(params["up"], {}, h)
+        dn, _ = c["down"].apply(params["down"], {}, jax.nn.silu(g) * u)
+        return x + dn, ck, cv
+
 
 class LlamaLM(Module):
     """LLaMA-architecture causal LM (RMSNorm + RoPE + GQA + SwiGLU) on
@@ -459,7 +502,7 @@ class LlamaLM(Module):
                 initializers.random_normal(0.0, 0.02))
         return specs
 
-    def _apply(self, params, state, tokens, *, training=False, rng=None):
+    def _hidden(self, params, state, tokens, training=False, rng=None):
         x = params["embed"][tokens]
         rngs = (jax.random.split(rng, self.num_layers)
                 if rng is not None else (None,) * self.num_layers)
@@ -468,46 +511,46 @@ class LlamaLM(Module):
                 params[f"l{i}"], state.get(f"l{i}", {}), x,
                 training=training, rng=rngs[i])
         x, _ = self.children()["norm"].apply(params["norm"], {}, x)
+        return x, state
+
+    def _head(self, params):
+        return params["embed"] if self.tied else params["lm_head"]
+
+    def _apply(self, params, state, tokens, *, training=False, rng=None):
+        x, _ = self._hidden(params, state, tokens, training, rng)
+        return x @ self._head(params).T, state
+
+    def _cached_forward(self, params, tokens, caches, start):
+        """tokens (N, T) at absolute positions [start, start+T) →
+        (last-position logits (N, V), new caches); caches = per-layer
+        (cks, cvs) of (N, L, KV, hd)."""
+        cks, cvs = caches
+        x = params["embed"][tokens]
+        new_ck, new_cv = [], []
+        for i in range(self.num_layers):
+            x, ck_i, cv_i = self.children()[f"l{i}"].cached_step(
+                params[f"l{i}"], x, cks[i], cvs[i], start)
+            new_ck.append(ck_i)
+            new_cv.append(cv_i)
+        x, _ = self.children()["norm"].apply(params["norm"], {}, x)
         head = params["embed"] if self.tied else params["lm_head"]
-        return x @ head.T, state
+        return x[:, -1] @ head.T, (tuple(new_ck), tuple(new_cv))
 
     def generate(self, params, state, prompt, max_new_tokens: int,
-                 beam_size: int = 4, eos_id=None, alpha: float = 0.0):
-        """Beam-search continuation (same fixed-buffer recompute recipe
-        as GPT2LM.generate's default path — the causal mask hides the
-        zero tail, and RoPE positions are absolute so the prefix's
-        embeddings never shift). Returns (sequences (B, K, P+new),
-        scores (B, K))."""
-        from bigdl_tpu.nn.recurrent import beam_search, tile_beam
-        if eos_id is None:
-            eos_id = self.eos_id
-        if eos_id is None:
-            raise ValueError("generate: pass eos_id (the converted "
-                             "config carried none)")
-        B, P = prompt.shape
-        L = P + max_new_tokens
-        buf0 = jnp.zeros((B, L), jnp.int32).at[:, :P - 1].set(
-            prompt[:, :-1])
-        st0 = tile_beam((buf0, jnp.full((B,), P - 1, jnp.int32)),
-                        beam_size)
-
-        def step_fn(tokens_last, st):
-            buf, pos = st
-            p = pos[0]
-            buf = jax.lax.dynamic_update_slice_in_dim(
-                buf, tokens_last[:, None], p, axis=1)
-            logits, _ = self.apply(params, state, buf)
-            step_logits = jax.lax.dynamic_index_in_dim(
-                logits, p, axis=1, keepdims=False)
-            return step_logits, (buf, pos + 1)
-
-        seqs, scores = beam_search(
-            step_fn, st0, prompt[:, -1], beam_size=beam_size,
-            vocab_size=self.vocab_size, max_len=max_new_tokens,
-            eos_id=eos_id, alpha=alpha)
-        full = jnp.concatenate(
-            [jnp.repeat(prompt[:, None], beam_size, axis=1), seqs], -1)
-        return full, scores
+                 beam_size: int = 4, eos_id=None, alpha: float = 0.0,
+                 kv_cache: bool = False):
+        """Beam-search continuation (shared _beam_generate recipe — the
+        causal mask hides the zero tail, and RoPE positions are absolute
+        so the prefix's embeddings never shift; only the decode row hits
+        the LM head). `kv_cache=True` decodes incrementally over
+        grouped-KV caches — identical outputs, O(L) per step. Returns
+        (sequences (B, K, P+new), scores (B, K))."""
+        attn0 = self.children()["l0"].children()["attn"]
+        KV = attn0.num_kv_heads or attn0.num_heads
+        return _beam_generate(
+            self, params, state, prompt, max_new_tokens, beam_size,
+            eos_id, alpha, kv_cache, kv_shape=(KV, attn0.head_dim),
+            dtype=params["embed"].dtype)
 
 
 def from_llama(hf_model):
