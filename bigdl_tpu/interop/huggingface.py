@@ -438,8 +438,7 @@ class LlamaBlock(Module):
         happens at the attend, exactly like apply(). RoPE uses absolute
         positions, so cached entries never shift. Returns
         (out, new_ck, new_cv)."""
-        from bigdl_tpu.nn.attention import (dot_product_attention,
-                                            rotary_embedding)
+        from bigdl_tpu.nn.attention import cached_attend, rotary_embedding
         c = self.children()
         attn = c["attn"]
         N, T, d = x.shape
@@ -455,16 +454,7 @@ class LlamaBlock(Module):
                              pos)
         k = rotary_embedding(k.transpose(0, 2, 1, 3), attn.rope_theta,
                              pos).transpose(0, 2, 1, 3)
-        ck = jax.lax.dynamic_update_slice(ck, k, (0, start, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v, (0, start, 0, 0))
-        L = ck.shape[1]
-        rep = H // KV
-        fk = jnp.repeat(ck.transpose(0, 2, 1, 3), rep, axis=1)
-        fv = jnp.repeat(cv.transpose(0, 2, 1, 3), rep, axis=1)
-        mask = (jnp.arange(L)[None, :] <=
-                (start + jnp.arange(T))[:, None])
-        a = dot_product_attention(q, fk, fv, mask)
-        a = a.transpose(0, 2, 1, 3).reshape(N, T, d)
+        a, ck, cv = cached_attend(q, k, v, ck, cv, start)
         x = x + a @ at["wo"]
         h, _ = c["ln2"].apply(params["ln2"], {}, x)
         g, _ = c["gate"].apply(params["gate"], {}, h)

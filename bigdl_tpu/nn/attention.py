@@ -154,6 +154,29 @@ def rotary_embedding(x, theta: float = 10000.0, positions=None):
     return (x * cos + rotated * sin).astype(x.dtype)
 
 
+def cached_attend(q_heads, k_chunk, v_chunk, ck, cv, start):
+    """Shared incremental-decode attention core (used by
+    TransformerLayer.cached_step and the HF bridge's LlamaBlock): write
+    this chunk's K/V into the caches at [start, start+T), build the
+    causal-over-cache mask, and attend. q_heads (N, H, T, hd);
+    k_chunk/v_chunk (N, T, Hc, hd) with Hc == H or a grouped divisor
+    (GQA — repeated up to H here). Returns ((N, T, H*hd), new_ck,
+    new_cv)."""
+    ck = jax.lax.dynamic_update_slice(ck, k_chunk, (0, start, 0, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v_chunk, (0, start, 0, 0))
+    N, H, T, hd = q_heads.shape
+    L, Hc = ck.shape[1], ck.shape[2]
+    fk = ck.transpose(0, 2, 1, 3)
+    fv = cv.transpose(0, 2, 1, 3)
+    if Hc != H:
+        fk = jnp.repeat(fk, H // Hc, axis=1)
+        fv = jnp.repeat(fv, H // Hc, axis=1)
+    mask = (jnp.arange(L)[None, :] <=
+            (start + jnp.arange(T))[:, None])   # causal + cache tail
+    a = dot_product_attention(q_heads, fk, fv, mask)
+    return a.transpose(0, 2, 1, 3).reshape(N, T, H * hd), ck, cv
+
+
 class MultiHeadAttention(Module):
     """Multi-head attention (reference: nn/Attention.scala). Packed QKV
     projections; inputs (B, T, d_model). `attn_impl` picks the kernel:
@@ -348,20 +371,12 @@ class TransformerLayer(Module):
         v = h @ at["wv"]
         if self.attn.bias:
             q, k, v = q + at["bq"], k + at["bk"], v + at["bv"]
-        q = q.reshape(N, T, H, hd)
+        q = q.reshape(N, T, H, hd).transpose(0, 2, 1, 3)
         k = k.reshape(N, T, H, hd)
         v = v.reshape(N, T, H, hd)
-        ck = jax.lax.dynamic_update_slice(ck, k, (0, start, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v, (0, start, 0, 0))
-        L = ck.shape[1]
-        mask = (jnp.arange(L)[None, :] <=
-                (start + jnp.arange(T))[:, None])   # causal + cache tail
         # one numerical core: the same scale/mask/softmax chain apply()
         # uses ((N, H, T, hd) layout; mask broadcasts over N, H)
-        a = dot_product_attention(q.transpose(0, 2, 1, 3),
-                                  ck.transpose(0, 2, 1, 3),
-                                  cv.transpose(0, 2, 1, 3), mask)
-        a = a.transpose(0, 2, 1, 3).reshape(N, T, d)
+        a, ck, cv = cached_attend(q, k, v, ck, cv, start)
         a = a @ at["wo"]
         if self.attn.bias:
             a = a + at["bo"]

@@ -58,7 +58,7 @@ for i in $(seq 1 100000); do
     echo "$(date -u +%FT%TZ) smokes: $smoke" >> "$LOG"
     merge_result "pallas_smokes" "\"$smoke\""
     # 2..5 battery, headline first, each result written immediately
-    for m in resnet50 kernels resnet50_sweep lstm transformer lenet; do
+    for m in resnet50 kernels resnet50_sweep llama lstm transformer lenet; do
       j=$(timeout 1500 python bench.py "$m" 2>>"$LOG" | tail -1)
       echo "$(date -u +%FT%TZ) bench $m: $j" >> "$LOG"
       merge_result "$m" "$j"
