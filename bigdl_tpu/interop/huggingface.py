@@ -3,10 +3,13 @@ the HF ecosystem; its closest analogue is the Keras/TF importer surface,
 §2.8). Converts a torch `transformers` model's weights onto this
 framework's own primitives — no torch at inference time.
 
-Currently: GPT-2 family (`GPT2Model`/`GPT2LMHeadModel`). The returned
-module is assembled from nn.TransformerLayer blocks (pre-norm, biased
-projections, tanh-gelu FFN — exactly GPT-2's block wiring), learned
-token+position LookupTables, a final LayerNorm, and the tied LM head.
+Bridges: `from_gpt2` (decoder, pre-LN + tanh-gelu, beam/KV-cache
+generate), `from_bert` (post-LN encoder with padding masks + token
+types), `from_llama` (modern decoder: RMSNorm + rotary + grouped-query
+attention + SwiGLU, grouped-KV cached generate), `from_vit` (vision
+encoder: patchify conv + CLS + learned positions). Each is logits/
+hidden-state exact vs the torch forward and returns a trainable,
+serializable module on nn.* primitives.
 
     from transformers import GPT2LMHeadModel
     from bigdl_tpu.interop.huggingface import from_gpt2
@@ -278,6 +281,32 @@ def _t(x) -> np.ndarray:
     return np.asarray(x.detach().cpu().numpy(), np.float32)
 
 
+def _torch_attn_params(query, key, value, out_dense):
+    """torch Linear q/k/v/out modules -> our packed attn param dict
+    (shared by from_bert and from_vit — HF encoders store separate
+    (out, in) Linears; ours is x @ w)."""
+    return {
+        "wq": jnp.asarray(_t(query.weight).T),
+        "bq": jnp.asarray(_t(query.bias)),
+        "wk": jnp.asarray(_t(key.weight).T),
+        "bk": jnp.asarray(_t(key.bias)),
+        "wv": jnp.asarray(_t(value.weight).T),
+        "bv": jnp.asarray(_t(value.bias)),
+        "wo": jnp.asarray(_t(out_dense.weight).T),
+        "bo": jnp.asarray(_t(out_dense.bias)),
+    }
+
+
+def _torch_ffn_params(inter_dense, out_dense):
+    """torch intermediate/output Linears -> FeedForwardNetwork params."""
+    return {
+        "w1": {"weight": jnp.asarray(_t(inter_dense.weight).T),
+               "bias": jnp.asarray(_t(inter_dense.bias))},
+        "w2": {"weight": jnp.asarray(_t(out_dense.weight).T),
+               "bias": jnp.asarray(_t(out_dense.bias))},
+    }
+
+
 def _zero_skeleton(model):
     """Shaped zero trees for (params, state) — every leaf is overwritten
     with checkpoint weights, so skip the random init entirely."""
@@ -374,26 +403,14 @@ def from_bert(hf_model):
                         "bias": jnp.asarray(_t(emb.LayerNorm.bias))}
     for i, layer in enumerate(bert.encoder.layer):
         att = layer.attention
-        params[f"attn{i}"] = {
-            "wq": jnp.asarray(_t(att.self.query.weight).T),
-            "bq": jnp.asarray(_t(att.self.query.bias)),
-            "wk": jnp.asarray(_t(att.self.key.weight).T),
-            "bk": jnp.asarray(_t(att.self.key.bias)),
-            "wv": jnp.asarray(_t(att.self.value.weight).T),
-            "bv": jnp.asarray(_t(att.self.value.bias)),
-            "wo": jnp.asarray(_t(att.output.dense.weight).T),
-            "bo": jnp.asarray(_t(att.output.dense.bias)),
-        }
+        params[f"attn{i}"] = _torch_attn_params(
+            att.self.query, att.self.key, att.self.value,
+            att.output.dense)
         params[f"attn_ln{i}"] = {
             "weight": jnp.asarray(_t(att.output.LayerNorm.weight)),
             "bias": jnp.asarray(_t(att.output.LayerNorm.bias))}
-        params[f"ffn{i}"] = {
-            "w1": {"weight": jnp.asarray(_t(layer.intermediate.dense
-                                            .weight).T),
-                   "bias": jnp.asarray(_t(layer.intermediate.dense.bias))},
-            "w2": {"weight": jnp.asarray(_t(layer.output.dense.weight).T),
-                   "bias": jnp.asarray(_t(layer.output.dense.bias))},
-        }
+        params[f"ffn{i}"] = _torch_ffn_params(layer.intermediate.dense,
+                                              layer.output.dense)
         params[f"ffn_ln{i}"] = {
             "weight": jnp.asarray(_t(layer.output.LayerNorm.weight)),
             "bias": jnp.asarray(_t(layer.output.LayerNorm.bias))}
@@ -602,4 +619,120 @@ def from_llama(hf_model):
         p["up"] = {"weight": jnp.asarray(_t(layer.mlp.up_proj.weight).T)}
         p["down"] = {"weight": jnp.asarray(_t(layer.mlp.down_proj.weight).T)}
     params["norm"] = {"weight": jnp.asarray(_t(m.norm.weight))}
+    return model, params, state
+
+
+class ViTEncoder(Module):
+    """Vision Transformer rebuilt on this framework's primitives —
+    patchify conv + CLS token + learned position embeddings + pre-LN
+    TransformerLayer stack + final LN (+ tanh pooler on CLS).
+    apply(params, state, images (B, H, W, C) NHWC) -> last hidden
+    (B, 1+N, d); `pool=True` returns the pooled CLS vector (B, d)."""
+
+    def __init__(self, image_size, patch_size, channels, d_model,
+                 num_heads, d_ff, num_layers, ln_eps=1e-12,
+                 has_pooler=True, name=None):
+        super().__init__(name or "ViTEncoder")
+        from bigdl_tpu.nn.conv import SpatialConvolution
+        from bigdl_tpu.nn.linear import Linear
+        if image_size % patch_size:
+            raise ValueError(f"image {image_size} % patch {patch_size}")
+        self.d_model = d_model
+        self.num_layers = num_layers
+        self.n_patches = (image_size // patch_size) ** 2
+        self.has_pooler = has_pooler
+        self.add_child("patch", SpatialConvolution(
+            channels, d_model, patch_size, patch_size, patch_size,
+            patch_size, 0, 0))
+        for i in range(num_layers):
+            self.add_child(f"h{i}", TransformerLayer(
+                d_model, num_heads, d_ff, bias=True,
+                activation=_gelu_exact, ln_eps=ln_eps))
+        self.add_child("ln", LayerNormalization(d_model, eps=ln_eps))
+        if has_pooler:
+            self.add_child("pooler", Linear(d_model, d_model))
+
+    def param_specs(self):
+        from bigdl_tpu.core.module import ParamSpec
+        from bigdl_tpu.core import init as initializers
+        return {
+            "cls": ParamSpec((1, 1, self.d_model),
+                             initializers.random_normal(0.0, 0.02)),
+            "pos": ParamSpec((1, 1 + self.n_patches, self.d_model),
+                             initializers.random_normal(0.0, 0.02)),
+        }
+
+    def _apply(self, params, state, images, *, pool=False, training=False,
+               rng=None):
+        c = self.children()
+        x, _ = c["patch"].apply(params["patch"], state.get("patch", {}),
+                                images)
+        B = x.shape[0]
+        x = x.reshape(B, -1, self.d_model)            # (B, N, d), row-major
+        cls = jnp.broadcast_to(params["cls"], (B, 1, self.d_model))
+        x = jnp.concatenate([cls, x], axis=1) + params["pos"]
+        rngs = (jax.random.split(rng, self.num_layers)
+                if rng is not None else (None,) * self.num_layers)
+        for i in range(self.num_layers):
+            x, _ = c[f"h{i}"].apply(params[f"h{i}"],
+                                    state.get(f"h{i}", {}), x,
+                                    training=training, rng=rngs[i])
+        x, _ = c["ln"].apply(params["ln"], {}, x)
+        if pool:
+            if not self.has_pooler:
+                raise ValueError(
+                    "pool=True, but the source model had no pooler "
+                    "(e.g. ViTForImageClassification's inner ViTModel) "
+                    "— use the last hidden state's CLS row instead")
+            p, _ = c["pooler"].apply(params["pooler"], {}, x[:, 0])
+            return jnp.tanh(p), state
+        return x, state
+
+
+def from_vit(hf_model):
+    """`transformers` ViTModel → (module, params, state). Inputs here are
+    NHWC (TPU layout); the patch conv's torch OIHW weight transposes to
+    HWIO. Interpolated position embeddings (image sizes other than the
+    config's) are not replicated."""
+    vit = getattr(hf_model, "vit", hf_model)          # task heads wrap it
+    cfg = hf_model.config
+    act = getattr(cfg, "hidden_act", "gelu")
+    if act != "gelu":
+        raise NotImplementedError(
+            f"from_vit: hidden_act={act!r} (only exact-erf 'gelu')")
+    if not getattr(cfg, "qkv_bias", True):
+        raise NotImplementedError("from_vit: qkv_bias=False")
+    pooler = getattr(vit, "pooler", None)
+    model = ViTEncoder(cfg.image_size, cfg.patch_size, cfg.num_channels,
+                       cfg.hidden_size, cfg.num_attention_heads,
+                       cfg.intermediate_size, cfg.num_hidden_layers,
+                       ln_eps=cfg.layer_norm_eps,
+                       has_pooler=pooler is not None)
+    params, state = _zero_skeleton(model)
+    emb = vit.embeddings
+    params["cls"] = jnp.asarray(_t(emb.cls_token))            # (1, 1, d)
+    params["pos"] = jnp.asarray(_t(emb.position_embeddings))  # (1, 1+N, d)
+    pw_ = _t(emb.patch_embeddings.projection.weight)          # (d, C, p, p)
+    params["patch"] = {
+        "weight": jnp.asarray(np.transpose(pw_, (2, 3, 1, 0))),  # HWIO
+        "bias": jnp.asarray(_t(emb.patch_embeddings.projection.bias)),
+    }
+    for i, layer in enumerate(vit.encoder.layer):
+        p = params[f"h{i}"]
+        att = layer.attention
+        p["ln1"] = {"weight": jnp.asarray(_t(layer.layernorm_before.weight)),
+                    "bias": jnp.asarray(_t(layer.layernorm_before.bias))}
+        p["ln2"] = {"weight": jnp.asarray(_t(layer.layernorm_after.weight)),
+                    "bias": jnp.asarray(_t(layer.layernorm_after.bias))}
+        p["attn"] = _torch_attn_params(
+            att.attention.query, att.attention.key, att.attention.value,
+            att.output.dense)
+        p["ffn"] = _torch_ffn_params(layer.intermediate.dense,
+                                     layer.output.dense)
+    params["ln"] = {"weight": jnp.asarray(_t(vit.layernorm.weight)),
+                    "bias": jnp.asarray(_t(vit.layernorm.bias))}
+    if pooler is not None:
+        params["pooler"] = {
+            "weight": jnp.asarray(_t(pooler.dense.weight).T),
+            "bias": jnp.asarray(_t(pooler.dense.bias))}
     return model, params, state

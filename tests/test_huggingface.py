@@ -331,3 +331,96 @@ def test_llama_generate_kv_cache_matches_recompute():
         np.testing.assert_array_equal(np.asarray(s_a), np.asarray(s_b))
         np.testing.assert_allclose(np.asarray(sc_a), np.asarray(sc_b),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_vit_parity_and_pooler():
+    """ViT bridge: patchify conv + CLS + positions + pre-LN blocks match
+    the real transformers ViTModel (NHWC inputs here vs NCHW there),
+    last hidden AND pooled output."""
+    from transformers import ViTConfig, ViTModel
+    from bigdl_tpu.interop.huggingface import from_vit
+    torch.manual_seed(8)
+    cfg = ViTConfig(hidden_size=48, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=96,
+                    image_size=32, patch_size=8, num_channels=3,
+                    hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0)
+    hf = ViTModel(cfg).eval()
+    module, params, state = from_vit(hf)
+
+    imgs = np.random.RandomState(8).randn(2, 32, 32, 3).astype(np.float32)
+    with torch.no_grad():
+        out = hf(torch.from_numpy(imgs.transpose(0, 3, 1, 2)))
+    got, _ = module.apply(params, state, jnp.asarray(imgs))
+    np.testing.assert_allclose(np.asarray(got),
+                               out.last_hidden_state.numpy(),
+                               rtol=1e-4, atol=1e-4)
+    pooled, _ = module.apply(params, state, jnp.asarray(imgs), pool=True)
+    np.testing.assert_allclose(np.asarray(pooled),
+                               out.pooler_output.numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_vit_fine_tunes_as_classifier():
+    """The converted ViT trains as an image classifier head-to-toe
+    through jit/grad (pooled CLS -> linear head)."""
+    from transformers import ViTConfig, ViTModel
+    from bigdl_tpu.interop.huggingface import from_vit
+    torch.manual_seed(9)
+    cfg = ViTConfig(hidden_size=32, num_hidden_layers=1,
+                    num_attention_heads=4, intermediate_size=48,
+                    image_size=16, patch_size=8, num_channels=1)
+    hf = ViTModel(cfg).eval()
+    module, params, state = from_vit(hf)
+    r = np.random.RandomState(9)
+    x = r.randn(16, 16, 16, 1).astype(np.float32)
+    y = (x.mean((1, 2, 3)) > 0).astype(np.int32)
+    head = jnp.zeros((32, 2))
+    packed = {"vit": params, "head": head}
+
+    @jax.jit
+    def loss_fn(pk):
+        pooled, _ = module.apply(pk["vit"], state, jnp.asarray(x),
+                                 pool=True)
+        lp = jax.nn.log_softmax(pooled @ pk["head"])
+        return -jnp.take_along_axis(lp, jnp.asarray(y)[:, None], 1).mean()
+
+    l0 = float(loss_fn(packed))
+    g = jax.jit(jax.grad(loss_fn))
+    for _ in range(60):
+        gr = g(packed)
+        packed = jax.tree.map(lambda a, b: a - 0.5 * b, packed, gr)
+    l1 = float(loss_fn(packed))
+    assert l1 < l0 * 0.5, (l0, l1)
+
+
+def test_vit_classifier_wrapper_and_guards():
+    """ViTForImageClassification converts (no pooler -> pool=True
+    raises clearly); unmodeled config fields refuse loudly."""
+    from transformers import ViTConfig, ViTForImageClassification
+    from bigdl_tpu.interop.huggingface import from_vit
+    torch.manual_seed(10)
+    cfg = ViTConfig(hidden_size=32, num_hidden_layers=1,
+                    num_attention_heads=4, intermediate_size=48,
+                    image_size=16, patch_size=8, num_channels=1,
+                    num_labels=3)
+    hf = ViTForImageClassification(cfg).eval()
+    module, params, state = from_vit(hf)
+    assert not module.has_pooler and "pooler" not in params
+    imgs = np.random.RandomState(10).randn(2, 16, 16, 1).astype(np.float32)
+    with torch.no_grad():
+        want = hf.vit(torch.from_numpy(imgs.transpose(0, 3, 1, 2))
+                      ).last_hidden_state.numpy()
+    got, _ = module.apply(params, state, jnp.asarray(imgs))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4,
+                               atol=1e-4)
+    with pytest.raises(ValueError, match="no pooler"):
+        module.apply(params, state, jnp.asarray(imgs), pool=True)
+
+    from transformers import ViTModel
+    bad = ViTConfig(hidden_size=32, num_hidden_layers=1,
+                    num_attention_heads=4, intermediate_size=48,
+                    image_size=16, patch_size=8, num_channels=1,
+                    qkv_bias=False)
+    with pytest.raises(NotImplementedError, match="qkv_bias"):
+        from_vit(ViTModel(bad))
