@@ -423,14 +423,16 @@ class LlamaBlock(Module):
     SwiGLU MLP, both residual."""
 
     def __init__(self, d_model, num_heads, num_kv_heads, d_ff, eps,
-                 rope_theta, name=None):
+                 rope_theta, attn_impl="dense", block_size=512,
+                 name=None):
         super().__init__(name or "LlamaBlock")
         from bigdl_tpu.nn.linear import Linear
         from bigdl_tpu.nn.normalization import RMSNorm
         self.add_child("ln1", RMSNorm(d_model, eps=eps))
         self.add_child("attn", MultiHeadAttention(
             d_model, num_heads, bias=False, num_kv_heads=num_kv_heads,
-            rope_theta=rope_theta))
+            rope_theta=rope_theta, attn_impl=attn_impl,
+            block_size=block_size))
         self.add_child("ln2", RMSNorm(d_model, eps=eps))
         self.add_child("gate", Linear(d_model, d_ff, bias=False))
         self.add_child("up", Linear(d_model, d_ff, bias=False))
@@ -458,6 +460,14 @@ class LlamaBlock(Module):
         from bigdl_tpu.nn.attention import cached_attend, rotary_embedding
         c = self.children()
         attn = c["attn"]
+        if callable(attn.attn_impl):
+            # decoding runs the dense core; a custom kernel's numerics
+            # would silently diverge from apply() (same refusal as
+            # TransformerLayer.cached_step)
+            raise ValueError(
+                "cached_step decodes through the dense attention core; "
+                "this block was built with a custom attn_impl whose "
+                "numerics it cannot reproduce")
         N, T, d = x.shape
         H, hd = attn.num_heads, attn.head_dim
         KV = attn.num_kv_heads or H
@@ -488,14 +498,16 @@ class LlamaLM(Module):
 
     def __init__(self, vocab_size, d_model, num_heads, num_kv_heads,
                  d_ff, num_layers, eps=1e-6, rope_theta=10000.0,
-                 tied=False, eos_id=None, name=None):
+                 tied=False, eos_id=None, attn_impl="dense",
+                 block_size=512, name=None):
         super().__init__(name or "LlamaLM")
         from bigdl_tpu.nn.normalization import RMSNorm
         self.vocab_size, self.d_model = vocab_size, d_model
         self.num_layers, self.tied, self.eos_id = num_layers, tied, eos_id
         for i in range(num_layers):
             self.add_child(f"l{i}", LlamaBlock(
-                d_model, num_heads, num_kv_heads, d_ff, eps, rope_theta))
+                d_model, num_heads, num_kv_heads, d_ff, eps, rope_theta,
+                attn_impl=attn_impl, block_size=block_size))
         self.add_child("norm", RMSNorm(d_model, eps=eps))
 
     def param_specs(self):
@@ -560,9 +572,13 @@ class LlamaLM(Module):
             dtype=params["embed"].dtype)
 
 
-def from_llama(hf_model):
+def from_llama(hf_model, attn_impl="dense", block_size=512):
     """`transformers` LlamaModel / LlamaForCausalLM → (module, params,
-    state). torch Linear weights are (out, in) — transposed into the
+    state). `attn_impl` selects the attention backend for the converted
+    blocks ('dense', 'blockwise', or a callable like
+    kernels.flash_attention.PallasFlashAttention — GQA repeat and RoPE
+    happen before the attend, so every backend sees full-head q/k/v).
+    torch Linear weights are (out, in) — transposed into the
     `x @ w` orientation; k/v projections keep their grouped
     (num_key_value_heads) width. Non-default rope_scaling and explicit
     head_dim ≠ hidden/heads refuse (rotary math would silently
@@ -598,7 +614,8 @@ def from_llama(hf_model):
     model = LlamaLM(cfg.vocab_size, d, H, kv, cfg.intermediate_size,
                     cfg.num_hidden_layers, eps=cfg.rms_norm_eps,
                     rope_theta=float(getattr(cfg, "rope_theta", 10000.0)),
-                    tied=tied, eos_id=eos)
+                    tied=tied, eos_id=eos, attn_impl=attn_impl,
+                    block_size=block_size)
     params, state = _zero_skeleton(model)
     params["embed"] = jnp.asarray(_t(m.embed_tokens.weight))
     if not tied:

@@ -424,3 +424,34 @@ def test_vit_classifier_wrapper_and_guards():
                     qkv_bias=False)
     with pytest.raises(NotImplementedError, match="qkv_bias"):
         from_vit(ViTModel(bad))
+
+
+def test_llama_flash_attention_backend_and_int8():
+    """The converted LLaMA runs with the Pallas flash kernel as its
+    attention backend (matching dense logits), and quantize() swaps the
+    SwiGLU Linears to int8 with argmax agreement — BigQuant-style int8
+    on a modern decoder."""
+    from bigdl_tpu.interop.huggingface import from_llama
+    from bigdl_tpu.kernels.flash_attention import PallasFlashAttention
+    from bigdl_tpu.nn.quantized import QuantizedLinear, quantize
+
+    hf = _tiny_llama(seed=5, kv_heads=2)
+    module, params, state = from_llama(hf)
+    toks = jnp.asarray(
+        np.random.RandomState(5).randint(0, 128, (2, 64)), jnp.int32)
+    want, _ = module.apply(params, state, toks)
+
+    flash = from_llama(hf, attn_impl=PallasFlashAttention(
+        block_q=32, block_k=32, interpret=True))[0]
+    got, _ = flash.apply(params, state, toks)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-2, atol=2e-3)
+
+    qmod, qparams = quantize(module, params)
+    blk = qmod.children()["l0"].children()
+    assert isinstance(blk["gate"], QuantizedLinear)
+    assert isinstance(blk["down"], QuantizedLinear)
+    qlogits, _ = qmod.apply(qparams, state, toks)
+    agree = float((np.asarray(qlogits).argmax(-1)
+                   == np.asarray(want).argmax(-1)).mean())
+    assert agree > 0.97, agree
