@@ -753,3 +753,19 @@ def from_vit(hf_model):
             "weight": jnp.asarray(_t(pooler.dense.weight).T),
             "bias": jnp.asarray(_t(pooler.dense.bias))}
     return model, params, state
+
+
+def llama_tp_rules():
+    """Megatron-style tensor-parallel ShardingRules for LlamaLM param
+    paths: q/k/v and gate/up split output columns over the 'model' axis,
+    o and down split input rows (XLA GSPMD inserts the collectives).
+    Constraint: the model-axis size must divide num_heads AND
+    num_kv_heads (grouped K/V shard by kv head)."""
+    from jax.sharding import PartitionSpec as P
+    from bigdl_tpu.parallel.sharding import ShardingRules
+    return ShardingRules([
+        (r"l\d+/attn/w[qkv]", P(None, "model")),
+        (r"l\d+/attn/wo", P("model", None)),
+        (r"l\d+/(gate|up)/weight", P(None, "model")),
+        (r"l\d+/down/weight", P("model", None)),
+    ])

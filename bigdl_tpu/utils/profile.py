@@ -132,3 +132,27 @@ class IterationMetrics:
             lines.append(f"{phase}: total {s:.3f}s over {n} "
                          f"(avg {s / n * 1e3:.2f}ms)")
         return "\n".join(lines)
+
+
+def device_memory_summary(device=None):
+    """Per-device memory stats dict (bytes_in_use, peak_bytes_in_use,
+    bytes_limit when the backend reports them — TPU/GPU do; host CPU
+    returns {}). The analogue of the reference's per-phase memory
+    accounting in Metrics (optim/Metrics.scala); pair with
+    `jax.profiler.save_device_memory_profile` for a full breakdown."""
+    import jax
+    dev = device or jax.devices()[0]
+    stats = getattr(dev, "memory_stats", lambda: None)()
+    if not stats:
+        return {}
+    keep = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+            "largest_alloc_size", "num_allocs")
+    return {k: int(v) for k, v in stats.items() if k in keep}
+
+
+def memory_profile(path: str) -> str:
+    """Write a pprof-format device-memory profile (open with `pprof` or
+    xprof). Returns the path."""
+    import jax
+    jax.profiler.save_device_memory_profile(path)
+    return path
