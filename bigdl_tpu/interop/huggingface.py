@@ -438,11 +438,13 @@ class LlamaBlock(Module):
         self.add_child("up", Linear(d_model, d_ff, bias=False))
         self.add_child("down", Linear(d_ff, d_model, bias=False))
 
-    def _apply(self, params, state, x, *, training=False, rng=None):
+    def _apply(self, params, state, x, *, positions=None, training=False,
+               rng=None):
         c = self.children()
         h, _ = c["ln1"].apply(params["ln1"], {}, x)
         a, _ = c["attn"].apply(params["attn"], {}, h, causal=True,
-                               training=training, rng=rng)
+                               positions=positions, training=training,
+                               rng=rng)
         x = x + a
         h, _ = c["ln2"].apply(params["ln2"], {}, x)
         g, _ = c["gate"].apply(params["gate"], {}, h)
@@ -521,22 +523,25 @@ class LlamaLM(Module):
                 initializers.random_normal(0.0, 0.02))
         return specs
 
-    def _hidden(self, params, state, tokens, training=False, rng=None):
+    def _hidden(self, params, state, tokens, training=False, rng=None,
+                positions=None):
         x = params["embed"][tokens]
         rngs = (jax.random.split(rng, self.num_layers)
                 if rng is not None else (None,) * self.num_layers)
         for i in range(self.num_layers):
             x, _ = self.children()[f"l{i}"].apply(
                 params[f"l{i}"], state.get(f"l{i}", {}), x,
-                training=training, rng=rngs[i])
+                positions=positions, training=training, rng=rngs[i])
         x, _ = self.children()["norm"].apply(params["norm"], {}, x)
         return x, state
 
     def _head(self, params):
         return params["embed"] if self.tied else params["lm_head"]
 
-    def _apply(self, params, state, tokens, *, training=False, rng=None):
-        x, _ = self._hidden(params, state, tokens, training, rng)
+    def _apply(self, params, state, tokens, *, positions=None,
+               training=False, rng=None):
+        x, _ = self._hidden(params, state, tokens, training, rng,
+                            positions=positions)
         return x @ self._head(params).T, state
 
     def _cached_forward(self, params, tokens, caches, start):
@@ -769,3 +774,48 @@ def llama_tp_rules():
         (r"l\d+/(gate|up)/weight", P(None, "model")),
         (r"l\d+/down/weight", P("model", None)),
     ])
+
+
+def llama_sp_apply(module, params, tokens, mesh, seq_axis="seq"):
+    """Sequence-parallel LLaMA forward: run a
+    `from_llama(attn_impl=RingAttention(seq_axis))` module inside
+    shard_map with the sequence dim sharded over `seq_axis` — each shard
+    computes RoPE with its GLOBAL position offsets (axis_index) and K/V
+    blocks rotate the ring, so the logits are exactly the dense
+    full-sequence forward's. Composes with a 'data' batch axis when the
+    mesh carries one. tokens (B, T) with T % mesh.shape[seq_axis] == 0;
+    returns (B, T, vocab) logits sharded over the sequence dim."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+    from bigdl_tpu.parallel.mesh import composed_data_axis
+    from bigdl_tpu.parallel.ring import RingAttention
+
+    # a non-ring backend inside shard_map would attend only within each
+    # shard's slice and return plausible-shaped but WRONG logits
+    for i in range(module.num_layers):
+        impl = module.children()[f"l{i}"].children()["attn"].attn_impl
+        if not (isinstance(impl, RingAttention)
+                and impl.axis_name == seq_axis):
+            raise ValueError(
+                f"llama_sp_apply: layer l{i} attn_impl is {impl!r}; "
+                f"build the module with from_llama(hf, attn_impl="
+                f"RingAttention(axis_name={seq_axis!r}))")
+
+    cache = module.__dict__.setdefault("_sp_compiled", {})
+    key = (mesh, seq_axis)
+    if key not in cache:
+        batch_axis = composed_data_axis(mesh)
+        tok_spec = P(batch_axis, seq_axis)
+
+        def fwd(p, xt):
+            t_local = xt.shape[1]
+            idx = jax.lax.axis_index(seq_axis)
+            pos = idx * t_local + jnp.arange(t_local)
+            logits, _ = module.apply(p, {}, xt, positions=pos)
+            return logits
+
+        cache[key] = jax.jit(shard_map(
+            fwd, mesh=mesh, in_specs=(P(), tok_spec),
+            out_specs=P(batch_axis, seq_axis, None),
+            check_vma=False))
+    return cache[key](params, tokens)

@@ -253,7 +253,8 @@ class MultiHeadAttention(Module):
         return dot_product_attention(q, k, v, mask)
 
     def _apply(self, params, state, x, memory=None, *, mask=None,
-               causal: bool = False, training=False, rng=None):
+               causal: bool = False, positions=None, training=False,
+               rng=None):
         kv_src = memory if memory is not None else x
         q = x @ params["wq"]
         k = kv_src @ params["wk"]
@@ -266,8 +267,10 @@ class MultiHeadAttention(Module):
         k = self._split(k, kv_heads)
         v = self._split(v, kv_heads)
         if self.rope_theta:
-            q = rotary_embedding(q, self.rope_theta)
-            k = rotary_embedding(k, self.rope_theta)
+            # `positions` carries ABSOLUTE token positions (sequence-
+            # parallel shards pass their global offsets); default 0..T-1
+            q = rotary_embedding(q, self.rope_theta, positions)
+            k = rotary_embedding(k, self.rope_theta, positions)
         if kv_heads != self.num_heads:      # GQA: repeat kv to q heads
             rep = self.num_heads // kv_heads
             k = jnp.repeat(k, rep, axis=1)

@@ -494,3 +494,45 @@ def test_llama_tensor_parallel_training():
     got, _ = model.apply(sharded0, state0, jnp.asarray(toks))
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_llama_ring_attention_sequence_parallel():
+    """The converted LLaMA runs ring-attention sequence-parallel: a
+    from_llama(attn_impl=RingAttention) module inside shard_map over a
+    seq-sharded mesh produces EXACTLY the dense full-sequence logits
+    (RoPE offsets per shard; GQA repeat before the ring)."""
+    from bigdl_tpu.interop.huggingface import from_llama, llama_sp_apply
+    from bigdl_tpu.parallel import create_mesh
+    from bigdl_tpu.parallel.ring import RingAttention
+
+    hf = _tiny_llama(seed=6, kv_heads=2)
+    dense, params, state = from_llama(hf)
+    ring = from_llama(hf, attn_impl=RingAttention(axis_name="seq"))[0]
+
+    toks = jnp.asarray(
+        np.random.RandomState(6).randint(0, 128, (2, 32)), jnp.int32)
+    want, _ = dense.apply(params, state, toks)
+
+    mesh = create_mesh(seq=4, drop_trivial_axes=True)
+    got = llama_sp_apply(ring, params, toks, mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    # composes with data parallelism: batch over 'data', seq over 'seq'
+    mesh2 = create_mesh(data=2, seq=4, drop_trivial_axes=False)
+    got2 = llama_sp_apply(ring, params, toks, mesh2)
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_llama_sp_apply_refuses_dense_backend():
+    """Passing a non-ring module to llama_sp_apply raises instead of
+    silently attending only within shards."""
+    from bigdl_tpu.interop.huggingface import from_llama, llama_sp_apply
+    from bigdl_tpu.parallel import create_mesh
+    hf = _tiny_llama(seed=7)
+    dense, params, state = from_llama(hf)
+    toks = jnp.zeros((1, 32), jnp.int32)
+    mesh = create_mesh(seq=4, drop_trivial_axes=True)
+    with pytest.raises(ValueError, match="RingAttention"):
+        llama_sp_apply(dense, params, toks, mesh)
