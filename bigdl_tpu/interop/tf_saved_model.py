@@ -1,0 +1,63 @@
+"""TF2 SavedModel → trainable module graph.
+
+The reference loads TF1 checkpoints with helper scripts that need a TF
+install (`scripts/export_tf_checkpoint.py`, dump_tf_graph.py —
+SURVEY §2.8); the analogue here: a SavedModel directory's serving
+signature is frozen through TensorFlow (variables inlined as consts,
+v2 control flow lowered to v1 — which `tf_convert` imports natively)
+and handed to `to_module`. TensorFlow is only needed at CONVERSION
+time; the returned module runs and fine-tunes with no TF dependency,
+like every other importer output.
+
+    module, params, state, names = load_saved_model("path/to/saved_model")
+    logits, _ = module.apply(params, state, x)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+def load_saved_model(path: str,
+                     signature: str = "serving_default",
+                     inputs: Optional[Sequence[str]] = None,
+                     outputs: Optional[Sequence[str]] = None):
+    """Load a TF2 SavedModel directory and convert its `signature` to
+    (module, params, state, name_map). Requires `tensorflow` importable
+    (conversion time only); raises ImportError with guidance otherwise.
+    `inputs`/`outputs` override the frozen graph's inferred boundary
+    (placeholder names / the signature's structured outputs)."""
+    try:
+        import tensorflow as tf
+        from tensorflow.python.framework.convert_to_constants import \
+            convert_variables_to_constants_v2
+    except ImportError as e:                      # pragma: no cover
+        raise ImportError(
+            "load_saved_model freezes the SavedModel through TensorFlow "
+            "(conversion time only). Install tensorflow, or freeze "
+            "elsewhere and import the GraphDef with "
+            "interop.tf_convert.load_model") from e
+
+    from bigdl_tpu.interop.tensorflow import load_graphdef
+    from bigdl_tpu.interop.tf_convert import to_module
+
+    loaded = tf.saved_model.load(path)
+    sigs = getattr(loaded, "signatures", {})
+    if signature not in sigs:
+        raise ValueError(
+            f"SavedModel at {path!r} has no signature {signature!r}; "
+            f"available: {sorted(sigs)}")
+    concrete = sigs[signature]
+    frozen = convert_variables_to_constants_v2(concrete)
+    gd = frozen.graph.as_graph_def()
+
+    def _spec(tensor_name: str) -> str:
+        name, _, port = tensor_name.partition(":")
+        return name if port in ("", "0") else f"{name}:{port}"
+
+    if inputs is None:
+        inputs = [_spec(t.name) for t in frozen.inputs]
+    if outputs is None:
+        outputs = [_spec(t.name) for t in frozen.outputs]
+    return to_module(load_graphdef(gd.SerializeToString()),
+                     inputs=list(inputs), outputs=list(outputs))

@@ -486,3 +486,48 @@ def test_real_tf_cond_both_const_arms_and_frozen_pred():
                                 inputs=["x"], outputs=["out"])
     got2, _ = mod2.apply(p2, s2, jnp.asarray([5.0, 3.0]))
     np.testing.assert_allclose(np.asarray(got2), want2)
+
+
+def test_saved_model_roundtrip(tmp_path):
+    """A REAL tf.saved_model.save'd module (variables + a while loop)
+    loads through load_saved_model: frozen via TF, converted, trainable,
+    and numerically identical to the SavedModel's own serving
+    signature."""
+    from bigdl_tpu.interop.tf_saved_model import load_saved_model
+
+    class M(tf.Module):
+        def __init__(self):
+            super().__init__()
+            self.w = tf.Variable(
+                (0.3 * np.random.RandomState(0).randn(4, 3)
+                 ).astype(np.float32))
+            self.b = tf.Variable(tf.zeros((3,)))
+
+        @tf.function(input_signature=[
+            tf.TensorSpec((None, 4), tf.float32)])
+        def __call__(self, x):
+            def cond(i, v):
+                return i < 3
+
+            def body(i, v):
+                return i + 1, tf.nn.relu(v)
+            _, x = tf.while_loop(cond, body, [tf.constant(0), x])
+            return tf.nn.softmax(x @ self.w + self.b)
+
+    m = M()
+    x = np.random.RandomState(1).randn(5, 4).astype(np.float32)
+    want = m(tf.constant(x)).numpy()
+    d = str(tmp_path / "sm")
+    tf.saved_model.save(m, d)
+
+    module, params, state, _ = load_saved_model(d)
+    got, _ = module.apply(params, state, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                               atol=1e-6)
+    # the frozen variables are trainable params: a non-constant scalar
+    # (softmax's full sum is identically B) must produce NON-ZERO grads
+    import jax
+    g = jax.grad(lambda p: module.apply(
+        p, state, jnp.asarray(x))[0][:, 0].sum())(params)
+    gl = [l for l in jax.tree.leaves(g) if l.shape == (4, 3)]
+    assert gl and float(jnp.abs(gl[0]).max()) > 0
