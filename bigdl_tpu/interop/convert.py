@@ -13,18 +13,27 @@ like the reference requires the model code)."""
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 import numpy as np
 
 
-def _fmt(path: str) -> str:
+def _fmt(path: str, writable: bool = False) -> str:
+    if os.path.isdir(path):
+        if writable:
+            raise ValueError(
+                f"{path!r} is a directory — SavedModel is an INPUT "
+                "format only (export via .pb / tf_saver instead)")
+        # a TF2 SavedModel directory (saved_model.pb inside)
+        return "saved_model"
     for ext, fmt in ((".bigdl-tpu", "bigdl"), (".caffemodel", "caffe"),
                      (".t7", "torch"), (".onnx", "onnx"), (".pb", "tf")):
         if path.endswith(ext):
             return fmt
     raise ValueError(f"cannot infer format of {path!r} "
-                     f"(.bigdl-tpu | .caffemodel | .t7 | .onnx | .pb)")
+                     f"(.bigdl-tpu | .caffemodel | .t7 | .onnx | .pb | "
+                     f"SavedModel dir)")
 
 
 def _params_to_table(params, prefix=""):
@@ -57,7 +66,7 @@ def _table_to_params(table, skeleton):
 def convert(input_path: str, output_path: str, module_path: str = None,
             example_shape=None):
     from bigdl_tpu.utils.serializer import load_module, save_module
-    src, dst = _fmt(input_path), _fmt(output_path)
+    src, dst = _fmt(input_path), _fmt(output_path, writable=True)
 
     if src == "bigdl":
         module, params, state = load_module(input_path)
@@ -67,8 +76,10 @@ def convert(input_path: str, output_path: str, module_path: str = None,
     elif src == "tf":
         from bigdl_tpu.interop.tf_convert import load_model as load_tf
         module, params, state, _ = load_tf(input_path)
+    elif src == "saved_model":
+        from bigdl_tpu.interop.tf_saved_model import load_saved_model
+        module, params, state, _ = load_saved_model(input_path)
     else:
-        import os
         sibling_proto = input_path[:-len(".caffemodel")] + ".prototxt" \
             if src == "caffe" else None
         if not module_path and sibling_proto and os.path.exists(

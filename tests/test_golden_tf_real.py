@@ -531,3 +531,36 @@ def test_saved_model_roundtrip(tmp_path):
         p, state, jnp.asarray(x))[0][:, 0].sum())(params)
     gl = [l for l in jax.tree.leaves(g) if l.shape == (4, 3)]
     assert gl and float(jnp.abs(gl[0]).max()) > 0
+
+
+def test_convert_cli_accepts_saved_model_dir(tmp_path):
+    """ConvertModel any-to-any: a SavedModel DIRECTORY as --input
+    converts to the durable format (reference: utils/ConvertModel.scala
+    from-tf path)."""
+    from bigdl_tpu.interop.convert import convert
+    from bigdl_tpu.utils.serializer import load_module
+
+    class M(tf.Module):
+        def __init__(self):
+            super().__init__()
+            self.w = tf.Variable(
+                (0.2 * np.random.RandomState(2).randn(3, 5)
+                 ).astype(np.float32))
+
+        @tf.function(input_signature=[
+            tf.TensorSpec((None, 3), tf.float32)])
+        def __call__(self, x):
+            return tf.nn.relu(x @ self.w)
+
+    m = M()
+    x = np.random.RandomState(3).randn(4, 3).astype(np.float32)
+    want = m(tf.constant(x)).numpy()
+    d = str(tmp_path / "sm")
+    tf.saved_model.save(m, d)
+
+    out = str(tmp_path / "converted.bigdl-tpu")
+    convert(d, out)
+    mod, params, state = load_module(out)
+    got, _ = mod.apply(params, state, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                               atol=1e-6)
