@@ -819,3 +819,27 @@ def llama_sp_apply(module, params, tokens, mesh, seq_axis="seq"):
             out_specs=P(batch_axis, seq_axis, None),
             check_vma=False))
     return cache[key](params, tokens)
+
+
+def gpt2_tp_rules():
+    """Megatron-style tensor-parallel rules for GPT2LM param paths
+    (h<i>/attn + h<i>/ffn) — the same split as encoder_tp_rules, whose
+    alternation already covers the GPT-2 paths; kept as a named entry
+    point. The model-axis size must divide num_heads."""
+    return encoder_tp_rules()
+
+
+def encoder_tp_rules():
+    """Tensor-parallel rules for the BERT/ViT encoder param paths
+    (attn<i>/..., ffn<i>/... for BERT; h<i>/... for ViT — both match).
+    Same Megatron split as gpt2_tp_rules."""
+    from jax.sharding import PartitionSpec as P
+    from bigdl_tpu.parallel.sharding import ShardingRules
+    return ShardingRules([
+        (r"(attn\d+|h\d+/attn)/w[qkv]", P(None, "model")),
+        (r"(attn\d+|h\d+/attn)/b[qkv]", P("model")),
+        (r"(attn\d+|h\d+/attn)/wo", P("model", None)),
+        (r"(ffn\d+|h\d+/ffn)/w1/weight", P(None, "model")),
+        (r"(ffn\d+|h\d+/ffn)/w1/bias", P("model")),
+        (r"(ffn\d+|h\d+/ffn)/w2/weight", P("model", None)),
+    ])

@@ -536,3 +536,56 @@ def test_llama_sp_apply_refuses_dense_backend():
     mesh = create_mesh(seq=4, drop_trivial_axes=True)
     with pytest.raises(ValueError, match="RingAttention"):
         llama_sp_apply(dense, params, toks, mesh)
+
+
+def test_gpt2_and_encoder_tp_rules_shard_and_match():
+    """Megatron TP rules for the other bridges: GPT-2, BERT, and ViT
+    params shard over 'model', and the sharded forward equals the
+    unsharded one."""
+    from jax.sharding import PartitionSpec as P
+    from bigdl_tpu.interop.huggingface import (BertEncoder, GPT2LM,
+                                               ViTEncoder,
+                                               encoder_tp_rules,
+                                               gpt2_tp_rules)
+    from bigdl_tpu.parallel import create_mesh
+    from bigdl_tpu.parallel.sharding import shard_tree
+
+    mesh = create_mesh(data=4, model=2, drop_trivial_axes=False)
+
+    gpt = GPT2LM(31, 16, 16, 2, 1)
+    gp, gs = gpt.init(jax.random.PRNGKey(0))
+    toks = jnp.asarray(np.random.RandomState(0).randint(0, 31, (2, 8)),
+                       jnp.int32)
+    want, _ = gpt.apply(gp, gs, toks)
+    specs = gpt2_tp_rules().tree_specs(gp)
+    assert specs["h0"]["attn"]["wq"] == P(None, "model")
+    assert specs["h0"]["ffn"]["w2"]["weight"] == P("model", None)
+    sharded = shard_tree(gp, mesh, specs)
+    got, _ = gpt.apply(sharded, gs, toks)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    bert = BertEncoder(31, 16, 2, 16, 2, 1, 32)
+    bp, bs = bert.init(jax.random.PRNGKey(1))
+    bspecs = encoder_tp_rules().tree_specs(bp)
+    assert bspecs["attn0"]["wq"] == P(None, "model")
+    assert bspecs["ffn0"]["w1"]["weight"] == P(None, "model")
+    mask = jnp.ones((2, 8), jnp.int32)
+    types = jnp.zeros((2, 8), jnp.int32)
+    bwant, _ = bert.apply(bp, bs, toks, mask, types)
+    bsharded = shard_tree(bp, mesh, bspecs)
+    bgot, _ = bert.apply(bsharded, bs, toks, mask, types)
+    np.testing.assert_allclose(np.asarray(bgot), np.asarray(bwant),
+                               rtol=2e-5, atol=2e-5)
+
+    vit = ViTEncoder(16, 8, 1, 16, 2, 32, 1)
+    vp, vs = vit.init(jax.random.PRNGKey(2))
+    vspecs = encoder_tp_rules().tree_specs(vp)
+    assert vspecs["h0"]["attn"]["wq"] == P(None, "model")
+    imgs = jnp.asarray(np.random.RandomState(2).randn(2, 16, 16, 1),
+                       jnp.float32)
+    vwant, _ = vit.apply(vp, vs, imgs)
+    vsharded = shard_tree(vp, mesh, vspecs)
+    vgot, _ = vit.apply(vsharded, vs, imgs)
+    np.testing.assert_allclose(np.asarray(vgot), np.asarray(vwant),
+                               rtol=2e-5, atol=2e-5)
