@@ -87,3 +87,41 @@ def test_oversized_request_chunks_correctly():
     got = svc.predict(x)
     want = np.asarray(ref(jnp.asarray(x)))
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_int8_llama_serving_under_concurrency():
+    """Serving composition: a quantized (int8 SwiGLU) LLaMA behind
+    PredictionService under threaded clients — per-request rows match
+    the single-shot int8 forward, and argmax agrees with fp32."""
+    import threading
+    from bigdl_tpu.interop.huggingface import LlamaLM
+    from bigdl_tpu.nn.quantized import quantize
+    from bigdl_tpu.optim.predictor import PredictionService
+
+    model = LlamaLM(48, 32, 4, 2, 48, 2, tied=True)
+    params, state = model.init(jax.random.PRNGKey(0))
+    qmod, qparams = quantize(model, params)
+    svc = PredictionService(qmod, qparams, state, max_batch=16)
+
+    r = np.random.RandomState(0)
+    reqs = [r.randint(0, 48, (n, 12)).astype(np.int32)
+            for n in (1, 3, 7, 2, 5, 4)]
+    want = [np.asarray(qmod.apply(qparams, state, jnp.asarray(q))[0])
+            for q in reqs]
+
+    results = [None] * len(reqs)
+    def client(i):
+        results[i] = svc.predict(reqs[i])
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(reqs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for got, exp in zip(results, want):
+        np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-5)
+
+    fp_logits, _ = model.apply(params, state, jnp.asarray(reqs[2]))
+    agree = (results[2].argmax(-1)
+             == np.asarray(fp_logits).argmax(-1)).mean()
+    assert agree > 0.9, agree
