@@ -275,7 +275,13 @@ def train_ptb(args):
         return _train_ptb_seq_parallel(args, d, xs, ys)
     if args.moe_experts and args.moe_experts > 1:
         return _train_ptb_moe(args, d, xs, ys)
-    if args.model == "transformer":
+    if args.model == "llama":
+        # modern decoder (RMSNorm + RoPE + GQA + SwiGLU) from the HF
+        # bridge's architecture class, trained like any zoo model
+        from bigdl_tpu.interop.huggingface import LlamaLM
+        model = LlamaLM(d.vocab_size, args.hidden, 4, args.kv_heads,
+                        args.hidden * 4, args.layers, tied=True)
+    elif args.model == "transformer":
         model = rnn.build_transformer(d.vocab_size, d_model=args.hidden,
                                       num_heads=4, d_ff=args.hidden * 4,
                                       num_layers=args.layers, dropout=0.0)
@@ -285,7 +291,8 @@ def train_ptb(args):
                                num_layers=args.layers)
     # build_lstm ends in LogSoftMax (ClassNLL input); the Transformer LM
     # returns tied-embedding logits (CrossEntropy input)
-    inner = (nn.CrossEntropyCriterion() if args.model == "transformer"
+    inner = (nn.CrossEntropyCriterion()
+             if args.model in ("transformer", "llama")
              else nn.ClassNLLCriterion())
     crit = nn.TimeDistributedCriterion(inner, size_average=True)
     opt = Optimizer(model, ds, crit, _method(args, Adam(1e-3)))
@@ -450,8 +457,10 @@ def main(argv=None):
 
     p = sub.add_parser("ptb", help="PTB language model")
     _common(p)
-    p.add_argument("--model", choices=["lstm", "transformer"],
+    p.add_argument("--model", choices=["lstm", "transformer", "llama"],
                    default="lstm")
+    p.add_argument("--kv-heads", type=int, default=2,
+                   help="grouped-query KV heads for --model llama")
     p.add_argument("--hidden", type=int, default=128)
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--num-steps", type=int, default=20)

@@ -185,3 +185,25 @@ def test_perf_scaling_and_loader_api():
     lrec = run_loader(batch_size=16, n_images=64, size=32, n_batches=2)
     assert lrec["loader_imgs_per_sec"] > 0
     assert set(glob.glob("/tmp/perf_shards_*")) == before   # cleaned up
+
+
+def test_ptb_llama_cli_trains():
+    """The PTB CLI's --model llama path (the HF bridge's architecture as
+    a zoo model) trains to a falling loss on the synthetic corpus."""
+    import os
+    import subprocess
+    import sys
+    r = subprocess.run(
+        [sys.executable, "-m", "bigdl_tpu.models.train", "ptb",
+         "--model", "llama", "--hidden", "32", "--layers", "1",
+         "--num-steps", "12", "--vocab-size", "64", "-b", "8",
+         "--max-iter", "30"],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "BIGDL_TPU_FORCE_CPU": "1"})
+    assert r.returncode == 0, r.stderr[-800:]
+    import re
+    m = re.search(r"ptb perplexity ~ ([0-9.ainf]+)", r.stdout)
+    assert m, r.stdout[-400:]
+    ppl = float(m.group(1))
+    # vocab 64 => random-guess ppl 64; training must beat it and be finite
+    assert np.isfinite(ppl) and ppl < 60.0, ppl
