@@ -501,11 +501,12 @@ class LlamaLM(Module):
     def __init__(self, vocab_size, d_model, num_heads, num_kv_heads,
                  d_ff, num_layers, eps=1e-6, rope_theta=10000.0,
                  tied=False, eos_id=None, attn_impl="dense",
-                 block_size=512, name=None):
+                 block_size=512, remat=False, name=None):
         super().__init__(name or "LlamaLM")
         from bigdl_tpu.nn.normalization import RMSNorm
         self.vocab_size, self.d_model = vocab_size, d_model
         self.num_layers, self.tied, self.eos_id = num_layers, tied, eos_id
+        self.remat = remat
         for i in range(num_layers):
             self.add_child(f"l{i}", LlamaBlock(
                 d_model, num_heads, num_kv_heads, d_ff, eps, rope_theta,
@@ -523,15 +524,24 @@ class LlamaLM(Module):
                 initializers.random_normal(0.0, 0.02))
         return specs
 
+    remat = False     # class default keeps older pickles loading
+
     def _hidden(self, params, state, tokens, training=False, rng=None,
                 positions=None):
         x = params["embed"][tokens]
         rngs = (jax.random.split(rng, self.num_layers)
                 if rng is not None else (None,) * self.num_layers)
         for i in range(self.num_layers):
-            x, _ = self.children()[f"l{i}"].apply(
-                params[f"l{i}"], state.get(f"l{i}", {}), x,
-                positions=positions, training=training, rng=rngs[i])
+            blk = self.children()[f"l{i}"]
+
+            def run(p, h, blk=blk, st=state.get(f"l{i}", {}), rng=rngs[i]):
+                return blk.apply(p, st, h, positions=positions,
+                                 training=training, rng=rng)[0]
+            if self.remat:
+                # recompute each block's activations in the backward —
+                # the TPU-standard HBM-for-FLOPs trade (jax.checkpoint)
+                run = jax.checkpoint(run)
+            x = run(params[f"l{i}"], x)
         x, _ = self.children()["norm"].apply(params["norm"], {}, x)
         return x, state
 
@@ -577,7 +587,8 @@ class LlamaLM(Module):
             dtype=params["embed"].dtype)
 
 
-def from_llama(hf_model, attn_impl="dense", block_size=512):
+def from_llama(hf_model, attn_impl="dense", block_size=512,
+               remat=False):
     """`transformers` LlamaModel / LlamaForCausalLM → (module, params,
     state). `attn_impl` selects the attention backend for the converted
     blocks ('dense', 'blockwise', or a callable like
@@ -620,7 +631,7 @@ def from_llama(hf_model, attn_impl="dense", block_size=512):
                     cfg.num_hidden_layers, eps=cfg.rms_norm_eps,
                     rope_theta=float(getattr(cfg, "rope_theta", 10000.0)),
                     tied=tied, eos_id=eos, attn_impl=attn_impl,
-                    block_size=block_size)
+                    block_size=block_size, remat=remat)
     params, state = _zero_skeleton(model)
     params["embed"] = jnp.asarray(_t(m.embed_tokens.weight))
     if not tied:

@@ -589,3 +589,28 @@ def test_gpt2_and_encoder_tp_rules_shard_and_match():
     vgot, _ = vit.apply(vsharded, vs, imgs)
     np.testing.assert_allclose(np.asarray(vgot), np.asarray(vwant),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_llama_remat_grads_identical():
+    """remat=True recomputes block activations in the backward without
+    changing ANY gradient (jax.checkpoint is numerics-neutral)."""
+    from bigdl_tpu.interop.huggingface import LlamaLM
+
+    plain = LlamaLM(48, 32, 4, 2, 48, 2, tied=True)
+    params, state = plain.init(jax.random.PRNGKey(0))
+    remat = LlamaLM(48, 32, 4, 2, 48, 2, tied=True, remat=True)
+    toks = jnp.asarray(np.random.RandomState(0).randint(0, 48, (2, 10)),
+                       jnp.int32)
+
+    def loss(m):
+        def f(p):
+            logits, _ = m.apply(p, state, toks[:, :-1])
+            lp = jax.nn.log_softmax(logits)
+            return -jnp.take_along_axis(lp, toks[:, 1:, None], -1).mean()
+        return f
+
+    ga = jax.grad(loss(plain))(params)
+    gb = jax.grad(loss(remat))(params)
+    for a, b in zip(jax.tree.leaves(ga), jax.tree.leaves(gb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
