@@ -30,7 +30,24 @@ DT_STRING, DT_INT64, DT_UINT8 = 7, 9, 4
 
 # DataType enum → numpy (the types the pipeline/decode ops traffic in)
 NP_OF_DT = {1: np.float32, 2: np.float64, 3: np.int32, 4: np.uint8,
-            5: np.int16, 6: np.int8, 9: np.int64, 10: np.bool_}
+            5: np.int16, 6: np.int8, 9: np.int64, 10: np.bool_,
+            19: np.float16}
+try:                                     # bfloat16 via ml_dtypes (jax dep)
+    import ml_dtypes as _mld
+    NP_OF_DT[14] = _mld.bfloat16
+except ImportError:                      # pragma: no cover
+    pass
+
+# pure-jnp elementwise mappings shared by the graph executor below AND
+# the module converter's op tables (tf_convert) — one source of truth
+ELEMENTWISE_UNARY = {
+    "Rsqrt": lambda x: 1.0 / jnp.sqrt(x), "Sqrt": jnp.sqrt,
+    "Square": jnp.square, "Neg": jnp.negative, "Exp": jnp.exp,
+    "Log": jnp.log, "Abs": jnp.abs,
+}
+ELEMENTWISE_BINARY = {
+    "Maximum": jnp.maximum, "Minimum": jnp.minimum,
+}
 
 
 def _parse_tensor(t: pw.Msg) -> np.ndarray:
@@ -216,6 +233,16 @@ class TFGraph:
             return ins[0] * ins[1]
         if op == "RealDiv":
             return ins[0] / ins[1]
+        if op in ELEMENTWISE_UNARY:
+            return ELEMENTWISE_UNARY[op](ins[0])
+        if op in ELEMENTWISE_BINARY:
+            return ELEMENTWISE_BINARY[op](ins[0], ins[1])
+        if op == "Cast":
+            dst = node.attr_type("DstT", DT_FLOAT)
+            if dst not in NP_OF_DT:
+                raise NotImplementedError(
+                    f"Cast {node.name}: unsupported DstT={dst}")
+            return ins[0].astype(NP_OF_DT[dst])
         if op == "Conv2D":
             strides = node.attr_ints("strides") or [1, 1, 1, 1]
             pad = node.attr_str("padding", "SAME")

@@ -24,7 +24,9 @@ from bigdl_tpu.core.container import Graph, Input, Node
 from bigdl_tpu.core.module import Module, ParamSpec
 from bigdl_tpu.core import init as initializers
 from bigdl_tpu.interop import protowire as pw
-from bigdl_tpu.interop.tensorflow import (NP_OF_DT, TFGraph, TFNode,
+from bigdl_tpu.interop.tensorflow import (ELEMENTWISE_BINARY,
+                                          ELEMENTWISE_UNARY, NP_OF_DT,
+                                          TFGraph, TFNode,
                                           strided_slice_index)
 
 
@@ -105,9 +107,8 @@ _TF_DTYPES = {1: jnp.float32, 2: jnp.float64, 3: jnp.int32, 4: jnp.uint8,
               14: jnp.bfloat16, 19: jnp.float16}
 
 _UNARY_OPS = {
-    "Abs": jnp.abs, "Neg": jnp.negative, "Exp": jnp.exp, "Log": jnp.log,
-    "Log1p": jnp.log1p, "Expm1": jnp.expm1, "Sqrt": jnp.sqrt,
-    "Rsqrt": lambda x: 1.0 / jnp.sqrt(x), "Square": jnp.square,
+    **ELEMENTWISE_UNARY,                  # shared with the graph executor
+    "Log1p": jnp.log1p, "Expm1": jnp.expm1,
     "Reciprocal": lambda x: 1.0 / x, "Inv": lambda x: 1.0 / x,
     "Ceil": jnp.ceil, "Floor": jnp.floor, "Round": jnp.round,
     "Rint": jnp.round, "Sign": jnp.sign,
@@ -128,7 +129,7 @@ _BINARY_OPS = {
         jnp.trunc(a / b).astype(a.dtype),
     "FloorMod": jnp.mod, "Mod": jnp.mod, "Pow": jnp.power,
     "TruncateMod": jnp.fmod,
-    "Maximum": jnp.maximum, "Minimum": jnp.minimum,
+    **ELEMENTWISE_BINARY,                 # shared with the graph executor
     "SquaredDifference": lambda a, b: jnp.square(a - b),
     "Equal": lambda a, b: a == b, "NotEqual": lambda a, b: a != b,
     "Greater": lambda a, b: a > b, "GreaterEqual": lambda a, b: a >= b,
@@ -626,6 +627,29 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
     if op == "Reshape":
         shape = const(1)
         if shape is None:
+            # batch-dynamic target: shape built by Pack(dynamic_batch,
+            # const...) — the Keras-3 Flatten pattern. One dynamic slot
+            # becomes reshape's -1
+            shp_node = graph.nodes.get(node.inputs[1])
+            hops = set()
+            while shp_node is not None and shp_node.op in _ALIAS_OPS \
+                    and shp_node.inputs and shp_node.name not in hops:
+                hops.add(shp_node.name)
+                shp_node = graph.nodes.get(shp_node.inputs[0])
+            if shp_node is not None and shp_node.op == "Pack":
+                dims = []
+                for inm in shp_node.inputs:
+                    cv = _cv(inm)
+                    dims.append(-1 if cv is None
+                                else int(np.asarray(cv).reshape(())))
+                if dims.count(-1) <= 1:
+                    # wire ONLY the data tensor: the symbolically-
+                    # converted Pack output must not ride in as a second
+                    # arg (a traced shape breaks reshape under jit)
+                    return mk(Lambda(
+                        lambda x, d=tuple(dims): x.reshape(d),
+                        "reshape_dyn"),
+                        parents=[resolve(*node.input_ports[0])])
             raise NotImplementedError(f"Reshape {node.name}: dynamic shape")
         shape = [int(d) for d in np.asarray(shape).reshape(-1)]
         if shape and shape[0] in (-1, 0):

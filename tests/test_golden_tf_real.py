@@ -564,3 +564,43 @@ def test_convert_cli_accepts_saved_model_dir(tmp_path):
     got, _ = mod.apply(params, state, jnp.asarray(x))
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
                                atol=1e-6)
+
+
+def test_real_keras3_model_via_tf2_freeze():
+    """A MODERN Keras 3 model (conv + pool + BatchNorm + Flatten +
+    Dense) traced with tf.function and frozen imports exactly — BN
+    decomposes into a const rsqrt subgraph (folded through the
+    executor) and Flatten into a batch-dynamic Pack reshape."""
+    import keras
+
+    m = keras.Sequential([
+        keras.layers.Input((16, 16, 3)),
+        keras.layers.Conv2D(8, 3, padding="same", activation="relu"),
+        keras.layers.MaxPooling2D(2),
+        keras.layers.BatchNormalization(),
+        keras.layers.Flatten(),
+        keras.layers.Dense(5, activation="softmax"),
+    ])
+    x = np.random.RandomState(0).rand(2, 16, 16, 3).astype(np.float32)
+    want = m(x).numpy()
+
+    f = tf.function(lambda t: m(t))
+    cf = f.get_concrete_function(tf.TensorSpec((None, 16, 16, 3),
+                                               tf.float32))
+    from tensorflow.python.framework.convert_to_constants import \
+        convert_variables_to_constants_v2
+    gd = convert_variables_to_constants_v2(cf).graph.as_graph_def()
+    inp = [n.name for n in gd.node if n.op == "Placeholder"][0]
+    mod, params, state, _ = to_module(
+        load_graphdef(gd.SerializeToString()), inputs=[inp],
+        outputs=["Identity"])
+    got, _ = mod.apply(params, state, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                               atol=1e-6)
+    # under jit too: the batch-dynamic reshape must close over a static
+    # dims tuple, not trace the Pack output
+    import jax
+    jgot = jax.jit(lambda v: mod.apply(params, state, v)[0])(
+        jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(jgot), want, rtol=1e-5,
+                               atol=1e-6)
