@@ -48,6 +48,9 @@ ELEMENTWISE_UNARY = {
 ELEMENTWISE_BINARY = {
     "Maximum": jnp.maximum, "Minimum": jnp.minimum,
 }
+REDUCE_OPS = {"Mean": jnp.mean, "Sum": jnp.sum, "Max": jnp.max,
+              "Min": jnp.min, "Prod": jnp.prod, "All": jnp.all,
+              "Any": jnp.any}
 
 
 def _parse_tensor(t: pw.Msg) -> np.ndarray:
@@ -284,11 +287,13 @@ class TFGraph:
             return jnp.squeeze(ins[0], axis=tuple(dims) if dims else None)
         if op == "ExpandDims":
             return jnp.expand_dims(ins[0], int(np.asarray(ins[1])))
-        if op == "Mean":
+        if op in REDUCE_OPS:
+            # axis=() is identity (TF semantics for empty indices)
             axes = tuple(int(a) for a in np.asarray(ins[1]).reshape(-1))
             keep = node.attrs.get("keep_dims")
-            return jnp.mean(ins[0], axis=axes,
-                            keepdims=bool(keep.int(5)) if keep else False)
+            return REDUCE_OPS[op](
+                ins[0], axis=axes,
+                keepdims=bool(keep.int(5)) if keep else False)
         if op == "Pad":
             pads = np.asarray(ins[1])
             return jnp.pad(ins[0], [(int(a), int(b)) for a, b in pads])

@@ -26,7 +26,7 @@ from bigdl_tpu.core import init as initializers
 from bigdl_tpu.interop import protowire as pw
 from bigdl_tpu.interop.tensorflow import (ELEMENTWISE_BINARY,
                                           ELEMENTWISE_UNARY, NP_OF_DT,
-                                          TFGraph, TFNode,
+                                          REDUCE_OPS, TFGraph, TFNode,
                                           strided_slice_index)
 
 
@@ -137,14 +137,14 @@ _BINARY_OPS = {
     "LogicalAnd": jnp.logical_and, "LogicalOr": jnp.logical_or,
 }
 
-_REDUCE_OPS = {"Sum": jnp.sum, "Max": jnp.max, "Min": jnp.min,
-               "Prod": jnp.prod, "All": jnp.all, "Any": jnp.any}
+# shared with the graph executor; Mean has its own handler here
+_REDUCE_OPS = {k: v for k, v in REDUCE_OPS.items() if k != "Mean"}
 
 
 # ------------------------------------------------------------ const folding
 _ALIAS_OPS = ("Identity", "StopGradient", "Snapshot")
 # ops with no data inputs that still create graph values (not const/dead)
-_SOURCE_OPS = ("TensorArrayV3",)
+_SOURCE_OPS = ("TensorArrayV3", "TensorListReserve")
 
 
 # never fold these even when inputs are const: placeholders need feeds,
@@ -726,14 +726,28 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
 
     # ------------------------------------------------------- shape/array
     if op == "Shape":
-        return mk(Lambda(lambda x: jnp.asarray(x.shape, jnp.int32), "shape"))
+        # numpy, NOT jnp: under jit even a constant jnp array is a
+        # tracer, and shape chains must stay concrete so Fill/Reshape
+        # targets built from them remain static
+        return mk(Lambda(lambda x: np.asarray(x.shape, np.int32), "shape"))
     if op == "Rank":
-        return mk(Lambda(lambda x: jnp.asarray(x.ndim, jnp.int32), "rank"))
+        return mk(Lambda(lambda x: np.asarray(x.ndim, np.int32), "rank"))
     if op == "Pack":
         axis = attr_int("axis", 0)
         wrap, parents = mixed(len(node.inputs))
-        return mk(Lambda(wrap(lambda *xs, ax=axis: jnp.stack(xs, axis=ax)),
-                         "pack", n_in=len(parents)), parents=parents)
+
+        def do_pack(*xs, ax=axis):
+            # keep shape-domain chains concrete under jit: when NO input
+            # is a tracer (mixed()'s const slots are concrete jax
+            # arrays; the Shape handler emits numpy), stack host-side —
+            # a jnp.stack of concrete values would LIFT to a tracer
+            # inside a trace and break static Fill/Reshape targets
+            import jax.core as _jc
+            if any(isinstance(v, _jc.Tracer) for v in xs):
+                return jnp.stack(xs, axis=ax)
+            return np.stack([np.asarray(v) for v in xs], axis=ax)
+        return mk(Lambda(wrap(do_pack), "pack", n_in=len(parents)),
+                  parents=parents)
     if op == "Tile":
         mult = const(1)
         if mult is None:
@@ -1097,6 +1111,58 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
                               v.reshape((n, ln) + v.shape[1:])),
                          "ta_split", n_in=len(parents)), parents=parents)
 
+    # --------------------- TensorList (TF2's TensorArray successor)
+    # Same flow-as-buffer design, but the HANDLE is the buffer (no
+    # separate flow tensor). Keras 3's LSTM/RNN layers compile to these
+    # around the while frame.
+    if op == "TensorListFromTensor":      # (tensor, element_shape)
+        return resolve(*node.input_ports[0])   # the list IS the tensor
+
+    if op == "TensorListStack":           # (handle, element_shape)
+        return resolve(*node.input_ports[0])   # buffer already stacked
+
+    if op == "TensorListReserve":         # (element_shape, num_elements)
+        nc = const(1)
+        if nc is None:
+            raise NotImplementedError(
+                f"TensorListReserve {node.name}: dynamic num_elements")
+        n = int(np.asarray(nc).reshape(()))
+        dt = NP_OF_DT.get(node.attr_type("element_dtype", 1), np.float32)
+        es = const(0)
+        shape = (n, 0)                    # sentinel; SetItem materializes
+        if es is not None:
+            flat = np.asarray(es).reshape(-1)
+            if flat.size and (flat >= 0).all():
+                shape = (n,) + tuple(int(d) for d in flat)
+        return Lambda(lambda s=shape, d=dt: jnp.zeros(s, d),
+                      "tensor_list", n_in=0)()
+
+    if op == "TensorListGetItem":         # (handle, index, element_shape)
+        wrap, parents = mixed(2)
+        return mk(Lambda(wrap(lambda h, i: lax.dynamic_index_in_dim(
+            h, jnp.asarray(i, jnp.int32).reshape(()), 0, keepdims=False)),
+            "tl_get", n_in=len(parents)), parents=parents)
+
+    if op == "TensorListSetItem":         # (handle, index, item)
+        wrap, parents = mixed(3)
+
+        def tl_set(h, i, v):
+            if h.ndim >= 2 and h.shape[-1] == 0 and v.shape[-1:] != (0,):
+                # reserve-time element_shape was unknown: materialize
+                # from the first written item (TFWhile re-seeds carries)
+                h = jnp.zeros((h.shape[0],) + v.shape, h.dtype)
+            return lax.dynamic_update_index_in_dim(
+                h, v.astype(h.dtype), jnp.asarray(i, jnp.int32).reshape(()),
+                0)
+        return mk(Lambda(wrap(tl_set), "tl_set", n_in=len(parents)),
+                  parents=parents)
+
+    if op == "TensorListLength":
+        wrap, parents = mixed(1)
+        return mk(Lambda(wrap(lambda h: jnp.asarray(h.shape[0],
+                                                    jnp.int32)),
+                         "tl_length", n_in=len(parents)), parents=parents)
+
     if op == "TensorArrayCloseV3":
         return parent[0] if parent else None
 
@@ -1178,10 +1244,21 @@ def _build_layer(graph: TFGraph, node: TFNode, data_ins: List[str],
                   parents=parents)
     if op == "Fill":
         dims = const(0)
-        if dims is None:
-            raise NotImplementedError(f"Fill {node.name}: dynamic dims")
-        shape = tuple(int(d) for d in np.asarray(dims).reshape(-1))
-        return mk(Lambda(lambda v, s=shape: jnp.broadcast_to(v, s), "fill"))
+        if dims is not None:
+            shape = tuple(int(d) for d in np.asarray(dims).reshape(-1))
+            return mk(Lambda(lambda v, s=shape: jnp.broadcast_to(v, s),
+                             "fill"))
+        # dims from a shape chain stay CONCRETE at trace time (x.shape is
+        # static ints, and ops on non-tracers evaluate eagerly) — e.g.
+        # Keras-3 LSTM zero-state Fill(Pack(Shape(x)[0], units), 0).
+        # Genuinely traced dims raise jax's tracer-conversion error.
+        wrap, parents = mixed(2)
+
+        def dyn_fill(d, v):
+            return jnp.broadcast_to(
+                v, tuple(int(e) for e in np.asarray(d).reshape(-1)))
+        return mk(Lambda(wrap(dyn_fill), "fill_dyn", n_in=len(parents)),
+                  parents=parents)
     if op in ("TopK", "TopKV2"):
         if op == "TopKV2":
             kv = const(1)

@@ -604,3 +604,36 @@ def test_real_keras3_model_via_tf2_freeze():
         jnp.asarray(x))
     np.testing.assert_allclose(np.asarray(jgot), want, rtol=1e-5,
                                atol=1e-6)
+
+
+def test_real_keras3_lstm_via_tf2_freeze():
+    """A Keras 3 LSTM (returns sequences) + Dense head, traced and
+    frozen: the recurrence compiles to TensorList ops around a v2-
+    lowered while frame — imports exactly, eager AND jitted."""
+    import jax
+    import keras
+
+    m = keras.Sequential([
+        keras.layers.Input((10, 4)),
+        keras.layers.LSTM(6, return_sequences=True),
+        keras.layers.Dense(3),
+    ])
+    x = np.random.RandomState(0).randn(2, 10, 4).astype(np.float32)
+    want = m(x).numpy()
+    f = tf.function(lambda t: m(t))
+    cf = f.get_concrete_function(tf.TensorSpec((None, 10, 4),
+                                               tf.float32))
+    from tensorflow.python.framework.convert_to_constants import \
+        convert_variables_to_constants_v2
+    gd = convert_variables_to_constants_v2(cf).graph.as_graph_def()
+    inp = [n.name for n in gd.node if n.op == "Placeholder"][0]
+    mod, params, state, _ = to_module(
+        load_graphdef(gd.SerializeToString()), inputs=[inp],
+        outputs=["Identity"])
+    got, _ = mod.apply(params, state, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                               atol=1e-6)
+    jgot = jax.jit(lambda v: mod.apply(params, state, v)[0])(
+        jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(jgot), want, rtol=1e-5,
+                               atol=1e-6)
