@@ -55,12 +55,12 @@ def main():
         try:
             if bs == 128:
                 with jax.profiler.trace(os.path.join(outdir, "profile")):
-                    ips, flops, sec = _bench_resnet50(
+                    ips, flops, sec, _runs = _bench_resnet50(
                         compute_dtype=jnp.bfloat16, batch_size=bs,
                         spatial=224, warmup=3, iters=10)
                 report["profile_dir"] = os.path.join(outdir, "profile")
             else:
-                ips, flops, sec = _bench_resnet50(
+                ips, flops, sec, _runs = _bench_resnet50(
                     compute_dtype=jnp.bfloat16, batch_size=bs,
                     spatial=224, warmup=3, iters=10)
             rec = {"imgs_per_sec": round(ips, 1),
