@@ -127,6 +127,30 @@ def parse_prototxt(text: str) -> PText:
 
 
 # --------------------------------------------------------- converter module
+class CaffeReshape(Module):
+    """Caffe Reshape with NCHW memory semantics on NHWC tensors
+    (reference: utils/caffe/Converter.scala fromCaffeReshape →
+    InferReshape). Caffe reshapes the NCHW-contiguous buffer, so a 4D
+    input is permuted to NCHW first, reshaped (0 copies the input dim,
+    -1 infers, batch slot included), and a 4D result is permuted back to
+    NHWC."""
+
+    def __init__(self, dims, name: Optional[str] = None):
+        super().__init__(name=name)
+        self.dims = tuple(int(d) for d in dims)
+
+    def forward(self, params, x, **_):
+        if x.ndim == 4:
+            x = jnp.transpose(x, (0, 3, 1, 2))
+        in_shape = x.shape
+        out = [in_shape[i] if (d == 0 and i < len(in_shape)) else d
+               for i, d in enumerate(self.dims)]
+        y = jnp.reshape(x, tuple(out))
+        if y.ndim == 4:
+            y = jnp.transpose(y, (0, 2, 3, 1))
+        return y
+
+
 class Scale(Module):
     """Per-channel scale+shift (caffe Scale layer; reference:
     utils/caffe/Converter.scala fromCaffeScale → CMul/CAdd)."""
@@ -162,20 +186,40 @@ def _pool_out(size, k, s, p):
     return ceil_pool_out(size, k, s, p)
 
 
-# V1 (layers { type: CONVOLUTION }) enum → V2 string names
+# V1 (layers { type: CONVOLUTION }) enum → V2 string names — full registry
+# parity with utils/caffe/V1LayerConverter.scala + Converter.scala:631-669
 _V1_TYPES = {
-    "CONVOLUTION": "Convolution", "INNER_PRODUCT": "InnerProduct",
+    "CONVOLUTION": "Convolution", "DECONVOLUTION": "Deconvolution",
+    "INNER_PRODUCT": "InnerProduct", "INNERPRODUCT": "InnerProduct",
     "RELU": "ReLU", "POOLING": "Pooling", "LRN": "LRN",
     "DROPOUT": "Dropout", "SOFTMAX": "Softmax",
-    "SOFTMAX_LOSS": "Softmax", "CONCAT": "Concat", "ELTWISE": "Eltwise",
+    "SOFTMAX_LOSS": "Softmax", "SOFTMAXWITHLOSS": "Softmax",
+    "CONCAT": "Concat", "ELTWISE": "Eltwise",
     "SIGMOID": "Sigmoid", "TANH": "TanH", "FLATTEN": "Flatten",
-    "DATA": "Input", "ACCURACY": "_skip", "SPLIT": "Split",
+    "ABSVAL": "AbsVal", "POWER": "Power", "EXP": "Exp",
+    "THRESHOLD": "Threshold", "SLICE": "Slice", "BNLL": "BNLL",
+    "SIGMOID_CROSS_ENTROPY_LOSS": "Sigmoid",
+    "DATA": "Input", "DUMMY_DATA": "Input", "MEMORY_DATA": "Input",
+    "IMAGE_DATA": "Input", "WINDOW_DATA": "Input", "HDF5_DATA": "Input",
+    "ACCURACY": "_skip", "SILENCE": "_skip", "HDF5_OUTPUT": "_skip",
+    "SPLIT": "Split",
 }
 
 
 def _first_int(param: PText, key: str, default: int) -> int:
     v = param.one(key)
     return int(v) if v is not None else default
+
+
+def _caffe_axis(axis: int, in_shape, lname: str, what: str):
+    """caffe NCHW axis → (our NHWC axis, index into the batchless shape
+    tuple). Batch axis (0) and negative axes are refused."""
+    ax_map = {1: -1, 2: 1, 3: 2}
+    if axis not in ax_map:
+        raise NotImplementedError(
+            f"caffe {what} {lname}: axis={axis} (batch) unsupported")
+    dim_idx = {1: len(in_shape) - 1, 2: 0, 3: 1}[axis]
+    return ax_map[axis], dim_idx
 
 
 def _hw(param: PText, base: str, default: int) -> Tuple[int, int]:
@@ -216,10 +260,14 @@ def load(prototxt_path: str, caffemodel_path: Optional[str] = None,
     dims = [int(d) for d in net.many("input_dim")]
     if not dims and net.one("input_shape") is not None:
         dims = [int(d) for d in net.msg("input_shape").many("dim")]
+    seq_shape = None
     if input_shape is not None:
         h, w, c = input_shape
     elif len(dims) >= 4:
         c, h, w = dims[1], dims[2], dims[3]
+    elif len(dims) == 3:                      # (N, T, D) sequence input
+        h = w = c = None
+        seq_shape = (dims[1], dims[2])
     else:
         h = w = c = None                      # must come from an Input layer
 
@@ -228,15 +276,17 @@ def load(prototxt_path: str, caffemodel_path: Optional[str] = None,
     weights: List[tuple] = []                 # (node, params, state)
     name_map_nodes: List[tuple] = []
 
-    def declare_input(blob, hh, ww, cc):
+    def declare_input(blob, *shape):
         node = Input()
         blobs[blob] = node
-        shapes[blob] = (hh, ww, cc)
+        shapes[blob] = tuple(shape)
         return node
 
     inputs = []
     if input_names and h is not None:
         inputs.append(declare_input(input_names[0], h, w, c))
+    elif input_names and seq_shape is not None:
+        inputs.append(declare_input(input_names[0], *seq_shape))
 
     def mk(blob_out, module, parents, out_shape, p_over=None, s_over=None,
            lname=None):
@@ -263,9 +313,15 @@ def load(prototxt_path: str, caffemodel_path: Optional[str] = None,
         ltype = layer.one("type", "")
         if not isinstance(ltype, str):
             ltype = str(ltype)
+        raw_type = ltype
         ltype = _V1_TYPES.get(ltype, ltype)
         lname = layer.one("name", ltype)
         bottoms = [str(b) for b in layer.many("bottom")]
+        if "LOSS" in raw_type.upper():
+            # loss layers import as their inference activation on the
+            # score bottom only (the label bottom has no blob in this
+            # graph; reference maps SOFTMAX_LOSS etc. the same way)
+            bottoms = bottoms[:1]
         tops = [str(t) for t in layer.many("top")]
         top = tops[0] if tops else lname
         include = layer.one("include")
@@ -273,22 +329,45 @@ def load(prototxt_path: str, caffemodel_path: Optional[str] = None,
             continue
         if ltype in ("_skip", "Accuracy", "Silence"):
             continue
-        if ltype == "Input" or (not bottoms and ltype in ("Data", "HDF5Data")):
-            p = layer.msg("input_param")
-            sh = p.msg("shape")
-            ldims = [int(d) for d in sh.many("dim")]
+        if ltype == "Input" or (not bottoms and ltype in ("Data", "HDF5Data",
+                                                          "DummyData",
+                                                          "MemoryData",
+                                                          "AnnotatedData")):
+            # reference: Converter.scala:663-667 — DATA/DUMMYDATA/
+            # MEMORYDATA/ANNOTATEDDATA all map to input declarations
+            ldims = []
+            for pkey in ("input_param", "dummy_data_param"):
+                sh = layer.msg(pkey).msg("shape")
+                if sh.many("dim"):
+                    ldims = [int(d) for d in sh.many("dim")]
+                    break
+            mp = layer.msg("memory_data_param")
+            if not ldims and mp.one("batch_size") is not None:
+                ldims = [int(mp.one("batch_size", 1)),
+                         int(mp.one("channels", 1)),
+                         int(mp.one("height", 1)), int(mp.one("width", 1))]
             if input_shape is not None:
-                ih, iw, ic = input_shape
+                inputs.append(declare_input(top, *input_shape))
             elif len(ldims) >= 4:
-                ic, ih, iw = ldims[1], ldims[2], ldims[3]
+                inputs.append(declare_input(top, ldims[2], ldims[3],
+                                            ldims[1]))
+            elif len(ldims) == 3:
+                # (N, T, D) sequence input, batch-major (caffe recurrent
+                # blobs are time-major (T, N, D) — the caller transposes)
+                inputs.append(declare_input(top, ldims[1], ldims[2]))
+            elif len(ldims) == 2:
+                inputs.append(declare_input(top, ldims[1]))
             else:
                 raise ValueError(f"Input layer {lname} without dims and no "
                                  f"input_shape given")
-            inputs.append(declare_input(top, ih, iw, ic))
             last_top = top
             continue
         if not bottoms:
             continue
+        if ltype in ("Recurrent", "RNN") and len(bottoms) > 1:
+            raise NotImplementedError(
+                f"caffe {ltype} {lname}: sequence-continuation markers "
+                f"(second bottom) are not supported")
         bot = bottoms[0]
         if bot not in blobs:
             raise ValueError(f"layer {lname}: bottom {bot!r} undefined — "
@@ -417,9 +496,26 @@ def load(prototxt_path: str, caffemodel_path: Optional[str] = None,
         elif ltype == "Eltwise":
             p = layer.msg("eltwise_param")
             op = str(p.one("operation", "SUM"))
-            m = {"SUM": nn.CAddTable, "PROD": nn.CMulTable,
-                 "MAX": nn.CMaxTable}[op]()
-            mk(top, m, parent, in_shape, lname=lname)
+            coeffs = [float(cf) for cf in p.many("coeff")]
+            if coeffs and len(coeffs) != len(parent):
+                raise ValueError(
+                    f"caffe Eltwise {lname}: {len(coeffs)} coeffs for "
+                    f"{len(parent)} bottoms")
+            if op == "SUM" and coeffs and any(cf != 1.0 for cf in coeffs):
+                # reference Converter.scala fromCaffeEltwise: (1,-1) →
+                # CSubTable, general coeffs → scale inputs then add
+                if coeffs == [1.0, -1.0] and len(parent) == 2:
+                    mk(top, nn.CSubTable(), parent, in_shape, lname=lname)
+                else:
+                    scaled = [
+                        mk(f"{top}__c{i}", nn.MulConstant(cf), [pa],
+                           in_shape)
+                        for i, (pa, cf) in enumerate(zip(parent, coeffs))]
+                    mk(top, nn.CAddTable(), scaled, in_shape, lname=lname)
+            else:
+                m = {"SUM": nn.CAddTable, "PROD": nn.CMulTable,
+                     "MAX": nn.CMaxTable}[op]()
+                mk(top, m, parent, in_shape, lname=lname)
         elif ltype == "BatchNorm":
             ic = in_shape[-1]
             p = layer.msg("batch_norm_param")
@@ -448,6 +544,207 @@ def load(prototxt_path: str, caffemodel_path: Optional[str] = None,
         elif ltype == "Flatten":
             ih, iw, ic = in_shape
             mk(top, nn.Flatten(), parent, (ih * iw * ic,), lname=lname)
+        elif ltype == "Deconvolution":
+            # reference: Converter.scala:631-632 DECONVOLUTION →
+            # fromCaffeConvolution; caffe deconv blob is (cin, cout/g, kh, kw)
+            p = layer.msg("convolution_param")
+            cout = _first_int(p, "num_output", 1)
+            if p.one("kernel_size") is not None:
+                kh = kw = int(p.one("kernel_size"))
+            else:
+                kh, kw = _hw(p, "kernel", 1)
+            sh_, sw_ = _hw(p, "stride", 1)
+            ph_, pw_ = _hw(p, "pad", 0)
+            group = _first_int(p, "group", 1)
+            if group != 1:
+                raise NotImplementedError(
+                    f"caffe Deconvolution {lname}: group={group} deconv is "
+                    f"not supported")
+            if _first_int(p, "dilation", 1) != 1:
+                raise NotImplementedError(
+                    f"caffe Deconvolution {lname}: dilated deconvolution "
+                    f"is not supported")
+            bias = bool(p.one("bias_term", True))
+            ih, iw, ic = in_shape
+            oh = sh_ * (ih - 1) + kh - 2 * ph_
+            ow = sw_ * (iw - 1) + kw - 2 * pw_
+            m = nn.SpatialFullConvolution(ic, cout, kw, kh, sw_, sh_,
+                                          pw_, ph_, bias=bias)
+            p_over = {}
+            w0 = blob_w(lname, 0)
+            if w0 is not None:
+                # (cin, cout, kh, kw) -> ours (kh, kw, cin, cout)
+                p_over["weight"] = np.transpose(w0, (2, 3, 0, 1))
+            b0 = blob_w(lname, 1)
+            if bias and b0 is not None:
+                p_over["bias"] = b0.reshape(-1)
+            mk(top, m, parent, (oh, ow, cout), p_over, lname=lname)
+        elif ltype == "PReLU":
+            # reference: Converter.scala fromCaffePreLU — slope count from
+            # blob 0; caffe prelu_param.channel_shared → single slope
+            p = layer.msg("prelu_param")
+            shared = bool(p.one("channel_shared", False))
+            ic = in_shape[-1]
+            m = nn.PReLU(0 if shared else ic)
+            p_over = {}
+            w0 = blob_w(lname, 0)
+            if w0 is not None:
+                p_over["weight"] = w0.reshape(-1)
+            mk(top, m, parent, in_shape, p_over, lname=lname)
+        elif ltype == "ELU":
+            p = layer.msg("elu_param")
+            mk(top, nn.ELU(float(p.one("alpha", 1.0))), parent, in_shape,
+               lname=lname)
+        elif ltype == "Power":
+            # y = (shift + scale*x)^power (Converter.scala fromCaffePower)
+            p = layer.msg("power_param")
+            mk(top, nn.Power(float(p.one("power", 1.0)),
+                             float(p.one("scale", 1.0)),
+                             float(p.one("shift", 0.0))),
+               parent, in_shape, lname=lname)
+        elif ltype == "Exp":
+            # caffe: y = base^(shift + scale*x), base=-1 → e. The reference
+            # drops non-default params (Converter.scala fromCaffeExp →
+            # bare Exp); here they compose exactly:
+            # base^(shift+scale*x) = exp(ln(base)*(shift + scale*x))
+            p = layer.msg("exp_param")
+            base = float(p.one("base", -1.0))
+            scale = float(p.one("scale", 1.0))
+            shift = float(p.one("shift", 0.0))
+            ln_base = 1.0 if base == -1.0 else float(np.log(base))
+            cur = parent
+            if scale * ln_base != 1.0:
+                cur = [mk(f"{top}__scale", nn.MulConstant(scale * ln_base),
+                          cur, in_shape)]
+            if shift * ln_base != 0.0:
+                cur = [mk(f"{top}__shift", nn.AddConstant(shift * ln_base),
+                          cur, in_shape)]
+            mk(top, nn.Exp(), cur, in_shape, lname=lname)
+        elif ltype == "AbsVal":
+            mk(top, nn.Abs(), parent, in_shape, lname=lname)
+        elif ltype == "Threshold":
+            # y = 1 if x > threshold else 0 (Converter.scala
+            # fromCaffeThreshold → BinaryThreshold)
+            p = layer.msg("threshold_param")
+            mk(top, nn.BinaryThreshold(float(p.one("threshold", 1e-6))),
+               parent, in_shape, lname=lname)
+        elif ltype == "BNLL":
+            mk(top, nn.SoftPlus(), parent, in_shape, lname=lname)
+        elif ltype == "Slice":
+            # one Narrow per top along the sliced axis (the reference maps
+            # to SplitTable, Converter.scala fromCaffeSlice; Narrow keeps
+            # each slice an ordinary blob in this graph)
+            p = layer.msg("slice_param")
+            axis = _first_int(p, "axis", 1)
+            pts = [int(sp) for sp in p.many("slice_point")]
+            our_axis, dim_idx = _caffe_axis(axis, in_shape, lname, "Slice")
+            total = in_shape[dim_idx]
+            if pts:
+                starts = [0] + pts
+                ends = pts + [total]
+            else:
+                if total % max(1, len(tops)):
+                    raise ValueError(
+                        f"caffe Slice {lname}: {total} not divisible into "
+                        f"{len(tops)} equal slices")
+                step = total // len(tops)
+                starts = [i * step for i in range(len(tops))]
+                ends = [s + step for s in starts]
+            for t, s0, e0 in zip(tops, starts, ends):
+                osh = list(in_shape)
+                osh[dim_idx] = e0 - s0
+                mk(t, nn.Narrow(our_axis, s0, e0 - s0), parent,
+                   tuple(osh), lname=lname if t == tops[0] else None)
+            last_top = tops[-1]
+            continue
+        elif ltype == "Tile":
+            p = layer.msg("tile_param")
+            axis = _first_int(p, "axis", 1)
+            tiles = _first_int(p, "tiles", 1)
+            our_axis, dim_idx = _caffe_axis(axis, in_shape, lname, "Tile")
+            osh = list(in_shape)
+            osh[dim_idx] = osh[dim_idx] * tiles
+            mk(top, nn.Tile(our_axis, tiles), parent, tuple(osh),
+               lname=lname)
+        elif ltype == "Reshape":
+            # NCHW-semantics reshape (CaffeReshape docstring); shape dims
+            # include the batch slot, 0 copies, -1 infers
+            p = layer.msg("reshape_param")
+            rdims = [int(d) for d in p.msg("shape").many("dim")]
+            if not rdims:
+                raise ValueError(f"caffe Reshape {lname}: no shape dims")
+            nchw_in = ([1] + ([in_shape[2], in_shape[0], in_shape[1]]
+                              if len(in_shape) == 3 else list(in_shape)))
+            total = int(np.prod(nchw_in))
+            out_nchw = [nchw_in[i] if (d == 0 and i < len(nchw_in)) else d
+                        for i, d in enumerate(rdims)]
+            if -1 in out_nchw:
+                known = int(np.prod([d for d in out_nchw if d != -1]))
+                out_nchw[out_nchw.index(-1)] = total // known
+            if len(out_nchw) == 4:
+                osh = (out_nchw[2], out_nchw[3], out_nchw[1])
+            else:
+                osh = tuple(out_nchw[1:])
+            mk(top, CaffeReshape(rdims), parent, osh, lname=lname)
+        elif ltype == "Bias":
+            # learnable broadcast add (Converter.scala fromCaffeBias →
+            # Add(size)); default axis=1/num_axes=1 → per-channel
+            p = layer.msg("bias_param")
+            axis = _first_int(p, "axis", 1)
+            if len(parent) > 1:
+                mk(top, nn.CAddTable(), parent, in_shape, lname=lname)
+            else:
+                if axis != 1:
+                    raise NotImplementedError(
+                        f"caffe Bias {lname}: axis={axis} unsupported "
+                        f"(channel axis only)")
+                ic = in_shape[-1]
+                p_over = {}
+                w0 = blob_w(lname, 0)
+                if w0 is not None:
+                    p_over["bias"] = w0.reshape(-1)
+                mk(top, nn.CAdd((ic,)), parent, in_shape, p_over,
+                   lname=lname)
+        elif ltype in ("Recurrent", "RNN"):
+            # reference Converter.scala fromCaffeRecurrent instantiates a
+            # bare Recurrent container (no cell — unusable as-is); here the
+            # caffe RNN semantics (vanilla tanh RNN, recurrent_param.
+            # num_output) are honored on batch-major (B, T, D) input.
+            # Caffe's sequence-continuation second bottom is refused above.
+            p = layer.msg("recurrent_param")
+            nout = _first_int(p, "num_output", 1)
+            if len(in_shape) != 2:
+                raise ValueError(
+                    f"caffe {ltype} {lname}: needs (T, D) sequence input, "
+                    f"got shape {in_shape}")
+            tlen, dfeat = in_shape
+            m = nn.Recurrent(nn.RnnCell(dfeat, nout))
+            p_over = {}
+            nblobs = len(model_blobs.get(lname, ()))
+            w0, b0, w1 = (blob_w(lname, 0), blob_w(lname, 1),
+                          blob_w(lname, 2))
+            if w0 is not None and w1 is not None:
+                cell_p = {"w_i": w0.reshape(nout, dfeat).T,
+                          "w_h": w1.reshape(nout, nout).T}
+                if b0 is not None:
+                    cell_p["bias"] = b0.reshape(-1)
+                p_over = {"cell": cell_p}
+            node = mk(top if nblobs <= 3 else f"{top}__h", m, parent,
+                      (tlen, nout), p_over, lname=lname)
+            if nblobs == 5:
+                # caffe RNNLayer's output transform: o_t = tanh(W_ho h_t
+                # + b_o) — blobs 3/4
+                who, bo = blob_w(lname, 3), blob_w(lname, 4)
+                oout = who.shape[0]
+                lin = mk(f"{top}__o", nn.Linear(nout, oout), [node],
+                         (tlen, oout),
+                         {"weight": who.reshape(oout, nout).T,
+                          "bias": bo.reshape(-1)})
+                mk(top, nn.Tanh(), [lin], (tlen, oout))
+            elif nblobs == 4:
+                raise NotImplementedError(
+                    f"caffe {ltype} {lname}: unexpected 4-blob layout "
+                    f"(want W_xh, b_h, W_hh [, W_ho, b_o])")
         elif ltype == "Split":
             for t in tops:                    # pure fan-out aliases
                 blobs[t] = blobs[bot]
@@ -464,12 +761,17 @@ def load(prototxt_path: str, caffemodel_path: Optional[str] = None,
     out_node = blobs[last_top]
     g = Graph(inputs, [out_node])
     params, state = g.init(rng if rng is not None else jax.random.PRNGKey(0))
+    def _merge(dst, src):
+        for kname, v in src.items():
+            if isinstance(v, dict):
+                _merge(dst[kname], v)
+            else:
+                dst[kname] = jnp.asarray(np.ascontiguousarray(v))
+
     for node, p_over, s_over in weights:
         key = g._node_key[id(node)]
-        for kname, v in p_over.items():
-            params[key][kname] = jnp.asarray(np.ascontiguousarray(v))
-        for kname, v in s_over.items():
-            state[key][kname] = jnp.asarray(np.ascontiguousarray(v))
+        _merge(params[key], p_over)
+        _merge(state[key], s_over)
     name_map = {nm: g._node_key[id(n)] for nm, n in name_map_nodes
                 if id(n) in g._node_key}
     first = inputs[0]

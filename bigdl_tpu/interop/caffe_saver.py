@@ -32,7 +32,7 @@ import numpy as np
 from bigdl_tpu.core.container import Graph, Sequential
 from bigdl_tpu.core.module import Module
 from bigdl_tpu.interop import protowire as pw
-from bigdl_tpu.interop.caffe_proto import Scale
+from bigdl_tpu.interop.caffe_proto import CaffeReshape, Scale
 from bigdl_tpu.nn.pooling import ceil_pool_out
 
 import bigdl_tpu.nn as nn
@@ -276,6 +276,88 @@ def _emit(s: _Saver, m: Module, p: Dict, st: Dict, bottoms: List[str],
         name = s.fresh("eltwise")
         s.layer(name, "Eltwise", bottoms, name,
                 f"  eltwise_param {{ operation: {op} }}")
+        return name, None
+    if isinstance(m, nn.SpatialFullConvolution):
+        if m.aw or m.ah:
+            raise NotImplementedError(
+                "caffe export: Deconvolution output adjustment (adj_w/"
+                "adj_h) has no Caffe field")
+        name = s.fresh(_base(m, "deconv"))
+        s.layer(name, "Deconvolution", [bot], name, _conv_param(m))
+        blobs = [np.transpose(np.asarray(p["weight"]), (2, 3, 0, 1))]
+        if m.bias:
+            blobs.append(p["bias"])
+        s.blobs(name, blobs)
+        return name, None
+    if isinstance(m, nn.PReLU):
+        if m.alpha_shape is not None:
+            raise NotImplementedError(
+                "caffe export: PReLU with partial shared_axes has no "
+                "Caffe equivalent (channel slopes or one shared slope)")
+        name = s.fresh(_base(m, "prelu"))
+        extra = ("  prelu_param { channel_shared: true }"
+                 if m.nout == 0 else None)
+        s.layer(name, "PReLU", [bot], name, extra)
+        s.blobs(name, [np.asarray(p["weight"])])
+        return name, None
+    if isinstance(m, nn.ELU):
+        name = s.fresh("elu")
+        s.layer(name, "ELU", [bot], name,
+                "  elu_param { " + _txt("alpha", float(m.alpha)) + " }")
+        return name, None
+    if isinstance(m, nn.Power):
+        name = s.fresh("power")
+        s.layer(name, "Power", [bot], name,
+                "  power_param { " + " ".join(
+                    [_txt("power", float(m.power)),
+                     _txt("scale", float(m.scale)),
+                     _txt("shift", float(m.shift))]) + " }")
+        return name, None
+    if type(m) is nn.Exp:
+        name = s.fresh("exp")
+        s.layer(name, "Exp", [bot], name)
+        return name, None
+    if type(m) is nn.Abs:
+        name = s.fresh("abs")
+        s.layer(name, "AbsVal", [bot], name)
+        return name, None
+    if isinstance(m, nn.BinaryThreshold):
+        name = s.fresh("thresh")
+        s.layer(name, "Threshold", [bot], name,
+                "  threshold_param { " + _txt("threshold", float(m.th))
+                + " }")
+        return name, None
+    if type(m) is nn.SoftPlus:
+        if float(getattr(m, "beta", 1.0)) != 1.0:
+            raise NotImplementedError(
+                "caffe export: SoftPlus beta != 1 has no Caffe equivalent "
+                "(BNLL is beta=1)")
+        name = s.fresh("bnll")
+        s.layer(name, "BNLL", [bot], name)
+        return name, None
+    if isinstance(m, nn.Tile):
+        ax = {3: 1, -1: 1, 1: 2, 2: 3}.get(m.dim)
+        if ax is None:
+            raise NotImplementedError(
+                "caffe export: Tile over the batch dim has no Caffe axis")
+        name = s.fresh("tile")
+        s.layer(name, "Tile", [bot], name,
+                "  tile_param { " + " ".join(
+                    [_txt("axis", ax), _txt("tiles", m.copies)]) + " }")
+        return name, None
+    if isinstance(m, nn.CAdd):
+        if len(m.shape) != 1:
+            raise NotImplementedError(
+                "caffe export: Bias maps per-channel CAdd only")
+        name = s.fresh(_base(m, "bias"))
+        s.layer(name, "Bias", [bot], name)
+        s.blobs(name, [np.asarray(p["bias"])])
+        return name, None
+    if isinstance(m, CaffeReshape):
+        name = s.fresh("reshape")
+        dims = " ".join(_txt("dim", int(d)) for d in m.dims)
+        s.layer(name, "Reshape", [bot], name,
+                "  reshape_param { shape { " + dims + " } }")
         return name, None
     _UNARY = {nn.ReLU: "ReLU", nn.Sigmoid: "Sigmoid", nn.Tanh: "TanH"}
     for cls, ltype in _UNARY.items():

@@ -1,0 +1,459 @@
+"""Caffe converter vocabulary closure (VERDICT r4 item 3): every layer
+type registered by the reference (utils/caffe/Converter.scala:631-669 +
+V1LayerConverter.scala) either imports with verified numerics or raises a
+documented refusal. Oracles: torch where the op exists there, hand math
+otherwise (the keras_loader2 pattern)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from bigdl_tpu.interop import protowire as pw
+
+
+def _write_caffemodel(path, weights, net="n"):
+    body = pw.field_str(1, net)
+    for lname, blobs in weights.items():
+        layer = pw.field_str(1, lname)
+        for b in blobs:
+            b = np.asarray(b, np.float32)
+            blob = pw.field_bytes(7, pw.field_packed_ints(1, list(b.shape)))
+            blob += pw.field_packed_floats(5, b.reshape(-1).tolist())
+            layer += pw.field_bytes(7, blob)
+        body += pw.field_bytes(100, layer)
+    with open(path, "wb") as fh:
+        fh.write(body)
+
+
+def _load(tmp_path, proto_text, weights=None, **kw):
+    from bigdl_tpu.interop.caffe_proto import load
+    p = tmp_path / "net.prototxt"
+    p.write_text(proto_text)
+    cm = None
+    if weights:
+        cm = str(tmp_path / "net.caffemodel")
+        _write_caffemodel(cm, weights)
+    return load(str(p), cm, **kw)
+
+
+_HDR = '''
+input: "data"
+input_dim: 1 input_dim: 3 input_dim: 6 input_dim: 6
+'''
+
+
+def _run(cn, x):
+    out, _ = cn.module.apply(cn.params, cn.state, jnp.asarray(x),
+                             training=False)
+    return np.asarray(out)
+
+
+# ---------------------------------------------------------------- Deconv
+def test_deconvolution_matches_torch(tmp_path):
+    torch = pytest.importorskip("torch")
+    r = np.random.RandomState(0)
+    w = r.randn(3, 5, 3, 3).astype(np.float32) * 0.3   # (cin, cout, kh, kw)
+    b = r.randn(5).astype(np.float32) * 0.1
+    cn = _load(tmp_path, _HDR + '''
+layer { name: "up" type: "Deconvolution" bottom: "data" top: "up"
+  convolution_param { num_output: 5 kernel_size: 3 stride: 2 pad: 1 } }
+''', {"up": [w, b]})
+    x = r.randn(2, 6, 6, 3).astype(np.float32)
+    out = _run(cn, x)
+    tx = torch.from_numpy(x).permute(0, 3, 1, 2)
+    ref = torch.conv_transpose2d(tx, torch.from_numpy(w),
+                                 torch.from_numpy(b), stride=2, padding=1)
+    ref = ref.permute(0, 2, 3, 1).numpy()
+    assert out.shape == ref.shape == (2, 11, 11, 5)
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_deconvolution_group_refused(tmp_path):
+    with pytest.raises(NotImplementedError, match="group"):
+        _load(tmp_path, _HDR + '''
+layer { name: "up" type: "Deconvolution" bottom: "data" top: "up"
+  convolution_param { num_output: 6 kernel_size: 3 group: 3 } }
+''')
+
+
+# ----------------------------------------------------------------- PReLU
+def test_prelu_matches_torch(tmp_path):
+    torch = pytest.importorskip("torch")
+    r = np.random.RandomState(1)
+    slopes = (r.rand(3).astype(np.float32) * 0.5).reshape(3)
+    cn = _load(tmp_path, _HDR + '''
+layer { name: "act" type: "PReLU" bottom: "data" top: "act" }
+''', {"act": [slopes]})
+    x = r.randn(2, 6, 6, 3).astype(np.float32)
+    out = _run(cn, x)
+    tx = torch.from_numpy(x).permute(0, 3, 1, 2)
+    ref = torch.nn.functional.prelu(tx, torch.from_numpy(slopes))
+    np.testing.assert_allclose(out, ref.permute(0, 2, 3, 1).numpy(),
+                               atol=1e-6)
+
+
+def test_prelu_channel_shared(tmp_path):
+    r = np.random.RandomState(2)
+    cn = _load(tmp_path, _HDR + '''
+layer { name: "act" type: "PReLU" bottom: "data" top: "act"
+  prelu_param { channel_shared: true } }
+''', {"act": [np.asarray([0.1], np.float32)]})
+    x = r.randn(2, 6, 6, 3).astype(np.float32)
+    np.testing.assert_allclose(_run(cn, x), np.where(x >= 0, x, 0.1 * x),
+                               atol=1e-6)
+
+
+# ------------------------------------------------------- ELU / unary ops
+def test_elu_matches_torch(tmp_path):
+    torch = pytest.importorskip("torch")
+    r = np.random.RandomState(3)
+    cn = _load(tmp_path, _HDR + '''
+layer { name: "act" type: "ELU" bottom: "data" top: "act"
+  elu_param { alpha: 0.7 } }
+''')
+    x = r.randn(2, 6, 6, 3).astype(np.float32)
+    ref = torch.nn.functional.elu(torch.from_numpy(x), alpha=0.7).numpy()
+    np.testing.assert_allclose(_run(cn, x), ref, atol=1e-6)
+
+
+def test_power_hand_math(tmp_path):
+    r = np.random.RandomState(4)
+    cn = _load(tmp_path, _HDR + '''
+layer { name: "pw" type: "Power" bottom: "data" top: "pw"
+  power_param { power: 2.0 scale: 0.5 shift: 1.0 } }
+''')
+    x = r.rand(2, 6, 6, 3).astype(np.float32)
+    np.testing.assert_allclose(_run(cn, x), (1.0 + 0.5 * x) ** 2.0,
+                               rtol=1e-5)
+
+
+def test_exp_base_scale_shift(tmp_path):
+    """Caffe Exp is base^(shift+scale*x); the reference drops the params
+    (Converter.scala fromCaffeExp) — here they must compose exactly."""
+    r = np.random.RandomState(5)
+    cn = _load(tmp_path, _HDR + '''
+layer { name: "e" type: "Exp" bottom: "data" top: "e"
+  exp_param { base: 2.0 scale: 0.5 shift: 0.25 } }
+''')
+    x = r.randn(2, 6, 6, 3).astype(np.float32)
+    np.testing.assert_allclose(_run(cn, x),
+                               2.0 ** (0.25 + 0.5 * x), rtol=1e-4)
+    cn2 = _load(tmp_path, _HDR + '''
+layer { name: "e" type: "Exp" bottom: "data" top: "e" }
+''')
+    np.testing.assert_allclose(_run(cn2, x), np.exp(x), rtol=1e-5)
+
+
+def test_absval_and_threshold(tmp_path):
+    r = np.random.RandomState(6)
+    x = r.randn(2, 6, 6, 3).astype(np.float32)
+    cn = _load(tmp_path, _HDR + '''
+layer { name: "a" type: "AbsVal" bottom: "data" top: "a" }
+''')
+    np.testing.assert_allclose(_run(cn, x), np.abs(x), atol=1e-7)
+    cn = _load(tmp_path, _HDR + '''
+layer { name: "t" type: "Threshold" bottom: "data" top: "t"
+  threshold_param { threshold: 0.3 } }
+''')
+    np.testing.assert_allclose(_run(cn, x), (x > 0.3).astype(np.float32))
+
+
+def test_bnll_matches_softplus(tmp_path):
+    torch = pytest.importorskip("torch")
+    r = np.random.RandomState(12)
+    x = r.randn(2, 6, 6, 3).astype(np.float32)
+    cn = _load(tmp_path, _HDR + '''
+layer { name: "b" type: "BNLL" bottom: "data" top: "b" }
+''')
+    ref = torch.nn.functional.softplus(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(_run(cn, x), ref, atol=1e-5)
+
+
+# ---------------------------------------------------- Slice / Tile / etc.
+def test_slice_equal_and_slice_points(tmp_path):
+    r = np.random.RandomState(7)
+    x = r.randn(2, 6, 6, 4).astype(np.float32)
+    proto = '''
+input: "data"
+input_dim: 1 input_dim: 4 input_dim: 6 input_dim: 6
+layer { name: "sl" type: "Slice" bottom: "data"
+  top: "a" top: "b" }
+layer { name: "cat" type: "Concat" bottom: "b" bottom: "a" top: "cat" }
+'''
+    cn = _load(tmp_path, proto)
+    out = _run(cn, x)
+    np.testing.assert_allclose(
+        out, np.concatenate([x[..., 2:], x[..., :2]], -1), atol=1e-7)
+
+    proto_pts = '''
+input: "data"
+input_dim: 1 input_dim: 4 input_dim: 6 input_dim: 6
+layer { name: "sl" type: "Slice" bottom: "data" top: "a" top: "b"
+  slice_param { axis: 1 slice_point: 1 } }
+layer { name: "cat" type: "Concat" bottom: "b" bottom: "a" top: "cat" }
+'''
+    cn = _load(tmp_path, proto_pts)
+    np.testing.assert_allclose(
+        _run(cn, x), np.concatenate([x[..., 1:], x[..., :1]], -1),
+        atol=1e-7)
+
+
+def test_tile_channels(tmp_path):
+    r = np.random.RandomState(8)
+    x = r.randn(2, 6, 6, 3).astype(np.float32)
+    cn = _load(tmp_path, _HDR + '''
+layer { name: "t" type: "Tile" bottom: "data" top: "t"
+  tile_param { axis: 1 tiles: 3 } }
+''')
+    np.testing.assert_allclose(_run(cn, x), np.tile(x, (1, 1, 1, 3)),
+                               atol=1e-7)
+
+
+def test_reshape_nchw_semantics(tmp_path):
+    """Caffe Reshape operates on the NCHW-contiguous buffer — the import
+    must permute, reshape, and permute back (CaffeReshape)."""
+    torch = pytest.importorskip("torch")
+    r = np.random.RandomState(9)
+    x = r.randn(2, 6, 6, 4).astype(np.float32)
+    cn = _load(tmp_path, '''
+input: "data"
+input_dim: 1 input_dim: 4 input_dim: 6 input_dim: 6
+layer { name: "rs" type: "Reshape" bottom: "data" top: "rs"
+  reshape_param { shape { dim: 0 dim: 2 dim: 12 dim: 6 } } }
+''')
+    out = _run(cn, x)
+    ref = (torch.from_numpy(x).permute(0, 3, 1, 2).reshape(2, 2, 12, 6)
+           .permute(0, 2, 3, 1).numpy())
+    assert out.shape == (2, 12, 6, 2)
+    np.testing.assert_allclose(out, ref, atol=1e-7)
+
+    cn2 = _load(tmp_path, '''
+input: "data"
+input_dim: 1 input_dim: 4 input_dim: 6 input_dim: 6
+layer { name: "rs" type: "Reshape" bottom: "data" top: "rs"
+  reshape_param { shape { dim: 0 dim: -1 } } }
+''')
+    out2 = _run(cn2, x)
+    ref2 = torch.from_numpy(x).permute(0, 3, 1, 2).reshape(2, -1).numpy()
+    np.testing.assert_allclose(out2, ref2, atol=1e-7)
+
+
+def test_bias_layer(tmp_path):
+    r = np.random.RandomState(10)
+    x = r.randn(2, 6, 6, 3).astype(np.float32)
+    bias = r.randn(3).astype(np.float32)
+    cn = _load(tmp_path, _HDR + '''
+layer { name: "b" type: "Bias" bottom: "data" top: "b" }
+''', {"b": [bias]})
+    np.testing.assert_allclose(_run(cn, x), x + bias, atol=1e-6)
+
+
+def test_eltwise_coeff_sub_and_general(tmp_path):
+    r = np.random.RandomState(11)
+    x = r.randn(2, 6, 6, 3).astype(np.float32)
+    base = '''
+input: "data"
+input_dim: 1 input_dim: 3 input_dim: 6 input_dim: 6
+layer { name: "sp" type: "Split" bottom: "data" top: "d1" top: "d2" }
+layer { name: "a1" type: "AbsVal" bottom: "d1" top: "a1" }
+layer { name: "s1" type: "Sigmoid" bottom: "d2" top: "s1" }
+'''
+    cn = _load(tmp_path, base + '''
+layer { name: "e" type: "Eltwise" bottom: "a1" bottom: "s1" top: "e"
+  eltwise_param { operation: SUM coeff: 1.0 coeff: -1.0 } }
+''')
+    sig = 1 / (1 + np.exp(-x))
+    np.testing.assert_allclose(_run(cn, x), np.abs(x) - sig, atol=1e-5)
+
+    cn = _load(tmp_path, base + '''
+layer { name: "e" type: "Eltwise" bottom: "a1" bottom: "s1" top: "e"
+  eltwise_param { operation: SUM coeff: 0.5 coeff: 2.0 } }
+''')
+    np.testing.assert_allclose(_run(cn, x), 0.5 * np.abs(x) + 2.0 * sig,
+                               atol=1e-5)
+
+
+# ------------------------------------------------------------- Recurrent
+def test_rnn_matches_torch(tmp_path):
+    """Caffe RNN (vanilla tanh, recurrent_param.num_output) on batch-major
+    (B, T, D) input vs torch.nn.RNN. Blob order: W_xh, b, W_hh."""
+    torch = pytest.importorskip("torch")
+    r = np.random.RandomState(13)
+    T, D, H = 5, 4, 3
+    wx = r.randn(H, D).astype(np.float32) * 0.4
+    wh = r.randn(H, H).astype(np.float32) * 0.4
+    b = r.randn(H).astype(np.float32) * 0.1
+    cn = _load(tmp_path, '''
+input: "data"
+input_dim: 1 input_dim: 5 input_dim: 4
+layer { name: "rnn" type: "RNN" bottom: "data" top: "rnn"
+  recurrent_param { num_output: 3 } }
+''', {"rnn": [wx, b, wh]})
+    x = r.randn(2, T, D).astype(np.float32)
+    out = _run(cn, x)
+
+    ref = torch.nn.RNN(D, H, batch_first=True)
+    with torch.no_grad():
+        ref.weight_ih_l0.copy_(torch.from_numpy(wx))
+        ref.weight_hh_l0.copy_(torch.from_numpy(wh))
+        ref.bias_ih_l0.copy_(torch.from_numpy(b))
+        ref.bias_hh_l0.zero_()
+    want, _ = ref(torch.from_numpy(x))
+    assert out.shape == (2, T, H)
+    np.testing.assert_allclose(out, want.detach().numpy(), atol=1e-5)
+
+
+def test_rnn_output_transform_blobs(tmp_path):
+    """Caffe RNNLayer stores 5 blobs — W_xh, b_h, W_hh, W_ho, b_o — with
+    o_t = tanh(W_ho h_t + b_o); the import must apply the output
+    transform, not return raw hidden states."""
+    torch = pytest.importorskip("torch")
+    r = np.random.RandomState(17)
+    T, D, H, O = 4, 3, 5, 2
+    wx = r.randn(H, D).astype(np.float32) * 0.4
+    wh = r.randn(H, H).astype(np.float32) * 0.4
+    b = r.randn(H).astype(np.float32) * 0.1
+    who = r.randn(O, H).astype(np.float32) * 0.4
+    bo = r.randn(O).astype(np.float32) * 0.1
+    cn = _load(tmp_path, '''
+input: "data"
+input_dim: 1 input_dim: 4 input_dim: 3
+layer { name: "rnn" type: "RNN" bottom: "data" top: "rnn"
+  recurrent_param { num_output: 5 } }
+''', {"rnn": [wx, b, wh, who, bo]})
+    x = r.randn(2, T, D).astype(np.float32)
+    out = _run(cn, x)
+
+    ref = torch.nn.RNN(D, H, batch_first=True)
+    with torch.no_grad():
+        ref.weight_ih_l0.copy_(torch.from_numpy(wx))
+        ref.weight_hh_l0.copy_(torch.from_numpy(wh))
+        ref.bias_ih_l0.copy_(torch.from_numpy(b))
+        ref.bias_hh_l0.zero_()
+        h, _ = ref(torch.from_numpy(x))
+        want = torch.tanh(h @ torch.from_numpy(who).T
+                          + torch.from_numpy(bo)).numpy()
+    assert out.shape == (2, T, O)
+    np.testing.assert_allclose(out, want, atol=1e-5)
+
+
+def test_eltwise_coeff_count_mismatch_refused(tmp_path):
+    with pytest.raises(ValueError, match="coeffs"):
+        _load(tmp_path, '''
+input: "data"
+input_dim: 1 input_dim: 3 input_dim: 6 input_dim: 6
+layer { name: "sp" type: "Split" bottom: "data" top: "d1" top: "d2"
+  top: "d3" }
+layer { name: "e" type: "Eltwise" bottom: "d1" bottom: "d2" bottom: "d3"
+  top: "e" eltwise_param { operation: SUM coeff: 1.0 coeff: -1.0 } }
+''')
+
+
+def test_dilated_deconv_refused(tmp_path):
+    with pytest.raises(NotImplementedError, match="dilated"):
+        _load(tmp_path, _HDR + '''
+layer { name: "up" type: "Deconvolution" bottom: "data" top: "up"
+  convolution_param { num_output: 5 kernel_size: 3 dilation: 2 } }
+''')
+
+
+def test_v1_loss_layer_two_bottoms(tmp_path):
+    """A V1 train prototxt's 2-bottom loss layer imports as its inference
+    activation on the score bottom; the (undeclared) label bottom must
+    not crash the load."""
+    r = np.random.RandomState(18)
+    cn = _load(tmp_path, '''
+input: "data"
+input_dim: 1 input_dim: 3 input_dim: 6 input_dim: 6
+layers { name: "a" type: ABSVAL bottom: "data" top: "a" }
+layers { name: "loss" type: SOFTMAX_LOSS bottom: "a" bottom: "label"
+  top: "loss" }
+''')
+    x = r.randn(2, 6, 6, 3).astype(np.float32)
+    out = _run(cn, x)
+    np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-5)
+
+
+def test_rnn_cont_markers_refused(tmp_path):
+    with pytest.raises(NotImplementedError, match="continuation"):
+        _load(tmp_path, '''
+input: "data"
+input_dim: 1 input_dim: 5 input_dim: 4
+input: "cont"
+input_dim: 1 input_dim: 5
+layer { name: "rnn" type: "RNN" bottom: "data" bottom: "cont" top: "rnn"
+  recurrent_param { num_output: 3 } }
+''')
+
+
+# ----------------------------------------------- V1 format + DummyData
+def test_v1_enum_vocabulary(tmp_path):
+    """V1 `layers { type: ENUM }` spellings route through the same
+    converters (V1LayerConverter.scala parity)."""
+    r = np.random.RandomState(14)
+    w = r.randn(3, 5, 3, 3).astype(np.float32) * 0.3
+    cn = _load(tmp_path, '''
+input: "data"
+input_dim: 1 input_dim: 3 input_dim: 6 input_dim: 6
+layers { name: "up" type: DECONVOLUTION bottom: "data" top: "up"
+  convolution_param { num_output: 5 kernel_size: 3 bias_term: false } }
+layers { name: "p" type: POWER bottom: "up" top: "p"
+  power_param { power: 1.0 scale: 2.0 } }
+layers { name: "a" type: ABSVAL bottom: "p" top: "a" }
+layers { name: "acc" type: ACCURACY bottom: "a" top: "acc" }
+''', {"up": [w]})
+    x = r.randn(1, 6, 6, 3).astype(np.float32)
+    out = _run(cn, x)
+    assert out.shape == (1, 8, 8, 5) and (out >= 0).all()
+
+
+def test_dummydata_input(tmp_path):
+    cn = _load(tmp_path, '''
+layer { name: "data" type: "DummyData" top: "data"
+  dummy_data_param { shape { dim: 1 dim: 3 dim: 6 dim: 6 } } }
+layer { name: "a" type: "AbsVal" bottom: "data" top: "a" }
+''')
+    assert cn.input_shape == (6, 6, 3)
+    r = np.random.RandomState(15)
+    x = r.randn(2, 6, 6, 3).astype(np.float32)
+    np.testing.assert_allclose(_run(cn, x), np.abs(x), atol=1e-7)
+
+
+# -------------------------------------------------- round-trip (save→load)
+def test_prelu_deconv_roundtrip(tmp_path):
+    """VERDICT r4 item 3 'done' bar: a PReLU+Deconv net round-trips
+    through our own prototxt+caffemodel writer and re-imports with equal
+    outputs."""
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.interop import caffe_proto
+    from bigdl_tpu.interop.caffe_saver import save_caffe
+    import jax
+
+    model = nn.Sequential(
+        nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1),
+        nn.PReLU(4),
+        nn.SpatialFullConvolution(4, 3, 3, 3, 2, 2, 1, 1),
+        nn.ELU(0.5),
+        nn.Power(2.0, 1.0, 0.5),
+    )
+    params, state = model.init(jax.random.PRNGKey(0))
+    r = np.random.RandomState(16)
+    params["1"]["weight"] = jnp.asarray(r.rand(4).astype(np.float32) * 0.5)
+
+    proto = str(tmp_path / "m.prototxt")
+    cm = str(tmp_path / "m.caffemodel")
+    x = jnp.asarray(r.randn(2, 6, 6, 3).astype(np.float32))
+    save_caffe(proto, cm, model, params, state, example_input=x)
+
+    cn = caffe_proto.load(proto, cm)
+    want, _ = model.apply(params, state, x, training=False)
+    got = _run(cn, np.asarray(x))
+    np.testing.assert_allclose(got, np.asarray(want), atol=1e-5)
+
+
+def test_unknown_type_still_refuses(tmp_path):
+    with pytest.raises(NotImplementedError, match="no converter"):
+        _load(tmp_path, _HDR + '''
+layer { name: "x" type: "Embed" bottom: "data" top: "x" }
+''')
