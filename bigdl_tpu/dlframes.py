@@ -30,8 +30,10 @@ class DLEstimator:
                  feature_size: Sequence[int], label_size: Sequence[int] = (),
                  features_col: str = "features", label_col: str = "label",
                  batch_size: int = 32, max_epoch: int = 10,
-                 optim_method=None, learning_rate: Optional[float] = None):
+                 optim_method=None, learning_rate: Optional[float] = None,
+                 mesh=None):
         self.model, self.criterion = model, criterion
+        self.mesh = mesh
         self.feature_size = tuple(feature_size)
         self.label_size = tuple(label_size)
         self.features_col, self.label_col = features_col, label_col
@@ -54,14 +56,21 @@ class DLEstimator:
         method = self.optim_method or SGD(self.learning_rate or 1e-2,
                                           momentum=0.9)
         ds = ArrayDataSet(x, y, self.batch_size, drop_last=True)
-        opt = Optimizer(self.model, ds, self.criterion, method)
+        if self.mesh is not None:
+            # reference: DLEstimator.scala:163 — fit IS the distributed
+            # optimizer; here the mesh-parallel trainer
+            from bigdl_tpu.parallel.distri import DistriOptimizer
+            opt = DistriOptimizer(self.model, ds, self.criterion, method,
+                                  mesh=self.mesh)
+        else:
+            opt = Optimizer(self.model, ds, self.criterion, method)
         opt.set_end_when(Trigger.max_epoch(self.max_epoch))
         params, state = opt.optimize()
         return self._make_model(params, state)
 
     def _make_model(self, params, state) -> "DLModel":
         return DLModel(self.model, params, state, self.feature_size,
-                       features_col=self.features_col)
+                       features_col=self.features_col, mesh=self.mesh)
 
 
 class DLModel:
@@ -72,16 +81,18 @@ class DLModel:
                  feature_size: Sequence[int],
                  features_col: str = "features",
                  prediction_col: str = "prediction",
-                 batch_size: int = 128):
+                 batch_size: int = 128, mesh=None):
         self.model, self.params, self.state = model, params, state
         self.feature_size = tuple(feature_size)
         self.features_col, self.prediction_col = features_col, prediction_col
         self.batch_size = batch_size
+        self.mesh = mesh
 
     def _predict(self, x: np.ndarray) -> np.ndarray:
         from bigdl_tpu.optim.predictor import Predictor
         return Predictor(self.model, self.params, self.state,
-                         batch_size=self.batch_size).predict(x)
+                         batch_size=self.batch_size,
+                         mesh=self.mesh).predict(x)
 
     def _post(self, out: np.ndarray) -> np.ndarray:
         return out
@@ -112,7 +123,8 @@ class DLClassifier(DLEstimator):
     def _make_model(self, params, state):
         return DLClassifierModel(self.model, params, state,
                                  self.feature_size,
-                                 features_col=self.features_col)
+                                 features_col=self.features_col,
+                                 mesh=self.mesh)
 
 
 class DLClassifierModel(DLModel):

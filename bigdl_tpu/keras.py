@@ -97,14 +97,27 @@ class KerasModel:
 
     def fit(self, x: np.ndarray, y: np.ndarray, batch_size: int = 32,
             nb_epoch: int = 10, validation_data: Optional[Tuple] = None,
-            shuffle: bool = True, seed: int = 1):
-        """(reference: Topology.scala:89 fit)."""
+            shuffle: bool = True, seed: int = 1, mesh=None,
+            rules=None, zero1: bool = True, compute_dtype=None):
+        """(reference: Topology.scala:89 fit — there, `fit` IS the
+        distributed optimizer). Pass `mesh` (jax.sharding.Mesh) to train
+        with the mesh-parallel DistriOptimizer — batch sharded over the
+        'data' axis, ZeRO-1 slots, optional TP `rules` — instead of the
+        single-device Optimizer; results match the local trajectory (the
+        distri≡local oracle, tests/test_keras_mesh.py)."""
         if self.criterion is None:
             raise RuntimeError("call compile() before fit()")
         ds = ArrayDataSet(np.asarray(x), np.asarray(y), batch_size,
                           shuffle=shuffle, drop_last=True, seed=seed)
-        opt = Optimizer(self.module, ds, self.criterion, self.optim_method,
-                        seed=seed)
+        if mesh is not None:
+            from bigdl_tpu.parallel.distri import DistriOptimizer
+            opt = DistriOptimizer(self.module, ds, self.criterion,
+                                  self.optim_method, mesh=mesh,
+                                  rules=rules, zero1=zero1,
+                                  compute_dtype=compute_dtype, seed=seed)
+        else:
+            opt = Optimizer(self.module, ds, self.criterion,
+                            self.optim_method, seed=seed)
         opt.set_end_when(Trigger.max_epoch(nb_epoch))
         if validation_data is not None and self.metrics:
             vx, vy = validation_data

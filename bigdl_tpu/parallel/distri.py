@@ -122,6 +122,11 @@ class DistriOptimizer(Optimizer):
     def _place_array(self, x):
         import numpy as np
         x = np.asarray(x)
+        if self._data_axis_size > 1 and x.shape[0] % self._data_axis_size:
+            raise ValueError(
+                f"global batch of {x.shape[0]} rows does not divide over "
+                f"the {self._data_axis_size}-way data axis — use a "
+                f"batch_size that is a multiple of {self._data_axis_size}")
         sh = self._batch_sharding(x)
         if jax.process_count() > 1:
             return jax.make_array_from_process_local_data(sh, x)
@@ -150,4 +155,17 @@ class DistriOptimizer(Optimizer):
     def _build_eval_fn(self):
         eval_fn = jax.jit(
             lambda p, s, x: self.model.apply(p, s, x, training=False)[0])
-        return lambda p, s, x: eval_fn(p, s, self._place_array(x))
+
+        def run(p, s, x):
+            # validation tails need not divide the data axis: pad
+            # (repeat-last) to the next multiple, slice the rows back
+            import numpy as np
+            x = np.asarray(x)
+            n = x.shape[0]
+            pad = -n % self._data_axis_size
+            if pad:
+                x = np.concatenate([x, np.repeat(x[-1:], pad, axis=0)], 0)
+            out = eval_fn(p, s, self._place_array(x))
+            return out[:n]
+
+        return run

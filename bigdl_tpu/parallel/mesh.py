@@ -88,6 +88,19 @@ def composed_data_axis(mesh) -> "Optional[str]":
     return DATA_AXIS if DATA_AXIS in mesh.axis_names else None
 
 
+def data_axis_size(mesh) -> int:
+    """Size of the composed batch axis (1 when the mesh has none)."""
+    ax = composed_data_axis(mesh)
+    return mesh.shape[ax] if ax else 1
+
+
+def round_up_to_data_multiple(n: int, mesh) -> int:
+    """Smallest multiple of the data-axis size ≥ n — the padding rule
+    batch-sharded inference uses so every padded batch shards evenly."""
+    k = data_axis_size(mesh)
+    return -(-n // k) * k
+
+
 def host_array_to_global(arr, mesh, spec):
     """Place a host array (identical on every process) as a global array
     sharded by `spec` over `mesh` — multi-host safe for ANY mesh rank:

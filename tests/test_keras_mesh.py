@@ -1,0 +1,156 @@
+"""High-level APIs on the device mesh (VERDICT r4 item 4): keras fit,
+Predictor, PredictionService, and DLEstimator reach the mesh-parallel
+engine the way the reference's user-facing entry points ARE the
+distributed engine (nn/keras/Topology.scala:89, optim/Predictor.scala:
+35-260, dlframes/DLEstimator.scala:163). Oracle: distri ≡ local — same
+seed + data must land on the local path's numbers."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.parallel.mesh import create_mesh
+
+
+def _toy(n=128, dim=8, classes=4, seed=0):
+    r = np.random.RandomState(seed)
+    x = r.randn(n, dim).astype(np.float32)
+    w = r.randn(dim, classes).astype(np.float32)
+    y = np.argmax(x @ w + 0.1 * r.randn(n, classes), -1).astype(np.int32)
+    return x, y
+
+
+def _mlp():
+    return nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4),
+                         nn.LogSoftMax())
+
+
+class TestKerasFitMesh:
+    def test_fit_mesh_matches_local_trajectory(self):
+        """keras fit(mesh=) must reproduce the local fit's parameters —
+        the distri≡local oracle pattern of tests/test_parallel.py."""
+        from bigdl_tpu.keras import KerasModel
+
+        x, y = _toy()
+        local = KerasModel(_mlp()).compile("sgd",
+                                           "sparse_categorical_crossentropy")
+        local.fit(x, y, batch_size=32, nb_epoch=2, shuffle=False, seed=3)
+
+        mesh = create_mesh(drop_trivial_axes=True)
+        dist = KerasModel(_mlp()).compile("sgd",
+                                          "sparse_categorical_crossentropy")
+        dist.fit(x, y, batch_size=32, nb_epoch=2, shuffle=False, seed=3,
+                 mesh=mesh)
+
+        for a, b in zip(jax.tree.leaves(local.params),
+                        jax.tree.leaves(dist.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_fit_mesh_then_evaluate_predict(self):
+        from bigdl_tpu.keras import KerasModel
+
+        x, y = _toy(n=256)
+        mesh = create_mesh(drop_trivial_axes=True)
+        m = KerasModel(_mlp()).compile(
+            "adam", "sparse_categorical_crossentropy", ["accuracy"])
+        m.fit(x, y, batch_size=32, nb_epoch=25, mesh=mesh)
+        (res,) = m.evaluate(x, y).values()
+        assert res.result > 0.8
+        probs = m.predict(x[:10])
+        assert probs.shape == (10, 4)
+
+
+class TestKerasFitMeshEdges:
+    def test_ragged_validation_tail(self):
+        """validation_data whose row count does not divide the data axis
+        must evaluate (padded internally), not crash the first epoch."""
+        from bigdl_tpu.keras import KerasModel
+
+        x, y = _toy(n=128)
+        vx, vy = _toy(n=53, seed=9)          # 53 % 8 != 0
+        mesh = create_mesh(drop_trivial_axes=True)
+        m = KerasModel(_mlp()).compile(
+            "sgd", "sparse_categorical_crossentropy", ["accuracy"])
+        m.fit(x, y, batch_size=32, nb_epoch=2, mesh=mesh,
+              validation_data=(vx, vy))
+        assert m.params is not None
+
+    def test_indivisible_batch_raises_clearly(self):
+        from bigdl_tpu.keras import KerasModel
+
+        x, y = _toy(n=90)
+        mesh = create_mesh(drop_trivial_axes=True)
+        m = KerasModel(_mlp()).compile(
+            "sgd", "sparse_categorical_crossentropy")
+        with pytest.raises(ValueError, match="data axis"):
+            m.fit(x, y, batch_size=30, nb_epoch=1, mesh=mesh)
+
+
+class TestPredictorMesh:
+    def test_sharded_predict_matches_local(self):
+        from bigdl_tpu.optim.predictor import Predictor
+
+        model = _mlp()
+        params, state = model.init(jax.random.PRNGKey(0))
+        x, _ = _toy(n=100)
+
+        local = Predictor(model, params, state, batch_size=16).predict(x)
+        mesh = create_mesh(drop_trivial_axes=True)
+        pred = Predictor(model, params, state, batch_size=16, mesh=mesh)
+        sharded = pred.predict(x)
+        assert pred.batch_size % mesh.shape["data"] == 0
+        np.testing.assert_allclose(sharded, local, rtol=1e-5, atol=1e-6)
+
+    def test_batch_size_rounds_up_to_data_axis(self):
+        from bigdl_tpu.optim.predictor import Predictor
+
+        model = _mlp()
+        params, state = model.init(jax.random.PRNGKey(0))
+        mesh = create_mesh(drop_trivial_axes=True)
+        pred = Predictor(model, params, state, batch_size=13, mesh=mesh)
+        ndata = mesh.shape["data"]
+        assert pred.batch_size == -(-13 // ndata) * ndata
+        out = pred.predict(_toy(n=5)[0])     # remainder < data-axis size
+        assert out.shape == (5, 4)
+
+    def test_prediction_service_mesh(self):
+        from bigdl_tpu.optim.predictor import PredictionService
+
+        model = _mlp()
+        params, state = model.init(jax.random.PRNGKey(0))
+        mesh = create_mesh(drop_trivial_axes=True)
+        svc = PredictionService(model, params, state, max_batch=64,
+                                mesh=mesh)
+        x, _ = _toy(n=37)
+        want = PredictionService(model, params, state,
+                                 max_batch=64).predict(x)
+        np.testing.assert_allclose(svc.predict(x), want, rtol=1e-5,
+                                   atol=1e-6)
+        assert svc._bucket(3) == mesh.shape["data"]
+
+
+class TestDLEstimatorMesh:
+    def test_fit_mesh_matches_local(self):
+        from bigdl_tpu.dlframes import DLClassifier
+        from bigdl_tpu.optim.method import SGD
+
+        x, y = _toy(n=128)
+        df = {"features": x, "label": y}
+        kw = dict(feature_size=(8,), batch_size=32, max_epoch=2)
+        local = DLClassifier(_mlp(), nn.ClassNLLCriterion(),
+                             optim_method=SGD(0.1), **kw).fit(df)
+        mesh = create_mesh(drop_trivial_axes=True)
+        dist = DLClassifier(_mlp(), nn.ClassNLLCriterion(),
+                            optim_method=SGD(0.1), mesh=mesh, **kw).fit(df)
+        for a, b in zip(jax.tree.leaves(local.params),
+                        jax.tree.leaves(dist.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
+        out_local = local.transform(df)["prediction"]
+        out_dist = dist.transform(df)["prediction"]
+        np.testing.assert_array_equal(out_local, out_dist)
+        assert dist.mesh is mesh
