@@ -34,6 +34,36 @@ def pytest_configure(config):
         "markers",
         "examples: subprocess-runs examples/*.py (slow; deselect with "
         "-m 'not examples' for the inner loop)")
+    config.addinivalue_line(
+        "markers",
+        "tier2: slow/external tier — external-framework goldens, "
+        "multi-process multihost, training-to-convergence, full-scale "
+        "int8 (the reference's Parallel/Serial/Integration partition, "
+        "spark/dl/pom.xml:332-346). Fast inner loop: -m 'not tier2 and "
+        "not examples'; second tier: -m 'tier2 or examples'. The layer "
+        "closure meta-tests stay in the FAST tier by design (coverage "
+        "can never silently rot).")
+
+
+# Tier-2 membership by module (docs/testing.md): golden suites against
+# external frameworks (torch/tf/keras subprocess oracles), multi-process
+# tests, and training-to-convergence tests. test_layer_closure is
+# deliberately NOT here.
+_TIER2_MODULES = {
+    "test_golden_keras_real", "test_golden_tf_real", "test_golden_torch",
+    "test_golden_torch2", "test_golden_torch3", "test_golden_torch4",
+    "test_golden_torch5", "test_golden_models", "test_golden_oracle",
+    "test_multihost", "test_maskrcnn_train", "test_int8_accuracy",
+    "test_gradcheck2", "test_serializer_sweep2", "test_examples",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    import os as _os
+    for item in items:
+        mod = _os.path.basename(str(item.fspath))[:-3]
+        if mod in _TIER2_MODULES:
+            item.add_marker(pytest.mark.tier2)
 
 
 @pytest.fixture
