@@ -6,13 +6,13 @@
 # chip answers it runs, IN PRIORITY ORDER, (1) the non-interpret Pallas
 # Mosaic-lowering smokes, (2) the ResNet-50 bf16 MFU bench (the headline),
 # (3) the Pallas-vs-XLA kernel table, (4) the rest of the battery, (5) an
-# XLA profile — writing each result to BENCH_EARLY_r04.json INCREMENTALLY
+# XLA profile — writing each result to BENCH_EARLY_r05.json INCREMENTALLY
 # so a mid-battery wedge still leaves evidence. Then keeps re-probing.
 #
 # Usage: nohup bash tools/tpu_watch.sh &   (logs to /tmp/tpu_watch.log)
 cd "$(dirname "$0")/.." || exit 1
 LOG=/tmp/tpu_watch.log
-OUT=BENCH_EARLY_r04.json
+OUT=BENCH_EARLY_r05.json
 PROBE='import jax, jax.numpy as jnp
 d = jax.devices()
 assert d[0].platform != "cpu", d
@@ -59,12 +59,13 @@ for i in $(seq 1 100000); do
     merge_result "pallas_smokes" "\"$smoke\""
     # 2..5 battery, headline first, each result written immediately
     for m in resnet50 kernels resnet50_sweep llama lstm transformer lenet; do
-      j=$(timeout 1500 python bench.py "$m" 2>>"$LOG" | tail -1)
+      j=$(BIGDL_TPU_ASSUME_ALIVE=1 timeout 1500 python bench.py "$m" \
+          2>>"$LOG" | tail -1)
       echo "$(date -u +%FT%TZ) bench $m: $j" >> "$LOG"
       merge_result "$m" "$j"
     done
-    timeout 600 python tools/capture_tpu_profile.py tpu_profile_r04 \
-        >> "$LOG" 2>&1 && merge_result "profile" "\"tpu_profile_r04/\""
+    timeout 600 python tools/capture_tpu_profile.py tpu_profile_r05 \
+        >> "$LOG" 2>&1 && merge_result "profile" "\"tpu_profile_r05/\""
     echo "$(date -u +%FT%TZ) battery pass done (see $OUT)" >> "$LOG"
     sleep 600
   else
