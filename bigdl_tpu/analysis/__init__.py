@@ -1,0 +1,22 @@
+"""Static analysis for bigdl_tpu — correctness tooling that enables scale.
+
+Two prongs (docs/static_analysis.md):
+  * graph checker (:mod:`bigdl_tpu.analysis.graphcheck`): one abstract-eval
+    walk over a `Module` tree catches shape mismatches, dtype drift, dead
+    params, stale state, bad PartitionSpecs and rng-fold collisions — with
+    module-path provenance, before any XLA trace. Bound as
+    ``Module.check()`` / ``Module.summary()``; also the
+    ``python -m bigdl_tpu.analysis`` CLI.
+  * tracing-safety lint (:mod:`bigdl_tpu.analysis.rules` via
+    ``tools/tpu_lint.py``): AST rules TPU-LINT001..007 over the repo, with
+    a checked-in ratchet baseline. The lint is stdlib-only; import it from
+    here only when jax is already in the process.
+"""
+
+from bigdl_tpu.analysis.graphcheck import (GraphCheckError, Issue,
+                                           check_module, summarize)
+from bigdl_tpu.analysis.rules import (RULES, Violation, lint_paths,
+                                      lint_source)
+
+__all__ = ["GraphCheckError", "Issue", "check_module", "summarize",
+           "RULES", "Violation", "lint_paths", "lint_source"]

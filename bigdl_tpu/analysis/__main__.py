@@ -1,0 +1,95 @@
+"""Graph-doctor CLI: abstract-eval a model factory and report graph issues.
+
+    python -m bigdl_tpu.analysis bigdl_tpu.models.lenet:build \
+        --input 1,28,28,1 --summary
+
+The factory is `module.path:callable` — called with no arguments, it must
+return a `Module` (or already be one). `--input` repeats per model input;
+shape is comma-separated, with an optional `:dtype` suffix
+(`--input 1,16:int32`). The walk runs `jax.eval_shape` only — zero FLOPs,
+no device needed (the CLI forces JAX_PLATFORMS=cpu before importing jax).
+
+Exit status: 0 clean, 1 error-severity issues (or factory failure) —
+CI-friendly, like tools/tpu_lint.py for the AST prong.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import os
+import sys
+from typing import List, Optional, Sequence
+
+
+def _parse_input(spec: str):
+    # lazy jax import: JAX_PLATFORMS must be set first (see main)
+    import jax
+    import jax.numpy as jnp
+    dtype = jnp.float32
+    if ":" in spec:
+        spec, dname = spec.rsplit(":", 1)
+        dtype = jnp.dtype(dname)
+    shape = tuple(int(s) for s in spec.split(",") if s != "")
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _load_factory(ref: str):
+    if ":" not in ref:
+        raise SystemExit(f"factory must be 'module.path:callable', got "
+                         f"'{ref}'")
+    mod_name, attr = ref.split(":", 1)
+    obj = getattr(importlib.import_module(mod_name), attr)
+    model = obj() if callable(obj) and not hasattr(obj, "apply") else obj
+    if not hasattr(model, "apply"):
+        raise SystemExit(f"{ref} did not produce a Module (got "
+                         f"{type(model).__name__})")
+    return model
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m bigdl_tpu.analysis",
+        description="Ahead-of-trace model-graph checker "
+                    "(docs/static_analysis.md)")
+    parser.add_argument("factory",
+                        help="model factory as 'pkg.module:callable'")
+    parser.add_argument("--input", action="append", default=[],
+                        metavar="SHAPE[:DTYPE]",
+                        help="one per model input, e.g. 8,28,28,1 or "
+                             "4,16:int32 (repeatable)")
+    parser.add_argument("--eval", action="store_true",
+                        help="check in eval mode (default: training mode, "
+                             "which also exercises state updates)")
+    parser.add_argument("--summary", action="store_true",
+                        help="print the Module.summary() table")
+    args = parser.parse_args(argv)
+
+    # abstract eval needs no accelerator; keep the TPU untouched
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from bigdl_tpu.analysis.graphcheck import check_module, summarize
+
+    model = _load_factory(args.factory)
+    inputs = [_parse_input(s) for s in args.input]
+    training = not args.eval
+
+    if args.summary and inputs:
+        try:
+            print(summarize(model, inputs, training=training))
+        except Exception as e:  # noqa: BLE001 — issues re-printed below
+            print(f"summary unavailable: {e}")
+
+    issues = check_module(model, inputs, training=training,
+                          raise_on_error=False)
+    errors = [i for i in issues if i.severity == "error"]
+    warnings = [i for i in issues if i.severity == "warning"]
+    for issue in issues:
+        print(issue)
+    print(f"graph check: {len(errors)} error(s), {len(warnings)} "
+          f"warning(s) in '{model.name}'")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,529 @@
+"""Ahead-of-trace model-graph checker.
+
+The functional `Module` contract defers every wiring mistake to XLA trace
+time, where a shape mismatch deep inside a 60-layer `Sequential` surfaces
+as an opaque jnp broadcast error with no module provenance. This checker
+walks the module tree ONCE under `jax.eval_shape` — zero FLOPs, CPU-only —
+with every module's `apply` instrumented, and reports defects with full
+module-path provenance (`model/trunk/conv3`).
+
+Defect classes (rule ids):
+  GRAPH-SHAPE       shape/type incompatibility between adjacent children
+                    (the trace error, re-anchored to the module that raised)
+  GRAPH-DTYPE       float64 drift: a float64 param/state declaration, or a
+                    module whose output picks up f64 its inputs didn't have
+  GRAPH-QUANT       int8→float transition outside the sanctioned dequant
+                    points (nn/quantized.py, kernels/)
+  GRAPH-DEADPARAM   a parameter declared in param_specs() but never read by
+                    _apply — dead weight that still costs HBM + allreduce
+  GRAPH-STALESTATE  a state buffer returned unchanged in training mode
+                    (e.g. BatchNorm stats that never update)
+  GRAPH-MESH        a PartitionSpec axis name not present in the active
+                    mesh (sharding rule would silently no-op or crash)
+  GRAPH-RNGFOLD     two sibling child/param names folding to the same CRC32
+                    rng stream (silent init aliasing) — warning
+  GRAPH-INIT        module.init itself failed under abstract eval
+
+Entry points: :func:`check_module` (bound as ``Module.check``),
+:func:`summarize` (bound as ``Module.summary``), and the
+``python -m bigdl_tpu.analysis`` CLI.
+"""
+
+from __future__ import annotations
+
+import zlib
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.core.module import Module
+
+# module namespaces allowed to cross the int8→float boundary (dequant)
+_DEQUANT_MODULES = ("bigdl_tpu.nn.quantized", "bigdl_tpu.kernels")
+
+
+@dataclass
+class Issue:
+    """One graph-check finding, anchored to a module path."""
+    rule: str
+    path: str                   # e.g. "model/trunk/conv3"
+    module: str                 # class name (+ instance name if custom)
+    message: str
+    severity: str = "error"     # 'error' | 'warning'
+
+    def __str__(self):
+        return (f"[{self.rule}] {self.path} ({self.module}): "
+                f"{self.message}")
+
+
+class GraphCheckError(Exception):
+    """Raised by Module.check() when error-severity issues were found."""
+
+    def __init__(self, issues: Sequence[Issue]):
+        self.issues = list(issues)
+        errors = [i for i in self.issues if i.severity == "error"]
+        lines = "\n".join(f"  {i}" for i in self.issues)
+        super().__init__(
+            f"graph check failed with {len(errors)} error(s):\n{lines}")
+
+
+class _Abort(Exception):
+    """Internal: unwind the trace after the deepest module recorded the
+    original error (prevents every ancestor re-reporting it)."""
+
+
+class _Spy(dict):
+    """Params/state dict that records which keys `_apply` actually reads."""
+
+    def __init__(self, data):
+        super().__init__(data)
+        self.accessed = set()
+
+    def __getitem__(self, k):
+        self.accessed.add(k)
+        return super().__getitem__(k)
+
+    def get(self, k, default=None):
+        self.accessed.add(k)
+        return super().get(k, default)
+
+    def items(self):
+        self.accessed.update(super().keys())
+        return super().items()
+
+    def values(self):
+        self.accessed.update(super().keys())
+        return super().values()
+
+
+def _mod_label(m: Module) -> str:
+    cls = type(m).__name__
+    return cls if m.name == cls else f"{cls} '{m.name}'"
+
+
+def _module_paths(root: Module, root_name: str) -> Dict[int, str]:
+    """id(module) -> 'root/child_key/...' (first path wins for shared
+    modules). Child keys are the (params/state) pytree keys, so a reported
+    path doubles as the param keypath."""
+    out = {id(root): root_name}
+
+    def walk(mod: Module, prefix: str):
+        for key, child in mod.children().items():
+            path = f"{prefix}/{key}"
+            if id(child) not in out:
+                out[id(child)] = path
+                walk(child, path)
+
+    walk(root, root_name)
+    return out
+
+
+def _dtype_leaves(tree) -> List[Any]:
+    return [x for x in jax.tree.leaves(tree) if hasattr(x, "dtype")]
+
+
+def _is_f64(x) -> bool:
+    try:
+        return jnp.issubdtype(x.dtype, jnp.floating) and \
+            jnp.dtype(x.dtype).itemsize == 8
+    except TypeError:
+        return False
+
+
+def _is_i8(x) -> bool:
+    try:
+        return jnp.dtype(x.dtype) in (jnp.dtype(jnp.int8),
+                                      jnp.dtype(jnp.uint8))
+    except TypeError:
+        return False
+
+
+def _is_float(x) -> bool:
+    try:
+        return jnp.issubdtype(x.dtype, jnp.floating)
+    except TypeError:
+        return False
+
+
+def _shapes(tree) -> str:
+    s = [str(tuple(x.shape)) for x in _dtype_leaves(tree)]
+    return ", ".join(s) if s else "<none>"
+
+
+def _own_leaves(d) -> List[Any]:
+    """Direct (non-subtree) leaves of a params/state dict — this module's
+    own tensors, excluding child subtrees."""
+    if not isinstance(d, dict):
+        return []
+    return [v for v in dict.values(d) if not isinstance(v, dict)]
+
+
+class _Ctx:
+    """Shared state of one instrumented walk."""
+
+    def __init__(self, root: Module, training: bool,
+                 collect_summary: bool = False):
+        self.paths = _module_paths(root, root.name)
+        self.training = training
+        self.issues: List[Issue] = []
+        self.stack: List[dict] = []          # one frame per live apply()
+        self.collect_summary = collect_summary
+        self.rows: List[dict] = []           # summary rows, entry order
+
+    def path_of(self, m: Module) -> str:
+        return self.paths.get(id(m), f"<detached>/{m.name}")
+
+    def _flag_parent(self, key: str):
+        if len(self.stack) >= 2:
+            self.stack[-2][key] = True
+
+
+def _post_checks(ctx: _Ctx, frame: dict, mod: Module, path: str,
+                 inputs, spy_p, spy_s, output, new_state, training: bool):
+    """Per-module checks run right after a successful _apply."""
+    label = _mod_label(mod)
+
+    # --- dead params: declared but never read
+    own_params = set(mod.param_specs())
+    if own_params and isinstance(spy_p, _Spy):
+        for k in sorted(own_params - spy_p.accessed):
+            ctx.issues.append(Issue(
+                "GRAPH-DEADPARAM", f"{path}/{k}", label,
+                f"param '{k}' is declared in param_specs() but never read "
+                f"by _apply — dead weight (still inited, stored, sharded "
+                f"and all-reduced every step)"))
+
+    # --- stale state: buffer returned unchanged in training mode
+    own_state = set(mod.state_specs())
+    if training and own_state and isinstance(spy_s, _Spy) and \
+            isinstance(new_state, dict):
+        for k in sorted(own_state):
+            old = dict.get(spy_s, k)          # unbound: skips Spy recording
+            new = dict.get(new_state, k)
+            if new is not None and new is old:
+                ctx.issues.append(Issue(
+                    "GRAPH-STALESTATE", f"{path}/{k}", label,
+                    f"state buffer '{k}' is returned unchanged in training "
+                    f"mode — it will never update (did _apply forget to "
+                    f"return a new state dict?)"))
+
+    # --- dtype drift: float64 appearing out of nowhere
+    in_leaves = (_dtype_leaves(inputs) + _own_leaves(spy_p)
+                 + _own_leaves(spy_s))
+    out_leaves = _dtype_leaves(output)
+    out_f64 = any(_is_f64(x) for x in out_leaves)
+    if out_f64:
+        if not frame.get("f64_from_child") and \
+                not any(_is_f64(x) for x in in_leaves):
+            ctx.issues.append(Issue(
+                "GRAPH-DTYPE", path, label,
+                "output is float64 but no input/param/state leaf was — "
+                "an fp64 upcast leaked into the graph (10-100x slower on "
+                "TPU and it poisons everything downstream)"))
+        ctx._flag_parent("f64_from_child")
+
+    # --- int8 -> float transitions outside sanctioned dequant points
+    has_i8_in = any(_is_i8(x) for x in in_leaves)
+    if has_i8_in and any(_is_float(x) for x in out_leaves):
+        exempt = type(mod).__module__.startswith(_DEQUANT_MODULES)
+        if not exempt and not frame.get("i8_from_child"):
+            ctx.issues.append(Issue(
+                "GRAPH-QUANT", path, label,
+                "int8 input/param dequantized to float outside "
+                "nn/quantized.py / kernels/ — scales are unaccounted for "
+                "here; route through the quantized layer family"))
+        ctx._flag_parent("i8_from_child")
+
+    # --- summary row
+    if ctx.collect_summary:
+        own = {k: dict.__getitem__(spy_p, k) for k in own_params
+               if dict.__contains__(spy_p, k)} if isinstance(spy_p, dict) \
+            else {}
+        n_params = int(sum(np.prod(x.shape) for x in own.values()
+                           if hasattr(x, "shape")))
+        ctx.rows.append({
+            "path": path, "module": type(mod).__name__,
+            "depth": len(ctx.stack) - 1,
+            "out": " ".join(f"{tuple(x.shape)}:{jnp.dtype(x.dtype).name}"
+                            for x in out_leaves[:4])
+                   + (" …" if len(out_leaves) > 4 else ""),
+            "params": " ".join(
+                f"{k}{tuple(v.shape)}:{jnp.dtype(v.dtype).name}"
+                for k, v in sorted(own.items()) if hasattr(v, "shape")),
+            "n_params": n_params,
+        })
+
+
+@contextmanager
+def _instrumented(ctx: _Ctx):
+    orig = Module.apply
+
+    def apply(self, params, state, *inputs, training=False, rng=None,
+              **kwargs):
+        path = ctx.path_of(self)
+        frame: dict = {}
+        ctx.stack.append(frame)
+        spy_p = _Spy(params) if isinstance(params, dict) else params
+        spy_s = _Spy(state) if isinstance(state, dict) else state
+        try:
+            out = orig(self, spy_p, spy_s, *inputs, training=training,
+                       rng=rng, **kwargs)
+        except _Abort:
+            ctx.stack.pop()
+            raise
+        except Exception as e:     # noqa: BLE001 — re-anchored as an Issue
+            ctx.stack.pop()
+            ctx.issues.append(Issue(
+                "GRAPH-SHAPE", path, _mod_label(self),
+                f"{type(e).__name__}: {e} [inputs: {_shapes(inputs)}]"))
+            raise _Abort() from e
+        output, new_state = out
+        _post_checks(ctx, frame, self, path, inputs, spy_p, spy_s,
+                     output, new_state, training)
+        ctx.stack.pop()
+        # a module may return its (spy-wrapped) state dict as-is; strip the
+        # spy so the returned pytree is plain dicts (JAX rejects subclasses)
+        return output, _unspy(new_state)
+
+    def _unspy(tree):
+        if isinstance(tree, _Spy):
+            tree = dict(tree)
+        if isinstance(tree, dict):
+            return {k: _unspy(v) for k, v in tree.items()}
+        return tree
+
+    Module.apply = apply
+    try:
+        yield
+    finally:
+        Module.apply = orig
+
+
+# ----------------------------------------------------------- static checks
+
+def _static_checks(root: Module, issues: List[Issue]):
+    """Spec-level checks that need no trace: declared float64 dtypes and
+    CRC32 `_fold_name` collisions between sibling rng streams."""
+    for mod, path in _walk_with_paths(root, root.name):
+        label = _mod_label(mod)
+        for kind, specs in (("param", mod.param_specs()),
+                            ("state", mod.state_specs())):
+            for k, spec in specs.items():
+                try:
+                    if _is_f64(spec):
+                        issues.append(Issue(
+                            "GRAPH-DTYPE", f"{path}/{k}", label,
+                            f"{kind} spec declares dtype float64 — fp64 is "
+                            f"emulated on TPU; declare float32 and upcast "
+                            f"locally if a reduction needs it"))
+                except TypeError:
+                    pass
+        # rng fold collisions: params and children fold from the SAME key
+        # in Module.init (state buffers are not rng-inited — excluded)
+        names = list(mod.param_specs()) + list(mod.children())
+        folds: Dict[int, List[str]] = {}
+        for n in names:
+            folds.setdefault(zlib.crc32(n.encode()) & 0x7FFFFFFF,
+                             []).append(n)
+        for fold, group in folds.items():
+            if len(group) > 1:
+                issues.append(Issue(
+                    "GRAPH-RNGFOLD", path, label,
+                    f"sibling names {group} fold to the same CRC32 rng "
+                    f"stream ({fold:#x}) — their initializations (and any "
+                    f"per-child dropout keys) are silently identical; "
+                    f"rename one", severity="warning"))
+
+
+def _walk_with_paths(root: Module, root_name: str):
+    yield root, root_name
+    seen = {id(root)}
+
+    def walk(mod: Module, prefix: str):
+        for key, child in mod.children().items():
+            if id(child) in seen:
+                continue
+            seen.add(id(child))
+            path = f"{prefix}/{key}"
+            yield child, path
+            yield from walk(child, path)
+
+    yield from walk(root, root_name)
+
+
+def _mesh_checks(mesh, rules, params_template, issues: List[Issue],
+                 root_name: str):
+    """Validate ShardingRules against the active mesh: every axis named by
+    a rule's PartitionSpec must exist in the mesh, and every rule should
+    match at least one param path."""
+    axis_names = set(mesh.axis_names)
+    rule_list = getattr(rules, "rules", rules)
+    paths = None
+    if params_template is not None:
+        flat, _ = jax.tree_util.tree_flatten_with_path(params_template)
+        paths = ["/".join(_key_str(k) for k in p) for p, _ in flat]
+    for pat, spec in rule_list:
+        pattern = getattr(pat, "pattern", str(pat))
+        for entry in spec:
+            axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+            for ax in axes:
+                if ax is not None and ax not in axis_names:
+                    issues.append(Issue(
+                        "GRAPH-MESH", f"{root_name}", f"rule '{pattern}'",
+                        f"PartitionSpec axis '{ax}' is not in the active "
+                        f"mesh (axes: {sorted(axis_names)}) — the rule "
+                        f"would crash device_put or silently replicate"))
+        if paths is not None:
+            rx = pat if hasattr(pat, "fullmatch") else None
+            if rx is not None and not any(rx.fullmatch(p) for p in paths):
+                issues.append(Issue(
+                    "GRAPH-MESH", root_name, f"rule '{pattern}'",
+                    "sharding rule matches no parameter path — dead rule "
+                    "(typo in the regex?)", severity="warning"))
+
+
+def _key_str(k) -> str:
+    if hasattr(k, "key"):
+        return str(k.key)
+    if hasattr(k, "idx"):
+        return str(k.idx)
+    return str(k)
+
+
+# ------------------------------------------------------------- entry points
+
+def _sanitize(tree):
+    """Replace non-JAX leaves (custom host objects) with None so the tree
+    survives eval_shape's output canonicalization."""
+    if isinstance(tree, dict):
+        return {k: _sanitize(v) for k, v in tree.items()}
+    if isinstance(tree, (tuple, list)):
+        return type(tree)(_sanitize(v) for v in tree)
+    if tree is None or isinstance(tree, (int, float, bool, complex)):
+        return tree
+    return tree if hasattr(tree, "dtype") and hasattr(tree, "shape") \
+        else None
+
+
+def _is_abstract_input(x) -> bool:
+    leaves = jax.tree.leaves(x)
+    return bool(leaves) and all(
+        isinstance(leaf, jax.ShapeDtypeStruct) for leaf in leaves)
+
+
+def _trace(module: Module, inputs: Tuple, training: bool, rng,
+           apply_kwargs: Optional[dict], ctx: _Ctx,
+           issues: List[Issue]) -> bool:
+    """Run the instrumented abstract walk. Returns True if the trace ran
+    (init succeeded)."""
+    apply_kwargs = apply_kwargs or {}
+    key = rng if rng is not None else \
+        jax.random.PRNGKey(0)  # tpu-lint: disable=004 — abstract walk only
+    try:
+        params_s, state_s = jax.eval_shape(module.init, key)
+    except Exception as e:  # noqa: BLE001
+        issues.append(Issue(
+            "GRAPH-INIT", module.name, _mod_label(module),
+            f"init() failed under abstract eval: {type(e).__name__}: {e}"))
+        return False
+
+    spec_pos = [i for i, x in enumerate(inputs) if _is_abstract_input(x)]
+    spec_args = [inputs[i] for i in spec_pos]
+
+    def fn(params, state, *abstract):
+        xs = list(inputs)
+        for i, v in zip(spec_pos, abstract):
+            xs[i] = v
+        out = module.apply(params, state, *xs, training=training,
+                           rng=key, **apply_kwargs)
+        # eval_shape canonicalizes the return pytree; drop leaves that are
+        # not JAX types (host-side outputs like SparseCOO, strings) — all
+        # checks on them already ran inside the instrumented walk
+        return _sanitize(out)
+
+    with _instrumented(ctx):
+        try:
+            jax.eval_shape(fn, params_s, state_s, *spec_args)
+        except _Abort:
+            pass                      # already recorded with provenance
+        except Exception as e:  # noqa: BLE001 — outside any module apply
+            issues.append(Issue(
+                "GRAPH-SHAPE", module.name, _mod_label(module),
+                f"{type(e).__name__}: {e}"))
+    return True
+
+
+def check_module(module: Module, inputs: Sequence = (), *,
+                 training: bool = True, rng=None, mesh=None, rules=None,
+                 raise_on_error: bool = True,
+                 apply_kwargs: Optional[dict] = None) -> List[Issue]:
+    """Run every static + abstract-eval check over `module`.
+
+    `inputs` are example inputs (concrete arrays, or
+    `jax.ShapeDtypeStruct` pytrees for a shape-only check). With
+    `mesh`/`rules`, sharding rules are validated against the mesh axes.
+    Returns the issue list; raises :class:`GraphCheckError` when
+    error-severity issues exist and `raise_on_error` (the default).
+    """
+    issues: List[Issue] = []
+    _static_checks(module, issues)
+    ctx = _Ctx(module, training)
+    if inputs:
+        _trace(module, tuple(inputs), training, rng, apply_kwargs, ctx,
+               issues)
+        issues.extend(ctx.issues)
+    if rules is not None:
+        if mesh is None:
+            from bigdl_tpu.parallel.mesh import Engine
+            mesh = Engine.mesh()
+        try:
+            params_t, _ = jax.eval_shape(
+                module.init,
+                rng if rng is not None
+                else jax.random.PRNGKey(0))  # tpu-lint: disable=004
+        except Exception:  # noqa: BLE001 — init failure already reported
+            params_t = None
+        _mesh_checks(mesh, rules, params_t, issues, module.name)
+    if raise_on_error and any(i.severity == "error" for i in issues):
+        raise GraphCheckError(issues)
+    return issues
+
+
+def summarize(module: Module, inputs: Sequence, *, training: bool = False,
+              rng=None, apply_kwargs: Optional[dict] = None) -> str:
+    """Flax-`tabulate`-style summary table from one abstract-eval walk:
+    module path, class, output shapes/dtypes, own params, param count.
+    Costs zero FLOPs (shapes only) — safe on any model size."""
+    ctx = _Ctx(module, training, collect_summary=True)
+    issues: List[Issue] = []
+    ok = _trace(module, tuple(inputs), training, rng, apply_kwargs, ctx,
+                issues)
+    if not ok or any(i.rule == "GRAPH-SHAPE" for i in ctx.issues + issues):
+        bad = [i for i in ctx.issues + issues]
+        raise GraphCheckError(bad)
+
+    rows = ctx.rows
+    # apply() frames close leaf-first; re-order rows parent-first (pre-order
+    # by path, numeric child keys in numeric order) so the table reads like
+    # the module tree
+    rows.sort(key=lambda r: [(0, int(c)) if c.isdigit() else (1, c)
+                             for c in r["path"].split("/")])
+    total = sum(r["n_params"] for r in rows)
+    header = ("path", "module", "output [shape:dtype]",
+              "params [shape:dtype]", "#params")
+    table = [(r["path"], r["module"], r["out"], r["params"],
+              f"{r['n_params']:,}" if r["n_params"] else "")
+             for r in rows]
+    widths = [max(len(h), *(len(row[i]) for row in table)) if table
+              else len(h) for i, h in enumerate(header)]
+    lines = [" | ".join(h.ljust(w) for h, w in zip(header, widths)),
+             "-+-".join("-" * w for w in widths)]
+    for row in table:
+        lines.append(" | ".join(c.ljust(w) for c, w in zip(row, widths)))
+    lines.append("-+-".join("-" * w for w in widths))
+    lines.append(f"total params: {total:,}")
+    return "\n".join(lines)

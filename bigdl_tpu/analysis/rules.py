@@ -1,0 +1,598 @@
+"""Tracing-safety AST lint rules (TPU-LINT001..007).
+
+A framework-specific linter for XLA-traced code: the `Module` contract makes
+every `forward`/`_apply` body a *traced* function, where host-side numpy,
+value-dependent Python branching, or `.item()` syncs either break under
+`jit` or silently serialize the TPU step on a device→host transfer. These
+rules encode that contract so violations surface at review time instead of
+at trace time (or worse, as a silent 10x step-time regression).
+
+This module is deliberately stdlib-only (ast/json/argparse) so
+`tools/tpu_lint.py` can run it without importing jax or the bigdl_tpu
+package — linting must stay O(ms) and importable anywhere (pre-commit, CI,
+bare containers).
+
+Rules
+-----
+  TPU-LINT001  np./numpy./math. *call* inside a forward/_apply body. Host
+               math does not trace; use jnp (or hoist static math to
+               __init__).
+  TPU-LINT002  host sync on a traced value in a hot path: `.item()`,
+               `jax.device_get`, or `float()`/`int()`/`bool()` applied to
+               an expression that references a traced argument.
+  TPU-LINT003  Python `if`/`while`/ternary branching on an expression
+               derived from a traced argument (use lax.cond/lax.select).
+               Structural probes (.shape/.ndim/.dtype, len(), isinstance,
+               `is None`, `in params`) are exempt — those are static.
+  TPU-LINT004  hardcoded `jax.random.PRNGKey(<const>)` outside
+               tests/examples/docs/tools — hidden fixed seeds make "random"
+               init/dropout silently identical across runs and processes.
+  TPU-LINT005  float64 literal (jnp.float64/np.float64/"float64") in
+               nn/, optim/ or kernels/ — fp64 is 10-100x slower on TPU and
+               a single leak poisons every downstream op.
+  TPU-LINT006  mutation of `self` inside a forward/_apply body — apply-path
+               methods must stay pure or retracing/vmap/sharding silently
+               diverge.
+  TPU-LINT007  (warn-only) `jax.jit` of a train/step function without
+               `donate_argnums` — doubles peak HBM by keeping dead input
+               buffers alive across the update.
+
+Suppression: a trailing ``# tpu-lint: disable=001,006`` (or full ids, or
+``all``) on the flagged line. Pre-existing violations are ratcheted via a
+checked-in baseline of per-file per-rule counts (tools/tpu_lint_baseline.json):
+going over a file's baselined count fails, shrinking it is encouraged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+RULES: Dict[str, Tuple[str, str]] = {
+    "TPU-LINT001": ("np./math. call inside forward/_apply (host math does "
+                    "not trace; use jnp)", "error"),
+    "TPU-LINT002": ("host sync on traced value in hot path (.item()/float()/"
+                    "int()/jax.device_get)", "error"),
+    "TPU-LINT003": ("Python control flow on a traced value (use lax.cond/"
+                    "lax.select)", "error"),
+    "TPU-LINT004": ("hardcoded jax.random.PRNGKey outside tests", "error"),
+    "TPU-LINT005": ("float64 literal in nn//optim//kernels/ hot path",
+                    "error"),
+    "TPU-LINT006": ("mutation of self inside an apply-path method", "error"),
+    "TPU-LINT007": ("jit of a train/step function without donate_argnums",
+                    "warning"),
+}
+
+# Names of methods whose bodies are traced by XLA (the Module contract).
+HOT_METHODS = ("forward", "_apply")
+
+# Attribute reads on a traced value that are static at trace time.
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
+                 "weak_type", "itemsize", "nbytes"}
+# Builtins whose result over a traced value is static (structure, not data).
+_STATIC_FUNCS = {"isinstance", "len", "hasattr", "getattr", "type",
+                 "callable", "id", "repr"}
+# Comparison ops that probe identity/structure, not traced data.
+_STATIC_CMPOPS = (ast.Is, ast.IsNot, ast.In, ast.NotIn)
+
+# forward/_apply arguments that are NOT traced values.
+_UNTRACED_ARGS = {"self", "training", "name"}
+
+_PRAGMA_RE = re.compile(r"#\s*tpu-lint:\s*disable=([\w,\- ]+)")
+
+
+@dataclass
+class Violation:
+    rule: str
+    path: str                  # posix path relative to repo root
+    line: int
+    col: int
+    message: str
+    severity: str              # 'error' | 'warning'
+    baselined: bool = False
+
+    def __str__(self):
+        tag = " (baselined)" if self.baselined else ""
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.severity}]{tag} {self.message}")
+
+
+def _normalize_rule(token: str) -> Optional[str]:
+    token = token.strip()
+    if not token:
+        return None
+    if token.lower() == "all":
+        return "all"
+    if token.upper().startswith("TPU-LINT"):
+        return token.upper()
+    return f"TPU-LINT{token.zfill(3)}"
+
+
+def _pragmas(source: str) -> Dict[int, Set[str]]:
+    """line number -> set of disabled rule ids ('all' disables every rule)."""
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _PRAGMA_RE.search(line)
+        if m:
+            rules = {r for r in (_normalize_rule(t)
+                                 for t in m.group(1).split(",")) if r}
+            out[i] = rules
+    return out
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of a call target ('jax.random.PRNGKey')."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif isinstance(node, ast.Call):
+        return _dotted(node.func)
+    return ".".join(reversed(parts))
+
+
+def _terminal_name(node: ast.AST) -> str:
+    """Rightmost identifier of an expression (for jit-target heuristics)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Call):
+        return _terminal_name(node.func)
+    if isinstance(node, (ast.Lambda,)):
+        return "<lambda>"
+    return ""
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.pragmas = _pragmas(source)
+        self.violations: List[Violation] = []
+        # stack of traced-arg-name sets; non-empty top == inside hot scope
+        self._hot: List[Set[str]] = []
+        # stack of the hot method's *vararg tuple* names (`*inputs`): the
+        # tuple itself is static structure, its elements are traced
+        self._varargs: List[Set[str]] = []
+        self._parents: Dict[int, ast.AST] = {}
+        posix = path.replace(os.sep, "/")
+        self._f64_scope = any(seg in posix for seg in
+                              ("bigdl_tpu/nn/", "bigdl_tpu/optim/",
+                               "bigdl_tpu/kernels/"))
+        base = posix.rsplit("/", 1)[-1]
+        self._prng_exempt = (any(seg in posix for seg in
+                                 ("tests/", "examples/", "docs/", "tools/",
+                                  "bench"))
+                             or base.startswith(("test_", "conftest")))
+
+    # ----------------------------------------------------------- reporting
+    def _report(self, rule: str, node: ast.AST, message: str):
+        line = getattr(node, "lineno", 0)
+        disabled = self.pragmas.get(line, set())
+        if "all" in disabled or rule in disabled:
+            return
+        self.violations.append(Violation(
+            rule=rule, path=self.path, line=line,
+            col=getattr(node, "col_offset", 0), message=message,
+            severity=RULES[rule][1]))
+
+    # ------------------------------------------------------------- helpers
+    def _traced(self) -> Set[str]:
+        return self._hot[-1] if self._hot else set()
+
+    def _link_parents(self, root: ast.AST):
+        for parent in ast.walk(root):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[id(child)] = parent
+
+    def _is_static_use(self, name_node: ast.Name, boundary: ast.AST) -> bool:
+        """True if this traced-name reference only feeds static structure
+        probes (shape/ndim/len/isinstance/is-None) within `boundary`."""
+        node: ast.AST = name_node
+        varargs = self._varargs[-1] if self._varargs else set()
+        if name_node.id in varargs:
+            # `*inputs` is a python tuple: `if inputs:` / `if not inputs:`
+            # probes arity (static); only element access yields a tracer.
+            parent = self._parents.get(id(name_node))
+            if not isinstance(parent, ast.Subscript):
+                return True
+        while node is not boundary:
+            parent = self._parents.get(id(node))
+            if parent is None:
+                break
+            if isinstance(parent, ast.Attribute) and \
+                    parent.attr in _STATIC_ATTRS:
+                return True
+            if isinstance(parent, ast.Call):
+                fn = parent.func
+                if node is not fn and isinstance(fn, ast.Name) and \
+                        fn.id in _STATIC_FUNCS:
+                    return True
+            if isinstance(parent, ast.Compare) and \
+                    all(isinstance(op, _STATIC_CMPOPS) for op in parent.ops):
+                return True
+            node = parent
+        return False
+
+    def _dynamic_traced_ref(self, expr: ast.AST) -> Optional[str]:
+        """Name of a traced argument used *dynamically* inside expr."""
+        traced = self._traced()
+        if not traced:
+            return None
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Name) and sub.id in traced and \
+                    isinstance(sub.ctx, ast.Load) and \
+                    not self._is_static_use(sub, expr):
+                return sub.id
+        return None
+
+    # -------------------------------------------------------------- scopes
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self._check_jit_decorators(node)
+        if node.name in HOT_METHODS:
+            a = node.args
+            names = {x.arg for x in (a.posonlyargs + a.args + a.kwonlyargs)}
+            if a.vararg:
+                names.add(a.vararg.arg)
+            if a.kwarg:
+                names.add(a.kwarg.arg)
+            # args defaulting to a bool constant are config flags
+            # (causal=False, pool=False), not traced values
+            flags = set()
+            pos = a.posonlyargs + a.args
+            for arg, default in zip(pos[len(pos) - len(a.defaults):],
+                                    a.defaults):
+                if isinstance(default, ast.Constant) and \
+                        isinstance(default.value, bool):
+                    flags.add(arg.arg)
+            for arg, default in zip(a.kwonlyargs, a.kw_defaults):
+                if isinstance(default, ast.Constant) and \
+                        isinstance(default.value, bool):
+                    flags.add(arg.arg)
+            self._hot.append(names - _UNTRACED_ARGS - flags)
+            self._varargs.append({a.vararg.arg} if a.vararg else set())
+            self.generic_visit(node)
+            self._hot.pop()
+            self._varargs.pop()
+        else:
+            self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    # --------------------------------------------------------------- calls
+    def visit_Call(self, node: ast.Call):
+        dotted = _dotted(node.func)
+        root = dotted.split(".", 1)[0]
+        in_hot = bool(self._hot)
+
+        if in_hot and root in ("np", "numpy", "math"):
+            self._report("TPU-LINT001", node,
+                         f"`{dotted}()` runs on the host and breaks under "
+                         f"trace; use the jnp equivalent (or hoist static "
+                         f"math to __init__)")
+        if in_hot:
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "item" and not node.args:
+                self._report("TPU-LINT002", node,
+                             "`.item()` forces a device->host sync inside a "
+                             "traced function")
+            elif dotted in ("jax.device_get", "device_get"):
+                self._report("TPU-LINT002", node,
+                             "`jax.device_get` forces a device->host sync "
+                             "inside a traced function")
+            elif isinstance(node.func, ast.Name) and \
+                    node.func.id in ("float", "int", "bool") and node.args:
+                ref = self._dynamic_traced_ref(node.args[0])
+                if ref is not None:
+                    self._report(
+                        "TPU-LINT002", node,
+                        f"`{node.func.id}()` on traced value `{ref}` forces "
+                        f"a host sync; keep it as a jnp scalar")
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id == "setattr" and node.args and \
+                    isinstance(node.args[0], ast.Name) and \
+                    node.args[0].id == "self":
+                self._report("TPU-LINT006", node,
+                             "setattr(self, ...) inside an apply-path method")
+
+        if not self._prng_exempt and \
+                (dotted in ("jax.random.PRNGKey", "random.PRNGKey",
+                            "PRNGKey", "jax.random.key", "random.key")) and \
+                node.args and isinstance(node.args[0], ast.Constant):
+            self._report("TPU-LINT004", node,
+                         f"hardcoded `{dotted}({node.args[0].value!r})` — "
+                         f"thread an rng from the caller instead")
+
+        if dotted in ("jax.jit", "jit"):
+            self._check_jit_call(node)
+        self.generic_visit(node)
+
+    def _jit_kwargs_donate(self, call: ast.Call) -> bool:
+        return any(kw.arg in ("donate_argnums", "donate_argnames")
+                   for kw in call.keywords)
+
+    def _check_jit_call(self, node: ast.Call):
+        if self._jit_kwargs_donate(node):
+            return
+        target = _terminal_name(node.args[0]) if node.args else ""
+        if any(h in target.lower() for h in ("step", "train")):
+            self._report("TPU-LINT007", node,
+                         f"jax.jit({target}) without donate_argnums keeps "
+                         f"dead param/opt-state buffers alive (2x peak HBM)")
+
+    def _check_jit_decorators(self, node: ast.FunctionDef):
+        if not any(h in node.name.lower() for h in ("step", "train")):
+            return
+        for dec in node.decorator_list:
+            dotted = _dotted(dec if not isinstance(dec, ast.Call)
+                             else dec.func)
+            if dotted in ("jax.jit", "jit"):
+                if isinstance(dec, ast.Call) and self._jit_kwargs_donate(dec):
+                    continue
+                self._report("TPU-LINT007", dec,
+                             f"@jax.jit on {node.name} without donate_argnums")
+            elif dotted.endswith("partial") and isinstance(dec, ast.Call) \
+                    and dec.args and _dotted(dec.args[0]) in ("jax.jit",
+                                                              "jit"):
+                if not self._jit_kwargs_donate(dec):
+                    self._report("TPU-LINT007", dec,
+                                 f"jit of {node.name} without donate_argnums")
+
+    # ----------------------------------------------------- float64 / attrs
+    def visit_Attribute(self, node: ast.Attribute):
+        if self._f64_scope and node.attr == "float64":
+            root = _dotted(node).split(".", 1)[0]
+            if root in ("jnp", "np", "numpy", "jax"):
+                self._report("TPU-LINT005", node,
+                             f"`{_dotted(node)}` — fp64 is emulated (slow) "
+                             f"on TPU; use float32 or a pragma if this is "
+                             f"host-side")
+        self.generic_visit(node)
+
+    def visit_Constant(self, node: ast.Constant):
+        if self._f64_scope and node.value == "float64":
+            self._report("TPU-LINT005", node, "'float64' dtype literal")
+
+    # ------------------------------------------------------- control flow
+    def _check_branch(self, node, test: ast.AST, kind: str):
+        ref = self._dynamic_traced_ref(test)
+        if ref is not None:
+            self._report("TPU-LINT003", node,
+                         f"Python `{kind}` on traced value `{ref}` bakes one "
+                         f"branch into the compiled graph; use "
+                         f"lax.cond/lax.select/jnp.where")
+
+    def visit_If(self, node: ast.If):
+        if self._hot:
+            self._check_branch(node, node.test, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While):
+        if self._hot:
+            self._check_branch(node, node.test, "while")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp):
+        if self._hot:
+            self._check_branch(node, node.test, "x if y else z")
+        self.generic_visit(node)
+
+    # ----------------------------------------------------- self mutation
+    def _self_target(self, target: ast.AST) -> bool:
+        if isinstance(target, ast.Attribute) and \
+                isinstance(target.value, ast.Name) and \
+                target.value.id == "self":
+            return True
+        if isinstance(target, ast.Subscript):
+            return self._self_target(target.value)
+        if isinstance(target, (ast.Tuple, ast.List)):
+            return any(self._self_target(t) for t in target.elts)
+        return False
+
+    def visit_Assign(self, node: ast.Assign):
+        if self._hot and any(self._self_target(t) for t in node.targets):
+            self._report("TPU-LINT006", node,
+                         "assignment to self.* inside an apply-path method "
+                         "breaks purity (state must flow through the state "
+                         "pytree)")
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        if self._hot and self._self_target(node.target):
+            self._report("TPU-LINT006", node,
+                         "augmented assignment to self.* inside an "
+                         "apply-path method")
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if self._hot and self._self_target(node.target):
+            self._report("TPU-LINT006", node,
+                         "assignment to self.* inside an apply-path method")
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete):
+        if self._hot and any(self._self_target(t) for t in node.targets):
+            self._report("TPU-LINT006", node,
+                         "del self.* inside an apply-path method")
+        self.generic_visit(node)
+
+
+# ------------------------------------------------------------------ driving
+
+def lint_source(source: str, path: str = "<string>") -> List[Violation]:
+    """Lint one source string. `path` drives the path-scoped rules
+    (004 exemptions, 005 scoping) and appears in the violations."""
+    tree = ast.parse(source, filename=path)
+    linter = _Linter(path, source)
+    linter._link_parents(tree)
+    linter.visit(tree)
+    linter.violations.sort(key=lambda v: (v.line, v.col, v.rule))
+    return linter.violations
+
+
+def lint_file(filepath: str, root: str) -> List[Violation]:
+    with open(filepath, "r", encoding="utf-8") as f:
+        source = f.read()
+    rel = os.path.relpath(filepath, root).replace(os.sep, "/")
+    return lint_source(source, rel)
+
+
+def iter_py_files(paths: Sequence[str], root: str):
+    for p in paths:
+        absolute = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(absolute):
+            yield absolute
+        else:
+            for dirpath, dirnames, filenames in os.walk(absolute):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if not d.startswith((".", "__pycache")))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def lint_paths(paths: Sequence[str], root: str) -> List[Violation]:
+    out: List[Violation] = []
+    for f in iter_py_files(paths, root):
+        out.extend(lint_file(f, root))
+    return out
+
+
+# ------------------------------------------------------------------ baseline
+
+def load_baseline(path: str) -> Dict[str, Dict[str, int]]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return data.get("counts", {})
+
+
+def apply_baseline(violations: List[Violation],
+                   baseline: Dict[str, Dict[str, int]]) -> List[Violation]:
+    """Mark the first `baseline[file][rule]` error-severity violations per
+    (file, rule) as baselined (ratchet: counts may shrink, never grow).
+    Returns the list of NEW (non-baselined, error-severity) violations."""
+    budget = {(f, r): n for f, rules in baseline.items()
+              for r, n in rules.items()}
+    new: List[Violation] = []
+    for v in violations:
+        if v.severity != "error":
+            continue
+        key = (v.path, v.rule)
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            v.baselined = True
+        else:
+            new.append(v)
+    return new
+
+
+def write_baseline(violations: List[Violation], path: str):
+    counts: Dict[str, Dict[str, int]] = {}
+    for v in violations:
+        if v.severity != "error":
+            continue
+        counts.setdefault(v.path, {})
+        counts[v.path][v.rule] = counts[v.path].get(v.rule, 0) + 1
+    payload = {
+        "comment": "tpu_lint ratchet baseline: per-file per-rule counts of "
+                   "pre-existing violations. New code must be clean; shrink "
+                   "these by fixing or pragma-ing (# tpu-lint: disable=NNN). "
+                   "Regenerate with tools/tpu_lint.py --write-baseline.",
+        "counts": {f: dict(sorted(r.items()))
+                   for f, r in sorted(counts.items())},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def stats(violations: List[Violation]) -> Dict[str, int]:
+    out = {rule: 0 for rule in RULES}
+    for v in violations:
+        out[v.rule] += 1
+    return out
+
+
+# ----------------------------------------------------------------------- CLI
+
+def _default_root() -> str:
+    # rules.py lives at <root>/bigdl_tpu/analysis/rules.py
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpu_lint",
+        description="Tracing-safety linter for bigdl_tpu (rules "
+                    "TPU-LINT001..007; see docs/static_analysis.md)")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files/dirs to lint (default: bigdl_tpu/)")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: inferred from rules.py)")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline json (default: tools/"
+                             "tpu_lint_baseline.json under root)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline (report everything)")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="regenerate the baseline from the current scan")
+    parser.add_argument("--stats", action="store_true",
+                        help="print per-rule violation counts (ratchet "
+                             "tracking for PR descriptions)")
+    parser.add_argument("-q", "--quiet", action="store_true",
+                        help="suppress per-violation lines")
+    args = parser.parse_args(argv)
+
+    root = os.path.abspath(args.root) if args.root else _default_root()
+    paths = args.paths or ["bigdl_tpu"]
+    baseline_path = args.baseline or os.path.join(
+        root, "tools", "tpu_lint_baseline.json")
+
+    violations = lint_paths(paths, root)
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+
+    if args.write_baseline:
+        write_baseline(violations, baseline_path)
+        print(f"tpu_lint: wrote baseline for "
+              f"{sum(1 for v in violations if v.severity == 'error')} "
+              f"error(s) to {baseline_path}")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(baseline_path)
+    new = apply_baseline(violations, baseline)
+    warnings = [v for v in violations if v.severity == "warning"]
+
+    if not args.quiet:
+        for v in violations:
+            if not v.baselined:
+                print(v)
+
+    if args.stats:
+        print("tpu_lint per-rule counts (all / baselined / new):")
+        per_rule = stats(violations)
+        base_rule = stats([v for v in violations if v.baselined])
+        new_rule = stats(new)
+        for rule, (desc, sev) in RULES.items():
+            print(f"  {rule} [{sev:7s}] total={per_rule[rule]:3d} "
+                  f"baselined={base_rule[rule]:3d} new={new_rule[rule]:3d}  "
+                  f"{desc}")
+
+    n_base = sum(1 for v in violations if v.baselined)
+    print(f"tpu_lint: {len(new)} new error(s), {n_base} baselined, "
+          f"{len(warnings)} warning(s)")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -159,6 +159,29 @@ class Module:
                 mask[k] = jax.tree.map(lambda _: True, v)
         return mask
 
+    # ------------------------------------------------------- static analysis
+    def check(self, *inputs, training: bool = True, rng=None, mesh=None,
+              rules=None, raise_on_error: bool = True, **apply_kwargs):
+        """Ahead-of-trace graph check (zero FLOPs, `jax.eval_shape` only):
+        shape mismatches with module-path provenance, dtype drift, dead
+        params, stale state, bad PartitionSpec axes, rng-fold collisions.
+        Returns the issue list; raises
+        :class:`bigdl_tpu.analysis.GraphCheckError` on errors by default.
+        See docs/static_analysis.md."""
+        from bigdl_tpu.analysis.graphcheck import check_module
+        return check_module(self, inputs, training=training, rng=rng,
+                            mesh=mesh, rules=rules,
+                            raise_on_error=raise_on_error,
+                            apply_kwargs=apply_kwargs or None)
+
+    def summary(self, *inputs, training: bool = False, rng=None,
+                **apply_kwargs) -> str:
+        """Tabulated view of the module tree (path, class, output shapes,
+        param shapes/dtypes, param counts) from one abstract-eval walk."""
+        from bigdl_tpu.analysis.graphcheck import summarize
+        return summarize(self, inputs, training=training, rng=rng,
+                         apply_kwargs=apply_kwargs or None)
+
     # --------------------------------------------------------------- utility
     def modules(self):
         """Pre-order iterator over the module tree."""

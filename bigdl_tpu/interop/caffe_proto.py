@@ -760,7 +760,7 @@ def load(prototxt_path: str, caffemodel_path: Optional[str] = None,
                          "Input layer) and no input_shape argument")
     out_node = blobs[last_top]
     g = Graph(inputs, [out_node])
-    params, state = g.init(rng if rng is not None else jax.random.PRNGKey(0))
+    params, state = g.init(rng if rng is not None else jax.random.PRNGKey(0))  # tpu-lint: disable=004
     def _merge(dst, src):
         for kname, v in src.items():
             if isinstance(v, dict):

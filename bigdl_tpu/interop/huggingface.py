@@ -310,7 +310,7 @@ def _torch_ffn_params(inter_dense, out_dense):
 def _zero_skeleton(model):
     """Shaped zero trees for (params, state) — every leaf is overwritten
     with checkpoint weights, so skip the random init entirely."""
-    p_shape, s_shape = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    p_shape, s_shape = jax.eval_shape(model.init, jax.random.PRNGKey(0))  # tpu-lint: disable=004
     zeros = lambda s: jnp.zeros(s.shape, s.dtype)
     return jax.tree.map(zeros, p_shape), jax.tree.map(zeros, s_shape)
 
@@ -797,7 +797,7 @@ def llama_sp_apply(module, params, tokens, mesh, seq_axis="seq"):
     mesh carries one. tokens (B, T) with T % mesh.shape[seq_axis] == 0;
     returns (B, T, vocab) logits sharded over the sequence dim."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from bigdl_tpu.utils.compat import shard_map
     from bigdl_tpu.parallel.mesh import composed_data_axis
     from bigdl_tpu.parallel.ring import RingAttention
 

@@ -1161,7 +1161,7 @@ class _Loaded:
 
     def init(self, rng=None):
         return self.module.init(rng if rng is not None
-                                else jax.random.PRNGKey(0))
+                                else jax.random.PRNGKey(0))  # tpu-lint: disable=004
 
     def apply_weights(self, params, state, weight_table: Dict[str, list],
                       by_name: bool = False):

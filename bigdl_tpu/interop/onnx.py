@@ -348,7 +348,7 @@ def to_module(g: OnnxGraph, rng=None):
         out_nodes.append(as_onnx(o))
     graph = Graph([sym[i] for i in g.inputs], out_nodes)
     params, state = graph.init(rng if rng is not None
-                               else jax.random.PRNGKey(0))
+                               else jax.random.PRNGKey(0))  # tpu-lint: disable=004
     for n, p_over, s_over in weights:
         key = graph._node_key[id(n)]
         for k, v in p_over.items():

@@ -375,7 +375,7 @@ def to_module(graph: TFGraph, inputs: Optional[Sequence[str]] = None,
         raise ValueError(f"outputs {missing} were not converted")
     g = Graph([input_node_of[i] for i in input_names],
               [out_node(o) for o in output_names])
-    params, state = g.init(rng if rng is not None else jax.random.PRNGKey(0))
+    params, state = g.init(rng if rng is not None else jax.random.PRNGKey(0))  # tpu-lint: disable=004
 
     def _assign(dst, k, v):
         # nested dicts carry whole converted-subgraph params (TFWhile)

@@ -236,7 +236,7 @@ def _convert_body_subset(graph, fr, idxs):
     mod, p, st, _ = to_module(
         TFGraph([graph.nodes[n] for n in graph.order if n in need]),
         inputs=specs, outputs=[_spec(*r) for r in roots],
-        rng=jax.random.PRNGKey(0))
+        rng=jax.random.PRNGKey(0))  # tpu-lint: disable=004
     return mod, p, st, sel
 
 
@@ -269,7 +269,7 @@ def build_frame_subgraphs(graph, fr):
         TFGraph([graph.nodes[n] for n in graph.order
                  if n in cuts.cond_need]),
         inputs=cond_specs, outputs=[_spec(*cuts.cond_root)],
-        rng=jax.random.PRNGKey(0))
+        rng=jax.random.PRNGKey(0))  # tpu-lint: disable=004
     body_mod, body_p, body_s, body_sel = _convert_body_subset(
         graph, fr, list(range(n_vars)))
 

@@ -373,7 +373,7 @@ def finetune(model: MaskRCNN, dataset, *, epochs: int = 20,
     from bigdl_tpu.optim.method import (Adam, apply_update,
                                         init_update_slots)
     log = logging.getLogger("bigdl_tpu.maskrcnn")
-    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)  # tpu-lint: disable=004
     rng, init_key = jax.random.split(rng)
     params, state = model.init(init_key)
     method = Adam(learning_rate=lr)

@@ -478,7 +478,7 @@ class Transformer(Module):
         if t > self.max_len:
             raise ValueError(
                 f"sequence length {t} exceeds max_len={self.max_len}")
-        x = params["embedding"][tokens] * math.sqrt(self.d_model)
+        x = params["embedding"][tokens] * self.d_model ** 0.5
         return x + positional_encoding(t, self.d_model, x.dtype)
 
     def _apply(self, params, state, inputs, *, training=False, rng=None):
@@ -538,7 +538,7 @@ class Transformer(Module):
         d = self.d_model
         H = self.children()["dec0"].attn.num_heads
         hd = d // H
-        scale = math.sqrt(d)
+        scale = d ** 0.5
         dtype = params["embedding"].dtype      # bf16 params → bf16 caches
 
         def fwd(tokens, caches, start):

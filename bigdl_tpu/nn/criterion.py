@@ -402,7 +402,9 @@ class ClassSimplexCriterion(Criterion):
     @staticmethod
     def _build(n):
         import numpy as np
-        a = np.zeros((n, n - 1), dtype=np.float64)
+        # host-side one-time constant: fp64 keeps the Gram-Schmidt stable;
+        # the returned matrix is fp32
+        a = np.zeros((n, n - 1), dtype=np.float64)  # tpu-lint: disable=005
         for k in range(n - 1):
             # a[k][k] makes the vertex unit-norm given the prior coordinates
             a[k, k] = np.sqrt(1.0 - np.sum(a[k, :k] ** 2))

@@ -689,8 +689,8 @@ class CrossCol(Operation):
     def forward(self, params, *cols, **_):
         import itertools
         import zlib
-        if len(cols) == 1 and isinstance(cols[0], (tuple, list)) \
-                and cols[0] and isinstance(cols[0][0], (tuple, list)):
+        if (len(cols) == 1 and isinstance(cols[0], (tuple, list))  # tpu-lint: disable=003
+                and cols[0] and isinstance(cols[0][0], (tuple, list))):
             cols = tuple(cols[0])
         rows = len(cols[0])
         out = []
@@ -744,7 +744,7 @@ class Kv2Tensor(Operation):
             parsed.append(kv)
             if not self.n_cols and kv:
                 width = max(width, max(kv) + 1)
-        out = np.zeros((len(parsed), width), np.float32)
+        out = np.zeros((len(parsed), width), np.float32)  # tpu-lint: disable=001
         for i, kv in enumerate(parsed):
             for k, v in kv.items():
                 if 0 <= k < width:
@@ -762,7 +762,7 @@ class MkString(Operation):
 
     def forward(self, params, x, **_):
         import numpy as np
-        arr = np.asarray(x)
+        arr = np.asarray(x)  # tpu-lint: disable=001
         fmt = (lambda v: str(int(v))) if arr.dtype.kind in "iu" else str
         return [self.delim.join(fmt(v) for v in row) for row in arr]
 
@@ -861,7 +861,7 @@ class DecodeRaw(Operation):
         def one(r):
             # byte-swap to native order like TF DecodeRaw — big-endian
             # dtypes are not valid JAX array types
-            return np.frombuffer(r, dtype=self.wire_dtype).astype(
+            return np.frombuffer(r, dtype=self.wire_dtype).astype(  # tpu-lint: disable=001
                 self.wire_dtype.newbyteorder("="))
         if isinstance(raw, (list, tuple)):
             return [one(r) for r in raw]
@@ -886,9 +886,9 @@ class DecodeImage(Operation):
         def one(buf):
             with Image.open(io.BytesIO(buf)) as im:
                 if self.channels == 0:     # TF default: the file's channels
-                    return np.asarray(im)
+                    return np.asarray(im)  # tpu-lint: disable=001
                 mode = {1: "L", 3: "RGB", 4: "RGBA"}[self.channels]
-                return np.asarray(im.convert(mode))
+                return np.asarray(im.convert(mode))  # tpu-lint: disable=001
         if isinstance(raw, (list, tuple)):
             return [one(r) for r in raw]
         return one(raw)

@@ -13,7 +13,6 @@ from __future__ import annotations
 from typing import Optional
 
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 
 from bigdl_tpu.core.module import Module
@@ -197,13 +196,12 @@ class SpatialAdaptiveMaxPooling(Module):
             kh, kw = h // self.out_h, w // self.out_w
             return lax.reduce_window(x, -jnp.inf, lax.max, (1, kh, kw, 1),
                                      (1, kh, kw, 1), "VALID")
-        import math
         rows = []
         for i in range(self.out_h):
-            h0, h1 = (i * h) // self.out_h, math.ceil((i + 1) * h / self.out_h)
+            h0, h1 = (i * h) // self.out_h, -(-(i + 1) * h // self.out_h)
             cols = []
             for j in range(self.out_w):
-                w0, w1 = (j * w) // self.out_w, math.ceil((j + 1) * w / self.out_w)
+                w0, w1 = (j * w) // self.out_w, -(-(j + 1) * w // self.out_w)
                 cols.append(jnp.max(x[:, h0:h1, w0:w1, :], axis=(1, 2)))
             rows.append(jnp.stack(cols, axis=1))
         return jnp.stack(rows, axis=1)
@@ -241,7 +239,7 @@ class VolumetricAveragePooling(Module):
         pad = [(0, 0)] + [(p, p) for p in self.p] + [(0, 0)]
         summed = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
         if self.include_pad:
-            return summed / float(np.prod(self.k))
+            return summed / (self.k[0] * self.k[1] * self.k[2])
         counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window,
                                    strides, pad)
         return summed / jnp.maximum(counts, 1.0)

@@ -226,5 +226,5 @@ class DenseToSparse(Module):
         self.propagate_back = propagate_back
 
     def forward(self, params, x, **_):
-        return SparseCOO.from_dense(np.asarray(x), self.nnz_per_row,
-                                    self.pad_id)
+        return SparseCOO.from_dense(np.asarray(x),  # tpu-lint: disable=001
+                                    self.nnz_per_row, self.pad_id)

@@ -19,8 +19,8 @@ from bigdl_tpu.optim.metrics import ValidationMethod, ValidationResult
 
 def box_iou_np(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     """IoU matrix for xyxy boxes: (Na, 4) x (Nb, 4) → (Na, Nb)."""
-    a = np.asarray(a, np.float64).reshape(-1, 4)
-    b = np.asarray(b, np.float64).reshape(-1, 4)
+    a = np.asarray(a, np.float64).reshape(-1, 4)  # tpu-lint: disable=005
+    b = np.asarray(b, np.float64).reshape(-1, 4)  # tpu-lint: disable=005
     lt = np.maximum(a[:, None, :2], b[None, :, :2])
     rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
     wh = np.clip(rb - lt, 0, None)
@@ -43,7 +43,7 @@ def average_precision(scores: np.ndarray, tp: np.ndarray, n_gt: int,
     if scores.size == 0:
         return 0.0
     order = np.argsort(-scores, kind="stable")
-    tp = tp[order].astype(np.float64)
+    tp = tp[order].astype(np.float64)  # tpu-lint: disable=005
     fp = 1.0 - tp
     tp_cum = np.cumsum(tp)
     fp_cum = np.cumsum(fp)
@@ -77,10 +77,10 @@ class _Accumulator:
 
     def add_image(self, boxes, scores, labels, gt_boxes, gt_labels,
                   difficult=None):
-        boxes = np.asarray(boxes, np.float64).reshape(-1, 4)
-        scores = np.asarray(scores, np.float64).reshape(-1)
+        boxes = np.asarray(boxes, np.float64).reshape(-1, 4)  # tpu-lint: disable=005
+        scores = np.asarray(scores, np.float64).reshape(-1)  # tpu-lint: disable=005
         labels = np.asarray(labels, np.int64).reshape(-1)
-        gt_boxes = np.asarray(gt_boxes, np.float64).reshape(-1, 4)
+        gt_boxes = np.asarray(gt_boxes, np.float64).reshape(-1, 4)  # tpu-lint: disable=005
         gt_labels = np.asarray(gt_labels, np.int64).reshape(-1)
         difficult = (np.zeros(len(gt_labels), bool) if difficult is None
                      else np.asarray(difficult, bool).reshape(-1))
@@ -218,7 +218,7 @@ class MaskMeanAveragePrecision(MeanAveragePrecision):
             (0.0, 0.0), lambda _vals: acc.compute(use07)["map"])
 
     def _add_mask_image(self, masks, scores, labels, gt_masks, gt_labels):
-        scores = np.asarray(scores, np.float64).reshape(-1)
+        scores = np.asarray(scores, np.float64).reshape(-1)  # tpu-lint: disable=005
         labels = np.asarray(labels, np.int64).reshape(-1)
         gt_labels = np.asarray(gt_labels, np.int64).reshape(-1)
         iou_full = np.zeros((len(masks), len(gt_masks)))

@@ -141,13 +141,17 @@ class DistriOptimizer(Optimizer):
         # Pin layouts so XLA partitions rather than replicates: params per
         # TP rules, slots per ZeRO-1, batch over 'data'.
         params_shape, _ = jax.eval_shape(
-            self.model.init, jax.random.PRNGKey(0))
+            self.model.init, jax.random.PRNGKey(0))  # tpu-lint: disable=004
         slots_shape = jax.eval_shape(self.method.init_slots, params_shape)
         p_sh = self._param_shardings(params_shape)
         s_sh = self._slot_shardings(slots_shape)
         rep = NamedSharding(self.mesh, P())
+        from bigdl_tpu.utils.compat import SUPPORTS_SHARDED_DONATION
         return jax.jit(
-            step, donate_argnums=(0, 1, 2),
+            step,
+            # old-jax GSPMD crashes aliasing donated buffers across the
+            # ZeRO-1 reshard — skip donation there (utils/compat.py)
+            donate_argnums=(0, 1, 2) if SUPPORTS_SHARDED_DONATION else (),
             # model_state & batches: None = keep the layout _place_* chose
             in_shardings=(p_sh, None, s_sh, None, None, rep, rep, rep),
             out_shardings=(p_sh, None, s_sh, rep))

@@ -50,7 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from bigdl_tpu.utils.compat import shard_map
 
 from bigdl_tpu.parallel.mesh import PIPE_AXIS
 
@@ -314,7 +314,7 @@ class Pipeline:
     def apply(self, pv, x, mesh: Mesh, training: bool = False, rng=None):
         S, M = self.n_stages, self.n_microbatches
         xs, mb = self._prep(x)
-        base_key = rng if rng is not None else jax.random.PRNGKey(0)
+        base_key = rng if rng is not None else jax.random.PRNGKey(0)  # tpu-lint: disable=004
         sig = ("apply", training, xs.shape, str(x.dtype), mesh)
         fn = self._compiled.get(sig)
         if fn is None:
@@ -429,7 +429,7 @@ class Pipeline:
         S, M = self.n_stages, self.n_microbatches
         xs, mb = self._prep(x)
         ys = y.reshape((S, M // S, mb) + y.shape[1:])
-        base_key = rng if rng is not None else jax.random.PRNGKey(0)
+        base_key = rng if rng is not None else jax.random.PRNGKey(0)  # tpu-lint: disable=004
         lp = loss_params if full else jnp.zeros((), jnp.float32)
         sig = ("train", full, xs.shape, str(x.dtype), ys.shape,
                str(y.dtype), loss_fn, mesh)

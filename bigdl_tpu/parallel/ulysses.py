@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from bigdl_tpu.utils.compat import axis_size, shard_map
 
 from bigdl_tpu.parallel.ring import SEQ_AXIS
 
@@ -32,7 +32,7 @@ def ulysses_attention(q, k, v, *, axis_name: str = SEQ_AXIS,
     on `axis_name`. Returns (B, H, T_local, d), sequence-sharded again.
     The axis size must divide the head count H (each device takes H/N
     heads after the all-to-all)."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     h = q.shape[1]
     if h % n:
         raise ValueError(f"seq-axis size {n} must divide head count {h}")

@@ -1,0 +1,48 @@
+"""JAX version-compatibility shims.
+
+The codebase targets the modern `jax.shard_map` API (top-level export,
+`check_vma=` kwarg). Older jax (< 0.5, e.g. 0.4.37 in some images) only
+has `jax.experimental.shard_map.shard_map` with the kwarg spelled
+`check_rep=`. Import `shard_map` from here and both work.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:
+    from jax import shard_map as _shard_map          # jax >= 0.5
+except ImportError:                                  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+MODERN_JAX = "check_vma" in inspect.signature(_shard_map).parameters
+
+if MODERN_JAX:
+    shard_map = _shard_map
+else:
+    def shard_map(*args, check_vma=None, **kwargs):
+        """Old-API adapter: `check_vma` → `check_rep` (same semantics:
+        skip the replication-invariance check of out_specs)."""
+        if check_vma is not None:
+            kwargs.setdefault("check_rep", check_vma)
+        return _shard_map(*args, **kwargs)
+
+# jax 0.4.x GSPMD crashes at dispatch (INTERNAL: Expected aliased input ...
+# to have the same size) when a donated input's per-device buffer differs
+# from the pinned out_sharding — exactly the ZeRO-1 reshard pattern of
+# DistriOptimizer. Modern jax handles that alias; on old jax we trade the
+# donation (2x transient param/slot memory) for correctness.
+SUPPORTS_SHARDED_DONATION = MODERN_JAX
+
+try:
+    from jax.lax import axis_size                    # jax >= 0.6
+except ImportError:                                  # pragma: no cover
+    import jax.core as _core
+
+    def axis_size(axis_name):
+        """Static size of a named mesh axis inside shard_map (old jax
+        spells it jax.core.axis_frame and returns the int directly)."""
+        return _core.axis_frame(axis_name)
+
+__all__ = ["shard_map", "axis_size", "MODERN_JAX",
+           "SUPPORTS_SHARDED_DONATION"]

@@ -1,0 +1,62 @@
+"""Deliberately-broken model factories for the graph-doctor CLI test
+(python -m bigdl_tpu.analysis resolves factories by import path, so these
+must live in an importable module, not inside a test function)."""
+
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.core.module import Module, ParamSpec, StateSpec
+
+
+class DeadParamLayer(Module):
+    """Declares 'unused' but never reads it."""
+
+    def param_specs(self):
+        return {"weight": ParamSpec((4, 4)), "unused": ParamSpec((7,))}
+
+    def forward(self, params, x, **_):
+        return x @ params["weight"]
+
+
+class StaleStateLayer(Module):
+    """Declares a buffer but never returns an updated one (the default
+    _apply returns `state` untouched)."""
+
+    def state_specs(self):
+        return {"counter": StateSpec((1,))}
+
+    def forward(self, params, x, **_):
+        return x
+
+
+class Float64Layer(Module):
+    """Declares a float64 param — an fp64 leak by construction."""
+
+    def param_specs(self):
+        return {"w": ParamSpec((4,), dtype=jnp.float64)}
+
+    def forward(self, params, x, **_):
+        return x * params["w"]
+
+
+class RogueDequantLayer(Module):
+    """int8 weights dequantized outside nn/quantized.py."""
+
+    def param_specs(self):
+        from bigdl_tpu.core import init as initializers
+        return {"wq": ParamSpec((4, 4), init=initializers.zeros,
+                                dtype=jnp.int8)}
+
+    def forward(self, params, x, **_):
+        return x @ params["wq"].astype(jnp.float32)
+
+
+def broken_shapes() -> nn.Sequential:
+    """Adjacent children with incompatible shapes: 4->5 feeds a 3-in
+    Linear."""
+    return nn.Sequential(nn.Linear(4, 5), nn.Linear(3, 2), name="model")
+
+
+def clean_mlp() -> nn.Sequential:
+    return nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2),
+                         name="mlp")

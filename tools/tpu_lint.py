@@ -1,0 +1,35 @@
+#!/usr/bin/env python
+"""Tracing-safety linter CLI — thin wrapper over
+bigdl_tpu/analysis/rules.py, loaded by file path so linting never imports
+jax (or the bigdl_tpu package): `python tools/tpu_lint.py` stays O(ms) and
+works in bare containers.
+
+Usage:
+  python tools/tpu_lint.py                   # lint bigdl_tpu/ vs baseline
+  python tools/tpu_lint.py --stats           # per-rule ratchet counts
+  python tools/tpu_lint.py --write-baseline  # regenerate the ratchet
+  python tools/tpu_lint.py path/to/file.py   # lint specific files
+
+Exit code: non-zero iff NEW (non-baselined) error-severity violations exist.
+See docs/static_analysis.md for rule ids and the pragma syntax.
+"""
+
+import importlib.util
+import os
+import sys
+
+_RULES_PY = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "bigdl_tpu", "analysis", "rules.py")
+
+
+def _load_rules():
+    spec = importlib.util.spec_from_file_location("_tpu_lint_rules",
+                                                  _RULES_PY)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod      # dataclasses resolves __module__
+    spec.loader.exec_module(mod)
+    return mod
+
+
+if __name__ == "__main__":
+    sys.exit(_load_rules().main(sys.argv[1:]))
