@@ -42,7 +42,17 @@ _METRICS = {
     "kernels": ("pallas_kernel_speedups", "ratio"),
     "resnet50_sweep": ("resnet50_bf16_mfu_best", "mfu"),
     "llama": ("llama_125m_train_throughput", "tokens/sec"),
+    "dispatch": ("fused_dispatch_cpu8_speedup", "ratio"),
 }
+
+# serialize against tools/tpu_watch.sh (ADVICE r5 #5). Env names + defaults
+# mirror the BIGDL_TPU_BENCH_* knobs in utils/config.py — read directly so
+# the parent process never imports the jax-loading package
+_LOCK_FILE = os.environ.get("BIGDL_TPU_BENCH_LOCK_FILE",
+                            "/tmp/bigdl_tpu_bench.lock")
+_LOCK_WAIT_S = int(os.environ.get("BIGDL_TPU_BENCH_LOCK_WAIT_S", "600"))
+_CONTENDED_LOADAVG = float(
+    os.environ.get("BIGDL_TPU_BENCH_CONTENDED_LOADAVG", "1.5"))
 
 # bf16 peak FLOPs/sec per chip, keyed by substring of device_kind
 _PEAK_FLOPS = [
@@ -363,6 +373,56 @@ def _bench_llama(batch_size=None, seq_len=None, warmup=None, iters=None):
     return batch_size * seq_len / sec, flops, sec
 
 
+def _bench_dispatch(batch_size=32, window=64, iters=256):
+    """Fused-dispatch amortization microbench: a small MLP trained through
+    the REAL DistriOptimizer.optimize() loop on an 8-virtual-device CPU
+    mesh (the PERF_r05 scaling-efficiency configuration), sweeping
+    steps_per_call K ∈ {1,2,4,8}. Per-K throughput is the BEST
+    post-compile flush window of the trainer's own throughput meter — the
+    best-sample convention _time_steps already uses (min over runs), since
+    single-window samples on a 1-core host swing ±30% with scheduler
+    noise. The number measures exactly what the fused path amortizes:
+    per-step Python dispatch + placement plumbing. Returns
+    {k: rec_per_sec}."""
+    import numpy as np
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import ArrayDataSet
+    from bigdl_tpu.optim.method import SGD
+    from bigdl_tpu.optim.trigger import Trigger
+    from bigdl_tpu.parallel import DistriOptimizer, create_mesh
+
+    class _Windows:                       # summary stub: collect rates only
+        def __init__(self):
+            self.rates = []
+
+        def add_scalar(self, name, v, step):
+            if name == "Throughput":
+                self.rates.append(v)
+
+    r = np.random.RandomState(0)
+    n = batch_size * (iters + window)     # one epoch covers the whole run
+    x = r.randn(n, 16).astype(np.float32)
+    y = r.randint(0, 2, n).astype(np.int32)
+    mesh = create_mesh(drop_trivial_axes=True)
+    rows = {}
+    for k in (1, 2, 4, 8, 16):
+        # the smallest honest train step: per-step device time on the
+        # 8-way-emulated 1-core mesh is ~#HLO-ops-bound, and it is the
+        # floor the amortization win is measured against
+        model = nn.Sequential(nn.Linear(16, 2), nn.LogSoftMax())
+        ds = ArrayDataSet(x, y, batch_size, drop_last=True, shuffle=False)
+        opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(), SGD(0.1),
+                              mesh=mesh, seed=0, steps_per_call=k)
+        opt._log_every = window
+        w = _Windows()
+        opt.set_train_summary(w)
+        opt.set_end_when(Trigger.max_iteration(iters))
+        opt.optimize()
+        post = w.rates[window:]           # first window eats compile
+        rows[k] = round(max(post), 1)
+    return rows
+
+
 def child_main():
     from bigdl_tpu.utils.platform import force_cpu_if_requested
     force_cpu_if_requested()
@@ -384,6 +444,35 @@ def child_main():
     peak = _peak_flops(getattr(dev, "device_kind", "")) \
         if backend != "cpu" else None
 
+    if which == "dispatch":
+        # CPU-mesh microbench by design (the parent forces FORCE_CPU=1 and
+        # an 8-device host platform): the win being measured is Python
+        # dispatch amortization, which a fast chip would only mask
+        metric, unit = _METRICS[which]
+        rows = _bench_dispatch()
+        base = rows.get(1) or 1e-9
+        speedups = {f"speedup_k{k}": round(v / base, 2)
+                    for k, v in rows.items() if k != 1}
+        # headline: best speedup among K >= 4 (the amortized regime; the
+        # per-K columns keep the full curve honest)
+        best = max(v / base for k, v in rows.items() if k >= 4)
+        print(json.dumps({
+            "metric": metric,
+            "value": round(best, 2),
+            "unit": unit,
+            "vs_baseline": 1.0,
+            "backend": backend,
+            "n_devices": len(jax.devices()),
+            "batch_size": 32,
+            "rec_per_sec": {f"k{k}": v for k, v in rows.items()},
+            **speedups,
+            "host": _host_provenance(),
+            "note": "small-model DistriOptimizer.optimize() on the "
+                    "8-virtual-device CPU mesh; K=1 runs the pre-fusion "
+                    "per-step dispatch path unchanged (bit-identical "
+                    "program)",
+        }))
+        return
     if which == "lenet":
         ips = _bench_lenet()
         metric, unit = _METRICS["lenet"]
@@ -545,6 +634,48 @@ def child_main():
 
 
 # -------------------------------------------------------------------- parent
+def _acquire_bench_lock():
+    """Exclusive flock shared with tools/tpu_watch.sh so the watcher's
+    battery and a driver-run bench never time each other's measurements
+    (ADVICE r5 #5 — the CPU trend series must not be polluted by the
+    harness). Returns (lock_fh, waited_s, timed_out); on timeout the bench
+    proceeds anyway but the JSON is annotated. Hold the fh until exit —
+    the lock dies with the process."""
+    import fcntl
+    try:
+        fh = open(_LOCK_FILE, "a")
+    except OSError:
+        return None, 0.0, False
+    t0 = time.time()
+    while True:
+        try:
+            fcntl.flock(fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            return fh, round(time.time() - t0, 1), False
+        except OSError:
+            if time.time() - t0 > _LOCK_WAIT_S:
+                return fh, round(time.time() - t0, 1), True
+            time.sleep(2.0)
+
+
+def _contention(rec, lock_waited, lock_timed_out):
+    """Annotate a result record with host contention evidence: loadavg
+    above the threshold means another process (the watcher, a test run)
+    was competing for the core during measurement."""
+    try:
+        la1 = os.getloadavg()[0]
+    except OSError:
+        la1 = None
+    if la1 is not None and la1 > _CONTENDED_LOADAVG:
+        rec["contended"] = True
+        rec["contended_loadavg_1m"] = round(la1, 2)
+    if lock_waited:
+        rec["lock_waited_s"] = lock_waited
+    if lock_timed_out:
+        rec["contended"] = True
+        rec["lock_timeout"] = True
+    return rec
+
+
 def _tpu_alive(timeout_s: int = 150) -> bool:
     """Cheap liveness probe in a throwaway child: the axon tunnel, when
     wedged, hangs jax backend init forever — burn 2.5 min here instead of
@@ -570,7 +701,17 @@ def parent_main():
     # finish inside the watcher's outer `timeout 1500` even when the
     # chip dies mid-battery and the tpu attempt burns its full 900s,
     # else the degraded record is never emitted at all.
-    if os.environ.get("BIGDL_TPU_ASSUME_ALIVE") == "1":
+    lock_fh, lock_waited, lock_timed_out = _acquire_bench_lock()
+    which_arg = sys.argv[1] if len(sys.argv) > 1 else "resnet50"
+    if which_arg == "dispatch":
+        # CPU-mesh microbench: 8 virtual devices, never a TPU attempt
+        xla = (os.environ.get("XLA_FLAGS", "") +
+               " --xla_force_host_platform_device_count=8").strip()
+        attempts = [
+            ("cpu-mesh8", {"BIGDL_TPU_FORCE_CPU": "1", "XLA_FLAGS": xla},
+             900),
+        ]
+    elif os.environ.get("BIGDL_TPU_ASSUME_ALIVE") == "1":
         attempts = [
             ("tpu", {}, 900),
             ("cpu-fallback", {"BIGDL_TPU_FORCE_CPU": "1"}, 450),
@@ -600,23 +741,21 @@ def parent_main():
         line = next((ln for ln in reversed(r.stdout.splitlines())
                      if ln.startswith("{")), None)
         if r.returncode == 0 and line:
+            rec = json.loads(line)
             if errors:               # note degraded path in the JSON itself
-                rec = json.loads(line)
                 rec["degraded"] = "; ".join(errors)
-                line = json.dumps(rec)
-            print(line)
+            print(json.dumps(_contention(rec, lock_waited, lock_timed_out)))
             return
         tail = (r.stderr or r.stdout or "")[-500:].replace("\n", " | ")
         errors.append(f"{name}: rc={r.returncode} {tail}")
-    which = sys.argv[1] if len(sys.argv) > 1 else "resnet50"
-    metric, unit = _METRICS.get(which, _METRICS["resnet50"])
-    print(json.dumps({
+    metric, unit = _METRICS.get(which_arg, _METRICS["resnet50"])
+    print(json.dumps(_contention({
         "metric": metric,
         "value": 0.0,
         "unit": unit,
         "vs_baseline": 0.0,
         "error": "; ".join(errors)[:2000],
-    }))
+    }, lock_waited, lock_timed_out)))
 
 
 if __name__ == "__main__":

@@ -97,6 +97,27 @@ def prefetch_to_device(it: Iterable, size: Optional[int] = None,
                 "re-iterate the same dataset until it exits")
 
 
+def stack_batches(it: Iterable, k: int) -> Iterator:
+    """Group k consecutive (x, y) host batches into one [k, batch, ...]
+    super-batch (np.stack, host-side — the fused dispatcher's K steps then
+    ride ONE H2D transfer instead of k). The epoch tail (fewer than k
+    batches left) is never dropped: tail batches stream through
+    individually with leading dim 1, so the consumer sees at most two
+    static shapes ([k, ...] and [1, ...]) and XLA compiles at most two
+    program variants."""
+    if k < 1:
+        raise ValueError(f"stack_batches needs k >= 1, got {k}")
+    buf = []
+    for batch in it:
+        buf.append(batch)
+        if len(buf) == k:
+            yield (np.stack([np.asarray(b[0]) for b in buf]),
+                   np.stack([np.asarray(b[1]) for b in buf]))
+            buf = []
+    for x, y in buf:                       # tail: leading dim 1, no drop
+        yield (np.asarray(x)[None], np.asarray(y)[None])
+
+
 class PrefetchDataSet:
     """Wrap an epoch-iterable dataset so each epoch's batches stream through
     `prefetch_to_device` — the trainer sees device-resident batches while
@@ -115,7 +136,9 @@ class PrefetchDataSet:
 class MTBatchPipeline:
     """Multithreaded per-sample transform → batch assembly (reference:
     MTImageFeatureToBatch.scala — N transformer threads filling one batch
-    buffer). Order within a batch is not guaranteed, matching the reference."""
+    buffer). Samples run through the pool concurrently but batches are
+    assembled in submission order (deterministic, unlike the reference's
+    racy buffer fill)."""
 
     def __init__(self, transform_fn: Callable, batch_size: int,
                  num_threads: int = 4):
@@ -124,12 +147,34 @@ class MTBatchPipeline:
         self.num_threads = num_threads
 
     def __call__(self, samples: Iterable) -> Iterator:
+        """Stream samples through the pool with bounded in-flight futures
+        (at most 2*num_threads + batch_size outstanding): the first batch
+        is yielded after batch_size samples complete, not after the whole
+        epoch is materialized and mapped. The tail partial batch is
+        yielded too (smaller leading dim) — callers needing fixed shapes
+        drop it themselves, the pipeline never silently loses records."""
+        from collections import deque
         from concurrent.futures import ThreadPoolExecutor
-        items = list(samples)
+
+        def emit(chunk):
+            return (np.stack([c[0] for c in chunk]),
+                    np.stack([c[1] for c in chunk]))
+
+        max_inflight = 2 * self.num_threads + self.batch_size
         with ThreadPoolExecutor(self.num_threads) as pool:
-            done = list(pool.map(self.transform_fn, items))
-        for i in range(0, len(done) - self.batch_size + 1, self.batch_size):
-            chunk = done[i:i + self.batch_size]
-            xs = np.stack([c[0] for c in chunk])
-            ys = np.stack([c[1] for c in chunk])
-            yield xs, ys
+            pending: deque = deque()
+            chunk = []
+            for sample in samples:
+                pending.append(pool.submit(self.transform_fn, sample))
+                if len(pending) > max_inflight:
+                    chunk.append(pending.popleft().result())
+                if len(chunk) == self.batch_size:
+                    yield emit(chunk)
+                    chunk = []
+            while pending:
+                chunk.append(pending.popleft().result())
+                if len(chunk) == self.batch_size:
+                    yield emit(chunk)
+                    chunk = []
+            if chunk:                       # tail partial batch, not dropped
+                yield emit(chunk)

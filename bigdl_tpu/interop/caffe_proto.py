@@ -143,8 +143,21 @@ class CaffeReshape(Module):
         if x.ndim == 4:
             x = jnp.transpose(x, (0, 3, 1, 2))
         in_shape = x.shape
-        out = [in_shape[i] if (d == 0 and i < len(in_shape)) else d
-               for i, d in enumerate(self.dims)]
+        out = []
+        for i, d in enumerate(self.dims):
+            if d == 0:
+                # caffe: dim 0 copies the input dim at the same index —
+                # beyond the input rank there is nothing to copy and caffe
+                # errors; a literal 0 here would silently produce a
+                # zero-size tensor (ADVICE r5)
+                if i >= len(in_shape):
+                    raise ValueError(
+                        f"caffe Reshape: dim index {i} is 0 (copy input "
+                        f"dim) but the input has only {len(in_shape)} "
+                        f"dims {tuple(in_shape)}")
+                out.append(in_shape[i])
+            else:
+                out.append(d)
         y = jnp.reshape(x, tuple(out))
         if y.ndim == 4:
             y = jnp.transpose(y, (0, 2, 3, 1))
@@ -640,6 +653,21 @@ def load(prototxt_path: str, caffemodel_path: Optional[str] = None,
             our_axis, dim_idx = _caffe_axis(axis, in_shape, lname, "Slice")
             total = in_shape[dim_idx]
             if pts:
+                # unsorted/duplicate/out-of-range points would silently
+                # build empty or negative-length Narrow slices (ADVICE r5)
+                if any(b <= a for a, b in zip(pts, pts[1:])):
+                    raise ValueError(
+                        f"caffe Slice {lname}: slice_point {pts} must be "
+                        f"strictly increasing")
+                if pts[0] <= 0 or pts[-1] >= total:
+                    raise ValueError(
+                        f"caffe Slice {lname}: slice_point {pts} out of "
+                        f"range (0, {total}) along the sliced axis")
+                if len(pts) != len(tops) - 1:
+                    raise ValueError(
+                        f"caffe Slice {lname}: {len(pts)} slice_point "
+                        f"values need {len(pts) + 1} tops, got "
+                        f"{len(tops)}")
                 starts = [0] + pts
                 ends = pts + [total]
             else:
@@ -676,10 +704,29 @@ def load(prototxt_path: str, caffemodel_path: Optional[str] = None,
             nchw_in = ([1] + ([in_shape[2], in_shape[0], in_shape[1]]
                               if len(in_shape) == 3 else list(in_shape)))
             total = int(np.prod(nchw_in))
-            out_nchw = [nchw_in[i] if (d == 0 and i < len(nchw_in)) else d
+            if any(d == 0 and i >= len(nchw_in)
+                   for i, d in enumerate(rdims)):
+                raise ValueError(
+                    f"caffe Reshape {lname}: a 0 dim (copy input dim) at "
+                    f"index >= the input rank {len(nchw_in)} has nothing "
+                    f"to copy (dims {rdims})")
+            out_nchw = [nchw_in[i] if d == 0 else d
                         for i, d in enumerate(rdims)]
             if -1 in out_nchw:
+                # this graph builds static shapes with an assumed batch of
+                # 1 — an explicit batch dim != 1 would make the inferred
+                # -1 wrong for the real runtime batch (ADVICE r5)
+                if rdims[0] not in (0, 1, -1):
+                    raise ValueError(
+                        f"caffe Reshape {lname}: explicit batch dim "
+                        f"{rdims[0]} conflicts with -1 inference (batch "
+                        f"is dynamic here; use 0 to copy it)")
                 known = int(np.prod([d for d in out_nchw if d != -1]))
+                if known == 0 or total % known:
+                    raise ValueError(
+                        f"caffe Reshape {lname}: cannot infer -1 — "
+                        f"{total} elements do not divide by the explicit "
+                        f"dims product {known} (dims {rdims})")
                 out_nchw[out_nchw.index(-1)] = total // known
             if len(out_nchw) == 4:
                 osh = (out_nchw[2], out_nchw[3], out_nchw[1])

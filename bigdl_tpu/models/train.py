@@ -43,6 +43,15 @@ def _common(p: argparse.ArgumentParser):
     p.add_argument("--synthetic-size", type=int, default=512)
     p.add_argument("--optimizer", default=None,
                    help="sgd|adam|rmsprop (model default otherwise)")
+    p.add_argument("--steps-per-call", type=int, default=None,
+                   help="fused dispatch: optimizer steps per jitted call "
+                        "(lax.scan over the train step; default "
+                        "BIGDL_TPU_STEPS_PER_CALL — see "
+                        "docs/performance.md)")
+    p.add_argument("--accum-steps", type=int, default=None,
+                   help="gradient accumulation: microbatches per "
+                        "optimizer step (batch size must divide; default "
+                        "BIGDL_TPU_ACCUM_STEPS)")
 
 
 def _end_trigger(args, default_epochs):
@@ -55,6 +64,10 @@ def _end_trigger(args, default_epochs):
 def _finish(opt, args, model, app):
     from bigdl_tpu.optim.trigger import Trigger
     from bigdl_tpu import visualization as viz
+    if getattr(args, "steps_per_call", None):
+        opt.set_steps_per_call(args.steps_per_call)
+    if getattr(args, "accum_steps", None):
+        opt.set_accum_steps(args.accum_steps)
     if args.checkpoint:
         opt.set_checkpoint(args.checkpoint, Trigger.every_epoch())
     if args.summary_dir:

@@ -80,10 +80,22 @@ class Optimizer:
 
     def __init__(self, model: Module, dataset, criterion: Criterion,
                  optim_method: Optional[OptimMethod] = None,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None,
+                 steps_per_call: Optional[int] = None,
+                 accum_steps: Optional[int] = None):
         from bigdl_tpu.utils import config
         if seed is None:
             seed = config.get("SEED")
+        if steps_per_call is None:
+            steps_per_call = config.get("STEPS_PER_CALL")
+        if accum_steps is None:
+            accum_steps = config.get("ACCUM_STEPS")
+        if steps_per_call < 1 or accum_steps < 1:
+            raise ValueError(
+                f"steps_per_call ({steps_per_call}) and accum_steps "
+                f"({accum_steps}) must be >= 1")
+        self.steps_per_call = int(steps_per_call)
+        self.accum_steps = int(accum_steps)
         Optimizer._live_instances += 1
         if config.get("CHECK_SINGLETON") and Optimizer._live_instances > 1:
             log.warning(
@@ -132,6 +144,29 @@ class Optimizer:
 
     def set_constant_gradient_clipping(self, min_v: float, max_v: float):
         self.grad_processors.append(ConstantClipping(min_v, max_v))
+        return self
+
+    def set_steps_per_call(self, k: int):
+        """Fused dispatch: run K optimizer steps per jitted call via
+        lax.scan (BIGDL_TPU_STEPS_PER_CALL). Triggers and counters advance
+        in K-sized strides — validation/checkpoint/end_when fire at the
+        next K boundary after their nominal iteration (documented in
+        docs/performance.md). K=1 keeps today's per-step dispatch
+        bit-identical."""
+        if k < 1:
+            raise ValueError(f"steps_per_call must be >= 1, got {k}")
+        self.steps_per_call = int(k)
+        return self
+
+    def set_accum_steps(self, m: int):
+        """Gradient accumulation: split each batch into M microbatches
+        inside the jitted step, average their gradients, apply one
+        optimizer update (BIGDL_TPU_ACCUM_STEPS). The batch dimension must
+        divide by M. Composes with steps_per_call — both run in the same
+        jitted program."""
+        if m < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {m}")
+        self.accum_steps = int(m)
         return self
 
     def set_train_summary(self, summary):
@@ -194,8 +229,127 @@ class Optimizer:
 
         return step
 
+    def _make_accum_step(self, accum_steps: int, compute_dtype=None) -> Callable:
+        """Gradient-accumulation variant of `_make_step`: the batch is
+        split into `accum_steps` microbatches, an inner `lax.scan` averages
+        their gradients (model_state threaded sequentially, so BN running
+        stats see every microbatch), then ONE optimizer update is applied —
+        the reference's mini-batch aggregation (DistriOptimizer sums
+        sub-batch gradients before the update). Same signature as the
+        `_make_step` body, so the fused dispatcher scans over either.
+        Per-microbatch rng is `fold_in(rng, microbatch_index)` (dropout
+        masks differ across microbatches)."""
+        from bigdl_tpu.core.module import cast_floating
+        model, criterion, method = self.model, self.criterion, self.method
+        processors = list(self.grad_processors)
+        frozen = any(m._frozen for m in model.modules())
+        M = accum_steps
+
+        def step(params, model_state, slots, x, y, lr, step_num, rng):
+            if x.shape[0] % M:
+                raise ValueError(
+                    f"batch of {x.shape[0]} rows does not divide into "
+                    f"accum_steps={M} microbatches")
+            xs = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+            ys = y.reshape((M, y.shape[0] // M) + y.shape[1:])
+
+            def grad_one(ms, xm, ym, r):
+                def loss_fn(p):
+                    pc = cast_floating(p, compute_dtype) if compute_dtype \
+                        else p
+                    xc = (xm.astype(compute_dtype)
+                          if compute_dtype
+                          and jnp.issubdtype(xm.dtype, jnp.floating)
+                          else xm)
+                    out, new_ms = model.apply(pc, ms, xc,
+                                              training=True, rng=r)
+                    if compute_dtype:
+                        out = jax.tree.map(
+                            lambda o: o.astype(jnp.float32)
+                            if jnp.issubdtype(o.dtype, jnp.floating) else o,
+                            out)
+                    return criterion.forward(out, ym), new_ms
+
+                (loss, new_ms), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                if compute_dtype:
+                    grads = cast_floating(grads, jnp.float32)
+                return loss, new_ms, grads
+
+            def body(carry, inp):
+                ms, gsum, lsum = carry
+                xm, ym, m_idx = inp
+                loss, new_ms, grads = grad_one(
+                    ms, xm, ym, jax.random.fold_in(rng, m_idx))
+                gsum = jax.tree.map(jnp.add, gsum, grads)
+                return (new_ms, gsum, lsum + loss), None
+
+            (new_ms, gsum, lsum), _ = jax.lax.scan(
+                body,
+                (model_state, jax.tree.map(jnp.zeros_like, params),
+                 jnp.float32(0.0)),
+                (xs, ys, jnp.arange(M)))
+            # equal-sized microbatches: mean of per-microbatch mean losses
+            # and gradients equals the full-batch mean
+            grads = jax.tree.map(lambda g: g / M, gsum)
+            loss = lsum / M
+            for proc in processors:
+                grads = proc(grads, params)
+            if not frozen:
+                new_params, new_slots = method.update(params, grads, slots,
+                                                      lr, step_num)
+            else:
+                tm = model.trainable_mask(params)
+                old_params = params
+                new_params, new_slots = method.update(params, grads, slots,
+                                                      lr, step_num)
+                new_params = jax.tree.map(
+                    lambda trainable, new, old: new if trainable is True
+                    else (old if trainable is False
+                          else jnp.where(trainable, new, old)),
+                    tm, new_params, old_params)
+            return new_params, new_ms, new_slots, loss
+
+        return step
+
+    def _make_fused_step(self, accum_steps: int = 1,
+                         compute_dtype=None) -> Callable:
+        """One XLA program that runs K optimizer steps back-to-back:
+        `lax.scan` over the per-step body (plain `_make_step` when
+        accum_steps == 1, the accumulating body otherwise). Inputs are the
+        K-stacked (xs, ys) super-batch plus per-step (lr, neval, rng)
+        threaded as scan inputs; output is the K-stacked per-step losses,
+        which ride the existing `_pending`/`_flush_metrics` buffering
+        unchanged. K is implicit in the stacked leading dim, so the same
+        jitted callable also serves the epoch's tail batches (leading
+        dim 1 — at most one extra compile)."""
+        body_step = (self._make_step(compute_dtype) if accum_steps == 1
+                     else self._make_accum_step(accum_steps, compute_dtype))
+
+        def fused(params, model_state, slots, xs, ys, lrs, step_nums, rngs):
+            def body(carry, inp):
+                p, ms, sl = carry
+                x, y, lr, n, r = inp
+                p, ms, sl, loss = body_step(p, ms, sl, x, y, lr, n, r)
+                return (p, ms, sl), loss
+
+            (params, model_state, slots), losses = jax.lax.scan(
+                body, (params, model_state, slots),
+                (xs, ys, lrs, step_nums, rngs))
+            return params, model_state, slots, losses
+
+        return fused
+
     def _build_step(self) -> Callable:
         return jax.jit(self._make_step(), donate_argnums=(0, 1, 2))
+
+    def _build_fused_step(self) -> Callable:
+        # local trainer: jit with donation; the distributed trainer
+        # overrides this with mesh shardings for the stacked batches
+        return jax.jit(
+            self._make_fused_step(self.accum_steps,
+                                  getattr(self, "compute_dtype", None)),
+            donate_argnums=(0, 1, 2))
 
     # ----------------------------------------------------- placement hooks
     # Overridden by parallel.DistriOptimizer to lay trees/batches out on the
@@ -205,6 +359,12 @@ class Optimizer:
 
     def _place_batch(self, x, y):
         return jnp.asarray(x), jnp.asarray(y)
+
+    def _place_stacked_batch(self, xs, ys):
+        """Place a K-stacked super-batch ([K, batch, ...]) in ONE H2D
+        transfer. The distributed trainer overrides this to shard the
+        batch dim (dim 1) over the mesh's data axis."""
+        return jnp.asarray(xs), jnp.asarray(ys)
 
     def _batch_iter(self, epoch_iter):
         """Stream (x, y) batches through host→device prefetch so the H2D
@@ -223,6 +383,33 @@ class Optimizer:
             return (self._place_batch(x, y) for x, y in epoch_iter)
         return prefetch_to_device(
             epoch_iter, size, place_fn=lambda b: self._place_batch(*b))
+
+    def _fused_batch_iter(self, epoch_iter):
+        """K-grouped variant of `_batch_iter` for the fused dispatch path:
+        host batches are stacked into [K, batch, ...] super-batches BEFORE
+        placement (dataset/prefetch.py stack_batches), so the K batches
+        ride one H2D transfer instead of K. The epoch tail (fewer than K
+        batches left) streams through with leading dim 1."""
+        from bigdl_tpu.dataset.prefetch import (prefetch_to_device,
+                                                stack_batches)
+        from bigdl_tpu.utils import config
+        grouped = stack_batches(epoch_iter, self.steps_per_call)
+        size = config.get("PREFETCH_SIZE")
+        if not size or size <= 0:
+            return (self._place_stacked_batch(xs, ys) for xs, ys in grouped)
+        return prefetch_to_device(
+            grouped, size, place_fn=lambda b: self._place_stacked_batch(*b))
+
+    def _fused_epoch_source(self):
+        """The iterable the fused path stacks from. A PrefetchDataSet
+        already device-places every batch — stacking those would bounce
+        each batch device→host→device, so unwrap to its inner host-side
+        dataset (counters/fast-forward delegate through __getattr__, so
+        resume bookkeeping is unaffected)."""
+        from bigdl_tpu.dataset.prefetch import PrefetchDataSet
+        if isinstance(self.dataset, PrefetchDataSet):
+            return self.dataset.dataset
+        return self.dataset
 
     def _build_eval_fn(self):
         return jax.jit(
@@ -296,7 +483,13 @@ class Optimizer:
                 jax.random.fold_in(rng, 0xBD1))
             slots = self.method.init_slots(params)
         params, model_state, slots = self._place_trees(params, model_state, slots)
-        step = self._build_step()
+        self._step_rng = step_rng
+        # steps_per_call == accum_steps == 1 takes the pre-existing
+        # per-step dispatch path bit-identically (same step builder, same
+        # loop); anything else compiles the fused K-step scan program
+        use_fused = self.steps_per_call > 1 or self.accum_steps > 1
+        step = None if use_fused else self._build_step()
+        fused_step = self._build_fused_step() if use_fused else None
         st = self.state
 
         self._eval_fn = self._build_eval_fn()
@@ -333,7 +526,8 @@ class Optimizer:
                 if hasattr(self.dataset, "fast_forward_batches"):
                     self.dataset.fast_forward_batches(skip)
                     skip = 0
-            epoch_iter = iter(self.dataset)
+            epoch_iter = (iter(self._fused_epoch_source()) if use_fused
+                          else iter(self.dataset))
             if skip > 0:
                 # consume-and-discard fallback: decodes every skipped
                 # batch, so a late-epoch resume can cost close to a full
@@ -349,7 +543,11 @@ class Optimizer:
                     skipped += 1
                 log.info("fast-forward consumed %d/%d batches in %.1fs",
                          skipped, skip, time.time() - t_ff)
-            for xd, yd in self._batch_iter(epoch_iter):
+            if use_fused:
+                (params, model_state, slots, epoch_records,
+                 ended_mid_epoch) = self._fused_epoch(
+                    fused_step, epoch_iter, params, model_state, slots, st)
+            for xd, yd in (() if use_fused else self._batch_iter(epoch_iter)):
                 lr = self.method.current_lr(st)
                 sub = jax.random.fold_in(step_rng, st["neval"])
                 if self._param_summary_enabled():
@@ -405,6 +603,104 @@ class Optimizer:
         self.params, self.model_state, self.slots = params, model_state, slots
         return params, model_state
 
+    # ------------------------------------------------- fused dispatch path
+    def _fused_inputs(self, st, k):
+        """Stack the next k steps' (lr, neval, rng) host-side. Schedules
+        are arbitrary Python (reference: optim/SGD.scala hyper-parameter
+        handling), so lrs are computed here per sub-step — the sub-step
+        state advances `neval` only; loss/score-driven schedules (Plateau,
+        min_loss) see values as of the last flush for all k steps. The rng
+        stream is exactly the unfused path's: fold_in(step_rng, neval)."""
+        lr_list, nevals = [], []
+        for i in range(k):
+            sub_state = dict(st)
+            sub_state["neval"] = st["neval"] + i
+            lr_list.append(self.method.current_lr(sub_state))
+            nevals.append(st["neval"] + i)
+        # ONE dispatch derives all k step keys (vmapped fold_in computes
+        # the identical per-step keys) — k eager fold_in calls would hand
+        # back most of the per-step dispatch cost the fusion just removed
+        fns = self.__dict__.setdefault("_fold_keys_fns", {})
+        fold_keys = fns.get(k)
+        if fold_keys is None:
+            fold_keys = jax.jit(lambda key, start: jax.vmap(
+                lambda i: jax.random.fold_in(key, i))(
+                    start + jnp.arange(k)))
+            fns[k] = fold_keys
+        rngs = fold_keys(self._step_rng, jnp.int32(st["neval"]))
+        return (jnp.asarray(lr_list, jnp.float32),
+                jnp.asarray(nevals, jnp.int32),
+                rngs, lr_list)
+
+    def _fused_epoch(self, fused_step, epoch_iter, params, model_state,
+                     slots, st):
+        """One epoch through the fused dispatcher: one jitted call runs K
+        optimizer steps, so counters, the metric buffer, and trigger
+        checks advance in K-sized strides. Validation/checkpoint/end_when
+        are evaluated once per call — a trigger nominally matching
+        iteration i fires at the next K boundary >= i
+        (fire-at-next-K-boundary; asserted by tests/test_fused_dispatch.py).
+        Checkpoints therefore always land on K boundaries (modulo the
+        epoch tail), so a mid-epoch resume's batch cursor re-aligns with
+        the K-grouping automatically: the surviving run re-groups whatever
+        batches remain."""
+        epoch_records = 0
+        ended_mid_epoch = False
+        W = self._log_every
+        for xs, ys in self._fused_batch_iter(epoch_iter):
+            k = int(xs.shape[0])
+            lrs, nevals, rngs, lr_list = self._fused_inputs(st, k)
+            if self._param_summary_enabled():
+                self._last_batch = (xs[-1], ys[-1], rngs[-1])
+            params, model_state, slots, losses = fused_step(
+                params, model_state, slots, xs, ys, lrs, nevals, rngs)
+            n = int(xs.shape[1])           # GLOBAL batch rows per step
+            start = st["neval"]
+            for i in range(k):
+                # per-step losses are lazy slices of the stacked device
+                # array — they ride _pending/_flush_metrics unchanged
+                self._pending.append((start + i + 1, lr_list[i], losses[i]))
+            st["neval"] += k
+            st["records"] += k * n
+            st["batch_in_epoch"] = st.get("batch_in_epoch", 0) + k
+            epoch_records += k * n
+            self._window_records += k * n
+            if st["neval"] // W != start // W:   # crossed a log boundary
+                self._flush_metrics(st)
+            # fire-at-next-K-boundary: a per-iteration trigger whose
+            # nominal iteration fell INSIDE this stride (e.g.
+            # several_iteration(5) at neval 5 with K=2 landing on 6) must
+            # not be skipped — probe every sub-step's neval
+            if self._param_summary_enabled():
+                trig = self._summary.get_summary_trigger("Parameters")
+                self._maybe_param_summary(
+                    params, model_state, st,
+                    fired=self._stride_fired(trig, st, start, k))
+            self._maybe_validate(
+                params, model_state, st,
+                fired=self._stride_fired(self.val_trigger, st, start, k))
+            self._maybe_checkpoint(
+                params, model_state, slots, st,
+                fired=self._stride_fired(self.ckpt_trigger, st, start, k))
+            if self.end_when(st):
+                ended_mid_epoch = True
+                break
+        return params, model_state, slots, epoch_records, ended_mid_epoch
+
+    @staticmethod
+    def _stride_fired(trigger, st, start, k):
+        """Would `trigger` have fired at ANY iteration in (start, start+k]?
+        Probes sub-states advancing neval only — loss/score fields hold
+        their last-flushed values for the whole stride."""
+        if trigger is None:
+            return False
+        for i in range(1, k + 1):
+            sub = dict(st)
+            sub["neval"] = start + i
+            if trigger(sub):
+                return True
+        return False
+
     # ------------------------------------------------------------- internals
     def _flush_metrics(self, st):
         """Fetch pending device losses (blocks only until the last dispatched
@@ -434,7 +730,7 @@ class Optimizer:
             self._summary, "get_summary_trigger",
             lambda _n: None)("Parameters") is not None
 
-    def _maybe_param_summary(self, params, model_state, st):
+    def _maybe_param_summary(self, params, model_state, st, fired=None):
         """Per-parameter histogram dumps when the train summary carries a
         'Parameters' trigger (reference: optim/AbstractOptimizer.scala:47-91
         — trainSummary.setSummaryTrigger("Parameters", ...) dumps the
@@ -448,8 +744,10 @@ class Optimizer:
         have not yet been donated to the next step)."""
         if not self._param_summary_enabled():
             return
-        trig = self._summary.get_summary_trigger("Parameters")
-        if not trig(st):
+        if fired is None:
+            trig = self._summary.get_summary_trigger("Parameters")
+            fired = bool(trig(st))
+        if not fired:
             return
         if getattr(self, "_last_hist_neval", -1) == st["neval"]:
             return
@@ -516,8 +814,12 @@ class Optimizer:
                             _np.asarray(jax.device_get(g)), st["neval"])
         walk(params, grads, "")
 
-    def _maybe_validate(self, params, model_state, st):
-        if self.val_trigger is None or not self.val_trigger(st):
+    def _maybe_validate(self, params, model_state, st, fired=None):
+        # `fired` overrides the trigger check — the fused dispatcher
+        # probes every sub-step of its K-stride (fire-at-next-K-boundary)
+        if fired is None:
+            fired = self.val_trigger is not None and self.val_trigger(st)
+        if not fired:
             return
         # a trigger can match both on an epoch's last iteration and again at
         # epoch end — don't run validation twice for the same step
@@ -536,8 +838,10 @@ class Optimizer:
         if self.val_methods:
             st["score"] = totals[self.val_methods[0].name].result
 
-    def _maybe_checkpoint(self, params, model_state, slots, st):
-        if self.ckpt_trigger is None or not self.ckpt_trigger(st):
+    def _maybe_checkpoint(self, params, model_state, slots, st, fired=None):
+        if fired is None:
+            fired = self.ckpt_trigger is not None and self.ckpt_trigger(st)
+        if not fired:
             return
         if getattr(self, "_last_ckpt_neval", -1) == st["neval"]:
             return

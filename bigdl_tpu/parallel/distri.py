@@ -65,6 +65,12 @@ class DistriOptimizer(Optimizer):
       compute_dtype  — bf16 forward/backward with fp32 master weights
                        (the TPU-native form of the reference's FP16 wire
                        compression + fp32 master copy).
+      steps_per_call — fused dispatch: K optimizer steps per jitted call
+                       (lax.scan over the step body; one H2D transfer for
+                       the K-stacked super-batch). Default from
+                       BIGDL_TPU_STEPS_PER_CALL. See docs/performance.md.
+      accum_steps    — microbatch gradient accumulation inside the same
+                       jitted program (BIGDL_TPU_ACCUM_STEPS).
     """
 
     def __init__(self, model: Module, dataset, criterion: Criterion,
@@ -73,8 +79,12 @@ class DistriOptimizer(Optimizer):
                  rules: Optional[ShardingRules] = None,
                  zero1: bool = True,
                  compute_dtype: Any = None,
-                 seed: Optional[int] = None):
-        super().__init__(model, dataset, criterion, optim_method, seed=seed)
+                 seed: Optional[int] = None,
+                 steps_per_call: Optional[int] = None,
+                 accum_steps: Optional[int] = None):
+        super().__init__(model, dataset, criterion, optim_method, seed=seed,
+                         steps_per_call=steps_per_call,
+                         accum_steps=accum_steps)
         if compute_dtype is None:
             # reference: FP16 wire compression knob; here the bf16 policy
             from bigdl_tpu.utils import config
@@ -135,6 +145,31 @@ class DistriOptimizer(Optimizer):
     def _place_batch(self, x, y):
         return self._place_array(x), self._place_array(y)
 
+    # -------------------------------------------- fused (stacked) batches
+    def _stacked_batch_sharding(self, arr):
+        """Layout for a [K, batch, ...] super-batch: the steps dim (0) is
+        replicated — every device walks the same K scan iterations — and
+        the batch dim (1) shards over the data axis exactly like an
+        unstacked batch's dim 0."""
+        spec = batch_spec(self.mesh, arr.ndim - 1)
+        return NamedSharding(self.mesh, P(None, *spec))
+
+    def _place_stacked_array(self, x):
+        import numpy as np
+        x = np.asarray(x)
+        if self._data_axis_size > 1 and x.shape[1] % self._data_axis_size:
+            raise ValueError(
+                f"global batch of {x.shape[1]} rows does not divide over "
+                f"the {self._data_axis_size}-way data axis — use a "
+                f"batch_size that is a multiple of {self._data_axis_size}")
+        sh = self._stacked_batch_sharding(x)
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(sh, x)
+        return jax.device_put(x, sh)
+
+    def _place_stacked_batch(self, xs, ys):
+        return self._place_stacked_array(xs), self._place_stacked_array(ys)
+
     # ------------------------------------------------------------ step build
     def _build_step(self):
         step = self._make_step(self.compute_dtype)
@@ -153,6 +188,28 @@ class DistriOptimizer(Optimizer):
             # ZeRO-1 reshard — skip donation there (utils/compat.py)
             donate_argnums=(0, 1, 2) if SUPPORTS_SHARDED_DONATION else (),
             # model_state & batches: None = keep the layout _place_* chose
+            in_shardings=(p_sh, None, s_sh, None, None, rep, rep, rep),
+            out_shardings=(p_sh, None, s_sh, rep))
+
+    def _build_fused_step(self):
+        """Mesh-pinned build of the K-step fused program: params per TP
+        rules, slots per ZeRO-1, the stacked super-batch sharded on its
+        batch dim (dim 1) over 'data', per-step (lr, neval, rng) stacks
+        and the stacked per-step losses replicated. Same
+        SUPPORTS_SHARDED_DONATION guard as the single-step build — old-jax
+        GSPMD crashes aliasing donated buffers across the ZeRO-1
+        reshard."""
+        fused = self._make_fused_step(self.accum_steps, self.compute_dtype)
+        params_shape, _ = jax.eval_shape(
+            self.model.init, jax.random.PRNGKey(0))  # tpu-lint: disable=004
+        slots_shape = jax.eval_shape(self.method.init_slots, params_shape)
+        p_sh = self._param_shardings(params_shape)
+        s_sh = self._slot_shardings(slots_shape)
+        rep = NamedSharding(self.mesh, P())
+        from bigdl_tpu.utils.compat import SUPPORTS_SHARDED_DONATION
+        return jax.jit(
+            fused,
+            donate_argnums=(0, 1, 2) if SUPPORTS_SHARDED_DONATION else (),
             in_shardings=(p_sh, None, s_sh, None, None, rep, rep, rep),
             out_shardings=(p_sh, None, s_sh, rep))
 

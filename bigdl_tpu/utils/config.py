@@ -67,6 +67,29 @@ _register("CHECK_SINGLETON", False, _bool,
 _register("LOG_THROUGHPUT_EVERY", 20, int,
           "Iterations between trainer log lines "
           "(reference: per-iteration Throughput log)")
+_register("STEPS_PER_CALL", 1, int,
+          "Fused dispatch: optimizer steps per jitted call. K>1 stacks K "
+          "host batches into one super-batch (one H2D transfer) and runs "
+          "lax.scan over the train step on device, amortizing the Python "
+          "dispatch that dominates small per-device workloads "
+          "(optim/local.py; reference: the per-iteration Spark job "
+          "overhead DistriOptimizer.scala:185-516 pays twice per step)")
+_register("ACCUM_STEPS", 1, int,
+          "Gradient accumulation: microbatches per optimizer step. M>1 "
+          "splits each batch into M microbatches inside the jitted step, "
+          "scans over them averaging gradients, then applies ONE update — "
+          "the reference's mini-batch aggregation "
+          "(optim/DistriOptimizer.scala gradient sum over sub-batches)")
+_register("BENCH_LOCK_FILE", "/tmp/bigdl_tpu_bench.lock", str,
+          "Lockfile serializing bench.py against tools/tpu_watch.sh so "
+          "the harness cannot pollute the CPU trend series (ADVICE r5 #5)")
+_register("BENCH_LOCK_WAIT_S", 600, int,
+          "Max seconds bench.py waits for the bench lockfile before "
+          "proceeding anyway (annotated in the JSON)")
+_register("BENCH_CONTENDED_LOADAVG", 1.5, float,
+          "loadavg_1m threshold above which bench.py marks its JSON "
+          "record {contended: true} — a loaded host masquerades as a "
+          "code regression otherwise (ROUND5_NOTES.md r4→r3 scare)")
 
 
 def get(name: str):

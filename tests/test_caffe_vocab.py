@@ -239,6 +239,61 @@ layer { name: "rs" type: "Reshape" bottom: "data" top: "rs"
     np.testing.assert_allclose(out2, ref2, atol=1e-7)
 
 
+def test_reshape_zero_dim_beyond_rank_refused(tmp_path):
+    """dim: 0 copies the input dim at the same index — beyond the input
+    rank there is nothing to copy; caffe errors, so must we (ADVICE r5)."""
+    with pytest.raises(ValueError, match="nothing to copy"):
+        _load(tmp_path, _HDR + '''
+layer { name: "rs" type: "Reshape" bottom: "data" top: "rs"
+  reshape_param { shape { dim: 0 dim: 3 dim: 36 dim: 1 dim: 0 } } }
+''')
+
+
+def test_reshape_explicit_batch_with_infer_refused(tmp_path):
+    """-1 inference assumes the load-time batch of 1; an explicit batch
+    dim != 1 would make the inferred dim wrong at runtime (ADVICE r5)."""
+    with pytest.raises(ValueError, match="batch dim"):
+        _load(tmp_path, _HDR + '''
+layer { name: "rs" type: "Reshape" bottom: "data" top: "rs"
+  reshape_param { shape { dim: 2 dim: -1 } } }
+''')
+
+
+def test_reshape_indivisible_infer_refused(tmp_path):
+    # 3*6*6 = 108 elements do not divide by 7
+    with pytest.raises(ValueError, match="cannot infer -1"):
+        _load(tmp_path, _HDR + '''
+layer { name: "rs" type: "Reshape" bottom: "data" top: "rs"
+  reshape_param { shape { dim: 0 dim: 7 dim: -1 } } }
+''')
+
+
+@pytest.mark.parametrize("pts,match", [
+    ("slice_point: 2 slice_point: 1", "strictly increasing"),
+    ("slice_point: 2 slice_point: 2", "strictly increasing"),
+    ("slice_point: 0", "out of range"),
+    ("slice_point: 3", "out of range"),
+])
+def test_slice_bad_points_refused(tmp_path, pts, match):
+    """Unsorted / duplicate / out-of-range slice_point values built empty
+    or negative-length Narrow slices silently (ADVICE r5)."""
+    tops = "top: \"a\" top: \"b\" top: \"c\"" \
+        if pts.count("slice_point") == 2 else "top: \"a\" top: \"b\""
+    with pytest.raises(ValueError, match=match):
+        _load(tmp_path, _HDR + f'''
+layer {{ name: "sl" type: "Slice" bottom: "data" {tops}
+  slice_param {{ axis: 1 {pts} }} }}
+''')
+
+
+def test_slice_top_count_mismatch_refused(tmp_path):
+    with pytest.raises(ValueError, match="tops"):
+        _load(tmp_path, _HDR + '''
+layer { name: "sl" type: "Slice" bottom: "data" top: "a" top: "b" top: "c"
+  slice_param { axis: 1 slice_point: 1 } }
+''')
+
+
 def test_bias_layer(tmp_path):
     r = np.random.RandomState(10)
     x = r.randn(2, 6, 6, 3).astype(np.float32)

@@ -165,6 +165,43 @@ def test_mt_batch_pipeline():
     got = list(mt(items))
     assert len(got) == 2
     assert got[0][0].shape == (4, 3, 3)
+    # batches assemble in submission order
+    np.testing.assert_array_equal(got[0][1], np.arange(4))
+    np.testing.assert_array_equal(got[1][1], np.arange(4, 8))
+
+
+def test_mt_batch_pipeline_yields_tail_partial_batch():
+    """The tail partial batch is yielded, not silently dropped — callers
+    wanting one fixed XLA shape drop it themselves."""
+    from bigdl_tpu.dataset.prefetch import MTBatchPipeline
+    items = [(np.full((2,), i, np.float32), i) for i in range(10)]
+    mt = MTBatchPipeline(lambda s: s, batch_size=4, num_threads=2)
+    got = list(mt(items))
+    assert [g[0].shape[0] for g in got] == [4, 4, 2]
+    np.testing.assert_array_equal(got[2][1], [8, 9])
+
+
+def test_mt_batch_pipeline_streams_with_bounded_inflight():
+    """The first batch must surface long before the source is exhausted
+    (the old implementation materialized list(samples) and mapped the
+    whole epoch first), and in-flight work stays bounded."""
+    from bigdl_tpu.dataset.prefetch import MTBatchPipeline
+    consumed = {"n": 0}
+
+    def source(n=500):
+        for i in range(n):
+            consumed["n"] = i + 1
+            yield (np.full((2,), i, np.float32), i)
+
+    mt = MTBatchPipeline(lambda s: s, batch_size=4, num_threads=2)
+    it = mt(source())
+    first = next(it)
+    assert first[0].shape[0] == 4
+    # bounded read-ahead: batch + max_inflight (2*threads + batch) + 1
+    assert consumed["n"] <= 4 + (2 * 2 + 4) + 1
+    rest = list(it)
+    assert consumed["n"] == 500
+    assert sum(g[0].shape[0] for g in [first] + rest) == 500
 
 
 # --------------------------------------------- ROI label transforms

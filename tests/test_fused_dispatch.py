@@ -1,0 +1,263 @@
+"""Fused multi-step dispatch (`steps_per_call` scan) + gradient
+accumulation: equivalence with the unfused baseline, trigger stride
+semantics, tail handling, and the stacked-batch plumbing
+(optim/local.py `_fused_epoch`, parallel/distri.py `_build_fused_step`,
+dataset/prefetch.py `stack_batches`)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.dataset import ArrayDataSet
+from bigdl_tpu.optim.local import Optimizer
+from bigdl_tpu.optim.method import SGD, Adam
+from bigdl_tpu.optim.trigger import Trigger
+
+R = np.random.RandomState(0)
+X = R.randn(96, 6).astype(np.float32)
+Y = (X[:, 0] > 0).astype(np.int32)
+
+
+def _model(dropout=0.0):
+    layers = [nn.Linear(6, 16), nn.ReLU()]
+    if dropout:
+        layers.append(nn.Dropout(dropout))
+    layers += [nn.Linear(16, 2), nn.LogSoftMax()]
+    return nn.Sequential(*layers)
+
+
+class _Collect:
+    """Summary stub: records the per-step Loss scalars the trainer
+    flushes, keyed by iteration."""
+
+    def __init__(self):
+        self.losses = {}
+
+    def add_scalar(self, name, v, step):
+        if name == "Loss":
+            self.losses[step] = v
+
+
+def _run(K, M=1, iters=6, bs=16, dropout=0.0, method=None, log_every=2):
+    ds = ArrayDataSet(X, Y, bs, drop_last=True, shuffle=False)
+    opt = Optimizer(_model(dropout), ds, nn.ClassNLLCriterion(),
+                    method or Adam(1e-2), seed=5,
+                    steps_per_call=K, accum_steps=M)
+    opt._log_every = log_every
+    col = _Collect()
+    opt.set_train_summary(col)
+    opt.set_end_when(Trigger.max_iteration(iters))
+    params, _ = opt.optimize()
+    return params, opt, col
+
+
+def _assert_trees_close(a, b, rtol=2e-6, atol=2e-7):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=rtol, atol=atol)
+
+
+# ------------------------------------------------------------ equivalence
+@pytest.mark.parametrize("K", [2, 4])
+def test_fused_k_matches_unfused_params_slots_losses(K):
+    """After N total steps, params, optimizer slots, and the per-step loss
+    sequence from steps_per_call=K match the K=1 baseline (same batches,
+    same per-step lr/neval/rng threading through the scan)."""
+    p1, o1, c1 = _run(1, iters=6)
+    pk, ok, ck = _run(K, iters=6)
+    _assert_trees_close(p1, pk)
+    _assert_trees_close(o1.slots, ok.slots)
+    assert o1.state["neval"] == ok.state["neval"] == 6
+    assert set(c1.losses) == set(ck.losses)
+    for step in c1.losses:
+        np.testing.assert_allclose(c1.losses[step], ck.losses[step],
+                                   rtol=2e-5, atol=1e-7)
+
+
+def test_fused_rng_stream_matches_unfused():
+    """With dropout active the loss depends on the per-step rng — equal
+    loss sequences prove the fused path derives the identical
+    fold_in(step_rng, neval) stream (batched via vmap)."""
+    _, _, c1 = _run(1, iters=6, dropout=0.5)
+    _, _, c4 = _run(4, iters=6, dropout=0.5)
+    assert set(c1.losses) == set(c4.losses)
+    for step in c1.losses:
+        np.testing.assert_allclose(c1.losses[step], c4.losses[step],
+                                   rtol=2e-5, atol=1e-7)
+
+
+def test_accum_matches_full_batch():
+    """accum_steps=M over a batch of B equals the unfused full-batch
+    step: mean of per-microbatch mean losses/gradients is the full-batch
+    mean (equal-sized microbatches)."""
+    pm, om, _ = _run(1, M=2, bs=32, iters=3)
+    pb, ob, _ = _run(1, M=1, bs=32, iters=3)
+    _assert_trees_close(pm, pb, rtol=1e-5, atol=1e-6)
+    _assert_trees_close(om.slots, ob.slots, rtol=1e-5, atol=1e-6)
+
+
+def test_accum_composes_with_steps_per_call():
+    pc, oc, _ = _run(4, M=2, bs=32, iters=3)
+    pb, ob, _ = _run(1, M=1, bs=32, iters=3)
+    _assert_trees_close(pc, pb, rtol=1e-5, atol=1e-6)
+    assert oc.state["neval"] == 3
+
+
+def test_accum_indivisible_batch_raises():
+    with pytest.raises(ValueError, match="divide"):
+        _run(1, M=3, bs=16, iters=1)
+
+
+def test_sgd_momentum_slots_match():
+    m1 = SGD(0.05, momentum=0.9)
+    m2 = SGD(0.05, momentum=0.9)
+    p1, o1, _ = _run(1, iters=6, method=m1)
+    p4, o4, _ = _run(4, iters=6, method=m2)
+    _assert_trees_close(p1, p4)
+    _assert_trees_close(o1.slots, o4.slots)
+
+
+# ------------------------------------------------- triggers / bookkeeping
+def test_end_when_fires_at_next_k_boundary():
+    """max_iteration(5) with K=2: the end check runs once per fused call,
+    so training stops at neval 6 — the next K boundary after 5."""
+    _, o, _ = _run(2, iters=5)
+    assert o.state["neval"] == 6
+
+
+def test_validation_and_checkpoint_fire_at_next_k_boundary(tmp_path):
+    """several_iteration(5) nominally fires at neval 5; with K=2 the
+    stride probe must catch it and fire at the boundary (neval 6) rather
+    than skip it entirely (6 % 5 != 0)."""
+    ds = ArrayDataSet(X, Y, 16, drop_last=True, shuffle=False)
+    val = ArrayDataSet(X, Y, 16, shuffle=False)
+    from bigdl_tpu.optim.metrics import Top1Accuracy
+    opt = Optimizer(_model(), ds, nn.ClassNLLCriterion(), Adam(1e-2),
+                    seed=5, steps_per_call=2)
+    opt.set_validation(Trigger.several_iteration(5), val, [Top1Accuracy()])
+    opt.set_checkpoint(str(tmp_path), Trigger.several_iteration(5))
+    opt.set_end_when(Trigger.max_iteration(8))
+    opt.optimize()
+    assert opt._last_val_neval == 6          # fired at the K boundary
+    assert (tmp_path / "snapshot-6").exists()
+
+
+def test_records_and_batch_cursor_advance_in_strides():
+    _, o, _ = _run(4, iters=6, bs=16)        # 6 batches/epoch: 4 + 1 + 1
+    assert o.state["neval"] == 6
+    assert o.state["records"] == 6 * 16
+    # end_when fired on the epoch's last stride: mid-epoch stop semantics
+    # (epoch not counted) match the unfused path exactly
+    assert o.state["epoch"] == 0
+
+
+def test_tail_batches_not_dropped():
+    """5 batches/epoch with K=4: one full group + one tail batch — the
+    tail streams through with leading dim 1, never dropped."""
+    x = X[:80]
+    y = Y[:80]
+    ds = ArrayDataSet(x, y, 16, drop_last=True, shuffle=False)  # 5 batches
+    opt = Optimizer(_model(), ds, nn.ClassNLLCriterion(), Adam(1e-2),
+                    seed=5, steps_per_call=4)
+    opt.set_end_when(Trigger.max_epoch(1))
+    opt.optimize()
+    assert opt.state["neval"] == 5
+    assert opt.state["records"] == 80
+
+
+def test_fused_mid_epoch_resume_matches_uninterrupted(tmp_path):
+    """Checkpoint at a K boundary mid-epoch, resume in a fresh trainer,
+    finish — final params equal the uninterrupted fused run (the resumed
+    epoch re-groups the remaining batches; rng is neval-derived)."""
+    def trainer():
+        ds = ArrayDataSet(X, Y, 16, drop_last=True, shuffle=False)
+        opt = Optimizer(_model(), ds, nn.ClassNLLCriterion(), Adam(1e-2),
+                        seed=5, steps_per_call=2)
+        opt.set_end_when(Trigger.max_iteration(6))
+        return opt
+
+    straight = trainer()
+    p_straight, _ = straight.optimize()
+
+    first = trainer()
+    first.set_checkpoint(str(tmp_path), Trigger.several_iteration(4))
+    first.set_end_when(Trigger.max_iteration(4))
+    first.optimize()
+    assert (tmp_path / "snapshot-4").exists()
+
+    resumed = trainer()
+    assert resumed.resume(str(tmp_path))
+    assert resumed.state["neval"] == 4
+    p_resumed, _ = resumed.optimize()
+    _assert_trees_close(p_straight, p_resumed, rtol=2e-5, atol=1e-6)
+
+
+# -------------------------------------------------------- distributed path
+def test_distri_fused_matches_local_unfused():
+    """DistriOptimizer with steps_per_call=2 (+ZeRO-1 slots, stacked-batch
+    shardings) reproduces the local K=1 trajectory on the test mesh."""
+    from bigdl_tpu.parallel import DistriOptimizer, create_mesh
+    # bs=16 -> 6 batches/epoch: iters and the epoch length are K-aligned,
+    # so both runs stop at the same neval (a 3-batch epoch would let the
+    # fused run legally overshoot to the next K boundary). SGD: linear in
+    # the gradient, so the accumulation's fp reassociation is not
+    # amplified the way Adam's ~g/|g| first steps amplify it.
+    p1, _, _ = _run(1, iters=4, bs=16, method=SGD(0.05, momentum=0.9))
+    mesh = create_mesh(drop_trivial_axes=True)
+    ds = ArrayDataSet(X, Y, 16, drop_last=True, shuffle=False)
+    opt = DistriOptimizer(_model(), ds, nn.ClassNLLCriterion(),
+                          SGD(0.05, momentum=0.9),
+                          mesh=mesh, zero1=True, seed=5, steps_per_call=2,
+                          accum_steps=2)
+    opt.set_end_when(Trigger.max_iteration(4))
+    pd, _ = opt.optimize()
+    assert opt.state["neval"] == 4
+    _assert_trees_close(p1, pd, rtol=2e-5, atol=1e-6)
+
+
+# ------------------------------------------------------- stacking plumbing
+def test_stack_batches_groups_and_tail():
+    from bigdl_tpu.dataset.prefetch import stack_batches
+    batches = [(np.full((4, 3), i, np.float32), np.full((4,), i, np.int32))
+               for i in range(7)]
+    out = list(stack_batches(iter(batches), 3))
+    assert [o[0].shape[0] for o in out] == [3, 3, 1]
+    np.testing.assert_array_equal(out[0][0][1], batches[1][0])
+    np.testing.assert_array_equal(out[2][0][0], batches[6][0])
+    with pytest.raises(ValueError, match="k >= 1"):
+        list(stack_batches(iter(batches), 0))
+
+
+def test_fused_inputs_match_eager_fold_in():
+    """The one-dispatch vmapped key derivation must produce exactly the
+    keys the unfused path folds eagerly — the rng contract everything
+    else builds on."""
+    ds = ArrayDataSet(X, Y, 16, drop_last=True, shuffle=False)
+    opt = Optimizer(_model(), ds, nn.ClassNLLCriterion(), Adam(1e-2),
+                    seed=5, steps_per_call=4)
+    rng = jax.random.PRNGKey(5)
+    opt._step_rng = jax.random.fold_in(rng, 0x57E9)
+    st = {"neval": 7, "epoch": 0, "records": 0}
+    lrs, nevals, rngs, lr_list = opt._fused_inputs(st, 4)
+    assert list(np.asarray(nevals)) == [7, 8, 9, 10]
+    for i in range(4):
+        np.testing.assert_array_equal(
+            np.asarray(rngs[i]),
+            np.asarray(jax.random.fold_in(opt._step_rng, 7 + i)))
+
+
+def test_k1_uses_pre_fusion_path():
+    """steps_per_call=1, accum_steps=1 must take the original per-step
+    dispatch path (bit-identical behavior guarantee): the fused builder is
+    never invoked."""
+    ds = ArrayDataSet(X, Y, 16, drop_last=True, shuffle=False)
+    opt = Optimizer(_model(), ds, nn.ClassNLLCriterion(), Adam(1e-2),
+                    seed=5)
+    called = []
+    opt._build_fused_step = lambda: called.append(True)
+    opt.set_end_when(Trigger.max_iteration(2))
+    opt.optimize()
+    assert not called

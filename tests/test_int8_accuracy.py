@@ -47,8 +47,10 @@ def trained_resnet20():
         p2, sl2 = apply_update(method, p, g, sl)
         return p2, ns, sl2, l
 
+    # 6 epochs reaches the 0.95 fp32 floor with margin on the fixture
+    # set; 8 made this the #3 tier-1 offender (ROUND6_NOTES.md)
     r = np.random.RandomState(0)
-    for _ in range(8):
+    for _ in range(6):
         order = r.permutation(len(xtr))
         for i in range(0, len(xtr) - 63, 64):
             idx = order[i:i + 64]

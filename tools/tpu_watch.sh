@@ -13,6 +13,11 @@
 cd "$(dirname "$0")/.." || exit 1
 LOG=/tmp/tpu_watch.log
 OUT=BENCH_EARLY_r05.json
+# Shared lockfile serializing the watcher against driver-run benches
+# (ADVICE r5 #5): bench.py flocks this file itself, so only the steps that
+# do NOT go through bench.py are wrapped here — never hold the lock around
+# a bench.py call or the two would deadlock on each other.
+LOCK="${BIGDL_TPU_BENCH_LOCK_FILE:-/tmp/bigdl_tpu_bench.lock}"
 PROBE='import jax, jax.numpy as jnp
 d = jax.devices()
 assert d[0].platform != "cpu", d
@@ -53,8 +58,8 @@ for i in $(seq 1 100000); do
     touch /tmp/tpu_alive_now
     merge_result "device" "\"$(echo "$out" | sed 's/ALIVE //')\""
     # 1. Mosaic-lowering smokes first — even 20s of chip life proves them
-    smoke=$(BIGDL_TPU_REAL_CHIP=1 timeout 300 python -m pytest \
-        tests/test_kernels.py -q -k real_tpu 2>&1 | tail -1)
+    smoke=$(flock -w 600 "$LOCK" env BIGDL_TPU_REAL_CHIP=1 timeout 300 \
+        python -m pytest tests/test_kernels.py -q -k real_tpu 2>&1 | tail -1)
     echo "$(date -u +%FT%TZ) smokes: $smoke" >> "$LOG"
     merge_result "pallas_smokes" "\"$smoke\""
     # 2..5 battery, headline first, each result written immediately
@@ -64,7 +69,8 @@ for i in $(seq 1 100000); do
       echo "$(date -u +%FT%TZ) bench $m: $j" >> "$LOG"
       merge_result "$m" "$j"
     done
-    timeout 600 python tools/capture_tpu_profile.py tpu_profile_r05 \
+    flock -w 600 "$LOCK" timeout 600 \
+        python tools/capture_tpu_profile.py tpu_profile_r05 \
         >> "$LOG" 2>&1 && merge_result "profile" "\"tpu_profile_r05/\""
     echo "$(date -u +%FT%TZ) battery pass done (see $OUT)" >> "$LOG"
     sleep 600
