@@ -43,6 +43,7 @@ _METRICS = {
     "resnet50_sweep": ("resnet50_bf16_mfu_best", "mfu"),
     "llama": ("llama_125m_train_throughput", "tokens/sec"),
     "dispatch": ("fused_dispatch_cpu8_speedup", "ratio"),
+    "checkpoint": ("async_checkpoint_stall_reduction", "ratio"),
 }
 
 # serialize against tools/tpu_watch.sh (ADVICE r5 #5). Env names + defaults
@@ -423,6 +424,80 @@ def _bench_dispatch(batch_size=32, window=64, iters=256):
     return rows
 
 
+def _bench_checkpoint(batch_size=32, hidden=1024, iters=24, every=4):
+    """Checkpoint-induced step-time stall: the blocking time the train
+    loop pays per snapshot, sync v1 (gather-to-host-0 npz) vs async v2
+    (device-side clone + background shard write — resilience/). Same
+    model (~1M params, ~13 MB snapshot with Adam slots), same
+    DistriOptimizer.optimize() loop on the 8-virtual-device CPU mesh,
+    same snapshot cadence — only the writer differs. Stall samples come
+    from the trainer's own `_ckpt_stalls` meter (optim/local.py); the
+    first sample per mode eats the writer's jit/compile warmup and is
+    dropped. Total optimize() wall time rides along (on a 1-core host
+    the background serialization still competes for the CPU — the stall
+    number is what the STEP BOUNDARY pays, the wall number keeps the
+    total cost honest)."""
+    import shutil
+    import tempfile
+    import numpy as np
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import ArrayDataSet
+    from bigdl_tpu.optim.method import Adam
+    from bigdl_tpu.optim.trigger import Trigger
+    from bigdl_tpu.parallel import DistriOptimizer, create_mesh
+
+    r = np.random.RandomState(0)
+    n = batch_size * (iters + 2)
+    x = r.randn(n, 16).astype(np.float32)
+    y = r.randint(0, 2, n).astype(np.int32)
+    mesh = create_mesh(drop_trivial_axes=True)
+    modes = {"sync_v1": {"BIGDL_TPU_CHECKPOINT_FORMAT": "1"},
+             "sync_v2": {"BIGDL_TPU_CHECKPOINT_ASYNC": "0"},
+             "async_v2": {}}
+    rows = {}
+    for mode, env in modes.items():
+        saved = {k: os.environ.get(k) for k in
+                 ("BIGDL_TPU_CHECKPOINT_FORMAT",
+                  "BIGDL_TPU_CHECKPOINT_ASYNC")}
+        os.environ.update(env)
+        ckdir = tempfile.mkdtemp(prefix=f"bigdl_ckpt_bench_{mode}_")
+        try:
+            model = nn.Sequential(nn.Linear(16, hidden), nn.ReLU(),
+                                  nn.Linear(hidden, hidden), nn.ReLU(),
+                                  nn.Linear(hidden, 2), nn.LogSoftMax())
+            ds = ArrayDataSet(x, y, batch_size, drop_last=True,
+                              shuffle=False)
+            opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(),
+                                  Adam(1e-3), mesh=mesh, seed=0)
+            opt.set_checkpoint(ckdir, Trigger.several_iteration(every))
+            opt.set_end_when(Trigger.max_iteration(iters))
+            t0 = time.time()
+            opt.optimize()
+            wall = time.time() - t0
+            stalls = opt._ckpt_stalls[1:]         # [0] eats writer warmup
+            rows[mode] = {
+                "stall_ms_median": round(
+                    1e3 * float(np.median(stalls)), 2),
+                "stall_ms_mean": round(1e3 * float(np.mean(stalls)), 2),
+                "n_saves": len(opt._ckpt_stalls),
+                "wall_s": round(wall, 2),
+            }
+            snaps = [s for s in os.listdir(ckdir)
+                     if s.startswith("snapshot-")]
+            snap = os.path.join(ckdir, sorted(snaps)[0])
+            rows[mode]["snapshot_bytes"] = sum(
+                os.path.getsize(os.path.join(snap, f))
+                for f in os.listdir(snap))
+        finally:
+            shutil.rmtree(ckdir, ignore_errors=True)
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+    return rows
+
+
 def child_main():
     from bigdl_tpu.utils.platform import force_cpu_if_requested
     force_cpu_if_requested()
@@ -471,6 +546,32 @@ def child_main():
                     "8-virtual-device CPU mesh; K=1 runs the pre-fusion "
                     "per-step dispatch path unchanged (bit-identical "
                     "program)",
+        }))
+        return
+    if which == "checkpoint":
+        # CPU-mesh microbench (parent forces FORCE_CPU=1 + 8 virtual
+        # devices): the number is the step-boundary stall a snapshot
+        # costs the train loop, which is backend-independent plumbing
+        metric, unit = _METRICS[which]
+        rows = _bench_checkpoint()
+        sync_ms = rows["sync_v1"]["stall_ms_median"]
+        async_ms = rows["async_v2"]["stall_ms_median"] or 1e-3
+        print(json.dumps({
+            "metric": metric,
+            "value": round(sync_ms / async_ms, 1),
+            "unit": unit,
+            "vs_baseline": 1.0,
+            "backend": backend,
+            "n_devices": len(jax.devices()),
+            "batch_size": 32,
+            "modes": rows,
+            "host": _host_provenance(),
+            "note": "median checkpoint-induced step-time stall, "
+                    "DistriOptimizer.optimize() on the 8-virtual-device "
+                    "CPU mesh, ~1M-param MLP + Adam slots, snapshot "
+                    "every 4 iterations; sync_v1 = legacy gather-to-"
+                    "host-0 npz, async_v2 = resilience/ device-clone + "
+                    "background sharded write (equal snapshot payload)",
         }))
         return
     if which == "lenet":
@@ -703,8 +804,8 @@ def parent_main():
     # else the degraded record is never emitted at all.
     lock_fh, lock_waited, lock_timed_out = _acquire_bench_lock()
     which_arg = sys.argv[1] if len(sys.argv) > 1 else "resnet50"
-    if which_arg == "dispatch":
-        # CPU-mesh microbench: 8 virtual devices, never a TPU attempt
+    if which_arg in ("dispatch", "checkpoint"):
+        # CPU-mesh microbenches: 8 virtual devices, never a TPU attempt
         xla = (os.environ.get("XLA_FLAGS", "") +
                " --xla_force_host_platform_device_count=8").strip()
         attempts = [

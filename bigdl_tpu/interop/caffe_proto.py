@@ -260,7 +260,17 @@ def load(prototxt_path: str, caffemodel_path: Optional[str] = None,
     """prototxt (+ optional caffemodel weights) → CaffeNet.
 
     `input_shape` overrides the prototxt input dims; give (H, W, C).
-    (reference: CaffeLoader.scala:544 `load(model, defPath, modelPath)`.)"""
+    (reference: CaffeLoader.scala:544 `load(model, defPath, modelPath)`.)
+
+    Recurrent transpose contract: Caffe's RNN/Recurrent layers consume
+    TIME-major blobs (T, N, D), but the imported `nn.Recurrent` module —
+    like every sequence module here — runs BATCH-major (N, T, D). A
+    prototxt declaring a 3-dim input (N, T, D) imports with those
+    semantics, and the CALLER must feed batch-major arrays; data saved
+    for Caffe itself (time-major) has to be transposed
+    (`x.transpose(1, 0, 2)`) before `CaffeNet.module.apply`. RNN import
+    emits a RuntimeWarning as a reminder; weights need no transpose
+    (they are time-layout-free)."""
     with open(prototxt_path) as fh:
         net = parse_prototxt(fh.read())
 
@@ -758,6 +768,13 @@ def load(prototxt_path: str, caffemodel_path: Optional[str] = None,
             # caffe RNN semantics (vanilla tanh RNN, recurrent_param.
             # num_output) are honored on batch-major (B, T, D) input.
             # Caffe's sequence-continuation second bottom is refused above.
+            import warnings
+            warnings.warn(
+                f"caffe {ltype} {lname}: Caffe recurrent blobs are "
+                f"TIME-major (T, N, D) but this import runs BATCH-major "
+                f"(N, T, D) — transpose your input data accordingly "
+                f"(see bigdl_tpu.interop.caffe_proto.load docstring)",
+                RuntimeWarning, stacklevel=2)
             p = layer.msg("recurrent_param")
             nout = _first_int(p, "num_output", 1)
             if len(in_shape) != 2:

@@ -336,10 +336,15 @@ def _emit(s: _Saver, m: Module, p: Dict, st: Dict, bottoms: List[str],
         s.layer(name, "BNLL", [bot], name)
         return name, None
     if isinstance(m, nn.Tile):
-        ax = {3: 1, -1: 1, 1: 2, 2: 3}.get(m.dim)
+        # our NHWC dim -> caffe NCHW axis; negative dims normalize via
+        # % 4 (rank-4 activations), so -1→C, -2→W, -3→H all export.
+        # Only the batch dim (0 / -4) is truly unexportable.
+        ax = ({3: 1, 1: 2, 2: 3}.get(m.dim % 4)
+              if -4 <= m.dim <= 3 else None)
         if ax is None:
             raise NotImplementedError(
-                "caffe export: Tile over the batch dim has no Caffe axis")
+                f"caffe export: Tile dim {m.dim} maps to the batch axis "
+                f"(or is out of range for rank-4 NCHW) — no Caffe axis")
         name = s.fresh("tile")
         s.layer(name, "Tile", [bot], name,
                 "  tile_param { " + " ".join(

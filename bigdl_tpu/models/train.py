@@ -52,6 +52,22 @@ def _common(p: argparse.ArgumentParser):
                    help="gradient accumulation: microbatches per "
                         "optimizer step (batch size must divide; default "
                         "BIGDL_TPU_ACCUM_STEPS)")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the latest committed snapshot under "
+                        "--checkpoint before training (uncommitted/corrupt "
+                        "snapshots are skipped; mesh-shape-agnostic — an "
+                        "8-device snapshot resumes on 4 devices. "
+                        "docs/resilience.md)")
+    p.add_argument("--checkpoint-every", type=int, default=None,
+                   help="checkpoint every N iterations instead of every "
+                        "epoch (fires at the next steps-per-call K "
+                        "boundary)")
+    p.add_argument("--sync-checkpoint", action="store_true",
+                   help="write snapshots inline instead of in the "
+                        "background thread (BIGDL_TPU_CHECKPOINT_ASYNC=0)")
+    p.add_argument("--checkpoint-keep-n", type=int, default=None,
+                   help="retention: keep only the newest N committed "
+                        "snapshots (BIGDL_TPU_CHECKPOINT_KEEP_N)")
 
 
 def _end_trigger(args, default_epochs):
@@ -69,7 +85,18 @@ def _finish(opt, args, model, app):
     if getattr(args, "accum_steps", None):
         opt.set_accum_steps(args.accum_steps)
     if args.checkpoint:
-        opt.set_checkpoint(args.checkpoint, Trigger.every_epoch())
+        import os
+        if getattr(args, "sync_checkpoint", False):
+            os.environ["BIGDL_TPU_CHECKPOINT_ASYNC"] = "0"
+        if getattr(args, "checkpoint_keep_n", None):
+            os.environ["BIGDL_TPU_CHECKPOINT_KEEP_N"] = \
+                str(args.checkpoint_keep_n)
+        every = getattr(args, "checkpoint_every", None)
+        opt.set_checkpoint(args.checkpoint,
+                           Trigger.several_iteration(every) if every
+                           else Trigger.every_epoch())
+        if getattr(args, "resume", False):
+            opt.resume(args.checkpoint)
     if args.summary_dir:
         opt.set_train_summary(viz.TrainSummary(args.summary_dir, app))
     params, state = opt.optimize()

@@ -430,8 +430,21 @@ class Optimizer:
         crashed run trained on; under shuffle or multi-worker decode the
         stream order differs run-to-run, so the resumed epoch may re-see
         some trained records and miss others (same contract as
-        ShardedDataset.fast_forward_batches — see its docstring)."""
-        snap = ckpt.latest_checkpoint(path)
+        ShardedDataset.fast_forward_batches — see its docstring).
+
+        Recovery hygiene: an in-flight background snapshot write is
+        joined first (it may BE the latest snapshot), and candidates are
+        CRC-validated against their manifest — uncommitted or corrupt
+        snapshots are skipped, falling back to the previous good one.
+        Restore is mesh-shape-agnostic: v2 shards reassemble into global
+        host arrays here and optimize()'s _place_trees lays them out
+        under whatever mesh is CURRENT (including re-sharding ZeRO-1
+        slots), so an 8-device snapshot resumes on 4 devices and vice
+        versa (resilience/elastic.py)."""
+        w = getattr(self, "_ckpt_writer", None)
+        if w is not None:
+            w.drain()               # a failed write just means older snap
+        snap = ckpt.latest_checkpoint(path, validate=True)
         if snap is None:
             return False
         trees, meta = ckpt.load_checkpoint(snap)
@@ -504,6 +517,14 @@ class Optimizer:
         self._pending: List[tuple] = []
         self._window_t0 = time.time()
         self._window_records = 0
+        self._ckpt_stalls: List[float] = []
+        if self.ckpt_path is not None:
+            from bigdl_tpu.utils import config as _cfg
+            if _cfg.get("CHECKPOINT_ON_PREEMPT"):
+                # SIGTERM (TPU-VM preemption notice) -> one final
+                # checkpoint at the next step/K boundary, clean stop
+                from bigdl_tpu.resilience import faults as _faults
+                _faults.install_sigterm_handler()
 
         while not self.end_when(st):
             epoch_start = time.time()
@@ -578,6 +599,9 @@ class Optimizer:
                 self._maybe_param_summary(params, model_state, st)
                 self._maybe_validate(params, model_state, st)
                 self._maybe_checkpoint(params, model_state, slots, st)
+                if self._check_resilience(params, model_state, slots, st):
+                    ended_mid_epoch = True
+                    break
                 if self.end_when(st):
                     ended_mid_epoch = True
                     break
@@ -598,6 +622,7 @@ class Optimizer:
             st["epoch_finished"] = False
 
         self._flush_metrics(st)
+        self._finish_checkpoints()         # join any background snapshot
 
         self._last_batch = None            # release pinned device buffers
         self.params, self.model_state, self.slots = params, model_state, slots
@@ -682,6 +707,12 @@ class Optimizer:
             self._maybe_checkpoint(
                 params, model_state, slots, st,
                 fired=self._stride_fired(self.ckpt_trigger, st, start, k))
+            # faults/preemption are probed at the K boundary — the
+            # preempt contract is "final checkpoint at the NEXT
+            # steps_per_call boundary"
+            if self._check_resilience(params, model_state, slots, st):
+                ended_mid_epoch = True
+                break
             if self.end_when(st):
                 ended_mid_epoch = True
                 break
@@ -850,62 +881,119 @@ class Optimizer:
         path = f"{self.ckpt_path}/snapshot-{st['neval']}"
         meta = {k: v for k, v in st.items()
                 if isinstance(v, (int, float, bool, str))}
-        ckpt.save_checkpoint(path, {"params": params,
-                                    "model_state": model_state,
-                                    "slots": slots}, meta)
-        log.info("checkpoint -> %s", path)
+        meta.update(self._snapshot_extra_meta())
+        trees = {"params": params, "model_state": model_state,
+                 "slots": slots}
+        t0 = time.time()
+        from bigdl_tpu.utils import config
+        if config.get("CHECKPOINT_FORMAT") == 1:
+            # legacy v1: synchronous gather-to-host-0 single npz
+            ckpt.save_checkpoint(path, trees, meta)
+        else:
+            self._checkpointer().save(path, trees, meta,
+                                      root=self.ckpt_path,
+                                      clone=self._step_donates())
+        # per-save blocking stall, observable by bench.py checkpoint mode
+        self._ckpt_stalls.append(time.time() - t0)
+        log.info("checkpoint -> %s (%.1f ms stall)", path,
+                 self._ckpt_stalls[-1] * 1e3)
+
+    def _checkpointer(self):
+        """Lazy per-trainer AsyncCheckpointer (format v2) — knobs
+        BIGDL_TPU_CHECKPOINT_ASYNC / _KEEP_N read at first checkpoint."""
+        if getattr(self, "_ckpt_writer", None) is None:
+            from bigdl_tpu.resilience.snapshot import AsyncCheckpointer
+            self._ckpt_writer = AsyncCheckpointer()
+        return self._ckpt_writer
+
+    def _step_donates(self) -> bool:
+        """Whether the jitted train step donates its tree buffers — the
+        async checkpointer must clone before a donating step can
+        invalidate them (resilience/snapshot.py). The local trainer
+        always donates; DistriOptimizer overrides with its
+        SUPPORTS_SHARDED_DONATION guard."""
+        return True
+
+    def _snapshot_extra_meta(self) -> Dict:
+        """Provenance recorded into the snapshot meta; the distributed
+        trainer adds its mesh layout (elastic restores log what the
+        source slice looked like)."""
+        return {"steps_per_call": self.steps_per_call,
+                "accum_steps": self.accum_steps}
+
+    def _finish_checkpoints(self):
+        """Join the in-flight background snapshot write (shutdown /
+        end-of-optimize barrier); surfaces a deferred write failure."""
+        w = getattr(self, "_ckpt_writer", None)
+        if w is not None:
+            w.wait()
+
+    # --------------------------------------------------------- resilience
+    def _check_resilience(self, params, model_state, slots, st) -> bool:
+        """Per-boundary fault/preemption probe (resilience/faults.py):
+        called after each step (or each K-stride in the fused path).
+        Injected crashes raise out to the retry loop; a SIGTERM
+        preemption request writes ONE final checkpoint at this boundary
+        and returns True so the epoch loop stops cleanly."""
+        from bigdl_tpu.resilience import faults
+        faults.check_step(st["neval"])
+        if not faults.preempt_requested():
+            return False
+        faults.clear_preempt()
+        if self.ckpt_path is not None:
+            self.__dict__.pop("_last_ckpt_neval", None)
+            self._maybe_checkpoint(params, model_state, slots, st,
+                                   fired=True)
+            self._finish_checkpoints()
+        st["preempted"] = True
+        log.warning("preempted at iteration %d — final checkpoint %s; "
+                    "stopping cleanly", st["neval"],
+                    "written" if self.ckpt_path else "skipped (no "
+                    "set_checkpoint)")
+        return True
 
     # -------------------------------------------------------------- retry
     def optimize_with_retry(self, retries: Optional[int] = None,
-                            window_s: Optional[float] = None):
+                            window_s: Optional[float] = None,
+                            backoff_s: Optional[float] = None):
         """Driver-side failure recovery (reference:
         optim/DistriOptimizer.scala:886-963): on an exception, reload the
-        latest checkpoint under `ckpt_path` and retry, up to
+        latest VALIDATED checkpoint under `ckpt_path` and retry, up to
         BIGDL_TPU_FAILURE_RETRY_TIMES attempts within a
-        BIGDL_TPU_FAILURE_RETRY_INTERVAL_S sliding window. Requires
+        BIGDL_TPU_FAILURE_RETRY_INTERVAL_S sliding window with
+        BIGDL_TPU_FAILURE_RETRY_BACKOFF_S exponential backoff. The loop
+        is resilience.RetryPolicy — shared verbatim by LocalOptimizer and
+        DistriOptimizer (this method is inherited). Requires
         `set_checkpoint` to have been called (no snapshot → no recovery)."""
-        from bigdl_tpu.utils import config
-        if retries is None:
-            retries = config.get("FAILURE_RETRY_TIMES")
-        if window_s is None:
-            window_s = config.get("FAILURE_RETRY_INTERVAL_S")
+        from bigdl_tpu.resilience.retry import RetryPolicy
         if self.ckpt_path is None:
             raise RuntimeError("optimize_with_retry needs set_checkpoint() "
                                "so there is a snapshot to recover from")
-        failures: List[float] = []
-        while True:
-            try:
-                return self.optimize()
-            except KeyboardInterrupt:
-                raise
-            except Exception as e:             # noqa: BLE001 — driver loop
-                now = time.time()
-                failures = [t for t in failures if now - t < window_s]
-                failures.append(now)
-                if len(failures) > retries:
-                    log.error("giving up after %d failures in %.0fs window",
-                              len(failures), window_s)
-                    raise
-                log.warning("training failed (%s); retry %d/%d from latest "
-                            "checkpoint", e, len(failures), retries)
-                if not self.resume(self.ckpt_path):
-                    # no snapshot yet — discard the mutated counters from the
-                    # failed run so triggers/progress restart from scratch;
-                    # user-supplied initial trees (set_initial) are restored,
-                    # NOT thrown away — a pre-snapshot failure must not turn
-                    # fine-tuning into from-scratch training
-                    log.warning("no snapshot found; retrying from %s",
-                                "initial trees"
-                                if hasattr(self, "_initial_trees")
-                                else "scratch")
-                    self.state = {"epoch": 0, "neval": 0, "records": 0,
-                                  "batch_in_epoch": 0}
-                    if hasattr(self, "_initial_trees"):
-                        self._resume_trees = dict(self._initial_trees)
-                    else:
-                        self.__dict__.pop("_resume_trees", None)
-                    self.__dict__.pop("_last_val_neval", None)
-                    self.__dict__.pop("_last_ckpt_neval", None)
+
+        def recover(_e):
+            # resume() drains the in-flight background write and resumes
+            # from the latest snapshot that passes manifest validation
+            if not self.resume(self.ckpt_path):
+                # no snapshot yet — discard the mutated counters from the
+                # failed run so triggers/progress restart from scratch;
+                # user-supplied initial trees (set_initial) are restored,
+                # NOT thrown away — a pre-snapshot failure must not turn
+                # fine-tuning into from-scratch training
+                log.warning("no usable snapshot; retrying from %s",
+                            "initial trees"
+                            if hasattr(self, "_initial_trees")
+                            else "scratch")
+                self.state = {"epoch": 0, "neval": 0, "records": 0,
+                              "batch_in_epoch": 0}
+                if hasattr(self, "_initial_trees"):
+                    self._resume_trees = dict(self._initial_trees)
+                else:
+                    self.__dict__.pop("_resume_trees", None)
+                self.__dict__.pop("_last_val_neval", None)
+                self.__dict__.pop("_last_ckpt_neval", None)
+
+        return RetryPolicy(retries, window_s, backoff_s).run(
+            self.optimize, recover)
 
 
 LocalOptimizer = Optimizer

@@ -213,6 +213,31 @@ class DistriOptimizer(Optimizer):
             in_shardings=(p_sh, None, s_sh, None, None, rep, rep, rep),
             out_shardings=(p_sh, None, s_sh, rep))
 
+    # ------------------------------------------------------------ resilience
+    def _step_donates(self):
+        # mirrors _build_step/_build_fused_step: donation is skipped on
+        # old-jax GSPMD (utils/compat.py), and then the async snapshot
+        # can read live buffers without a device-side clone
+        from bigdl_tpu.utils.compat import SUPPORTS_SHARDED_DONATION
+        return SUPPORTS_SHARDED_DONATION
+
+    def _snapshot_extra_meta(self):
+        """Snapshot provenance: record the source slice's layout so an
+        elastic restore (resilience/elastic.py) can log the 8-device →
+        4-device reshard it performed. Restore itself never needs this —
+        v2 pieces carry global windows and _place_trees re-derives
+        zero1/TP specs from the LIVE mesh — it is operator-facing
+        breadcrumbs (the reference logs executor topology on recovery)."""
+        meta = super()._snapshot_extra_meta()
+        meta.update({
+            "mesh_axes": ",".join(self.mesh.axis_names),
+            "mesh_shape": ",".join(str(self.mesh.shape[a])
+                                   for a in self.mesh.axis_names),
+            "n_devices": int(self.mesh.size),
+            "zero1": bool(self.zero1),
+        })
+        return meta
+
     def _build_eval_fn(self):
         eval_fn = jax.jit(
             lambda p, s, x: self.model.apply(p, s, x, training=False)[0])

@@ -1,0 +1,32 @@
+"""Resilience subsystem — survive crashes, preemptions, and slice
+reconfiguration without losing training progress.
+
+The reference's robustness story is driver-side retry/recovery from
+checkpoint plus parameter-server-sharded state (optim/
+DistriOptimizer.scala:886-963, parameters/AllReduceParameter.scala);
+this package is its TPU-native translation:
+
+  * `manifest`  — on-disk format v2: per-host shard files + manifest +
+                  CRC32C integrity + COMMIT-marker atomic commit +
+                  retention GC (keep_n);
+  * `snapshot`  — AsyncCheckpointer: device-side clone at the step
+                  boundary, serialization + IO in a background thread
+                  (CheckFreq-style), double-buffered;
+  * `elastic`   — mesh-shape-agnostic restore: reassemble global host
+                  arrays from shards, re-place (incl. ZeRO-1 slots)
+                  under the CURRENT mesh;
+  * `faults`    — deterministic fault injection (BIGDL_TPU_FAULT) and
+                  the SIGTERM preemption handler;
+  * `retry`     — RetryPolicy: bounded retries, exponential backoff,
+                  resume-validation, shared by both trainers.
+
+See docs/resilience.md.
+"""
+
+from bigdl_tpu.resilience.faults import (SimulatedCrash,  # noqa: F401
+                                         install_sigterm_handler)
+from bigdl_tpu.resilience.manifest import (CorruptSnapshot,  # noqa: F401
+                                           gc_snapshots, latest_checkpoint,
+                                           validate_snapshot)
+from bigdl_tpu.resilience.retry import RetryPolicy  # noqa: F401
+from bigdl_tpu.resilience.snapshot import AsyncCheckpointer  # noqa: F401

@@ -1,0 +1,86 @@
+"""Elastic restore — resume a snapshot on a DIFFERENT mesh shape.
+
+The reference's recovery contract is "reload the latest checkpoint and
+rebuild the job on whatever executors are left" (optim/
+DistriOptimizer.scala:886-963); the TPU translation (SURVEY:
+"checkpoint-restart on slice reconfiguration") must survive the mesh
+changing shape under the job — an 8-device snapshot resuming on 4
+devices after a slice shrink, or on 16 after a grow.
+
+Format v2 makes this almost free: every piece records its window into
+the GLOBAL array (resilience/manifest.py), so `load_trees` reassembles
+full host arrays with no reference to the source mesh at all. Placement
+under the CURRENT mesh — including re-sharding ZeRO-1 optimizer slots to
+the new data-axis size — is then the trainers' ordinary `_place_trees`
+(DistriOptimizer re-derives zero1_spec/TP specs from the live mesh), or
+`place_tree` here for standalone use.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from bigdl_tpu.resilience import manifest
+
+
+def load_trees(path: str) -> Tuple[Dict[str, Any], Dict]:
+    """(trees, meta) as full HOST arrays, from a v2 (per-host sharded)
+    or v1 (single npz) snapshot — the mesh-shape-agnostic half of an
+    elastic restore. v2 integrity failures raise CorruptSnapshot."""
+    if manifest.is_v2(path):
+        return manifest.load_snapshot(path)
+    from bigdl_tpu.utils import checkpoint as v1    # v1 fallback
+    return v1.load_checkpoint(path)
+
+
+def place_tree(tree, mesh, specs=None):
+    """Re-place a host tree under `mesh`: leaf-wise PartitionSpecs (or
+    replicated when omitted), multi-host safe via host_array_to_global.
+    This is what re-shards a ZeRO-1 slot tree saved on an 8-way data
+    axis onto a 4-way one — the spec is recomputed for the new mesh, the
+    host array is global, XLA lays out the new shards."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from bigdl_tpu.parallel.mesh import host_array_to_global
+    if specs is None:
+        specs = jax.tree.map(lambda _: P(), tree)
+    return jax.tree.map(
+        lambda a, s: host_array_to_global(np.asarray(a), mesh, s),
+        tree, specs)
+
+
+def validate_against(path: str, shapes: Dict[str, Any]) -> List[str]:
+    """Resume-validation: compare a snapshot's manifest against the
+    shapes the model would init today ({tree_name: pytree of
+    jax.ShapeDtypeStruct / arrays}). Returns human-readable mismatch
+    strings (empty = compatible) WITHOUT loading any array data — the
+    cheap pre-flight the retry loop runs before committing to a resume.
+    v1 snapshots (no manifest) validate shallowly as [] — their load
+    fails loudly instead."""
+    if not manifest.is_v2(path):
+        return []
+    from bigdl_tpu.utils.checkpoint import _flatten
+    doc = manifest.read_manifest(path)
+    problems = []
+    want = {}
+    for name, tree in shapes.items():
+        for k, v in _flatten(tree, f"{name}/").items():
+            want[k] = (tuple(getattr(v, "shape", ())),
+                       str(np.dtype(getattr(v, "dtype", np.float32))))
+    have = {k: (tuple(info["shape"]), info["dtype"])
+            for k, info in doc["arrays"].items()}
+    for k, (shape, dtype) in want.items():
+        if k not in have:
+            problems.append(f"missing array {k!r}")
+        elif have[k][0] != shape:
+            problems.append(
+                f"{k!r}: snapshot shape {have[k][0]} != model {shape}")
+        elif have[k][1] != dtype:
+            problems.append(
+                f"{k!r}: snapshot dtype {have[k][1]} != model {dtype}")
+    for k in have:
+        if k not in want:
+            problems.append(f"unexpected array {k!r} in snapshot")
+    return problems

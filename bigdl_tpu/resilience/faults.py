@@ -1,0 +1,150 @@
+"""Deterministic fault injection + preemption handling.
+
+The reference proves its retry loop with Spark executor kills; here the
+equivalent is a deterministic harness the resilience tests (and any
+soak run) drive through one env knob:
+
+    BIGDL_TPU_FAULT=step:N[:kind]      kind ∈ crash | preempt | io
+
+  * crash    — raise SimulatedCrash at the first step boundary >= N
+               (the driver's retry loop treats it like any trainer
+               exception and resumes from the latest snapshot);
+  * preempt  — SIGTERM ourselves at that boundary, exercising the real
+               signal path below;
+  * io       — arm ONE shard-write failure: the next snapshot write
+               raises OSError mid-write, leaving an uncommitted dir that
+               recovery must skip.
+
+Faults fire once per process (the resumed run must survive), and the
+trainer checks at `steps_per_call` K-boundaries, so the fire step is
+deterministic for any K.
+
+Preemption: `install_sigterm_handler()` converts SIGTERM (the TPU-VM
+maintenance/preemption notice) into a request flag; the trainers poll
+`preempt_requested()` at each K-boundary, write one final checkpoint,
+and return cleanly — the next invocation resumes where the preemption
+landed.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+
+log = logging.getLogger("bigdl_tpu")
+
+CRASH, PREEMPT, IO = "crash", "preempt", "io"
+
+
+class SimulatedCrash(RuntimeError):
+    """Injected training failure (BIGDL_TPU_FAULT=step:N:crash)."""
+
+
+class _Injector:
+    def __init__(self, spec: str):
+        self.step = None
+        self.kind = CRASH
+        self.fired = False
+        if spec:
+            parts = spec.split(":")
+            if len(parts) < 2 or parts[0] != "step":
+                raise ValueError(
+                    f"BIGDL_TPU_FAULT={spec!r}: want 'step:N[:kind]'")
+            self.step = int(parts[1])
+            if len(parts) > 2:
+                if parts[2] not in (CRASH, PREEMPT, IO):
+                    raise ValueError(
+                        f"BIGDL_TPU_FAULT kind {parts[2]!r}: want "
+                        f"crash|preempt|io")
+                self.kind = parts[2]
+
+
+_injector: _Injector = None
+_io_armed = False
+_preempt = threading.Event()
+_prev_handler = None
+_lock = threading.Lock()
+
+
+def configure(spec: str = None) -> None:
+    """(Re)arm the injector — tests call this; None re-reads the env."""
+    global _injector, _io_armed
+    if spec is None:
+        from bigdl_tpu.utils import config
+        spec = config.get("FAULT")
+    with _lock:
+        _injector = _Injector(spec)
+        _io_armed = False
+
+
+def _get() -> _Injector:
+    global _injector
+    if _injector is None:
+        configure()
+    return _injector
+
+
+def check_step(neval: int) -> None:
+    """Called by the trainers at every step/K-stride boundary with the
+    post-step iteration count. Fires the armed fault once."""
+    global _io_armed
+    inj = _get()
+    if inj.step is None or inj.fired or neval < inj.step:
+        return
+    inj.fired = True
+    if inj.kind == CRASH:
+        log.warning("fault injection: crash at iteration %d", neval)
+        raise SimulatedCrash(f"injected crash at iteration {neval}")
+    if inj.kind == PREEMPT:
+        log.warning("fault injection: SIGTERM self at iteration %d", neval)
+        os.kill(os.getpid(), signal.SIGTERM)
+        return
+    log.warning("fault injection: arming shard-write IO error "
+                "(iteration %d)", neval)
+    _io_armed = True
+
+
+def maybe_fail_io(path: str) -> None:
+    """Consumed by manifest.write_snapshot before serializing: one armed
+    IO fault makes the write die mid-snapshot, leaving the uncommitted
+    dir the recovery path must skip."""
+    global _io_armed
+    if _io_armed:
+        _io_armed = False
+        raise OSError(f"injected shard-write IO error for {path}")
+
+
+# ------------------------------------------------------------- preemption
+def install_sigterm_handler() -> bool:
+    """Route SIGTERM to a graceful-checkpoint request. Idempotent; False
+    when installation isn't possible (non-main thread — e.g. a trainer
+    driven from a worker thread keeps the process default)."""
+    global _prev_handler
+    if _prev_handler is not None:
+        return True
+    try:
+        _prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+        return True
+    except ValueError:                     # not the main thread
+        return False
+
+
+def _on_sigterm(signum, frame):
+    log.warning("SIGTERM: final checkpoint requested at the next "
+                "step boundary")
+    _preempt.set()
+
+
+def preempt_requested() -> bool:
+    return _preempt.is_set()
+
+
+def clear_preempt() -> None:
+    _preempt.clear()
+
+
+def request_preempt() -> None:
+    """Programmatic preemption request (same path as SIGTERM)."""
+    _preempt.set()

@@ -1,0 +1,151 @@
+"""Async checkpointer — hide snapshot cost behind training (CheckFreq).
+
+The v1 writer stalls the train loop for gather + serialization + IO.
+Following CheckFreq (Mohan et al., FAST '21), the save splits in two:
+
+  1. **snapshot** (foreground, at the step boundary): ONE jitted identity
+     dispatch clones every leaf device-side — async dispatch, so the call
+     returns in microseconds — then the host-side piece plan is built
+     from the clones. The clones are fresh buffers, so the next train
+     step is free to donate/overwrite the live trees immediately.
+  2. **persist** (background thread): CRC + npz serialization + IO +
+     COMMIT + retention GC run off the training thread
+     (resilience/manifest.py). No jax collectives happen here, so the
+     thread is multi-host-safe by construction.
+
+Double-buffering: a new save() first dispatches its own device clone
+(buffer B) while the previous write (buffer A) may still be draining,
+then joins A before queueing B — at most two snapshot buffers ever live.
+`wait()` joins the in-flight write and re-raises its failure; the
+trainers call it before every dependent read (resume, shutdown) and the
+retry loop calls it before trusting `latest_checkpoint`.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Any, Dict, Optional
+
+from bigdl_tpu.resilience import manifest
+
+log = logging.getLogger("bigdl_tpu")
+
+
+class AsyncCheckpointer:
+    """Format-v2 snapshot writer with optional background persistence.
+
+    async_mode=None / keep_n=None read the BIGDL_TPU_CHECKPOINT_ASYNC /
+    BIGDL_TPU_CHECKPOINT_KEEP_N knobs at construction.
+    """
+
+    def __init__(self, async_mode: Optional[bool] = None,
+                 keep_n: Optional[int] = None):
+        from bigdl_tpu.utils import config
+        self.async_mode = (config.get("CHECKPOINT_ASYNC")
+                           if async_mode is None else async_mode)
+        self.keep_n = (config.get("CHECKPOINT_KEEP_N")
+                       if keep_n is None else keep_n)
+        # ONE persistent writer thread per checkpointer (spawned lazily):
+        # per-save thread creation costs milliseconds on a busy host,
+        # which is the same order as the whole foreground stall
+        self._queue: "queue.Queue" = queue.Queue()
+        self._worker: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._clone_fns: Dict[Any, Any] = {}
+        self._last_path: Optional[str] = None
+
+    # ------------------------------------------------------------ plumbing
+    def _clone(self, trees):
+        """Device-side copy of every leaf in ONE jitted dispatch (cached
+        per tree structure). Output buffers are fresh (no donation), and
+        sharding propagation keeps each input's layout, so the background
+        fetch reads stable buffers while training overwrites the originals."""
+        import jax
+        import jax.numpy as jnp
+        treedef = jax.tree.structure(trees)
+        fn = self._clone_fns.get(treedef)
+        if fn is None:
+            fn = jax.jit(lambda t: jax.tree.map(jnp.copy, t))
+            self._clone_fns[treedef] = fn
+        return fn(trees)
+
+    def _persist(self, path: str, plan: dict, root: Optional[str]):
+        try:
+            manifest.write_snapshot(path, plan)
+            if root is not None and plan["process_index"] == 0:
+                manifest.gc_snapshots(root, self.keep_n)
+        except BaseException as e:                 # noqa: BLE001 — deferred
+            self._error = e
+            log.error("background checkpoint %s failed: %s", path, e)
+
+    def _run_worker(self):
+        while True:
+            item = self._queue.get()
+            try:
+                if item is not None:
+                    self._persist(*item)
+            finally:
+                self._queue.task_done()
+            if item is None:
+                return
+
+    def _enqueue(self, path, plan, root):
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._run_worker, name="ckpt-writer", daemon=True)
+            self._worker.start()
+        self._queue.put((path, plan, root))
+
+    # ------------------------------------------------------------------ api
+    def save(self, path: str, trees: Dict[str, Any],
+             meta: Optional[Dict] = None,
+             root: Optional[str] = None, clone: bool = True) -> None:
+        """Snapshot `trees` to `path`. Blocking cost is the device-side
+        clone dispatch + host piece-plan build; serialization and IO run
+        in the background (async mode). `root` enables retention GC of
+        sibling snapshots after a successful commit. Raises any error the
+        PREVIOUS background write hit — a failed write surfaces at the
+        next save/wait rather than vanishing.
+
+        `clone=False` skips the device-side copy and lets the background
+        writer read the LIVE buffers directly — only safe when the
+        caller's train step does NOT donate them (the shard references
+        held by the plan keep the buffers alive; a donating step would
+        invalidate them mid-read). The trainers pass their donation flag
+        (DistriOptimizer skips donation on old-jax GSPMD —
+        utils/compat.SUPPORTS_SHARDED_DONATION — and then the snapshot
+        stall drops to the piece-plan build alone)."""
+        if self.async_mode:
+            # buffer B (async dispatch) while buffer A's write drains
+            clones = self._clone(trees) if clone else trees
+            self.wait()                            # join buffer A's write
+            plan = manifest.snapshot_to_host(clones, meta)
+            self._last_path = path
+            self._enqueue(path, plan, root)
+        else:
+            self.wait()
+            plan = manifest.snapshot_to_host(trees, meta)
+            self._last_path = path
+            manifest.write_snapshot(path, plan)
+            if root is not None and plan["process_index"] == 0:
+                manifest.gc_snapshots(root, self.keep_n)
+
+    def wait(self) -> None:
+        """Block until the in-flight background write (if any) is fully
+        committed; re-raise its failure."""
+        if self._worker is not None:
+            self._queue.join()
+        if self._error is not None:
+            e, self._error = self._error, None
+            raise e
+
+    def drain(self) -> Optional[BaseException]:
+        """Join without raising — shutdown/recovery path. Returns the
+        swallowed error (already logged) so callers can decide."""
+        try:
+            self.wait()
+            return None
+        except BaseException as e:                 # noqa: BLE001 — drained
+            return e

@@ -95,16 +95,31 @@ def save_checkpoint(path: str, trees: Dict[str, Any],
                     arrays[k] = fetched    # collective, never keep the copy
 
         if writer:
-            tmp = path + ".tmp"
-            if os.path.exists(tmp):
-                shutil.rmtree(tmp)
-            os.makedirs(tmp, exist_ok=True)
-            np.savez(os.path.join(tmp, "arrays.npz"), **arrays)
-            with open(os.path.join(tmp, "tree.json"), "w") as f:
-                json.dump({"specs": specs, "meta": meta or {}}, f)
-            if os.path.exists(path):
-                shutil.rmtree(path)
+            # crash-safe staging: the OLD snapshot survives until the new
+            # one is fully written — a crash between "delete old" and
+            # "rename tmp" must never lose the only copy. Sequence:
+            # write tmp -> rename old aside -> rename tmp in -> drop old.
+            # A crash at any point leaves either the old snapshot at
+            # `path`/.old or the new one at `path`; stale .tmp/.old dirs
+            # from earlier crashes are swept first and on failure.
+            tmp, old = path + ".tmp", path + ".old"
+            for stale in (tmp, old):
+                if os.path.exists(stale):
+                    shutil.rmtree(stale)
+            try:
+                os.makedirs(tmp, exist_ok=True)
+                np.savez(os.path.join(tmp, "arrays.npz"), **arrays)
+                with open(os.path.join(tmp, "tree.json"), "w") as f:
+                    json.dump({"specs": specs, "meta": meta or {}}, f)
+            except BaseException:
+                shutil.rmtree(tmp, ignore_errors=True)
+                raise
+            had_old = os.path.exists(path)
+            if had_old:
+                os.replace(path, old)
             os.replace(tmp, path)
+            if had_old:
+                shutil.rmtree(old, ignore_errors=True)
     finally:
         # reached even if the write fails, so the other hosts' barrier
         # doesn't hang forever on a host-0 IO error
@@ -115,7 +130,13 @@ def save_checkpoint(path: str, trees: Dict[str, Any],
 
 
 def load_checkpoint(path: str) -> Tuple[Dict[str, Any], Dict]:
-    """Returns (trees, meta)."""
+    """Returns (trees, meta) as full host arrays. Dispatches on the
+    on-disk format: v2 per-host sharded snapshots (manifest.json —
+    resilience/manifest.py, CRC-verified) and the v1 single-npz layout
+    both load transparently, so pre-v2 checkpoints keep working."""
+    from bigdl_tpu.resilience import manifest as v2
+    if v2.is_v2(path):
+        return v2.load_snapshot(path)
     with open(os.path.join(path, "tree.json")) as f:
         doc = json.load(f)
     npz = np.load(os.path.join(path, "arrays.npz"))
@@ -125,13 +146,10 @@ def load_checkpoint(path: str) -> Tuple[Dict[str, Any], Dict]:
     return trees, doc.get("meta", {})
 
 
-def latest_checkpoint(root: str) -> Optional[str]:
-    """Newest snapshot dir under root (named by iteration)."""
-    import re
-    if not os.path.isdir(root):
-        return None
-    snaps = [d for d in os.listdir(root) if re.fullmatch(r"snapshot-\d+", d)]
-    if not snaps:
-        return None
-    snaps.sort(key=lambda d: int(d.split("-")[-1]))
-    return os.path.join(root, snaps[-1])
+def latest_checkpoint(root: str, validate: bool = False) -> Optional[str]:
+    """Newest COMMITTED snapshot dir under root (named by iteration) —
+    v1 or v2; uncommitted v2 dirs (no COMMIT marker: in-flight or torn
+    writes) are skipped. `validate=True` additionally CRC-checks and
+    skips corrupt snapshots (the recovery path)."""
+    from bigdl_tpu.resilience import manifest as v2
+    return v2.latest_checkpoint(root, validate=validate)

@@ -80,6 +80,36 @@ _register("ACCUM_STEPS", 1, int,
           "scans over them averaging gradients, then applies ONE update — "
           "the reference's mini-batch aggregation "
           "(optim/DistriOptimizer.scala gradient sum over sub-batches)")
+_register("FAILURE_RETRY_BACKOFF_S", 0.0, float,
+          "Initial exponential-backoff sleep between driver-loop retries "
+          "(doubles per failure, capped at 16x; 0 disables — "
+          "resilience/retry.py)")
+_register("CHECKPOINT_FORMAT", 2, int,
+          "On-disk snapshot format: 2 = per-host sharded shards + "
+          "manifest.json + COMMIT marker (resilience/manifest.py), "
+          "1 = legacy single-npz gather-to-host-0 (utils/checkpoint.py). "
+          "Both formats load transparently on resume")
+_register("CHECKPOINT_ASYNC", True, _bool,
+          "Format-2 snapshots: take the device->host snapshot at the step "
+          "boundary and run serialization+IO in a background thread "
+          "(resilience/snapshot.py; CheckFreq-style split). 0 = write "
+          "inline (the bench baseline)")
+_register("CHECKPOINT_KEEP_N", 0, int,
+          "Retention: keep only the newest N committed snapshots under "
+          "the checkpoint root (0 = keep all; resilience/manifest.py)")
+_register("CHECKPOINT_COMMIT_TIMEOUT_S", 300, int,
+          "Multi-host format-2 commit: seconds process 0 polls for the "
+          "other hosts' shard files before declaring the snapshot failed")
+_register("CHECKPOINT_ON_PREEMPT", True, _bool,
+          "Install a SIGTERM handler that requests one final checkpoint "
+          "at the next steps_per_call K-boundary before stopping "
+          "(resilience/faults.py; the TPU-preemption grace window)")
+_register("FAULT", "", str,
+          "Deterministic fault injection for resilience tests: "
+          "'step:N[:kind]' with kind crash (raise SimulatedCrash) | "
+          "preempt (SIGTERM self) | io (fail the next shard write). "
+          "Fires once at the first step boundary >= N "
+          "(resilience/faults.py)")
 _register("BENCH_LOCK_FILE", "/tmp/bigdl_tpu_bench.lock", str,
           "Lockfile serializing bench.py against tools/tpu_watch.sh so "
           "the harness cannot pollute the CPU trend series (ADVICE r5 #5)")

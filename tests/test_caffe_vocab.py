@@ -210,6 +210,58 @@ layer { name: "t" type: "Tile" bottom: "data" top: "t"
                                atol=1e-7)
 
 
+@pytest.mark.parametrize("dim", [-2, -3, 1, 2, 3, -1])
+def test_tile_export_negative_dims_roundtrip(tmp_path, dim):
+    """ADVICE r5: Tile export refused valid NEGATIVE dims -2 (W) / -3 (H)
+    with a misleading 'batch dim' error — dims now normalize via % 4 and
+    both axes round-trip through our own writer with equal outputs."""
+    import jax
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.interop import caffe_proto
+    from bigdl_tpu.interop.caffe_saver import save_caffe
+
+    model = nn.Sequential(nn.Tile(dim, 2))
+    params, state = model.init(jax.random.PRNGKey(0))
+    r = np.random.RandomState(4)
+    x = jnp.asarray(r.randn(2, 5, 6, 3).astype(np.float32))
+    proto = str(tmp_path / "m.prototxt")
+    cm = str(tmp_path / "m.caffemodel")
+    save_caffe(proto, cm, model, params, state, example_input=x)
+    cn = caffe_proto.load(proto, cm)
+    want, _ = model.apply(params, state, x, training=False)
+    np.testing.assert_allclose(_run(cn, np.asarray(x)), np.asarray(want),
+                               atol=1e-6)
+
+
+@pytest.mark.parametrize("dim", [0, -4])
+def test_tile_export_batch_dim_still_refused(tmp_path, dim):
+    import jax
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.interop.caffe_saver import save_caffe
+
+    model = nn.Sequential(nn.Tile(dim, 2))
+    params, state = model.init(jax.random.PRNGKey(0))
+    x = jnp.ones((2, 5, 6, 3), jnp.float32)
+    with pytest.raises(NotImplementedError, match="batch axis"):
+        save_caffe(str(tmp_path / "m.prototxt"),
+                   str(tmp_path / "m.caffemodel"),
+                   model, params, state, example_input=x)
+
+
+def test_rnn_import_warns_time_major(tmp_path):
+    """ADVICE r5: caffe recurrent blobs are time-major (T, N, D); the
+    import runs batch-major and must SAY so instead of silently
+    reinterpreting the layout (transpose contract in load()'s
+    docstring)."""
+    with pytest.warns(RuntimeWarning, match="TIME-major"):
+        _load(tmp_path, '''
+input: "data"
+input_dim: 1 input_dim: 5 input_dim: 4
+layer { name: "rnn" type: "RNN" bottom: "data" top: "rnn"
+  recurrent_param { num_output: 3 } }
+''')
+
+
 def test_reshape_nchw_semantics(tmp_path):
     """Caffe Reshape operates on the NCHW-contiguous buffer — the import
     must permute, reshape, and permute back (CaffeReshape)."""
