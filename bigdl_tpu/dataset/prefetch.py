@@ -16,6 +16,8 @@ from typing import Callable, Iterable, Iterator, Optional
 import jax
 import numpy as np
 
+from bigdl_tpu import observe
+
 
 def prefetch_to_device(it: Iterable, size: Optional[int] = None,
                        sharding=None, place_fn=None) -> Iterator:
@@ -61,11 +63,17 @@ def prefetch_to_device(it: Iterable, size: Optional[int] = None,
                 continue
         return False
 
+    depth = observe.gauge("data/prefetch_depth")
+
     def worker():
         try:
             for batch in it:
                 if stop.is_set() or not _put(place(batch)):
                     return                  # consumer abandoned the epoch
+                # in-flight batches ready for the trainer: a depth pinned
+                # at 0 means the host pipeline is the bottleneck, pinned
+                # at `size` means the device is
+                depth.set(q.qsize())
         except BaseException as e:          # surfaced on the consumer side
             err.append(e)
         finally:
@@ -161,6 +169,7 @@ class MTBatchPipeline:
                     np.stack([c[1] for c in chunk]))
 
         max_inflight = 2 * self.num_threads + self.batch_size
+        depth = observe.gauge("data/mt_pipeline_inflight")
         with ThreadPoolExecutor(self.num_threads) as pool:
             pending: deque = deque()
             chunk = []
@@ -169,6 +178,7 @@ class MTBatchPipeline:
                 if len(pending) > max_inflight:
                     chunk.append(pending.popleft().result())
                 if len(chunk) == self.batch_size:
+                    depth.set(len(pending))
                     yield emit(chunk)
                     chunk = []
             while pending:

@@ -68,6 +68,16 @@ def _common(p: argparse.ArgumentParser):
     p.add_argument("--checkpoint-keep-n", type=int, default=None,
                    help="retention: keep only the newest N committed "
                         "snapshots (BIGDL_TPU_CHECKPOINT_KEEP_N)")
+    p.add_argument("--trace-dir", default=None,
+                   help="flight recorder: record host spans and dump "
+                        "Chrome/Perfetto trace JSON here at the end of "
+                        "training (BIGDL_TPU_TRACE — "
+                        "docs/observability.md)")
+    p.add_argument("--metrics-jsonl", default=None,
+                   help="structured run log: append one JSON metrics "
+                        "snapshot per flush; render with `python -m "
+                        "bigdl_tpu.observe <file>` "
+                        "(BIGDL_TPU_METRICS_JSONL)")
 
 
 def _end_trigger(args, default_epochs):
@@ -80,6 +90,12 @@ def _end_trigger(args, default_epochs):
 def _finish(opt, args, model, app):
     from bigdl_tpu.optim.trigger import Trigger
     from bigdl_tpu import visualization as viz
+    if getattr(args, "trace_dir", None):
+        import os
+        os.environ["BIGDL_TPU_TRACE"] = args.trace_dir
+    if getattr(args, "metrics_jsonl", None):
+        import os
+        os.environ["BIGDL_TPU_METRICS_JSONL"] = args.metrics_jsonl
     if getattr(args, "steps_per_call", None):
         opt.set_steps_per_call(args.steps_per_call)
     if getattr(args, "accum_steps", None):
@@ -477,6 +493,10 @@ def main(argv=None):
     force_cpu_if_requested()
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(message)s")
+    # structured [p<index> <run-id>] prefix on every bigdl_tpu log line —
+    # multihost workers' interleaved stdout stays attributable
+    from bigdl_tpu.utils.runtime import install_log_prefix
+    install_log_prefix()
     ap = argparse.ArgumentParser(prog="bigdl_tpu.models.train")
     sub = ap.add_subparsers(dest="cmd", required=True)
 

@@ -1,0 +1,138 @@
+"""bigdl_tpu.observe — the flight recorder.
+
+Unified observability for the training stack (reference analogues:
+`optim/Metrics.scala` phase timers, `AbstractModule` nanosecond timers,
+`visualization/TrainSummary` events — SURVEY §2.10):
+
+  * **trace**   — thread-safe ring-buffered span tracer emitting
+                  Chrome/Perfetto `trace_event` JSON, with matching
+                  `jax.profiler.TraceAnnotation` scopes so host spans
+                  line up with XLA device traces;
+  * **metrics** — process-wide registry of counters, gauges, and
+                  log-bucket histograms (bounded memory for any run
+                  length) fed only host-side values — no added syncs;
+  * **export**  — TensorBoard / JSONL / Prometheus-textfile exporters
+                  flushed by one background thread;
+  * **report**  — `python -m bigdl_tpu.observe run.jsonl` phase table.
+
+Enable via knobs (utils/config.py): BIGDL_TPU_TRACE=<dir> records and
+dumps a trace per optimize(); BIGDL_TPU_METRICS_JSONL / _PROM / _TB
+attach exporters. The trainers call `ensure_started()` once per
+optimize() and `finish()` at the end — a disabled flight recorder costs
+one attribute check per span site.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from bigdl_tpu.observe import metrics as metrics  # noqa: F401 — re-export
+from bigdl_tpu.observe import trace as trace      # noqa: F401 — re-export
+from bigdl_tpu.observe.metrics import (counter, gauge, histogram, phase,
+                                       registry)
+from bigdl_tpu.observe.trace import get_tracer, instant, span
+from bigdl_tpu.utils.runtime import (install_log_prefix, process_index,
+                                     run_id)
+
+__all__ = [
+    "counter", "gauge", "histogram", "phase", "registry",
+    "get_tracer", "instant", "span",
+    "process_index", "run_id",
+    "ensure_started", "finish", "shutdown", "export_manager",
+]
+
+_lock = threading.Lock()
+_exports = None            # ExportManager when any exporter is configured
+_started = False
+_compile_listener = None
+
+
+def _on_jax_duration(event: str, duration: float, **kw):
+    if event.endswith("backend_compile_duration"):
+        counter("jit/compiles").inc()
+        counter("jit/compile_seconds").inc(duration)
+        trace.instant("jit/compile", cat="jit",
+                      args={"seconds": round(duration, 4)})
+
+
+def _install_jax_compile_listener() -> None:
+    """Count XLA compiles + seconds through jax.monitoring — the
+    flight-recorder view of "why was this step 40s": recompilation.
+    Registered once per process; survives jax's clear_event_listeners in
+    tests by re-registering on the next ensure_started."""
+    global _compile_listener
+    try:
+        from jax import monitoring
+        from jax._src import monitoring as _impl
+    except Exception:
+        return
+    live = getattr(_impl, "get_event_duration_listeners", lambda: [])()
+    if _compile_listener is not None and _compile_listener in live:
+        return
+    monitoring.register_event_duration_secs_listener(_on_jax_duration)
+    _compile_listener = _on_jax_duration
+
+
+def ensure_started() -> bool:
+    """Configure the flight recorder from the env knobs (idempotent; the
+    trainers call this at the top of optimize()). Returns True when any
+    observability sink (trace dir or exporter) is active."""
+    global _exports, _started
+    from bigdl_tpu.utils import config
+    with _lock:
+        install_log_prefix()
+        _install_jax_compile_listener()
+        trace_dir = config.get("TRACE")
+        t = get_tracer()
+        if trace_dir:
+            if trace_dir in ("1", "true", "yes", "on"):
+                trace_dir = "/tmp/bigdl_tpu_trace"
+            t.enable(trace_dir, ring=config.get("TRACE_RING"))
+        if _exports is None:
+            exporters = []
+            jsonl = config.get("METRICS_JSONL")
+            prom = config.get("METRICS_PROM")
+            tb = config.get("METRICS_TB")
+            from bigdl_tpu.observe.export import (ExportManager,
+                                                  JsonlExporter,
+                                                  PrometheusExporter,
+                                                  TensorBoardExporter)
+            if jsonl:
+                exporters.append(JsonlExporter(jsonl))
+            if prom:
+                exporters.append(PrometheusExporter(prom))
+            if tb and process_index() == 0:
+                exporters.append(TensorBoardExporter(tb))
+            if exporters:
+                _exports = ExportManager(
+                    exporters, flush_s=config.get("METRICS_FLUSH_S")).start()
+        _started = True
+        return bool(t.enabled or _exports)
+
+
+def export_manager():
+    """The live ExportManager (None when no exporter knob is set)."""
+    return _exports
+
+
+def finish() -> Optional[str]:
+    """End-of-optimize flush: dump the trace (returns its path) and push
+    one final exporter snapshot. The recorder stays enabled — a process
+    training twice appends both runs to the same flight record."""
+    t = get_tracer()
+    path = t.dump() if t.enabled else None
+    if _exports is not None:
+        _exports.flush()
+    return path
+
+
+def shutdown() -> None:
+    """Tear down exporters + disable tracing (tests / process exit)."""
+    global _exports, _started
+    with _lock:
+        if _exports is not None:
+            _exports.close()
+            _exports = None
+        get_tracer().disable()
+        _started = False

@@ -1,0 +1,7 @@
+"""`python -m bigdl_tpu.observe run.jsonl` — see observe/report.py."""
+
+import sys
+
+from bigdl_tpu.observe.report import main
+
+sys.exit(main())

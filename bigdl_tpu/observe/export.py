@@ -1,0 +1,200 @@
+"""Telemetry exporters — TensorBoard, JSONL run log, Prometheus textfile.
+
+One `ExportManager` owns all configured exporters and ONE background
+thread that flushes them on a fixed cadence (BIGDL_TPU_METRICS_FLUSH_S)
+plus once at close — the train loop never blocks on telemetry IO, the
+same contract the EventWriter thread (visualization.py) and the async
+snapshot writer (resilience/snapshot.py) already follow.
+
+Formats:
+  * TensorBoard — scalars for counters/gauges and native histogram
+    events built straight from the registry's log buckets, written
+    through the existing `visualization.EventWriter` (so the files are
+    byte-compatible with `tensorboard --logdir` and the parse_records
+    round-trip tests);
+  * JSONL — one self-contained JSON object per flush (ts, step, run id,
+    counters, gauges, histogram summaries+buckets): the `python -m
+    bigdl_tpu.observe` report input, and trivially greppable;
+  * Prometheus textfile — node-exporter textfile-collector format,
+    rewritten atomically each flush so a scraper never reads a torn
+    file.
+
+Multihost: each process exports its own stream; non-zero processes
+suffix their file names with `.p<index>` (TensorBoard event files are
+process-0-only via the Summary guard in visualization.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from typing import Dict, List, Optional
+
+from bigdl_tpu.observe import metrics as _metrics
+from bigdl_tpu.utils.runtime import process_index, run_id
+
+
+class Exporter:
+    """One export target. `export(snapshot, step)` must be quick and
+    must never raise into the flush thread (wrap IO errors)."""
+
+    def export(self, snapshot: dict, step: int) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+def _proc_suffix(path: str) -> str:
+    idx = process_index()
+    return path if idx == 0 else f"{path}.p{idx}"
+
+
+class JsonlExporter(Exporter):
+    """Append-only structured run log: one JSON object per flush."""
+
+    def __init__(self, path: str):
+        self.path = _proc_suffix(path)
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._fh = open(self.path, "a")
+
+    def export(self, snapshot: dict, step: int) -> None:
+        rec = {"ts": time.time(), "step": step, "run_id": run_id(),
+               "process_index": process_index(), **snapshot}
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    return "bigdl_tpu_" + _PROM_BAD.sub("_", name)
+
+
+class PrometheusExporter(Exporter):
+    """Textfile-collector format: the whole registry rewritten atomically
+    per flush (tmp + rename), counters as `counter`, gauges as `gauge`,
+    histograms as `_bucket{le=...}/_sum/_count`."""
+
+    def __init__(self, path: str):
+        self.path = _proc_suffix(path)
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+
+    def export(self, snapshot: dict, step: int) -> None:
+        lines: List[str] = []
+        for name, v in snapshot.get("counters", {}).items():
+            pn = _prom_name(name)
+            lines += [f"# TYPE {pn} counter", f"{pn} {v!r}"]
+        for name, v in snapshot.get("gauges", {}).items():
+            pn = _prom_name(name)
+            lines += [f"# TYPE {pn} gauge", f"{pn} {v!r}"]
+        for name, h in snapshot.get("histograms", {}).items():
+            pn = _prom_name(name)
+            lines.append(f"# TYPE {pn} histogram")
+            cum = 0
+            for le, c in zip(h["bounds"], h["counts"]):
+                cum += c
+                lines.append(f'{pn}_bucket{{le="{le!r}"}} {cum}')
+            lines.append(f'{pn}_bucket{{le="+Inf"}} {h["count"]}')
+            lines.append(f"{pn}_sum {h['sum']!r}")
+            lines.append(f"{pn}_count {h['count']}")
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+        os.replace(tmp, self.path)
+
+
+class TensorBoardExporter(Exporter):
+    """Scalars + histograms through the existing event-file machinery.
+    Counters/gauges become scalar events at `step`; each histogram
+    becomes a native TB histogram event rebuilt from the log buckets
+    (no raw samples are retained anywhere)."""
+
+    def __init__(self, log_dir: str):
+        from bigdl_tpu.visualization import EventWriter
+        self.log_dir = log_dir
+        self._writer = EventWriter(log_dir)
+        self._last: Dict[str, float] = {}
+
+    def export(self, snapshot: dict, step: int) -> None:
+        from bigdl_tpu.visualization import encode_histogram_stats_event
+        for name, v in snapshot.get("counters", {}).items():
+            self._writer.add_scalar(name, v, step)
+        for name, v in snapshot.get("gauges", {}).items():
+            self._writer.add_scalar(name, v, step)
+        for name, h in snapshot.get("histograms", {}).items():
+            if not h["count"] or h["count"] == self._last.get(name):
+                continue                       # unchanged since last flush
+            self._last[name] = h["count"]
+            stats = {"min": h["min"], "max": h["max"],
+                     "num": float(h["count"]), "sum": h["sum"],
+                     "sum_squares": h.get("sum_squares", 0.0),
+                     "bucket_limit": (list(h["bounds"])
+                                      + [max(h["max"],
+                                             h["bounds"][-1] * 2.0)]),
+                     "bucket": [float(c) for c in h["counts"]]}
+            self._writer.add_event(
+                encode_histogram_stats_event(name, stats, step))
+
+    def close(self) -> None:
+        self._writer.close()
+
+
+class ExportManager:
+    """All exporters + the single background flush thread."""
+
+    def __init__(self, exporters: List[Exporter],
+                 flush_s: float = 5.0,
+                 step_gauge: str = "train/neval"):
+        self.exporters = list(exporters)
+        self.flush_s = max(0.1, float(flush_s))
+        self._step_gauge = step_gauge
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ExportManager":
+        if self.exporters and self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="observe-export", daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.flush_s):
+            self.flush()
+
+    def flush(self) -> None:
+        """Export one registry snapshot everywhere. Exporter errors are
+        logged, never raised — telemetry must not kill training."""
+        snap = _metrics.registry().snapshot()
+        step = int(snap.get("gauges", {}).get(self._step_gauge, 0))
+        for ex in self.exporters:
+            try:
+                ex.export(snap, step)
+            except Exception as e:             # noqa: BLE001 — telemetry
+                import logging
+                logging.getLogger("bigdl_tpu").warning(
+                    "exporter %s failed: %s", type(ex).__name__, e)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        self.flush()                            # final consistent snapshot
+        for ex in self.exporters:
+            try:
+                ex.close()
+            except Exception:                  # noqa: BLE001 — shutdown
+                pass

@@ -1,0 +1,112 @@
+"""Report CLI — `python -m bigdl_tpu.observe <run.jsonl>`.
+
+Renders the phase-breakdown table from a JSONL run log written by
+`JsonlExporter` (knob BIGDL_TPU_METRICS_JSONL / --metrics-jsonl): where
+each second of a training run went, per phase (data-wait, placement,
+dispatch, flush, checkpoint...), plus the counters/gauges of the final
+snapshot. Can also schema-check a recorded Chrome/Perfetto trace
+(`--trace trace.json`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from bigdl_tpu.observe.metrics import phase_table
+
+
+def load_jsonl(path: str) -> List[dict]:
+    recs = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                recs.append(json.loads(line))
+    return recs
+
+
+def render_phase_table(snapshot: dict) -> str:
+    rows = phase_table(snapshot)
+    if not rows:
+        return "(no phase/ histograms in this run log)"
+    header = (f"{'phase':<28} {'count':>8} {'total s':>10} "
+              f"{'avg ms':>9} {'p50 ms':>9} {'max ms':>9} {'share':>7}")
+    lines = [header, "-" * len(header)]
+    for r in rows:
+        lines.append(
+            f"{r['phase']:<28} {r['count']:>8} {r['total_s']:>10.3f} "
+            f"{r['avg_ms']:>9.2f} {r['p50_ms']:>9.2f} {r['max_ms']:>9.2f} "
+            f"{r['share']:>6.1%}")
+    return "\n".join(lines)
+
+
+def render_report(recs: List[dict]) -> str:
+    if not recs:
+        return "empty run log"
+    last = recs[-1]
+    out = []
+    out.append(f"run {last.get('run_id', '?')} · p{last.get('process_index', 0)}"
+               f" · {len(recs)} flushes · final step {last.get('step', 0)}")
+    out.append("")
+    out.append(render_phase_table(last))
+    counters = last.get("counters", {})
+    gauges = last.get("gauges", {})
+    if counters:
+        out.append("")
+        out.append("counters:")
+        for name, v in sorted(counters.items()):
+            out.append(f"  {name:<38} {v:,.6g}")
+    if gauges:
+        out.append("")
+        out.append("gauges:")
+        for name, v in sorted(gauges.items()):
+            out.append(f"  {name:<38} {v:,.6g}")
+    return "\n".join(out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bigdl_tpu.observe",
+        description="Flight-recorder report: phase breakdown from a "
+                    "JSONL run log (BIGDL_TPU_METRICS_JSONL)")
+    ap.add_argument("run_jsonl", nargs="?",
+                    help="run log written by the JSONL exporter")
+    ap.add_argument("--trace", default=None,
+                    help="also validate a recorded Chrome/Perfetto trace "
+                         "JSON and summarize its spans")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON instead of a table")
+    args = ap.parse_args(argv)
+    if not args.run_jsonl and not args.trace:
+        ap.error("need a run.jsonl and/or --trace")
+    rc = 0
+    if args.run_jsonl:
+        recs = load_jsonl(args.run_jsonl)
+        if args.json:
+            last = recs[-1] if recs else {}
+            print(json.dumps({"flushes": len(recs),
+                              "phases": phase_table(last),
+                              "counters": last.get("counters", {}),
+                              "gauges": last.get("gauges", {})}))
+        else:
+            print(render_report(recs))
+    if args.trace:
+        from bigdl_tpu.observe.trace import validate_chrome_trace
+        with open(args.trace) as fh:
+            doc = json.load(fh)
+        problems = validate_chrome_trace(doc)
+        events = [e for e in doc.get("traceEvents", [])
+                  if e.get("ph") == "X"]
+        print(f"\ntrace {args.trace}: {len(events)} spans, "
+              f"{'VALID' if not problems else 'INVALID'}")
+        for p in problems[:20]:
+            print(f"  problem: {p}")
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

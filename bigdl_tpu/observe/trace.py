@@ -1,0 +1,241 @@
+"""Host-side span tracer — the flight recorder's timeline.
+
+The reference logs per-phase wall times through `optim/Metrics.scala`
+accumulators and leaves the timeline to the driver log; with fused
+dispatch (PR 2) and async checkpointing (PR 3) the train loop has five
+asynchronous moving parts (host batch assembly, H2D placement, K-step
+scan dispatch, metric flush, background snapshot writer) and a log line
+cannot show which one a slow step waited on. This tracer records spans
+from EVERY thread into one lock-free ring buffer and emits standard
+Chrome/Perfetto `trace_event` JSON, so `chrome://tracing` / ui.perfetto.dev
+renders the actual interleaving.
+
+Design constraints, in order:
+
+  * **Zero allocation on the hot path when disabled.** `span()` returns a
+    module-level singleton no-op context manager; the enabled check is one
+    attribute load. Callers pass `args=None` (no kwargs dict is built).
+  * **Thread-safe without a lock.** Events append to a
+    `collections.deque(maxlen=ring)` — atomic under the GIL, and the
+    bounded ring means a forgotten tracer can never eat the heap (the
+    oldest spans fall off, which is exactly what a flight recorder does).
+  * **Monotonic clocks.** Timestamps are `time.perf_counter_ns()` deltas
+    from the tracer's start; the wall-clock anchor rides the metadata so
+    traces from different hosts can still be lined up.
+  * **Device correlation.** When enabled, each span also enters a
+    `jax.profiler.TraceAnnotation` scope, so a `jax.profiler.trace`
+    capture taken during the run shows these host spans aligned with the
+    XLA device timeline (utils/profile.xla_profile).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional
+
+
+class _NullSpan:
+    """Shared disabled-path context manager: no state, no allocation."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "name", "cat", "args", "_t0", "_ann")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str, args):
+        self._tracer = tracer
+        self.name, self.cat, self.args = name, cat, args
+
+    def __enter__(self):
+        ann = None
+        if self._tracer.annotate:
+            try:
+                import jax
+                ann = jax.profiler.TraceAnnotation(self.name)
+                ann.__enter__()
+            except Exception:              # profiler unavailable — host-only
+                ann = None
+        self._ann = ann
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter_ns()
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+        self._tracer.record(self.name, self.cat, self._t0, t1 - self._t0,
+                            self.args)
+        return False
+
+
+class Tracer:
+    """Ring-buffered span recorder. One process-wide instance lives in
+    this module (`get_tracer()`); tests may build private ones."""
+
+    def __init__(self, ring: int = 100_000, annotate: bool = True):
+        self.enabled = False
+        self.annotate = annotate
+        self.trace_dir: Optional[str] = None
+        self._ring = ring
+        self._events: deque = deque(maxlen=ring)
+        self._thread_names: Dict[int, str] = {}
+        self._t0_ns = time.perf_counter_ns()
+        self._wall0 = time.time()
+
+    # ------------------------------------------------------------- control
+    def enable(self, trace_dir: Optional[str] = None,
+               ring: Optional[int] = None) -> None:
+        if ring and ring != self._ring:
+            self._ring = ring
+            self._events = deque(self._events, maxlen=ring)
+        self.trace_dir = trace_dir
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def clear(self) -> None:
+        self._events.clear()
+
+    # ------------------------------------------------------------ recording
+    def span(self, name: str, cat: str = "host", args: Optional[dict] = None):
+        """Context manager timing a host phase. Disabled: returns the
+        shared no-op singleton (zero allocation)."""
+        if not self.enabled:
+            return NULL_SPAN
+        return _Span(self, name, cat, args)
+
+    def record(self, name: str, cat: str, t0_ns: int, dur_ns: int,
+               args: Optional[dict] = None) -> None:
+        """Append one complete ('X') event; called by _Span.__exit__ and
+        by instrumentation that timed a phase itself."""
+        if not self.enabled:
+            return
+        tid = threading.get_ident()
+        if tid not in self._thread_names:
+            self._thread_names[tid] = threading.current_thread().name
+        self._events.append(("X", name, cat, tid, t0_ns, dur_ns, args))
+
+    def instant(self, name: str, cat: str = "host",
+                args: Optional[dict] = None) -> None:
+        """Zero-duration marker (fault injected, retry, preemption...)."""
+        if not self.enabled:
+            return
+        tid = threading.get_ident()
+        if tid not in self._thread_names:
+            self._thread_names[tid] = threading.current_thread().name
+        self._events.append(("i", name, cat, tid,
+                             time.perf_counter_ns(), 0, args))
+
+    # ------------------------------------------------------------- export
+    def _ts_us(self, t_ns: int) -> float:
+        return (t_ns - self._t0_ns) / 1e3
+
+    def chrome_trace(self) -> dict:
+        """The ring buffer as a Chrome/Perfetto `trace_event` JSON object
+        (object form so metadata rides along)."""
+        from bigdl_tpu.utils.runtime import process_index, run_id
+        pid = process_index()
+        events: List[dict] = [
+            {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": f"bigdl_tpu p{pid} {run_id()}"}},
+        ]
+        for tid, tname in sorted(self._thread_names.items()):
+            events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": tid, "args": {"name": tname}})
+        for ph, name, cat, tid, t0, dur, args in list(self._events):
+            ev = {"name": name, "cat": cat, "ph": ph, "pid": pid,
+                  "tid": tid, "ts": self._ts_us(t0)}
+            if ph == "X":
+                ev["dur"] = dur / 1e3
+            else:
+                ev["s"] = "t"
+            if args:
+                ev["args"] = dict(args)
+            events.append(ev)
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "run_id": run_id(),
+                "process_index": pid,
+                "wall_time_origin": self._wall0,
+            },
+        }
+
+    def dump(self, path: Optional[str] = None) -> Optional[str]:
+        """Write the Chrome trace JSON. `path=None` uses
+        `<trace_dir>/trace.p<index>.json`; no dir configured → no-op.
+        Returns the written path."""
+        if path is None:
+            if not self.trace_dir:
+                return None
+            from bigdl_tpu.utils.runtime import process_index
+            os.makedirs(self.trace_dir, exist_ok=True)
+            path = os.path.join(self.trace_dir,
+                                f"trace.p{process_index()}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(self.chrome_trace(), fh)
+        os.replace(tmp, path)
+        return path
+
+    def events(self) -> Iterable[tuple]:
+        """Raw ring contents (tests / report tooling)."""
+        return list(self._events)
+
+
+_TRACER = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _TRACER
+
+
+def span(name: str, cat: str = "host", args: Optional[dict] = None):
+    """Module-level hot-path entry: `with trace.span("train/dispatch"): ...`
+    Disabled tracing returns the no-op singleton."""
+    if not _TRACER.enabled:
+        return NULL_SPAN
+    return _Span(_TRACER, name, cat, args)
+
+
+def instant(name: str, cat: str = "host",
+            args: Optional[dict] = None) -> None:
+    if _TRACER.enabled:
+        _TRACER.instant(name, cat, args)
+
+
+def validate_chrome_trace(doc: Any) -> List[str]:
+    """Schema check for Chrome/Perfetto trace JSON — the report CLI and
+    tests use it; returns a list of problems (empty = valid)."""
+    problems: List[str] = []
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        return ["missing traceEvents"]
+    for i, ev in enumerate(doc["traceEvents"]):
+        for key in ("name", "ph", "pid", "tid"):
+            if key not in ev:
+                problems.append(f"event {i}: missing {key!r}")
+        ph = ev.get("ph")
+        if ph not in ("X", "i", "M", "B", "E", "C"):
+            problems.append(f"event {i}: unknown ph {ph!r}")
+        if ph == "X":
+            if "ts" not in ev or "dur" not in ev:
+                problems.append(f"event {i}: X event needs ts+dur")
+            elif ev["dur"] < 0:
+                problems.append(f"event {i}: negative dur")
+    return problems

@@ -13,12 +13,14 @@ from __future__ import annotations
 
 import logging
 import time
+from collections import deque
 from functools import partial
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from bigdl_tpu import observe
 from bigdl_tpu.core.module import Criterion, Module
 from bigdl_tpu.optim.method import OptimMethod, SGD
 from bigdl_tpu.optim.metrics import ValidationMethod, ValidationResult
@@ -358,13 +360,19 @@ class Optimizer:
         return params, model_state, slots
 
     def _place_batch(self, x, y):
-        return jnp.asarray(x), jnp.asarray(y)
+        with observe.phase("data/placement", cat="data"):
+            xd, yd = jnp.asarray(x), jnp.asarray(y)
+        observe.counter("data/h2d_bytes").inc(xd.nbytes + yd.nbytes)
+        return xd, yd
 
     def _place_stacked_batch(self, xs, ys):
         """Place a K-stacked super-batch ([K, batch, ...]) in ONE H2D
         transfer. The distributed trainer overrides this to shard the
         batch dim (dim 1) over the mesh's data axis."""
-        return jnp.asarray(xs), jnp.asarray(ys)
+        with observe.phase("data/placement", cat="data"):
+            xd, yd = jnp.asarray(xs), jnp.asarray(ys)
+        observe.counter("data/h2d_bytes").inc(xd.nbytes + yd.nbytes)
+        return xd, yd
 
     def _batch_iter(self, epoch_iter):
         """Stream (x, y) batches through host→device prefetch so the H2D
@@ -475,8 +483,28 @@ class Optimizer:
         self._resume_trees = dict(self._initial_trees)
         return self
 
+    def _observed_batches(self, it):
+        """Yield batches, timing the train loop's wait on each one (span
+        `train/data_wait`). With prefetch on this is pure queue wait —
+        host pipeline + H2D run in the worker thread and show up in the
+        trace as `data/placement` spans on that thread; with prefetch off
+        it includes the inline decode + placement."""
+        it = iter(it)
+        phase = observe.phase
+        while True:
+            with phase("train/data_wait"):
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+            yield batch
+
     # -------------------------------------------------------------- optimize
     def optimize(self) -> Tuple[Dict, Dict]:
+        # flight recorder (observe/): knob-gated trace spans + metrics
+        # exporters; a disabled recorder costs one attribute check per
+        # span site (BIGDL_TPU_TRACE / _METRICS_* — docs/observability.md)
+        observe.ensure_started()
         rng = jax.random.PRNGKey(self.seed)
         # disjoint key namespace from the 0xBD1 init fold below — a step
         # key derived straight from (rng, neval) would collide with the
@@ -517,7 +545,11 @@ class Optimizer:
         self._pending: List[tuple] = []
         self._window_t0 = time.time()
         self._window_records = 0
-        self._ckpt_stalls: List[float] = []
+        # bounded: long runs used to grow this list forever; the full
+        # distribution lives in the phase/train/checkpoint log-bucket
+        # histogram (observe/metrics.py), this deque keeps only the
+        # newest samples for bench.py checkpoint mode
+        self._ckpt_stalls: "deque[float]" = deque(maxlen=256)
         if self.ckpt_path is not None:
             from bigdl_tpu.utils import config as _cfg
             if _cfg.get("CHECKPOINT_ON_PREEMPT"):
@@ -568,16 +600,22 @@ class Optimizer:
                 (params, model_state, slots, epoch_records,
                  ended_mid_epoch) = self._fused_epoch(
                     fused_step, epoch_iter, params, model_state, slots, st)
-            for xd, yd in (() if use_fused else self._batch_iter(epoch_iter)):
+            for xd, yd in (() if use_fused else
+                           self._observed_batches(
+                               self._batch_iter(epoch_iter))):
                 lr = self.method.current_lr(st)
                 sub = jax.random.fold_in(step_rng, st["neval"])
                 if self._param_summary_enabled():
                     # batch refs only (never donated) — lets the Parameters
                     # summary recompute gradients on its cadence
                     self._last_batch = (xd, yd, sub)
-                params, model_state, slots, loss = step(
-                    params, model_state, slots, xd, yd,
-                    jnp.float32(lr), jnp.int32(st["neval"]), sub)
+                with observe.phase("train/dispatch"):
+                    # async dispatch latency: the time Python takes to
+                    # hand XLA the step, NOT device compute (which the
+                    # flush span pays when it fetches the losses)
+                    params, model_state, slots, loss = step(
+                        params, model_state, slots, xd, yd,
+                        jnp.float32(lr), jnp.int32(st["neval"]), sub)
                 # GLOBAL batch dim (multi-host _place_batch assembles the
                 # global array): records/throughput count the whole job's
                 # progress, the reference's recordsProcessedThisEpoch
@@ -614,6 +652,9 @@ class Optimizer:
             st["batch_in_epoch"] = 0
             st["epoch_finished"] = True
             dur = time.time() - epoch_start
+            observe.instant("train/epoch_end", cat="train",
+                            args={"epoch": st["epoch"] - 1,
+                                  "records": epoch_records})
             log.info("epoch %d done: %d records in %.1fs (%.1f rec/s)",
                      st["epoch"] - 1, epoch_records, dur, epoch_records / max(dur, 1e-9))
             self._maybe_param_summary(params, model_state, st)
@@ -623,6 +664,11 @@ class Optimizer:
 
         self._flush_metrics(st)
         self._finish_checkpoints()         # join any background snapshot
+
+        trace_path = observe.finish()      # dump trace + final export flush
+        if trace_path:
+            log.info("flight-recorder trace -> %s "
+                     "(chrome://tracing / ui.perfetto.dev)", trace_path)
 
         self._last_batch = None            # release pinned device buffers
         self.params, self.model_state, self.slots = params, model_state, slots
@@ -672,13 +718,17 @@ class Optimizer:
         epoch_records = 0
         ended_mid_epoch = False
         W = self._log_every
-        for xs, ys in self._fused_batch_iter(epoch_iter):
+        for xs, ys in self._observed_batches(
+                self._fused_batch_iter(epoch_iter)):
             k = int(xs.shape[0])
             lrs, nevals, rngs, lr_list = self._fused_inputs(st, k)
             if self._param_summary_enabled():
                 self._last_batch = (xs[-1], ys[-1], rngs[-1])
-            params, model_state, slots, losses = fused_step(
-                params, model_state, slots, xs, ys, lrs, nevals, rngs)
+            with observe.phase("train/dispatch"):
+                # one span covers the whole K-step scan dispatch — divide
+                # by k when comparing against per-step numbers
+                params, model_state, slots, losses = fused_step(
+                    params, model_state, slots, xs, ys, lrs, nevals, rngs)
             n = int(xs.shape[1])           # GLOBAL batch rows per step
             start = st["neval"]
             for i in range(k):
@@ -742,9 +792,22 @@ class Optimizer:
             return
         dt = time.time() - self._window_t0
         rate = self._window_records / max(dt, 1e-9)
-        losses = jax.device_get([p[2] for p in pending])
+        with observe.phase("train/flush"):
+            # the ONE host sync of the loop: blocks until the last
+            # dispatched step's losses land — device compute backlog
+            # shows up here, which is exactly what the span shows
+            losses = jax.device_get([p[2] for p in pending])
         last_iter, last_lr = pending[-1][0], pending[-1][1]
         st["loss"] = float(losses[-1])
+        # registry updates ride this existing cadence with values already
+        # on host — observability adds NO per-step syncs (asserted by
+        # tests/test_observe.py)
+        g = observe.gauge
+        g("train/neval").set(last_iter)
+        g("train/loss").set(st["loss"])
+        g("train/lr").set(last_lr)
+        g("train/throughput").set(rate)
+        observe.counter("train/records").inc(self._window_records)
         log.info("epoch %d iter %d loss %.4f lr %.5f %.1f rec/s",
                  st["epoch"], last_iter, st["loss"], last_lr, rate)
         if self._summary is not None:
@@ -884,17 +947,20 @@ class Optimizer:
         meta.update(self._snapshot_extra_meta())
         trees = {"params": params, "model_state": model_state,
                  "slots": slots}
-        t0 = time.time()
+        t0 = time.perf_counter()
         from bigdl_tpu.utils import config
-        if config.get("CHECKPOINT_FORMAT") == 1:
-            # legacy v1: synchronous gather-to-host-0 single npz
-            ckpt.save_checkpoint(path, trees, meta)
-        else:
-            self._checkpointer().save(path, trees, meta,
-                                      root=self.ckpt_path,
-                                      clone=self._step_donates())
-        # per-save blocking stall, observable by bench.py checkpoint mode
-        self._ckpt_stalls.append(time.time() - t0)
+        with observe.phase("train/checkpoint"):
+            if config.get("CHECKPOINT_FORMAT") == 1:
+                # legacy v1: synchronous gather-to-host-0 single npz
+                ckpt.save_checkpoint(path, trees, meta)
+            else:
+                self._checkpointer().save(path, trees, meta,
+                                          root=self.ckpt_path,
+                                          clone=self._step_donates())
+        # per-save blocking stall: newest samples ride the bounded deque
+        # (bench.py checkpoint mode), the full run's distribution lives
+        # in the phase/train/checkpoint log-bucket histogram
+        self._ckpt_stalls.append(time.perf_counter() - t0)
         log.info("checkpoint -> %s (%.1f ms stall)", path,
                  self._ckpt_stalls[-1] * 1e3)
 

@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from bigdl_tpu import observe
 from bigdl_tpu.core.module import Criterion, Module
 from bigdl_tpu.optim.local import Optimizer
 from bigdl_tpu.optim.method import OptimMethod
@@ -138,9 +139,11 @@ class DistriOptimizer(Optimizer):
                 f"the {self._data_axis_size}-way data axis — use a "
                 f"batch_size that is a multiple of {self._data_axis_size}")
         sh = self._batch_sharding(x)
-        if jax.process_count() > 1:
-            return jax.make_array_from_process_local_data(sh, x)
-        return jax.device_put(x, sh)
+        observe.counter("data/h2d_bytes").inc(x.nbytes)
+        with observe.phase("data/placement", cat="data"):
+            if jax.process_count() > 1:
+                return jax.make_array_from_process_local_data(sh, x)
+            return jax.device_put(x, sh)
 
     def _place_batch(self, x, y):
         return self._place_array(x), self._place_array(y)
@@ -163,9 +166,11 @@ class DistriOptimizer(Optimizer):
                 f"the {self._data_axis_size}-way data axis — use a "
                 f"batch_size that is a multiple of {self._data_axis_size}")
         sh = self._stacked_batch_sharding(x)
-        if jax.process_count() > 1:
-            return jax.make_array_from_process_local_data(sh, x)
-        return jax.device_put(x, sh)
+        observe.counter("data/h2d_bytes").inc(x.nbytes)
+        with observe.phase("data/placement", cat="data"):
+            if jax.process_count() > 1:
+                return jax.make_array_from_process_local_data(sh, x)
+            return jax.device_put(x, sh)
 
     def _place_stacked_batch(self, xs, ys):
         return self._place_stacked_array(xs), self._place_stacked_array(ys)

@@ -94,6 +94,10 @@ def check_step(neval: int) -> None:
     if inj.step is None or inj.fired or neval < inj.step:
         return
     inj.fired = True
+    from bigdl_tpu import observe
+    observe.counter("resilience/faults_injected").inc()
+    observe.instant(f"fault/{inj.kind}", cat="resilience",
+                    args={"step": neval})
     if inj.kind == CRASH:
         log.warning("fault injection: crash at iteration %d", neval)
         raise SimulatedCrash(f"injected crash at iteration {neval}")
@@ -134,6 +138,9 @@ def install_sigterm_handler() -> bool:
 def _on_sigterm(signum, frame):
     log.warning("SIGTERM: final checkpoint requested at the next "
                 "step boundary")
+    from bigdl_tpu import observe
+    observe.counter("resilience/preemptions").inc()
+    observe.instant("preempt/sigterm", cat="resilience")
     _preempt.set()
 
 

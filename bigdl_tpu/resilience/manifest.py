@@ -42,7 +42,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from bigdl_tpu.visualization import crc32c
+from bigdl_tpu.utils.crc import crc32c_of
 
 FORMAT_VERSION = 2
 MANIFEST = "manifest.json"
@@ -56,15 +56,10 @@ class CorruptSnapshot(RuntimeError):
 
 # --------------------------------------------------------------- helpers
 def _crc(data) -> int:
-    """CRC32C of an array's raw bytes. Prefers a C implementation when the
-    image carries one (same polynomial); falls back to the pure-python
-    table loop in visualization.py."""
-    buf = data.tobytes() if hasattr(data, "tobytes") else bytes(data)
-    try:
-        import google_crc32c                      # optional, never required
-        return int.from_bytes(google_crc32c.Checksum(buf).digest(), "big")
-    except Exception:
-        return crc32c(buf)
+    """CRC32C of an array's raw bytes — the shared util (utils/crc.py:
+    C-accelerated when the google_crc32c wheel is present, pure-python
+    table fallback; same Castagnoli polynomial either way)."""
+    return crc32c_of(data)
 
 
 def _dtype_str(dt) -> str:
@@ -172,15 +167,19 @@ def write_snapshot(path: str, plan: dict,
     os.makedirs(path, exist_ok=True)
     faults.maybe_fail_io(path)                 # deterministic IO-fault hook
     table, npz = {}, {}
+    total_bytes = 0
     for k, pcs in pieces.items():
         for i, p in enumerate(pcs):
             key = f"{k}::p{i}"
             data = np.asarray(p["data"])       # device->host happens HERE
             npz[key] = data
+            total_bytes += data.nbytes
             table[key] = {"array": k, "index": p["index"],
                           "crc32c": _crc(data)}
     with open(os.path.join(path, shard_file(proc)), "wb") as fh:
         np.savez(fh, **npz)
+    from bigdl_tpu import observe
+    observe.counter("checkpoint/bytes_written").inc(total_bytes)
     tmp_tbl = os.path.join(path, shard_index_file(proc) + ".tmp")
     with open(tmp_tbl, "w") as fh:
         json.dump(table, fh)

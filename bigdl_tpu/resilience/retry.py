@@ -43,6 +43,10 @@ class RetryPolicy:
         self.failures = [t for t in self.failures
                          if now - t < self.window_s]
         self.failures.append(now)
+        from bigdl_tpu import observe
+        observe.counter("resilience/retries").inc()
+        observe.instant("retry", cat="resilience",
+                        args={"failures_in_window": len(self.failures)})
         return len(self.failures)
 
     def exhausted(self) -> bool:

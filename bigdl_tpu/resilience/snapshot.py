@@ -28,6 +28,7 @@ import queue
 import threading
 from typing import Any, Dict, Optional
 
+from bigdl_tpu import observe
 from bigdl_tpu.resilience import manifest
 
 log = logging.getLogger("bigdl_tpu")
@@ -73,11 +74,17 @@ class AsyncCheckpointer:
 
     def _persist(self, path: str, plan: dict, root: Optional[str]):
         try:
-            manifest.write_snapshot(path, plan)
-            if root is not None and plan["process_index"] == 0:
-                manifest.gc_snapshots(root, self.keep_n)
+            # runs on the ckpt-writer thread: its own lane in the trace
+            with observe.phase("checkpoint/persist", cat="checkpoint"):
+                manifest.write_snapshot(path, plan)
+                if root is not None and plan["process_index"] == 0:
+                    manifest.gc_snapshots(root, self.keep_n)
+            observe.counter("checkpoint/saves").inc()
         except BaseException as e:                 # noqa: BLE001 — deferred
             self._error = e
+            observe.counter("checkpoint/failures").inc()
+            observe.instant("checkpoint/failure", cat="checkpoint",
+                            args={"path": path, "error": str(e)[:200]})
             log.error("background checkpoint %s failed: %s", path, e)
 
     def _run_worker(self):
@@ -119,18 +126,26 @@ class AsyncCheckpointer:
         stall drops to the piece-plan build alone)."""
         if self.async_mode:
             # buffer B (async dispatch) while buffer A's write drains
-            clones = self._clone(trees) if clone else trees
+            if clone:
+                with observe.phase("checkpoint/clone", cat="checkpoint"):
+                    clones = self._clone(trees)
+            else:
+                clones = trees
             self.wait()                            # join buffer A's write
-            plan = manifest.snapshot_to_host(clones, meta)
+            with observe.phase("checkpoint/plan", cat="checkpoint"):
+                plan = manifest.snapshot_to_host(clones, meta)
             self._last_path = path
             self._enqueue(path, plan, root)
         else:
             self.wait()
-            plan = manifest.snapshot_to_host(trees, meta)
+            with observe.phase("checkpoint/plan", cat="checkpoint"):
+                plan = manifest.snapshot_to_host(trees, meta)
             self._last_path = path
-            manifest.write_snapshot(path, plan)
-            if root is not None and plan["process_index"] == 0:
-                manifest.gc_snapshots(root, self.keep_n)
+            with observe.phase("checkpoint/persist", cat="checkpoint"):
+                manifest.write_snapshot(path, plan)
+                if root is not None and plan["process_index"] == 0:
+                    manifest.gc_snapshots(root, self.keep_n)
+            observe.counter("checkpoint/saves").inc()
 
     def wait(self) -> None:
         """Block until the in-flight background write (if any) is fully

@@ -110,6 +110,34 @@ _register("FAULT", "", str,
           "preempt (SIGTERM self) | io (fail the next shard write). "
           "Fires once at the first step boundary >= N "
           "(resilience/faults.py)")
+_register("TRACE", "", str,
+          "Flight-recorder span tracing (observe/trace.py): a directory "
+          "records host spans and dumps Chrome/Perfetto trace JSON there "
+          "at the end of each optimize(); '1' uses /tmp/bigdl_tpu_trace; "
+          "'' disables (zero-allocation no-op spans)")
+_register("TRACE_RING", 100_000, int,
+          "Span ring-buffer capacity: the newest N events are kept, the "
+          "oldest fall off — a flight recorder, not an unbounded log "
+          "(observe/trace.py)")
+_register("METRICS_JSONL", "", str,
+          "Structured run log: one JSON object per metrics flush appended "
+          "to this path (observe/export.py); input of the "
+          "`python -m bigdl_tpu.observe` phase report. '' disables")
+_register("METRICS_PROM", "", str,
+          "Prometheus textfile-collector export: the metrics registry "
+          "rewritten atomically to this path every flush "
+          "(observe/export.py). '' disables")
+_register("METRICS_TB", "", str,
+          "TensorBoard export dir for the metrics registry (scalars + "
+          "native histogram events through visualization.EventWriter; "
+          "process 0 only). '' disables")
+_register("METRICS_FLUSH_S", 5.0, float,
+          "Seconds between background exporter flushes "
+          "(observe/export.py ExportManager)")
+_register("RUN_ID", "", str,
+          "Run id stamped into log prefixes, traces, and JSONL records; "
+          "set the same value on every host of a multihost job "
+          "(utils/runtime.py; '' derives one per process)")
 _register("BENCH_LOCK_FILE", "/tmp/bigdl_tpu_bench.lock", str,
           "Lockfile serializing bench.py against tools/tpu_watch.sh so "
           "the harness cannot pollute the CPU trend series (ADVICE r5 #5)")

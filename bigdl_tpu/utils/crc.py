@@ -1,0 +1,60 @@
+"""Shared CRC32C (Castagnoli) — one home for every checksum in the tree.
+
+Both TensorBoard record framing (visualization.py, reference:
+netty/Crc32c.java + visualization/tensorboard/RecordWriter.scala) and
+snapshot piece integrity (resilience/manifest.py) use the same
+polynomial; before this module each carried its own copy and the event
+writer ran the per-byte pure-Python loop on every record. The fast path
+binds the C `google_crc32c` wheel ONCE at import (the per-call
+try/import the manifest used to do costs more than small checksums);
+the pure-Python table stays as the dependency-free fallback and as the
+oracle the fast path is tested against (tests/test_observe.py).
+"""
+
+from __future__ import annotations
+
+_POLY = 0x82F63B78
+_CRC_TABLE = []
+for _n in range(256):
+    _c = _n
+    for _ in range(8):
+        _c = (_c >> 1) ^ _POLY if _c & 1 else _c >> 1
+    _CRC_TABLE.append(_c)
+
+
+def crc32c_py(data: bytes, crc: int = 0) -> int:
+    """Pure-Python Castagnoli CRC (reference: netty/Crc32c.java).
+    Always available; used directly only as fallback/oracle."""
+    crc ^= 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+try:
+    import google_crc32c as _gcrc
+
+    def crc32c(data: bytes, crc: int = 0) -> int:
+        """Castagnoli CRC32C, C-accelerated (google_crc32c.extend is the
+        seeded form; identical values to `crc32c_py`)."""
+        return _gcrc.extend(crc, data)
+
+    ACCELERATED = True
+except Exception:                                 # wheel absent — pure py
+    crc32c = crc32c_py
+    ACCELERATED = False
+
+
+def masked_crc32c(data: bytes) -> int:
+    """TFRecord-style masked CRC (rotate + magic), used by the event-file
+    framing on both the write and parse-back paths."""
+    crc = crc32c(data)
+    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+def crc32c_of(array_like) -> int:
+    """CRC32C of an array's raw bytes (ndarray or anything exposing
+    tobytes) — the snapshot-piece form (resilience/manifest.py)."""
+    buf = (array_like.tobytes() if hasattr(array_like, "tobytes")
+           else bytes(array_like))
+    return crc32c(buf)

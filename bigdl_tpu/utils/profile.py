@@ -101,37 +101,12 @@ def xla_profile(fn: Callable, *args, logdir: str = "/tmp/bigdl_tpu_profile",
     return logdir
 
 
-class IterationMetrics:
-    """Phase-timing accumulator for training loops (reference:
-    optim/Metrics.scala:31-123 — set/add per phase, summary string)."""
-
-    def __init__(self):
-        self._sums: Dict[str, float] = {}
-        self._counts: Dict[str, int] = {}
-
-    def add(self, phase: str, seconds: float):
-        self._sums[phase] = self._sums.get(phase, 0.0) + seconds
-        self._counts[phase] = self._counts.get(phase, 0) + 1
-
-    def time(self, phase: str):
-        metrics = self
-
-        class _Ctx:
-            def __enter__(self):
-                self.t0 = time.perf_counter()
-
-            def __exit__(self, *a):
-                metrics.add(phase, time.perf_counter() - self.t0)
-
-        return _Ctx()
-
-    def summary(self) -> str:
-        lines = []
-        for phase, s in sorted(self._sums.items(), key=lambda kv: -kv[1]):
-            n = self._counts[phase]
-            lines.append(f"{phase}: total {s:.3f}s over {n} "
-                         f"(avg {s / n * 1e3:.2f}ms)")
-        return "\n".join(lines)
+# IterationMetrics was absorbed by the flight recorder (PR 4): the same
+# reference-shaped facade now lives in observe/metrics.py, optionally
+# mirroring every sample into the process-wide registry so ad-hoc users
+# ride the same exporters as the trainers. Re-exported here for the
+# pre-existing import sites.
+from bigdl_tpu.observe.metrics import IterationMetrics  # noqa: E402,F401
 
 
 def device_memory_summary(device=None):

@@ -22,27 +22,12 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-# ---------------------------------------------------------------- CRC32C
-_CRC_TABLE = []
-_POLY = 0x82F63B78
-for _n in range(256):
-    _c = _n
-    for _ in range(8):
-        _c = (_c >> 1) ^ _POLY if _c & 1 else _c >> 1
-    _CRC_TABLE.append(_c)
-
-
-def crc32c(data: bytes, crc: int = 0) -> int:
-    """Castagnoli CRC (reference: netty/Crc32c.java)."""
-    crc ^= 0xFFFFFFFF
-    for b in data:
-        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
-    return crc ^ 0xFFFFFFFF
-
-
-def _masked_crc(data: bytes) -> int:
-    crc = crc32c(data)
-    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+# CRC32C lives in utils/crc.py (shared with resilience/manifest.py, C
+# -accelerated when the google_crc32c wheel is present — record framing
+# used to run the per-byte pure-Python loop on every event). `crc32c` is
+# re-exported here for the pre-existing import sites.
+from bigdl_tpu.utils.crc import crc32c  # noqa: F401 — public re-export
+from bigdl_tpu.utils.crc import masked_crc32c as _masked_crc
 
 
 # -------------------------------------------------------- proto encoding
@@ -94,6 +79,26 @@ def _pb_packed_doubles(field: int, vals) -> bytes:
     return _tag(field, 2) + _varint(len(payload)) + payload
 
 
+def encode_histogram_stats_event(tag: str, stats: dict, step: int,
+                                 wall_time: Optional[float] = None) -> bytes:
+    """HistogramProto event from PRECOMPUTED stats — min/max/num/sum/
+    sum_squares/bucket_limit/bucket (the same keys parse_histogram_event
+    returns). Lets the flight recorder's log-bucket histograms
+    (observe/metrics.py) export natively without retaining raw samples."""
+    histo = (_pb_double(1, float(stats["min"]))
+             + _pb_double(2, float(stats["max"]))
+             + _pb_double(3, float(stats["num"]))
+             + _pb_double(4, float(stats["sum"]))
+             + _pb_double(5, float(stats["sum_squares"]))
+             + _pb_packed_doubles(6, [float(e)
+                                      for e in stats["bucket_limit"]])
+             + _pb_packed_doubles(7, [float(c) for c in stats["bucket"]]))
+    sv = _pb_string(1, tag) + _pb_bytes(5, histo)
+    summary = _pb_bytes(1, sv)
+    return (_pb_double(1, wall_time if wall_time is not None else time.time())
+            + _pb_int64(2, step) + _pb_bytes(5, summary))
+
+
 def encode_histogram_event(tag: str, values, step: int,
                            bins: int = 30,
                            wall_time: Optional[float] = None) -> bytes:
@@ -105,15 +110,14 @@ def encode_histogram_event(tag: str, values, step: int,
     if v.size == 0:
         v = _np.zeros(1)
     counts, edges = _np.histogram(v, bins=bins)
-    histo = (_pb_double(1, float(v.min())) + _pb_double(2, float(v.max()))
-             + _pb_double(3, float(v.size)) + _pb_double(4, float(v.sum()))
-             + _pb_double(5, float((v * v).sum()))
-             + _pb_packed_doubles(6, [float(e) for e in edges[1:]])
-             + _pb_packed_doubles(7, [float(c) for c in counts]))
-    sv = _pb_string(1, tag) + _pb_bytes(5, histo)
-    summary = _pb_bytes(1, sv)
-    return (_pb_double(1, wall_time if wall_time is not None else time.time())
-            + _pb_int64(2, step) + _pb_bytes(5, summary))
+    return encode_histogram_stats_event(
+        tag,
+        {"min": float(v.min()), "max": float(v.max()),
+         "num": float(v.size), "sum": float(v.sum()),
+         "sum_squares": float((v * v).sum()),
+         "bucket_limit": [float(e) for e in edges[1:]],
+         "bucket": [float(c) for c in counts]},
+        step, wall_time=wall_time)
 
 
 def encode_file_version_event() -> bytes:
@@ -260,6 +264,11 @@ class EventWriter:
     def add_histogram(self, tag: str, values, step: int):
         self._q.put(encode_histogram_event(tag, values, int(step)))
 
+    def add_event(self, event_bytes: bytes):
+        """Queue an already-encoded Event proto (the flight recorder's
+        histogram-stats events — observe/export.py)."""
+        self._q.put(event_bytes)
+
     def flush(self):
         """Block until the queue is drained and bytes hit the file —
         readers must not race the writer thread."""
@@ -285,14 +294,46 @@ class EventWriter:
         self._fh.close()
 
 
+class _NullEventWriter:
+    """Accepts the EventWriter API and writes nothing — what every
+    process except 0 gets in a multihost job, so `dryrun_multichip` /
+    multi-process training never interleaves duplicate event dirs
+    (reference: the driver alone writes TrainSummary)."""
+
+    path = None
+
+    def add_scalar(self, tag, value, step):
+        pass
+
+    def add_histogram(self, tag, values, step):
+        pass
+
+    def add_event(self, event_bytes):
+        pass
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
 class Summary:
-    """Base summary bound to logdir/<app_name>/<tag> like the reference."""
+    """Base summary bound to logdir/<app_name>/<tag> like the reference.
+
+    Multihost: only process 0 opens an event file; the other processes
+    get a null writer (their scalars are identical replicas — the
+    reference's driver-writes-alone contract). `read_scalar` on a
+    non-writing process returns what process 0 has flushed (shared
+    filesystem) or []."""
 
     tag = "summary"
 
     def __init__(self, log_dir: str, app_name: str):
+        from bigdl_tpu.utils.runtime import process_index
         self.log_dir = os.path.join(log_dir, app_name, self.tag)
-        self._writer = EventWriter(self.log_dir)
+        self._writer = (EventWriter(self.log_dir) if process_index() == 0
+                        else _NullEventWriter())
         self._triggers = {}
 
     def set_summary_trigger(self, name: str, trigger) -> "Summary":
@@ -316,6 +357,8 @@ class Summary:
     def _read_events(self, parse_fn, tag: str):
         self._writer.flush()
         out = []
+        if not os.path.isdir(self.log_dir):   # non-writing process, no dir
+            return out
         for name in sorted(os.listdir(self.log_dir)):
             with open(os.path.join(self.log_dir, name), "rb") as fh:
                 for rec in parse_records(fh.read()):
