@@ -1,0 +1,31 @@
+"""bigdl_tpu.compilecache — compile once, run everywhere.
+
+Compile-latency subsystem (reference analogue: `ModelBroadcast` cached
+model replicas + warm `Engine` thread pools — the reference never pays
+re-initialization per task; here the equivalent fixed cost is XLA
+compilation):
+
+  * **cache**  — persistent XLA compilation cache behind
+                 BIGDL_TPU_COMPILE_CACHE / --compile-cache, with
+                 per-process staging + atomic-rename publishing so
+                 multiple processes can safely share one directory;
+  * **warmup** — AOT `jit(...).lower(specs).compile()` plumbing for the
+                 trainers' `precompile()` (BIGDL_TPU_PRECOMPILE /
+                 --precompile), logging XLA cost analysis (flops, bytes,
+                 peak memory) through the observe metrics registry;
+  * **CLI**    — `python -m bigdl_tpu.compilecache {stats,clear}`.
+
+See docs/compile_cache.md.
+"""
+
+from bigdl_tpu.compilecache.cache import (cache_dir, clear, disable,
+                                          enable, enabled, ensure_enabled,
+                                          stats, sync)
+from bigdl_tpu.compilecache.warmup import (cost_summary, key_sds, log_cost,
+                                           scalar_sds, sds_like)
+
+__all__ = [
+    "enable", "ensure_enabled", "enabled", "disable", "sync",
+    "cache_dir", "stats", "clear",
+    "cost_summary", "log_cost", "sds_like", "key_sds", "scalar_sds",
+]

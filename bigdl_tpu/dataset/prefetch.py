@@ -107,23 +107,78 @@ def prefetch_to_device(it: Iterable, size: Optional[int] = None,
 
 def stack_batches(it: Iterable, k: int) -> Iterator:
     """Group k consecutive (x, y) host batches into one [k, batch, ...]
-    super-batch (np.stack, host-side — the fused dispatcher's K steps then
-    ride ONE H2D transfer instead of k). The epoch tail (fewer than k
-    batches left) is never dropped: tail batches stream through
-    individually with leading dim 1, so the consumer sees at most two
-    static shapes ([k, ...] and [1, ...]) and XLA compiles at most two
-    program variants."""
+    super-batch — the fused dispatcher's K steps then ride ONE H2D
+    transfer instead of k. Yields `(xs, ys, n_valid)` triples with xs/ys
+    ALWAYS [k, batch, ...]: the epoch tail (n_valid < k) is padded to k
+    rows and masked out device-side (optim/local.py valid-mask scan), so
+    the consumer sees exactly ONE static shape and XLA compiles exactly
+    one program variant — tail epochs included.
+
+    Copy discipline: the old implementation round-tripped every
+    sub-batch through `np.asarray` + `np.stack` (two host copies per
+    super-batch). Now ONE [k, batch, ...] output buffer per group is
+    allocated and filled in place — a single copy — and ownership
+    effectively transfers to the placement: jax's CPU client zero-copies
+    suitably-aligned numpy buffers into device arrays
+    (kImmutableZeroCopy), so the filled buffer often BECOMES the device
+    array with no further copy. That same aliasing is why the buffer is
+    fresh per group rather than recycled: a recycled buffer's refill
+    would silently corrupt the previous group's device array (observed
+    on this jax: a 128 KB f32 buffer aliases across mutation even after
+    block_until_ready). A fresh ~100 KB–10 MB allocation is microseconds
+    (mmap) — the copies were the cost, and there is now one, down from
+    two.
+
+    A batch whose row shape differs from the group's (e.g. a ragged
+    final batch from drop_last=False) flushes the current group and
+    streams alone as a [1, batch', ...] group (its own program variant —
+    fixed-shape batching avoids this; see the Optimizer docstring)."""
     if k < 1:
         raise ValueError(f"stack_batches needs k >= 1, got {k}")
-    buf = []
-    for batch in it:
-        buf.append(batch)
-        if len(buf) == k:
-            yield (np.stack([np.asarray(b[0]) for b in buf]),
-                   np.stack([np.asarray(b[1]) for b in buf]))
-            buf = []
-    for x, y in buf:                       # tail: leading dim 1, no drop
-        yield (np.asarray(x)[None], np.asarray(y)[None])
+    it = iter(it)
+    if k == 1:
+        # no stacking copy at all: a length-1 leading axis is a view
+        for x, y in it:
+            yield np.asarray(x)[None], np.asarray(y)[None], 1
+        return
+    try:
+        x0, y0 = next(it)
+    except StopIteration:
+        return
+    x0, y0 = np.asarray(x0), np.asarray(y0)
+
+    def fresh():
+        return (np.empty((k,) + x0.shape, x0.dtype),
+                np.empty((k,) + y0.shape, y0.dtype))
+
+    xs, ys = fresh()
+    xs[0], ys[0] = x0, y0
+    n = 1
+    for x, y in it:
+        x, y = np.asarray(x), np.asarray(y)
+        if x.shape != x0.shape or y.shape != y0.shape:
+            # ragged batch: flush the group, stream the odd one alone
+            if n:
+                xs[n:] = 0                # pad rows: defined bytes
+                ys[n:] = 0
+                yield xs, ys, n
+                xs, ys = fresh()
+                n = 0
+            yield x[None], y[None], 1
+            continue
+        if n == k:
+            yield xs, ys, k
+            xs, ys = fresh()
+            n = 0
+        xs[n], ys[n] = x, y
+        n += 1
+    if n:
+        # tail: same padded [k, ...] buffer scheme as full groups — the
+        # pad rows are zeroed (transferred but masked out of the
+        # compute; the valid mask skips those scan steps entirely)
+        xs[n:] = 0
+        ys[n:] = 0
+        yield xs, ys, n
 
 
 class PrefetchDataSet:

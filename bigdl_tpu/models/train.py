@@ -68,6 +68,17 @@ def _common(p: argparse.ArgumentParser):
     p.add_argument("--checkpoint-keep-n", type=int, default=None,
                    help="retention: keep only the newest N committed "
                         "snapshots (BIGDL_TPU_CHECKPOINT_KEEP_N)")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="persistent XLA compilation cache root: a warm "
+                        "run deserializes its step programs instead of "
+                        "recompiling (BIGDL_TPU_COMPILE_CACHE; inspect "
+                        "with `python -m bigdl_tpu.compilecache stats` — "
+                        "docs/compile_cache.md)")
+    p.add_argument("--precompile", action="store_true",
+                   help="AOT warmup: compile the train/eval programs "
+                        "from shape specs before the first batch "
+                        "(BIGDL_TPU_PRECOMPILE; logs XLA cost analysis "
+                        "per program)")
     p.add_argument("--trace-dir", default=None,
                    help="flight recorder: record host spans and dump "
                         "Chrome/Perfetto trace JSON here at the end of "
@@ -96,6 +107,12 @@ def _finish(opt, args, model, app):
     if getattr(args, "metrics_jsonl", None):
         import os
         os.environ["BIGDL_TPU_METRICS_JSONL"] = args.metrics_jsonl
+    if getattr(args, "compile_cache", None):
+        from bigdl_tpu import compilecache
+        compilecache.enable(args.compile_cache)
+    if getattr(args, "precompile", False):
+        import os
+        os.environ["BIGDL_TPU_PRECOMPILE"] = "1"
     if getattr(args, "steps_per_call", None):
         opt.set_steps_per_call(args.steps_per_call)
     if getattr(args, "accum_steps", None):

@@ -46,32 +46,72 @@ _lock = threading.Lock()
 _exports = None            # ExportManager when any exporter is configured
 _started = False
 _compile_listener = None
+_compile_event_listener = None
+_tls = threading.local()   # per-thread cache-hit marker (see below)
+
+# event-key suffixes the DURATION listener owns: the plain-event listener
+# must skip these, because some jax versions fire BOTH
+# record_event_duration_secs AND record_event with the same key for one
+# compilation — counting both double-counted jit/compiles (regression
+# test: tests/test_observe.py::test_jit_compile_counter_dedupes...)
+_DURATION_OWNED = ("backend_compile_duration", "cache_retrieval_time_sec")
 
 
 def _on_jax_duration(event: str, duration: float, **kw):
     if event.endswith("backend_compile_duration"):
+        # a persistent-cache hit goes through the same backend_compile
+        # monitoring path (the "compile" is a deserialization) — the
+        # retrieval event that immediately precedes it on this thread
+        # tells the two apart
+        hit = getattr(_tls, "cache_hit", False)
+        _tls.cache_hit = False
         counter("jit/compiles").inc()
         counter("jit/compile_seconds").inc(duration)
+        if hit:
+            counter("jit/cache_hit_compiles").inc()
         trace.instant("jit/compile", cat="jit",
-                      args={"seconds": round(duration, 4)})
+                      args={"seconds": round(duration, 4),
+                            "cache_hit": hit})
+    elif event.endswith("cache_retrieval_time_sec"):
+        _tls.cache_hit = True
+        counter("jit/cache_retrieval_seconds").inc(duration)
+
+
+def _on_jax_event(event: str, **kw):
+    # dedupe by event key: anything the duration listener counts must
+    # not be re-counted here when jax also fires it as a plain event
+    if any(event.endswith(s) for s in _DURATION_OWNED):
+        return
+    if event.endswith("cache_hits"):
+        counter("jit/cache_hits").inc()
+    elif event.endswith("cache_misses"):
+        counter("jit/cache_misses").inc()
 
 
 def _install_jax_compile_listener() -> None:
-    """Count XLA compiles + seconds through jax.monitoring — the
-    flight-recorder view of "why was this step 40s": recompilation.
-    Registered once per process; survives jax's clear_event_listeners in
-    tests by re-registering on the next ensure_started."""
-    global _compile_listener
+    """Count XLA compiles + seconds (and persistent-cache hits/misses)
+    through jax.monitoring — the flight-recorder view of "why was this
+    step 40s": recompilation. Registered once per process; survives
+    jax's clear_event_listeners in tests by re-registering on the next
+    ensure_started."""
+    global _compile_listener, _compile_event_listener
     try:
         from jax import monitoring
         from jax._src import monitoring as _impl
     except Exception:
         return
     live = getattr(_impl, "get_event_duration_listeners", lambda: [])()
-    if _compile_listener is not None and _compile_listener in live:
-        return
-    monitoring.register_event_duration_secs_listener(_on_jax_duration)
-    _compile_listener = _on_jax_duration
+    if _compile_listener is None or _compile_listener not in live:
+        monitoring.register_event_duration_secs_listener(_on_jax_duration)
+        _compile_listener = _on_jax_duration
+    live_ev = getattr(_impl, "get_event_listeners", lambda: [])()
+    if _compile_event_listener is None \
+            or _compile_event_listener not in live_ev:
+        try:
+            monitoring.register_event_listener(_on_jax_event)
+            _compile_event_listener = _on_jax_event
+        except Exception:
+            pass
 
 
 def ensure_started() -> bool:

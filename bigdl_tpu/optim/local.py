@@ -63,6 +63,32 @@ class L2NormClipping(GradientProcessor):
         return jax.tree.map(lambda g: g * scale, grads)
 
 
+class _StepEntry:
+    """One built train/eval program: the jitted callable plus, after
+    `precompile()`, its AOT-compiled executable. Calling the entry
+    prefers the AOT executable (zero trace, zero compile on first use);
+    an argument-spec mismatch falls back to the jitted path once and
+    logs — the mismatch TypeError is raised during argument checking,
+    before any donation happens, so the inputs are still alive."""
+
+    __slots__ = ("jitted", "aot")
+
+    def __init__(self, jitted):
+        self.jitted = jitted
+        self.aot = None
+
+    def __call__(self, *args):
+        if self.aot is not None:
+            try:
+                return self.aot(*args)
+            except TypeError as e:
+                log.warning(
+                    "precompiled executable rejected the live inputs "
+                    "(%s); falling back to the jitted path", e)
+                self.aot = None
+        return self.jitted(*args)
+
+
 class Optimizer:
     """Training facade. Usage mirrors the reference:
 
@@ -120,10 +146,19 @@ class Optimizer:
         self._log_every = max(1, _config.get("LOG_THROUGHPUT_EVERY"))
         self._summary = None
         self._val_summary = None
+        # built-program cache (compile-latency subsystem,
+        # docs/compile_cache.md): resume/retry and repeated optimize()
+        # calls reuse the SAME jitted objects — a fresh jax.jit per
+        # optimize() used to retrace and recompile programs the trainer
+        # already had. Keyed by the config that shapes the program;
+        # builder setters that change a captured closure clear it.
+        self._built_steps: Dict[tuple, _StepEntry] = {}
+        self._valid_masks: Dict[tuple, object] = {}
 
     # ------------------------------------------------------------- builders
     def set_optim_method(self, method: OptimMethod):
         self.method = method
+        self._built_steps.clear()        # method is a closure capture
         return self
 
     def set_end_when(self, trigger: Trigger):
@@ -142,10 +177,12 @@ class Optimizer:
 
     def set_gradient_clipping_by_l2_norm(self, max_norm: float):
         self.grad_processors.append(L2NormClipping(max_norm))
+        self._built_steps.clear()        # processors are closure captures
         return self
 
     def set_constant_gradient_clipping(self, min_v: float, max_v: float):
         self.grad_processors.append(ConstantClipping(min_v, max_v))
+        self._built_steps.clear()
         return self
 
     def set_steps_per_call(self, k: int):
@@ -229,6 +266,11 @@ class Optimizer:
                     tm, new_params, old_params)
             return new_params, new_ms, new_slots, loss
 
+        # the jitted name lands in the persistent compile-cache key
+        # (jit_bigdl_train_step-<hash>), so `compilecache stats` and the
+        # bench can count train-step program variants by name
+        step.__name__ = "bigdl_train_step"
+        step.__qualname__ = "bigdl_train_step"
         return step
 
     def _make_accum_step(self, accum_steps: int, compute_dtype=None) -> Callable:
@@ -320,27 +362,44 @@ class Optimizer:
         `lax.scan` over the per-step body (plain `_make_step` when
         accum_steps == 1, the accumulating body otherwise). Inputs are the
         K-stacked (xs, ys) super-batch plus per-step (lr, neval, rng)
-        threaded as scan inputs; output is the K-stacked per-step losses,
-        which ride the existing `_pending`/`_flush_metrics` buffering
-        unchanged. K is implicit in the stacked leading dim, so the same
-        jitted callable also serves the epoch's tail batches (leading
-        dim 1 — at most one extra compile)."""
+        threaded as scan inputs AND a per-step `valid` mask; output is
+        the K-stacked per-step losses, which ride the existing
+        `_pending`/`_flush_metrics` buffering unchanged.
+
+        Single-variant shape bucketing: epoch tails used to stream with
+        leading dim 1, compiling a SECOND program variant per config and
+        paying its cold compile on the first short epoch. Now the tail
+        is padded to the same [K, ...] super-batch with `valid[i]=False`
+        on the pad rows: a masked step takes the `lax.cond` skip branch,
+        so it contributes zero gradient, does not advance params/
+        model_state/slots, and costs no compute at runtime (cond is a
+        real branch inside the scan loop, not a select). Each trainer
+        config therefore compiles exactly ONE train-step program —
+        tail epochs included."""
         body_step = (self._make_step(compute_dtype) if accum_steps == 1
                      else self._make_accum_step(accum_steps, compute_dtype))
 
-        def fused(params, model_state, slots, xs, ys, lrs, step_nums, rngs):
+        def bigdl_fused_train_step(params, model_state, slots,
+                                   xs, ys, lrs, step_nums, rngs, valid):
             def body(carry, inp):
-                p, ms, sl = carry
-                x, y, lr, n, r = inp
-                p, ms, sl, loss = body_step(p, ms, sl, x, y, lr, n, r)
-                return (p, ms, sl), loss
+                x, y, lr, n, r, v = inp
+
+                def run(c):
+                    p, ms, sl = c
+                    p, ms, sl, loss = body_step(p, ms, sl, x, y, lr, n, r)
+                    return (p, ms, sl), loss
+
+                def skip(c):
+                    return c, jnp.float32(0.0)
+
+                return jax.lax.cond(v, run, skip, carry)
 
             (params, model_state, slots), losses = jax.lax.scan(
                 body, (params, model_state, slots),
-                (xs, ys, lrs, step_nums, rngs))
+                (xs, ys, lrs, step_nums, rngs, valid))
             return params, model_state, slots, losses
 
-        return fused
+        return bigdl_fused_train_step
 
     def _build_step(self) -> Callable:
         return jax.jit(self._make_step(), donate_argnums=(0, 1, 2))
@@ -352,6 +411,33 @@ class Optimizer:
             self._make_fused_step(self.accum_steps,
                                   getattr(self, "compute_dtype", None)),
             donate_argnums=(0, 1, 2))
+
+    # ------------------------------------------------- built-program cache
+    def _step_key(self, kind: str) -> tuple:
+        """Cache key for a built program: everything a builder closure
+        captures that can change between builds of one trainer instance.
+        Model/criterion/mesh are fixed per instance; the optim method is
+        handled by set_optim_method clearing the cache."""
+        return (kind, self.steps_per_call, self.accum_steps,
+                str(getattr(self, "compute_dtype", None)),
+                tuple(id(p) for p in self.grad_processors),
+                any(m._frozen for m in self.model.modules()))
+
+    def _get_built(self, kind: str) -> _StepEntry:
+        """Memoized build of the 'step' / 'fused' / 'eval_jit' program.
+        resume()/optimize_with_retry() re-enter optimize() with the same
+        config — they must reuse the jitted objects, not rebuild them
+        (a rebuild retraces and recompiles; the jit-compile counter made
+        this cost visible)."""
+        key = self._step_key(kind)
+        entry = self._built_steps.get(key)
+        if entry is None:
+            builder = {"step": self._build_step,
+                       "fused": self._build_fused_step,
+                       "eval_jit": self._build_eval_jit}[kind]
+            entry = _StepEntry(builder())
+            self._built_steps[key] = entry
+        return entry
 
     # ----------------------------------------------------- placement hooks
     # Overridden by parallel.DistriOptimizer to lay trees/batches out on the
@@ -396,17 +482,22 @@ class Optimizer:
         """K-grouped variant of `_batch_iter` for the fused dispatch path:
         host batches are stacked into [K, batch, ...] super-batches BEFORE
         placement (dataset/prefetch.py stack_batches), so the K batches
-        ride one H2D transfer instead of K. The epoch tail (fewer than K
-        batches left) streams through with leading dim 1."""
+        ride one H2D transfer instead of K. Yields (xs, ys, n_valid)
+        triples — the epoch tail is PADDED to the same [K, ...] shape
+        with n_valid < K (single-variant shape bucketing; the pad steps
+        are masked out device-side)."""
         from bigdl_tpu.dataset.prefetch import (prefetch_to_device,
                                                 stack_batches)
         from bigdl_tpu.utils import config
         grouped = stack_batches(epoch_iter, self.steps_per_call)
+
+        def place(b):
+            return self._place_stacked_batch(b[0], b[1]) + (b[2],)
+
         size = config.get("PREFETCH_SIZE")
         if not size or size <= 0:
-            return (self._place_stacked_batch(xs, ys) for xs, ys in grouped)
-        return prefetch_to_device(
-            grouped, size, place_fn=lambda b: self._place_stacked_batch(*b))
+            return (place(b) for b in grouped)
+        return prefetch_to_device(grouped, size, place_fn=place)
 
     def _fused_epoch_source(self):
         """The iterable the fused path stacks from. A PrefetchDataSet
@@ -419,9 +510,114 @@ class Optimizer:
             return self.dataset.dataset
         return self.dataset
 
+    def _build_eval_jit(self):
+        model = self.model
+
+        def bigdl_eval_step(p, s, x):
+            return model.apply(p, s, x, training=False)[0]
+
+        return jax.jit(bigdl_eval_step)
+
     def _build_eval_fn(self):
-        return jax.jit(
-            lambda p, s, x: self.model.apply(p, s, x, training=False)[0])
+        # memoized: a resume/retry re-entry of optimize() must reuse the
+        # compiled eval program (DistriOptimizer wraps this with its
+        # data-axis padding, sharing the same cached inner jit)
+        return self._get_built("eval_jit")
+
+    def _eval_pad_rows(self, n: int) -> int:
+        """Rows the eval program is compiled for, given an n-row batch
+        (DistriOptimizer pads validation batches to the data axis)."""
+        return n
+
+    # ---------------------------------------------------------- precompile
+    def precompile(self, sample_batch=None, val_batch=None) -> Dict:
+        """AOT warmup (docs/compile_cache.md): compile the train-step —
+        and, when validation is configured, the eval — programs from
+        shape specs BEFORE the first batch arrives, via
+        `jit(...).lower(specs).compile()`. The compiled executables are
+        attached to the built-step cache, so the first real iteration
+        dispatches a ready program: zero trace, zero compile on the hot
+        path. With the persistent compile cache enabled, a warm machine
+        pays only deserialization here.
+
+        Shapes come from `jax.eval_shape` on the model/optimizer init
+        (no device work) plus ONE peeked host batch (`sample_batch`
+        overrides the peek for datasets that cannot be re-iterated).
+        XLA cost analysis per program (flops, bytes accessed, peak
+        memory) is logged through the observe metrics registry
+        (`compile/<program>/...`) and returned.
+
+        CLI: `--precompile`; knob: BIGDL_TPU_PRECOMPILE (optimize()
+        then calls this automatically)."""
+        import numpy as _np
+        from bigdl_tpu import compilecache
+        from bigdl_tpu.compilecache import (key_sds, log_cost, scalar_sds,
+                                            sds_like)
+        compilecache.ensure_enabled()
+        observe.ensure_started()
+        use_fused = self.steps_per_call > 1 or self.accum_steps > 1
+        if sample_batch is None:
+            src = (self._fused_epoch_source() if use_fused
+                   else self.dataset)
+            sample_batch = next(iter(src))
+        x, y = sample_batch[0], sample_batch[1]
+        x_sds, y_sds = sds_like(x), sds_like(y)
+
+        params_s, ms_s = jax.eval_shape(
+            self.model.init, jax.random.PRNGKey(0))  # tpu-lint: disable=004
+        slots_s = jax.eval_shape(self.method.init_slots, params_s)
+        k_sds = key_sds()
+        results: Dict = {}
+
+        with observe.phase("compile/precompile", cat="jit"):
+            t0 = time.perf_counter()
+            if use_fused:
+                K = self.steps_per_call
+                entry = self._get_built("fused")
+                stack = lambda s: jax.ShapeDtypeStruct(  # noqa: E731
+                    (K,) + tuple(s.shape), s.dtype)
+                specs = self._annotate_aot_specs("fused", (
+                    params_s, ms_s, slots_s, stack(x_sds), stack(y_sds),
+                    jax.ShapeDtypeStruct((K,), jnp.float32),
+                    jax.ShapeDtypeStruct((K,), jnp.int32),
+                    stack(k_sds),
+                    jax.ShapeDtypeStruct((K,), jnp.bool_)))
+            else:
+                entry = self._get_built("step")
+                specs = self._annotate_aot_specs("step", (
+                    params_s, ms_s, slots_s, x_sds, y_sds,
+                    scalar_sds(jnp.float32), scalar_sds(jnp.int32),
+                    k_sds))
+            compiled = entry.jitted.lower(*specs).compile()
+            entry.aot = compiled
+            results["train_step"] = log_cost(
+                "train_step", compiled, time.perf_counter() - t0)
+
+            if val_batch is None and self.val_dataset is not None:
+                val_batch = next(iter(self.val_dataset))
+            if val_batch is not None:
+                vx = _np.asarray(val_batch[0])
+                rows = self._eval_pad_rows(vx.shape[0])
+                vx_sds = jax.ShapeDtypeStruct(
+                    (rows,) + tuple(vx.shape[1:]), vx.dtype)
+                t0 = time.perf_counter()
+                e2 = self._get_built("eval_jit")
+                specs = self._annotate_aot_specs(
+                    "eval_jit", (params_s, ms_s, vx_sds))
+                e2.aot = e2.jitted.lower(*specs).compile()
+                results["eval_step"] = log_cost(
+                    "eval_step", e2.aot, time.perf_counter() - t0)
+
+        compilecache.sync()                # publish what warmup compiled
+        self._precompiled = True
+        return results
+
+    def _annotate_aot_specs(self, kind: str, specs: tuple) -> tuple:
+        """Hook for subclasses to pin device layouts onto the AOT shape
+        specs (the local trainer compiles for jit's default placement;
+        DistriOptimizer annotates mesh shardings so the precompiled
+        executable accepts the live sharded trees)."""
+        return specs
 
     # --------------------------------------------------------------- resume
     def resume(self, path: str) -> bool:
@@ -505,6 +701,14 @@ class Optimizer:
         # exporters; a disabled recorder costs one attribute check per
         # span site (BIGDL_TPU_TRACE / _METRICS_* — docs/observability.md)
         observe.ensure_started()
+        # compile-latency subsystem (docs/compile_cache.md): persistent
+        # compilation cache + optional AOT warmup, both knob-gated
+        from bigdl_tpu import compilecache
+        compilecache.ensure_enabled()
+        from bigdl_tpu.utils import config as _cfg
+        if _cfg.get("PRECOMPILE") and not getattr(self, "_precompiled",
+                                                  False):
+            self.precompile()
         rng = jax.random.PRNGKey(self.seed)
         # disjoint key namespace from the 0xBD1 init fold below — a step
         # key derived straight from (rng, neval) would collide with the
@@ -512,8 +716,14 @@ class Optimizer:
         step_rng = jax.random.fold_in(rng, 0x57E9)
         if hasattr(self, "_resume_trees"):
             # copy before handing to the donating step: _resume_trees (and
-            # any caller alias of it) must survive the donation
-            copy = lambda t: jax.tree.map(lambda a: jnp.array(a), t)  # noqa: E731
+            # any caller alias of it) must survive the donation. HOST-side
+            # copy (np, not jnp): resume trees are npz-loaded numpy
+            # already, and a device-side jnp.array copy would compile one
+            # tiny convert program per leaf shape — the retry/resume
+            # re-entry must stay at zero fresh compiles
+            # (tests/test_compile_cache.py retrace-hygiene contract)
+            import numpy as _np
+            copy = lambda t: jax.tree.map(lambda a: _np.array(a), t)  # noqa: E731
             params = copy(self._resume_trees["params"])
             model_state = copy(self._resume_trees["model_state"])
             slots = copy(self._resume_trees["slots"]) \
@@ -527,10 +737,13 @@ class Optimizer:
         self._step_rng = step_rng
         # steps_per_call == accum_steps == 1 takes the pre-existing
         # per-step dispatch path bit-identically (same step builder, same
-        # loop); anything else compiles the fused K-step scan program
+        # loop); anything else compiles the fused K-step scan program.
+        # Programs come from the built-step cache: a resume/retry
+        # re-entry reuses the jitted callables instead of rebuilding
+        # them (retrace hygiene — docs/compile_cache.md)
         use_fused = self.steps_per_call > 1 or self.accum_steps > 1
-        step = None if use_fused else self._build_step()
-        fused_step = self._build_fused_step() if use_fused else None
+        step = None if use_fused else self._get_built("step")
+        fused_step = self._get_built("fused") if use_fused else None
         st = self.state
 
         self._eval_fn = self._build_eval_fn()
@@ -664,6 +877,7 @@ class Optimizer:
 
         self._flush_metrics(st)
         self._finish_checkpoints()         # join any background snapshot
+        compilecache.sync()                # publish fresh cache entries
 
         trace_path = observe.finish()      # dump trace + final export flush
         if trace_path:
@@ -694,14 +908,28 @@ class Optimizer:
         fns = self.__dict__.setdefault("_fold_keys_fns", {})
         fold_keys = fns.get(k)
         if fold_keys is None:
-            fold_keys = jax.jit(lambda key, start: jax.vmap(
-                lambda i: jax.random.fold_in(key, i))(
-                    start + jnp.arange(k)))
+            def bigdl_fold_keys(key, start):
+                return jax.vmap(
+                    lambda i: jax.random.fold_in(key, i))(
+                        start + jnp.arange(k))
+            fold_keys = jax.jit(bigdl_fold_keys)
             fns[k] = fold_keys
         rngs = fold_keys(self._step_rng, jnp.int32(st["neval"]))
         return (jnp.asarray(lr_list, jnp.float32),
                 jnp.asarray(nevals, jnp.int32),
                 rngs, lr_list)
+
+    def _valid_mask(self, k: int, k_valid: int):
+        """[K] bool mask with the first k_valid steps live — the
+        single-variant bucketing input. Cached per (K, k_valid): an
+        epoch sees at most two distinct masks (full groups + one tail)."""
+        m = self._valid_masks.get((k, k_valid))
+        if m is None:
+            import numpy as _np
+            m = _np.zeros((k,), _np.bool_)
+            m[:k_valid] = True
+            self._valid_masks[(k, k_valid)] = m
+        return m
 
     def _fused_epoch(self, fused_step, epoch_iter, params, model_state,
                      slots, st):
@@ -714,32 +942,45 @@ class Optimizer:
         Checkpoints therefore always land on K boundaries (modulo the
         epoch tail), so a mid-epoch resume's batch cursor re-aligns with
         the K-grouping automatically: the surviving run re-groups whatever
-        batches remain."""
+        batches remain.
+
+        Shape bucketing: every call — tail groups included — carries the
+        same [K, batch, ...] super-batch; the tail's pad steps arrive
+        masked (valid[i]=False) and are skipped device-side, so host
+        bookkeeping advances by k_valid, not K. The tail stride's
+        boundary is the epoch end, so a trigger nominally firing inside
+        the tail fires there (same fire-at-next-boundary semantics —
+        nothing is skipped or double-fired)."""
         epoch_records = 0
         ended_mid_epoch = False
         W = self._log_every
-        for xs, ys in self._observed_batches(
+        for xs, ys, k_valid in self._observed_batches(
                 self._fused_batch_iter(epoch_iter)):
             k = int(xs.shape[0])
+            k_valid = int(k_valid)
             lrs, nevals, rngs, lr_list = self._fused_inputs(st, k)
+            valid = self._valid_mask(k, k_valid)
             if self._param_summary_enabled():
-                self._last_batch = (xs[-1], ys[-1], rngs[-1])
+                self._last_batch = (xs[k_valid - 1], ys[k_valid - 1],
+                                    rngs[k_valid - 1])
             with observe.phase("train/dispatch"):
                 # one span covers the whole K-step scan dispatch — divide
-                # by k when comparing against per-step numbers
+                # by k_valid when comparing against per-step numbers
                 params, model_state, slots, losses = fused_step(
-                    params, model_state, slots, xs, ys, lrs, nevals, rngs)
+                    params, model_state, slots, xs, ys, lrs, nevals, rngs,
+                    valid)
             n = int(xs.shape[1])           # GLOBAL batch rows per step
             start = st["neval"]
-            for i in range(k):
+            for i in range(k_valid):
                 # per-step losses are lazy slices of the stacked device
                 # array — they ride _pending/_flush_metrics unchanged
+                # (pad-step losses are never appended)
                 self._pending.append((start + i + 1, lr_list[i], losses[i]))
-            st["neval"] += k
-            st["records"] += k * n
-            st["batch_in_epoch"] = st.get("batch_in_epoch", 0) + k
-            epoch_records += k * n
-            self._window_records += k * n
+            st["neval"] += k_valid
+            st["records"] += k_valid * n
+            st["batch_in_epoch"] = st.get("batch_in_epoch", 0) + k_valid
+            epoch_records += k_valid * n
+            self._window_records += k_valid * n
             if st["neval"] // W != start // W:   # crossed a log boundary
                 self._flush_metrics(st)
             # fire-at-next-K-boundary: a per-iteration trigger whose
@@ -750,13 +991,15 @@ class Optimizer:
                 trig = self._summary.get_summary_trigger("Parameters")
                 self._maybe_param_summary(
                     params, model_state, st,
-                    fired=self._stride_fired(trig, st, start, k))
+                    fired=self._stride_fired(trig, st, start, k_valid))
             self._maybe_validate(
                 params, model_state, st,
-                fired=self._stride_fired(self.val_trigger, st, start, k))
+                fired=self._stride_fired(self.val_trigger, st, start,
+                                         k_valid))
             self._maybe_checkpoint(
                 params, model_state, slots, st,
-                fired=self._stride_fired(self.ckpt_trigger, st, start, k))
+                fired=self._stride_fired(self.ckpt_trigger, st, start,
+                                         k_valid))
             # faults/preemption are probed at the K boundary — the
             # preempt contract is "final checkpoint at the NEXT
             # steps_per_call boundary"

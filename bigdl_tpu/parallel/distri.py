@@ -199,11 +199,11 @@ class DistriOptimizer(Optimizer):
     def _build_fused_step(self):
         """Mesh-pinned build of the K-step fused program: params per TP
         rules, slots per ZeRO-1, the stacked super-batch sharded on its
-        batch dim (dim 1) over 'data', per-step (lr, neval, rng) stacks
-        and the stacked per-step losses replicated. Same
-        SUPPORTS_SHARDED_DONATION guard as the single-step build — old-jax
-        GSPMD crashes aliasing donated buffers across the ZeRO-1
-        reshard."""
+        batch dim (dim 1) over 'data', per-step (lr, neval, rng) stacks,
+        the per-step valid mask (shape bucketing), and the stacked
+        per-step losses replicated. Same SUPPORTS_SHARDED_DONATION guard
+        as the single-step build — old-jax GSPMD crashes aliasing
+        donated buffers across the ZeRO-1 reshard."""
         fused = self._make_fused_step(self.accum_steps, self.compute_dtype)
         params_shape, _ = jax.eval_shape(
             self.model.init, jax.random.PRNGKey(0))  # tpu-lint: disable=004
@@ -215,7 +215,7 @@ class DistriOptimizer(Optimizer):
         return jax.jit(
             fused,
             donate_argnums=(0, 1, 2) if SUPPORTS_SHARDED_DONATION else (),
-            in_shardings=(p_sh, None, s_sh, None, None, rep, rep, rep),
+            in_shardings=(p_sh, None, s_sh, None, None, rep, rep, rep, rep),
             out_shardings=(p_sh, None, s_sh, rep))
 
     # ------------------------------------------------------------ resilience
@@ -243,9 +243,47 @@ class DistriOptimizer(Optimizer):
         })
         return meta
 
+    def _eval_pad_rows(self, n):
+        return n + (-n % self._data_axis_size)
+
+    def _annotate_aot_specs(self, kind, specs):
+        """Pin the mesh layout onto every AOT shape spec so the
+        precompiled executable's input avals match the live arrays:
+        params per TP rules, model_state replicated, slots per ZeRO-1,
+        batches over 'data' (dim 0 per-step, dim 1 stacked), everything
+        else replicated — exactly the layouts _place_trees/_place_*
+        produce at runtime."""
+        rep = NamedSharding(self.mesh, P())
+
+        def ann(leaf, sh):
+            return jax.ShapeDtypeStruct(tuple(leaf.shape), leaf.dtype,
+                                        sharding=sh)
+
+        def annt(tree, sh_tree):
+            return jax.tree.map(ann, tree, sh_tree)
+
+        def reps(tree):
+            return jax.tree.map(lambda leaf: ann(leaf, rep), tree)
+
+        specs = list(specs)
+        specs[0] = annt(specs[0], self._param_shardings(specs[0]))
+        specs[1] = reps(specs[1])
+        if kind == "eval_jit":
+            specs[2] = ann(specs[2], self._batch_sharding(specs[2]))
+            return tuple(specs)
+        specs[2] = annt(specs[2], self._slot_shardings(specs[2]))
+        batch_sh = (self._stacked_batch_sharding if kind == "fused"
+                    else self._batch_sharding)
+        specs[3] = ann(specs[3], batch_sh(specs[3]))
+        specs[4] = ann(specs[4], batch_sh(specs[4]))
+        specs[5:] = [ann(s, rep) for s in specs[5:]]
+        return tuple(specs)
+
     def _build_eval_fn(self):
-        eval_fn = jax.jit(
-            lambda p, s, x: self.model.apply(p, s, x, training=False)[0])
+        # the inner jitted program rides the shared built-step cache
+        # (optim/local.py _get_built) so resume/retry and precompile()
+        # reuse one compiled eval program
+        eval_fn = self._get_built("eval_jit")
 
         def run(p, s, x):
             # validation tails need not divide the data axis: pad

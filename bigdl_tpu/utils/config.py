@@ -138,6 +138,22 @@ _register("RUN_ID", "", str,
           "Run id stamped into log prefixes, traces, and JSONL records; "
           "set the same value on every host of a multihost job "
           "(utils/runtime.py; '' derives one per process)")
+_register("COMPILE_CACHE", "", str,
+          "Persistent XLA compilation cache root directory "
+          "(compilecache/cache.py): jitted programs are staged per "
+          "process and published with atomic renames, so a second run "
+          "of the same config skips the XLA compile entirely. '' "
+          "disables. CLI: python -m bigdl_tpu.compilecache {stats,clear}")
+_register("COMPILE_CACHE_MIN_COMPILE_S", 0.0, float,
+          "Only persist programs whose XLA compile took at least this "
+          "many seconds (maps to jax_persistent_cache_min_compile_time_"
+          "secs; 0.0 caches everything — the default, so tiny step "
+          "programs warm too)")
+_register("PRECOMPILE", False, _bool,
+          "AOT warmup: trainers call precompile() at the top of "
+          "optimize(), compiling the step/eval programs from shape specs "
+          "before the first batch arrives and logging XLA cost analysis "
+          "(optim/local.py precompile; CLI --precompile)")
 _register("BENCH_LOCK_FILE", "/tmp/bigdl_tpu_bench.lock", str,
           "Lockfile serializing bench.py against tools/tpu_watch.sh so "
           "the harness cannot pollute the CPU trend series (ADVICE r5 #5)")

@@ -155,8 +155,8 @@ def test_records_and_batch_cursor_advance_in_strides():
 
 
 def test_tail_batches_not_dropped():
-    """5 batches/epoch with K=4: one full group + one tail batch — the
-    tail streams through with leading dim 1, never dropped."""
+    """5 batches/epoch with K=4: one full group + one padded tail group
+    (valid-mask bucketing) — the tail is never dropped."""
     x = X[:80]
     y = Y[:80]
     ds = ArrayDataSet(x, y, 16, drop_last=True, shuffle=False)  # 5 batches
@@ -219,14 +219,20 @@ def test_distri_fused_matches_local_unfused():
 
 
 # ------------------------------------------------------- stacking plumbing
-def test_stack_batches_groups_and_tail():
+def test_stack_batches_groups_and_padded_tail():
+    """Single-variant bucketing contract: every group — the tail
+    included — is [k, batch, ...]; the third element counts the valid
+    rows and the tail's pad rows are zeroed."""
     from bigdl_tpu.dataset.prefetch import stack_batches
     batches = [(np.full((4, 3), i, np.float32), np.full((4,), i, np.int32))
                for i in range(7)]
     out = list(stack_batches(iter(batches), 3))
-    assert [o[0].shape[0] for o in out] == [3, 3, 1]
+    assert [o[0].shape[0] for o in out] == [3, 3, 3]
+    assert [o[2] for o in out] == [3, 3, 1]
     np.testing.assert_array_equal(out[0][0][1], batches[1][0])
     np.testing.assert_array_equal(out[2][0][0], batches[6][0])
+    np.testing.assert_array_equal(out[2][0][1:], 0.0)   # pad rows zeroed
+    np.testing.assert_array_equal(out[2][1][1:], 0)
     with pytest.raises(ValueError, match="k >= 1"):
         list(stack_batches(iter(batches), 0))
 

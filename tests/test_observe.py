@@ -439,6 +439,39 @@ def test_jit_compile_counter(clean_observe, monkeypatch):
     assert observe.counter("jit/compile_seconds").value > 0.0
 
 
+def test_jit_compile_counter_dedupes_duration_and_plain_events(
+        clean_observe, monkeypatch):
+    """Some jax versions fire BOTH record_event_duration_secs AND
+    record_event with the same key for one compilation; counting both
+    double-counted jit/compiles. The plain-event listener must skip every
+    duration-owned key (observe._DURATION_OWNED)."""
+    for kk in ("BIGDL_TPU_TRACE", "BIGDL_TPU_METRICS_JSONL",
+               "BIGDL_TPU_METRICS_PROM"):
+        monkeypatch.delenv(kk, raising=False)
+    observe.ensure_started()
+    before = observe.counter("jit/compiles").value
+    # one compilation, both monitoring callbacks fire with the same key
+    key = "/jax/compilation_cache/backend_compile_duration"
+    observe._on_jax_duration(key, 0.25)
+    observe._on_jax_event(key)
+    assert observe.counter("jit/compiles").value == before + 1
+    # same discipline for the cache-retrieval timing key
+    rkey = "/jax/compilation_cache/cache_retrieval_time_sec"
+    observe._on_jax_duration(rkey, 0.01)
+    observe._on_jax_event(rkey)
+    assert observe.counter("jit/compiles").value == before + 1
+    # the NEXT duration event is flagged as a cache hit by the
+    # retrieval marker the previous pair set
+    observe._on_jax_duration(key, 0.02)
+    assert observe.counter("jit/compiles").value == before + 2
+    assert observe.counter("jit/cache_hit_compiles").value == 1
+    # hit/miss plain events are NOT duration-owned: they count normally
+    observe._on_jax_event("/jax/compilation_cache/cache_hits")
+    observe._on_jax_event("/jax/compilation_cache/cache_misses")
+    assert observe.counter("jit/cache_hits").value == 1
+    assert observe.counter("jit/cache_misses").value == 1
+
+
 # ------------------------------------------------------ resilience events
 def test_retry_and_fault_counters(clean_observe, monkeypatch):
     from bigdl_tpu.resilience.retry import RetryPolicy
