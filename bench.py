@@ -46,6 +46,7 @@ _METRICS = {
     "checkpoint": ("async_checkpoint_stall_reduction", "ratio"),
     "overhead": ("observability_overhead_pct", "percent"),
     "compile": ("compile_cache_warm_startup_speedup", "ratio"),
+    "chaos": ("slice_failover_budget_headroom", "ratio"),
 }
 
 # serialize against tools/tpu_watch.sh (ADVICE r5 #5). Env names + defaults
@@ -707,6 +708,109 @@ def _bench_compile():
     }
 
 
+def _bench_chaos(batch_size=32, hidden=128, iters=48, k=8):
+    """Slice-failover chaos bench: DistriOptimizer on a 2 slices × 4
+    devices CPU mesh, kill slice 1 mid-run via the `slice:1@step:N`
+    injector, and measure the wall-clock lost to the in-run failover
+    against the budget of one K-window plus re-shard + recompile
+    overhead (ISSUE 6 acceptance; docs/resilience.md "Slice failover").
+
+    Two (control, chaos) passes share one persistent compile cache: the
+    first pays the cold compiles for BOTH topologies and publishes them;
+    the second is the measurement — its post-failover recompile for the
+    survivor mesh is served warm from the cache. Deltas of the observe
+    registry (jit/compile_seconds, phase/failover/reshard,
+    failover/slice_losses) attribute where the lost time went."""
+    import tempfile
+    import numpy as np
+    cache_dir = tempfile.mkdtemp(prefix="bigdl_chaos_cache_")
+    os.environ["BIGDL_TPU_COMPILE_CACHE"] = cache_dir
+    import jax
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu import observe
+    from bigdl_tpu.dataset import ArrayDataSet
+    from bigdl_tpu.optim.method import Adam
+    from bigdl_tpu.optim.trigger import Trigger
+    from bigdl_tpu.parallel import DistriOptimizer, create_mesh
+    from bigdl_tpu.resilience import faults
+
+    r = np.random.RandomState(0)
+    n = batch_size * iters
+    x = r.randn(n, 16).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int32)
+
+    def run(fault):
+        faults.configure(fault)
+        observe.registry().reset()        # per-run telemetry isolation
+        mesh = create_mesh(jax.devices()[:8], slices=2,
+                           drop_trivial_axes=True)
+        model = nn.Sequential(nn.Linear(16, hidden), nn.ReLU(),
+                              nn.Linear(hidden, 2), nn.LogSoftMax())
+        ds = ArrayDataSet(x, y, batch_size, drop_last=True, shuffle=False)
+        opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(),
+                              Adam(1e-3), mesh=mesh, zero1=True, seed=3,
+                              steps_per_call=k)
+        opt.set_end_when(Trigger.max_iteration(iters))
+        t0 = time.perf_counter()
+        opt.optimize()
+        wall = time.perf_counter() - t0
+        snap = observe.registry().snapshot()
+        faults.configure("")
+        if opt.state["neval"] != iters:
+            raise RuntimeError(
+                f"chaos bench run stopped at {opt.state['neval']}/{iters}")
+
+        def hist(name):
+            return snap["histograms"].get(name) or {
+                "sum": 0.0, "count": 0, "max": 0.0}
+
+        disp = hist("phase/train/dispatch")
+        disp_mean = disp["sum"] / max(disp["count"], 1)
+        return {
+            "wall_s": round(wall, 3),
+            "compile_s": round(
+                snap["counters"].get("jit/compile_seconds", 0.0), 3),
+            "compiles": int(snap["counters"].get("jit/compiles", 0)),
+            "cache_hit_compiles": int(
+                snap["counters"].get("jit/cache_hit_compiles", 0)),
+            "reshard_s": round(hist("phase/failover/reshard")["sum"], 4),
+            # the post-failover program rebuild (retrace + cache-warm
+            # deserialize + first execution) lands inside ONE dispatch
+            # span — its excess over the mean dispatch is the rebuild
+            "dispatch_max_s": round(disp["max"], 4),
+            "dispatch_mean_s": round(disp_mean, 4),
+            "slice_losses": int(
+                snap["counters"].get("failover/slice_losses", 0)),
+            "failover_counters": {
+                name: v for name, v in snap["counters"].items()
+                if name.startswith("failover/")},
+            "survivor_devices": int(opt.mesh.size),
+        }
+
+    fault_spec = f"slice:1@step:{iters // 2}"
+    passes = []
+    for _ in range(2):
+        passes.append({"control": run(""), "chaos": run(fault_spec)})
+    ctrl, chaos = passes[1]["control"], passes[1]["chaos"]
+    k_window_s = ctrl["wall_s"] / (iters / k)
+    time_lost_s = max(0.0, chaos["wall_s"] - ctrl["wall_s"])
+    rebuild_s = max(0.0, chaos["dispatch_max_s"]
+                    - chaos["dispatch_mean_s"])
+    budget_s = k_window_s + chaos["reshard_s"] + rebuild_s
+    return {
+        "time_lost_s": round(time_lost_s, 3),
+        "budget_s": round(budget_s, 3),
+        "k_window_s": round(k_window_s, 4),
+        "reshard_s": chaos["reshard_s"],
+        "rebuild_s": round(rebuild_s, 4),
+        "within_budget": time_lost_s <= budget_s,
+        "warm_failover_cache_hits": chaos["cache_hit_compiles"],
+        "cold_pass": passes[0],
+        "warm_pass": passes[1],
+        "failover_counters": chaos["failover_counters"],
+    }
+
+
 def child_main():
     from bigdl_tpu.utils.platform import force_cpu_if_requested
     force_cpu_if_requested()
@@ -755,6 +859,34 @@ def child_main():
                     "8-virtual-device CPU mesh; K=1 runs the pre-fusion "
                     "per-step dispatch path unchanged (bit-identical "
                     "program)",
+        }))
+        return
+    if which == "chaos":
+        # CPU-mesh microbench (parent forces FORCE_CPU=1 + 8 virtual
+        # devices as 2 slices × 4): in-run slice failover cost — host
+        # re-shard + recompile plumbing, backend-agnostic
+        metric, unit = _METRICS[which]
+        rows = _bench_chaos()
+        headroom = rows["budget_s"] / max(rows["time_lost_s"], 1e-3)
+        print(json.dumps({
+            "metric": metric,
+            "value": round(min(headroom, 99.0), 2),
+            "unit": unit,
+            "vs_baseline": 1.0,
+            "backend": backend,
+            "n_devices": len(jax.devices()),
+            "batch_size": 32,
+            **rows,
+            "host": _host_provenance(),
+            "note": "kill-slice-1-mid-run on a 2x4 two-tier mesh, "
+                    "small-MLP DistriOptimizer.optimize() K=8; "
+                    "time_lost = chaos wall - control wall (warm pass; "
+                    "the cold pass seeds the persistent compile cache "
+                    "so the failover recompile is served warm); budget "
+                    "= one K-window + failover re-shard + program "
+                    "rebuild (retrace + warm deserialize, the max-over-"
+                    "mean dispatch span). Acceptance: value >= 1 (time "
+                    "lost within budget)",
         }))
         return
     if which == "compile":
@@ -1062,7 +1194,8 @@ def parent_main():
     # else the degraded record is never emitted at all.
     lock_fh, lock_waited, lock_timed_out = _acquire_bench_lock()
     which_arg = sys.argv[1] if len(sys.argv) > 1 else "resnet50"
-    if which_arg in ("dispatch", "checkpoint", "overhead", "compile"):
+    if which_arg in ("dispatch", "checkpoint", "overhead", "compile",
+                     "chaos"):
         # CPU-mesh microbenches: 8 virtual devices, never a TPU attempt
         xla = (os.environ.get("XLA_FLAGS", "") +
                " --xla_force_host_platform_device_count=8").strip()

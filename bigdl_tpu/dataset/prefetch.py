@@ -91,11 +91,21 @@ def prefetch_to_device(it: Iterable, size: Optional[int] = None,
             yield item
     finally:
         # a trainer breaking mid-epoch (max_iteration, early stop, retry
-        # after a failure) must not leave a placement thread iterating
-        # the shared dataset while the caller re-enters it — signal and
-        # wait briefly (bounded: a device_put wedged on a dead chip must
-        # not hang the trainer's control path; the thread is daemonic)
+        # after a failure, slice failover) must not leave a placement
+        # thread iterating the shared dataset while the caller re-enters
+        # it — signal and wait briefly (bounded: a device_put wedged on a
+        # dead chip must not hang the trainer's control path; the thread
+        # is daemonic)
         stop.set()
+        # drop queued batches NOW rather than at GC time: they hold
+        # device buffers placed for the OLD topology, and a slice
+        # failover wants that memory back before re-sharding the trees
+        # (the re-entered epoch re-places its batches from the cursor)
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
         t.join(timeout=2.0)
         if t.is_alive():
             import logging

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import sys
 
 import numpy as np
@@ -43,6 +44,11 @@ def _common(p: argparse.ArgumentParser):
     p.add_argument("--synthetic-size", type=int, default=512)
     p.add_argument("--optimizer", default=None,
                    help="sgd|adam|rmsprop (model default otherwise)")
+    p.add_argument("--slices", type=int, default=None,
+                   help="two-tier data parallelism: split the batch "
+                        "axis into a ('slice','data') mesh of this many "
+                        "slices (BIGDL_TPU_SLICES) — arms in-run slice "
+                        "failover; see docs/resilience.md")
     p.add_argument("--steps-per-call", type=int, default=None,
                    help="fused dispatch: optimizer steps per jitted call "
                         "(lax.scan over the train step; default "
@@ -554,6 +560,10 @@ def main(argv=None):
                         "expert-parallel over an 'expert' mesh axis")
 
     args = ap.parse_args(argv)
+    if getattr(args, "slices", None):
+        # before any mesh exists: Engine.mesh()/create_mesh() read the
+        # knob when the trainer is constructed
+        os.environ["BIGDL_TPU_SLICES"] = str(args.slices)
     fn = {"lenet": train_lenet, "resnet": train_resnet,
           "inception": train_inception, "vgg": train_vgg,
           "ptb": train_ptb}[args.cmd]

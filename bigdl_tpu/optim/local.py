@@ -30,6 +30,14 @@ from bigdl_tpu.utils import checkpoint as ckpt
 log = logging.getLogger("bigdl_tpu")
 
 
+class NonFiniteLossError(RuntimeError):
+    """Training aborted: BIGDL_TPU_MAX_NONFINITE consecutive non-finite
+    training steps. The fused path masks each bad step's update (params/
+    slots hold their last good values), so the state at abort time is
+    the last finite state — the retry loop can resume from the latest
+    snapshot, or the operator can inspect it directly."""
+
+
 # ------------------------------------------------- gradient processors
 class GradientProcessor:
     """Pluggable gradient transform (reference: parameters/
@@ -154,6 +162,14 @@ class Optimizer:
         # builder setters that change a captured closure clear it.
         self._built_steps: Dict[tuple, _StepEntry] = {}
         self._valid_masks: Dict[tuple, object] = {}
+        # non-finite step guard (docs/resilience.md): consecutive bad
+        # steps observed at flush time; abort past the knob's budget
+        self._max_nonfinite = _config.get("MAX_NONFINITE")
+        self._nonfinite_run = 0
+        # in-run slice failover (resilience/failover.py): a pending
+        # ("lose", idx) / ("grow", None) event the epoch loop applies at
+        # the K-boundary it was detected on
+        self._failover_pending = None
 
     # ------------------------------------------------------------- builders
     def set_optim_method(self, method: OptimMethod):
@@ -227,6 +243,7 @@ class Optimizer:
         model, criterion, method = self.model, self.criterion, self.method
         processors = list(self.grad_processors)
         frozen = any(m._frozen for m in model.modules())
+        exchange = self._grad_exchange_fn()
 
         def step(params, model_state, slots, x, y, lr, step_num, rng):
             def loss_fn(p):
@@ -246,6 +263,7 @@ class Optimizer:
                 loss_fn, has_aux=True)(params)
             if compute_dtype:
                 grads = cast_floating(grads, jnp.float32)
+            grads = exchange(grads)
             for proc in processors:
                 grads = proc(grads, params)
             if not frozen:
@@ -287,6 +305,7 @@ class Optimizer:
         model, criterion, method = self.model, self.criterion, self.method
         processors = list(self.grad_processors)
         frozen = any(m._frozen for m in model.modules())
+        exchange = self._grad_exchange_fn()
         M = accum_steps
 
         def step(params, model_state, slots, x, y, lr, step_num, rng):
@@ -337,6 +356,7 @@ class Optimizer:
             # and gradients equals the full-batch mean
             grads = jax.tree.map(lambda g: g / M, gsum)
             loss = lsum / M
+            grads = exchange(grads)
             for proc in processors:
                 grads = proc(grads, params)
             if not frozen:
@@ -375,7 +395,19 @@ class Optimizer:
         model_state/slots, and costs no compute at runtime (cond is a
         real branch inside the scan loop, not a select). Each trainer
         config therefore compiles exactly ONE train-step program —
-        tail epochs included."""
+        tail epochs included.
+
+        Non-finite step guard: each live step's loss and UPDATED trees
+        are probed with a cheap device-side all-finite reduce (the
+        updated params embed the gradients, so a NaN/Inf anywhere in
+        loss or grads trips it); a bad step's update is MASKED — params/
+        model_state/slots keep their previous values, exactly as if the
+        step were skipped — while its (non-finite) loss still flows to
+        the host, where `_flush_metrics` counts `train/nonfinite_steps`
+        and aborts after BIGDL_TPU_MAX_NONFINITE consecutive bad steps
+        instead of silently training on NaNs. An all-finite step takes
+        the jnp.where true-branch bitwise unchanged, so the unfused
+        -oracle equivalence is preserved."""
         body_step = (self._make_step(compute_dtype) if accum_steps == 1
                      else self._make_accum_step(accum_steps, compute_dtype))
 
@@ -385,9 +417,21 @@ class Optimizer:
                 x, y, lr, n, r, v = inp
 
                 def run(c):
-                    p, ms, sl = c
-                    p, ms, sl, loss = body_step(p, ms, sl, x, y, lr, n, r)
-                    return (p, ms, sl), loss
+                    p0, ms0, sl0 = c
+                    p1, ms1, sl1, loss = body_step(p0, ms0, sl0, x, y,
+                                                   lr, n, r)
+                    ok = jnp.isfinite(loss)
+                    for leaf in jax.tree.leaves(p1):
+                        if jnp.issubdtype(leaf.dtype, jnp.inexact):
+                            ok = jnp.logical_and(
+                                ok, jnp.all(jnp.isfinite(leaf)))
+
+                    def pick(new, old):
+                        return jax.tree.map(
+                            lambda a, b: jnp.where(ok, a, b), new, old)
+
+                    return (pick(p1, p0), pick(ms1, ms0),
+                            pick(sl1, sl0)), loss
 
                 def skip(c):
                     return c, jnp.float32(0.0)
@@ -444,6 +488,19 @@ class Optimizer:
     # mesh; the local trainer leaves placement to jit's defaults.
     def _place_trees(self, params, model_state, slots):
         return params, model_state, slots
+
+    def _grad_exchange_fn(self):
+        """Seam for the cross-slice gradient exchange, captured at step
+        -build time (a failover rebuild rebinds it to the new mesh) —
+        identity on the local trainer; DistriOptimizer routes it through
+        parallel.mesh.cross_slice_exchange."""
+        return lambda grads: grads
+
+    def _supports_failover(self) -> bool:
+        """Whether this trainer can re-shard in-run on a slice event —
+        the local trainer cannot (no mesh); DistriOptimizer can when its
+        mesh is two-tier and the driver is single-process."""
+        return False
 
     def _place_batch(self, x, y):
         with observe.phase("data/placement", cat="data"):
@@ -709,6 +766,10 @@ class Optimizer:
         if _cfg.get("PRECOMPILE") and not getattr(self, "_precompiled",
                                                   False):
             self.precompile()
+        # a retry re-entry must not replay a slice event or a non-finite
+        # run that died with the previous attempt
+        self._failover_pending = None
+        self._nonfinite_run = 0
         rng = jax.random.PRNGKey(self.seed)
         # disjoint key namespace from the 0xBD1 init fold below — a step
         # key derived straight from (rng, neval) would collide with the
@@ -742,11 +803,7 @@ class Optimizer:
         # re-entry reuses the jitted callables instead of rebuilding
         # them (retrace hygiene — docs/compile_cache.md)
         use_fused = self.steps_per_call > 1 or self.accum_steps > 1
-        step = None if use_fused else self._get_built("step")
-        fused_step = self._get_built("fused") if use_fused else None
         st = self.state
-
-        self._eval_fn = self._build_eval_fn()
 
         # Losses are NOT fetched per step: pending (iter, lr, loss) tuples
         # buffer the device values and are flushed to host on the log
@@ -772,6 +829,13 @@ class Optimizer:
                 _faults.install_sigterm_handler()
 
         while not self.end_when(st):
+            # built programs are looked up per epoch pass, not hoisted:
+            # a slice failover (resilience/failover.py) invalidates the
+            # built-step cache mid-run, and the re-entered pass must
+            # pick up the programs compiled for the NEW topology
+            step = None if use_fused else self._get_built("step")
+            fused_step = self._get_built("fused") if use_fused else None
+            self._eval_fn = self._build_eval_fn()
             epoch_start = time.time()
             epoch_records = 0
             ended_mid_epoch = False
@@ -809,6 +873,11 @@ class Optimizer:
                     skipped += 1
                 log.info("fast-forward consumed %d/%d batches in %.1fs",
                          skipped, skip, time.time() - t_ff)
+            # nan@step:N injection (resilience/faults.py): wrap the raw
+            # stream AFTER the cursor skip so batch i trains iteration
+            # neval + i + 1 — identity when no nan event is armed
+            from bigdl_tpu.resilience import faults as _faults
+            epoch_iter = _faults.poison_nan_stream(epoch_iter, st["neval"])
             if use_fused:
                 (params, model_state, slots, epoch_records,
                  ended_mid_epoch) = self._fused_epoch(
@@ -857,6 +926,15 @@ class Optimizer:
                     ended_mid_epoch = True
                     break
             self._flush_metrics(st)
+            if self._failover_pending is not None:
+                # in-run slice failover (resilience/failover.py): re-shard
+                # onto the new topology at this K-boundary and RE-ENTER
+                # the epoch at the batch cursor — the while loop's
+                # fast-forward path re-groups the remaining batches, so
+                # the run loses nothing past the last completed boundary
+                params, model_state, slots = self._apply_failover(
+                    params, model_state, slots, st)
+                continue
             if ended_mid_epoch:
                 # partial epoch: don't advance counters or fire per-epoch
                 # triggers — a resume picks the epoch up at batch_in_epoch
@@ -1042,6 +1120,32 @@ class Optimizer:
             losses = jax.device_get([p[2] for p in pending])
         last_iter, last_lr = pending[-1][0], pending[-1][1]
         st["loss"] = float(losses[-1])
+        # non-finite step accounting: the fused path already MASKED each
+        # bad step's update device-side (the guard in _make_fused_step),
+        # so a transient NaN batch costs one skipped step; here the bad
+        # losses are counted and a consecutive run past the budget
+        # aborts loudly instead of training on NaNs. Detection rides the
+        # flush cadence — no extra host syncs.
+        import numpy as _np
+        bad_run = self._nonfinite_run
+        for (it_num, _, _), loss_f in zip(pending, losses):
+            if _np.isfinite(loss_f):
+                bad_run = 0
+                continue
+            bad_run += 1
+            observe.counter("train/nonfinite_steps").inc()
+            if self._max_nonfinite and bad_run >= self._max_nonfinite:
+                self._nonfinite_run = bad_run
+                self._pending = []
+                raise NonFiniteLossError(
+                    f"non-finite loss at iteration {it_num} — "
+                    f"{bad_run} consecutive non-finite steps "
+                    f"(BIGDL_TPU_MAX_NONFINITE={self._max_nonfinite}); "
+                    f"aborting instead of training on NaNs. Params/"
+                    f"slots hold the last finite state (fused-path "
+                    f"updates were masked); resume from the latest "
+                    f"snapshot or inspect the input pipeline.")
+        self._nonfinite_run = bad_run
         # registry updates ride this existing cadence with values already
         # on host — observability adds NO per-step syncs (asserted by
         # tests/test_observe.py)
@@ -1243,9 +1347,23 @@ class Optimizer:
         called after each step (or each K-stride in the fused path).
         Injected crashes raise out to the retry loop; a SIGTERM
         preemption request writes ONE final checkpoint at this boundary
-        and returns True so the epoch loop stops cleanly."""
+        and returns True so the epoch loop stops cleanly; a slice
+        loss/gain request (faults.request_slice_loss / the
+        slice:I@step:N spec) is recorded for the epoch loop to apply at
+        THIS boundary — optimize() re-shards and continues instead of
+        stopping (resilience/failover.py)."""
         from bigdl_tpu.resilience import faults
         faults.check_step(st["neval"])
+        ev = faults.take_slice_event()
+        if ev is not None:
+            if self._supports_failover():
+                self._failover_pending = ev
+                return True
+            log.warning(
+                "slice %s requested at iteration %d but this trainer "
+                "has no two-tier mesh to re-shard — ignored (arrange "
+                "checkpoint-restart via resilience/elastic.py instead)",
+                ev[0], st["neval"])
         if not faults.preempt_requested():
             return False
         faults.clear_preempt()
@@ -1260,6 +1378,13 @@ class Optimizer:
                     "written" if self.ckpt_path else "skipped (no "
                     "set_checkpoint)")
         return True
+
+    def _apply_failover(self, params, model_state, slots, st):
+        """Re-shard onto the pending slice event's topology — only the
+        mesh-aware DistriOptimizer implements this; the base trainer
+        never records a pending event (_supports_failover is False)."""
+        raise RuntimeError(
+            "slice failover requested on a trainer without a mesh")
 
     # -------------------------------------------------------------- retry
     def optimize_with_retry(self, retries: Optional[int] = None,

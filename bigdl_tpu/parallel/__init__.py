@@ -11,7 +11,8 @@ all-gather over ICI) that the reference hand-built on Spark block fetches.
 """
 
 from bigdl_tpu.parallel.mesh import (
-    Engine, create_mesh, mesh_shape_for, DATA_AXIS, MODEL_AXIS, PIPE_AXIS,
+    Engine, create_mesh, mesh_shape_for, cross_slice_exchange,
+    data_axis_size, SLICE_AXIS, DATA_AXIS, MODEL_AXIS, PIPE_AXIS,
     SEQ_AXIS, EXPERT_AXIS,
 )
 from bigdl_tpu.parallel.sharding import (
@@ -26,8 +27,10 @@ from bigdl_tpu.parallel.pipeline import (Pipeline, pipeline_apply,
 from bigdl_tpu.parallel.moe import MoE, expert_parallel_apply
 
 __all__ = [
-    "Engine", "create_mesh", "mesh_shape_for",
-    "DATA_AXIS", "MODEL_AXIS", "PIPE_AXIS", "SEQ_AXIS", "EXPERT_AXIS",
+    "Engine", "create_mesh", "mesh_shape_for", "cross_slice_exchange",
+    "data_axis_size",
+    "SLICE_AXIS", "DATA_AXIS", "MODEL_AXIS", "PIPE_AXIS", "SEQ_AXIS",
+    "EXPERT_AXIS",
     "ShardingRules", "batch_spec", "replicated_spec", "zero1_spec",
     "shard_tree", "DistriOptimizer", "ring_attention", "ring_self_attention",
     "ulysses_attention", "ulysses_self_attention",

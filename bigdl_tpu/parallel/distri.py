@@ -40,7 +40,8 @@ from bigdl_tpu import observe
 from bigdl_tpu.core.module import Criterion, Module
 from bigdl_tpu.optim.local import Optimizer
 from bigdl_tpu.optim.method import OptimMethod
-from bigdl_tpu.parallel.mesh import DATA_AXIS, Engine
+from bigdl_tpu.parallel.mesh import (DATA_AXIS, SLICE_AXIS, Engine,
+                                     cross_slice_exchange, data_axis_size)
 from bigdl_tpu.parallel.sharding import (
     ShardingRules, batch_spec, zero1_spec)
 
@@ -96,8 +97,9 @@ class DistriOptimizer(Optimizer):
         self.rules = rules or ShardingRules()
         self.zero1 = zero1
         self.compute_dtype = compute_dtype
-        self._data_axis_size = (self.mesh.shape[DATA_AXIS]
-                                if DATA_AXIS in self.mesh.axis_names else 1)
+        # composed slice×data ways — the global batch divides over BOTH
+        # tiers of a two-tier mesh
+        self._data_axis_size = data_axis_size(self.mesh)
 
     # ------------------------------------------------------------- placement
     def _param_shardings(self, params):
@@ -107,8 +109,13 @@ class DistriOptimizer(Optimizer):
 
     def _slot_shardings(self, slots):
         if self.zero1:
+            from bigdl_tpu.utils import config
+            # default: composed ('slice','data') windows — bit-identical
+            # to the flat mesh; ZERO1_SLICE_LOCAL keeps a full slot copy
+            # per slice instead (survives a real slice death in place)
+            axis = DATA_AXIS if config.get("ZERO1_SLICE_LOCAL") else None
             spec_of = lambda leaf: NamedSharding(
-                self.mesh, zero1_spec(leaf, self.mesh))
+                self.mesh, zero1_spec(leaf, self.mesh, axis=axis))
         else:
             spec_of = lambda leaf: NamedSharding(self.mesh, P())
         return jax.tree.map(spec_of, slots)
@@ -218,6 +225,85 @@ class DistriOptimizer(Optimizer):
             in_shardings=(p_sh, None, s_sh, None, None, rep, rep, rep, rep),
             out_shardings=(p_sh, None, s_sh, rep))
 
+    # --------------------------------------------------------- two-tier DP
+    def _grad_exchange_fn(self):
+        """The cross-slice gradient exchange seam (parallel/mesh.py):
+        identity on a flat mesh; on a ('slice', 'data') mesh the
+        exchange is labeled — and optionally compressed
+        (BIGDL_TPU_SLICE_GRAD_DTYPE) — for DCN-friendly lowering.
+        Captured at step-build time, so the failover rebuild rebinds it
+        to the survivor mesh."""
+        from bigdl_tpu.utils import config
+        mesh = self.mesh
+        name = config.get("SLICE_GRAD_DTYPE")
+        dtype = getattr(jnp, name) if name else None
+        return lambda grads: cross_slice_exchange(grads, mesh,
+                                                  compress_dtype=dtype)
+
+    # --------------------------------------------------------- failover
+    def _slice_topology(self):
+        """Lazy SliceTopology pinned to the FULL mesh this trainer was
+        constructed with — survivor meshes are derived from it and
+        grow-back returns to it."""
+        if getattr(self, "_slice_topo", None) is None:
+            from bigdl_tpu.resilience.failover import SliceTopology
+            self._slice_topo = SliceTopology(self.mesh)
+        return self._slice_topo
+
+    def _supports_failover(self):
+        # in-run re-shard needs a single-controller driver (the
+        # survivors of a multi-host job cannot fetch shards that lived
+        # on a dead process) and a two-tier mesh to drop rows from
+        return (jax.process_count() == 1
+                and SLICE_AXIS in self._slice_topology()
+                .full_mesh.axis_names)
+
+    def _set_mesh(self, mesh):
+        """Point the trainer at a new mesh mid-run: every built program,
+        AOT executable, and the eval wrapper bake the old mesh in, so
+        the built-step cache is invalidated — the next K-call compiles
+        for the new topology (warm from the persistent compile cache
+        when this topology was seen before)."""
+        self.mesh = mesh
+        self._data_axis_size = data_axis_size(mesh)
+        self._built_steps.clear()
+        self.__dict__.pop("_hist_grad_fn", None)
+
+    def _apply_failover(self, params, model_state, slots, st):
+        """Apply the pending slice event at this K-boundary: fetch the
+        trees to host (global arrays — the mesh-shape-agnostic form
+        elastic restore uses), rebuild the mesh from the survivors (or
+        back to the full grid on grow-back), and re-place through
+        `_place_trees`, which re-derives ZeRO-1/TP specs from the new
+        mesh. Lossless by layout: params and slots are replicated
+        across 'slice' (parallel/sharding.py), so the survivors hold
+        everything. An impossible transition (last slice, nothing to
+        restore) logs and continues on the current mesh."""
+        import time as _time
+        from bigdl_tpu.resilience import failover as _fo
+        kind, idx = self._failover_pending
+        self._failover_pending = None
+        topo = self._slice_topology()
+        t0 = _time.perf_counter()
+        with observe.phase("failover/reshard", cat="resilience"):
+            with observe.phase("failover/fetch", cat="resilience"):
+                host = jax.device_get(
+                    {"params": params, "model_state": model_state,
+                     "slots": slots})
+            try:
+                new_mesh = (topo.lose(idx) if kind == "lose"
+                            else topo.restore())
+            except _fo.FailoverError as e:
+                log.warning("failover request dropped: %s", e)
+                return params, model_state, slots
+            self._set_mesh(new_mesh)
+            with observe.phase("failover/replace", cat="resilience"):
+                params, model_state, slots = self._place_trees(
+                    host["params"], host["model_state"], host["slots"])
+        _fo.note_transition(kind, idx, new_mesh, topo, st["neval"],
+                            _time.perf_counter() - t0)
+        return params, model_state, slots
+
     # ------------------------------------------------------------ resilience
     def _step_donates(self):
         # mirrors _build_step/_build_fused_step: donation is skipped on
@@ -241,6 +327,11 @@ class DistriOptimizer(Optimizer):
             "n_devices": int(self.mesh.size),
             "zero1": bool(self.zero1),
         })
+        topo = getattr(self, "_slice_topo", None)
+        if topo is not None and topo.n_slices > 1:
+            meta.update({"live_slices": len(topo.live_slices()),
+                         "lost_slices": ",".join(
+                             str(i) for i in sorted(topo.lost))})
         return meta
 
     def _eval_pad_rows(self, n):

@@ -10,11 +10,23 @@ singleton check + py4j gateway bootstrap, utils/Engine.scala:146-186,266).
 Mesh axes (superset of the reference's parallelism inventory, SURVEY §2.13 —
 the reference only has data parallelism; tensor/pipeline/sequence/expert axes
 are the parity-plus TPU extensions):
+  slice  — slice-level data parallelism (two-tier: DCN across slices)
   data   — batch sharding (sync data-parallel SGD)
   model  — tensor parallelism (megatron-style param sharding)
   pipe   — pipeline stages
   seq    — sequence/context parallelism (ring attention)
   expert — MoE expert parallelism
+
+Two-tier topology (BIGDL_TPU_SLICES > 1): the batch axis splits into
+`('slice', 'data')` — gradients reduce over ICI inside a slice and the
+cross-slice half of the exchange is factored into its own labeled scope
+(`cross_slice_exchange`) so it can later be lowered to DCN-friendly
+(lower-frequency or compressed) exchange. Params stay replicated across
+slices; ZeRO-1 slots default to the composed ('slice', 'data') windows
+(bit-identical to the flat mesh at equal global batch — the failover
+equivalence tests rely on it) with BIGDL_TPU_ZERO1_SLICE_LOCAL opting
+into slice-redundant slots instead. In-run slice failover lives in
+resilience/failover.py.
 """
 
 from __future__ import annotations
@@ -30,49 +42,64 @@ from jax.sharding import Mesh
 
 log = logging.getLogger("bigdl_tpu")
 
+SLICE_AXIS = "slice"
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 PIPE_AXIS = "pipe"
 SEQ_AXIS = "seq"
 EXPERT_AXIS = "expert"
 
-# Canonical axis order: data outermost (DCN-friendly), then pipe, then the
-# ICI-heavy axes innermost so tensor/sequence collectives ride the
-# fastest links (scaling-book recipe: keep high-traffic axes on ICI).
-AXIS_ORDER = (DATA_AXIS, PIPE_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
+# Canonical axis order: slice outermost (pure DCN), then data, then pipe,
+# then the ICI-heavy axes innermost so tensor/sequence collectives ride
+# the fastest links (scaling-book recipe: keep high-traffic axes on ICI).
+AXIS_ORDER = (SLICE_AXIS, DATA_AXIS, PIPE_AXIS, EXPERT_AXIS, SEQ_AXIS,
+              MODEL_AXIS)
 
 
-def mesh_shape_for(n_devices: int, *, model: int = 1, pipe: int = 1,
-                   seq: int = 1, expert: int = 1,
+def mesh_shape_for(n_devices: int, *, slices: int = 1, model: int = 1,
+                   pipe: int = 1, seq: int = 1, expert: int = 1,
                    data: Optional[int] = None) -> Dict[str, int]:
     """Resolve a full axis->size dict; `data` auto-fills remaining devices."""
-    fixed = model * pipe * seq * expert
+    fixed = slices * model * pipe * seq * expert
     if n_devices % fixed != 0:
         raise ValueError(
-            f"{n_devices} devices not divisible by model*pipe*seq*expert={fixed}")
+            f"{n_devices} devices not divisible by "
+            f"slices*model*pipe*seq*expert={fixed}")
     if data is None:
         data = n_devices // fixed
     if data * fixed != n_devices:
         raise ValueError(
             f"mesh {data}x{fixed} != {n_devices} devices")
-    return {DATA_AXIS: data, PIPE_AXIS: pipe, EXPERT_AXIS: expert,
-            SEQ_AXIS: seq, MODEL_AXIS: model}
+    return {SLICE_AXIS: slices, DATA_AXIS: data, PIPE_AXIS: pipe,
+            EXPERT_AXIS: expert, SEQ_AXIS: seq, MODEL_AXIS: model}
 
 
 def create_mesh(devices: Optional[Sequence[jax.Device]] = None, *,
+                slices: Optional[int] = None,
                 model: int = 1, pipe: int = 1, seq: int = 1,
                 expert: int = 1, data: Optional[int] = None,
                 drop_trivial_axes: bool = False) -> Mesh:
     """Build a named mesh over `devices` (default: all).
 
+    `slices` (default: BIGDL_TPU_SLICES) splits the batch dimension into
+    a two-tier `('slice', 'data')` topology — one 'slice' row per TPU
+    slice, devices_per_slice along 'data'. The 'slice' axis only appears
+    in the mesh when slices > 1, so single-slice jobs keep today's axis
+    names exactly (a survivor mesh built by resilience/failover.py DOES
+    keep a size-1 'slice' axis: its specs must stay valid mid-run).
+
     With `drop_trivial_axes`, size-1 axes are omitted — useful for tests
     that want a pure-DP mesh named ('data',).
     """
+    if slices is None:
+        from bigdl_tpu.utils import config
+        slices = config.get("SLICES")
     devices = list(devices if devices is not None else jax.devices())
-    shape = mesh_shape_for(len(devices), model=model, pipe=pipe, seq=seq,
-                           expert=expert, data=data)
+    shape = mesh_shape_for(len(devices), slices=slices, model=model,
+                           pipe=pipe, seq=seq, expert=expert, data=data)
     names = tuple(a for a in AXIS_ORDER
-                  if not (drop_trivial_axes and shape[a] == 1))
+                  if not (a == SLICE_AXIS and shape[a] == 1)
+                  and not (drop_trivial_axes and shape[a] == 1))
     if not names:
         names = (DATA_AXIS,)
     dims = tuple(shape[a] for a in names)
@@ -89,9 +116,49 @@ def composed_data_axis(mesh) -> "Optional[str]":
 
 
 def data_axis_size(mesh) -> int:
-    """Size of the composed batch axis (1 when the mesh has none)."""
-    ax = composed_data_axis(mesh)
-    return mesh.shape[ax] if ax else 1
+    """Total batch-sharding ways: the product of the 'slice' and 'data'
+    axis sizes present on the mesh (1 when it carries neither). A global
+    batch must divide by this — on a two-tier 2×4 mesh that is 8, same
+    as the flat 8-device mesh it is numerically equivalent to."""
+    n = 1
+    for ax in (SLICE_AXIS, DATA_AXIS):
+        if ax in mesh.axis_names:
+            n *= mesh.shape[ax]
+    return n
+
+
+def slice_axis_size(mesh) -> int:
+    """Number of slice rows (1 on a flat mesh)."""
+    return mesh.shape[SLICE_AXIS] if SLICE_AXIS in mesh.axis_names else 1
+
+
+def cross_slice_exchange(grads, mesh, compress_dtype=None):
+    """The cross-slice half of the gradient reduction, factored into its
+    own labeled scope. Under GSPMD jit the all-reduce over the composed
+    ('slice', 'data') batch axes is inserted by the partitioner; this
+    seam marks where the cross-slice leg belongs so a later lowering can
+    make it DCN-friendly — lower-frequency, or compressed on the wire:
+    with `compress_dtype` (BIGDL_TPU_SLICE_GRAD_DTYPE, e.g. bfloat16)
+    every floating gradient leaf round-trips through that dtype inside
+    the `cross_slice_grad_exchange` scope, so the converts (and the
+    collectives sharing their fusion) carry the label in HLO metadata.
+    Identity on a mesh without a >1 'slice' axis, and bit-identical to
+    no-op when compression is off — the flat-mesh ≡ two-tier-mesh
+    equivalence tests rely on that."""
+    if (mesh is None or SLICE_AXIS not in mesh.axis_names
+            or mesh.shape[SLICE_AXIS] <= 1):
+        return grads
+    if compress_dtype is None:
+        return grads
+    import jax.numpy as jnp
+
+    def one(g):
+        if hasattr(g, "dtype") and jnp.issubdtype(g.dtype, jnp.floating):
+            return g.astype(compress_dtype).astype(g.dtype)
+        return g
+
+    with jax.named_scope("cross_slice_grad_exchange"):
+        return jax.tree.map(one, grads)
 
 
 def round_up_to_data_multiple(n: int, mesh) -> int:

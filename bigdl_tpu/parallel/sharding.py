@@ -24,11 +24,15 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from bigdl_tpu.parallel.mesh import DATA_AXIS
+from bigdl_tpu.parallel.mesh import DATA_AXIS, SLICE_AXIS
 
 
-def batch_spec(mesh: Mesh, ndim: int = 1, axes=(DATA_AXIS,)) -> P:
-    """Shard dim 0 across the data(+expert/pipe if fused) axes."""
+def batch_spec(mesh: Mesh, ndim: int = 1,
+               axes=(SLICE_AXIS, DATA_AXIS)) -> P:
+    """Shard dim 0 across the batch axes: the composed ('slice', 'data')
+    pair on a two-tier mesh, plain 'data' on a flat one (size-1 or
+    absent axes drop out of the spec, so a survivor mesh whose 'slice'
+    axis shrank to 1 keeps sharding over 'data' alone)."""
     names = [a for a in axes if a in mesh.axis_names and
              mesh.shape[a] > 1] or [a for a in axes if a in mesh.axis_names]
     return P(tuple(names) if len(names) > 1 else (names[0] if names else None),
@@ -39,21 +43,40 @@ def replicated_spec() -> P:
     return P()
 
 
-def zero1_spec(leaf, mesh: Mesh, axis: str = DATA_AXIS) -> P:
+def zero1_spec(leaf, mesh: Mesh, axis=None) -> P:
     """ZeRO-1 layout for one optimizer-slot leaf: shard the largest
-    dimension divisible by the data-axis size; replicate if none divides
+    dimension divisible by the batch-axis size; replicate if none divides
     (small biases/scalars — same as the reference keeping tiny tails on one
-    shard)."""
-    if axis not in mesh.axis_names:
+    shard).
+
+    `axis` defaults to the COMPOSED batch axes — ('slice', 'data') on a
+    two-tier mesh — so a 2×4 mesh partitions slots into the same 8
+    windows as the flat 8-device mesh, keeping the two numerically
+    bit-identical (the slice-failover equivalence tests rely on this).
+    Pass `axis=DATA_AXIS` (BIGDL_TPU_ZERO1_SLICE_LOCAL on the trainer)
+    to keep slot shards WITHIN a slice instead: every slice then holds a
+    complete slot copy — redundancy that survives a real slice death
+    without a host fetch, at the cost of flat-mesh bit-parity and an
+    S-times larger slot footprint."""
+    if axis is None:
+        axes = tuple(a for a in (SLICE_AXIS, DATA_AXIS)
+                     if a in mesh.axis_names)
+    elif isinstance(axis, str):
+        axes = (axis,) if axis in mesh.axis_names else ()
+    else:
+        axes = tuple(a for a in axis if a in mesh.axis_names)
+    if not axes:
         return P()
-    n = mesh.shape[axis]
+    n = 1
+    for a in axes:
+        n *= mesh.shape[a]
     if n <= 1 or not hasattr(leaf, "shape") or leaf.ndim == 0:
         return P()
     dims = sorted(range(leaf.ndim), key=lambda d: -leaf.shape[d])
     for d in dims:
         if leaf.shape[d] % n == 0 and leaf.shape[d] >= n:
             spec = [None] * leaf.ndim
-            spec[d] = axis
+            spec[d] = axes if len(axes) > 1 else axes[0]
             return P(*spec)
     return P()
 

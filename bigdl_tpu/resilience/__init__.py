@@ -15,16 +15,30 @@ this package is its TPU-native translation:
   * `elastic`   — mesh-shape-agnostic restore: reassemble global host
                   arrays from shards, re-place (incl. ZeRO-1 slots)
                   under the CURRENT mesh;
-  * `faults`    — deterministic fault injection (BIGDL_TPU_FAULT) and
-                  the SIGTERM preemption handler;
+  * `faults`    — deterministic fault injection (BIGDL_TPU_FAULT:
+                  crash/preempt/io/slice/grow/nan events), the SIGTERM
+                  preemption handler, and the slice-event request API
+                  (request_slice_loss / request_slice_gain);
+  * `failover`  — in-run slice failover: when a slice of a two-tier
+                  ('slice', 'data') mesh dies, the DistriOptimizer
+                  re-shards onto the survivors at the next K-boundary
+                  INSIDE optimize() and grows back when capacity
+                  returns — fault ⇒ lose at most the current K window;
   * `retry`     — RetryPolicy: bounded retries, exponential backoff,
                   resume-validation, shared by both trainers.
+
+CLI: `python -m bigdl_tpu.resilience {ls,validate,gc}` inspects,
+deep-validates, and retention-sweeps checkpoint roots.
 
 See docs/resilience.md.
 """
 
+from bigdl_tpu.resilience.failover import (FailoverError,  # noqa: F401
+                                           SliceTopology)
 from bigdl_tpu.resilience.faults import (SimulatedCrash,  # noqa: F401
-                                         install_sigterm_handler)
+                                         install_sigterm_handler,
+                                         request_slice_gain,
+                                         request_slice_loss)
 from bigdl_tpu.resilience.manifest import (CorruptSnapshot,  # noqa: F401
                                            gc_snapshots, latest_checkpoint,
                                            validate_snapshot)

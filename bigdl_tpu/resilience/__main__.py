@@ -1,0 +1,142 @@
+"""CLI: inspect / validate / garbage-collect checkpoint roots.
+
+    python -m bigdl_tpu.resilience ls ROOT [--json]
+    python -m bigdl_tpu.resilience validate ROOT [--latest] [--json]
+    python -m bigdl_tpu.resilience gc ROOT --keep N [--dry-run] [--json]
+
+`ls` lists every snapshot under ROOT (step, format, committed state,
+bytes, the manifest's meta summary). `validate` deep-validates —
+COMMIT marker + shard coverage + CRC32C reassembly, the same check the
+retry loop runs before trusting a resume — and exits non-zero when any
+checked snapshot (or, with --latest, the newest committed one) fails.
+`gc` applies the retention sweep (`manifest.gc_snapshots`): keep the
+newest N committed snapshots, drop older ones plus dead uncommitted
+leftovers; `--dry-run` previews the victim set (docs/resilience.md)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from bigdl_tpu.resilience import manifest
+
+
+def _dir_bytes(path: str) -> int:
+    total = 0
+    for base, _dirs, files in os.walk(path):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(base, f))
+            except OSError:
+                pass
+    return total
+
+
+def _meta_summary(path: str) -> dict:
+    try:
+        if manifest.is_v2(path):
+            meta = manifest.read_manifest(path).get("meta", {}) or {}
+        else:                                  # v1: tree.json carries meta
+            with open(os.path.join(path, "tree.json")) as f:
+                meta = json.load(f).get("meta", {}) or {}
+    except Exception:                         # noqa: BLE001 — listing only
+        return {}
+    keys = ("epoch", "neval", "records", "mesh_shape", "n_devices",
+            "live_slices", "lost_slices")
+    return {k: meta[k] for k in keys if k in meta}
+
+
+def _rows(root: str) -> list:
+    rows = []
+    for step, path in manifest.list_snapshots(root):
+        rows.append({
+            "step": step,
+            "path": path,
+            "format": "v2" if manifest.is_v2(path) else "v1",
+            "committed": manifest.is_committed(path),
+            "bytes": _dir_bytes(path),
+            "meta": _meta_summary(path),
+        })
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="bigdl_tpu.resilience")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("ls", help="list snapshots under a checkpoint root")
+    p.add_argument("root")
+    p.add_argument("--json", action="store_true",
+                   help="emit one JSON object instead of the table")
+    p = sub.add_parser("validate",
+                       help="deep-validate snapshots (CRC32C reassembly)")
+    p.add_argument("root")
+    p.add_argument("--latest", action="store_true",
+                   help="only the newest committed snapshot")
+    p.add_argument("--json", action="store_true")
+    p = sub.add_parser("gc", help="retention sweep (keep newest N)")
+    p.add_argument("root")
+    p.add_argument("--keep", type=int, required=True,
+                   help="committed snapshots to keep")
+    p.add_argument("--dry-run", action="store_true",
+                   help="print the victim set without deleting")
+    p.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "ls":
+        rows = _rows(args.root)
+        if args.json:
+            print(json.dumps({"root": args.root, "snapshots": rows}))
+            return 0
+        if not rows:
+            print(f"no snapshots under {args.root}")
+            return 0
+        for r in rows:
+            state = "committed" if r["committed"] else "UNCOMMITTED"
+            meta = " ".join(f"{k}={v}" for k, v in r["meta"].items())
+            print(f"snapshot-{r['step']}  {r['format']}  {state}  "
+                  f"{r['bytes']} bytes  {meta}")
+        return 0
+
+    if args.cmd == "validate":
+        rows = _rows(args.root)
+        if args.latest:
+            committed = [r for r in rows if r["committed"]]
+            rows = committed[-1:]
+            if not rows:
+                print(f"no committed snapshot under {args.root}",
+                      file=sys.stderr)
+                return 1
+        results, bad = [], 0
+        for r in rows:
+            err = manifest.validate_snapshot(r["path"], deep=True)
+            results.append({"step": r["step"], "path": r["path"],
+                            "ok": err is None, "error": err})
+            if err is not None:
+                bad += 1
+        if args.json:
+            print(json.dumps({"root": args.root, "results": results,
+                              "invalid": bad}))
+        else:
+            for r in results:
+                print(f"snapshot-{r['step']}  "
+                      f"{'OK' if r['ok'] else 'FAIL: ' + str(r['error'])}")
+            print(f"{len(results) - bad}/{len(results)} valid")
+        return 1 if bad else 0
+
+    removed = manifest.gc_snapshots(args.root, args.keep,
+                                    dry_run=args.dry_run)
+    if args.json:
+        print(json.dumps({"root": args.root, "keep": args.keep,
+                          "dry_run": args.dry_run, "removed": removed}))
+        return 0
+    verb = "would remove" if args.dry_run else "removed"
+    for p_ in removed:
+        print(f"{verb} {p_}")
+    print(f"{verb} {len(removed)} path{'s' if len(removed) != 1 else ''}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

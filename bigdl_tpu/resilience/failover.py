@@ -1,0 +1,151 @@
+"""Slice failover — two-tier mesh elasticity INSIDE a running optimize().
+
+The reference's headline robustness property is that a failed Spark task
+never kills the job: the driver re-schedules and training continues
+(optim/DistriOptimizer.scala failure/retry path). The TPU failure mode
+that matters is coarser — a whole slice preempted mid-run — and the
+pre-existing answer (checkpoint-restart via `elastic.py`) pays a process
+restart plus the last-checkpoint delta. This module converts that into
+an in-process transition: fault ⇒ lose at most the current K window.
+
+Why no training state is lost: the transition happens at a K-boundary,
+where the trainer holds a complete, consistent (params, model_state,
+slots) snapshot on the still-addressable devices — it is fetched to
+host as global arrays and re-placed under the survivor mesh, so the run
+loses at most the K window that was in flight. The continued run uses
+the same neval-derived rng stream and the same batch cursor, making it
+bit-identical to one that had STARTED on the survivor mesh from that
+boundary's state (tests/test_failover.py). Layout note
+(parallel/sharding.py): ZeRO-1 slots default to composed
+('slice', 'data') windows — bit-identical to the flat mesh — while
+BIGDL_TPU_ZERO1_SLICE_LOCAL trades that parity for a complete slot copy
+per slice, redundancy that would survive even an abrupt slice death
+with no fetchable buffers.
+
+The transition itself (DistriOptimizer._apply_failover):
+  1. fetch params/model_state/slots to host (global arrays — the same
+     mesh-shape-agnostic form elastic.load_trees produces);
+  2. rebuild the mesh from the survivors (`SliceTopology.lose`) or back
+     to the full grid when capacity returns (`.restore`);
+  3. re-place the trees under the new mesh through the trainers'
+     ordinary `_place_trees` (ZeRO-1/TP specs re-derived from the live
+     mesh — the exact path elastic restore uses);
+  4. invalidate the built-step cache so the next K-call compiles for the
+     new topology — served warm from the persistent compile cache
+     (compilecache/) when the topology was seen before;
+  5. re-enter the epoch at the batch cursor: the data iterator re-groups
+     the remaining batches from the last completed K-boundary.
+
+Detection is a REQUEST, not an interrupt: `faults.request_slice_loss(i)`
+(or the `slice:I@step:N` injection spec) sets a flag the trainers poll
+at each K-boundary — the same contract as preemption. A real deployment
+wires its pod-manager/health-watchdog notification to that call.
+
+Every transition emits `failover/*` counters/gauges and a
+`failover/reshard` span (with `failover/fetch` / `failover/replace`
+children) through the observe registry.
+
+Multi-controller caveat: in-run failover assumes a single-process
+driver (the CPU-mesh simulation, or a single-controller TPU topology).
+Multi-host jobs keep the restart-based elastic path — the survivors
+cannot re-place a global array whose shards lived on a dead process.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+import numpy as np
+
+log = logging.getLogger("bigdl_tpu")
+
+
+class FailoverError(RuntimeError):
+    """An impossible slice transition (lose the last slice, grow with
+    nothing lost, lose an already-dead slice)."""
+
+
+class SliceTopology:
+    """Bookkeeping for a `slices × devices_per_slice` mesh: which slice
+    rows are live, and how to build the survivor / restored mesh.
+
+    The full mesh is captured at construction; `lose(i)` drops row i
+    from the device grid (keeping the 'slice' axis, at its reduced size,
+    so every PartitionSpec naming it stays valid), `restore()` returns
+    to the full grid. A flat mesh (no 'slice' axis) is a single
+    un-losable slice."""
+
+    def __init__(self, mesh):
+        from bigdl_tpu.parallel.mesh import SLICE_AXIS
+        self.full_mesh = mesh
+        self._axis = SLICE_AXIS
+        self._has_slices = SLICE_AXIS in mesh.axis_names
+        self.lost: set = set()
+
+    @property
+    def n_slices(self) -> int:
+        if not self._has_slices:
+            return 1
+        return int(self.full_mesh.shape[self._axis])
+
+    def live_slices(self) -> List[int]:
+        return [i for i in range(self.n_slices) if i not in self.lost]
+
+    def _mesh_for(self, live: List[int]):
+        from jax.sharding import Mesh
+        grid = self.full_mesh.devices
+        pos = self.full_mesh.axis_names.index(self._axis)
+        return Mesh(np.take(grid, live, axis=pos),
+                    self.full_mesh.axis_names)
+
+    def lose(self, idx: int):
+        """Survivor mesh after losing slice `idx`; raises FailoverError
+        when idx is unknown/already lost or it is the last live slice."""
+        if not self._has_slices:
+            raise FailoverError(
+                "mesh has no 'slice' axis — single-slice jobs cannot "
+                "fail over in-run (use the checkpoint-restart path)")
+        if idx not in self.live_slices():
+            raise FailoverError(
+                f"slice {idx} is not live (lost={sorted(self.lost)}, "
+                f"n_slices={self.n_slices})")
+        if len(self.live_slices()) == 1:
+            raise FailoverError(
+                f"slice {idx} is the last live slice — nothing to fail "
+                f"over to")
+        self.lost.add(idx)
+        return self._mesh_for(self.live_slices())
+
+    def restore(self):
+        """The full mesh again (grow-back); raises FailoverError when no
+        slice is lost."""
+        if not self.lost:
+            raise FailoverError("no lost slice to grow back")
+        self.lost.clear()
+        return self.full_mesh
+
+
+def note_transition(kind: str, slice_idx: Optional[int], mesh,
+                    topo: SliceTopology, neval: int,
+                    reshard_s: float) -> None:
+    """Emit the `failover/*` telemetry for one completed transition."""
+    from bigdl_tpu import observe
+    if kind == "lose":
+        observe.counter("failover/slice_losses").inc()
+    else:
+        observe.counter("failover/grow_backs").inc()
+    observe.gauge("failover/live_devices").set(int(mesh.size))
+    observe.gauge("failover/live_slices").set(len(topo.live_slices()))
+    observe.gauge("failover/lost_slices").set(len(topo.lost))
+    observe.gauge("failover/last_reshard_s").set(reshard_s)
+    observe.instant(f"failover/{kind}", cat="resilience",
+                    args={"step": neval, "slice": slice_idx,
+                          "live_devices": int(mesh.size),
+                          "reshard_s": round(reshard_s, 4)})
+    log.warning(
+        "failover: %s at iteration %d -> %d live devices "
+        "(%d/%d slices, re-shard %.1f ms)",
+        f"lost slice {slice_idx}" if kind == "lose" else "grow-back",
+        neval, int(mesh.size), len(topo.live_slices()), topo.n_slices,
+        reshard_s * 1e3)

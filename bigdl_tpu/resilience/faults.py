@@ -1,10 +1,14 @@
-"""Deterministic fault injection + preemption handling.
+"""Deterministic fault injection + preemption / slice-event handling.
 
 The reference proves its retry loop with Spark executor kills; here the
 equivalent is a deterministic harness the resilience tests (and any
-soak run) drive through one env knob:
+soak run) drive through one env knob — a comma-separated list of
+one-shot events:
 
     BIGDL_TPU_FAULT=step:N[:kind]      kind ∈ crash | preempt | io
+    BIGDL_TPU_FAULT=slice:I@step:N     lose slice I (in-run failover)
+    BIGDL_TPU_FAULT=grow@step:N        capacity returns (grow back)
+    BIGDL_TPU_FAULT=nan@step:N         poison iteration N's batch to NaN
 
   * crash    — raise SimulatedCrash at the first step boundary >= N
                (the driver's retry loop treats it like any trainer
@@ -13,9 +17,20 @@ soak run) drive through one env knob:
                signal path below;
   * io       — arm ONE shard-write failure: the next snapshot write
                raises OSError mid-write, leaving an uncommitted dir that
-               recovery must skip.
+               recovery must skip;
+  * slice    — request the loss of slice I at that boundary: the
+               DistriOptimizer catches it INSIDE optimize(), re-shards
+               onto the survivors and keeps training
+               (resilience/failover.py) — fault ⇒ lose at most the
+               current K window, not a restart;
+  * grow     — the symmetric grow-back request: re-shard onto the full
+               mesh again;
+  * nan      — replace the input batch of iteration N with NaNs, so its
+               loss/gradients go non-finite — drives the fused scan's
+               masked-update guard and the train/nonfinite_steps
+               counter (optim/local.py).
 
-Faults fire once per process (the resumed run must survive), and the
+Events fire once per process (the resumed run must survive), and the
 trainer checks at `steps_per_call` K-boundaries, so the fire step is
 deterministic for any K.
 
@@ -23,7 +38,13 @@ Preemption: `install_sigterm_handler()` converts SIGTERM (the TPU-VM
 maintenance/preemption notice) into a request flag; the trainers poll
 `preempt_requested()` at each K-boundary, write one final checkpoint,
 and return cleanly — the next invocation resumes where the preemption
-landed.
+landed. Slice events mirror that API exactly:
+`request_slice_loss(i)` / `slice_loss_requested()` /
+`clear_slice_loss()` and `request_slice_gain()` /
+`slice_gain_requested()` / `clear_slice_gain()` are the programmatic
+path a real pod-manager hook would call (GKE preemption notice, slice
+health watchdog); the spec grammar above is just a deterministic way to
+schedule them.
 """
 
 from __future__ import annotations
@@ -32,38 +53,78 @@ import logging
 import os
 import signal
 import threading
+from typing import List, Optional, Tuple
 
 log = logging.getLogger("bigdl_tpu")
 
 CRASH, PREEMPT, IO = "crash", "preempt", "io"
+SLICE, GROW, NAN = "slice", "grow", "nan"
 
 
 class SimulatedCrash(RuntimeError):
     """Injected training failure (BIGDL_TPU_FAULT=step:N:crash)."""
 
 
+class _Event:
+    __slots__ = ("kind", "step", "slice_idx", "fired")
+
+    def __init__(self, kind: str, step: int, slice_idx: int = 0):
+        self.kind, self.step, self.slice_idx = kind, step, slice_idx
+        self.fired = False
+
+
+def _parse(spec: str) -> "List[_Event]":
+    events: List[_Event] = []
+    for part in filter(None, (p.strip() for p in (spec or "").split(","))):
+        if part.startswith("step:"):
+            bits = part.split(":")
+            try:
+                step = int(bits[1])
+            except (IndexError, ValueError):
+                raise ValueError(
+                    f"BIGDL_TPU_FAULT={part!r}: want 'step:N[:kind]'")
+            kind = bits[2] if len(bits) > 2 else CRASH
+            if kind not in (CRASH, PREEMPT, IO):
+                raise ValueError(
+                    f"BIGDL_TPU_FAULT kind {kind!r}: want crash|preempt|io")
+            events.append(_Event(kind, step))
+            continue
+        head, sep, tail = part.partition("@step:")
+        if not sep:
+            raise ValueError(
+                f"BIGDL_TPU_FAULT={part!r}: want 'step:N[:kind]', "
+                f"'slice:I@step:N', 'grow@step:N' or 'nan@step:N'")
+        try:
+            step = int(tail)
+        except ValueError:
+            raise ValueError(f"BIGDL_TPU_FAULT={part!r}: bad step {tail!r}")
+        if head == GROW:
+            events.append(_Event(GROW, step))
+        elif head == NAN:
+            events.append(_Event(NAN, step))
+        elif head.startswith("slice:"):
+            try:
+                idx = int(head[len("slice:"):])
+            except ValueError:
+                raise ValueError(
+                    f"BIGDL_TPU_FAULT={part!r}: bad slice index")
+            events.append(_Event(SLICE, step, idx))
+        else:
+            raise ValueError(
+                f"BIGDL_TPU_FAULT={part!r}: unknown event {head!r}")
+    return events
+
+
 class _Injector:
     def __init__(self, spec: str):
-        self.step = None
-        self.kind = CRASH
-        self.fired = False
-        if spec:
-            parts = spec.split(":")
-            if len(parts) < 2 or parts[0] != "step":
-                raise ValueError(
-                    f"BIGDL_TPU_FAULT={spec!r}: want 'step:N[:kind]'")
-            self.step = int(parts[1])
-            if len(parts) > 2:
-                if parts[2] not in (CRASH, PREEMPT, IO):
-                    raise ValueError(
-                        f"BIGDL_TPU_FAULT kind {parts[2]!r}: want "
-                        f"crash|preempt|io")
-                self.kind = parts[2]
+        self.events = _parse(spec)
 
 
-_injector: _Injector = None
+_injector: Optional[_Injector] = None
 _io_armed = False
 _preempt = threading.Event()
+_slice_loss: Optional[int] = None
+_slice_gain = False
 _prev_handler = None
 _lock = threading.Lock()
 
@@ -88,26 +149,38 @@ def _get() -> _Injector:
 
 def check_step(neval: int) -> None:
     """Called by the trainers at every step/K-stride boundary with the
-    post-step iteration count. Fires the armed fault once."""
+    post-step iteration count. Fires every armed fault whose step has
+    been reached, once each. NaN events are not fired here — they are
+    consumed by `poison_nan_stream` before the batch is dispatched."""
     global _io_armed
     inj = _get()
-    if inj.step is None or inj.fired or neval < inj.step:
-        return
-    inj.fired = True
-    from bigdl_tpu import observe
-    observe.counter("resilience/faults_injected").inc()
-    observe.instant(f"fault/{inj.kind}", cat="resilience",
-                    args={"step": neval})
-    if inj.kind == CRASH:
-        log.warning("fault injection: crash at iteration %d", neval)
-        raise SimulatedCrash(f"injected crash at iteration {neval}")
-    if inj.kind == PREEMPT:
-        log.warning("fault injection: SIGTERM self at iteration %d", neval)
-        os.kill(os.getpid(), signal.SIGTERM)
-        return
-    log.warning("fault injection: arming shard-write IO error "
-                "(iteration %d)", neval)
-    _io_armed = True
+    for ev in inj.events:
+        if ev.fired or ev.kind == NAN or neval < ev.step:
+            continue
+        ev.fired = True
+        from bigdl_tpu import observe
+        observe.counter("resilience/faults_injected").inc()
+        observe.instant(f"fault/{ev.kind}", cat="resilience",
+                        args={"step": neval})
+        if ev.kind == CRASH:
+            log.warning("fault injection: crash at iteration %d", neval)
+            raise SimulatedCrash(f"injected crash at iteration {neval}")
+        if ev.kind == PREEMPT:
+            log.warning("fault injection: SIGTERM self at iteration %d",
+                        neval)
+            os.kill(os.getpid(), signal.SIGTERM)
+        elif ev.kind == IO:
+            log.warning("fault injection: arming shard-write IO error "
+                        "(iteration %d)", neval)
+            _io_armed = True
+        elif ev.kind == SLICE:
+            log.warning("fault injection: slice %d lost at iteration %d",
+                        ev.slice_idx, neval)
+            request_slice_loss(ev.slice_idx)
+        elif ev.kind == GROW:
+            log.warning("fault injection: slice capacity returned at "
+                        "iteration %d", neval)
+            request_slice_gain()
 
 
 def maybe_fail_io(path: str) -> None:
@@ -118,6 +191,64 @@ def maybe_fail_io(path: str) -> None:
     if _io_armed:
         _io_armed = False
         raise OSError(f"injected shard-write IO error for {path}")
+
+
+# ----------------------------------------------------------- NaN poison
+def nan_poison_step() -> Optional[int]:
+    """The step of the first unfired nan@step:N event (None when none
+    armed) — consulted by the trainers when building an epoch stream."""
+    for ev in _get().events:
+        if ev.kind == NAN and not ev.fired:
+            return ev.step
+    return None
+
+
+def _consume_nan_poison(step: int) -> None:
+    for ev in _get().events:
+        if ev.kind == NAN and not ev.fired and ev.step == step:
+            ev.fired = True
+            from bigdl_tpu import observe
+            observe.counter("resilience/faults_injected").inc()
+            observe.instant("fault/nan", cat="resilience",
+                            args={"step": step})
+            return
+
+
+def poison_nan_stream(it, neval0: int):
+    """Wrap a raw (x, y) epoch stream so the batch that will train
+    iteration N (the armed `nan@step:N`) is replaced by NaNs. `neval0`
+    is the trainer's iteration count when the stream starts (batch i of
+    the stream trains iteration neval0 + i + 1); a target already in the
+    past (resume landed beyond it) poisons the first batch instead —
+    first-boundary->=N semantics, matching check_step. Returns `it`
+    untouched when no nan event is armed. Only floating x (or, failing
+    that, floating y) can be poisoned; an all-integer batch logs and
+    passes through."""
+    target = nan_poison_step()
+    if target is None:
+        return it
+    import numpy as np
+
+    def gen():
+        i = neval0
+        tgt = max(target, neval0 + 1)
+        for x, y in it:
+            i += 1
+            if i == tgt and nan_poison_step() == target:
+                _consume_nan_poison(target)
+                x, y = np.asarray(x), np.asarray(y)
+                if np.issubdtype(x.dtype, np.floating):
+                    x = np.full_like(x, np.nan)
+                elif np.issubdtype(y.dtype, np.floating):
+                    y = np.full_like(y, np.nan)
+                else:
+                    log.warning("nan@step:%d: batch has no floating "
+                                "leaves to poison — skipped", target)
+                log.warning("fault injection: NaN batch for iteration %d",
+                            tgt)
+            yield x, y
+
+    return gen()
 
 
 # ------------------------------------------------------------- preemption
@@ -155,3 +286,63 @@ def clear_preempt() -> None:
 def request_preempt() -> None:
     """Programmatic preemption request (same path as SIGTERM)."""
     _preempt.set()
+
+
+# ------------------------------------------------------------ slice events
+def request_slice_loss(slice_idx: int = 0) -> None:
+    """Report slice `slice_idx` lost — the slice-elasticity mirror of
+    `request_preempt()`. The trainers poll at the next K-boundary and,
+    when the mesh is two-tier, re-shard onto the survivors in-run
+    (resilience/failover.py). A second request before the first is
+    consumed overwrites it (the newest report wins)."""
+    global _slice_loss
+    with _lock:
+        if _slice_loss is not None and _slice_loss != slice_idx:
+            log.warning("slice-loss request %d overwrites pending %d",
+                        slice_idx, _slice_loss)
+        _slice_loss = slice_idx
+
+
+def slice_loss_requested() -> Optional[int]:
+    """Pending lost-slice index, or None (non-consuming peek)."""
+    with _lock:
+        return _slice_loss
+
+
+def clear_slice_loss() -> None:
+    global _slice_loss
+    with _lock:
+        _slice_loss = None
+
+
+def request_slice_gain() -> None:
+    """Report that slice capacity returned (grow-back request)."""
+    global _slice_gain
+    with _lock:
+        _slice_gain = True
+
+
+def slice_gain_requested() -> bool:
+    with _lock:
+        return _slice_gain
+
+
+def clear_slice_gain() -> None:
+    global _slice_gain
+    with _lock:
+        _slice_gain = False
+
+
+def take_slice_event() -> "Optional[Tuple[str, Optional[int]]]":
+    """Consume ONE pending slice event for the trainer's K-boundary
+    probe: ('lose', idx) or ('grow', None); loss wins when both are
+    pending (the grow is re-taken at the next boundary)."""
+    global _slice_loss, _slice_gain
+    with _lock:
+        if _slice_loss is not None:
+            idx, _slice_loss = _slice_loss, None
+            return ("lose", idx)
+        if _slice_gain:
+            _slice_gain = False
+            return ("grow", None)
+    return None

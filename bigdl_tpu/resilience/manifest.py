@@ -339,13 +339,16 @@ def latest_checkpoint(root: str, validate: bool = False) -> Optional[str]:
     return None
 
 
-def gc_snapshots(root: str, keep_n: int) -> List[str]:
+def gc_snapshots(root: str, keep_n: int,
+                 dry_run: bool = False) -> List[str]:
     """Retention: keep the newest `keep_n` committed snapshots; delete
     older committed ones plus uncommitted leftovers older than the newest
     committed step (dead tmp state from crashed writers — an uncommitted
     snapshot NEWER than the last commit may still be in flight and is
     left alone). Also sweeps v1 `.tmp`/`.old` staging dirs. Returns the
-    deleted paths. No-op for keep_n <= 0 on committed snapshots."""
+    deleted paths. No-op for keep_n <= 0 on committed snapshots.
+    `dry_run` computes the same victim set without deleting — the
+    resilience CLI's preview mode."""
     snaps = list_snapshots(root)
     committed = [(s, p) for s, p in snaps if is_committed(p)]
     newest_committed = committed[-1][0] if committed else None
@@ -360,6 +363,7 @@ def gc_snapshots(root: str, keep_n: int) -> List[str]:
             drop.append(stale)
     deleted = []
     for p in drop:
-        shutil.rmtree(p, ignore_errors=True)
+        if not dry_run:
+            shutil.rmtree(p, ignore_errors=True)
         deleted.append(p)
     return deleted

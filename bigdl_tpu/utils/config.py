@@ -105,11 +105,39 @@ _register("CHECKPOINT_ON_PREEMPT", True, _bool,
           "at the next steps_per_call K-boundary before stopping "
           "(resilience/faults.py; the TPU-preemption grace window)")
 _register("FAULT", "", str,
-          "Deterministic fault injection for resilience tests: "
-          "'step:N[:kind]' with kind crash (raise SimulatedCrash) | "
-          "preempt (SIGTERM self) | io (fail the next shard write). "
-          "Fires once at the first step boundary >= N "
-          "(resilience/faults.py)")
+          "Deterministic fault injection for resilience tests — a "
+          "comma-separated list of one-shot events: 'step:N[:kind]' with "
+          "kind crash (raise SimulatedCrash) | preempt (SIGTERM self) | "
+          "io (fail the next shard write); 'slice:I@step:N' (lose slice "
+          "I at the first K-boundary >= N — in-run failover, "
+          "resilience/failover.py); 'grow@step:N' (capacity returns: "
+          "grow back to the full mesh); 'nan@step:N' (poison iteration "
+          "N's batch to NaN — exercises the non-finite step guard). "
+          "Each event fires once (resilience/faults.py)")
+_register("SLICES", 1, int,
+          "Two-tier data parallelism: number of TPU slices. >1 splits "
+          "the batch axis into a ('slice', 'data') mesh — ICI gradient "
+          "reduction inside a slice, the cross-slice leg factored into "
+          "the labeled cross_slice_exchange seam (parallel/mesh.py) — "
+          "and arms in-run slice failover (docs/resilience.md)")
+_register("SLICE_GRAD_DTYPE", "", str,
+          "Compressed cross-slice gradient exchange: '' (off, exact) or "
+          "'bfloat16' — floating grads round-trip through this dtype in "
+          "the labeled cross-slice scope, halving DCN bytes at a "
+          "quantization cost (parallel/mesh.py cross_slice_exchange)")
+_register("ZERO1_SLICE_LOCAL", False, _bool,
+          "ZeRO-1 slot layout on a two-tier mesh: 0 (default) shards "
+          "over the composed ('slice','data') axes — bit-identical to "
+          "the flat mesh, S-times smaller slots; 1 shards within a "
+          "slice only, so every slice keeps a complete slot copy that "
+          "survives a real slice death without the host round-trip "
+          "(parallel/sharding.py zero1_spec)")
+_register("MAX_NONFINITE", 3, int,
+          "Abort training (NonFiniteLossError) after this many "
+          "CONSECUTIVE non-finite training steps; 0 disables the abort "
+          "(bad steps are still counted in train/nonfinite_steps and, "
+          "on the fused path, their updates are masked out — "
+          "optim/local.py)")
 _register("TRACE", "", str,
           "Flight-recorder span tracing (observe/trace.py): a directory "
           "records host spans and dumps Chrome/Perfetto trace JSON there "

@@ -32,6 +32,10 @@ import pytest  # noqa: E402
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
+        "slow: long-running scenarios (the multi-transition chaos soak) "
+        "excluded from tier-1 (-m 'not slow') to keep it within budget")
+    config.addinivalue_line(
+        "markers",
         "examples: subprocess-runs examples/*.py (slow; deselect with "
         "-m 'not examples' for the inner loop)")
     config.addinivalue_line(

@@ -475,3 +475,160 @@ def test_retry_policy_keyboard_interrupt_propagates():
 
     with pytest.raises(KeyboardInterrupt):
         pol.run(attempt, lambda e: None)
+
+
+# -------------------------------------- RetryPolicy timing (fake clock)
+def test_retry_window_expiry_fake_clock(monkeypatch):
+    """Failures spaced wider than the sliding window age out: the policy
+    never exhausts, no matter how many total failures — the reference's
+    `bigdl.failure.retryTimeInterval` semantics, timed with a
+    monkeypatched clock instead of real sleeps."""
+    clock = {"t": 1000.0}
+    monkeypatch.setattr("time.time", lambda: clock["t"])
+    pol = RetryPolicy(max_retries=2, window_s=10, backoff_s=0)
+    for _ in range(5):
+        clock["t"] += 11.0                   # outside the 10s window
+        assert pol.record_failure() == 1
+    assert not pol.exhausted()
+    # a burst INSIDE the window accumulates and exhausts
+    clock["t"] += 11.0                       # age out the last loner
+    for _ in range(3):
+        clock["t"] += 1.0
+        n = pol.record_failure()
+    assert n == 3 and pol.exhausted()
+
+
+def test_retry_backoff_caps_at_16x(monkeypatch):
+    """Exponential backoff doubles per failure and caps at 16× the base
+    (resilience/retry.py), without real sleeping."""
+    sleeps = []
+    monkeypatch.setattr("time.sleep", lambda s: sleeps.append(s))
+    pol = RetryPolicy(max_retries=100, window_s=1e9, backoff_s=0.5)
+    for _ in range(7):
+        pol.record_failure()
+        pol.sleep()
+    assert sleeps == [0.5, 1.0, 2.0, 4.0, 8.0, 8.0, 8.0]
+
+
+def test_retry_backoff_disabled_or_clean(monkeypatch):
+    monkeypatch.setattr("time.sleep",
+                        lambda s: (_ for _ in ()).throw(AssertionError(s)))
+    pol = RetryPolicy(max_retries=3, window_s=600, backoff_s=0)
+    pol.record_failure()
+    assert pol.sleep() == 0.0                 # backoff disabled: no sleep
+    pol2 = RetryPolicy(max_retries=3, window_s=600, backoff_s=1.0)
+    assert pol2.sleep() == 0.0                # no failures yet: no sleep
+
+
+# ------------------------------------------- elastic restore with a TP axis
+def test_elastic_restore_with_tp_axis(tmp_path):
+    """elastic restore when the mesh carries a tensor-parallel 'model'
+    axis, not just pure-dp ZeRO-1 (previously untested corner): a
+    (data=2, model=2) snapshot resumes on (data=4, model=2), TP params
+    re-place per rule under the NEW mesh, training continues, and the
+    result matches a local-trainer oracle resumed from the same
+    snapshot."""
+    from jax.sharding import PartitionSpec as P
+    from bigdl_tpu.parallel import (DistriOptimizer, ShardingRules,
+                                    create_mesh)
+    rules = ShardingRules([(r"0/weight", P(None, "model")),
+                           (r"2/weight", P("model", None))])
+    x, y = _data(128, seed=7)
+
+    def mk(mesh, end):
+        ds = ArrayDataSet(x, y, 16, drop_last=True, shuffle=False)
+        opt = DistriOptimizer(_mlp(), ds, nn.ClassNLLCriterion(),
+                              Adam(1e-2), mesh=mesh, rules=rules,
+                              zero1=True, seed=5)
+        opt.set_checkpoint(str(tmp_path), Trigger.several_iteration(4))
+        opt.set_end_when(Trigger.max_iteration(end))
+        return opt
+
+    m4 = create_mesh(jax.devices()[:4], model=2, drop_trivial_axes=True)
+    opt = mk(m4, 4)
+    opt.optimize()                            # writes snapshot-4
+    snap = ckpt.latest_checkpoint(str(tmp_path))
+    assert snap and snap.endswith("snapshot-4")
+
+    m8 = create_mesh(jax.devices()[:8], model=2, drop_trivial_axes=True)
+    opt2 = mk(m8, 8)
+    assert opt2.resume(str(tmp_path))
+    params2, _ = opt2.optimize()
+    assert opt2.state["neval"] == 8
+    assert params2["0"]["weight"].sharding.spec == P(None, "model")
+    assert params2["2"]["weight"].sharding.spec == P("model", None)
+
+    ds = ArrayDataSet(x, y, 16, drop_last=True, shuffle=False)
+    oracle = Optimizer(_mlp(), ds, nn.ClassNLLCriterion(), Adam(1e-2),
+                       seed=5)
+    oracle.set_end_when(Trigger.max_iteration(8))
+    assert oracle.resume(str(tmp_path))
+    oracle_p, _ = oracle.optimize()
+    _assert_trees_equal(params2, oracle_p, exact=False)
+    _assert_trees_equal(opt2.slots, oracle.slots, exact=False)
+
+
+# --------------------------------------------------------- resilience CLI
+def _cli(argv):
+    from bigdl_tpu.resilience.__main__ import main
+    return main(argv)
+
+
+def _seed_root(tmp_path, steps=(2, 4, 6)):
+    model = _mlp()
+    params, _state = model.init(jax.random.PRNGKey(0))
+    cp = AsyncCheckpointer(async_mode=False)
+    for step in steps:
+        cp.save(str(tmp_path / f"snapshot-{step}"), {"params": params},
+                {"neval": step})
+    return params
+
+
+def test_cli_ls_lists_snapshots_and_commit_state(tmp_path, capsys):
+    _seed_root(tmp_path)
+    (tmp_path / "snapshot-1").mkdir()          # dead uncommitted leftover
+    assert _cli(["ls", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    for frag in ("snapshot-2", "snapshot-4", "snapshot-6", "v2",
+                 "committed", "UNCOMMITTED", "neval=2"):
+        assert frag in out, frag
+
+
+def test_cli_ls_json(tmp_path, capsys):
+    _seed_root(tmp_path, steps=(3,))
+    assert _cli(["ls", str(tmp_path), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    (row,) = doc["snapshots"]
+    assert row["step"] == 3 and row["committed"] and row["format"] == "v2"
+    assert row["bytes"] > 0 and row["meta"]["neval"] == 3
+
+
+def test_cli_validate_deep_crc(tmp_path, capsys):
+    """validate exit code tracks deep-CRC health: clean root passes,
+    a flipped byte in the newest shard fails --latest."""
+    _seed_root(tmp_path)
+    assert _cli(["validate", str(tmp_path)]) == 0
+    shard = tmp_path / "snapshot-6" / manifest.shard_file(0)
+    data = bytearray(shard.read_bytes())
+    data[len(data) // 2] ^= 0xFF
+    shard.write_bytes(bytes(data))
+    assert _cli(["validate", str(tmp_path), "--latest"]) == 1
+    out = capsys.readouterr().out
+    assert "FAIL" in out
+    # older snapshots still validate clean
+    assert _cli(["validate", str(tmp_path / "snapshot-2" / "..")]) == 1
+
+
+def test_cli_gc_dry_run_then_sweep(tmp_path, capsys):
+    _seed_root(tmp_path)
+    (tmp_path / "snapshot-1").mkdir()          # dead uncommitted leftover
+    assert _cli(["gc", str(tmp_path), "--keep", "1", "--dry-run"]) == 0
+    out = capsys.readouterr().out
+    assert "would remove" in out
+    assert (tmp_path / "snapshot-2").is_dir()  # dry-run deletes nothing
+    assert _cli(["gc", str(tmp_path), "--keep", "1", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    removed = {os.path.basename(p) for p in doc["removed"]}
+    assert removed == {"snapshot-1", "snapshot-2", "snapshot-4"}
+    assert not (tmp_path / "snapshot-2").exists()
+    assert (tmp_path / "snapshot-6").is_dir()
