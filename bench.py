@@ -326,6 +326,133 @@ def _bench_kernels():
     return out
 
 
+def _bench_fused_update(batch_size=32, window=48, iters=192, depth=24):
+    """Fused optimizer update vs the tree-map path, measured through the
+    REAL DistriOptimizer.optimize() loop on the 8-virtual-device CPU
+    mesh — the dispatch-bench configuration with the update cost made
+    visible: Adam (2 slot trees) on a `depth`-layer MLP (~2*depth param
+    leaves), K=8 fused dispatch. The tree-map update pays ~10 elementwise
+    ops x n_leaves x K per call; the flat fused kernel pays one
+    flattened pass. Throughput per mode is the best post-compile flush
+    window (the dispatch-bench convention). Modes: unfused vs fused on
+    replicated slots (flat layout) and on ZeRO-1 sharded slots (leaf
+    layout). Returns {mode: rec_per_sec}."""
+    import numpy as np
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import ArrayDataSet
+    from bigdl_tpu.optim.method import Adam
+    from bigdl_tpu.optim.trigger import Trigger
+    from bigdl_tpu.parallel import DistriOptimizer, create_mesh
+
+    class _Windows:
+        def __init__(self):
+            self.rates = []
+
+        def add_scalar(self, name, v, step):
+            if name == "Throughput":
+                self.rates.append(v)
+
+    r = np.random.RandomState(0)
+    n = batch_size * (iters + window)
+    x = r.randn(n, 32).astype(np.float32)
+    y = r.randint(0, 2, n).astype(np.int32)
+    mesh = create_mesh(drop_trivial_axes=True)
+    rows = {}
+    for mode, flag, zero1 in (("unfused", "0", False),
+                              ("fused", "1", False),
+                              ("fused_flat", "flat", False),
+                              ("unfused_zero1", "0", True),
+                              ("fused_zero1", "1", True)):
+        os.environ["BIGDL_TPU_FUSED_UPDATE"] = flag
+        try:
+            layers = []
+            for _ in range(depth):
+                layers += [nn.Linear(32, 32), nn.ReLU()]
+            model = nn.Sequential(*layers, nn.Linear(32, 2),
+                                  nn.LogSoftMax())
+            ds = ArrayDataSet(x, y, batch_size, drop_last=True,
+                              shuffle=False)
+            opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(),
+                                  Adam(1e-3), mesh=mesh, seed=0,
+                                  steps_per_call=8, zero1=zero1)
+            opt._log_every = window
+            w = _Windows()
+            opt.set_train_summary(w)
+            opt.set_end_when(Trigger.max_iteration(iters))
+            opt.optimize()
+            post = w.rates[window:]       # first window eats compile
+            rows[mode] = round(max(post), 1)
+        finally:
+            os.environ.pop("BIGDL_TPU_FUSED_UPDATE", None)
+    return rows
+
+
+def _bench_autotune_warm(shape_set="smoke"):
+    """Cold-search vs warm-table autotune: this process sweeps the named
+    shape set (paying the search), then a FRESH subprocess resolves the
+    same shapes against the published table — the acceptance bar is a
+    100% warm-start hit rate (zero searches) and table-lookup latency in
+    the microseconds where the cold path paid a full search."""
+    import tempfile
+    from bigdl_tpu.kernels import autotune
+
+    root = tempfile.mkdtemp(prefix="bigdl_autotune_bench_")
+    autotune.detach()
+    autotune._attach(root)
+    t0 = time.perf_counter()
+    recs = autotune.tune_set(shape_set)
+    cold_s = time.perf_counter() - t0
+    cold_searches = autotune.process_search_count()
+    autotune.sync()
+
+    child = (
+        "import os, sys, time, json\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "from bigdl_tpu.kernels import autotune\n"
+        "from bigdl_tpu import observe\n"
+        "shape_set, root = sys.argv[1], sys.argv[2]\n"
+        "autotune._attach(root)\n"
+        "t0 = time.perf_counter()\n"
+        "for kernel, shape in autotune.SHAPE_SETS[shape_set]:\n"
+        "    autotune.tune(kernel, shape)\n"
+        "lookup_s = time.perf_counter() - t0\n"
+        "snap = observe.registry().snapshot()['counters']\n"
+        "print(json.dumps({'searches': autotune.process_search_count(),\n"
+        "    'hits': snap.get('autotune/hits', 0),\n"
+        "    'misses': snap.get('autotune/misses', 0),\n"
+        "    'lookup_s': lookup_s}))\n")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([sys.executable, "-c", child, shape_set, root],
+                       env=env, capture_output=True, text=True,
+                       timeout=450)
+    warm = {}
+    if r.returncode == 0:
+        line = next((ln for ln in reversed(r.stdout.splitlines())
+                     if ln.startswith("{")), "{}")
+        warm = json.loads(line)
+    else:                                # report, don't hide
+        warm = {"error": (r.stderr or "")[-300:]}
+    import shutil as _sh
+    _sh.rmtree(root, ignore_errors=True)
+    n_shapes = len(autotune.SHAPE_SETS[shape_set])
+    hits = warm.get("hits", 0)
+    return {
+        "shape_set": shape_set,
+        "shapes": n_shapes,
+        "cold_searches": cold_searches,
+        "cold_search_s": round(cold_s, 3),
+        "warm_searches": warm.get("searches"),
+        "warm_hits": hits,
+        "warm_misses": warm.get("misses"),
+        "warm_lookup_s": round(warm["lookup_s"], 4)
+        if "lookup_s" in warm else None,
+        "warm_hit_rate": round(hits / n_shapes, 3) if n_shapes else None,
+        "configs": {rec["kernel"]: rec["config"] for rec in recs},
+        **({"warm_error": warm["error"]} if "error" in warm else {}),
+    }
+
+
 def _bench_llama(batch_size=None, seq_len=None, warmup=None, iters=None):
     """Tokens/sec + MFU for a ~125M LLaMA-architecture train step in
     bf16 — the modern-decoder headline (GQA + RoPE + SwiGLU + flash-size
@@ -1048,24 +1175,55 @@ def child_main():
         return
     if which == "kernels":
         metric, unit = _METRICS["kernels"]
-        if backend == "cpu":
-            # Pallas interpret-mode timings say nothing about Mosaic —
-            # refuse rather than publish a meaningless ratio
-            print(json.dumps({
-                "metric": metric, "value": 0.0, "unit": unit,
-                "vs_baseline": 0.0, "backend": backend,
-                "skipped": "kernel speedups need a live TPU backend"}))
-            return
-        ratios = _bench_kernels()
-        print(json.dumps({
+        # fused-update + autotune warm-start run on ANY backend (the
+        # fused comparison is the 8-virtual-device dispatch bench; the
+        # autotuner is host-side table plumbing). The Mosaic kernel-vs-
+        # XLA ratios additionally need a live TPU — interpret-mode
+        # timings say nothing about Mosaic, so they stay TPU-gated.
+        fused_rows = _bench_fused_update()
+        fu_speedup = round(fused_rows["fused"]
+                           / max(fused_rows["unfused"], 1e-9), 3)
+        fu_flat_speedup = round(fused_rows["fused_flat"]
+                                / max(fused_rows["unfused"], 1e-9), 3)
+        fu_z1_speedup = round(fused_rows["fused_zero1"]
+                              / max(fused_rows["unfused_zero1"], 1e-9), 3)
+        tuned = _bench_autotune_warm()
+        rec = {
             "metric": metric,
-            "value": round(min(ratios.values()), 3),   # headline: worst
             "unit": unit,
             "vs_baseline": 1.0,
             "backend": backend,
+            "n_devices": len(jax.devices()),
             "device_kind": getattr(dev, "device_kind", "unknown"),
-            **ratios,
-        }))
+            "fused_update_rec_per_sec": fused_rows,
+            "fused_update_speedup": fu_speedup,
+            "fused_update_flat_speedup": fu_flat_speedup,
+            "fused_update_zero1_speedup": fu_z1_speedup,
+            "autotune": tuned,
+            "host": _host_provenance(),
+            "note": "fused_update_*: Adam on a 24-layer MLP through "
+                    "DistriOptimizer.optimize() K=8 on the 8-virtual-"
+                    "device mesh, best post-compile window. 'fused' is "
+                    "the shipping auto layout (leaf on CPU — bitwise the "
+                    "same math XLA fuses per leaf, so CPU parity is the "
+                    "honest expectation; the flat+Pallas+donation form "
+                    "this kernel exists for needs the real chip, see "
+                    "fused_update_flat_speedup for what the assembly "
+                    "copies cost when forced on CPU). autotune: cold "
+                    "sweep in this process vs a fresh process resolving "
+                    "the same shapes from the published table "
+                    "(acceptance: warm_hit_rate == 1.0, warm_searches "
+                    "== 0)",
+        }
+        if backend == "cpu":
+            rec["value"] = fu_speedup
+            rec["mosaic_ratios_skipped"] = \
+                "kernel-vs-XLA speedups need a live TPU backend"
+        else:
+            ratios = _bench_kernels()
+            rec.update(ratios)
+            rec["value"] = round(min(ratios.values()), 3)  # worst ratio
+        print(json.dumps(rec))
         return
 
     if backend == "cpu":
@@ -1194,11 +1352,16 @@ def parent_main():
     # else the degraded record is never emitted at all.
     lock_fh, lock_waited, lock_timed_out = _acquire_bench_lock()
     which_arg = sys.argv[1] if len(sys.argv) > 1 else "resnet50"
+    xla = (os.environ.get("XLA_FLAGS", "") +
+           " --xla_force_host_platform_device_count=8").strip()
+    # kernels' CPU fallback needs the 8-virtual-device mesh too — its
+    # fused-update section runs the dispatch-bench trainer loop
+    cpu_fb_env = ({"BIGDL_TPU_FORCE_CPU": "1", "XLA_FLAGS": xla}
+                  if which_arg == "kernels"
+                  else {"BIGDL_TPU_FORCE_CPU": "1"})
     if which_arg in ("dispatch", "checkpoint", "overhead", "compile",
                      "chaos"):
         # CPU-mesh microbenches: 8 virtual devices, never a TPU attempt
-        xla = (os.environ.get("XLA_FLAGS", "") +
-               " --xla_force_host_platform_device_count=8").strip()
         attempts = [
             ("cpu-mesh8", {"BIGDL_TPU_FORCE_CPU": "1", "XLA_FLAGS": xla},
              900),
@@ -1206,17 +1369,17 @@ def parent_main():
     elif os.environ.get("BIGDL_TPU_ASSUME_ALIVE") == "1":
         attempts = [
             ("tpu", {}, 900),
-            ("cpu-fallback", {"BIGDL_TPU_FORCE_CPU": "1"}, 450),
+            ("cpu-fallback", cpu_fb_env, 450),
         ]
     elif _tpu_alive():
         attempts = [
             ("tpu", {}, 900),
             ("tpu-retry", {}, 600),
-            ("cpu-fallback", {"BIGDL_TPU_FORCE_CPU": "1"}, 900),
+            ("cpu-fallback", cpu_fb_env, 900),
         ]
     else:
         attempts = [
-            ("cpu-fallback", {"BIGDL_TPU_FORCE_CPU": "1"}, 900),
+            ("cpu-fallback", cpu_fb_env, 900),
         ]
     errors = ([] if attempts[0][0] != "cpu-fallback"
               else ["tpu: liveness probe failed (chip tunnel down/wedged)"])

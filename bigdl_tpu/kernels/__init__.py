@@ -1,6 +1,14 @@
 """bigdl_tpu.kernels — Pallas TPU kernels for the ops where XLA's automatic
 fusion leaves throughput on the table (the analogue of the reference's
 hand-tuned BigDL-core native kernels, SURVEY.md §2.14; guide:
-/opt/skills/guides/pallas_guide.md)."""
+/opt/skills/guides/pallas_guide.md).
 
-from bigdl_tpu.kernels.flash_attention import flash_attention
+Block sizes are shape-keyed-autotunable (kernels/autotune.py,
+BIGDL_TPU_AUTOTUNE) with winners persisted next to the XLA compile
+cache; `python -m bigdl_tpu.kernels {tune,stats,clear}` manages the
+table. The fused optimizer update (kernels/fused_update.py) rides
+BIGDL_TPU_FUSED_UPDATE in the trainers."""
+
+from bigdl_tpu.kernels import autotune as autotune          # noqa: F401
+from bigdl_tpu.kernels import fused_update as fused_update  # noqa: F401
+from bigdl_tpu.kernels.flash_attention import flash_attention  # noqa: F401

@@ -21,6 +21,7 @@ online-softmax matmuls with no integer refs to tile.
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -180,17 +181,34 @@ def _dw(h, w, lse, g, block_n, block_v, v_total, interpret):
 
 # ------------------------------------------------------------- public API
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def cut_cross_entropy(h, w, labels, block_n: int = 128,
-                      block_v: int = 512, interpret: bool = False):
+def _cut_cross_entropy(h, w, labels, block_n, block_v, interpret):
+    """Block-size-resolved core (public wrapper: cut_cross_entropy)."""
+    loss, _ = _cce_fwd(h, w, labels, block_n, block_v, interpret)
+    return loss
+
+
+def cut_cross_entropy(h, w, labels, block_n: Optional[int] = None,
+                      block_v: Optional[int] = None,
+                      interpret: bool = False):
     """Per-row negative log-likelihood of `labels` under the logits
     `h @ w.T`, without ever materializing them.
 
     h (N, D) activations; w (V, D) head rows (tied embedding);
     labels (N,) int32. Returns (N,) fp32. N must divide block_n; V is
     padded internally; D rides whole in VMEM (keep D ≤ ~2048).
+    Block sizes left at None consult the shape-keyed autotune table
+    (BIGDL_TPU_AUTOTUNE, kernels/autotune.py), falling back to 128/512.
     `interpret=True` runs on CPU for tests."""
-    loss, _ = _cce_fwd(h, w, labels, block_n, block_v, interpret)
-    return loss
+    if block_n is None or block_v is None:
+        from bigdl_tpu.kernels import autotune
+        n, d = h.shape
+        cfg = autotune.lookup(
+            "cut_cross_entropy",
+            {"n": n, "d": d, "v": w.shape[0], "dtype": str(h.dtype)},
+            autotune._DEFAULTS["cut_cross_entropy"])
+        block_n = block_n if block_n is not None else cfg["block_n"]
+        block_v = block_v if block_v is not None else cfg["block_v"]
+    return _cut_cross_entropy(h, w, labels, block_n, block_v, interpret)
 
 
 def _cce_fwd(h, w, labels, block_n, block_v, interpret):
@@ -231,4 +249,4 @@ def _cce_fwd_vjp(h, w, labels, block_n, block_v, interpret):
     return _cce_fwd(h, w, labels, block_n, block_v, interpret)
 
 
-cut_cross_entropy.defvjp(_cce_fwd_vjp, _cce_bwd)
+_cut_cross_entropy.defvjp(_cce_fwd_vjp, _cce_bwd)

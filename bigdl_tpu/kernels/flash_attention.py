@@ -39,7 +39,7 @@ NEG_INF = -1e30
 
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
                block_q: int, block_k: int, seq_k: int, causal: bool,
-               scale: float, q_offset: int):
+               scale: float, q_offset: int, ragged_k: bool):
     kb = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -51,11 +51,15 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     qb = pl.program_id(1)
     # causal: K blocks entirely above the diagonal contribute nothing —
-    # skip their MXU work via predication (compute runs only `@pl.when`)
+    # skip their MXU work via predication (compute runs only `@pl.when`).
+    # Ragged K: blocks entirely inside the pad tail are skipped the same
+    # way (their every column would be masked below anyway).
     if causal:
         needed = kb * block_k <= q_offset + qb * block_q + block_q - 1
     else:
         needed = jnp.asarray(True)
+    if ragged_k:
+        needed = jnp.logical_and(needed, kb * block_k < seq_k)
 
     @pl.when(needed)
     def _compute():
@@ -65,14 +69,24 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
 
-        if causal:
-            q_pos = (q_offset + qb * block_q +
-                     jax.lax.broadcasted_iota(jnp.int32,
-                                              (block_q, block_k), 0))
+        if causal or ragged_k:
             k_pos = (kb * block_k +
                      jax.lax.broadcasted_iota(jnp.int32,
                                               (block_q, block_k), 1))
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            mask = None
+            if causal:
+                q_pos = (q_offset + qb * block_q +
+                         jax.lax.broadcasted_iota(jnp.int32,
+                                                  (block_q, block_k), 0))
+                mask = q_pos >= k_pos
+            if ragged_k:
+                # pad K rows (the single-variant valid-mask trick from
+                # the shape-bucketing work) contribute nothing: their
+                # logits go to -inf, so exp() gives exactly 0 weight
+                kmask = k_pos < seq_k
+                mask = kmask if mask is None else jnp.logical_and(mask,
+                                                                  kmask)
+            s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[:]                         # (block_q, 1)
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -91,27 +105,49 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
                     jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
 
 
+def _round_up(v: int, m: int) -> int:
+    return -(-v // m) * m
+
+
 def _flash_fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
                scale: Optional[float], interpret: bool):
     b, h, tq, d = q.shape
     tk = k.shape[2]
-    if tq % block_q or tk % block_k:
-        raise ValueError(f"Tq={tq} %% block_q={block_q} and Tk={tk} %% "
-                         f"block_k={block_k} must both be 0")
+    if d % 8 and not interpret:
+        # the ONE remaining hard error (ragged Tq/Tk pad instead): Mosaic
+        # cannot tile a head dim off the sublane grid. The interpreter
+        # has no such constraint, so CPU tests of tiny heads still run.
+        raise ValueError(
+            f"flash_attention head dim d={d} is not lane-aligned — it "
+            f"must be a multiple of 8 (ideally of 128) to tile into "
+            f"VMEM; pad the head dimension")
     if pltpu is None:
         raise RuntimeError(
             "jax.experimental.pallas.tpu is unavailable in this JAX build; "
             "use nn.attention.blockwise_attention instead")
+    # Ragged sequence lengths: pad q/k/v up to the block multiple and
+    # mask the K tail inside the kernel (the valid-mask trick from the
+    # shape-bucketing work) — callers never pre-pad. Pad q rows are
+    # garbage-in/garbage-out and sliced off the output.
+    pad_q = -tq % block_q
+    pad_k = -tk % block_k
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+    tq_p, tk_p = tq + pad_q, tk + pad_k
     sc = scale if scale is not None else 1.0 / math.sqrt(d)
     bh = b * h
-    qf = q.reshape(bh, tq, d)
-    kf = k.reshape(bh, tk, d)
-    vf = v.reshape(bh, tk, d)
-    grid = (bh, tq // block_q, tk // block_k)
+    qf = q.reshape(bh, tq_p, d)
+    kf = k.reshape(bh, tk_p, d)
+    vf = v.reshape(bh, tk_p, d)
+    grid = (bh, tq_p // block_q, tk_p // block_k)
 
     kernel = functools.partial(
         _fa_kernel, block_q=block_q, block_k=block_k, seq_k=tk,
-        causal=causal, scale=sc, q_offset=tk - tq)
+        causal=causal, scale=sc, q_offset=tk - tq,
+        ragged_k=bool(pad_k))
     scratch = [
         pltpu.VMEM((block_q, d), jnp.float32),    # acc
         pltpu.VMEM((block_q, 1), jnp.float32),    # running max
@@ -119,7 +155,7 @@ def _flash_fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
     ]
     out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, tq_p, d), q.dtype),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda s, i, j: (s, i, 0)),
@@ -130,50 +166,81 @@ def _flash_fwd(q, k, v, *, block_q: int, block_k: int, causal: bool,
         scratch_shapes=scratch,
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, tq, d)
+    return out.reshape(b, h, tq_p, d)[:, :, :tq]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def flash_attention(q, k, v, block_q: int = 128, block_k: int = 128,
+def _flash_attention(q, k, v, block_q, block_k, causal, scale, interpret):
+    """The block-size-resolved core (public wrapper: flash_attention).
+    Blocks are clamped to the 8-row-aligned sequence bound; ragged
+    lengths pad up to the block multiple inside `_flash_fwd`."""
+    bq = max(8, min(block_q, _round_up(q.shape[2], 8)))
+    bk = max(8, min(block_k, _round_up(k.shape[2], 8)))
+    return _flash_fwd(q, k, v, block_q=bq, block_k=bk, causal=causal,
+                      scale=scale, interpret=interpret)
+
+
+def flash_attention(q, k, v, block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     causal: bool = False, scale: Optional[float] = None,
                     interpret: bool = False):
     """Fused attention: q (B, H, Tq, d), k/v (B, H, Tk, d) → (B, H, Tq, d).
 
+    Sequence lengths need not divide the blocks (ragged tails are padded
+    and masked in-kernel); d must be 8-lane-aligned. Block sizes left at
+    None consult the shape-keyed autotune table (BIGDL_TPU_AUTOTUNE,
+    kernels/autotune.py) and fall back to 128/128.
     `interpret=True` runs the kernel in the Pallas interpreter (CPU tests).
     Numerics match `nn.attention.dot_product_attention` to fp32 tolerance."""
-    return _flash_fwd(q, k, v, block_q=min(block_q, q.shape[2]),
-                      block_k=min(block_k, k.shape[2]), causal=causal,
-                      scale=scale, interpret=interpret)
+    if block_q is None or block_k is None:
+        from bigdl_tpu.kernels import autotune
+        b, h, tq, d = q.shape
+        cfg = autotune.lookup(
+            "flash_attention",
+            {"b": b, "h": h, "tq": tq, "tk": k.shape[2], "d": d,
+             "causal": int(bool(causal)), "dtype": str(q.dtype)},
+            autotune._DEFAULTS["flash_attention"])
+        block_q = block_q if block_q is not None else cfg["block_q"]
+        block_k = block_k if block_k is not None else cfg["block_k"]
+    return _flash_attention(q, k, v, block_q, block_k, causal, scale,
+                            interpret)
 
 
 def _fwd(q, k, v, block_q, block_k, causal, scale, interpret):
-    out = flash_attention(q, k, v, block_q, block_k, causal, scale,
-                          interpret)
+    out = _flash_attention(q, k, v, block_q, block_k, causal, scale,
+                           interpret)
     return out, (q, k, v)
 
 
 def _bwd(block_q, block_k, causal, scale, interpret, res, g):
     q, k, v = res
     from bigdl_tpu.nn.attention import blockwise_attention
+    # blockwise_attention is numerically identical for ANY block size but
+    # needs one that divides Tk — ragged lengths take the largest divisor
+    tk = k.shape[2]
+    bs = min(block_k, tk)
+    while tk % bs:
+        bs -= 1
 
     def ref(q, k, v):
-        return blockwise_attention(
-            q, k, v, block_size=min(block_k, k.shape[2]), causal=causal,
-            scale=scale)
+        return blockwise_attention(q, k, v, block_size=bs, causal=causal,
+                                   scale=scale)
 
     _, vjp = jax.vjp(ref, q, k, v)
     return vjp(g)
 
 
-flash_attention.defvjp(_fwd, _bwd)
+_flash_attention.defvjp(_fwd, _bwd)
 
 
 class PallasFlashAttention:
     """Callable `attn_impl` backend for MultiHeadAttention:
     `MultiHeadAttention(d, h, attn_impl=PallasFlashAttention())`.
-    causal= only (like blockwise)."""
+    causal= only (like blockwise). Block sizes default to the autotune
+    table (or 128/128 when autotuning is off)."""
 
-    def __init__(self, block_q: int = 128, block_k: int = 128,
+    def __init__(self, block_q: Optional[int] = None,
+                 block_k: Optional[int] = None,
                  interpret: bool = False):
         self.block_q, self.block_k, self.interpret = \
             block_q, block_k, interpret

@@ -71,16 +71,27 @@ _LANE = 128
 
 
 def int8_matmul(xq, wq, x_scale, w_scale, *,
-                block_m: int = 256, block_n: int = 256, block_k: int = 256,
+                block_m: Optional[int] = None,
+                block_n: Optional[int] = None,
+                block_k: Optional[int] = None,
                 interpret: bool = False) -> jnp.ndarray:
     """(M, K) int8 @ (K, N) int8 → (M, N) fp32, dequantized by
     `x_scale` (M, 1) fp32 and `w_scale` (1, N) fp32.
 
     Shapes are padded up to hardware-tile-aligned block multiples
-    internally (zero padding is exact for the int32 accumulate)."""
+    internally (zero padding is exact for the int32 accumulate).
+    Block sizes left at None consult the shape-keyed autotune table
+    (BIGDL_TPU_AUTOTUNE, kernels/autotune.py), falling back to 256^3."""
     m, k = xq.shape
     k2, n = wq.shape
     assert k == k2, (xq.shape, wq.shape)
+    if block_m is None or block_n is None or block_k is None:
+        from bigdl_tpu.kernels import autotune
+        cfg = autotune.lookup("int8_matmul", {"m": m, "k": k, "n": n},
+                              autotune._DEFAULTS["int8_matmul"])
+        block_m = block_m if block_m is not None else cfg["block_m"]
+        block_n = block_n if block_n is not None else cfg["block_n"]
+        block_k = block_k if block_k is not None else cfg["block_k"]
 
     # tile-aligned blocks: never larger than requested, never smaller
     # than the hardware tile, and always a tile multiple

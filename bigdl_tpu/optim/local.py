@@ -240,10 +240,11 @@ class Optimizer:
         with fp32 master weights — the TPU-native form of the reference's
         FP16 wire compression (parameters/FP16CompressedTensor.scala)."""
         from bigdl_tpu.core.module import cast_floating
-        model, criterion, method = self.model, self.criterion, self.method
+        model, criterion = self.model, self.criterion
         processors = list(self.grad_processors)
         frozen = any(m._frozen for m in model.modules())
         exchange = self._grad_exchange_fn()
+        method_update = self._resolve_update_fn()
 
         def step(params, model_state, slots, x, y, lr, step_num, rng):
             def loss_fn(p):
@@ -267,7 +268,7 @@ class Optimizer:
             for proc in processors:
                 grads = proc(grads, params)
             if not frozen:
-                new_params, new_slots = method.update(params, grads, slots,
+                new_params, new_slots = method_update(params, grads, slots,
                                                       lr, step_num)
             else:
                 # Restore frozen leaves after the update so weight decay /
@@ -275,7 +276,7 @@ class Optimizer:
                 # every update rule).
                 tm = model.trainable_mask(params)
                 old_params = params
-                new_params, new_slots = method.update(params, grads, slots,
+                new_params, new_slots = method_update(params, grads, slots,
                                                       lr, step_num)
                 new_params = jax.tree.map(
                     lambda trainable, new, old: new if trainable is True
@@ -302,10 +303,11 @@ class Optimizer:
         Per-microbatch rng is `fold_in(rng, microbatch_index)` (dropout
         masks differ across microbatches)."""
         from bigdl_tpu.core.module import cast_floating
-        model, criterion, method = self.model, self.criterion, self.method
+        model, criterion = self.model, self.criterion
         processors = list(self.grad_processors)
         frozen = any(m._frozen for m in model.modules())
         exchange = self._grad_exchange_fn()
+        method_update = self._resolve_update_fn()
         M = accum_steps
 
         def step(params, model_state, slots, x, y, lr, step_num, rng):
@@ -360,12 +362,12 @@ class Optimizer:
             for proc in processors:
                 grads = proc(grads, params)
             if not frozen:
-                new_params, new_slots = method.update(params, grads, slots,
+                new_params, new_slots = method_update(params, grads, slots,
                                                       lr, step_num)
             else:
                 tm = model.trainable_mask(params)
                 old_params = params
-                new_params, new_slots = method.update(params, grads, slots,
+                new_params, new_slots = method_update(params, grads, slots,
                                                       lr, step_num)
                 new_params = jax.tree.map(
                     lambda trainable, new, old: new if trainable is True
@@ -445,6 +447,39 @@ class Optimizer:
 
         return bigdl_fused_train_step
 
+    def _resolve_update_fn(self) -> Callable:
+        """The optimizer-update callable captured at step-build time:
+        `method.update` (the tree-map oracle — bit-identical to every
+        pre-fused-kernel build), or the fused one-pass kernel
+        (kernels/fused_update.py) when BIGDL_TPU_FUSED_UPDATE=1 and the
+        method has a fused form (Adam/AdamW/SGD). An unsupported method
+        under the flag logs once and keeps the oracle — turning the
+        knob on can never change which methods train correctly."""
+        from bigdl_tpu.kernels import fused_update as _fu
+        mode = _fu.configured_mode()
+        if mode is None:
+            return self.method.update
+        opts = self._fused_update_opts()
+        if mode in ("flat", "leaf"):     # explicit layout override
+            opts["layout"] = mode
+        fn = _fu.make_update_fn(self.method, **opts)
+        if fn is None:
+            if not getattr(self, "_warned_fused_update", False):
+                self._warned_fused_update = True
+                log.warning(
+                    "BIGDL_TPU_FUSED_UPDATE=1 but %s has no fused kernel "
+                    "(supported: Adam/AdamW/SGD) — using the tree-map "
+                    "update", type(self.method).__name__)
+            return self.method.update
+        return fn
+
+    def _fused_update_opts(self) -> Dict:
+        """Layout options for the fused update — the local trainer lets
+        the kernel pick (flat+Pallas on TPU, leaf elsewhere);
+        DistriOptimizer overrides to preserve ZeRO-1/TP shardings
+        (parallel/distri.py)."""
+        return {"layout": "auto"}
+
     def _build_step(self) -> Callable:
         return jax.jit(self._make_step(), donate_argnums=(0, 1, 2))
 
@@ -462,10 +497,14 @@ class Optimizer:
         captures that can change between builds of one trainer instance.
         Model/criterion/mesh are fixed per instance; the optim method is
         handled by set_optim_method clearing the cache."""
+        from bigdl_tpu.kernels import fused_update as _fu
         return (kind, self.steps_per_call, self.accum_steps,
                 str(getattr(self, "compute_dtype", None)),
                 tuple(id(p) for p in self.grad_processors),
-                any(m._frozen for m in self.model.modules()))
+                any(m._frozen for m in self.model.modules()),
+                # env-read at build: a test/process flipping the knob
+                # between optimize() calls must not reuse a stale program
+                _fu.configured_mode())
 
     def _get_built(self, kind: str) -> _StepEntry:
         """Memoized build of the 'step' / 'fused' / 'eval_jit' program.

@@ -225,6 +225,19 @@ class DistriOptimizer(Optimizer):
             in_shardings=(p_sh, None, s_sh, None, None, rep, rep, rep, rep),
             out_shardings=(p_sh, None, s_sh, rep))
 
+    # ---------------------------------------------------- fused update
+    def _fused_update_opts(self):
+        """Layout for the fused optimizer update (BIGDL_TPU_FUSED_UPDATE,
+        kernels/fused_update.py) under this mesh: the flat whole-tree
+        concat is the fastest form, but concatenating ZeRO-1-sharded
+        slot leaves (or TP-sharded params) would make XLA re-gather
+        exactly the state the sharding distributed — those configs take
+        the leaf layout (same fused math, native dtype, per-leaf), which
+        composes with the partitioner's reduce-scatter + shard-local
+        update + all-gather unchanged."""
+        sharded = self.zero1 or bool(self.rules.rules)
+        return {"layout": "leaf" if sharded else "auto"}
+
     # --------------------------------------------------------- two-tier DP
     def _grad_exchange_fn(self):
         """The cross-slice gradient exchange seam (parallel/mesh.py):

@@ -182,6 +182,27 @@ _register("PRECOMPILE", False, _bool,
           "optimize(), compiling the step/eval programs from shape specs "
           "before the first batch arrives and logging XLA cost analysis "
           "(optim/local.py precompile; CLI --precompile)")
+_register("FUSED_UPDATE", "", str,
+          "Run the optimizer update (Adam/AdamW/SGD) through the fused "
+          "one-pass kernel (kernels/fused_update.py). '' / 0 (default) "
+          "= off: the tree-map OptimMethod.update path stays the oracle "
+          "and training is bit-identical. 1 = auto layout (flat blocks "
+          "+ donated buffers through Pallas on TPU; per-leaf fused math "
+          "elsewhere and on ZeRO-1/TP-sharded trees). 'flat' / 'leaf' "
+          "force a layout. Unsupported methods log once and keep the "
+          "tree-map path")
+_register("AUTOTUNE", False, _bool,
+          "Shape-keyed kernel autotuner (kernels/autotune.py): Pallas "
+          "call sites using default block sizes consult the persistent "
+          "table; a miss searches the block-size space once and records "
+          "the winner. Off = hard-coded defaults, bit-identical "
+          "behavior. CLI: python -m bigdl_tpu.kernels {tune,stats,clear}")
+_register("AUTOTUNE_CACHE", "", str,
+          "Autotune table root directory. '' derives "
+          "<BIGDL_TPU_COMPILE_CACHE>/autotune when the compile cache is "
+          "configured (the table lives next to the XLA cache, same "
+          "atomic-publish discipline), else the table is in-memory only "
+          "for this process")
 _register("BENCH_LOCK_FILE", "/tmp/bigdl_tpu_bench.lock", str,
           "Lockfile serializing bench.py against tools/tpu_watch.sh so "
           "the harness cannot pollute the CPU trend series (ADVICE r5 #5)")

@@ -85,10 +85,44 @@ def test_flash_gradients_match_dense():
                                    atol=3e-5, rtol=3e-5)
 
 
-def test_flash_block_divisibility_enforced():
-    q, k, v = _qkv(tq=60, tk=60)
-    with pytest.raises(ValueError, match="must both be 0"):
-        flash_attention(q, k, v, 32, 32, False, None, True)
+def test_flash_ragged_lengths_padded_and_masked():
+    """Tq/Tk that do NOT divide the blocks pad internally and mask the
+    K tail (the valid-mask trick) — callers never pre-pad."""
+    for tq, tk, causal in ((60, 60, False), (60, 60, True), (37, 91, False),
+                           (50, 77, True), (64, 60, False)):
+        q, k, v = _qkv(tq=tq, tk=tk)
+        out = flash_attention(q, k, v, 32, 32, causal, None, True)
+        mask = causal_mask(tk, tk)[..., tk - tq:, :] if causal else None
+        ref = dot_product_attention(q, k, v, mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5,
+                                   err_msg=f"tq={tq} tk={tk} causal={causal}")
+
+
+def test_flash_ragged_gradients_match_dense():
+    """The recompute-backward handles ragged Tk (largest-divisor block)."""
+    q, k, v = _qkv(tq=24, tk=33, d=16)
+
+    def f_flash(q, k, v):
+        return flash_attention(q, k, v, 16, 16, False, None, True).sum()
+
+    def f_ref(q, k, v):
+        return dot_product_attention(q, k, v).sum()
+
+    gf = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=3e-5, rtol=3e-5)
+
+
+def test_flash_lane_alignment_enforced():
+    """The ONE remaining hard error (compiled path only — the
+    interpreter has no tiling constraint): a head dim off the sublane
+    grid that Mosaic could not tile."""
+    q, k, v = _qkv(tq=32, tk=32, d=12)
+    with pytest.raises(ValueError, match="lane-aligned"):
+        flash_attention(q, k, v, 32, 32, False, None, False)
 
 
 def test_flash_as_mha_backend():
