@@ -47,6 +47,7 @@ _METRICS = {
     "overhead": ("observability_overhead_pct", "percent"),
     "compile": ("compile_cache_warm_startup_speedup", "ratio"),
     "chaos": ("slice_failover_budget_headroom", "ratio"),
+    "serve": ("serve_dynamic_batching_speedup", "ratio"),
 }
 
 # serialize against tools/tpu_watch.sh (ADVICE r5 #5). Env names + defaults
@@ -835,6 +836,171 @@ def _bench_compile():
     }
 
 
+# the serve bench's warm-start probe: executed in FRESH grandchild
+# processes sharing one persistent-cache root (in-memory jax caches must
+# not leak between cold and warm). Registers a model with the bucket-set
+# AOT precompile and serves one request per bucket; `fresh` counts XLA
+# compiles that were NOT persistent-cache deserializations — the warm
+# run's acceptance is fresh == 0 (every bucket an AOT cache hit).
+_SERVE_CHILD = r'''
+import json, sys
+from bigdl_tpu.utils.platform import force_cpu_if_requested
+force_cpu_if_requested()
+import numpy as np
+import jax
+import bigdl_tpu.nn as nn
+from bigdl_tpu import compilecache, observe
+from bigdl_tpu.parallel import create_mesh
+from bigdl_tpu.serve import ServeEngine
+
+root = sys.argv[1]
+observe.ensure_started()
+compilecache.enable(root)
+mesh = create_mesh(drop_trivial_axes=True)
+model = nn.Sequential(nn.Linear(16, 64), nn.Tanh(), nn.Linear(64, 8))
+params, state = model.init(jax.random.PRNGKey(0))
+r = np.random.RandomState(0)
+c0 = observe.counter("jit/compiles").value
+h0 = observe.counter("jit/cache_hit_compiles").value
+eng = ServeEngine()
+entry = eng.register("m", model, params, state, mesh=mesh, max_batch=64,
+                     precompile_input=((16,), "float32"))
+compiled = observe.counter("jit/compiles").value - c0
+served_c0 = observe.counter("jit/compiles").value
+for b in entry.buckets:
+    eng.predict("m", r.randn(max(1, b - 1), 16).astype(np.float32),
+                timeout=60)
+eng.shutdown()
+c1 = observe.counter("jit/compiles").value
+h1 = observe.counter("jit/cache_hit_compiles").value
+print(json.dumps({
+    "buckets": list(entry.buckets),
+    "precompile_compiles": compiled,
+    "serving_compiles": c1 - served_c0,
+    "compiles": c1 - c0,
+    "cache_hit_compiles": h1 - h0,
+    "fresh_compiles": (c1 - c0) - (h1 - h0),
+}))
+'''
+
+
+def _bench_serve(n_requests=600, feat=16, max_batch=64, queue_rows=256):
+    """Online-serving bench (ISSUE 8 acceptance): Poisson OPEN-LOOP load
+    against the ServeEngine on the 8-virtual-device CPU mesh — arrival
+    times are fixed up front (closed-form from one seeded exponential
+    stream), so a slow server cannot throttle its own offered load.
+
+    Modes share the model, the mesh, the request trace, and the offered
+    rate (calibrated to ~3x the measured batch-size-1 service rate, i.e.
+    the baseline is saturated):
+
+      * batch1  — coalescing off: every request dispatches alone
+                  (the pre-continuous-batching behavior);
+      * dynamic — continuous batching, 2 ms max-wait deadline.
+
+    Both run with the same bounded queue + Overloaded shedding, so the
+    saturated baseline sheds instead of queueing unboundedly; throughput
+    counts COMPLETED requests over the wall clock and p50/p99 come from
+    the per-model serve latency histograms. Acceptance: dynamic >= 2x
+    batch1 requests/sec at equal-or-better p99."""
+    import numpy as np
+    import jax
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.parallel import create_mesh
+    from bigdl_tpu.serve import Overloaded, ServeEngine
+
+    mesh = create_mesh(drop_trivial_axes=True)
+    model = nn.Sequential(nn.Linear(feat, 64), nn.Tanh(),
+                          nn.Linear(64, 8))
+    params, state = model.init(jax.random.PRNGKey(0))  # tpu-lint: disable=004
+    r = np.random.RandomState(0)
+    sizes = r.randint(1, 9, n_requests)
+    reqs = [r.randn(int(n), feat).astype(np.float32) for n in sizes]
+
+    # calibrate the batch-1 service rate: serial single-request dispatch
+    # through the real entry (padded smallest bucket, warm program)
+    cal = ServeEngine()
+    entry = cal.register("cal", model, params, state, mesh=mesh,
+                         max_batch=max_batch)
+    entry.precompile_for((feat,), "float32")
+    lo = entry.buckets[0]
+    probe = np.zeros((lo, feat), np.float32)
+    for _ in range(5):                      # warmup
+        entry.dispatch(probe, 1)
+    t0 = time.perf_counter()
+    n_cal = 40
+    for _ in range(n_cal):
+        entry.dispatch(probe, 1)
+    base_rate = n_cal / (time.perf_counter() - t0)
+    cal.shutdown()
+    offered = 3.0 * base_rate
+    arrivals = np.cumsum(
+        np.random.RandomState(1).exponential(1.0 / offered, n_requests))
+
+    def run_mode(tag, coalesce):
+        eng = ServeEngine()
+        e = eng.register(tag, model, params, state, mesh=mesh,
+                         max_batch=max_batch,
+                         max_wait_ms=2.0 if coalesce else 0.0,
+                         max_queue_rows=queue_rows, coalesce=coalesce)
+        e.precompile_for((feat,), "float32")
+        replies, shed = [], 0
+        t0 = time.perf_counter()
+        for i, q in enumerate(reqs):
+            now = time.perf_counter() - t0
+            if arrivals[i] > now:
+                time.sleep(arrivals[i] - now)
+            try:
+                rep = eng.submit(tag, q)
+            except Overloaded:
+                shed += 1
+                continue
+            replies.append(rep)
+        for rep in replies:
+            rep.result(timeout=300)
+        wall = time.perf_counter() - t0
+        st = eng.stats()
+        eng.shutdown()
+        return {
+            "completed": len(replies),
+            "shed": shed,
+            "wall_s": round(wall, 3),
+            "req_per_sec": round(len(replies) / wall, 1),
+            "p50_ms": st[tag]["p50_ms"],
+            "p99_ms": st[tag]["p99_ms"],
+        }
+
+    rows = {"batch1": run_mode("batch1", False),
+            "dynamic": run_mode("dynamic", True)}
+    rows["base_rate_req_per_sec"] = round(base_rate, 1)
+    rows["offered_req_per_sec"] = round(offered, 1)
+    rows["speedup"] = round(rows["dynamic"]["req_per_sec"]
+                            / max(rows["batch1"]["req_per_sec"], 1e-9), 2)
+    rows["p99_ok"] = bool(rows["dynamic"]["p99_ms"]
+                          <= rows["batch1"]["p99_ms"])
+
+    # warm-start probe: cold/warm grandchildren sharing one cache root
+    import shutil
+    import tempfile
+    root = tempfile.mkdtemp(prefix="bigdl_serve_bench_")
+    try:
+        for mode in ("cold", "warm"):
+            res = subprocess.run(
+                [sys.executable, "-c", _SERVE_CHILD, root],
+                capture_output=True, text=True, timeout=300,
+                env=dict(os.environ))
+            line = next((ln for ln in reversed(res.stdout.splitlines())
+                         if ln.startswith("{")), None)
+            if res.returncode != 0 or line is None:
+                rows[f"{mode}_start"] = {
+                    "error": (res.stderr or res.stdout)[-300:]}
+            else:
+                rows[f"{mode}_start"] = json.loads(line)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return rows
+
+
 def _bench_chaos(batch_size=32, hidden=128, iters=48, k=8):
     """Slice-failover chaos bench: DistriOptimizer on a 2 slices × 4
     devices CPU mesh, kill slice 1 mid-run via the `slice:1@step:N`
@@ -986,6 +1152,35 @@ def child_main():
                     "8-virtual-device CPU mesh; K=1 runs the pre-fusion "
                     "per-step dispatch path unchanged (bit-identical "
                     "program)",
+        }))
+        return
+    if which == "serve":
+        # CPU-mesh microbench (parent forces FORCE_CPU=1 + 8 virtual
+        # devices): what continuous batching buys over batch-size-1
+        # dispatch is host scheduling + program-count amortization,
+        # backend-agnostic plumbing
+        metric, unit = _METRICS[which]
+        rows = _bench_serve()
+        print(json.dumps({
+            "metric": metric,
+            "value": rows["speedup"],
+            "unit": unit,
+            "vs_baseline": 1.0,
+            "backend": backend,
+            "n_devices": len(jax.devices()),
+            **rows,
+            "host": _host_provenance(),
+            "note": "Poisson open-loop load (closed-form arrival times, "
+                    "offered = 3x the calibrated batch-1 service rate) "
+                    "against ServeEngine on the 8-virtual-device CPU "
+                    "mesh, mixed 1-8-row requests, bounded queue with "
+                    "Overloaded shedding in both modes; batch1 = "
+                    "coalescing off, dynamic = continuous batching with "
+                    "a 2ms max-wait deadline, both AOT-precompiled. "
+                    "Acceptance: speedup >= 2 with p99_ok (dynamic p99 "
+                    "<= batch1 p99) and warm_start.fresh_compiles == 0 "
+                    "(every bucket served from the persistent-cache-"
+                    "warmed AOT set)",
         }))
         return
     if which == "chaos":
@@ -1360,7 +1555,7 @@ def parent_main():
                   if which_arg == "kernels"
                   else {"BIGDL_TPU_FORCE_CPU": "1"})
     if which_arg in ("dispatch", "checkpoint", "overhead", "compile",
-                     "chaos"):
+                     "chaos", "serve"):
         # CPU-mesh microbenches: 8 virtual devices, never a TPU attempt
         attempts = [
             ("cpu-mesh8", {"BIGDL_TPU_FORCE_CPU": "1", "XLA_FLAGS": xla},

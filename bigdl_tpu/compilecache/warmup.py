@@ -75,6 +75,65 @@ def cost_summary(compiled) -> Dict[str, Optional[float]]:
     return out
 
 
+def precompile_buckets(jitted, params, state, feature_shape, dtype,
+                       buckets, *, name: str = "serve", mesh=None):
+    """AOT-lower one inference program per shape bucket — the serving
+    subsystem's warmup entry point (bigdl_tpu/serve/registry.py).
+
+    `jitted` is a `jax.jit` of `fn(params, state, x, valid)` where `x`
+    is `(bucket,) + feature_shape` and `valid` a `(bucket,)` bool mask;
+    every bucket in `buckets` is lowered + compiled from eval-shape
+    specs (zero device work), its XLA cost analysis logged under
+    `compile/<name>/bucket<B>/...`. With a mesh, the batch specs carry
+    the composed batch-axis sharding and params/state replicate — the
+    same pinning discipline as DistriOptimizer._annotate_aot_specs, so
+    the executables accept the live placed arrays.
+
+    Returns `(results, executables)`: per-bucket cost summaries and the
+    compiled executables keyed by bucket size, ready for dispatch."""
+    import time as _time
+    import jax
+    import numpy as np
+    from bigdl_tpu import compilecache
+    compilecache.ensure_enabled()
+
+    sh = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from bigdl_tpu.parallel.sharding import batch_spec
+        rep = NamedSharding(mesh, P())
+        sh = {"rep": rep,
+              "x": lambda nd: NamedSharding(mesh, batch_spec(mesh, nd))}
+
+    def spec(x, sharding=None):
+        s = sds_like(x)
+        if sharding is None:
+            return s
+        return jax.ShapeDtypeStruct(tuple(s.shape), s.dtype,
+                                    sharding=sharding)
+
+    p_s = jax.tree.map(lambda a: spec(a, sh and sh["rep"]), params)
+    s_s = jax.tree.map(lambda a: spec(a, sh and sh["rep"]), state)
+    dtype = np.dtype(dtype)
+    results: Dict[int, Dict] = {}
+    executables: Dict[int, object] = {}
+    for b in sorted(set(int(v) for v in buckets)):
+        x_s = jax.ShapeDtypeStruct((b,) + tuple(feature_shape), dtype,
+                                   **({"sharding": sh["x"](
+                                       1 + len(feature_shape))}
+                                      if sh else {}))
+        v_s = jax.ShapeDtypeStruct((b,), np.bool_,
+                                   **({"sharding": sh["x"](1)}
+                                      if sh else {}))
+        t0 = _time.perf_counter()
+        compiled = jitted.lower(p_s, s_s, x_s, v_s).compile()
+        executables[b] = compiled
+        results[b] = log_cost(f"{name}/bucket{b}", compiled,
+                              _time.perf_counter() - t0)
+    compilecache.sync()                 # publish what warmup compiled
+    return results, executables
+
+
 def log_cost(name: str, compiled, elapsed_s: float) -> Dict:
     """Record a precompiled program's cost analysis into the metrics
     registry (`compile/<name>/...` gauges) and the log."""

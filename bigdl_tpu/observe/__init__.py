@@ -20,6 +20,15 @@ dumps a trace per optimize(); BIGDL_TPU_METRICS_JSONL / _PROM / _TB
 attach exporters. The trainers call `ensure_started()` once per
 optimize() and `finish()` at the end — a disabled flight recorder costs
 one attribute check per span site.
+
+Span taxonomy (docs/observability.md): training spans (`train/*`,
+`data/*`, `checkpoint/*`, `jit/compile`), resilience markers
+(`fault/*`, `preempt/*`, `retry`), and — since the serving subsystem —
+the serve family: `serve/pack` and `serve/dispatch` spans around each
+continuous-batching dispatch, the `serve/drain` span on graceful
+shutdown, and the `serve/shed` instant for admission-control
+rejections, all riding the same flush cadence (ONE host fetch per
+dispatched batch, no per-request syncs — bigdl_tpu/serve/).
 """
 
 from __future__ import annotations

@@ -71,14 +71,21 @@ def _batched_predict(fn, params, state, xs: np.ndarray, bucket) -> np.ndarray:
 
 
 def _pad_to(x: np.ndarray, n: int):
-    """Pad batch dim to `n` rows (repeat-last) so every step reuses ONE
+    """Zero-pad the batch dim to `n` rows so every step reuses ONE
     compiled program — the analogue of the reference's per-partition batch
-    splitting (Predictor.scala:75-117), shaped for XLA instead of threads."""
+    splitting (Predictor.scala:75-117), shaped for XLA instead of threads.
+
+    Zeros, not repeat-last: replicated rows run real forward math and
+    skew any batch-coupled statistic, and a poisoned pad must never be
+    able to leak into the valid rows' outputs (the PR 5 valid-mask
+    discipline; tests/test_prediction_service.py asserts bit-identity
+    of the valid rows under pad-content poisoning)."""
     pad = n - x.shape[0]
     if pad == 0:
         return x
-    reps = np.repeat(x[-1:], pad, axis=0)
-    return np.concatenate([x, reps], axis=0)
+    out = np.zeros((n,) + x.shape[1:], x.dtype)
+    out[:x.shape[0]] = x
+    return out
 
 
 class Predictor:
@@ -155,34 +162,52 @@ class PredictionService:
     functions are reentrant so no queue is needed — `instance_num` is kept
     for API parity and ignored).
 
-    Pads each request up to the next power-of-two rows (capped at
-    `max_batch`) so the service compiles O(log max_batch) programs total,
-    whatever request sizes arrive."""
+    Since the `bigdl_tpu.serve` subsystem landed, this facade is a thin
+    shim over a private single-model `ServeEngine`: requests ride the
+    continuous-batching scheduler (greedy dispatch — a lone caller pays
+    no coalescing wait, concurrent callers coalesce into shared bucket
+    programs), padded up to the next power-of-two rows (× the mesh's
+    data-axis size, capped at `max_batch`) with a valid mask, so the
+    service still compiles O(log max_batch) programs total, whatever
+    request sizes arrive. Requests wider than `max_batch` are chunked;
+    empty requests are a client error."""
 
     def __init__(self, model: Module, params, state, *,
                  instance_num: int = 1, max_batch: int = 256, mesh=None):
         del instance_num
+        import weakref
+        from bigdl_tpu.serve.engine import ServeEngine
         self.model, self.params, self.state = model, params, state
-        self._min_bucket = 1
-        if mesh is not None:
-            from bigdl_tpu.parallel.mesh import (data_axis_size,
-                                                 round_up_to_data_multiple)
-            # buckets stay powers-of-two × data-axis size so every padded
-            # batch shards evenly and compile count stays O(log max_batch)
-            self._min_bucket = data_axis_size(mesh)
-            max_batch = round_up_to_data_multiple(max_batch, mesh)
-        self.max_batch = max_batch
-        self._fn = _jit_forward(model, mesh)
+        self._engine = ServeEngine()
+        self._entry = self._engine.register(
+            "default", model, params, state, mesh=mesh,
+            max_batch=max_batch, max_wait_ms=0.0)
+        self.max_batch = self._entry.max_batch
+        self._min_bucket = self._entry.buckets[0]
+        # the raw jitted forward: kept for the compile-count contract
+        # (tests probe _fn._cache_size() <= log2(max_batch)+1)
+        self._fn = self._entry._jitted
+        # a dropped service must not leak its scheduler thread; nothing
+        # can be in flight once unreachable, so a drain-less close is safe
+        self._finalizer = weakref.finalize(
+            self, ServeEngine.shutdown, self._engine, drain=False,
+            timeout=1.0)
 
     def _bucket(self, n: int) -> int:
-        b = self._min_bucket
-        while b < n and b * 2 <= self.max_batch:
-            b *= 2
-        return b if b >= n else self.max_batch
+        return self._entry.buckets[-1] if n > self.max_batch \
+            else self._engine._batchers["default"].bucket_for(n)
 
     def predict(self, request) -> np.ndarray:
         x = np.asarray(request)
         if x.ndim == 0:
             raise ValueError("request must be at least 1-D (batch of inputs)")
-        return _batched_predict(self._fn, self.params, self.state, x,
-                                bucket=self._bucket)
+        if x.shape[0] == 0:
+            raise ValueError(
+                "empty request (0 rows): a live prediction request must "
+                "carry at least one input row")
+        return np.asarray(self._engine.predict("default", x, timeout=120))
+
+    def close(self) -> None:
+        """Drain and stop the scheduler (idempotent; GC also reclaims)."""
+        self._finalizer.detach()
+        self._engine.shutdown(drain=True)

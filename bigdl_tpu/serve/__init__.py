@@ -1,0 +1,41 @@
+"""bigdl_tpu.serve — online inference: continuous batching over AOT
+shape buckets.
+
+The training stack's serving counterpart (reference surface:
+`Predictor`, `PredictionService.scala:56-66`, dlframes — SURVEY L5/L6).
+Batch predict already exists (`optim/predictor.py`); this package
+handles LIVE traffic:
+
+  * **batcher**  — bounded FIFO request queue + scheduler thread packing
+                   concurrent requests into the smallest precompiled
+                   shape bucket (continuous/dynamic batching), with a
+                   `max_wait_ms` deadline trading batch fullness against
+                   latency, typed `Overloaded` admission control, and
+                   graceful drain (no lost futures);
+  * **registry** — named models, each with its own params/mesh/dtype,
+                   a zero-pad + valid-mask forward (pad content can
+                   never leak), optional int8 via BIGDL_TPU_SERVE_INT8,
+                   and per-bucket AOT executables
+                   (compilecache.precompile_buckets) so a warm server
+                   compiles zero fresh programs;
+  * **engine**   — the facade: submit/predict, oversized-request
+                   chunking, per-model p50/p99 latency + queue-depth +
+                   batch-fill SLO metrics through the observe registry,
+                   SIGTERM drain riding the resilience handler;
+  * **CLI**      — `python -m bigdl_tpu.serve <factory> --input SHAPE`
+                   (line-JSON requests on stdin; `--smoke` self-drives).
+
+Knobs: BIGDL_TPU_SERVE_MAX_BATCH / _MAX_WAIT_MS / _MAX_QUEUE_ROWS /
+_INT8 (utils/config.py). Docs: docs/serving.md.
+"""
+
+from bigdl_tpu.serve.batcher import (Closed, ContinuousBatcher, Overloaded)
+from bigdl_tpu.serve.engine import Reply, ServeEngine
+from bigdl_tpu.serve.registry import (ModelEntry, ModelRegistry,
+                                      serve_buckets)
+
+__all__ = [
+    "ServeEngine", "Reply",
+    "ContinuousBatcher", "Overloaded", "Closed",
+    "ModelRegistry", "ModelEntry", "serve_buckets",
+]

@@ -1,0 +1,187 @@
+"""Serving CLI: stand up a ServeEngine around a model factory.
+
+    python -m bigdl_tpu.serve bigdl_tpu.models.lenet:build \
+        --input 28,28,1 --smoke
+
+The factory is `module.path:callable` (the analysis/kernels CLI
+convention) — called with no arguments it must return a `Module`.
+`--input` is the PER-ROW feature shape (no batch dim), with an optional
+`:dtype` suffix (`--input 16:int32`).
+
+Modes:
+  * default — line protocol on stdin: each line is a JSON array of
+    input rows (one request); the reply rows are printed as one JSON
+    array per line. EOF drains and exits. A transportless serving
+    surface: pipe a socket relay (socat) in front for the network.
+  * --smoke — self-drive: T client threads submit R mixed-size
+    requests, then ONE JSON summary line (requests, batches, mean
+    batch fill, p50/p99 ms, shed count) is printed. Exit 0 on a clean
+    drain with every request answered — the tier-1 CI probe.
+
+`--precompile` AOT-compiles every shape bucket before traffic (warm
+compile cache => zero fresh programs). `--int8` serves the quantized
+forward. Knob defaults: BIGDL_TPU_SERVE_* (docs/configuration.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import sys
+import threading
+from typing import Optional, Sequence
+
+
+def _parse_input(spec: str):
+    import numpy as np
+    dtype = "float32"
+    if ":" in spec:
+        spec, dtype = spec.rsplit(":", 1)
+    shape = tuple(int(s) for s in spec.split(",") if s != "")
+    return shape, np.dtype(dtype)
+
+
+def _load_factory(ref: str):
+    if ":" not in ref:
+        raise SystemExit(f"factory must be 'module.path:callable', got "
+                         f"'{ref}'")
+    mod_name, attr = ref.split(":", 1)
+    obj = getattr(importlib.import_module(mod_name), attr)
+    model = obj() if callable(obj) and not hasattr(obj, "apply") else obj
+    if not hasattr(model, "apply"):
+        raise SystemExit(f"{ref} did not produce a Module (got "
+                         f"{type(model).__name__})")
+    return model
+
+
+def _smoke(engine, name: str, feature_shape, dtype, *, threads: int,
+           requests: int, seed: int) -> dict:
+    """Self-drive: mixed-size requests from concurrent clients, checked
+    row-for-row against a direct forward of the same padded program."""
+    import numpy as np
+    r = np.random.RandomState(seed)
+    entry = engine.registry.get(name)
+    cap = min(entry.max_batch, 16)
+    reqs = [[_rand(r, feature_shape, dtype, int(r.randint(1, cap + 1)))
+             for _ in range(requests)] for _ in range(threads)]
+    errors: list = []
+    ok = [0]
+
+    def client(ti):
+        try:
+            for q in reqs[ti]:
+                out = engine.predict(name, q, timeout=60)
+                assert out.shape[0] == q.shape[0], (out.shape, q.shape)
+                ok[0] += 1
+        except Exception as exc:           # noqa: BLE001 — reported in JSON
+            errors.append(f"client {ti}: {exc!r}")
+
+    ts = [threading.Thread(target=client, args=(ti,))
+          for ti in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    stats = engine.stats()
+    return {
+        "mode": "smoke",
+        "model": name,
+        "clients": threads,
+        "requests_sent": threads * requests,
+        "requests_ok": ok[0],
+        "errors": errors[:5],
+        "buckets": stats[name]["buckets"],
+        "p50_ms": stats[name]["p50_ms"],
+        "p99_ms": stats[name]["p99_ms"],
+        "batches": stats["_totals"]["batches"],
+        "rows": stats["_totals"]["rows"],
+        "shed": stats["_totals"]["shed"],
+        "mean_batch_fill": stats["_totals"]["mean_batch_fill"],
+    }
+
+
+def _rand(r, feature_shape, dtype, n: int):
+    import numpy as np
+    if np.issubdtype(dtype, np.integer):
+        return r.randint(0, 8, (n,) + feature_shape).astype(dtype)
+    return r.randn(n, *feature_shape).astype(dtype)
+
+
+def _stdin_loop(engine, name: str, dtype) -> int:
+    import numpy as np
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        x = np.asarray(json.loads(line), dtype=dtype)
+        out = engine.predict(name, x, timeout=60)
+        print(json.dumps(np.asarray(out).tolist()))
+        sys.stdout.flush()
+    return 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m bigdl_tpu.serve",
+        description="Online inference engine around a model factory "
+                    "(docs/serving.md)")
+    ap.add_argument("factory", help="model factory as 'pkg.module:callable'")
+    ap.add_argument("--input", required=True, metavar="SHAPE[:DTYPE]",
+                    help="per-row feature shape, e.g. 28,28,1 or 16:int32")
+    ap.add_argument("--name", default="default", help="registry model name")
+    ap.add_argument("--max-batch", type=int, default=None)
+    ap.add_argument("--max-wait-ms", type=float, default=None)
+    ap.add_argument("--max-queue-rows", type=int, default=None)
+    ap.add_argument("--int8", action="store_true",
+                    help="serve the int8-quantized forward")
+    ap.add_argument("--mesh", action="store_true",
+                    help="dispatch under the global device mesh "
+                         "(sharded batch inference)")
+    ap.add_argument("--precompile", action="store_true",
+                    help="AOT-compile every shape bucket before traffic")
+    ap.add_argument("--smoke", action="store_true",
+                    help="self-drive concurrent clients, print one JSON "
+                         "summary, exit (CI probe)")
+    ap.add_argument("--smoke-threads", type=int, default=4)
+    ap.add_argument("--smoke-requests", type=int, default=8,
+                    help="requests per smoke client thread")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    from bigdl_tpu.utils.platform import force_cpu_if_requested
+    force_cpu_if_requested()
+    import jax
+    from bigdl_tpu.serve.engine import ServeEngine
+
+    feature_shape, dtype = _parse_input(args.input)
+    model = _load_factory(args.factory)
+    params, state = model.init(
+        jax.random.PRNGKey(args.seed))  # tpu-lint: disable=004
+    mesh = None
+    if args.mesh:
+        from bigdl_tpu.parallel.mesh import create_mesh
+        mesh = create_mesh(drop_trivial_axes=True)
+
+    engine = ServeEngine(install_sigterm=not args.smoke)
+    try:
+        engine.register(
+            args.name, model, params, state, mesh=mesh,
+            max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+            max_queue_rows=args.max_queue_rows,
+            int8=True if args.int8 else None,
+            precompile_input=((feature_shape, dtype)
+                              if args.precompile else None))
+        if args.smoke:
+            rec = _smoke(engine, args.name, feature_shape, dtype,
+                         threads=args.smoke_threads,
+                         requests=args.smoke_requests, seed=args.seed)
+            print(json.dumps(rec))
+            return 1 if rec["errors"] else 0
+        return _stdin_loop(engine, args.name, dtype)
+    finally:
+        engine.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,339 @@
+"""Continuous/dynamic batching scheduler — the serving subsystem's core.
+
+Concurrently arriving requests land in a bounded FIFO queue; one
+scheduler thread drains it by packing waiting requests into the smallest
+AOT-precompiled shape bucket that fits, dispatching ONE forward for the
+whole pack, and completing each request's future with exactly its own
+rows. The reference's analogue is `PredictionService.scala:56-66`'s
+BlockingQueue of model instances — there the queue multiplexes mutable
+model copies across threads; here the model is a pure function and the
+queue exists to SHAPE TRAFFIC: many small requests become one
+padded-bucket program dispatch.
+
+Scheduling policy (work-conserving, deadline-bounded):
+
+  * a full bucket's worth of rows is waiting  -> dispatch now;
+  * the oldest request has waited `max_wait_ms` -> dispatch now (the
+    batch-fullness vs latency knob: 0 = greedy, dispatch whatever is
+    queued the moment the scheduler is free);
+  * otherwise sleep until the oldest request's deadline.
+
+Admission control: `submit` raises the typed `Overloaded` when accepting
+the request would push queued rows past `max_queue_rows` — load is shed
+at the door with an error the client can retry on, instead of queueing
+into latency collapse. `Closed` is the post-shutdown/drain rejection.
+
+Determinism for tests: the scheduler's decisions are factored into
+side-effect-light methods (`bucket_for`, `_wait_s`, `_take`,
+`_run_batch`) driven by an injectable `clock`, so the fake-clock tests
+in tests/test_serve.py step the policy synchronously without threads;
+the thread loop only composes them.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu import observe
+
+log = logging.getLogger("bigdl_tpu")
+
+# serve/latency_ms histogram bounds: 0.001 ms .. ~134 s in ×2 buckets
+LATENCY_MS_BOUNDS = tuple(1e-3 * 2 ** i for i in range(28))
+# serve/batch_fill is a 0..1 ratio: linear 1/16 buckets resolve it
+BATCH_FILL_BOUNDS = tuple((i + 1) / 16 for i in range(16))
+
+
+class Overloaded(RuntimeError):
+    """Admission-control rejection: the request queue is at its bound.
+
+    Raised by `submit` BEFORE the request is queued — the client sees a
+    typed, immediately-retryable error instead of a timeout, and the
+    requests already queued keep their latency budget (docs/serving.md
+    "SLO machinery")."""
+
+
+class Closed(RuntimeError):
+    """The batcher is shut down (or draining) and accepts no new work."""
+
+
+class _Request:
+    __slots__ = ("x", "n", "sig", "future", "t_submit")
+
+    def __init__(self, x: np.ndarray, t_submit: float):
+        self.x = x
+        self.n = x.shape[0]
+        self.sig = (x.shape[1:], x.dtype.str)
+        self.future: Future = Future()
+        self.t_submit = t_submit
+
+
+class ContinuousBatcher:
+    """One model's request queue + scheduler.
+
+    `dispatch(xs_padded, n_valid)` is the only downstream contract: a
+    host array whose leading dim is a bucket size, of which the first
+    `n_valid` rows are real (the tail is zero padding), returning the
+    host outputs for all rows. The engine supplies it (registry.py
+    `ModelEntry.dispatch` — valid-mask forward + ONE result fetch).
+    """
+
+    def __init__(self, dispatch: Callable[[np.ndarray, int], np.ndarray],
+                 buckets: Sequence[int], *,
+                 max_wait_ms: float = 0.0,
+                 max_queue_rows: int = 4096,
+                 coalesce: bool = True,
+                 name: str = "default",
+                 clock: Callable[[], float] = time.monotonic,
+                 start: bool = True):
+        if not buckets:
+            raise ValueError("need at least one shape bucket")
+        self._dispatch = dispatch
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        self.max_wait_ms = float(max_wait_ms)
+        self.max_queue_rows = int(max_queue_rows)
+        self.coalesce = coalesce
+        self.name = name
+        self._clock = clock
+        self._cv = threading.Condition()
+        self._pending: deque = deque()
+        self._rows = 0
+        self._inflight = 0
+        self._closed = False          # accepts no submits, loop exiting
+        self._draining = False        # accepts no submits, queue drains
+        self._thread: Optional[threading.Thread] = None
+        self._stop_check: Optional[Callable[[], bool]] = None
+        self._lat = observe.histogram(f"serve/{name}/latency_ms",
+                                      LATENCY_MS_BOUNDS)
+        self._lat_all = observe.histogram("serve/latency_ms",
+                                          LATENCY_MS_BOUNDS)
+        self._fill = observe.histogram("serve/batch_fill",
+                                       BATCH_FILL_BOUNDS)
+        self._depth = observe.gauge("serve/queue_depth")
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------ admission
+    def submit(self, x: np.ndarray) -> Future:
+        """Queue one request (rows along dim 0) and return its future.
+        Raises `Overloaded` (queue bound) or `Closed` (shut down); a
+        request wider than the largest bucket is the ENGINE's job to
+        chunk — by this layer it is a caller bug."""
+        x = np.asarray(x)
+        if x.ndim == 0 or x.shape[0] == 0:
+            raise ValueError("request must have at least one row")
+        if x.shape[0] > self.buckets[-1]:
+            raise ValueError(
+                f"request of {x.shape[0]} rows exceeds the largest bucket "
+                f"{self.buckets[-1]} (the engine chunks oversized requests)")
+        req = _Request(x, self._clock())
+        with self._cv:
+            if self._closed or self._draining:
+                raise Closed(f"batcher {self.name!r} is shut down")
+            if self._rows + req.n > self.max_queue_rows:
+                observe.counter("serve/shed").inc()
+                observe.instant("serve/shed", cat="serve",
+                                args={"model": self.name,
+                                      "queued_rows": self._rows})
+                raise Overloaded(
+                    f"serving queue for {self.name!r} at bound: "
+                    f"{self._rows} rows queued + {req.n} requested > "
+                    f"{self.max_queue_rows}")
+            self._pending.append(req)
+            self._rows += req.n
+            self._depth.set(self._rows)
+            observe.counter("serve/requests").inc()
+            observe.counter("serve/rows").inc(req.n)
+            self._cv.notify()
+        return req.future
+
+    @property
+    def queued_rows(self) -> int:
+        return self._rows
+
+    # --------------------------------------------------- scheduling policy
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket >= n (n above every bucket takes the largest —
+        unreachable through submit, kept total for direct callers)."""
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def _head_group(self) -> List[_Request]:
+        """The dispatchable prefix: consecutive head requests sharing the
+        head's (feature-shape, dtype) signature, as many whole requests
+        as fit the largest bucket. FIFO is preserved per signature, and a
+        mixed-signature queue simply takes another cycle."""
+        group: List[_Request] = []
+        rows = 0
+        for req in self._pending:
+            if group and req.sig != group[0].sig:
+                break
+            if rows + req.n > self.buckets[-1]:
+                break
+            group.append(req)
+            rows += req.n
+        return group
+
+    def _wait_s(self, now: float) -> float:
+        """Seconds the scheduler should keep waiting before dispatching
+        the head group; <= 0 means dispatch now. Callers hold the lock.
+        An empty queue returns +inf (block on the condition instead)."""
+        if not self._pending:
+            return float("inf")
+        if self._draining or self._closed:
+            return 0.0
+        group = self._head_group()
+        rows = sum(r.n for r in group)
+        if rows >= self.buckets[-1] or not self.coalesce:
+            return 0.0
+        if self.max_wait_ms <= 0.0:
+            return 0.0
+        deadline = group[0].t_submit + self.max_wait_ms * 1e-3
+        return deadline - now
+
+    def _take(self) -> List[_Request]:
+        """Pop the head group off the queue. Callers hold the lock.
+        With coalescing disabled (the batch-size-1 baseline the bench
+        compares against) exactly one request is taken per dispatch."""
+        group = self._head_group()
+        if not self.coalesce and group:
+            group = group[:1]
+        for req in group:
+            self._pending.popleft()
+            self._rows -= req.n
+        self._inflight += len(group)
+        self._depth.set(self._rows)
+        return group
+
+    # ------------------------------------------------------------ dispatch
+    def _run_batch(self, group: List[_Request]) -> None:
+        """Pack a group into its bucket, dispatch once, complete every
+        future with exactly its own rows (zero pad never reaches a
+        client). An infra failure fails the whole group's futures — no
+        request is ever silently lost."""
+        if not group:
+            return
+        rows = sum(r.n for r in group)
+        bucket = self.bucket_for(rows)
+        try:
+            with observe.span("serve/pack", cat="serve",
+                              args={"model": self.name}):
+                xs = np.zeros((bucket,) + group[0].sig[0],
+                              dtype=np.dtype(group[0].sig[1]))
+                i = 0
+                for req in group:
+                    xs[i:i + req.n] = req.x
+                    i += req.n
+            with observe.span("serve/dispatch", cat="serve",
+                              args={"model": self.name, "bucket": bucket,
+                                    "rows": rows, "requests": len(group)}):
+                out = self._dispatch(xs, rows)
+        except BaseException as exc:  # noqa: BLE001 — routed to callers
+            for req in group:
+                if not req.future.cancelled():
+                    req.future.set_exception(exc)
+            return
+        observe.counter("serve/batches").inc()
+        self._fill.record(rows / bucket)
+        now = self._clock()
+        i = 0
+        for req in group:
+            if not req.future.cancelled():
+                req.future.set_result(out[i:i + req.n])
+            i += req.n
+            lat_ms = (now - req.t_submit) * 1e3
+            self._lat.record(lat_ms)
+            self._lat_all.record(lat_ms)
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self, stop_check: Optional[Callable[[], bool]] = None
+              ) -> "ContinuousBatcher":
+        """Launch the scheduler thread. `stop_check` is polled between
+        dispatches (the engine wires `faults.preempt_requested` here, so
+        SIGTERM drains every queue and stops accepting — the serving
+        mirror of the trainers' K-boundary preemption probe)."""
+        if self._thread is not None:
+            return self
+        self._stop_check = stop_check
+        self._thread = threading.Thread(
+            target=self._loop, name=f"serve-{self.name}", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while True:
+            group: List[_Request] = []
+            with self._cv:
+                while True:
+                    if self._stop_check is not None and not self._draining \
+                            and not self._closed and self._stop_check():
+                        log.warning("serve[%s]: stop requested — draining "
+                                    "%d queued rows", self.name, self._rows)
+                        observe.instant("serve/drain", cat="serve",
+                                        args={"model": self.name})
+                        self._draining = True
+                    if self._pending:
+                        w = self._wait_s(self._clock())
+                        if w <= 0:
+                            group = self._take()
+                            break
+                        self._cv.wait(timeout=min(w, 0.05))
+                    else:
+                        if self._closed or self._draining:
+                            self._closed = True
+                            return
+                        self._cv.wait(timeout=0.05)
+            try:
+                self._run_batch(group)
+            finally:
+                with self._cv:
+                    self._inflight -= len(group)
+                    self._cv.notify_all()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Stop accepting new requests and wait until every queued one
+        has completed (no lost futures). Returns False on timeout."""
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._cv:
+                if not self._pending and self._inflight == 0:
+                    return True
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(0.002)
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = 30.0) -> None:
+        """Shut down: `drain=True` completes everything queued first;
+        `drain=False` fails queued futures with `Closed` — either way no
+        future is left forever pending."""
+        if drain:
+            self.drain(timeout=timeout)
+        with self._cv:
+            self._draining = True
+            self._closed = True
+            dropped = list(self._pending)
+            self._pending.clear()
+            self._rows = 0
+            self._depth.set(0)
+            self._cv.notify_all()
+        for req in dropped:
+            if not req.future.done():
+                req.future.set_exception(
+                    Closed(f"batcher {self.name!r} closed before dispatch"))
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)
+        self._thread = None

@@ -1,0 +1,229 @@
+"""ServeEngine — the online inference server.
+
+Ties the pieces together: a `ModelRegistry` of named models
+(registry.py), one `ContinuousBatcher` per model (batcher.py), SLO
+accounting through the observe registry, and graceful drain riding the
+resilience SIGTERM handler. The reference's live-inference surface is
+`Predictor`/`PredictionService` (SURVEY L5/L6); this is that surface
+grown into a traffic-shaped server: bounded queues, dynamic batching
+over AOT shape buckets, admission control, and per-model latency SLOs.
+
+    engine = ServeEngine()
+    engine.register("mnist", model, params, state, mesh=mesh)
+    fut = engine.submit("mnist", batch_of_rows)   # -> Future-like
+    out = engine.predict("mnist", rows)           # sync sugar
+    engine.stats()["mnist"]["p99_ms"]             # SLO view
+    engine.shutdown()                             # drains every queue
+
+Request lifecycle: `submit` validates (empty requests are a client
+error), CHUNKS oversized requests into <= max_batch pieces (each rides
+the queue as its own unit, so one huge request cannot monopolize a
+bucket), and returns a reply whose `.result()` reassembles the rows.
+Admission control raises the typed `Overloaded` before queueing.
+
+Shutdown: `shutdown()` — or SIGTERM, via the same
+`resilience.faults.install_sigterm_handler` path the trainers use —
+stops admission (submit raises `Closed`), drains every queued request
+to completion, and joins the scheduler threads: no future is ever lost.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from bigdl_tpu import observe
+from bigdl_tpu.serve.batcher import Closed, ContinuousBatcher, Overloaded
+from bigdl_tpu.serve.registry import ModelEntry, ModelRegistry
+
+log = logging.getLogger("bigdl_tpu")
+
+__all__ = ["ServeEngine", "Reply", "Overloaded", "Closed"]
+
+
+class Reply:
+    """Handle for one submitted request (possibly chunked across several
+    queue units). `.result(timeout)` blocks and reassembles the rows in
+    submission order; chunk failures re-raise."""
+
+    __slots__ = ("_futures",)
+
+    def __init__(self, futures: List):
+        self._futures = futures
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        outs = [f.result(timeout) for f in self._futures]
+        return outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
+
+    def done(self) -> bool:
+        return all(f.done() for f in self._futures)
+
+
+class ServeEngine:
+    """Registry + per-model continuous batchers behind one facade."""
+
+    def __init__(self, *, install_sigterm: bool = False):
+        from bigdl_tpu.utils import config
+        observe.ensure_started()
+        self.registry = ModelRegistry()
+        self._batchers: Dict[str, ContinuousBatcher] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+        self._defaults = {
+            "max_batch": config.get("SERVE_MAX_BATCH"),
+            "max_wait_ms": config.get("SERVE_MAX_WAIT_MS"),
+            "max_queue_rows": config.get("SERVE_MAX_QUEUE_ROWS"),
+        }
+        if install_sigterm:
+            # the trainers' preemption path doubles as the server's
+            # graceful-drain signal: SIGTERM -> preempt_requested() ->
+            # every batcher drains and stops accepting
+            from bigdl_tpu.resilience import faults
+            faults.install_sigterm_handler()
+
+    # ----------------------------------------------------------- registry
+    def register(self, name: str, model, params, state, *, mesh=None,
+                 max_batch: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
+                 max_queue_rows: Optional[int] = None,
+                 int8: Optional[bool] = None,
+                 coalesce: bool = True,
+                 precompile_input=None) -> ModelEntry:
+        """Register a model and start its scheduler. `precompile_input`
+        = (feature_shape, dtype) AOT-compiles every bucket up front."""
+        if self._closed:
+            raise Closed("engine is shut down")
+        d = self._defaults
+        entry = self.registry.register(
+            name, model, params, state, mesh=mesh,
+            max_batch=max_batch if max_batch is not None
+            else d["max_batch"], int8=int8)
+        if precompile_input is not None:
+            shape, dtype = precompile_input
+            entry.precompile_for(tuple(shape), dtype)
+        from bigdl_tpu.resilience import faults
+        batcher = ContinuousBatcher(
+            entry.dispatch, entry.buckets, name=name, coalesce=coalesce,
+            max_wait_ms=max_wait_ms if max_wait_ms is not None
+            else d["max_wait_ms"],
+            max_queue_rows=max_queue_rows if max_queue_rows is not None
+            else d["max_queue_rows"],
+            start=False)
+        batcher.start(stop_check=faults.preempt_requested)
+        with self._lock:
+            self._batchers[name] = batcher
+        log.info("serve: model %r registered (buckets %s, int8=%s)",
+                 name, entry.buckets, entry.int8)
+        return entry
+
+    def unregister(self, name: str, drain: bool = True) -> None:
+        with self._lock:
+            batcher = self._batchers.pop(name, None)
+        if batcher is not None:
+            batcher.close(drain=drain)
+        self.registry.unregister(name)
+
+    def models(self) -> List[str]:
+        return self.registry.names()
+
+    # ------------------------------------------------------------ serving
+    def submit(self, name: str, x) -> Reply:
+        """Queue a request for model `name`; returns a `Reply`. Raises
+        ValueError (empty/scalar request), `Overloaded` (queue at
+        bound — nothing partially queued), or `Closed` (shut down).
+        Requests wider than the model's max_batch are chunked."""
+        x = np.asarray(x)
+        if x.ndim == 0:
+            raise ValueError("request must be at least 1-D "
+                             "(a batch of input rows)")
+        if x.shape[0] == 0:
+            raise ValueError("empty request: a serving request must "
+                             "carry at least one row")
+        with self._lock:
+            batcher = self._batchers.get(name)
+        if batcher is None:
+            raise KeyError(f"no model {name!r} registered")
+        cap = batcher.buckets[-1]
+        if x.shape[0] <= cap:
+            return Reply([batcher.submit(x)])
+        # oversized: all-or-nothing admission, then chunk FIFO —
+        # contiguous submits under the batcher lock keep the chunks
+        # adjacent so they pack into full buckets
+        if x.shape[0] > batcher.max_queue_rows:
+            observe.counter("serve/shed").inc()
+            raise Overloaded(
+                f"request of {x.shape[0]} rows exceeds the queue bound "
+                f"{batcher.max_queue_rows} for model {name!r}")
+        futures = []
+        try:
+            for i in range(0, x.shape[0], cap):
+                futures.append(batcher.submit(x[i:i + cap]))
+        except (Overloaded, Closed):
+            for f in futures:
+                f.cancel()
+            raise
+        return Reply(futures)
+
+    def predict(self, name: str, x,
+                timeout: Optional[float] = None) -> np.ndarray:
+        """Synchronous request: submit + wait + reassemble."""
+        return self.submit(name, x).result(timeout)
+
+    # ---------------------------------------------------------------- SLO
+    def stats(self) -> Dict[str, Dict]:
+        """Per-model SLO snapshot: p50/p99 latency (ms), request/batch
+        counts, mean batch fill, queued rows — read from the observe
+        registry (the same numbers the exporters flush)."""
+        from bigdl_tpu.serve.batcher import LATENCY_MS_BOUNDS
+        reg = observe.registry()
+        out: Dict[str, Dict] = {}
+        fill = reg.histogram("serve/batch_fill")
+        with self._lock:
+            batchers = dict(self._batchers)
+        for name, b in batchers.items():
+            lat = reg.histogram(f"serve/{name}/latency_ms",
+                                LATENCY_MS_BOUNDS)
+            out[name] = {
+                "requests": lat.count,
+                "p50_ms": round(lat.quantile(0.50), 3),
+                "p99_ms": round(lat.quantile(0.99), 3),
+                "queued_rows": b.queued_rows,
+                "buckets": list(b.buckets),
+            }
+        out["_totals"] = {
+            "requests": reg.counter("serve/requests").value,
+            "rows": reg.counter("serve/rows").value,
+            "batches": reg.counter("serve/batches").value,
+            "shed": reg.counter("serve/shed").value,
+            "mean_batch_fill": round(fill.sum / fill.count, 4)
+            if fill.count else 0.0,
+        }
+        return out
+
+    # ----------------------------------------------------------- shutdown
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = 30.0) -> None:
+        """Stop admission and close every batcher. `drain=True` (the
+        SIGTERM path) completes everything queued first; `drain=False`
+        fails queued futures with `Closed`. Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            batchers = dict(self._batchers)
+        for name, b in batchers.items():
+            with observe.span("serve/drain", cat="serve",
+                              args={"model": name}):
+                b.close(drain=drain, timeout=timeout)
+        log.info("serve: engine shut down (%d model%s drained)",
+                 len(batchers), "s" if len(batchers) != 1 else "")
+
+    def __enter__(self) -> "ServeEngine":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.shutdown()
+        return False

@@ -203,6 +203,26 @@ _register("AUTOTUNE_CACHE", "", str,
           "configured (the table lives next to the XLA cache, same "
           "atomic-publish discipline), else the table is in-memory only "
           "for this process")
+_register("SERVE_MAX_BATCH", 256, int,
+          "Online serving: the largest shape bucket (rows) the engine "
+          "compiles/dispatches. Buckets are powers-of-two times the "
+          "mesh's data-axis size, capped here, so each model compiles "
+          "O(log max_batch) programs total (serve/registry.py)")
+_register("SERVE_MAX_WAIT_MS", 2.0, float,
+          "Continuous batching deadline: a queued request older than "
+          "this dispatches even if the batch is not full — the batch-"
+          "fullness vs latency knob. 0 = greedy (dispatch whatever is "
+          "queued the moment the scheduler is free; serve/batcher.py)")
+_register("SERVE_MAX_QUEUE_ROWS", 4096, int,
+          "Admission control: queued rows per model above which submit "
+          "sheds load with the typed Overloaded error instead of "
+          "queueing into latency collapse (serve/batcher.py)")
+_register("SERVE_INT8", False, _bool,
+          "Serve registered models through an int8-quantized forward "
+          "(nn/quantized.quantize at registration; on a TPU backend "
+          "QuantizedLinear routes through the fused Pallas "
+          "kernels/quantized_matmul.py). Per-model override: "
+          "ServeEngine.register(int8=...)")
 _register("BENCH_LOCK_FILE", "/tmp/bigdl_tpu_bench.lock", str,
           "Lockfile serializing bench.py against tools/tpu_watch.sh so "
           "the harness cannot pollute the CPU trend series (ADVICE r5 #5)")

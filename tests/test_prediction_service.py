@@ -89,6 +89,61 @@ def test_oversized_request_chunks_correctly():
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
 
+def test_empty_request_raises():
+    """ISSUE 8 satellite: an n=0 request is a client error, not a silent
+    trip through the batch loop."""
+    svc, _ = _service()
+    import pytest
+    with pytest.raises(ValueError, match="empty request"):
+        svc.predict(np.zeros((0, 12), np.float32))
+    with pytest.raises(ValueError):
+        svc.predict(np.float32(3.0))       # scalar stays an error too
+
+
+def test_pad_is_zero_pad_and_content_cannot_leak():
+    """ISSUE 8 satellite: padding is zeros + valid mask (PR 5 trick), not
+    repeat-last — the bucket program's output on the VALID rows is
+    bitwise independent of the pad content."""
+    svc, _ = _service()
+    r = np.random.RandomState(3)
+    x = r.randn(5, 12).astype(np.float32)
+    entry = svc._entry
+    bucket = svc._bucket(5)
+    valid = np.zeros((bucket,), bool)
+    valid[:5] = True
+    clean = np.zeros((bucket, 12), np.float32)
+    clean[:5] = x
+    poison = np.full((bucket, 12), 3e8, np.float32)
+    poison[:5] = x
+    out_clean = np.asarray(entry._jitted(svc.params, svc.state,
+                                         clean, valid))
+    out_poison = np.asarray(entry._jitted(svc.params, svc.state,
+                                          poison, valid))
+    np.testing.assert_array_equal(out_clean, out_poison)
+    # and the service's live answer IS those valid rows
+    np.testing.assert_array_equal(svc.predict(x), out_clean[:5])
+
+
+def test_predictor_zero_pads_tail():
+    """Predictor._pad_to zero-pads (replicated last rows used to run
+    real forward math and skew batch-coupled statistics)."""
+    from bigdl_tpu.optim.predictor import Predictor, _pad_to
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    padded = _pad_to(x, 5)
+    np.testing.assert_array_equal(padded[:2], x)
+    np.testing.assert_array_equal(padded[2:], 0.0)
+
+    model = nn.Sequential(nn.Linear(12, 32), nn.Tanh(), nn.Linear(32, 5))
+    params, state = model.init(jax.random.PRNGKey(0))
+    pred = Predictor(model, params, state, batch_size=8)
+    r = np.random.RandomState(4)
+    q = r.randn(13, 12).astype(np.float32)   # 8 + padded tail of 5
+    got = pred.predict(q)
+    want = np.asarray(model.apply(params, state, jnp.asarray(q),
+                                  training=False)[0])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
 def test_int8_llama_serving_under_concurrency():
     """Serving composition: a quantized (int8 SwiGLU) LLaMA behind
     PredictionService under threaded clients — per-request rows match

@@ -1,0 +1,472 @@
+"""Online serving subsystem (ISSUE 8; docs/serving.md).
+
+The scheduler's decisions are deterministic functions of (queue, clock)
+— the fake-clock tests drive `bucket_for` / `_wait_s` / `_take` /
+`_run_batch` synchronously with no threads, so deadline firing, bucket
+selection, shedding, FIFO and drain semantics are asserted exactly.
+Real-thread coverage rides a fast concurrent test plus a slow-marked
+soak. AOT/compile-count and host-sync accounting use the observe
+registry's counters as deltas (the registry is process-wide)."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu import observe
+from bigdl_tpu.serve import (Closed, ContinuousBatcher, ModelEntry,
+                             Overloaded, ServeEngine, serve_buckets)
+
+
+def tiny_model():
+    """Model factory for the CLI smoke test (module:callable ref)."""
+    return nn.Sequential(nn.Linear(6, 8), nn.Tanh(), nn.Linear(8, 3))
+
+
+def _entry(max_batch=16, mesh=None, **kw):
+    model = tiny_model()
+    params, state = model.init(jax.random.PRNGKey(0))
+    return model, params, state, ModelEntry(
+        "t", model, params, state, max_batch=max_batch, mesh=mesh, **kw)
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _echo_dispatch(calls=None):
+    """Fake downstream: records (bucket, n_valid) and returns 2x input."""
+    calls = calls if calls is not None else []
+
+    def dispatch(xs, n_valid):
+        calls.append((xs.shape[0], n_valid))
+        return xs * 2
+    dispatch.calls = calls
+    return dispatch
+
+
+def _rows(r, n, d=4):
+    return r.randn(n, d).astype(np.float32)
+
+
+# ------------------------------------------------------- scheduling policy
+def test_bucket_ladder_and_selection():
+    assert serve_buckets(16) == (1, 2, 4, 8, 16)
+    b = ContinuousBatcher(_echo_dispatch(), serve_buckets(16), start=False)
+    assert [b.bucket_for(n) for n in (1, 2, 3, 5, 9, 16)] == \
+        [1, 2, 4, 8, 16, 16]
+
+
+def test_bucket_ladder_respects_mesh_data_axis():
+    from bigdl_tpu.parallel.mesh import create_mesh, data_axis_size
+    mesh = create_mesh(drop_trivial_axes=True)
+    k = data_axis_size(mesh)
+    buckets = serve_buckets(4 * k, mesh)
+    assert buckets[0] == k and buckets[-1] == 4 * k
+    assert all(b % k == 0 for b in buckets)
+
+
+def test_deadline_fires_after_max_wait_fake_clock():
+    clk = _FakeClock()
+    b = ContinuousBatcher(_echo_dispatch(), (1, 2, 4, 8), max_wait_ms=10.0,
+                          clock=clk, start=False)
+    r = np.random.RandomState(0)
+    b.submit(_rows(r, 2))
+    # below a full bucket and inside the deadline: keep waiting
+    assert b._wait_s(clk()) == pytest.approx(0.010)
+    clk.t = 0.004
+    assert b._wait_s(clk()) == pytest.approx(0.006)
+    # deadline reached: dispatch now
+    clk.t = 0.0101
+    assert b._wait_s(clk()) <= 0.0
+
+
+def test_full_bucket_dispatches_immediately_fake_clock():
+    clk = _FakeClock()
+    b = ContinuousBatcher(_echo_dispatch(), (1, 2, 4, 8),
+                          max_wait_ms=1e9, clock=clk, start=False)
+    r = np.random.RandomState(0)
+    b.submit(_rows(r, 5))
+    assert b._wait_s(clk()) > 0          # huge deadline, batch not full
+    b.submit(_rows(r, 3))                # 8 rows = largest bucket
+    assert b._wait_s(clk()) <= 0.0
+
+
+def test_greedy_mode_never_waits():
+    clk = _FakeClock()
+    b = ContinuousBatcher(_echo_dispatch(), (1, 2, 4), max_wait_ms=0.0,
+                          clock=clk, start=False)
+    b.submit(_rows(np.random.RandomState(0), 1))
+    assert b._wait_s(clk()) <= 0.0
+
+
+def test_admission_control_sheds_with_typed_error():
+    b = ContinuousBatcher(_echo_dispatch(), (1, 2, 4, 8),
+                          max_queue_rows=10, start=False)
+    r = np.random.RandomState(0)
+    shed0 = observe.registry().counter("serve/shed").value
+    b.submit(_rows(r, 8))
+    with pytest.raises(Overloaded):
+        b.submit(_rows(r, 3))            # 8 + 3 > 10
+    assert observe.registry().counter("serve/shed").value == shed0 + 1
+    b.submit(_rows(r, 2))                # 8 + 2 == 10 still admitted
+    assert b.queued_rows == 10
+
+
+def test_fifo_packing_and_signature_grouping():
+    clk = _FakeClock()
+    b = ContinuousBatcher(_echo_dispatch(), (1, 2, 4, 8), clock=clk,
+                          start=False)
+    r = np.random.RandomState(0)
+    f1 = b.submit(_rows(r, 2))
+    f2 = b.submit(_rows(r, 3))
+    # a different feature signature splits the pack: FIFO per signature
+    f3 = b.submit(r.randn(2, 7).astype(np.float32))
+    f4 = b.submit(_rows(r, 1))
+    group = b._take()
+    assert [g.n for g in group] == [2, 3]     # stops at the f3 boundary
+    b._run_batch(group)
+    assert f1.done() and f2.done() and not f3.done() and not f4.done()
+    group2 = b._take()
+    assert [g.n for g in group2] == [2]       # the (2,7) request alone
+    b._run_batch(group2)
+    assert f3.done()
+
+
+def test_take_caps_at_largest_bucket_whole_requests():
+    b = ContinuousBatcher(_echo_dispatch(), (1, 2, 4, 8), start=False)
+    r = np.random.RandomState(0)
+    for n in (4, 3, 3):
+        b.submit(_rows(r, n))
+    group = b._take()
+    # 4+3 fits in 8; the next 3 would overflow — requests never split
+    assert [g.n for g in group] == [4, 3]
+    assert b.queued_rows == 3
+
+
+def test_run_batch_returns_each_request_its_own_rows():
+    calls = []
+    b = ContinuousBatcher(_echo_dispatch(calls), (1, 2, 4, 8), start=False)
+    r = np.random.RandomState(0)
+    xs = [_rows(r, n) for n in (2, 3)]
+    futs = [b.submit(x) for x in xs]
+    b._run_batch(b._take())
+    assert calls == [(8, 5)]             # one padded bucket-8 dispatch
+    for x, f in zip(xs, futs):
+        np.testing.assert_array_equal(f.result(timeout=1), x * 2)
+    # batch_fill recorded 5/8
+    fill = observe.registry().histogram("serve/batch_fill")
+    assert fill.count >= 1
+
+
+def test_dispatch_error_fails_every_future_in_batch():
+    def boom(xs, n):
+        raise RuntimeError("device on fire")
+    b = ContinuousBatcher(boom, (1, 2, 4), start=False)
+    r = np.random.RandomState(0)
+    futs = [b.submit(_rows(r, 1)) for _ in range(3)]
+    b._run_batch(b._take())
+    for f in futs:
+        with pytest.raises(RuntimeError, match="device on fire"):
+            f.result(timeout=1)
+
+
+def test_close_without_drain_fails_futures_closed_not_lost():
+    b = ContinuousBatcher(_echo_dispatch(), (1, 2, 4), start=False)
+    r = np.random.RandomState(0)
+    futs = [b.submit(_rows(r, 1)) for _ in range(3)]
+    b.close(drain=False)
+    for f in futs:
+        with pytest.raises(Closed):
+            f.result(timeout=1)
+    with pytest.raises(Closed):
+        b.submit(_rows(r, 1))
+
+
+def test_graceful_drain_completes_all_queued_futures():
+    """Real scheduler thread: close(drain=True) finishes every queued
+    request — no lost futures."""
+    def slow_echo(xs, n):
+        time.sleep(0.01)
+        return xs * 2
+    b = ContinuousBatcher(slow_echo, (1, 2, 4), max_wait_ms=50.0)
+    r = np.random.RandomState(0)
+    xs = [_rows(r, 2) for _ in range(6)]
+    futs = [b.submit(x) for x in xs]
+    b.close(drain=True, timeout=10.0)
+    for x, f in zip(xs, futs):
+        np.testing.assert_array_equal(f.result(timeout=1), x * 2)
+
+
+def test_coalesce_off_is_batch_size_1_dispatch():
+    calls = []
+    b = ContinuousBatcher(_echo_dispatch(calls), (1, 2, 4, 8),
+                          coalesce=False, start=False)
+    r = np.random.RandomState(0)
+    for _ in range(3):
+        b.submit(_rows(r, 2))
+    for _ in range(3):
+        b._run_batch(b._take())
+    assert calls == [(2, 2)] * 3         # one request per dispatch
+
+
+# ----------------------------------------------------------------- engine
+def test_engine_concurrent_clients_fifo_results():
+    model = tiny_model()
+    params, state = model.init(jax.random.PRNGKey(0))
+    ref = jax.jit(lambda x: model.apply(params, state, x,
+                                        training=False)[0])
+    with ServeEngine() as eng:
+        eng.register("m", model, params, state, max_batch=16,
+                     max_wait_ms=2.0)
+        r = np.random.RandomState(0)
+        reqs = [[r.randn(int(r.randint(1, 9)), 6).astype(np.float32)
+                 for _ in range(6)] for _ in range(4)]
+        results = [[None] * 6 for _ in range(4)]
+        errors = []
+
+        def client(ti):
+            try:
+                for qi, q in enumerate(reqs[ti]):
+                    results[ti][qi] = eng.predict("m", q, timeout=30)
+            except Exception as exc:  # noqa: BLE001 — surfaced after join
+                errors.append(repr(exc))
+        ts = [threading.Thread(target=client, args=(ti,)) for ti in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errors, errors
+        for ti in range(4):
+            for qi in range(6):
+                want = np.asarray(ref(reqs[ti][qi]))
+                np.testing.assert_allclose(results[ti][qi], want,
+                                           rtol=1e-5, atol=1e-6)
+
+
+def test_engine_multi_model_registry():
+    m1 = tiny_model()
+    p1, s1 = m1.init(jax.random.PRNGKey(0))
+    m2 = nn.Sequential(nn.Linear(4, 2), nn.LogSoftMax())
+    p2, s2 = m2.init(jax.random.PRNGKey(1))
+    with ServeEngine() as eng:
+        eng.register("a", m1, p1, s1, max_batch=8)
+        eng.register("b", m2, p2, s2, max_batch=8)
+        with pytest.raises(ValueError):
+            eng.register("a", m1, p1, s1)
+        assert eng.models() == ["a", "b"]
+        r = np.random.RandomState(0)
+        oa = eng.predict("a", r.randn(3, 6).astype(np.float32))
+        ob = eng.predict("b", r.randn(2, 4).astype(np.float32))
+        assert oa.shape == (3, 3) and ob.shape == (2, 2)
+        eng.unregister("b")
+        with pytest.raises(KeyError):
+            eng.predict("b", r.randn(1, 4).astype(np.float32))
+
+
+def test_engine_empty_and_oversized_requests():
+    model, params, state, _ = _entry()
+    with ServeEngine() as eng:
+        eng.register("m", model, params, state, max_batch=8)
+        r = np.random.RandomState(0)
+        with pytest.raises(ValueError, match="empty request"):
+            eng.predict("m", np.zeros((0, 6), np.float32))
+        with pytest.raises(ValueError):
+            eng.predict("m", np.float32(1.0))          # scalar
+        # oversized: chunked into <= max_batch pieces, rows reassembled
+        x = r.randn(21, 6).astype(np.float32)
+        out = eng.predict("m", x)
+        ref = np.asarray(model.apply(params, state, x, training=False)[0])
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_engine_stats_slo_view():
+    model, params, state, _ = _entry()
+    with ServeEngine() as eng:
+        eng.register("slo", model, params, state, max_batch=8)
+        r = np.random.RandomState(0)
+        for n in (1, 3, 5):
+            eng.predict("slo", r.randn(n, 6).astype(np.float32))
+        st = eng.stats()
+        assert st["slo"]["requests"] >= 3
+        assert st["slo"]["p99_ms"] >= st["slo"]["p50_ms"] > 0
+        assert st["_totals"]["batches"] >= 1
+        assert 0 < st["_totals"]["mean_batch_fill"] <= 1.0
+
+
+def test_int8_registration_behind_knob(monkeypatch):
+    from bigdl_tpu.nn.quantized import quantize
+    model = tiny_model()
+    params, state = model.init(jax.random.PRNGKey(0))
+    qmod, qparams = quantize(model, params)
+    monkeypatch.setenv("BIGDL_TPU_SERVE_INT8", "1")
+    with ServeEngine() as eng:
+        entry = eng.register("q", model, params, state, max_batch=8)
+        assert entry.int8
+        r = np.random.RandomState(0)
+        x = r.randn(4, 6).astype(np.float32)
+        out = eng.predict("q", x)
+        want = np.asarray(qmod.apply(qparams, state, x, training=False)[0])
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+    # per-model override beats the knob
+    with ServeEngine() as eng2:
+        assert not eng2.register("f", model, params, state,
+                                 int8=False).int8
+
+
+def test_sigterm_preempt_drains_and_closes():
+    from bigdl_tpu.resilience import faults
+    model, params, state, _ = _entry()
+    eng = ServeEngine()
+    try:
+        eng.register("m", model, params, state, max_batch=8)
+        r = np.random.RandomState(0)
+        fut = eng.submit("m", r.randn(2, 6).astype(np.float32))
+        faults.request_preempt()
+        # the scheduler polls the preempt flag, drains, then closes
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            try:
+                eng.submit("m", r.randn(1, 6).astype(np.float32))
+                time.sleep(0.02)
+            except Closed:
+                break
+        else:
+            pytest.fail("batcher never closed after preempt request")
+        # the queued request was drained, not lost
+        assert fut.result(timeout=5).shape == (2, 3)
+    finally:
+        faults.clear_preempt()
+        eng.shutdown(drain=False)
+
+
+# ------------------------------------------------------- AOT + host syncs
+def _pad(x, entry):
+    b = next(v for v in entry.buckets if v >= x.shape[0])
+    out = np.zeros((b,) + x.shape[1:], x.dtype)
+    out[:x.shape[0]] = x
+    return out
+
+
+def test_precompile_buckets_then_zero_fresh_compiles():
+    """After the bucket-set AOT warmup, serving ANY request size
+    compiles nothing — every bucket is an AOT executable hit."""
+    observe.ensure_started()
+    model, params, state, entry = _entry(max_batch=16)
+    res = entry.precompile_for((6,), "float32")
+    assert sorted(res) == [1, 2, 4, 8, 16]
+    assert sorted(entry._aot) == [1, 2, 4, 8, 16]
+    compiles = observe.registry().counter("jit/compiles")
+    c0 = compiles.value
+    r = np.random.RandomState(0)
+    for n in (1, 2, 3, 7, 11, 16):
+        out = entry.dispatch(_pad(_rows(r, n, 6), entry), n)
+        assert out.shape[0] >= n
+    assert compiles.value == c0
+
+
+def test_no_per_request_host_syncs_beyond_result_fetch(monkeypatch):
+    """3 requests coalesced into 1 batch => exactly ONE jax.device_get:
+    serving adds no per-request host syncs beyond the result fetch."""
+    model, params, state, entry = _entry(max_batch=8)
+    b = ContinuousBatcher(entry.dispatch, entry.buckets, start=False)
+    r = np.random.RandomState(0)
+    futs = [b.submit(_rows(r, 2, 6)) for _ in range(3)]
+    syncs = {"n": 0}
+    real_get = jax.device_get
+
+    def counting_get(v):
+        syncs["n"] += 1
+        return real_get(v)
+    monkeypatch.setattr(jax, "device_get", counting_get)
+    b._run_batch(b._take())
+    monkeypatch.setattr(jax, "device_get", real_get)
+    assert syncs["n"] == 1
+    for f in futs:
+        assert f.result(timeout=1).shape == (2, 3)
+
+
+def test_valid_mask_pad_poisoning_bit_identity():
+    """The serving forward's output is a pure function of the VALID rows:
+    zero pad vs poisoned pad through the same bucket program is
+    bitwise identical (padded rows are masked to zero either way)."""
+    model, params, state, entry = _entry(max_batch=8)
+    r = np.random.RandomState(0)
+    x = _rows(r, 5, 6)
+    valid = np.zeros((8,), bool)
+    valid[:5] = True
+    clean = np.zeros((8, 6), np.float32)
+    clean[:5] = x
+    poison = np.full((8, 6), 7e7, np.float32)
+    poison[:5] = x
+    out_clean = np.asarray(entry._jitted(params, state, clean, valid))
+    out_poison = np.asarray(entry._jitted(params, state, poison, valid))
+    np.testing.assert_array_equal(out_clean, out_poison)
+    assert out_clean[:5].any()               # valid rows are real outputs
+    np.testing.assert_array_equal(out_clean[5:], 0.0)
+
+
+# --------------------------------------------------------------------- CLI
+def test_cli_smoke_mode(capsys):
+    from bigdl_tpu.serve.__main__ import main
+    rc = main(["test_serve:tiny_model", "--input", "6", "--smoke",
+               "--smoke-threads", "2", "--smoke-requests", "3",
+               "--max-batch", "8"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(out)
+    assert rc == 0
+    assert rec["requests_ok"] == rec["requests_sent"] == 6
+    assert rec["errors"] == []
+    assert rec["buckets"] == [1, 2, 4, 8]
+    assert rec["p99_ms"] >= rec["p50_ms"] > 0
+
+
+# -------------------------------------------------------------------- soak
+@pytest.mark.slow
+def test_soak_threads_mixed_sizes_with_deadline():
+    """Real-thread soak: 8 clients x 25 mixed-size requests through the
+    deadline scheduler; every client gets its own rows back and the
+    engine coalesces (fewer batches than requests)."""
+    model = tiny_model()
+    params, state = model.init(jax.random.PRNGKey(0))
+    ref = jax.jit(lambda x: model.apply(params, state, x,
+                                        training=False)[0])
+    batches0 = observe.registry().counter("serve/batches").value
+    with ServeEngine() as eng:
+        eng.register("soak", model, params, state, max_batch=32,
+                     max_wait_ms=3.0)
+        r = np.random.RandomState(0)
+        reqs = [[r.randn(int(r.randint(1, 17)), 6).astype(np.float32)
+                 for _ in range(25)] for _ in range(8)]
+        results = [[None] * 25 for _ in range(8)]
+        errors = []
+
+        def client(ti):
+            try:
+                for qi, q in enumerate(reqs[ti]):
+                    results[ti][qi] = eng.predict("soak", q, timeout=60)
+            except Exception as exc:  # noqa: BLE001
+                errors.append(repr(exc))
+        ts = [threading.Thread(target=client, args=(ti,)) for ti in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errors, errors
+        n_req = 8 * 25
+        batches = observe.registry().counter("serve/batches").value - batches0
+        assert batches < n_req          # dynamic batching actually coalesced
+        for ti in range(8):
+            for qi in range(25):
+                want = np.asarray(ref(reqs[ti][qi]))
+                np.testing.assert_allclose(results[ti][qi], want,
+                                           rtol=1e-5, atol=1e-6)
