@@ -43,6 +43,7 @@ _METRICS = {
     "resnet50_sweep": ("resnet50_bf16_mfu_best", "mfu"),
     "llama": ("llama_125m_train_throughput", "tokens/sec"),
     "dispatch": ("fused_dispatch_cpu8_speedup", "ratio"),
+    "input": ("input_service_data_wait_reduction", "ratio"),
     "checkpoint": ("async_checkpoint_stall_reduction", "ratio"),
     "overhead": ("observability_overhead_pct", "percent"),
     "compile": ("compile_cache_warm_startup_speedup", "ratio"),
@@ -553,6 +554,154 @@ def _bench_dispatch(batch_size=32, window=64, iters=256):
         post = w.rates[window:]           # first window eats compile
         rows[k] = round(max(post), 1)
     return rows
+
+
+def _bench_input(batch_size=32, k=8, warm_iters=16, iters=256,
+                 workers_on=8):
+    """Input-service bench: data-wait span fraction with the streaming
+    input service ON vs OFF, at the dispatch bench's K=8 record rate on
+    the 8-virtual-device CPU mesh. The workload is record-shard
+    ingestion (ShardedRecordDataset over synthetic raw records) whose
+    per-record decode carries a calibrated sleep emulating remote-
+    storage fetch latency — the IO-bound regime the service exists for,
+    and the only host-pipeline cost a 1-core host can honestly overlap
+    (CPU-bound decode overlap needs real cores next to a real chip;
+    the sleep releases the GIL exactly like a storage read does).
+
+    Calibration: an unthrottled service-on pass measures the device-side
+    demand R rec/s; the throttle is then set so ONE decode worker feeds
+    R/4 (the service-off path starves 4x) while `workers_on` workers
+    feed 2R (the service keeps the chip fed). The echoing run throttles
+    4x harder — even the full worker pool starves — and compares
+    DATA_ECHO=1 vs 2 trained-records/sec (Choi et al.: each fetched
+    batch trains twice, halving the IO demand per trained record).
+
+    Per mode: a warmup pass eats every compile, then the metrics
+    registry is reset and a fresh measured pass (same trainer — the
+    built-step cache keeps it at zero fresh compiles) yields the
+    data-wait fraction (observe.metrics.data_wait_fraction — data_wait /
+    step-loop time) and the trainer's own throughput meter."""
+    import shutil
+    import tempfile
+    import numpy as np
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu import observe
+    from bigdl_tpu.dataset.sharded import (ShardedRecordDataset,
+                                           generate_synthetic)
+    from bigdl_tpu.observe.metrics import data_wait_fraction
+    from bigdl_tpu.optim.method import SGD
+    from bigdl_tpu.optim.trigger import Trigger
+    from bigdl_tpu.parallel import DistriOptimizer, create_mesh
+
+    class _Windows:
+        def __init__(self):
+            self.rates = []
+
+        def add_scalar(self, name, v, step):
+            if name == "Throughput":
+                self.rates.append(v)
+
+    mesh = create_mesh(drop_trivial_axes=True)
+    shard_dir = tempfile.mkdtemp(prefix="bigdl_input_bench_")
+    # one long epoch covers warmup + measured pass per mode: epoch
+    # turnover re-primes the pipeline, and that fill must amortize, not
+    # dominate, the measured data-wait
+    generate_synthetic(shard_dir, batch_size * 512, num_shards=8,
+                       height=16, width=16, classes=2)
+    feat = 16 * 16 * 3
+
+    def make_transform(sleep_s):
+        def fn(img, label):
+            if sleep_s:
+                time.sleep(sleep_s)
+            return (img.astype(np.float32).reshape(feat) / 255.0 - 0.5,
+                    np.int32(label % 2))
+        return fn
+
+    _KNOBS = ("BIGDL_TPU_DATA_SERVICE", "BIGDL_TPU_DATA_WORKERS",
+              "BIGDL_TPU_DATA_ECHO", "BIGDL_TPU_PREFETCH_SIZE")
+
+    def run(env, sleep_s, workers):
+        saved = {kk: os.environ.get(kk) for kk in _KNOBS}
+        os.environ.update(env)
+        try:
+            ds = ShardedRecordDataset(
+                shard_dir, batch_size, transform=make_transform(sleep_s),
+                shuffle=False, num_workers=workers)
+            # enough device compute per step that the feed, not python
+            # dispatch, is the contended resource (the dispatch bench
+            # already covers the tiny-step regime)
+            model = nn.Sequential(nn.Linear(feat, 128), nn.ReLU(),
+                                  nn.Linear(128, 2), nn.LogSoftMax())
+            opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(),
+                                  SGD(0.1), mesh=mesh, seed=0,
+                                  steps_per_call=k)
+            w = _Windows()
+            opt.set_train_summary(w)
+            opt._log_every = iters // 4
+            # warmup pass pays every compile; the measured pass below
+            # reuses the built programs (retrace-hygiene contract)
+            opt.set_end_when(Trigger.max_iteration(warm_iters))
+            opt.optimize()
+            observe.registry().reset()
+            w.rates.clear()
+            opt.set_end_when(Trigger.max_iteration(warm_iters + iters))
+            t0 = time.time()
+            opt.optimize()
+            wall = time.time() - t0
+            dw = data_wait_fraction(observe.registry().snapshot())
+            return {
+                "data_wait_frac": round(dw["fraction"], 4) if dw else None,
+                "data_wait_s": round(dw["data_wait_s"], 3) if dw else None,
+                "step_loop_s": round(dw["step_loop_s"], 3) if dw else None,
+                "rec_per_sec": round(max(w.rates), 1) if w.rates
+                else round(iters * batch_size / max(wall, 1e-9), 1),
+                "wall_s": round(wall, 2),
+            }
+        finally:
+            for kk, v in saved.items():
+                if v is None:
+                    os.environ.pop(kk, None)
+                else:
+                    os.environ[kk] = v
+
+    try:
+        # calibrate the device-side demand with no throttle, service on
+        cal = run({"BIGDL_TPU_DATA_SERVICE": "1",
+                   "BIGDL_TPU_DATA_WORKERS": str(workers_on)}, 0.0,
+                  workers_on)
+        rate = max(cal["rec_per_sec"], 1.0)
+        # one worker feeds rate/4; `workers_on` workers feed 2x rate
+        sleep_s = (workers_on / 2.0) / rate
+        off = run({"BIGDL_TPU_DATA_SERVICE": "0"}, sleep_s, 1)
+        on = run({"BIGDL_TPU_DATA_SERVICE": "1",
+                  "BIGDL_TPU_DATA_WORKERS": str(workers_on)}, sleep_s,
+                 workers_on)
+        # IO-throttled regime: even the pool starves — echoing's win
+        heavy = 4.0 * sleep_s
+        e1 = run({"BIGDL_TPU_DATA_SERVICE": "1",
+                  "BIGDL_TPU_DATA_WORKERS": str(workers_on)}, heavy,
+                 workers_on)
+        e2 = run({"BIGDL_TPU_DATA_SERVICE": "1",
+                  "BIGDL_TPU_DATA_WORKERS": str(workers_on),
+                  "BIGDL_TPU_DATA_ECHO": "2"}, heavy, workers_on)
+        off_frac = off["data_wait_frac"] or 1e-9
+        on_frac = on["data_wait_frac"] or 1e-9
+        return {
+            "calibration_rec_per_sec": rate,
+            "throttle_ms_per_record": round(sleep_s * 1e3, 3),
+            "off": off, "on": on,
+            "data_wait_frac_ratio": round(off_frac / on_frac, 2),
+            "on_frac_of_off": round(on_frac / off_frac, 4),
+            "throttled": {
+                "throttle_ms_per_record": round(heavy * 1e3, 3),
+                "echo1": e1, "echo2": e2,
+                "echo_speedup": round(
+                    e2["rec_per_sec"] / max(e1["rec_per_sec"], 1e-9), 2),
+            },
+        }
+    finally:
+        shutil.rmtree(shard_dir, ignore_errors=True)
 
 
 def _bench_checkpoint(batch_size=32, hidden=1024, iters=24, every=4):
@@ -1154,6 +1303,39 @@ def child_main():
                     "program)",
         }))
         return
+    if which == "input":
+        # CPU-mesh microbench (parent forces FORCE_CPU=1 + 8 virtual
+        # devices): what the streaming input service buys the feed path
+        # — host pipeline scheduling + IO-wait overlap, backend-agnostic
+        metric, unit = _METRICS[which]
+        rows = _bench_input()
+        print(json.dumps({
+            "metric": metric,
+            "value": rows["data_wait_frac_ratio"],
+            "unit": unit,
+            "vs_baseline": 1.0,
+            "backend": backend,
+            "n_devices": len(jax.devices()),
+            "batch_size": 32,
+            **rows,
+            "host": _host_provenance(),
+            "note": "data-wait span fraction (train/data_wait over the "
+                    "step loop's accounted phases), small-model "
+                    "DistriOptimizer.optimize() K=8 over record shards "
+                    "on the 8-virtual-device CPU mesh; per-record decode "
+                    "carries a calibrated sleep emulating remote-storage "
+                    "fetch (one worker feeds 1/4 of device demand, the "
+                    "service's 8 workers feed 2x). off = "
+                    "BIGDL_TPU_DATA_SERVICE=0 legacy prefetch, on = "
+                    "read-ahead + 8 decode workers + double-buffered "
+                    "H2D. Acceptance: on-fraction <= 20% of off "
+                    "(value = off/on >= 5); 'throttled' starves even "
+                    "the pool and shows the DATA_ECHO=2 win "
+                    "(echo_speedup, Choi et al. data echoing). Warmup "
+                    "pass per mode eats every compile; measured pass "
+                    "is steady-state",
+        }))
+        return
     if which == "serve":
         # CPU-mesh microbench (parent forces FORCE_CPU=1 + 8 virtual
         # devices): what continuous batching buys over batch-size-1
@@ -1555,7 +1737,7 @@ def parent_main():
                   if which_arg == "kernels"
                   else {"BIGDL_TPU_FORCE_CPU": "1"})
     if which_arg in ("dispatch", "checkpoint", "overhead", "compile",
-                     "chaos", "serve"):
+                     "chaos", "serve", "input"):
         # CPU-mesh microbenches: 8 virtual devices, never a TPU attempt
         attempts = [
             ("cpu-mesh8", {"BIGDL_TPU_FORCE_CPU": "1", "XLA_FLAGS": xla},

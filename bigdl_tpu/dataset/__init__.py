@@ -3,6 +3,7 @@ SURVEY.md §2.7)."""
 
 from bigdl_tpu.dataset.core import (DataSet, ArrayDataSet, Sample, MiniBatch,
                                     Transformer, SampleToMiniBatch, Identity)
-from bigdl_tpu.dataset import (cifar, mnist, movielens, news20, text,
-                               vision)
+from bigdl_tpu.dataset import (cifar, mnist, movielens, news20, service,
+                               text, vision)
 from bigdl_tpu.dataset.prefetch import MTBatchPipeline, prefetch_to_device
+from bigdl_tpu.dataset.service import InputService, host_shard_order

@@ -52,3 +52,18 @@ def load(folder: Optional[str] = None, train: bool = True,
 def normalize(images: np.ndarray) -> np.ndarray:
     return ((images - np.asarray(TRAIN_MEAN, np.float32))
             / np.asarray(TRAIN_STD, np.float32))
+
+
+def dataset(folder: Optional[str] = None, train: bool = True,
+            batch_size: int = 32, normalized: bool = True,
+            shuffle: bool = True, seed: int = 0, drop_last: bool = True,
+            n_synthetic: int = 512):
+    """Resumable training dataset over the loaded arrays — the loader
+    shim giving CIFAR the same iterator-state protocol as the sharded
+    path (dataset/service.py; docs/data.md)."""
+    from bigdl_tpu.dataset.core import ArrayDataSet
+    x, y = load(folder, train, n_synthetic)
+    if normalized:
+        x = normalize(x).astype(np.float32)
+    return ArrayDataSet(x, y, batch_size, shuffle=shuffle, seed=seed,
+                        drop_last=drop_last)

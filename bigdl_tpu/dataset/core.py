@@ -172,6 +172,7 @@ class ArrayDataSet(DataSet):
             batch_size, shuffle, drop_last
         self.seed = seed
         self._epoch = 0
+        self._skip_batches = 0
 
     def __len__(self):
         n = len(self.features) // self.batch_size
@@ -190,14 +191,40 @@ class ArrayDataSet(DataSet):
         index-array shuffle is likewise re-derivable per epoch)."""
         self._epoch = epoch
 
+    def fast_forward_batches(self, n_batches: int):
+        """Arrange for the NEXT epoch iteration to start at batch
+        `n_batches` — an exact index-offset skip (the permutation is
+        stateless in (seed, epoch), so the skipped prefix is EXACTLY the
+        batches an uninterrupted run would have produced: mid-epoch
+        resume is sample-exact, and costs no decode or copy)."""
+        self._skip_batches = int(n_batches)
+
+    # ---- resumable iterator-state protocol (dataset/service.py,
+    # docs/data.md): everything needed to reconstruct the epoch stream
+    # is (seed, epoch, cursor); the cursor itself lives with the trainer
+    # (batch_in_epoch) or in a pending fast_forward_batches skip
+    def state_dict(self) -> dict:
+        return {"kind": "array", "version": 1, "seed": self.seed,
+                "epoch": self._epoch, "skip_batches": self._skip_batches,
+                "batch_size": self.batch_size,
+                "num_records": len(self.features),
+                "shuffle": bool(self.shuffle)}
+
+    def load_state_dict(self, state: dict):
+        if state.get("kind") != "array":
+            raise ValueError(f"not an ArrayDataSet state: {state!r}")
+        self._epoch = int(state.get("epoch", 0))
+        self._skip_batches = int(state.get("skip_batches", 0))
+
     def _raw_iter(self):
         idx = np.arange(len(self.features))
         if self.shuffle:
             np.random.RandomState(self.seed + self._epoch).shuffle(idx)
         self._epoch += 1
+        skip, self._skip_batches = self._skip_batches, 0
         bs = self.batch_size
         end = len(idx) - (len(idx) % bs) if self.drop_last else len(idx)
-        for i in range(0, end, bs):
+        for i in range(skip * bs, end, bs):
             sel = idx[i:i + bs]
             y = None if self.labels is None else self.labels[sel]
             yield MiniBatch(self.features[sel], y)

@@ -80,3 +80,19 @@ def normalize(images: np.ndarray) -> np.ndarray:
     """GreyImgNormalizer equivalent (reference: dataset/image/
     GreyImgNormalizer.scala): (x/255 - mean) / std."""
     return ((images / 255.0) - TRAIN_MEAN) / TRAIN_STD
+
+
+def dataset(folder: Optional[str] = None, train: bool = True,
+            batch_size: int = 32, normalized: bool = True,
+            shuffle: bool = True, seed: int = 0, drop_last: bool = True,
+            n_synthetic: int = 8192):
+    """Resumable training dataset over the loaded arrays — the loader
+    shim giving MNIST the same iterator-state protocol as the sharded
+    path (ArrayDataSet carries state_dict/load_state_dict and a
+    sample-exact fast_forward_batches; dataset/service.py)."""
+    from bigdl_tpu.dataset.core import ArrayDataSet
+    x, y = load(folder, train, n_synthetic)
+    if normalized:
+        x = normalize(x).astype(np.float32)
+    return ArrayDataSet(x, y, batch_size, shuffle=shuffle, seed=seed,
+                        drop_last=drop_last)

@@ -55,3 +55,17 @@ def get_id_pairs(data_dir: Optional[str] = None, **kw) -> np.ndarray:
 def get_id_ratings(data_dir: Optional[str] = None, **kw) -> np.ndarray:
     """(N, 3) [user, item, rating] (reference: get_id_ratings)."""
     return read_data_sets(data_dir, **kw)
+
+
+def dataset(data_dir: Optional[str] = None, batch_size: int = 256,
+            shuffle: bool = True, seed: int = 0, drop_last: bool = True,
+            **kw):
+    """Resumable recommender dataset: x = (user, item) int32 pairs,
+    y = rating — the loader shim giving MovieLens the same
+    iterator-state protocol as the sharded path (dataset/service.py;
+    docs/data.md)."""
+    from bigdl_tpu.dataset.core import ArrayDataSet
+    arr = read_data_sets(data_dir, seed=seed, **kw)
+    return ArrayDataSet(arr[:, :2].astype(np.int32),
+                        arr[:, 2].astype(np.int32), batch_size,
+                        shuffle=shuffle, seed=seed, drop_last=drop_last)

@@ -58,6 +58,32 @@ def get_news20(source_dir: Optional[str] = None, n_synthetic: int = 2000,
     return out
 
 
+def dataset(source_dir: Optional[str] = None, batch_size: int = 32,
+            seq_len: int = 64, vocab_size: int = 5000,
+            shuffle: bool = True, seed: int = 0, drop_last: bool = True,
+            n_synthetic: int = 2000):
+    """Resumable text-classification dataset: tokenized posts encoded to
+    fixed-length int32 id sequences (pad/truncate to `seq_len`, vocab
+    capped by frequency) with 0-based labels — the loader shim giving
+    news20 the same iterator-state protocol as the sharded path
+    (dataset/service.py; docs/data.md)."""
+    from bigdl_tpu.dataset.core import ArrayDataSet
+    from bigdl_tpu.dataset.text import Dictionary, tokenize
+    pairs = get_news20(source_dir, n_synthetic=n_synthetic, seed=seed)
+    tokens = [tokenize(text) for text, _ in pairs]
+    vocab = Dictionary(tokens, vocab_size=vocab_size)
+    unk = vocab.index(Dictionary.UNK)
+    ids = np.full((len(tokens), seq_len), unk, np.int32)
+    for i, words in enumerate(tokens):
+        enc = vocab.encode(words[:seq_len])
+        ids[i, :len(enc)] = enc
+    labels = np.asarray([label - 1 for _, label in pairs], np.int32)
+    ds = ArrayDataSet(ids, labels, batch_size, shuffle=shuffle, seed=seed,
+                      drop_last=drop_last)
+    ds.vocab = vocab                       # for embedding/table sizing
+    return ds
+
+
 def get_glove_w2v(source_dir: Optional[str] = None, dim: int = 50,
                   vocab: Optional[List[str]] = None,
                   seed: int = 0) -> Dict[str, np.ndarray]:

@@ -214,10 +214,13 @@ class MTBatchPipeline:
     racy buffer fill)."""
 
     def __init__(self, transform_fn: Callable, batch_size: int,
-                 num_threads: int = 4):
+                 num_threads: Optional[int] = None):
+        from bigdl_tpu.dataset import service as _svc
         self.transform_fn = transform_fn
         self.batch_size = batch_size
-        self.num_threads = num_threads
+        # None → the shared decode-worker knob (BIGDL_TPU_DATA_WORKERS,
+        # dataset/service.py) so every loader's pool sizes together
+        self.num_threads = _svc.resolve_workers(num_threads)
 
     def __call__(self, samples: Iterable) -> Iterator:
         """Stream samples through the pool with bounded in-flight futures

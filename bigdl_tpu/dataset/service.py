@@ -1,0 +1,435 @@
+"""Streaming input service — the staged host pipeline the trainers feed
+through (reference: the L4 data layer — dataset/DataSet.scala cached
+partitions + Transformer chains + MTImageFeatureToBatch.scala multithreaded
+batching — restructured as a feeder for one SPMD program).
+
+Stages, each its own thread(s) with a span + queue-depth gauge so a trace
+shows exactly which stage starves the chip:
+
+    dataset iter ──read_ahead──▶ echo ──stack_batches──▶ double_buffer ──▶ trainer
+    (decode workers)  queue      (xN)   [K,batch,...]     H2D thread
+
+  * `read_ahead`   — a background reader pulls host batches while the
+                     placement thread stacks and the device computes;
+  * `echo_batches` — BIGDL_TPU_DATA_ECHO=N data echoing (Choi et al.):
+                     each batch trains N times, with per-echo
+                     re-augmentation when the dataset provides
+                     `echo_transform`;
+  * `double_buffer`— H2D placement of super-batch N+1 overlaps compute
+                     of N (BIGDL_TPU_DATA_DOUBLE_BUFFER);
+  * `ordered_map`  — the shared decode-worker machinery: parallel map
+                     with submission-order output, used by the sharded
+                     loader's exact mode and the CLI/bench probes.
+
+Determinism contract: every stage preserves order and content, so
+training with the service ON is bit-identical to the service OFF — and a
+deterministic dataset (ArrayDataSet, ShardedRecordDataset(exact=True))
+makes a mid-epoch kill-and-resume sample-exact (docs/data.md).
+
+Per-host sharding: `host_shard_order` is the (seed, epoch, host)
+-deterministic partition of a shard-file list — disjoint across hosts,
+full coverage, and identical to the legacy single-host shard order when
+num_hosts == 1 (it extends sharded.py's shard-order contract).
+
+Resumable state: `pipeline_state` / `restore_pipeline` implement the
+iterator-state protocol persisted in the v2 snapshot manifest
+(`data_state` meta key): epoch + batch cursor (≡ shard index + record
+offset for index-ordered datasets), the rng seed the permutations derive
+from, and the echo counter. `resume()` restores the *pipeline*, not just
+params.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from collections import deque
+from typing import Callable, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu import observe
+
+log = logging.getLogger("bigdl_tpu")
+
+STATE_VERSION = 1
+
+
+# ---------------------------------------------------------------- knobs
+def resolve_workers(workers: Optional[int] = None) -> int:
+    """Decode-worker count: explicit > BIGDL_TPU_DATA_WORKERS > auto.
+    Auto floors at 4 even on small hosts — the workers overlap IO wait
+    (record fetch, storage latency), not CPU, so more threads than
+    cores is the right default for the loaders that use them."""
+    if workers is not None and workers > 0:
+        return int(workers)
+    from bigdl_tpu.utils import config
+    knob = config.get("DATA_WORKERS")
+    if knob and knob > 0:
+        return int(knob)
+    import os
+    return min(8, max(4, os.cpu_count() or 1))
+
+
+def service_enabled() -> bool:
+    from bigdl_tpu.utils import config
+    return bool(config.get("DATA_SERVICE"))
+
+
+def default_host() -> tuple:
+    """(host_index, num_hosts) for per-host sharding — jax process info
+    when a backend is up, else the single-host identity. Lazy and
+    exception-safe: datasets must stay constructible without jax."""
+    try:
+        import jax
+        return int(jax.process_index()), int(jax.process_count())
+    except Exception:
+        return 0, 1
+
+
+# -------------------------------------------------- per-host file sharding
+def host_shard_order(shards: Sequence[str], seed: int, epoch: int,
+                     host_index: int = 0, num_hosts: int = 1,
+                     shuffle: bool = True) -> List[str]:
+    """This host's shard files for `epoch`, deterministic in
+    (seed, epoch, host): the FULL list is permuted exactly like the
+    legacy single-host epoch order (RandomState(seed + epoch) — the
+    sharded.py contract), then host h takes every num_hosts-th entry
+    starting at h. Properties (asserted by tests/test_input_service.py):
+    hosts are pairwise disjoint, their union is the full list, and
+    num_hosts == 1 reproduces the legacy order bit-for-bit."""
+    if num_hosts < 1:
+        raise ValueError(f"num_hosts must be >= 1, got {num_hosts}")
+    if not 0 <= host_index < num_hosts:
+        raise ValueError(
+            f"host_index {host_index} out of range for {num_hosts} hosts")
+    order = list(shards)
+    if shuffle:
+        order = [order[i] for i in
+                 np.random.RandomState(seed + epoch)
+                 .permutation(len(order))]
+    return order[host_index::num_hosts]
+
+
+# ------------------------------------------------------- shared machinery
+def ordered_map(fn: Callable, items: Iterable, workers: int,
+                inflight: Optional[int] = None) -> Iterator:
+    """Parallel map with submission-order output — the deterministic form
+    of a decode pool (the reference's MTImageFeatureToBatch fills its
+    batch buffer racily; here order is the contract that makes resume
+    sample-exact). Bounded in-flight futures keep memory flat on long
+    streams. workers <= 1 degenerates to the plain serial map."""
+    if workers <= 1:
+        for item in items:
+            yield fn(item)
+        return
+    from concurrent.futures import ThreadPoolExecutor
+    inflight = inflight or 2 * workers
+    with ThreadPoolExecutor(workers) as pool:
+        dq: deque = deque()
+        for item in items:
+            dq.append(pool.submit(fn, item))
+            if len(dq) >= inflight:
+                yield dq.popleft().result()
+        while dq:
+            yield dq.popleft().result()
+
+
+def read_ahead(it: Iterable, depth: int = 8,
+               gauge_name: str = "data/read_ahead_depth") -> Iterator:
+    """Background reader stage: one thread pulls host batches from `it`
+    into a bounded queue so dataset decode overlaps the downstream
+    stack/place/compute stages. Order-preserving; producer errors
+    re-raise on the consumer side; abandonment (trainer break mid-epoch)
+    stops the reader promptly — same discipline as prefetch_to_device."""
+    if depth <= 0:
+        return iter(it)
+
+    def gen():
+        q: "queue.Queue" = queue.Queue(maxsize=depth)
+        _END = object()
+        err: list = []
+        stop = threading.Event()
+        gauge = observe.gauge(gauge_name)
+
+        def _put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker():
+            try:
+                for batch in it:
+                    if stop.is_set() or not _put(batch):
+                        return
+                    gauge.set(q.qsize())
+            except BaseException as e:      # surfaced on the consumer side
+                err.append(e)
+            finally:
+                _put(_END)
+
+        t = threading.Thread(target=worker, daemon=True,
+                             name="bigdl-data-read-ahead")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            stop.set()
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=2.0)
+
+    return gen()
+
+
+# ------------------------------------------------------------ data echoing
+def _echo_rng(seed: int, epoch: int, batch_index: int, echo_i: int):
+    """Stateless per-(batch, echo) rng so re-augmentation replays exactly
+    after a mid-epoch resume — no mutable rng to checkpoint."""
+    mix = (seed * 1_000_003 + epoch * 9_176 + batch_index * 131
+           + echo_i) & 0x7FFFFFFF
+    return np.random.RandomState(mix)
+
+
+def echo_batches(it: Iterable, n: int, *, skip_first: int = 0,
+                 transform: Optional[Callable] = None, seed: int = 0,
+                 epoch: int = 0, start_index: int = 0) -> Iterator:
+    """Yield each (x, y) batch `n` times (data echoing — Choi et al.):
+    the device trains every batch n times while the host pipeline reads
+    the next one, an up-to-n× effective-throughput win for IO-bound
+    runs. Copies beyond the first are re-augmented through
+    `transform(x, y, rng)` when given (fresh augmentation per echo keeps
+    the repeats from being literal duplicates — the paper's "echoing
+    before augmentation" regime); without it the repeat is exact (batch
+    echoing).
+
+    Resume: `skip_first` drops the leading echoes of the FIRST batch —
+    a cursor of `b` trained batches maps to dataset batch b // n with
+    b % n echoes already consumed (the echo counter of the snapshot's
+    data_state). `start_index` is that first batch's absolute index in
+    the epoch, so re-augmentation rngs replay identically."""
+    if n < 1:
+        raise ValueError(f"echo factor must be >= 1, got {n}")
+    if not 0 <= skip_first < n:
+        raise ValueError(f"skip_first {skip_first} outside [0, {n})")
+    if n == 1 and transform is None:
+        yield from it
+        return
+    echoed = observe.counter("data/echo_batches")
+    observe.gauge("data/echo_factor").set(n)
+    for bi, (x, y) in enumerate(it, start=start_index):
+        first = skip_first if bi == start_index else 0
+        for ei in range(first, n):
+            if ei == 0 or transform is None:
+                yield x, y
+            else:
+                xe, ye = transform(x, y, _echo_rng(seed, epoch, bi, ei))
+                yield xe, ye
+            if ei:
+                echoed.inc()
+
+
+# -------------------------------------------------- double-buffered H2D
+def double_buffer(batches: Iterable, place_fn: Callable,
+                  depth: Optional[int] = None) -> Iterator:
+    """H2D placement stage: a background thread runs `place_fn` on batch
+    N+1 while the consumer computes on batch N (depth 1 = one placed
+    batch queued + one in flight — the classic double buffer). Rides
+    prefetch_to_device's queue/abandonment machinery; the placement
+    spans (`data/placement`) land on the buffer thread, and the wait the
+    train loop still pays shows up as `train/data_wait`."""
+    if depth is None:
+        from bigdl_tpu.utils import config
+        depth = config.get("DATA_DOUBLE_BUFFER")
+    if not depth or depth <= 0:
+        return (place_fn(b) for b in batches)
+    from bigdl_tpu.dataset.prefetch import prefetch_to_device
+    return prefetch_to_device(batches, depth, place_fn=place_fn)
+
+
+# ------------------------------------------------------ resumable state
+def pipeline_state(dataset, batch_in_epoch: int = 0,
+                   echo: int = 1) -> dict:
+    """The iterator-state protocol persisted in the v2 snapshot manifest
+    (`data_state` meta): enough to restore the PIPELINE, not just
+    params. `batch_in_epoch` counts TRAINED (echoed) batches; the
+    dataset contribution comes from its own `state_dict()` when it
+    implements the protocol (ArrayDataSet, ShardedRecordDataset, the
+    loader shims)."""
+    state = {"version": STATE_VERSION, "echo": int(echo),
+             "batch_in_epoch": int(batch_in_epoch),
+             "echo_skip": int(batch_in_epoch % max(1, echo))}
+    sd = getattr(dataset, "state_dict", None)
+    if callable(sd):
+        try:
+            state["dataset"] = sd()
+        except Exception as e:              # never fail a snapshot on this
+            log.warning("dataset.state_dict() failed (%s) — snapshot "
+                        "carries no dataset state", e)
+    return state
+
+
+def restore_pipeline(dataset, state: dict, *, epoch: Optional[int] = None,
+                     fast_forward: bool = True) -> int:
+    """Standalone counterpart of the trainer's resume path: position
+    `dataset` at the cursor recorded by `pipeline_state` and return the
+    echo offset of the partially-trained batch. The trainer itself does
+    the equivalent via its batch_in_epoch cursor (optim/local.py) and
+    uses this module only for validation — this entry point serves
+    pipelines driven without a trainer (CLI probes, custom loops)."""
+    echo = max(1, int(state.get("echo", 1)))
+    ds_skip, echo_skip = divmod(int(state.get("batch_in_epoch", 0)), echo)
+    ls = getattr(dataset, "load_state_dict", None)
+    if callable(ls) and state.get("dataset") is not None:
+        ls(state["dataset"])
+    if epoch is not None and hasattr(dataset, "set_epoch"):
+        dataset.set_epoch(epoch)
+    if fast_forward and ds_skip and hasattr(dataset, "fast_forward_batches"):
+        dataset.fast_forward_batches(ds_skip)
+    return echo_skip
+
+
+def validate_state(dataset, state: dict, echo: int) -> List[str]:
+    """Cross-check a snapshot's data_state against the live pipeline;
+    returns human-readable mismatch strings (the trainer logs them —
+    a changed echo factor or dataset seed silently breaks the
+    sample-exact resume contract, so it must at least be loud)."""
+    problems = []
+    if not isinstance(state, dict):
+        return [f"unrecognized data_state {type(state).__name__}"]
+    snap_echo = int(state.get("echo", 1))
+    if snap_echo != echo:
+        problems.append(
+            f"snapshot trained with DATA_ECHO={snap_echo} but this run "
+            f"uses {echo} — the resume cursor counts echoed batches, so "
+            f"the resumed epoch will not be sample-exact")
+    snap_ds = state.get("dataset")
+    sd = getattr(dataset, "state_dict", None)
+    if isinstance(snap_ds, dict) and callable(sd):
+        try:
+            live = sd()
+        except Exception:
+            return problems
+        for key in ("kind", "seed", "num_shards", "batch_size"):
+            if key in snap_ds and key in live \
+                    and snap_ds[key] != live[key]:
+                problems.append(
+                    f"dataset {key} changed since the snapshot "
+                    f"({snap_ds[key]!r} -> {live[key]!r})")
+    return problems
+
+
+# ------------------------------------------------------------- the service
+class InputService:
+    """The composed feed pipeline a trainer (or the CLI/bench probes)
+    consumes instead of a raw iterator. Construction resolves the knobs
+    once; `fused_batches` / `batches` wire the stages for the fused and
+    per-step dispatch paths. All stages preserve order and content —
+    service on/off trains bit-identically (tested)."""
+
+    def __init__(self, dataset, *, workers: Optional[int] = None,
+                 echo: Optional[int] = None,
+                 double_buffer_depth: Optional[int] = None,
+                 read_ahead_depth: Optional[int] = None,
+                 seed: int = 0):
+        from bigdl_tpu.utils import config
+        self.dataset = dataset
+        self.workers = resolve_workers(workers)
+        self.echo = max(1, int(config.get("DATA_ECHO")
+                               if echo is None else echo))
+        self.db_depth = (config.get("DATA_DOUBLE_BUFFER")
+                         if double_buffer_depth is None
+                         else double_buffer_depth)
+        self.read_ahead_depth = read_ahead_depth
+        self.seed = seed
+        # per-echo re-augmentation hook: dataset-provided
+        # fn(x, y, rng) -> (x, y) applied to echo copies 1..n-1
+        self.echo_transform = getattr(dataset, "echo_transform", None)
+
+    def _depth(self, k: int) -> int:
+        if self.read_ahead_depth is not None:
+            return self.read_ahead_depth
+        return max(4, 2 * k)
+
+    def host_batches(self, epoch_iter: Iterable, *, k: int = 1,
+                     epoch: int = 0, start_index: int = 0,
+                     echo_skip: int = 0) -> Iterator:
+        """read_ahead + echo: the host-side stages shared by both
+        dispatch paths (placement is the caller's, via double_buffer)."""
+        it = read_ahead(epoch_iter, self._depth(k))
+        if self.echo > 1 or self.echo_transform is not None:
+            it = echo_batches(it, self.echo, skip_first=echo_skip,
+                              transform=self.echo_transform,
+                              seed=self.seed, epoch=epoch,
+                              start_index=start_index)
+        return it
+
+    def fused_batches(self, epoch_iter: Iterable, k: int,
+                      place_fn: Callable, **kw) -> Iterator:
+        """Full fused-path pipeline: read-ahead → echo → [K, batch, ...]
+        super-batch stacking → double-buffered placement."""
+        from bigdl_tpu.dataset.prefetch import stack_batches
+        grouped = stack_batches(self.host_batches(epoch_iter, k=k, **kw), k)
+        return double_buffer(grouped, place_fn, self.db_depth)
+
+    def batches(self, epoch_iter: Iterable, place_fn: Callable,
+                **kw) -> Iterator:
+        """Per-step path: read-ahead → echo → double-buffered placement."""
+        return double_buffer(self.host_batches(epoch_iter, k=1, **kw),
+                             place_fn, self.db_depth)
+
+    def state_dict(self, batch_in_epoch: int = 0) -> dict:
+        return pipeline_state(self.dataset, batch_in_epoch, self.echo)
+
+    # -------------------------------------------------- host-only probe
+    def throughput_probe(self, *, batches: Optional[int] = None,
+                         seconds: Optional[float] = None,
+                         k: int = 1) -> dict:
+        """Drive the HOST pipeline only — no trainer, no device — and
+        report its feed rate: the debugging probe behind
+        `python -m bigdl_tpu.dataset throughput`. Consumes up to
+        `batches` groups (or until `seconds` elapse) through the same
+        read_ahead/echo/stack stages the trainers use, with placement
+        replaced by a host no-op."""
+        import time
+        from bigdl_tpu.dataset.prefetch import stack_batches
+        it = self.host_batches(iter(self.dataset), k=k)
+        if k > 1:
+            it = stack_batches(it, k)
+        t0 = time.perf_counter()
+        n_batches = 0
+        n_records = 0
+        for item in it:
+            if k > 1:
+                xs, _ys, n_valid = item
+                n_batches += int(n_valid)
+                n_records += int(n_valid) * int(xs.shape[1])
+            else:
+                x, _y = item
+                n_batches += 1
+                n_records += int(np.asarray(x).shape[0])
+            if batches is not None and n_batches >= batches:
+                break
+            if seconds is not None \
+                    and time.perf_counter() - t0 >= seconds:
+                break
+        dt = max(time.perf_counter() - t0, 1e-9)
+        return {"batches": n_batches, "records": n_records,
+                "seconds": round(dt, 3),
+                "batches_per_sec": round(n_batches / dt, 2),
+                "records_per_sec": round(n_records / dt, 1),
+                "workers": self.workers, "echo": self.echo}

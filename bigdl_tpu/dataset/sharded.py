@@ -290,13 +290,37 @@ class ShardedRecordDataset(DataSet):
     This is the capability match for the reference's cached-partition
     SeqFile DataSet + MTImageFeatureToBatch, restructured as a host-side
     feeder for a single SPMD program (wrap with `prefetch_to_device`).
+
+    Two pipeline modes (docs/data.md):
+
+      * streaming (default) — N racy decode workers + bounded shuffle
+        buffer: maximum throughput, but the sample order is not
+        reproducible run-to-run, so mid-epoch resume is record-COUNT
+        exact only (fast_forward_batches docstring).
+      * exact=True — the sample stream is a pure function of
+        (seed, epoch, host): shard order is the (seed, epoch, host)
+        permutation (dataset/service.py host_shard_order), each shard's
+        records are visited in a stateless per-shard permutation, and
+        decode runs through the shared `ordered_map` worker pool
+        (parallel decode, submission-order output). Shuffle quality is
+        shard-order × within-shard instead of the streaming buffer;
+        memory stays one-shard. Mid-epoch kill-and-resume is
+        SAMPLE-EXACT: fast_forward_batches lands on the identical
+        record sequence the uninterrupted run would have trained.
+
+    Multi-host: `host_index`/`num_hosts` (or `set_host_sharding`, which
+    DistriOptimizer calls for multi-process jax) give each host a
+    disjoint, full-coverage slice of the shard files per epoch,
+    deterministic in (seed, epoch, host).
     """
 
     def __init__(self, shards: Union[str, Sequence[str]], batch_size: int,
                  transform: Optional[Callable] = None, shuffle: bool = True,
                  seed: int = 0, drop_last: bool = True,
                  num_workers: Optional[int] = None,
-                 shuffle_buffer: int = 1024, queue_depth: int = 256):
+                 shuffle_buffer: int = 1024, queue_depth: int = 256,
+                 exact: bool = False, host_index: Optional[int] = None,
+                 num_hosts: Optional[int] = None):
         super().__init__()
         if isinstance(shards, str):
             if os.path.isdir(shards):      # directory → all its .rec shards
@@ -306,29 +330,62 @@ class ShardedRecordDataset(DataSet):
         missing = [s for s in self.shards if not os.path.exists(s)]
         if missing:
             raise FileNotFoundError(f"shard files not found: {missing[:3]}")
+        from bigdl_tpu.dataset import service as _svc
         self.batch_size = batch_size
         self.transform = transform
         self.shuffle = shuffle
         self.seed = seed
         self.drop_last = drop_last
-        self.num_workers = num_workers or min(8, os.cpu_count() or 4)
+        self.num_workers = _svc.resolve_workers(num_workers) \
+            if num_workers is None else num_workers
         self.shuffle_buffer = shuffle_buffer
         self.queue_depth = queue_depth
+        self.exact = exact
+        self.host_index = host_index
+        self.num_hosts = num_hosts
         self._epoch = 0
         self._num_records: Optional[int] = None
         self._shard_counts: dict = {}
         self._skip_records = 0
+
+    # -------------------------------------------------- per-host sharding
+    def set_host_sharding(self, host_index: int, num_hosts: int):
+        """Pin this dataset to one host of a multi-host job: each epoch
+        it reads only its (seed, epoch, host)-deterministic slice of the
+        shard files — disjoint and fully covering across hosts
+        (dataset/service.py host_shard_order)."""
+        self.host_index, self.num_hosts = int(host_index), int(num_hosts)
+        self._num_records = None           # per-host count differs
+        return self
+
+    def _resolve_host(self) -> tuple:
+        if self.host_index is not None and self.num_hosts is not None:
+            return self.host_index, self.num_hosts
+        from bigdl_tpu.dataset import service as _svc
+        return _svc.default_host()
+
+    def _host_order(self, epoch: int) -> List[str]:
+        """This epoch's shard list for THIS host — the epoch-order
+        contract: deterministic in (seed, epoch, host), equal to the
+        legacy single-host permutation when num_hosts == 1."""
+        from bigdl_tpu.dataset import service as _svc
+        hi, nh = self._resolve_host()
+        return _svc.host_shard_order(self.shards, self.seed, epoch,
+                                     hi, nh, shuffle=self.shuffle)
 
     def _shard_count(self, path: str) -> int:
         if path not in self._shard_counts:
             self._shard_counts[path] = sum(1 for _ in read_shard(path))
         return self._shard_counts[path]
 
-    # records per epoch (scans once, cached)
+    # records per epoch (scans once, cached). With host sharding the
+    # count is THIS host's share for the next epoch (the shard→host
+    # assignment re-deals per epoch; equal-sized shards make it stable)
     def num_records(self) -> int:
         if self._num_records is None:
-            self._num_records = sum(self._shard_count(p)
-                                    for p in self.shards)
+            hi, nh = self._resolve_host()
+            paths = self.shards if nh <= 1 else self._host_order(self._epoch)
+            self._num_records = sum(self._shard_count(p) for p in paths)
         return self._num_records
 
     def __len__(self):
@@ -352,15 +409,28 @@ class ShardedRecordDataset(DataSet):
         With multi-threaded decode the stream interleaving is not
         reproducible anyway, so the contract is record-count based: the
         resumed epoch yields exactly (epoch_batches - n_batches) batches of
-        not-yet-seen-this-epoch shard data."""
+        not-yet-seen-this-epoch shard data. In `exact` mode the stream IS
+        reproducible, so the same skip is SAMPLE-exact: the resumed epoch
+        yields the identical batches the uninterrupted run would have."""
         self._skip_records = n_batches * self.batch_size
 
+    # ---- resumable iterator-state protocol (dataset/service.py)
+    def state_dict(self) -> dict:
+        hi, nh = self._resolve_host()
+        return {"kind": "sharded", "version": 1, "seed": self.seed,
+                "epoch": self._epoch, "skip_records": self._skip_records,
+                "batch_size": self.batch_size, "exact": bool(self.exact),
+                "num_shards": len(self.shards),
+                "host_index": hi, "num_hosts": nh}
+
+    def load_state_dict(self, state: dict):
+        if state.get("kind") != "sharded":
+            raise ValueError(f"not a sharded dataset state: {state!r}")
+        self._epoch = int(state.get("epoch", 0))
+        self._skip_records = int(state.get("skip_records", 0))
+
     def _sample_stream(self, epoch: int, skip_records: int = 0) -> Iterator:
-        order = list(self.shards)
-        if self.shuffle:
-            order = [order[i] for i in
-                     np.random.RandomState(self.seed + epoch)
-                     .permutation(len(order))]
+        order = self._host_order(epoch)
         work = []                        # (path, records_to_skip_in_shard)
         for p in order:
             if skip_records > 0:
@@ -440,7 +510,74 @@ class ShardedRecordDataset(DataSet):
         return MiniBatch(np.stack(xs),
                          None if ys[0] is None else np.stack(ys))
 
+    # ------------------------------------------------------- exact mode
+    def _shard_record_order(self, epoch: int, shard_index: int,
+                            count: int) -> np.ndarray:
+        """Within-shard record visit order — a STATELESS permutation in
+        (seed, epoch, shard): skipping whole shards on resume never
+        perturbs later shards' orders (a shared rng stream would)."""
+        if not self.shuffle:
+            return np.arange(count)
+        mix = (self.seed * 7919 + epoch * 104_729
+               + shard_index * 131) & 0x7FFFFFFF
+        return np.random.RandomState(mix).permutation(count)
+
+    def _exact_iter(self, epoch: int, skip_records: int) -> Iterator:
+        """Deterministic epoch stream: shards in (seed, epoch, host)
+        order, records within a shard in a stateless permutation, decode
+        through the shared ordered worker pool (dataset/service.py
+        ordered_map — parallel, submission-order output). The whole
+        stream is a pure function of (seed, epoch, host), so a resume
+        skip of N records lands on the identical sequence an
+        uninterrupted run would have produced — and the skip costs one
+        frame parse of the partial shard, not a re-decode."""
+        from bigdl_tpu import observe
+        from bigdl_tpu.dataset import service as _svc
+        from bigdl_tpu.utils import recordio
+
+        order = self._host_order(epoch)
+        work = []                          # (path, record_indices)
+        for si, path in enumerate(order):
+            c = self._shard_count(path)
+            if skip_records >= c:
+                skip_records -= c          # drop the whole shard
+                continue
+            idx = self._shard_record_order(epoch, si, c)
+            if skip_records:
+                idx = idx[skip_records:]
+                skip_records = 0
+            work.append((path, idx))
+
+        def payload_stream():
+            for path, idx in work:
+                with observe.phase("data/read", cat="data"):
+                    with open(path, "rb") as fh:
+                        blob = fh.read()
+                    payloads = recordio.parse_records(blob)
+                for j in idx:
+                    yield payloads[j]
+
+        def decode(payload):
+            with observe.phase("data/decode", cat="data"):
+                return self._decode_sample(payload)
+
+        pending: List = []
+        for sample in _svc.ordered_map(decode, payload_stream(),
+                                       self.num_workers):
+            pending.append(sample)
+            if len(pending) == self.batch_size:
+                yield self._make_batch(pending)
+                pending = []
+        if pending and not self.drop_last:
+            yield self._make_batch(pending)
+
     def _raw_iter(self):
+        if self.exact:
+            epoch = self._epoch
+            self._epoch += 1
+            skip_records, self._skip_records = self._skip_records, 0
+            yield from self._exact_iter(epoch, skip_records)
+            return
         epoch = self._epoch
         self._epoch += 1
         skip_records, self._skip_records = self._skip_records, 0

@@ -299,6 +299,40 @@ def phase_table(snapshot: dict) -> List[dict]:
     return rows
 
 
+def data_wait_fraction(snapshot: dict) -> Optional[dict]:
+    """Feed-health headline: the fraction of the training step loop the
+    trainer spent WAITING on the input pipeline (`train/data_wait` — the
+    span `_observed_batches` wraps around each batch fetch) over the
+    loop's total accounted time (data_wait + dispatch + flush +
+    checkpoint, the disjoint sibling phases of the step loop). This is
+    the number `bench.py input` gates on and the input service exists
+    to drive to ~0; None when the snapshot has no step-loop phases."""
+    hists = snapshot.get("histograms", {})
+
+    def total(name):
+        h = hists.get(f"phase/{name}")
+        return (float(h["sum"]), int(h["count"])) \
+            if h and h.get("count") else (0.0, 0)
+
+    wait_s, wait_n = total("train/data_wait")
+    # denominator: the true loop wall (train/step_wall_s — the full
+    # period between successive batch requests, optim/local.py
+    # _observed_batches); older run logs without it fall back to the
+    # sum of the instrumented step-loop phases (an overestimate of the
+    # fraction — uninstrumented loop time is dropped)
+    wall = hists.get("train/step_wall_s")
+    if wall and wall.get("count"):
+        loop_s = max(float(wall["sum"]), wait_s)
+    else:
+        loop_s = sum(total(n)[0] for n in (
+            "train/data_wait", "train/dispatch", "train/flush",
+            "train/checkpoint"))
+    if not wait_n or loop_s <= 0:
+        return None
+    return {"data_wait_s": wait_s, "step_loop_s": loop_s,
+            "fraction": wait_s / loop_s, "waits": wait_n}
+
+
 # ------------------------------------------------ reference-style facade
 class IterationMetrics:
     """Phase-timing accumulator (reference: optim/Metrics.scala:31-123 —

@@ -15,7 +15,7 @@ import json
 import sys
 from typing import List, Optional
 
-from bigdl_tpu.observe.metrics import phase_table
+from bigdl_tpu.observe.metrics import data_wait_fraction, phase_table
 
 
 def load_jsonl(path: str) -> List[dict]:
@@ -50,6 +50,15 @@ def render_report(recs: List[dict]) -> str:
     out = []
     out.append(f"run {last.get('run_id', '?')} · p{last.get('process_index', 0)}"
                f" · {len(recs)} flushes · final step {last.get('step', 0)}")
+    dw = data_wait_fraction(last)
+    if dw is not None:
+        # the feed-health headline (docs/data.md): how much of the step
+        # loop waited on the input pipeline — the number the streaming
+        # input service drives to ~0, reproducible from any run log
+        out.append(
+            f"data-wait: {dw['fraction']:.1%} of the step loop "
+            f"({dw['data_wait_s']:.3f}s / {dw['step_loop_s']:.3f}s over "
+            f"{dw['waits']} batch waits)")
     out.append("")
     out.append(render_phase_table(last))
     counters = last.get("counters", {})
@@ -88,6 +97,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.json:
             last = recs[-1] if recs else {}
             print(json.dumps({"flushes": len(recs),
+                              "data_wait": data_wait_fraction(last),
                               "phases": phase_table(last),
                               "counters": last.get("counters", {}),
                               "gauges": last.get("gauges", {})}))

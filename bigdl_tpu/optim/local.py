@@ -556,23 +556,62 @@ class Optimizer:
         observe.counter("data/h2d_bytes").inc(xd.nbytes + yd.nbytes)
         return xd, yd
 
+    def _make_service(self):
+        """The streaming input service feeding this trainer
+        (dataset/service.py: background read-ahead → echo →
+        [stacking →] double-buffered H2D), or None when
+        BIGDL_TPU_DATA_SERVICE=0 or the dataset already places its own
+        batches (PrefetchDataSet). Built per epoch pass — knob flips
+        between optimize() calls must take effect (tests toggle them)."""
+        from bigdl_tpu.dataset import service as _svc
+        from bigdl_tpu.dataset.prefetch import PrefetchDataSet
+        if isinstance(self.dataset, PrefetchDataSet) \
+                or not _svc.service_enabled():
+            return None
+        return _svc.InputService(self.dataset,
+                                 echo=getattr(self, "_echo", 1),
+                                 seed=self.seed)
+
+    def _echoed(self, it):
+        """Apply data echoing to a host-batch stream on the legacy
+        (service-off) feed path — echo semantics must not depend on the
+        service knob. Consumes the one-shot resume echo offset."""
+        echo = getattr(self, "_echo", 1)
+        tr = getattr(self.dataset, "echo_transform", None)
+        if echo <= 1 and tr is None:
+            return it
+        from bigdl_tpu.dataset import service as _svc
+        skip, self._echo_skip = getattr(self, "_echo_skip", 0), 0
+        return _svc.echo_batches(it, echo, skip_first=skip, transform=tr,
+                                 seed=self.seed, epoch=self.state["epoch"],
+                                 start_index=getattr(self, "_echo_start", 0))
+
     def _batch_iter(self, epoch_iter):
-        """Stream (x, y) batches through host→device prefetch so the H2D
-        copy of batch k+1 overlaps step k's compute (the reference keeps
-        the chip fed with cached partitions + Engine.default data threads;
-        here it is one background placement thread —
-        dataset/prefetch.py). BIGDL_TPU_PREFETCH_SIZE=0 disables."""
+        """Stream (x, y) batches through the input service (background
+        read-ahead + echo + double-buffered placement —
+        dataset/service.py) or, with BIGDL_TPU_DATA_SERVICE=0, the
+        legacy host→device prefetch so the H2D copy of batch k+1 still
+        overlaps step k's compute (BIGDL_TPU_PREFETCH_SIZE=0 disables
+        that too). Batch content is identical on every path."""
         from bigdl_tpu.dataset.prefetch import (PrefetchDataSet,
                                                 prefetch_to_device)
         from bigdl_tpu.utils import config
+        svc = self._make_service()
+        if svc is not None:
+            skip, self._echo_skip = getattr(self, "_echo_skip", 0), 0
+            return svc.batches(
+                epoch_iter, lambda b: self._place_batch(*b),
+                epoch=self.state["epoch"], echo_skip=skip,
+                start_index=getattr(self, "_echo_start", 0))
         size = config.get("PREFETCH_SIZE")
+        it = self._echoed(epoch_iter)
         if (not size or size <= 0
                 or isinstance(self.dataset, PrefetchDataSet)):
             # disabled, or the dataset already prefetches — a second
             # layer would double-buffer and double-place every batch
-            return (self._place_batch(x, y) for x, y in epoch_iter)
+            return (self._place_batch(x, y) for x, y in it)
         return prefetch_to_device(
-            epoch_iter, size, place_fn=lambda b: self._place_batch(*b))
+            it, size, place_fn=lambda b: self._place_batch(*b))
 
     def _fused_batch_iter(self, epoch_iter):
         """K-grouped variant of `_batch_iter` for the fused dispatch path:
@@ -581,15 +620,25 @@ class Optimizer:
         ride one H2D transfer instead of K. Yields (xs, ys, n_valid)
         triples — the epoch tail is PADDED to the same [K, ...] shape
         with n_valid < K (single-variant shape bucketing; the pad steps
-        are masked out device-side)."""
+        are masked out device-side). With the input service on, decode
+        runs ahead on a reader thread and placement of super-batch N+1
+        is double-buffered against compute of N (dataset/service.py)."""
         from bigdl_tpu.dataset.prefetch import (prefetch_to_device,
                                                 stack_batches)
         from bigdl_tpu.utils import config
-        grouped = stack_batches(epoch_iter, self.steps_per_call)
 
         def place(b):
             return self._place_stacked_batch(b[0], b[1]) + (b[2],)
 
+        svc = self._make_service()
+        if svc is not None:
+            skip, self._echo_skip = getattr(self, "_echo_skip", 0), 0
+            return svc.fused_batches(
+                epoch_iter, self.steps_per_call, place,
+                epoch=self.state["epoch"], echo_skip=skip,
+                start_index=getattr(self, "_echo_start", 0))
+        grouped = stack_batches(self._echoed(epoch_iter),
+                                self.steps_per_call)
         size = config.get("PREFETCH_SIZE")
         if not size or size <= 0:
             return (place(b) for b in grouped)
@@ -750,6 +799,19 @@ class Optimizer:
         trees, meta = ckpt.load_checkpoint(snap)
         self._resume_trees = trees
         meta.pop("epoch_finished", None)  # don't re-fire per-epoch triggers
+        # pipeline state (dataset/service.py): the batch cursor drives
+        # the fast-forward below; the rest is cross-checked against the
+        # LIVE pipeline so a changed echo factor or dataset seed — which
+        # would silently break the sample-exact resume contract — is at
+        # least loud
+        data_state = meta.pop("data_state", None)
+        if data_state is not None:
+            from bigdl_tpu.dataset import service as _svc
+            from bigdl_tpu.utils import config as _cfg
+            for problem in _svc.validate_state(
+                    self.dataset, data_state,
+                    max(1, int(_cfg.get("DATA_ECHO")))):
+                log.warning("resume data_state: %s", problem)
         # counters rewind on resume — the validate/checkpoint dedup marks
         # from the failed run must not suppress the replayed iterations
         self.__dict__.pop("_last_val_neval", None)
@@ -780,10 +842,23 @@ class Optimizer:
         `train/data_wait`). With prefetch on this is pure queue wait —
         host pipeline + H2D run in the worker thread and show up in the
         trace as `data/placement` spans on that thread; with prefetch off
-        it includes the inline decode + placement."""
+        it includes the inline decode + placement.
+
+        The `train/step_wall_s` histogram records the FULL period between
+        successive batch requests (data wait + everything the loop body
+        did with the previous batch) — the honest denominator for the
+        data-wait fraction (observe.metrics.data_wait_fraction): summing
+        only the instrumented phases would drop uninstrumented loop time
+        and overstate the fraction."""
         it = iter(it)
         phase = observe.phase
+        wall = observe.histogram("train/step_wall_s")
+        last = None
         while True:
+            now = time.perf_counter()
+            if last is not None:
+                wall.record(now - last)
+            last = now
             with phase("train/data_wait"):
                 try:
                     batch = next(it)
@@ -809,6 +884,10 @@ class Optimizer:
         # run that died with the previous attempt
         self._failover_pending = None
         self._nonfinite_run = 0
+        # data echoing factor (dataset/service.py; Choi et al.): read
+        # once per optimize() so the cursor math below and the snapshot
+        # data_state agree for the whole run
+        self._echo = max(1, int(_cfg.get("DATA_ECHO")))
         rng = jax.random.PRNGKey(self.seed)
         # disjoint key namespace from the 0xBD1 init fold below — a step
         # key derived straight from (rng, neval) would collide with the
@@ -888,30 +967,42 @@ class Optimizer:
             # the surviving iterations see the same stream a crash-free run
             # would). Datasets exposing fast_forward_batches skip at the
             # record-reader level (no decode); others consume and discard.
+            # the cursor counts TRAINED batches; with data echoing each
+            # dataset batch trains _echo times, so the dataset skip is
+            # cursor // echo and the current batch resumes at its
+            # cursor % echo-th echo (the snapshot data_state's echo
+            # counter — dataset/service.py)
             skip = st.get("batch_in_epoch", 0)
-            if skip > 0:
-                log.info("mid-epoch resume: fast-forwarding %d batches of "
-                         "epoch %d", skip, st["epoch"])
+            echo = getattr(self, "_echo", 1)
+            ds_skip, self._echo_skip = (divmod(skip, echo) if echo > 1
+                                        else (skip, 0))
+            self._echo_start = ds_skip
+            if ds_skip > 0:
+                log.info("mid-epoch resume: fast-forwarding %d dataset "
+                         "batches of epoch %d (cursor %d%s)",
+                         ds_skip, st["epoch"], skip,
+                         f", echo offset {self._echo_skip}"
+                         if echo > 1 else "")
                 if hasattr(self.dataset, "fast_forward_batches"):
-                    self.dataset.fast_forward_batches(skip)
-                    skip = 0
+                    self.dataset.fast_forward_batches(ds_skip)
+                    ds_skip = 0
             epoch_iter = (iter(self._fused_epoch_source()) if use_fused
                           else iter(self.dataset))
-            if skip > 0:
+            if ds_skip > 0:
                 # consume-and-discard fallback: decodes every skipped
                 # batch, so a late-epoch resume can cost close to a full
                 # epoch replay — datasets wanting cheap resume implement
                 # fast_forward_batches (record-level skip, no decode)
                 t_ff = time.time()
                 skipped = 0
-                for _ in range(skip):
+                for _ in range(ds_skip):
                     try:
                         next(epoch_iter)
                     except StopIteration:
                         break
                     skipped += 1
                 log.info("fast-forward consumed %d/%d batches in %.1fs",
-                         skipped, skip, time.time() - t_ff)
+                         skipped, ds_skip, time.time() - t_ff)
             # nan@step:N injection (resilience/faults.py): wrap the raw
             # stream AFTER the cursor skip so batch i trains iteration
             # neval + i + 1 — identity when no nan event is armed
@@ -1369,9 +1460,16 @@ class Optimizer:
     def _snapshot_extra_meta(self) -> Dict:
         """Provenance recorded into the snapshot meta; the distributed
         trainer adds its mesh layout (elastic restores log what the
-        source slice looked like)."""
+        source slice looked like). `data_state` is the resumable
+        iterator-state protocol (dataset/service.py pipeline_state):
+        epoch + batch cursor + echo counter + the dataset's own state,
+        so `resume()` restores the PIPELINE, not just params."""
+        from bigdl_tpu.dataset import service as _svc
         return {"steps_per_call": self.steps_per_call,
-                "accum_steps": self.accum_steps}
+                "accum_steps": self.accum_steps,
+                "data_state": _svc.pipeline_state(
+                    self.dataset, self.state.get("batch_in_epoch", 0),
+                    getattr(self, "_echo", 1))}
 
     def _finish_checkpoints(self):
         """Join the in-flight background snapshot write (shutdown /

@@ -100,6 +100,16 @@ class DistriOptimizer(Optimizer):
         # composed slice×data ways — the global batch divides over BOTH
         # tiers of a two-tier mesh
         self._data_axis_size = data_axis_size(self.mesh)
+        # multi-host feed: a host-shardable dataset (ShardedRecordDataset
+        # and friends — dataset/service.py host_shard_order) gets this
+        # process's (host, num_hosts) pinned so each host reads a
+        # disjoint, fully-covering slice of the shard files per epoch;
+        # an explicit set_host_sharding by the caller wins
+        if (jax.process_count() > 1
+                and hasattr(dataset, "set_host_sharding")
+                and getattr(dataset, "num_hosts", None) is None):
+            dataset.set_host_sharding(jax.process_index(),
+                                      jax.process_count())
 
     # ------------------------------------------------------------- placement
     def _param_shardings(self, params):

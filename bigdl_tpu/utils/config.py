@@ -223,6 +223,34 @@ _register("SERVE_INT8", False, _bool,
           "QuantizedLinear routes through the fused Pallas "
           "kernels/quantized_matmul.py). Per-model override: "
           "ServeEngine.register(int8=...)")
+_register("DATA_SERVICE", True, _bool,
+          "Streaming input service (dataset/service.py): trainers feed "
+          "through the staged host pipeline — background read-ahead, "
+          "optional echoing, and double-buffered H2D placement — instead "
+          "of the plain prefetch thread. 0 = the pre-service feed path "
+          "(batch content is identical either way; docs/data.md)")
+_register("DATA_WORKERS", 0, int,
+          "Host-pipeline decode workers shared by the record-shard / "
+          "vision / text loaders (dataset/service.py resolve_workers). "
+          "0 = auto: min(8, max(4, cpu_count)) — more threads than cores "
+          "is right for IO-bound record fetch, which is what the workers "
+          "overlap (reference: MTImageFeatureToBatch parallelism knob)")
+_register("DATA_ECHO", 1, int,
+          "Data echoing (Choi et al., 'Faster Neural Network Training "
+          "with Data Echoing'): each host batch is trained N times "
+          "before the next one is read, multiplying effective training "
+          "throughput for IO-bound runs by up to N. Echoed copies are "
+          "re-augmented when the dataset exposes `echo_transform`. The "
+          "resume cursor counts echoed batches (the echo counter rides "
+          "the snapshot's data_state) — keep N fixed across a "
+          "kill/resume pair (dataset/service.py echo_batches)")
+_register("DATA_DOUBLE_BUFFER", 1, int,
+          "Double-buffered H2D placement depth under the input service: "
+          "a background thread places super-batch N+1 while the device "
+          "computes N (depth 1 = one placed batch queued + one in "
+          "flight, the classic double buffer; 0 = synchronous "
+          "placement). Ignored when BIGDL_TPU_DATA_SERVICE=0, where "
+          "PREFETCH_SIZE keeps its legacy meaning")
 _register("BENCH_LOCK_FILE", "/tmp/bigdl_tpu_bench.lock", str,
           "Lockfile serializing bench.py against tools/tpu_watch.sh so "
           "the harness cannot pollute the CPU trend series (ADVICE r5 #5)")

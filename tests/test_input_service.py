@@ -1,0 +1,485 @@
+"""Streaming input service (dataset/service.py, docs/data.md):
+per-host sharding contract, pipeline-stage primitives, data echoing,
+sample-exact kill-and-resume, service on/off bit-identity, the
+iterator-state protocol, the dataset CLI, and the data-wait report
+headline."""
+
+import hashlib
+import json
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu import observe
+from bigdl_tpu.dataset import ArrayDataSet, cifar, mnist, movielens, news20
+from bigdl_tpu.dataset import service
+from bigdl_tpu.dataset.sharded import (ShardedRecordDataset,
+                                       generate_synthetic)
+from bigdl_tpu.observe.metrics import data_wait_fraction
+from bigdl_tpu.optim.local import Optimizer
+from bigdl_tpu.optim.method import SGD
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.resilience import faults
+from bigdl_tpu.utils import checkpoint as ckpt
+
+
+# ------------------------------------------------------------- helpers
+def _data(n=96, d=8, seed=0):
+    r = np.random.RandomState(seed)
+    return (r.randn(n, d).astype(np.float32),
+            r.randint(0, 2, n).astype(np.int32))
+
+
+def _mlp(d=8):
+    return nn.Sequential(nn.Linear(d, 2), nn.LogSoftMax())
+
+
+def _trees_equal(a, b):
+    leaves = jax.tree.leaves(
+        jax.tree.map(lambda x, y: bool(np.array_equal(np.asarray(x),
+                                                      np.asarray(y))),
+                     a, b))
+    return all(leaves)
+
+
+def _hash(x):
+    return hashlib.sha1(
+        np.ascontiguousarray(np.asarray(x)).tobytes()).hexdigest()
+
+
+def _assert_prefix_plus_exact_replay(crash_hashes, oracle_hashes,
+                                     resume_at, min_tail):
+    """The crash run's CONSUMED stream must be a prefix of the oracle
+    stream (attempt 1) followed by an exact replay of the oracle stream
+    from `resume_at` (the resumed attempt) — the sample-exact contract
+    at the batch-hash level."""
+    for i in range(len(crash_hashes) + 1):
+        tail = crash_hashes[i:]
+        if (len(tail) >= min_tail
+                and crash_hashes[:i] == oracle_hashes[:i]
+                and tail == oracle_hashes[resume_at:resume_at + len(tail)]):
+            return
+    raise AssertionError(
+        "crash-run batch stream is not trained-prefix + exact replay "
+        f"from batch {resume_at}")
+
+
+class _HashingDataSet:
+    """Record the hash of every batch the pipeline CONSUMES, in consume
+    order — the probe for the sample-exact resume contract."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.hashes = []
+
+    def __iter__(self):
+        for x, y in self.inner:
+            self.hashes.append(_hash(x))
+            yield x, y
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+# ---------------------------------------------- per-host file sharding
+def test_host_shard_order_contract():
+    shards = [f"part-{i:03d}" for i in range(17)]   # deliberately ragged
+    for epoch in (0, 3):
+        parts = [service.host_shard_order(shards, seed=5, epoch=epoch,
+                                          host_index=h, num_hosts=4)
+                 for h in range(4)]
+        flat = sum(parts, [])
+        assert len(flat) == len(shards)             # full coverage
+        assert set(flat) == set(shards)             # no overlap
+        # deterministic in (seed, epoch, host)
+        again = service.host_shard_order(shards, 5, epoch, 2, 4)
+        assert again == parts[2]
+    # epochs re-deal the assignment (the shard-order shuffle contract)
+    assert (service.host_shard_order(shards, 5, 0, 0, 4)
+            != service.host_shard_order(shards, 5, 1, 0, 4))
+    # num_hosts == 1 reproduces the legacy single-host epoch order
+    legacy = [shards[i]
+              for i in np.random.RandomState(5 + 2).permutation(17)]
+    assert service.host_shard_order(shards, 5, 2, 0, 1) == legacy
+    # shuffle=False is the plain strided split
+    assert service.host_shard_order(shards, 5, 0, 1, 4,
+                                    shuffle=False) == shards[1::4]
+    with pytest.raises(ValueError):
+        service.host_shard_order(shards, 0, 0, 4, 4)
+
+
+# --------------------------------------------------- stage primitives
+def test_ordered_map_preserves_order_and_surfaces_errors():
+    assert list(service.ordered_map(lambda v: v * 2, range(50), 4)) \
+        == [v * 2 for v in range(50)]
+    assert list(service.ordered_map(lambda v: v + 1, range(5), 1)) \
+        == [1, 2, 3, 4, 5]
+
+    def boom(v):
+        if v == 7:
+            raise RuntimeError("decode failed")
+        return v
+
+    with pytest.raises(RuntimeError, match="decode failed"):
+        list(service.ordered_map(boom, range(20), 4))
+
+
+def test_read_ahead_preserves_order_and_propagates_errors():
+    batches = [(np.full(2, i), np.full(2, i)) for i in range(11)]
+    got = [int(x[0]) for x, _ in service.read_ahead(iter(batches), 3)]
+    assert got == list(range(11))
+    assert list(service.read_ahead(iter([]), 2)) == []
+
+    def bad():
+        yield batches[0]
+        raise OSError("shard truncated")
+
+    with pytest.raises(OSError, match="shard truncated"):
+        list(service.read_ahead(bad(), 2))
+
+
+def test_echo_batches_repeats_skips_and_reaugments():
+    batches = [(np.full(2, i, np.float32), np.full(2, i)) for i in range(4)]
+    got = [int(x[0]) for x, _ in service.echo_batches(iter(batches), 3)]
+    assert got == [0, 0, 0, 1, 1, 1, 2, 2, 2, 3, 3, 3]
+    # resume mid-group: skip_first drops trained echoes of the FIRST batch
+    got = [int(x[0]) for x, _ in
+           service.echo_batches(iter(batches[1:]), 3, skip_first=2,
+                                start_index=1)]
+    assert got == [1, 2, 2, 2, 3, 3, 3]
+
+    def reaug(x, y, rng):
+        return x + rng.randn(*x.shape).astype(np.float32), y
+
+    def run(it):
+        return [x.copy() for x, _ in
+                service.echo_batches(it, 2, transform=reaug, seed=9,
+                                     epoch=1)]
+
+    a, b = run(iter(batches)), run(iter(batches))
+    # echo copies are re-augmented (differ from the original) but the
+    # augmentation is stateless in (seed, epoch, batch, echo): replays
+    # are bit-identical — the sample-exact-resume requirement
+    assert not np.array_equal(a[0], a[1])
+    for x1, x2 in zip(a, b):
+        assert np.array_equal(x1, x2)
+    with pytest.raises(ValueError):
+        list(service.echo_batches(iter(batches), 2, skip_first=2))
+
+
+def test_double_buffer_depth_zero_is_synchronous():
+    batches = [(np.full(1, i), np.full(1, i)) for i in range(5)]
+    place = lambda b: (b[0] * 10, b[1])  # noqa: E731
+    assert [int(x[0]) for x, _ in
+            service.double_buffer(iter(batches), place, depth=0)] \
+        == [0, 10, 20, 30, 40]
+    assert [int(x[0]) for x, _ in
+            service.double_buffer(iter(batches), place, depth=1)] \
+        == [0, 10, 20, 30, 40]
+
+
+# ------------------------------------------------ exact sharded pipeline
+def test_exact_sharded_fast_forward_is_sample_exact(tmp_path):
+    generate_synthetic(str(tmp_path), 64, 4, height=8, width=8, classes=7)
+
+    def make():
+        return ShardedRecordDataset(str(tmp_path), 8, shuffle=True,
+                                    seed=3, exact=True, num_workers=3)
+
+    oracle = [(_hash(x), _hash(y)) for x, y in make()]
+    assert len(oracle) == 8
+    for skip in (1, 3, 7):
+        ds = make()
+        ds.fast_forward_batches(skip)
+        assert [(_hash(x), _hash(y)) for x, y in ds] == oracle[skip:]
+    # and the stream is reproducible run-to-run (multi-worker decode
+    # reassembles in submission order)
+    assert [(_hash(x), _hash(y)) for x, y in make()] == oracle
+
+
+def test_exact_sharded_host_partition_covers_all_records(tmp_path):
+    generate_synthetic(str(tmp_path), 48, 6, height=8, width=8)
+
+    def records(host, hosts):
+        ds = ShardedRecordDataset(str(tmp_path), 4, shuffle=True, seed=1,
+                                  exact=True, num_workers=2,
+                                  host_index=host, num_hosts=hosts)
+        return [_hash(x[i]) for x, _ in ds for i in range(x.shape[0])]
+
+    whole = set(records(0, 1))
+    assert len(whole) == 48
+    h0, h1 = records(0, 2), records(1, 2)
+    assert set(h0) | set(h1) == whole           # full coverage
+    assert not set(h0) & set(h1)                # disjoint
+    assert len(h0) + len(h1) == 48
+
+
+def test_sharded_state_dict_roundtrip(tmp_path):
+    generate_synthetic(str(tmp_path), 32, 2, height=8, width=8)
+    ds = ShardedRecordDataset(str(tmp_path), 4, seed=7, exact=True)
+    ds.set_epoch(3)
+    ds.fast_forward_batches(2)
+    st = ds.state_dict()
+    assert st["kind"] == "sharded" and st["seed"] == 7
+    assert st["epoch"] == 3 and st["skip_records"] == 8
+    ds2 = ShardedRecordDataset(str(tmp_path), 4, seed=7, exact=True)
+    ds2.load_state_dict(st)
+    assert ds2._epoch == 3 and ds2._skip_records == 8
+    with pytest.raises(ValueError):
+        ds2.load_state_dict({"kind": "array"})
+
+
+# --------------------------------------- in-memory loader state protocol
+def test_loader_shims_share_the_state_protocol():
+    for make in (lambda: mnist.dataset(batch_size=16, n_synthetic=64),
+                 lambda: cifar.dataset(batch_size=16, n_synthetic=64),
+                 lambda: movielens.dataset(batch_size=16, n_synthetic=64),
+                 lambda: news20.dataset(batch_size=8, n_synthetic=40,
+                                        seq_len=16)):
+        ds = make()
+        st = ds.state_dict()
+        assert st["kind"] == "array" and "seed" in st
+        oracle = [(_hash(x), _hash(y)) for x, y in make()]
+        ds.fast_forward_batches(2)
+        # exact index-offset skip == the uninterrupted run's tail
+        assert [(_hash(x), _hash(y)) for x, y in ds] == oracle[2:]
+        ds.load_state_dict({"kind": "array", "epoch": 5,
+                            "skip_batches": 1})
+        assert ds._epoch == 5 and ds._skip_batches == 1
+
+
+# ----------------------------------------------- trainer: on/off identity
+def _train(tmp_path, k, iters, fault=None, seed=3, dataset=None,
+           ckpt_every=2):
+    x, y = _data()
+    ds = dataset if dataset is not None else \
+        ArrayDataSet(x, y, 8, drop_last=True, shuffle=True, seed=2)
+    opt = Optimizer(_mlp(), ds, nn.ClassNLLCriterion(), SGD(0.1),
+                    seed=seed, steps_per_call=k)
+    opt.set_end_when(Trigger.max_iteration(iters))
+    if tmp_path is not None:
+        opt.set_checkpoint(str(tmp_path), Trigger.several_iteration(ckpt_every))
+    if fault:
+        faults.configure(fault)
+        params, state = opt.optimize_with_retry(retries=3, window_s=600)
+    else:
+        params, state = opt.optimize()
+    return opt, params
+
+
+@pytest.mark.parametrize("k", [1, 4])
+def test_service_on_off_trains_bit_identical(monkeypatch, k):
+    monkeypatch.setenv("BIGDL_TPU_DATA_SERVICE", "1")
+    _, p_on = _train(None, k, 10)
+    monkeypatch.setenv("BIGDL_TPU_DATA_SERVICE", "0")
+    _, p_off = _train(None, k, 10)
+    assert _trees_equal(p_on, p_off)
+
+
+def test_service_distri_bit_identical(monkeypatch):
+    """Same identity through DistriOptimizer: the double-buffer thread
+    runs the mesh-sharded placement (`_place_stacked_batch`) off the
+    main thread and must change nothing."""
+    from bigdl_tpu.parallel import DistriOptimizer, create_mesh
+    mesh = create_mesh(drop_trivial_axes=True)
+    x, y = _data()
+
+    def run():
+        ds = ArrayDataSet(x, y, 16, drop_last=True, shuffle=True, seed=2)
+        opt = DistriOptimizer(_mlp(), ds, nn.ClassNLLCriterion(),
+                              SGD(0.1), mesh=mesh, seed=0,
+                              steps_per_call=2)
+        opt.set_end_when(Trigger.max_iteration(4))
+        p, _ = opt.optimize()
+        return jax.tree.map(np.asarray, p)
+
+    monkeypatch.setenv("BIGDL_TPU_DATA_SERVICE", "1")
+    p_on = run()
+    monkeypatch.setenv("BIGDL_TPU_DATA_SERVICE", "0")
+    p_off = run()
+    assert _trees_equal(p_on, p_off)
+
+
+# --------------------------------------- kill-and-resume sample-exactness
+def test_kill_resume_is_sample_exact_per_batch_hashes(tmp_path):
+    """Acceptance: crash at step 7, auto-resume, and the TRAINED batch
+    stream is sample-exact vs the uninterrupted run — the trained prefix
+    matches, the resumed tail replays the identical batches (per-batch
+    hashes), and the final params/slots are bit-identical."""
+    x, y = _data()
+    oracle_ds = _HashingDataSet(ArrayDataSet(x, y, 8, drop_last=True,
+                                             shuffle=True, seed=2))
+    oracle_opt, oracle_p = _train(tmp_path / "oracle", 4, 12,
+                                  dataset=oracle_ds)
+    crash_ds = _HashingDataSet(ArrayDataSet(x, y, 8, drop_last=True,
+                                            shuffle=True, seed=2))
+    crash_opt, crash_p = _train(tmp_path / "crash", 4, 12,
+                                fault="step:7:crash", dataset=crash_ds)
+    assert _trees_equal(crash_p, oracle_p)
+    assert _trees_equal(crash_opt.slots, oracle_opt.slots)
+    # the crash landed after the iteration-8 checkpoint: the resumed
+    # attempt re-enters at batch 8 and must replay EXACTLY the batches
+    # the oracle trained there (fast-forward is index-exact, the
+    # service pipeline is order-preserving)
+    n_resumed = 12 - 8
+    assert crash_ds.hashes[-n_resumed:] == oracle_ds.hashes[8:12]
+    assert crash_ds.hashes[:8] == oracle_ds.hashes[:8]
+    assert ckpt.latest_checkpoint(str(tmp_path / "crash"))
+
+
+def test_kill_resume_sample_exact_on_exact_sharded(tmp_path, monkeypatch):
+    """Same contract through the record-shard pipeline in exact mode,
+    with multi-worker decode and shuffle on."""
+    generate_synthetic(str(tmp_path / "shards"), 96, 4, height=8, width=8,
+                       classes=2)
+
+    def make():
+        def transform(img, label):
+            return (img.astype(np.float32).reshape(-1) / 255.0,
+                    np.int32(label % 2))
+        return _HashingDataSet(ShardedRecordDataset(
+            str(tmp_path / "shards"), 8, transform=transform,
+            shuffle=True, seed=5, exact=True, num_workers=3))
+
+    def train(tag, fault=None):
+        ds = make()
+        opt = Optimizer(_mlp(d=192), ds, nn.ClassNLLCriterion(), SGD(0.1),
+                        seed=3, steps_per_call=2)
+        opt.set_checkpoint(str(tmp_path / tag),
+                           Trigger.several_iteration(2))
+        opt.set_end_when(Trigger.max_iteration(10))
+        if fault:
+            faults.configure(fault)
+            p, _ = opt.optimize_with_retry(retries=3, window_s=600)
+        else:
+            p, _ = opt.optimize()
+        return ds, opt, p
+
+    o_ds, o_opt, o_p = train("oracle")
+    c_ds, c_opt, c_p = train("crash", fault="step:5:crash")
+    assert _trees_equal(c_p, o_p)
+    # checkpoint at 6 (K=2 boundary), crash, resume replays from batch 6.
+    # The read-ahead thread may legitimately CONSUME a batch or two past
+    # the last trained step, so assert the stream shape instead of fixed
+    # offsets: attempt 1 consumed a prefix of the oracle stream, and the
+    # resumed attempt replays the oracle stream from the cursor exactly
+    _assert_prefix_plus_exact_replay(c_ds.hashes, o_ds.hashes,
+                                     resume_at=6, min_tail=4)
+
+
+# ---------------------------------------------------------- data echoing
+def test_echo_trains_each_batch_n_times(monkeypatch):
+    monkeypatch.setenv("BIGDL_TPU_DATA_ECHO", "2")
+    x, y = _data(48)
+    ds = _HashingDataSet(ArrayDataSet(x, y, 8, drop_last=True,
+                                      shuffle=False))
+    opt = Optimizer(_mlp(), ds, nn.ClassNLLCriterion(), SGD(0.1), seed=0,
+                    steps_per_call=1)
+    opt.set_end_when(Trigger.max_epoch(1))
+    opt.optimize()
+    # 6 dataset batches -> 12 trained steps, each batch read ONCE
+    assert opt.state["neval"] == 12
+    assert len(ds.hashes) == 6
+    assert opt.state["records"] == 96          # trained records, echoed
+
+
+@pytest.mark.parametrize("k", [1, 4])
+def test_echo_crash_resume_bit_identical(tmp_path, monkeypatch, k):
+    """Mid-echo-group kill: the cursor's echo counter (data_state)
+    resumes inside a batch's echo run, bit-identically."""
+    monkeypatch.setenv("BIGDL_TPU_DATA_ECHO", "3")
+    _, p_oracle = _train(tmp_path / "oracle", k, 20)
+    _, p_crash = _train(tmp_path / "crash", k, 20, fault="step:11:crash")
+    assert _trees_equal(p_crash, p_oracle)
+
+
+# ------------------------------------------------ snapshot data_state
+def test_snapshot_carries_data_state_and_resume_validates(
+        tmp_path, monkeypatch, caplog):
+    _, _ = _train(tmp_path / "ck", 1, 6)
+    snap = ckpt.latest_checkpoint(str(tmp_path / "ck"))
+    _trees, meta = ckpt.load_checkpoint(snap)
+    ds_state = meta["data_state"]
+    assert ds_state["version"] == 1 and ds_state["echo"] == 1
+    assert ds_state["dataset"]["kind"] == "array"
+    assert ds_state["dataset"]["seed"] == 2
+    assert json.dumps(ds_state)                 # JSON round-trippable
+
+    # a changed echo factor breaks the cursor contract — resume warns
+    x, y = _data()
+    ds = ArrayDataSet(x, y, 8, drop_last=True, shuffle=True, seed=2)
+    opt = Optimizer(_mlp(), ds, nn.ClassNLLCriterion(), SGD(0.1), seed=3)
+    monkeypatch.setenv("BIGDL_TPU_DATA_ECHO", "4")
+    import logging
+    with caplog.at_level(logging.WARNING, logger="bigdl_tpu"):
+        assert opt.resume(str(tmp_path / "ck"))
+    assert any("DATA_ECHO" in r.message for r in caplog.records)
+    assert "data_state" not in opt.state        # popped, not leaked
+
+    problems = service.validate_state(
+        ds, {"echo": 1, "dataset": {"kind": "array", "seed": 99}}, 1)
+    assert any("seed" in p for p in problems)
+
+
+def test_restore_pipeline_standalone():
+    x, y = _data(64)
+    ds = ArrayDataSet(x, y, 8, drop_last=True, shuffle=True, seed=4)
+    oracle = [(_hash(bx), _hash(by)) for bx, by in ds]   # epoch 0
+    state = {"version": 1, "echo": 2, "batch_in_epoch": 5,
+             "dataset": ds.state_dict()}
+    ds2 = ArrayDataSet(x, y, 8, drop_last=True, shuffle=True, seed=4)
+    echo_skip = service.restore_pipeline(ds2, state, epoch=0)
+    assert echo_skip == 1                       # 5 trained = 2 full + 1
+    assert [(_hash(bx), _hash(by)) for bx, by in ds2] == oracle[2:]
+
+
+# ------------------------------------------------------ report headline
+def test_data_wait_fraction_and_report_headline():
+    reg = observe.registry()
+    reg.reset()
+    with observe.phase("train/data_wait"):
+        time.sleep(0.002)
+    with observe.phase("train/dispatch"):
+        time.sleep(0.001)
+    observe.histogram("train/step_wall_s").record(0.1)
+    snap = reg.snapshot()
+    dw = data_wait_fraction(snap)
+    assert dw is not None and 0 < dw["fraction"] < 0.2
+    assert dw["step_loop_s"] == pytest.approx(0.1)
+    from bigdl_tpu.observe.report import render_report
+    text = render_report([snap])
+    assert "data-wait:" in text and "% of the step loop" in text
+    # no step-loop phases -> no headline, no crash
+    reg.reset()
+    assert data_wait_fraction(reg.snapshot()) is None
+
+
+# ---------------------------------------------------------------- CLI
+def test_dataset_cli_stat_and_throughput(tmp_path, capsys):
+    from bigdl_tpu.dataset.__main__ import main
+    generate_synthetic(str(tmp_path), 64, 4, height=8, width=8)
+    assert main(["stat", "--shards", str(tmp_path), "--hosts", "2",
+                 "--json"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["total_records"] == 64 and rec["corrupt"] == 0
+    assert len(rec["shards"]) == 4
+    assert sum(h["records"] for h in rec["hosts"]) == 64
+
+    assert main(["throughput", "--shards", str(tmp_path),
+                 "--batch-size", "8", "--workers", "2", "--k", "2",
+                 "--exact", "--json"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["records"] > 0 and rec["records_per_sec"] > 0
+    assert rec["workers"] == 2
+
+    # corrupt shard flagged and non-zero exit
+    bad = tmp_path / "part-9-of-9.rec"
+    bad.write_bytes(b"\x13\x37" * 40)
+    assert main(["stat", "--shards", str(tmp_path / "*.rec"),
+                 "--json"]) == 1
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["corrupt"] == 1
