@@ -95,6 +95,12 @@ def _common(p: argparse.ArgumentParser):
                         "snapshot per flush; render with `python -m "
                         "bigdl_tpu.observe <file>` "
                         "(BIGDL_TPU_METRICS_JSONL)")
+    p.add_argument("--statusz-port", type=int, default=None,
+                   help="live telemetry plane: serve the in-process "
+                        "/healthz /metrics /statusz /tracez /profilez "
+                        "HTTP endpoints on this port "
+                        "(BIGDL_TPU_STATUSZ_PORT; 0 = off — "
+                        "docs/observability.md)")
 
 
 def _end_trigger(args, default_epochs):
@@ -113,6 +119,9 @@ def _finish(opt, args, model, app):
     if getattr(args, "metrics_jsonl", None):
         import os
         os.environ["BIGDL_TPU_METRICS_JSONL"] = args.metrics_jsonl
+    if getattr(args, "statusz_port", None):
+        import os
+        os.environ["BIGDL_TPU_STATUSZ_PORT"] = str(args.statusz_port)
     if getattr(args, "compile_cache", None):
         from bigdl_tpu import compilecache
         compilecache.enable(args.compile_cache)

@@ -13,7 +13,14 @@ Unified observability for the training stack (reference analogues:
                   length) fed only host-side values — no added syncs;
   * **export**  — TensorBoard / JSONL / Prometheus-textfile exporters
                   flushed by one background thread;
-  * **report**  — `python -m bigdl_tpu.observe run.jsonl` phase table.
+  * **report**  — `python -m bigdl_tpu.observe run.jsonl` phase table;
+  * **statusz** — live telemetry plane: in-process HTTP /healthz,
+                  /metrics (live Prometheus), /statusz, /tracez,
+                  /profilez endpoints (BIGDL_TPU_STATUSZ_PORT);
+  * **doctor**  — step-time anomaly watchdog riding the flush cadence
+                  (BIGDL_TPU_WATCHDOG_PCT), crash forensics bundles
+                  (BIGDL_TPU_FORENSICS), and the
+                  `python -m bigdl_tpu.observe doctor` post-mortem CLI.
 
 Enable via knobs (utils/config.py): BIGDL_TPU_TRACE=<dir> records and
 dumps a trace per optimize(); BIGDL_TPU_METRICS_JSONL / _PROM / _TB
@@ -49,6 +56,7 @@ __all__ = [
     "get_tracer", "instant", "span",
     "process_index", "run_id",
     "ensure_started", "finish", "shutdown", "export_manager",
+    "statusz_server",
 ]
 
 _lock = threading.Lock()
@@ -156,13 +164,24 @@ def ensure_started() -> bool:
             if exporters:
                 _exports = ExportManager(
                     exporters, flush_s=config.get("METRICS_FLUSH_S")).start()
+        # live telemetry plane (observe/statusz.py): the in-process
+        # /healthz /metrics /statusz /tracez /profilez HTTP endpoints,
+        # knob-gated (BIGDL_TPU_STATUSZ_PORT, 0 = off, process 0 only)
+        from bigdl_tpu.observe import statusz as _statusz
+        sz = _statusz.start()
         _started = True
-        return bool(t.enabled or _exports)
+        return bool(t.enabled or _exports or sz)
 
 
 def export_manager():
     """The live ExportManager (None when no exporter knob is set)."""
     return _exports
+
+
+def statusz_server():
+    """The live StatuszServer (None when the plane is off)."""
+    from bigdl_tpu.observe import statusz as _statusz
+    return _statusz.server()
 
 
 def finish() -> Optional[str]:
@@ -177,11 +196,14 @@ def finish() -> Optional[str]:
 
 
 def shutdown() -> None:
-    """Tear down exporters + disable tracing (tests / process exit)."""
+    """Tear down exporters + statusz server + disable tracing (tests /
+    process exit)."""
     global _exports, _started
     with _lock:
         if _exports is not None:
             _exports.close()
             _exports = None
+        from bigdl_tpu.observe import statusz as _statusz
+        _statusz.stop()
         get_tracer().disable()
         _started = False

@@ -1,6 +1,12 @@
-"""`python -m bigdl_tpu.observe run.jsonl` — see observe/report.py."""
+"""`python -m bigdl_tpu.observe run.jsonl` — phase report (observe/report.py);
+`python -m bigdl_tpu.observe doctor <bundle|run.jsonl>` — post-mortem
+(observe/doctor.py)."""
 
 import sys
+
+if len(sys.argv) > 1 and sys.argv[1] == "doctor":
+    from bigdl_tpu.observe.doctor import doctor_main
+    sys.exit(doctor_main(sys.argv[2:]))
 
 from bigdl_tpu.observe.report import main
 

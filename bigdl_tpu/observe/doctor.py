@@ -1,0 +1,470 @@
+"""Step-time anomaly watchdog + crash forensics + `observe doctor`.
+
+The flight recorder (PR 4) answers "where did the step go" AFTER the
+run; this module answers it DURING and right after a failure:
+
+  * **Watchdog** — a rolling median/MAD baseline over the per-flush mean
+    step time (`train/step_wall_s` is the honest denominator; here the
+    trainer hands us the same window wall + step count it already
+    computed for the throughput log line). A sustained regression past
+    BIGDL_TPU_WATCHDOG_PCT opens an *incident*: one loud log, a
+    `watchdog/incidents` counter, and an `alerts` entry the /statusz
+    endpoint serves live. The slowdown is ATTRIBUTED to a phase
+    (data-wait vs dispatch vs flush vs checkpoint) by comparing each
+    phase's per-step time this window against its own rolling baseline —
+    the MLPerf-style "which part of the step regressed" answer, computed
+    entirely from host-side registry state on the existing flush cadence
+    (no added device syncs; asserted by tests/test_observe.py).
+
+  * **Forensics** — on NonFiniteLossError, retry exhaustion, or any
+    unhandled optimize() exception, `dump_forensics` writes a
+    self-contained `forensics-<ts>/` bundle next to the trace dir
+    (knob BIGDL_TPU_FORENSICS): ring-buffer spans as Chrome trace JSON,
+    a metrics snapshot, the live /statusz payload, every config knob's
+    effective value, the trainer state + resume/data_state, and the
+    traceback. The newest 8 bundles are kept.
+
+  * **Doctor CLI** — `python -m bigdl_tpu.observe doctor <bundle|jsonl>`
+    parses a bundle (or a JSONL run log) and prints the phase
+    attribution + top anomalies: the post-mortem a pager-holder reads
+    before anyone attaches a debugger.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Dict, List, Optional
+
+log = logging.getLogger("bigdl_tpu")
+
+# the disjoint step-loop phases an incident can be attributed to —
+# matches the data_wait_fraction accounting (observe/metrics.py)
+WATCHED_PHASES = ("train/data_wait", "train/dispatch", "train/flush",
+                  "train/checkpoint")
+
+
+def _median(xs: List[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    if not n:
+        return 0.0
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+class Watchdog:
+    """Rolling-baseline step-time regression detector. One process-wide
+    instance rides `_flush_metrics` (optim/local.py); tests build
+    private ones. All inputs are host-side floats the trainer already
+    had — observing costs a registry snapshot and some arithmetic."""
+
+    def __init__(self, pct: Optional[float] = None,
+                 window: Optional[int] = None,
+                 sustain: Optional[int] = None):
+        from bigdl_tpu.utils import config
+        self.pct = config.get("WATCHDOG_PCT") if pct is None else pct
+        self.window = (config.get("WATCHDOG_WINDOW") if window is None
+                       else window)
+        self.sustain = max(1, config.get("WATCHDOG_SUSTAIN")
+                           if sustain is None else sustain)
+        self._lock = threading.Lock()
+        self._steps: deque = deque(maxlen=self.window)
+        self._phase_prev: Dict[str, float] = {}
+        self._phase_base: Dict[str, deque] = {
+            ph: deque(maxlen=self.window) for ph in WATCHED_PHASES}
+        self._bad_run = 0
+        self._active: Optional[dict] = None
+        self._incidents: List[dict] = []
+
+    @property
+    def enabled(self) -> bool:
+        return self.pct > 0
+
+    # ------------------------------------------------------------ observe
+    def observe(self, neval: int, window_s: float, steps: int,
+                snapshot: Optional[dict] = None) -> Optional[dict]:
+        """Feed one flush window (wall seconds + steps flushed). Returns
+        the incident dict when THIS call opened one, else None."""
+        if not self.enabled or steps <= 0 or window_s <= 0:
+            return None
+        from bigdl_tpu.observe import metrics as _metrics
+        if snapshot is None:
+            snapshot = _metrics.registry().snapshot()
+        step_s = window_s / steps
+        hists = snapshot.get("histograms", {})
+        with self._lock:
+            # per-phase seconds/step THIS window (delta of the running
+            # phase-histogram sums since the previous observe)
+            deltas: Dict[str, float] = {}
+            for ph in WATCHED_PHASES:
+                h = hists.get(f"phase/{ph}")
+                total = float(h["sum"]) if h else 0.0
+                prev = self._phase_prev.get(ph, total)
+                deltas[ph] = max(0.0, total - prev) / steps
+                self._phase_prev[ph] = total
+            warm = len(self._steps) >= max(4, self.window // 4)
+            opened = None
+            if warm:
+                base = _median(list(self._steps))
+                mad = _median([abs(x - base) for x in self._steps])
+                threshold = base * (1.0 + self.pct / 100.0)
+                is_bad = (step_s > threshold
+                          and step_s > base + 3.0 * mad)
+            else:
+                base, is_bad = 0.0, False
+            from bigdl_tpu.observe.metrics import counter, gauge
+            gauge("watchdog/step_s").set(step_s)
+            if warm:
+                gauge("watchdog/baseline_s").set(base)
+            if is_bad:
+                self._bad_run += 1
+                counter("watchdog/anomalies").inc()
+                if self._bad_run >= self.sustain and self._active is None:
+                    opened = self._open_incident(neval, step_s, base,
+                                                 deltas)
+            else:
+                self._bad_run = 0
+                if self._active is not None:
+                    self._close_incident(neval, step_s)
+                # only healthy windows feed the baseline — a sustained
+                # slowdown must not normalize itself into the median
+                self._steps.append(step_s)
+                for ph in WATCHED_PHASES:
+                    self._phase_base[ph].append(deltas[ph])
+            gauge("watchdog/alert_active").set(
+                1.0 if self._active is not None else 0.0)
+            return opened
+
+    def _attribute(self, deltas: Dict[str, float]) -> str:
+        """The phase whose per-step time grew the most over its own
+        baseline — ties and an all-flat window blame the dispatch
+        (device compute backlog surfaces in the flush/dispatch pair)."""
+        best, best_growth = "train/dispatch", 0.0
+        for ph in WATCHED_PHASES:
+            base = _median(list(self._phase_base[ph]))
+            growth = deltas[ph] - base
+            if growth > best_growth:
+                best, best_growth = ph, growth
+        return best
+
+    def _open_incident(self, neval, step_s, base, deltas) -> dict:
+        from bigdl_tpu.observe.metrics import counter
+        from bigdl_tpu.observe import trace as _trace
+        phase = self._attribute(deltas)
+        incident = {
+            "opened_at": time.time(),
+            "neval": int(neval),
+            "step_s": round(step_s, 6),
+            "baseline_s": round(base, 6),
+            "slowdown_x": round(step_s / base, 2) if base else 0.0,
+            "phase": phase,
+            "phase_step_s": {ph: round(v, 6) for ph, v in deltas.items()},
+            "resolved": False,
+        }
+        self._active = incident
+        self._incidents.append(incident)
+        if len(self._incidents) > 16:
+            del self._incidents[:-16]
+        counter("watchdog/incidents").inc()
+        _trace.instant("watchdog/incident", cat="watchdog",
+                       args={"phase": phase,
+                             "slowdown_x": incident["slowdown_x"]})
+        # ONE loud line per incident (the per-window anomaly rides the
+        # counter, not the log)
+        log.warning(
+            "WATCHDOG: step time regressed %.1fx (%.1f ms vs %.1f ms "
+            "baseline) at iteration %d — attributed to %s "
+            "(per-step: %s); alert stays up until a healthy window",
+            incident["slowdown_x"], step_s * 1e3, base * 1e3, neval,
+            phase,
+            ", ".join(f"{ph.split('/')[-1]}={v * 1e3:.1f}ms"
+                      for ph, v in deltas.items()))
+        return incident
+
+    def _close_incident(self, neval, step_s) -> None:
+        self._active["resolved"] = True
+        self._active["resolved_at"] = time.time()
+        log.warning("WATCHDOG: step time recovered (%.1f ms) at "
+                    "iteration %d — incident closed", step_s * 1e3, neval)
+        self._active = None
+
+    # ------------------------------------------------------------- views
+    def alerts(self) -> List[dict]:
+        """Incident list for /statusz (newest last; active one has
+        resolved=False)."""
+        with self._lock:
+            return [dict(i) for i in self._incidents]
+
+    def active_alert(self) -> Optional[dict]:
+        with self._lock:
+            return dict(self._active) if self._active else None
+
+
+_watchdog: Optional[Watchdog] = None
+_wd_lock = threading.Lock()
+
+
+def watchdog() -> Watchdog:
+    """The process-wide watchdog (knobs read at first use)."""
+    global _watchdog
+    if _watchdog is None:
+        with _wd_lock:
+            if _watchdog is None:
+                _watchdog = Watchdog()
+    return _watchdog
+
+
+def reset_watchdog() -> None:
+    """Drop the process-wide watchdog (tests; next use re-reads knobs)."""
+    global _watchdog
+    with _wd_lock:
+        _watchdog = None
+
+
+# ------------------------------------------------------------- forensics
+_KEEP_BUNDLES = 8
+_dumped: set = set()            # (reason, id(exc)) dedupe per process
+
+
+def forensics_root() -> Optional[str]:
+    """Bundle destination from BIGDL_TPU_FORENSICS: None (off), an
+    explicit path, or the default — next to the trace dir when tracing
+    is configured, /tmp/bigdl_tpu_forensics otherwise."""
+    from bigdl_tpu.utils import config
+    knob = (config.get("FORENSICS") or "").strip()
+    if knob in ("0", "false", "no", "off"):
+        return None
+    if knob not in ("", "1", "true", "yes", "on"):
+        return knob
+    from bigdl_tpu.observe.trace import get_tracer
+    t = get_tracer()
+    if t.trace_dir:
+        return t.trace_dir
+    return "/tmp/bigdl_tpu_forensics"
+
+
+def dump_forensics(reason: str, exc: Optional[BaseException] = None,
+                   state: Optional[dict] = None,
+                   extra: Optional[dict] = None) -> Optional[str]:
+    """Write one `forensics-<ts>/` bundle; returns its path (None when
+    disabled or already dumped for this (reason, exception) pair).
+    Every sub-write is best-effort — forensics must never mask the
+    original failure."""
+    root = forensics_root()
+    if root is None:
+        return None
+    key = (reason, id(exc))
+    if exc is not None and key in _dumped:
+        return None
+    _dumped.add(key)
+    from bigdl_tpu.observe import metrics as _metrics
+    from bigdl_tpu.observe import trace as _trace
+    from bigdl_tpu.utils.runtime import process_index, run_id
+    ts = time.strftime("%Y%m%d-%H%M%S") + f"-{int(time.time() * 1e3) % 1000:03d}"
+    path = os.path.join(root, f"forensics-{ts}-p{process_index()}")
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError as e:
+        log.warning("forensics: cannot create %s: %s", path, e)
+        return None
+
+    def _write(name, payload, as_json=True):
+        try:
+            with open(os.path.join(path, name), "w") as fh:
+                if as_json:
+                    json.dump(payload, fh, indent=2, default=str)
+                else:
+                    fh.write(payload)
+        except Exception as e:                 # noqa: BLE001 — forensics
+            log.warning("forensics: %s write failed: %s", name, e)
+
+    meta = {
+        "reason": reason,
+        "run_id": run_id(),
+        "process_index": process_index(),
+        "wall_time": time.time(),
+        "state": state or {},
+    }
+    if extra:
+        meta.update(extra)
+    if exc is not None:
+        meta["error"] = f"{type(exc).__name__}: {exc}"
+        _write("error.txt", "".join(traceback.format_exception(
+            type(exc), exc, exc.__traceback__)), as_json=False)
+    _write("meta.json", meta)
+    _write("metrics.json", _metrics.registry().snapshot())
+    _write("spans.json", _trace.get_tracer().chrome_trace())
+    from bigdl_tpu.utils import config
+    _write("config.json", {k.env: k.get() for k in
+                           config.knobs().values()})
+    try:
+        from bigdl_tpu.observe import statusz as _statusz
+        _write("statusz.json", _statusz.status_payload())
+    except Exception as e:                     # noqa: BLE001 — forensics
+        log.warning("forensics: statusz payload failed: %s", e)
+    _metrics.counter("forensics/bundles").inc()
+    _rotate_bundles(root)
+    log.error("FORENSICS: %s — bundle written to %s "
+              "(inspect with `python -m bigdl_tpu.observe doctor %s`)",
+              reason, path, path)
+    return path
+
+
+def _rotate_bundles(root: str) -> None:
+    try:
+        dirs = sorted(d for d in os.listdir(root)
+                      if d.startswith("forensics-")
+                      and os.path.isdir(os.path.join(root, d)))
+        for d in dirs[:-_KEEP_BUNDLES]:
+            shutil.rmtree(os.path.join(root, d), ignore_errors=True)
+    except OSError:
+        pass
+
+
+# ------------------------------------------------------------ doctor CLI
+def _load_bundle(path: str) -> dict:
+    """A forensics bundle dir -> {meta, snapshot, statusz, spans,
+    error}; missing pieces load as empty."""
+    out = {"meta": {}, "snapshot": {}, "statusz": {}, "spans": {},
+           "error": ""}
+    names = {"meta": "meta.json", "snapshot": "metrics.json",
+             "statusz": "statusz.json", "spans": "spans.json"}
+    for key, name in names.items():
+        p = os.path.join(path, name)
+        if os.path.exists(p):
+            try:
+                with open(p) as fh:
+                    out[key] = json.load(fh)
+            except (OSError, ValueError) as e:
+                out[key] = {"_load_error": str(e)}
+    p = os.path.join(path, "error.txt")
+    if os.path.exists(p):
+        with open(p) as fh:
+            out["error"] = fh.read()
+    return out
+
+
+def _top_spans(spans_doc: dict, n: int = 5) -> List[dict]:
+    evs = [e for e in spans_doc.get("traceEvents", [])
+           if e.get("ph") == "X" and "dur" in e]
+    evs.sort(key=lambda e: -e["dur"])
+    return [{"name": e["name"], "dur_ms": round(e["dur"] / 1e3, 3),
+             "cat": e.get("cat", "")} for e in evs[:n]]
+
+
+def render_doctor(target: str) -> dict:
+    """The doctor analysis as a dict (the CLI renders it; tests and
+    --json consume it directly). `target` is a forensics bundle dir or
+    a JSONL run log."""
+    from bigdl_tpu.observe.metrics import (data_wait_fraction, phase_table,
+                                           serve_slo)
+    if os.path.isdir(target):
+        b = _load_bundle(target)
+        snapshot, meta = b["snapshot"], b["meta"]
+        spans, error = b["spans"], b["error"]
+        alerts = (b["statusz"].get("watchdog", {}) or {}).get("alerts", [])
+        kind = "bundle"
+    else:
+        from bigdl_tpu.observe.report import load_jsonl
+        recs = load_jsonl(target)
+        snapshot = recs[-1] if recs else {}
+        meta = {"run_id": snapshot.get("run_id"),
+                "flushes": len(recs)}
+        spans, error, alerts = {}, "", []
+        kind = "jsonl"
+    counters = snapshot.get("counters", {})
+    gauges = snapshot.get("gauges", {})
+    anomalies = {
+        "nonfinite_steps": counters.get("train/nonfinite_steps", 0),
+        "watchdog_anomalies": counters.get("watchdog/anomalies", 0),
+        "watchdog_incidents": counters.get("watchdog/incidents", 0),
+        "checkpoint_failures": counters.get("checkpoint/failures", 0),
+        "retries": counters.get("resilience/retries", 0),
+        "faults_injected": counters.get("resilience/faults_injected", 0),
+        "shed_requests": counters.get("serve/shed", 0),
+    }
+    return {
+        "kind": kind,
+        "target": target,
+        "meta": meta,
+        "error": error.strip().splitlines()[-1] if error else "",
+        "phases": phase_table(snapshot),
+        "data_wait": data_wait_fraction(snapshot),
+        "serve": serve_slo(snapshot),
+        "alerts": alerts,
+        "anomalies": {k: v for k, v in anomalies.items() if v},
+        "top_spans": _top_spans(spans),
+        "last_step": gauges.get("train/neval", 0),
+        "last_loss": gauges.get("train/loss"),
+    }
+
+
+def doctor_main(argv: Optional[List[str]] = None) -> int:
+    """`python -m bigdl_tpu.observe doctor <bundle|run.jsonl> [--json]`"""
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="bigdl_tpu.observe doctor",
+        description="Post-mortem: phase attribution + top anomalies "
+                    "from a forensics bundle or a JSONL run log")
+    ap.add_argument("target", help="forensics-<ts>/ bundle dir or a "
+                                   "run.jsonl")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    d = render_doctor(args.target)
+    if args.json:
+        print(json.dumps(d))
+        return 0
+    meta = d["meta"]
+    print(f"doctor · {d['kind']} {args.target}")
+    if meta.get("reason"):
+        print(f"reason: {meta['reason']}")
+    if d["error"]:
+        print(f"error:  {d['error']}")
+    if meta.get("run_id"):
+        print(f"run:    {meta['run_id']} · last step "
+              f"{d['last_step']:.0f} · last loss {d['last_loss']}")
+    dw = d["data_wait"]
+    if dw:
+        print(f"data-wait: {dw['fraction']:.1%} of the step loop")
+    print()
+    print(render_phase_table_from_rows(d["phases"])
+          if d["phases"] else "(no phase/ histograms recorded)")
+    if d["anomalies"]:
+        print("\ntop anomalies:")
+        for k, v in sorted(d["anomalies"].items(), key=lambda kv: -kv[1]):
+            print(f"  {k:<24} {v:,.6g}")
+    if d["alerts"]:
+        print("\nwatchdog alerts:")
+        for a in d["alerts"]:
+            print(f"  iter {a.get('neval')}: {a.get('slowdown_x')}x "
+                  f"slowdown -> {a.get('phase')} "
+                  f"({'resolved' if a.get('resolved') else 'ACTIVE'})")
+    if d["serve"]:
+        print("\nserve:")
+        for m, s in d["serve"]["models"].items():
+            print(f"  {m:<16} p50 {s['p50_ms']} ms · p99 {s['p99_ms']} ms "
+                  f"· {s['requests']} reqs")
+    if d["top_spans"]:
+        print("\nlongest spans in the ring:")
+        for s in d["top_spans"]:
+            print(f"  {s['name']:<28} {s['dur_ms']:>10.3f} ms")
+    return 0
+
+
+def render_phase_table_from_rows(rows: List[dict]) -> str:
+    header = (f"{'phase':<28} {'count':>8} {'total s':>10} "
+              f"{'avg ms':>9} {'p50 ms':>9} {'max ms':>9} {'share':>7}")
+    lines = [header, "-" * len(header)]
+    for r in rows:
+        lines.append(
+            f"{r['phase']:<28} {r['count']:>8} {r['total_s']:>10.3f} "
+            f"{r['avg_ms']:>9.2f} {r['p50_ms']:>9.2f} {r['max_ms']:>9.2f} "
+            f"{r['share']:>6.1%}")
+    return "\n".join(lines)

@@ -80,10 +80,35 @@ def _prom_name(name: str) -> str:
     return "bigdl_tpu_" + _PROM_BAD.sub("_", name)
 
 
+def render_prometheus(snapshot: dict) -> str:
+    """The whole registry snapshot in Prometheus exposition format:
+    counters as `counter`, gauges as `gauge`, histograms as
+    `_bucket{le=...}/_sum/_count`. Shared by the textfile exporter and
+    the statusz server's live /metrics endpoint (observe/statusz.py) —
+    one renderer, so a scraper sees identical series either way."""
+    lines: List[str] = []
+    for name, v in snapshot.get("counters", {}).items():
+        pn = _prom_name(name)
+        lines += [f"# TYPE {pn} counter", f"{pn} {v!r}"]
+    for name, v in snapshot.get("gauges", {}).items():
+        pn = _prom_name(name)
+        lines += [f"# TYPE {pn} gauge", f"{pn} {v!r}"]
+    for name, h in snapshot.get("histograms", {}).items():
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} histogram")
+        cum = 0
+        for le, c in zip(h["bounds"], h["counts"]):
+            cum += c
+            lines.append(f'{pn}_bucket{{le="{le!r}"}} {cum}')
+        lines.append(f'{pn}_bucket{{le="+Inf"}} {h["count"]}')
+        lines.append(f"{pn}_sum {h['sum']!r}")
+        lines.append(f"{pn}_count {h['count']}")
+    return "\n".join(lines) + "\n"
+
+
 class PrometheusExporter(Exporter):
     """Textfile-collector format: the whole registry rewritten atomically
-    per flush (tmp + rename), counters as `counter`, gauges as `gauge`,
-    histograms as `_bucket{le=...}/_sum/_count`."""
+    per flush (tmp + rename) through the shared `render_prometheus`."""
 
     def __init__(self, path: str):
         self.path = _proc_suffix(path)
@@ -92,26 +117,9 @@ class PrometheusExporter(Exporter):
             os.makedirs(d, exist_ok=True)
 
     def export(self, snapshot: dict, step: int) -> None:
-        lines: List[str] = []
-        for name, v in snapshot.get("counters", {}).items():
-            pn = _prom_name(name)
-            lines += [f"# TYPE {pn} counter", f"{pn} {v!r}"]
-        for name, v in snapshot.get("gauges", {}).items():
-            pn = _prom_name(name)
-            lines += [f"# TYPE {pn} gauge", f"{pn} {v!r}"]
-        for name, h in snapshot.get("histograms", {}).items():
-            pn = _prom_name(name)
-            lines.append(f"# TYPE {pn} histogram")
-            cum = 0
-            for le, c in zip(h["bounds"], h["counts"]):
-                cum += c
-                lines.append(f'{pn}_bucket{{le="{le!r}"}} {cum}')
-            lines.append(f'{pn}_bucket{{le="+Inf"}} {h["count"]}')
-            lines.append(f"{pn}_sum {h['sum']!r}")
-            lines.append(f"{pn}_count {h['count']}")
         tmp = self.path + ".tmp"
         with open(tmp, "w") as fh:
-            fh.write("\n".join(lines) + "\n")
+            fh.write(render_prometheus(snapshot))
         os.replace(tmp, self.path)
 
 

@@ -129,17 +129,28 @@ class Histogram:
 
     def quantile(self, q: float) -> float:
         """Approximate q-quantile: upper bound of the bucket where the
-        cumulative count crosses q (0 observations -> 0.0)."""
+        cumulative count crosses q (0 observations -> 0.0). This is the
+        CONSERVATIVE (upper) edge of the true quantile's bucket — see
+        `quantile_bounds` for the bracketing error bar the /statusz SLO
+        numbers quote (docs/observability.md 'Percentile accuracy')."""
         if self._count == 0:
             return 0.0
-        target = q * self._count
-        cum = 0
-        for i, c in enumerate(self.counts):
-            cum += c
-            if cum >= target:
-                return (self.bounds[i] if i < len(self.bounds)
-                        else self._max)
-        return self._max
+        return quantile_from_snapshot(
+            {"count": self._count, "counts": self.counts,
+             "bounds": self.bounds, "max": self._max}, q)
+
+    def quantile_bounds(self, q: float) -> Tuple[float, float]:
+        """(lo, hi) bracketing the TRUE q-quantile: hi is `quantile()`'s
+        bucket upper edge, lo the bucket's lower edge (clamped to the
+        observed min/max). On the default x2 geometric grid hi/lo <= 2,
+        i.e. every quoted percentile is exact to within one bucket — at
+        most a factor of the grid ratio, and conservative (never an
+        underestimate). Asserted by tests/test_observe.py."""
+        if self._count == 0:
+            return (0.0, 0.0)
+        snap = {"count": self._count, "counts": self.counts,
+                "bounds": self.bounds, "max": self._max}
+        return quantile_bounds_from_snapshot(snap, self._min, q)
 
     def snapshot(self) -> dict:
         with _lock:
@@ -253,6 +264,46 @@ class _Phase:
         return False
 
 
+# ------------------------------------------- serialized-bucket quantiles
+def quantile_from_snapshot(h: dict, q: float) -> float:
+    """q-quantile from a SERIALIZED histogram (snapshot/JSONL form):
+    the upper bound of the bucket where the cumulative count crosses q.
+    Shared by the live Histogram, the report CLI, and the serve SLO
+    section so every surface quotes the same number."""
+    count = h.get("count", 0)
+    if not count:
+        return 0.0
+    target = q * count
+    cum = 0
+    for i, c in enumerate(h["counts"]):
+        cum += c
+        if cum >= target:
+            return (h["bounds"][i] if i < len(h["bounds"]) else h["max"])
+    return h["max"]
+
+
+def quantile_bounds_from_snapshot(h: dict, lo_clamp: float,
+                                  q: float) -> Tuple[float, float]:
+    """(lo, hi) bracket of the true q-quantile from serialized buckets
+    (`lo_clamp` = the observed min, which tightens bucket 0's open
+    lower edge)."""
+    count = h.get("count", 0)
+    if not count:
+        return (0.0, 0.0)
+    target = q * count
+    cum = 0
+    for i, c in enumerate(h["counts"]):
+        cum += c
+        if cum >= target:
+            if i < len(h["bounds"]):
+                hi = min(h["bounds"][i], h["max"])
+            else:
+                hi = h["max"]
+            lo = h["bounds"][i - 1] if i > 0 else 0.0
+            return (max(lo, min(lo_clamp, hi)), hi)
+    return (h["max"], h["max"])
+
+
 _phase_cache: Dict[str, Histogram] = {}
 
 
@@ -278,14 +329,7 @@ def phase_table(snapshot: dict) -> List[dict]:
     for name, h in hists.items():
         if not name.startswith("phase/") or not h["count"]:
             continue
-        # p50 from the serialized buckets (quantile() needs the live
-        # object; the report reads JSONL)
-        target, cum, p50 = 0.5 * h["count"], 0, h["max"]
-        for i, c in enumerate(h["counts"]):
-            cum += c
-            if cum >= target:
-                p50 = (h["bounds"][i] if i < len(h["bounds"]) else h["max"])
-                break
+        p50 = quantile_from_snapshot(h, 0.5)
         rows.append({
             "phase": name[len("phase/"):],
             "count": h["count"],
@@ -331,6 +375,45 @@ def data_wait_fraction(snapshot: dict) -> Optional[dict]:
         return None
     return {"data_wait_s": wait_s, "step_loop_s": loop_s,
             "fraction": wait_s / loop_s, "waits": wait_n}
+
+
+def serve_slo(snapshot: dict) -> Optional[dict]:
+    """The serving subsystem's SLO view from a registry snapshot (live
+    /statusz or a JSONL run log): per-model p50/p99 latency, shed count,
+    batch fill. Model names are recovered from the `serve/<model>/
+    latency_ms` histograms the batchers record; None when the snapshot
+    carries no serve traffic at all."""
+    hists = snapshot.get("histograms", {})
+    counters = snapshot.get("counters", {})
+    models: Dict[str, dict] = {}
+    for name, h in sorted(hists.items()):
+        if not (name.startswith("serve/") and name.endswith("/latency_ms")):
+            continue
+        model = name[len("serve/"):-len("/latency_ms")]
+        if not model:        # the combined serve/latency_ms histogram
+            continue
+        models[model] = {
+            "requests": h["count"],
+            "p50_ms": round(quantile_from_snapshot(h, 0.50), 3),
+            "p99_ms": round(quantile_from_snapshot(h, 0.99), 3),
+        }
+    total_req = counters.get("serve/requests", 0)
+    if not models and not total_req:
+        return None
+    fill = hists.get("serve/batch_fill")
+    return {
+        "models": models,
+        "totals": {
+            "requests": total_req,
+            "rows": counters.get("serve/rows", 0),
+            "batches": counters.get("serve/batches", 0),
+            "shed": counters.get("serve/shed", 0),
+            "mean_batch_fill": round(fill["sum"] / fill["count"], 4)
+            if fill and fill["count"] else 0.0,
+            "queued_rows": snapshot.get("gauges", {}).get(
+                "serve/queue_depth", 0),
+        },
+    }
 
 
 # ------------------------------------------------ reference-style facade

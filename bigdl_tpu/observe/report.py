@@ -15,7 +15,8 @@ import json
 import sys
 from typing import List, Optional
 
-from bigdl_tpu.observe.metrics import data_wait_fraction, phase_table
+from bigdl_tpu.observe.metrics import (data_wait_fraction, phase_table,
+                                       serve_slo)
 
 
 def load_jsonl(path: str) -> List[dict]:
@@ -61,6 +62,22 @@ def render_report(recs: List[dict]) -> str:
             f"{dw['waits']} batch waits)")
     out.append("")
     out.append(render_phase_table(last))
+    slo = serve_slo(last)
+    if slo is not None:
+        # serving SLO section: the serve/ metrics flushed into the run
+        # log, rendered as the numbers the batcher gates on
+        # (docs/serving.md) — p50/p99 are log-bucket approximations,
+        # conservative to within the x2 grid (docs/observability.md)
+        out.append("")
+        out.append("serve:")
+        for model, s in sorted(slo["models"].items()):
+            out.append(f"  {model:<20} {s['requests']:>8} reqs   "
+                       f"p50 {s['p50_ms']:>9.3f} ms   "
+                       f"p99 {s['p99_ms']:>9.3f} ms")
+        t = slo["totals"]
+        out.append(f"  {'(totals)':<20} {t['requests']:>8.0f} reqs   "
+                   f"{t['batches']:>6.0f} batches   shed {t['shed']:.0f}   "
+                   f"batch-fill {t['mean_batch_fill']:.1%}")
     counters = last.get("counters", {})
     gauges = last.get("gauges", {})
     if counters:
@@ -99,6 +116,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(json.dumps({"flushes": len(recs),
                               "data_wait": data_wait_fraction(last),
                               "phases": phase_table(last),
+                              "serve": serve_slo(last),
                               "counters": last.get("counters", {}),
                               "gauges": last.get("gauges", {})}))
         else:

@@ -1,0 +1,361 @@
+"""Live telemetry plane — the in-process /statusz HTTP endpoints.
+
+PR 4's flight recorder is write-only: spans and metrics land in files
+you read after the run. This module is the pull-based half (the
+reference's `TrainSummary`/validation dashboards were live), delivered
+TPU-natively: a stdlib `http.server` thread serving the CURRENT state
+of the process — no new deps, no agent, no sidecar.
+
+Endpoints (all GET, all JSON unless noted):
+
+  * `/healthz`   — liveness + last-step age: is the trainer stalled?
+  * `/metrics`   — the metrics registry rendered LIVE in Prometheus
+                   exposition format (text/plain) through the same
+                   `render_prometheus` the textfile exporter uses — a
+                   scraper no longer waits for the flush cadence.
+  * `/statusz`   — the operator headline: run id, epoch/step/K,
+                   data-wait fraction, failover live/lost slices, serve
+                   per-model p50/p99/shed/queue-depth, checkpoint
+                   in-flight, watchdog alerts, fault-injection state.
+  * `/tracez?n=N` — the newest N spans from the tracer ring buffer.
+  * `/profilez?seconds=S` — arms a `jax.profiler` capture window on
+                   demand; the TensorBoard-loadable capture lands under
+                   the trace dir.
+
+Cadence contract: every handler reads host-side registry/ring state
+only — a scrape NEVER touches a device value, so polling /statusz under
+load adds zero host syncs to the train loop (asserted by
+tests/test_statusz.py, measured by bench.py overhead / BENCH_r14).
+
+Enable with BIGDL_TPU_STATUSZ_PORT (0 = off; process 0 only — the
+other hosts of a multihost job export files with `.p<i>` suffixes and
+can run their own plane if wanted). `ensure_started()` (observe/
+__init__.py) starts it; `shutdown()` stops it. Binds
+BIGDL_TPU_STATUSZ_HOST (loopback by default — widening the bind is a
+deliberate operator choice).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
+
+log = logging.getLogger("bigdl_tpu")
+
+_t0 = time.time()
+
+# serve engines announce themselves here so /statusz can read their
+# per-model stats() without observe depending on serve at import time
+_engines: List = []
+_engines_lock = threading.Lock()
+
+
+def register_engine(engine) -> None:
+    """Called by ServeEngine.__init__ (weakly held via liveness checks:
+    a shut-down engine reports itself closed and is dropped)."""
+    import weakref
+    with _engines_lock:
+        _engines.append(weakref.ref(engine))
+
+
+def _live_engines() -> List:
+    with _engines_lock:
+        live, keep = [], []
+        for ref in _engines:
+            e = ref()
+            if e is not None and not getattr(e, "_closed", False):
+                live.append(e)
+                keep.append(ref)
+        _engines[:] = keep
+        return live
+
+
+# ------------------------------------------------------------- payloads
+def health_payload() -> dict:
+    """Liveness + staleness: `last_step_age_s` is the seconds since the
+    trainer's last metrics flush (the loop's heartbeat) — a live server
+    with a growing age means the train loop is stalled, which is
+    exactly the failure a file-based exporter cannot show."""
+    from bigdl_tpu.observe import metrics as _metrics
+    from bigdl_tpu.utils.runtime import process_index, run_id
+    g = _metrics.registry().snapshot().get("gauges", {})
+    last = g.get("train/last_flush_unix", 0.0)
+    return {
+        "ok": True,
+        "run_id": run_id(),
+        "process_index": process_index(),
+        "uptime_s": round(time.time() - _t0, 3),
+        "neval": int(g.get("train/neval", 0)),
+        "last_step_age_s": (round(time.time() - last, 3)
+                            if last else None),
+    }
+
+
+def status_payload() -> dict:
+    """The /statusz JSON — also snapshotted verbatim into every crash
+    forensics bundle (observe/doctor.py), so the post-mortem view and
+    the live view are the same document."""
+    from bigdl_tpu.observe import doctor as _doctor
+    from bigdl_tpu.observe import metrics as _metrics
+    snap = _metrics.registry().snapshot()
+    g, c = snap.get("gauges", {}), snap.get("counters", {})
+    serve: Dict[str, dict] = {}
+    for engine in _live_engines():
+        try:
+            serve.update(engine.stats())
+        except Exception as e:          # noqa: BLE001 — telemetry
+            serve["_error"] = {"error": str(e)}
+    if not serve:
+        # no live engine in-process (or a post-mortem reader): fall
+        # back to the registry-derived SLO view so a run log still
+        # answers the same questions
+        slo = _metrics.serve_slo(snap)
+        if slo:
+            serve = {"_from_registry": slo}
+    wd = _doctor.watchdog()
+    payload = {
+        **health_payload(),
+        "train": {
+            "epoch": int(g.get("train/epoch", 0)),
+            "step": int(g.get("train/neval", 0)),
+            "steps_per_call": int(g.get("train/steps_per_call", 1)) or 1,
+            "loss": g.get("train/loss"),
+            "lr": g.get("train/lr"),
+            "throughput_rec_s": g.get("train/throughput"),
+            "records": c.get("train/records", 0),
+            "nonfinite_steps": c.get("train/nonfinite_steps", 0),
+        },
+        "data_wait": _metrics.data_wait_fraction(snap),
+        "jit": {
+            "compiles": c.get("jit/compiles", 0),
+            "compile_seconds": round(c.get("jit/compile_seconds", 0.0), 3),
+            "cache_hit_compiles": c.get("jit/cache_hit_compiles", 0),
+        },
+        "checkpoint": {
+            "in_flight": bool(g.get("checkpoint/in_flight", 0)),
+            "saves": c.get("checkpoint/saves", 0),
+            "failures": c.get("checkpoint/failures", 0),
+        },
+        "serve": serve or None,
+        "alerts": wd.alerts(),
+        "watchdog": {
+            "enabled": wd.enabled,
+            "alert_active": wd.active_alert() is not None,
+            "anomalies": c.get("watchdog/anomalies", 0),
+            "incidents": c.get("watchdog/incidents", 0),
+            "alerts": wd.alerts(),
+        },
+    }
+    if "failover/live_slices" in g:
+        payload["failover"] = {
+            "live_slices": int(g["failover/live_slices"]),
+            "lost_slices": int(g.get("failover/lost_slices", 0)),
+            "live_devices": int(g.get("failover/live_devices", 0)),
+            "last_reshard_s": g.get("failover/last_reshard_s"),
+            "slice_losses": c.get("failover/slice_losses", 0),
+            "grow_backs": c.get("failover/grow_backs", 0),
+        }
+    if "train/mesh_devices" in g:
+        payload["train"]["mesh_devices"] = int(g["train/mesh_devices"])
+    try:
+        from bigdl_tpu.resilience import faults
+        payload["faults"] = faults.status()
+    except Exception:                    # noqa: BLE001 — telemetry
+        pass
+    return payload
+
+
+def tracez_payload(n: int = 100) -> dict:
+    """The newest `n` ring-buffer spans (host timeline post-mortem
+    without waiting for the end-of-run trace dump)."""
+    from bigdl_tpu.observe.trace import get_tracer
+    t = get_tracer()
+    evs = list(t.events())[-max(1, n):]
+    spans = []
+    for ph, name, cat, tid, t0, dur, args in evs:
+        spans.append({"ph": ph, "name": name, "cat": cat, "tid": tid,
+                      "ts_us": round(t._ts_us(t0), 1),
+                      "dur_us": round(dur / 1e3, 1),
+                      "args": args})
+    return {"enabled": t.enabled, "ring": t._ring,
+            "count": len(spans), "spans": spans}
+
+
+# ------------------------------------------------------------- profiler
+_profile_lock = threading.Lock()
+_profile_until = 0.0
+
+
+def arm_profiler(seconds: float) -> dict:
+    """Start a `jax.profiler` capture for `seconds` (clamped 0.1..600);
+    a background timer stops it. One window at a time. The capture dir
+    lands under the trace dir (or /tmp) — TensorBoard-loadable, with
+    the host spans' TraceAnnotations aligned to the device timeline."""
+    global _profile_until
+    seconds = min(600.0, max(0.1, float(seconds)))
+    try:
+        import jax.profiler as _prof
+    except Exception as e:               # noqa: BLE001 — optional dep
+        return {"ok": False, "error": f"jax.profiler unavailable: {e}"}
+    with _profile_lock:
+        now = time.time()
+        if _profile_until > now:
+            return {"ok": False, "error": "capture already in flight",
+                    "remaining_s": round(_profile_until - now, 1)}
+        from bigdl_tpu.observe.trace import get_tracer
+        root = get_tracer().trace_dir or "/tmp/bigdl_tpu_trace"
+        out = os.path.join(root, f"profilez-{int(now)}")
+        try:
+            _prof.start_trace(out)
+        except Exception as e:           # noqa: BLE001 — profiler state
+            return {"ok": False, "error": str(e)}
+        _profile_until = now + seconds
+
+    def _stop():
+        global _profile_until
+        time.sleep(seconds)
+        with _profile_lock:
+            try:
+                _prof.stop_trace()
+            except Exception as e:       # noqa: BLE001 — profiler state
+                log.warning("profilez: stop_trace failed: %s", e)
+            _profile_until = 0.0
+        log.info("profilez: %.1fs capture -> %s", seconds, out)
+
+    threading.Thread(target=_stop, name="profilez-stop",
+                     daemon=True).start()
+    from bigdl_tpu.observe.metrics import counter
+    counter("statusz/profile_captures").inc()
+    return {"ok": True, "seconds": seconds, "dir": out}
+
+
+# --------------------------------------------------------------- server
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "bigdl-tpu-statusz/1"
+
+    def log_message(self, fmt, *args):   # route to our logger, DEBUG
+        log.debug("statusz: " + fmt, *args)
+
+    def _send(self, code: int, body: str,
+              ctype: str = "application/json") -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype + "; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):                    # noqa: N802 — http.server API
+        url = urlparse(self.path)
+        q = parse_qs(url.query)
+        try:
+            if url.path == "/healthz":
+                self._send(200, json.dumps(health_payload()))
+            elif url.path == "/metrics":
+                from bigdl_tpu.observe import metrics as _metrics
+                from bigdl_tpu.observe.export import render_prometheus
+                self._send(200, render_prometheus(
+                    _metrics.registry().snapshot()), ctype="text/plain")
+            elif url.path in ("/statusz", "/", "/statusz/"):
+                self._send(200, json.dumps(status_payload(),
+                                           default=str))
+            elif url.path == "/tracez":
+                n = int(q.get("n", ["100"])[0])
+                self._send(200, json.dumps(tracez_payload(n),
+                                           default=str))
+            elif url.path == "/profilez":
+                sec = float(q.get("seconds", ["5"])[0])
+                out = arm_profiler(sec)
+                self._send(200 if out.get("ok") else 409,
+                           json.dumps(out))
+            else:
+                self._send(404, json.dumps({"error": "unknown endpoint",
+                                            "endpoints": [
+                                                "/healthz", "/metrics",
+                                                "/statusz", "/tracez",
+                                                "/profilez"]}))
+        except BrokenPipeError:
+            pass
+        except Exception as e:           # noqa: BLE001 — telemetry
+            log.warning("statusz handler %s failed: %s", url.path, e)
+            try:
+                self._send(500, json.dumps({"error": str(e)}))
+            except Exception:            # noqa: BLE001 — socket gone
+                pass
+
+
+class StatuszServer:
+    """The HTTP thread. `port=0` binds an ephemeral port (tests); the
+    knob path never passes 0 (0 = off)."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1"):
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self.httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="statusz-http",
+            daemon=True)
+        self._thread.start()
+        log.info("statusz: live telemetry plane on http://%s:%d "
+                 "(/healthz /metrics /statusz /tracez /profilez)",
+                 host, self.port)
+
+    def close(self) -> None:
+        try:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+        except Exception:                # noqa: BLE001 — shutdown
+            pass
+        self._thread.join(timeout=5)
+
+
+_server: Optional[StatuszServer] = None
+_server_lock = threading.Lock()
+
+
+def start(port: Optional[int] = None,
+          host: Optional[str] = None) -> Optional[StatuszServer]:
+    """Start (or return) the process-wide server. With `port=None` the
+    knobs decide: BIGDL_TPU_STATUSZ_PORT=0 -> None (off), and only
+    process 0 serves. An explicit `port` (0 = ephemeral) always starts."""
+    global _server
+    from bigdl_tpu.utils import config
+    with _server_lock:
+        if _server is not None:
+            return _server
+        if host is None:
+            host = config.get("STATUSZ_HOST")
+        if port is None:
+            port = config.get("STATUSZ_PORT")
+            if not port:
+                return None
+            from bigdl_tpu.utils.runtime import process_index
+            if process_index() != 0:
+                log.debug("statusz: not process 0 — skipping")
+                return None
+        try:
+            _server = StatuszServer(int(port), host)
+        except OSError as e:
+            log.warning("statusz: cannot bind %s:%s (%s) — telemetry "
+                        "plane disabled", host, port, e)
+            return None
+        return _server
+
+
+def server() -> Optional[StatuszServer]:
+    return _server
+
+
+def stop() -> None:
+    global _server
+    with _server_lock:
+        if _server is not None:
+            _server.close()
+            _server = None

@@ -868,10 +868,38 @@ class Optimizer:
 
     # -------------------------------------------------------------- optimize
     def optimize(self) -> Tuple[Dict, Dict]:
+        """Run training to `end_when`. Crash forensics seam
+        (observe/doctor.py): a NonFiniteLossError or any other unhandled
+        training exception dumps a self-contained forensics bundle
+        (ring spans, metrics snapshot, statusz JSON, live config, the
+        trainer state + data_state) before propagating — the retry loop
+        and the operator both get the post-mortem for free."""
+        try:
+            return self._optimize_impl()
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            from bigdl_tpu.observe import doctor as _doctor
+            extra = {"trainer": type(self).__name__}
+            try:
+                extra.update(self._snapshot_extra_meta())
+            except Exception:          # noqa: BLE001 — forensics is best-effort
+                pass
+            _doctor.dump_forensics(
+                "nonfinite-loss" if isinstance(e, NonFiniteLossError)
+                else "optimize-exception",
+                exc=e, state=dict(self.state), extra=extra)
+            raise
+
+    def _optimize_impl(self) -> Tuple[Dict, Dict]:
         # flight recorder (observe/): knob-gated trace spans + metrics
-        # exporters; a disabled recorder costs one attribute check per
-        # span site (BIGDL_TPU_TRACE / _METRICS_* — docs/observability.md)
+        # exporters + the statusz live telemetry plane; a disabled
+        # recorder costs one attribute check per span site
+        # (BIGDL_TPU_TRACE / _METRICS_* / _STATUSZ_PORT —
+        # docs/observability.md)
         observe.ensure_started()
+        # run-shape gauges for /statusz (host-side ints, no syncs)
+        observe.gauge("train/steps_per_call").set(self.steps_per_call)
         # compile-latency subsystem (docs/compile_cache.md): persistent
         # compilation cache + optional AOT warmup, both knob-gated
         from bigdl_tpu import compilecache
@@ -1281,10 +1309,19 @@ class Optimizer:
         # tests/test_observe.py)
         g = observe.gauge
         g("train/neval").set(last_iter)
+        g("train/epoch").set(st["epoch"])
         g("train/loss").set(st["loss"])
         g("train/lr").set(last_lr)
         g("train/throughput").set(rate)
+        # heartbeat for /healthz: a live statusz server with a growing
+        # last-step age means the loop is stalled (observe/statusz.py)
+        g("train/last_flush_unix").set(time.time())
         observe.counter("train/records").inc(self._window_records)
+        # step-time anomaly watchdog (observe/doctor.py): same window
+        # wall + step count the throughput line above used — host-side
+        # floats only, riding this existing cadence
+        from bigdl_tpu.observe import doctor as _doctor
+        _doctor.watchdog().observe(last_iter, dt, len(pending))
         log.info("epoch %d iter %d loss %.4f lr %.5f %.1f rec/s",
                  st["epoch"], last_iter, st["loss"], last_lr, rate)
         if self._summary is not None:

@@ -135,6 +135,12 @@ class DistriOptimizer(Optimizer):
             lambda _: NamedSharding(self.mesh, P()), tree)
 
     def _place_trees(self, params, model_state, slots):
+        # topology gauges for the live telemetry plane (/statusz):
+        # host-side ints, refreshed on every optimize() entry and after
+        # a failover re-shard (observe/statusz.py)
+        observe.gauge("train/mesh_devices").set(int(self.mesh.size))
+        observe.gauge("train/data_axis_size").set(
+            int(self._data_axis_size))
         params = jax.tree.map(jax.device_put, params,
                               self._param_shardings(params))
         model_state = jax.tree.map(
@@ -289,6 +295,9 @@ class DistriOptimizer(Optimizer):
         when this topology was seen before)."""
         self.mesh = mesh
         self._data_axis_size = data_axis_size(mesh)
+        observe.gauge("train/mesh_devices").set(int(mesh.size))
+        observe.gauge("train/data_axis_size").set(
+            int(self._data_axis_size))
         self._built_steps.clear()
         self.__dict__.pop("_hist_grad_fn", None)
 

@@ -333,6 +333,23 @@ def clear_slice_gain() -> None:
         _slice_gain = False
 
 
+def status() -> dict:
+    """Injector + pending-event state for the live telemetry plane
+    (/statusz — observe/statusz.py) and forensics bundles: which
+    events are armed/fired, and whether a preemption or slice event is
+    waiting for its K-boundary. Read-only — consumes nothing."""
+    inj = _get()
+    with _lock:
+        return {
+            "events": [{"kind": ev.kind, "step": ev.step,
+                        "slice": ev.slice_idx, "fired": ev.fired}
+                       for ev in inj.events],
+            "preempt_requested": _preempt.is_set(),
+            "slice_loss_pending": _slice_loss,
+            "slice_gain_pending": _slice_gain,
+        }
+
+
 def take_slice_event() -> "Optional[Tuple[str, Optional[int]]]":
     """Consume ONE pending slice event for the trainer's K-boundary
     probe: ('lose', idx) or ('grow', None); loss wins when both are

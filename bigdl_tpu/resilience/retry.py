@@ -75,6 +75,15 @@ class RetryPolicy:
                 if self.exhausted():
                     log.error("giving up after %d failures in %.0fs window",
                               n, self.window_s)
+                    # retry exhaustion is a terminal incident: dump one
+                    # final forensics bundle marking that the driver
+                    # gave up (observe/doctor.py; the per-crash bundle
+                    # was written by the optimize() seam already)
+                    from bigdl_tpu.observe import doctor as _doctor
+                    _doctor.dump_forensics(
+                        "retry-exhausted", exc=e,
+                        extra={"failures_in_window": n,
+                               "window_s": self.window_s})
                     raise
                 delay = self.sleep()
                 log.warning(

@@ -86,6 +86,10 @@ class AsyncCheckpointer:
             observe.instant("checkpoint/failure", cat="checkpoint",
                             args={"path": path, "error": str(e)[:200]})
             log.error("background checkpoint %s failed: %s", path, e)
+        finally:
+            # /statusz "checkpoint in-flight" flag (at most one write is
+            # ever in flight — save() joins the previous one first)
+            observe.gauge("checkpoint/in_flight").set(0)
 
     def _run_worker(self):
         while True:
@@ -135,6 +139,7 @@ class AsyncCheckpointer:
             with observe.phase("checkpoint/plan", cat="checkpoint"):
                 plan = manifest.snapshot_to_host(clones, meta)
             self._last_path = path
+            observe.gauge("checkpoint/in_flight").set(1)
             self._enqueue(path, plan, root)
         else:
             self.wait()

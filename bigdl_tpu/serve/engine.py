@@ -68,6 +68,11 @@ class ServeEngine:
     def __init__(self, *, install_sigterm: bool = False):
         from bigdl_tpu.utils import config
         observe.ensure_started()
+        # live telemetry plane: /statusz serves this engine's per-model
+        # stats() (p50/p99/shed/queue-depth) — weakly held, so a dropped
+        # engine vanishes from the payload (observe/statusz.py)
+        from bigdl_tpu.observe import statusz as _statusz
+        _statusz.register_engine(self)
         self.registry = ModelRegistry()
         self._batchers: Dict[str, ContinuousBatcher] = {}
         self._lock = threading.Lock()

@@ -251,6 +251,41 @@ _register("DATA_DOUBLE_BUFFER", 1, int,
           "flight, the classic double buffer; 0 = synchronous "
           "placement). Ignored when BIGDL_TPU_DATA_SERVICE=0, where "
           "PREFETCH_SIZE keeps its legacy meaning")
+_register("STATUSZ_PORT", 0, int,
+          "Live telemetry plane (observe/statusz.py): HTTP port for the "
+          "in-process /healthz /metrics /statusz /tracez /profilez "
+          "endpoints, served from a stdlib http.server thread on "
+          "process 0. 0 (default) = off. The server reads only "
+          "host-side registry state — a scrape never adds a device "
+          "sync (docs/observability.md)")
+_register("STATUSZ_HOST", "127.0.0.1", str,
+          "Bind address for the statusz server. The default is "
+          "loopback-only; set 0.0.0.0 deliberately when a scraper "
+          "lives off-host (the endpoints expose run metadata)")
+_register("WATCHDOG_PCT", 50.0, float,
+          "Step-time anomaly watchdog (observe/doctor.py): flag a "
+          "sustained regression when the per-flush mean step time "
+          "exceeds the rolling-median baseline by this percentage "
+          "(robust MAD gate on top). Rides the existing _flush_metrics "
+          "cadence — no extra host syncs. 0 disables the watchdog")
+_register("WATCHDOG_WINDOW", 32, int,
+          "Watchdog rolling-baseline window: number of recent flush "
+          "samples the median/MAD baseline is computed over (anomalous "
+          "samples are kept OUT of the baseline so a slowdown cannot "
+          "normalize itself)")
+_register("WATCHDOG_SUSTAIN", 2, int,
+          "Consecutive anomalous flush windows before the watchdog "
+          "opens an incident (one loud log + watchdog/incidents + the "
+          "/statusz alerts entry); transient single-window blips only "
+          "count in watchdog/anomalies")
+_register("FORENSICS", "1", str,
+          "Crash forensics bundles (observe/doctor.py): on "
+          "NonFiniteLossError, retry exhaustion, or an unhandled "
+          "optimize() exception, dump a forensics-<ts>/ bundle (ring "
+          "spans, metrics snapshot, statusz JSON, live config, error "
+          "traceback). '1' (default) writes next to the trace dir "
+          "(or /tmp/bigdl_tpu_forensics without one), a path overrides "
+          "the destination root, '0' disables. Newest 8 bundles kept")
 _register("BENCH_LOCK_FILE", "/tmp/bigdl_tpu_bench.lock", str,
           "Lockfile serializing bench.py against tools/tpu_watch.sh so "
           "the harness cannot pollute the CPU trend series (ADVICE r5 #5)")

@@ -277,9 +277,11 @@ def test_report_cli_trace_validation(tmp_path, clean_observe, capsys):
 # ----------------------------------------------- registry/trainer contract
 def _train(k, tmp_path, monkeypatch, instrumented, tag, iters=8):
     from bigdl_tpu.dataset import ArrayDataSet
+    from bigdl_tpu.observe import doctor as obs_doctor
     from bigdl_tpu.optim.local import Optimizer
     from bigdl_tpu.optim.method import SGD
     from bigdl_tpu.optim.trigger import Trigger
+    obs_doctor.reset_watchdog()          # re-read the WATCHDOG_PCT knob
 
     if instrumented:
         monkeypatch.setenv("BIGDL_TPU_TRACE",
@@ -289,10 +291,21 @@ def _train(k, tmp_path, monkeypatch, instrumented, tag, iters=8):
         monkeypatch.setenv("BIGDL_TPU_METRICS_PROM",
                            str(tmp_path / f"m_{tag}.prom"))
         monkeypatch.setenv("BIGDL_TPU_METRICS_FLUSH_S", "3600")
+        # the LIVE plane too: statusz HTTP server + watchdog armed —
+        # bit-identity and the sync count must hold with everything on
+        import socket
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        monkeypatch.setenv("BIGDL_TPU_STATUSZ_PORT", str(port))
+        monkeypatch.setenv("BIGDL_TPU_WATCHDOG_PCT", "50")
     else:
         for kk in ("BIGDL_TPU_TRACE", "BIGDL_TPU_METRICS_JSONL",
-                   "BIGDL_TPU_METRICS_PROM", "BIGDL_TPU_METRICS_FLUSH_S"):
+                   "BIGDL_TPU_METRICS_PROM", "BIGDL_TPU_METRICS_FLUSH_S",
+                   "BIGDL_TPU_STATUSZ_PORT"):
             monkeypatch.delenv(kk, raising=False)
+        monkeypatch.setenv("BIGDL_TPU_WATCHDOG_PCT", "0")
     r = np.random.RandomState(0)
     x = r.randn(16 * (iters + 2), 6).astype(np.float32)
     y = r.randint(0, 3, len(x)).astype(np.int32)

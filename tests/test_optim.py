@@ -218,8 +218,12 @@ def test_predictor_empty_and_bucket():
     assert svc._bucket(5) == 8
     assert svc._bucket(100) == 100
     assert svc._bucket(200) == 100
-    out = svc.predict(np.zeros((0, 4), np.float32))
-    assert out.shape == (0, 3)
+    # PR 8 contract: the serving path zero-pads rows into buckets and
+    # REJECTS empty requests as a client error (there is no bucket for
+    # 0 rows) — only the offline Predictor returns an empty result
+    with pytest.raises(ValueError, match="empty request"):
+        svc.predict(np.zeros((0, 4), np.float32))
+    svc.close()
 
 
 def test_set_initial_survives_donation_and_retry(tmp_path):
