@@ -1,22 +1,31 @@
-"""Static analysis for bigdl_tpu — correctness tooling that enables scale.
+"""Static + dynamic analysis for bigdl_tpu — correctness tooling that
+enables scale.
 
-Two prongs (docs/static_analysis.md):
+Three prongs (docs/static_analysis.md, docs/concurrency.md):
   * graph checker (:mod:`bigdl_tpu.analysis.graphcheck`): one abstract-eval
     walk over a `Module` tree catches shape mismatches, dtype drift, dead
     params, stale state, bad PartitionSpecs and rng-fold collisions — with
     module-path provenance, before any XLA trace. Bound as
     ``Module.check()`` / ``Module.summary()``; also the
     ``python -m bigdl_tpu.analysis`` CLI.
-  * tracing-safety lint (:mod:`bigdl_tpu.analysis.rules` via
-    ``tools/tpu_lint.py``): AST rules TPU-LINT001..007 over the repo, with
-    a checked-in ratchet baseline. The lint is stdlib-only; import it from
+  * tracing-safety + concurrency lint (:mod:`bigdl_tpu.analysis.rules`
+    via ``tools/tpu_lint.py``): AST rules TPU-LINT001..007 (tracing) and
+    TPU-LINT101..105 (threading discipline) over the repo, with a
+    checked-in ratchet baseline. The lint is stdlib-only; import it from
     here only when jax is already in the process.
+  * concurrency sanitizer (:mod:`bigdl_tpu.analysis.sancov`): opt-in
+    runtime checks behind BIGDL_TPU_SANITIZE — lock-order-inversion
+    cycles, long holds, lockset unlocked-write races on registered
+    shared structures, and un-sanctioned device→host syncs attributed
+    to phase spans. ``python -m bigdl_tpu.analysis threads`` dumps the
+    live thread/lock inventory + findings.
 """
 
+from bigdl_tpu.analysis import sancov
 from bigdl_tpu.analysis.graphcheck import (GraphCheckError, Issue,
                                            check_module, summarize)
 from bigdl_tpu.analysis.rules import (RULES, Violation, lint_paths,
                                       lint_source)
 
 __all__ = ["GraphCheckError", "Issue", "check_module", "summarize",
-           "RULES", "Violation", "lint_paths", "lint_source"]
+           "RULES", "Violation", "lint_paths", "lint_source", "sancov"]

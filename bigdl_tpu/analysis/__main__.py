@@ -11,6 +11,16 @@ no device needed (the CLI forces JAX_PLATFORMS=cpu before importing jax).
 
 Exit status: 0 clean, 1 error-severity issues (or factory failure) —
 CI-friendly, like tools/tpu_lint.py for the AST prong.
+
+The concurrency-doctor subcommand:
+
+    python -m bigdl_tpu.analysis threads [--json]
+
+dumps the live thread/lock inventory of THIS process (threads spawned
+through utils/threads.spawn with owner modules, every factory-built lock
+with live sanitizer state, registered shared structures) plus any
+sanitizer findings — the in-process view `/statusz` serves remotely.
+Library callers embed the same view via `threads_payload()`.
 """
 
 from __future__ import annotations
@@ -47,11 +57,75 @@ def _load_factory(ref: str):
     return model
 
 
+def threads_payload() -> dict:
+    """The live thread/lock inventory + sanitizer findings of this
+    process — the `threads` subcommand's document, importable so tests
+    and embedding processes read the same view."""
+    import threading as _threading
+
+    from bigdl_tpu.analysis import sancov
+    from bigdl_tpu.utils.threads import lock_inventory, thread_inventory
+    spawned = thread_inventory()
+    known = {t["ident"] for t in spawned}
+    other = [{"name": t.name, "daemon": t.daemon, "ident": t.ident,
+              "owner": "(not spawned via utils.threads)"}
+             for t in _threading.enumerate()
+             if t.ident not in known and t is not _threading.main_thread()]
+    return {
+        "threads": spawned,
+        "unmanaged_threads": other,
+        "locks": lock_inventory(),
+        "sanitizer": sancov.report_payload(),
+    }
+
+
+def threads_main(argv: Sequence[str]) -> int:
+    """`python -m bigdl_tpu.analysis threads [--json]`"""
+    import json
+    ap = argparse.ArgumentParser(
+        prog="python -m bigdl_tpu.analysis threads",
+        description="Live thread/lock inventory + concurrency-sanitizer "
+                    "findings (docs/concurrency.md)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    p = threads_payload()
+    if args.json:
+        print(json.dumps(p, default=str))
+        return 0
+    print(f"threads ({len(p['threads'])} spawned, "
+          f"{len(p['unmanaged_threads'])} unmanaged):")
+    for t in p["threads"]:
+        state = "alive" if t.get("alive") else "done"
+        print(f"  {t['name']:<24} {state:<5} daemon={t['daemon']} "
+              f"owner={t['owner']}")
+    for t in p["unmanaged_threads"]:
+        print(f"  {t['name']:<24} ????  daemon={t['daemon']} "
+              f"{t['owner']}")
+    print(f"locks ({len(p['locks'])}):")
+    for lk in p["locks"]:
+        extra = ""
+        if "acquisitions" in lk:
+            extra = (f" acquisitions={lk['acquisitions']}"
+                     f" held_now={lk['held_now']}")
+        print(f"  {lk['name']:<24} {lk['kind']:<9} "
+              f"tracked={lk['tracked']} owner={lk['owner']}{extra}")
+    san = p["sanitizer"]
+    print(f"sanitizer: modes={san['modes'] or 'off'} "
+          f"shared={san['shared']}")
+    for r in san["reports"]:
+        print(f"  [{r['kind']}] {r}")
+    return 1 if san["reports"] else 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "threads":
+        return threads_main(argv[1:])
     parser = argparse.ArgumentParser(
         prog="python -m bigdl_tpu.analysis",
         description="Ahead-of-trace model-graph checker "
-                    "(docs/static_analysis.md)")
+                    "(docs/static_analysis.md); `threads` subcommand: "
+                    "live thread/lock inventory")
     parser.add_argument("factory",
                         help="model factory as 'pkg.module:callable'")
     parser.add_argument("--input", action="append", default=[],

@@ -37,6 +37,31 @@ Rules
                `donate_argnums` — doubles peak HBM by keeping dead input
                buffers alive across the update.
 
+Concurrency rules (the TPU-LINT100 series — the static leg of the
+concurrency doctor; analysis/sancov.py is the runtime leg):
+
+  TPU-LINT101  raw `threading.Thread` inside bigdl_tpu/ outside the
+               sanctioned wrapper (utils/threads.py `spawn`) — threads
+               must land in the process inventory with an owner, or
+               `python -m bigdl_tpu.analysis threads` and the shutdown
+               audit cannot see them.
+  TPU-LINT102  `time.sleep` while lexically holding a lock — a sleeping
+               lock-holder serializes every other participant for the
+               whole nap (use Condition.wait with a timeout instead).
+  TPU-LINT103  `threading.Thread(...)` without an explicit `daemon=` —
+               undecided daemonhood is how clean exits hang; make the
+               discipline visible (daemon=True + join on the owner's
+               shutdown path).
+  TPU-LINT104  blocking I/O (open/os.replace/shutil/urllib/subprocess/
+               socket) lexically inside a lock scope — the serialization
+               that turned PR 9's input service into a bench item.
+  TPU-LINT105  mutation of module-level mutable state (list/dict/set)
+               outside any lock scope, in a module that owns a
+               module-level lock — the module declares locked
+               concurrency, so an unlocked mutation of shared state is
+               a race (the sanitizer's lockset check is the dynamic
+               twin).
+
 Suppression: a trailing ``# tpu-lint: disable=001,006`` (or full ids, or
 ``all``) on the flagged line. Pre-existing violations are ratcheted via a
 checked-in baseline of per-file per-rule counts (tools/tpu_lint_baseline.json):
@@ -67,6 +92,14 @@ RULES: Dict[str, Tuple[str, str]] = {
     "TPU-LINT006": ("mutation of self inside an apply-path method", "error"),
     "TPU-LINT007": ("jit of a train/step function without donate_argnums",
                     "warning"),
+    "TPU-LINT101": ("raw threading.Thread outside utils/threads.spawn",
+                    "error"),
+    "TPU-LINT102": ("time.sleep while holding a lock", "error"),
+    "TPU-LINT103": ("threading.Thread without an explicit daemon=",
+                    "error"),
+    "TPU-LINT104": ("blocking I/O inside a lock scope", "error"),
+    "TPU-LINT105": ("module-level mutable state mutated outside the "
+                    "module's lock", "error"),
 }
 
 # Names of methods whose bodies are traced by XLA (the Module contract).
@@ -85,6 +118,29 @@ _STATIC_CMPOPS = (ast.Is, ast.IsNot, ast.In, ast.NotIn)
 _UNTRACED_ARGS = {"self", "training", "name"}
 
 _PRAGMA_RE = re.compile(r"#\s*tpu-lint:\s*disable=([\w,\- ]+)")
+
+# ---- concurrency-rule (TPU-LINT10x) tables -------------------------------
+# a `with X:` whose terminal name looks like a mutex opens a lock scope
+_LOCKISH_RE = re.compile(r"(lock|mutex|cv|cond)", re.I)
+# module-level `X = <factory>()` that marks the module as lock-owning
+_LOCK_FACTORIES = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "Lock", "RLock", "Condition",
+    "make_lock", "make_rlock", "make_condition",
+    "threads.make_lock", "threads.make_rlock", "threads.make_condition",
+}
+# module-level values that are shared mutable state
+_MUTABLE_FACTORIES = {"dict", "list", "set", "deque", "defaultdict",
+                      "OrderedDict", "collections.deque",
+                      "collections.defaultdict",
+                      "collections.OrderedDict"}
+_MUTATING_METHODS = {"append", "appendleft", "extend", "insert", "clear",
+                     "update", "pop", "popleft", "popitem", "add",
+                     "remove", "discard", "setdefault"}
+# canonical blocking-I/O call targets for TPU-LINT104
+_BLOCKING_IO_DOTTED = {"open", "os.replace", "os.rename", "os.makedirs",
+                       "os.remove", "os.unlink", "os.rmdir", "os.listdir"}
+_BLOCKING_IO_ROOTS = {"shutil", "urllib", "subprocess", "socket"}
 
 
 @dataclass
@@ -139,6 +195,21 @@ def _dotted(node: ast.AST) -> str:
     return ".".join(reversed(parts))
 
 
+def _strict_dotted(node: ast.AST) -> str:
+    """Dotted name that does NOT resolve through chained calls:
+    `threading.Thread(...).start()` is '' (the outer call), not
+    'threading.Thread' — the concurrency rules must attribute the
+    construction exactly once."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
 def _terminal_name(node: ast.AST) -> str:
     """Rightmost identifier of an expression (for jit-target heuristics)."""
     if isinstance(node, ast.Name):
@@ -172,6 +243,13 @@ class _Linter(ast.NodeVisitor):
                                  ("tests/", "examples/", "docs/", "tools/",
                                   "bench"))
                              or base.startswith(("test_", "conftest")))
+        # TPU-LINT101 scope: the framework package, minus the wrapper
+        self._threads_scope = (posix.startswith("bigdl_tpu/")
+                               and posix != "bigdl_tpu/utils/threads.py")
+        self._lock_depth = 0
+        self._func_depth = 0
+        self._mod_mutables: Set[str] = set()
+        self._mod_has_lock = False
 
     # ----------------------------------------------------------- reporting
     def _report(self, rule: str, node: ast.AST, message: str):
@@ -192,6 +270,77 @@ class _Linter(ast.NodeVisitor):
         for parent in ast.walk(root):
             for child in ast.iter_child_nodes(parent):
                 self._parents[id(child)] = parent
+
+    def _prescan_module(self, tree: ast.Module):
+        """Module-level facts for TPU-LINT105: which top-level names are
+        mutable containers, and whether the module owns a lock."""
+        for stmt in tree.body:
+            if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                targets = [stmt.target]
+                value = stmt.value
+            elif isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+                value = stmt.value
+            else:
+                continue
+            names = [t.id for t in targets if isinstance(t, ast.Name)]
+            if not names:
+                continue
+            mutable = isinstance(value, (ast.List, ast.Dict, ast.Set,
+                                         ast.ListComp, ast.DictComp,
+                                         ast.SetComp))
+            if isinstance(value, ast.Call):
+                dotted = _dotted(value.func)
+                if dotted in _LOCK_FACTORIES:
+                    self._mod_has_lock = True
+                    continue
+                mutable = dotted in _MUTABLE_FACTORIES
+            if mutable:
+                self._mod_mutables.update(names)
+
+    @staticmethod
+    def _sub_base(node: ast.AST) -> Optional[str]:
+        """Unwrap subscript chains to the base Name (`_state['a']['b']`
+        -> '_state'); None for attribute bases (`self._x[k]`)."""
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        return node.id if isinstance(node, ast.Name) else None
+
+    def _check_global_mutation(self, node, targets) -> None:
+        """TPU-LINT105: an unlocked write to a module-level mutable in a
+        lock-owning module (only inside function bodies — module import
+        is single-threaded)."""
+        if not (self._mod_has_lock and self._func_depth
+                and not self._lock_depth):
+            return
+        for t in targets:
+            nm = self._sub_base(t)
+            if nm in self._mod_mutables:
+                self._report("TPU-LINT105", node,
+                             f"write to module-level `{nm}` without "
+                             f"holding the module's lock (wrap in the "
+                             f"lock's `with`, or pragma if truly "
+                             f"single-threaded)")
+
+    # -------------------------------------------------------- lock scopes
+    @staticmethod
+    def _lockish_item(item: ast.withitem) -> bool:
+        expr = item.context_expr
+        if isinstance(expr, ast.Name):
+            name = expr.id
+        elif isinstance(expr, ast.Attribute):
+            name = expr.attr
+        else:
+            return False
+        return bool(_LOCKISH_RE.search(name))
+
+    def visit_With(self, node: ast.With):
+        n = sum(1 for item in node.items if self._lockish_item(item))
+        self._lock_depth += n
+        self.generic_visit(node)
+        self._lock_depth -= n
+
+    visit_AsyncWith = visit_With
 
     def _is_static_use(self, name_node: ast.Name, boundary: ast.AST) -> bool:
         """True if this traced-name reference only feeds static structure
@@ -237,6 +386,13 @@ class _Linter(ast.NodeVisitor):
     # -------------------------------------------------------------- scopes
     def visit_FunctionDef(self, node: ast.FunctionDef):
         self._check_jit_decorators(node)
+        self._func_depth += 1
+        try:
+            self._visit_function_body(node)
+        finally:
+            self._func_depth -= 1
+
+    def _visit_function_body(self, node: ast.FunctionDef):
         if node.name in HOT_METHODS:
             a = node.args
             names = {x.arg for x in (a.posonlyargs + a.args + a.kwonlyargs)}
@@ -313,6 +469,42 @@ class _Linter(ast.NodeVisitor):
 
         if dotted in ("jax.jit", "jit"):
             self._check_jit_call(node)
+
+        # ---- concurrency rules (TPU-LINT10x) ----------------------------
+        sdotted = _strict_dotted(node.func)
+        if sdotted in ("threading.Thread", "Thread"):
+            if self._threads_scope:
+                self._report("TPU-LINT101", node,
+                             "raw threading.Thread — spawn through "
+                             "bigdl_tpu.utils.threads.spawn so the thread "
+                             "lands in the process inventory")
+            if not any(kw.arg == "daemon" for kw in node.keywords):
+                self._report("TPU-LINT103", node,
+                             "Thread without an explicit daemon= — the "
+                             "discipline is daemon=True plus a join on "
+                             "the owner's shutdown path")
+        if self._lock_depth:
+            if sdotted in ("time.sleep", "sleep"):
+                self._report("TPU-LINT102", node,
+                             "time.sleep while holding a lock serializes "
+                             "every other participant for the whole nap; "
+                             "use Condition.wait(timeout=...) instead")
+            elif sdotted in _BLOCKING_IO_DOTTED or \
+                    sdotted.split(".", 1)[0] in _BLOCKING_IO_ROOTS:
+                self._report("TPU-LINT104", node,
+                             f"blocking I/O `{sdotted}()` inside a lock "
+                             f"scope — stage outside the lock, publish "
+                             f"the result under it")
+        if self._mod_has_lock and self._func_depth \
+                and not self._lock_depth \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATING_METHODS:
+            nm = self._sub_base(node.func.value)
+            if nm in self._mod_mutables:
+                self._report("TPU-LINT105", node,
+                             f"`{nm}.{node.func.attr}()` mutates "
+                             f"module-level state without holding the "
+                             f"module's lock")
         self.generic_visit(node)
 
     def _jit_kwargs_donate(self, call: ast.Call) -> bool:
@@ -403,6 +595,9 @@ class _Linter(ast.NodeVisitor):
                          "assignment to self.* inside an apply-path method "
                          "breaks purity (state must flow through the state "
                          "pytree)")
+        self._check_global_mutation(
+            node, [t for t in node.targets
+                   if isinstance(t, ast.Subscript)])
         self.generic_visit(node)
 
     def visit_AugAssign(self, node: ast.AugAssign):
@@ -410,6 +605,8 @@ class _Linter(ast.NodeVisitor):
             self._report("TPU-LINT006", node,
                          "augmented assignment to self.* inside an "
                          "apply-path method")
+        if isinstance(node.target, ast.Subscript):
+            self._check_global_mutation(node, [node.target])
         self.generic_visit(node)
 
     def visit_AnnAssign(self, node: ast.AnnAssign):
@@ -422,6 +619,9 @@ class _Linter(ast.NodeVisitor):
         if self._hot and any(self._self_target(t) for t in node.targets):
             self._report("TPU-LINT006", node,
                          "del self.* inside an apply-path method")
+        self._check_global_mutation(
+            node, [t for t in node.targets
+                   if isinstance(t, ast.Subscript)])
         self.generic_visit(node)
 
 
@@ -433,6 +633,7 @@ def lint_source(source: str, path: str = "<string>") -> List[Violation]:
     tree = ast.parse(source, filename=path)
     linter = _Linter(path, source)
     linter._link_parents(tree)
+    linter._prescan_module(tree)
     linter.visit(tree)
     linter.violations.sort(key=lambda v: (v.line, v.col, v.rule))
     return linter.violations

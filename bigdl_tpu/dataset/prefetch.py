@@ -79,8 +79,8 @@ def prefetch_to_device(it: Iterable, size: Optional[int] = None,
         finally:
             _put(_END)
 
-    t = threading.Thread(target=worker, daemon=True)
-    t.start()
+    from bigdl_tpu.utils.threads import spawn
+    t = spawn(worker, name="bigdl-data-prefetch")
     try:
         while True:
             item = q.get()

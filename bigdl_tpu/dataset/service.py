@@ -173,9 +173,10 @@ def read_ahead(it: Iterable, depth: int = 8,
             finally:
                 _put(_END)
 
-        t = threading.Thread(target=worker, daemon=True,
-                             name="bigdl-data-read-ahead")
-        t.start()
+        from bigdl_tpu.analysis import sancov
+        from bigdl_tpu.utils.threads import spawn
+        sancov.register_shared(gauge_name, q.mutex)
+        t = spawn(worker, name="bigdl-data-read-ahead")
         try:
             while True:
                 item = q.get()

@@ -475,8 +475,9 @@ class ShardedRecordDataset(DataSet):
             except BaseException as e:      # surfaced on the consumer side
                 errors.append(e)
 
-        threads = [threading.Thread(target=worker, daemon=True)
-                   for _ in range(self.num_workers)]
+        from bigdl_tpu.utils.threads import spawn
+        threads = [spawn(worker, name=f"sharded-decode-{i}", start=False)
+                   for i in range(self.num_workers)]
         for t in threads:
             t.start()
 
@@ -485,7 +486,7 @@ class ShardedRecordDataset(DataSet):
                 t.join()
             put(_END)
 
-        threading.Thread(target=closer, daemon=True).start()
+        spawn(closer, name="sharded-closer")
 
         try:
             while True:

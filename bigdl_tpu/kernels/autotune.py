@@ -47,6 +47,8 @@ import shutil
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from bigdl_tpu.utils.threads import make_lock
+
 log = logging.getLogger("bigdl_tpu")
 
 _PREFIX = "tune_"
@@ -55,6 +57,10 @@ _STAGING_PREFIX = ".staging-p"
 
 _state: Dict = {"root": None, "staging": None, "table": {},
                 "loaded_root": None, "searches": 0}
+# _state is shared by every Pallas call site AND the autotune-search
+# thread hop — writes go under this lock (lockset-checked by the
+# concurrency sanitizer, analysis/sancov.py)
+_table_lock = make_lock("autotune.table")
 _atexit_registered = False
 
 
@@ -179,7 +185,8 @@ def _attach(root: Optional[str] = None) -> Optional[str]:
     staging = os.path.join(
         root, f"{_STAGING_PREFIX}{process_index()}-{os.getpid()}")
     os.makedirs(staging, exist_ok=True)
-    _state.update(root=root, staging=staging)
+    with _table_lock:
+        _state.update(root=root, staging=staging)
     global _atexit_registered
     if not _atexit_registered:
         atexit.register(sync)
@@ -201,8 +208,9 @@ def _load(root: str) -> int:
             table[rec["key"]] = rec
         except (OSError, ValueError, KeyError) as e:
             log.warning("autotune table entry %s unreadable: %s", name, e)
-    _state["table"] = table
-    _state["loaded_root"] = root
+    with _table_lock:
+        _state["table"] = table
+        _state["loaded_root"] = root
     return len(table)
 
 
@@ -218,7 +226,11 @@ def _record(key: str, rec: Dict) -> None:
     the commit, so a concurrent reader sees a whole entry or no entry.
     The temp name carries pid AND thread id: two threads of one process
     racing on a key must not publish each other's half-written files."""
-    _state["table"][key] = rec
+    with _table_lock:
+        from bigdl_tpu.analysis import sancov
+        if sancov.LOCKS_ON:        # lockset seed: the autotune table
+            sancov.check_owned(_table_lock, "autotune.table")
+        _state["table"][key] = rec
     root, staging = _state["root"], _state["staging"]
     if root is None or staging is None:
         return
@@ -250,8 +262,9 @@ def detach() -> None:
     """Drop the root binding and this process's staging dir (tests)."""
     sync()
     staging = _state["staging"]
-    _state.update(root=None, staging=None, table={}, loaded_root=None,
-                  searches=0)
+    with _table_lock:
+        _state.update(root=None, staging=None, table={}, loaded_root=None,
+                      searches=0)
     if staging:
         shutil.rmtree(staging, ignore_errors=True)
 
@@ -301,7 +314,8 @@ def clear(root: Optional[str] = None) -> int:
             except OSError:
                 pass
     if _state["loaded_root"] == root:
-        _state["table"] = {}
+        with _table_lock:
+            _state["table"] = {}
     return removed
 
 
@@ -373,8 +387,10 @@ def _search(kernel: str, shape: Dict, defaults: Dict) -> Dict:
                             kernel, shape, candidates, make_runner)
                     except Exception as e:   # noqa: BLE001
                         box["err"] = e
-                t = threading.Thread(target=run, name="autotune-search")
-                t.start()
+                from bigdl_tpu.utils.threads import spawn
+                # joined immediately: the hop exists only for a clean
+                # thread-local jax trace state, so non-daemon is safe
+                t = spawn(run, name="autotune-search", daemon=False)
                 t.join()
                 if "err" in box:
                     log.warning("autotune search for %s failed: %s",
@@ -385,7 +401,8 @@ def _search(kernel: str, shape: Dict, defaults: Dict) -> Dict:
             if got is not None:
                 best_cfg = got
     search_s = time.perf_counter() - t0
-    _state["searches"] += 1
+    with _table_lock:
+        _state["searches"] += 1
     observe.counter("autotune/search_seconds").inc(search_s)
     rec = {"key": key, "kernel": kernel, "shape": dict(shape),
            "config": best_cfg, "device": device_signature(),

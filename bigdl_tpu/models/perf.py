@@ -69,13 +69,24 @@ def _synth_batch(model_name, kind, spatial, batch_size, class_num,
     return x, y, criterion
 
 
-def _make_step(model, criterion, method, compute_dtype):
+def _resolve_seed(seed):
+    """Explicit seed > BIGDL_TPU_SEED — the bench stays deterministic by
+    default but the seed is threaded, not baked in (TPU-LINT004)."""
+    if seed is not None:
+        return int(seed)
+    from bigdl_tpu.utils import config
+    return int(config.get("SEED"))
+
+
+def _make_step(model, criterion, method, compute_dtype, seed):
     """The jitted SGD train step shared by run() and run_scaling()."""
     import jax
     import jax.numpy as jnp
 
     from bigdl_tpu.core.module import cast_floating
-    rng = jax.random.PRNGKey(7)
+    # distinct stream from the init key (same fold discipline as the
+    # trainers' per-step rng threading)
+    rng = jax.random.fold_in(jax.random.PRNGKey(seed), 7)
 
     @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
     def step(params, slots, model_state, x, y):
@@ -108,28 +119,29 @@ def _time_step(step, params, slots, state, x, y, warmup, iters,
 
 
 def run(model_name: str, batch_size: int, iters: int, warmup: int,
-        dtype: str, class_num: int) -> float:
+        dtype: str, class_num: int, seed: int = None) -> float:
     import jax
     import jax.numpy as jnp
 
     from bigdl_tpu.optim.method import SGD
 
+    seed = _resolve_seed(seed)
     model, spatial, kind = _model(model_name, class_num)
     autoenc = model_name == "autoencoder"
     method = SGD(0.1, momentum=0.9)
     compute_dtype = {"bf16": jnp.bfloat16, "fp32": None}[dtype]
-    params, state = model.init(jax.random.PRNGKey(0))
+    params, state = model.init(jax.random.PRNGKey(seed))
     slots = method.init_slots(params)
     x, y, criterion = _synth_batch(model_name, kind, spatial, batch_size,
                                    class_num, autoenc)
-    step = _make_step(model, criterion, method, compute_dtype)
+    step = _make_step(model, criterion, method, compute_dtype, seed)
     return _time_step(step, params, slots, state, x, y, warmup, iters,
                       batch_size)
 
 
 def run_scaling(model_name: str, batch_per_device: int, iters: int,
                 warmup: int, dtype: str, class_num: int,
-                device_counts=None) -> dict:
+                device_counts=None, seed: int = None) -> dict:
     """Data-parallel throughput at 1/2/4/... devices (whitepaper.md:160-164
     scaling-table culture; on the virtual CPU mesh this measures the SPMD
     plumbing's scaling, not chip FLOPs — the JSON labels the backend)."""
@@ -146,6 +158,7 @@ def run_scaling(model_name: str, batch_per_device: int, iters: int,
         device_counts = [n for n in (1, 2, 4, 8, 16, 32, 64) if n <= ndev]
         if ndev not in device_counts:    # non-power-of-2 topologies
             device_counts.append(ndev)
+    seed = _resolve_seed(seed)
     compute_dtype = {"bf16": jnp.bfloat16, "fp32": None}[dtype]
     model, spatial, kind = _model(model_name, class_num)
     autoenc = model_name == "autoencoder"
@@ -154,7 +167,7 @@ def run_scaling(model_name: str, batch_per_device: int, iters: int,
     for n in device_counts:
         mesh = create_mesh(jax.devices()[:n], drop_trivial_axes=True)
         bs = batch_per_device * n
-        params, state = model.init(jax.random.PRNGKey(0))
+        params, state = model.init(jax.random.PRNGKey(seed))
         slots = method.init_slots(params)
         x, y, criterion = _synth_batch(model_name, kind, spatial, bs,
                                        class_num, autoenc)
@@ -164,7 +177,7 @@ def run_scaling(model_name: str, batch_per_device: int, iters: int,
         place = lambda t, s: jax.tree.map(lambda a: jax.device_put(a, s), t)
         params, slots, state = (place(params, rep), place(slots, rep),
                                 place(state, rep))
-        step = _make_step(model, criterion, method, compute_dtype)
+        step = _make_step(model, criterion, method, compute_dtype, seed)
         results[n] = _time_step(step, params, slots, state, x, y, warmup,
                                 iters, bs)
     base = results[device_counts[0]] / device_counts[0]

@@ -24,6 +24,16 @@ import numpy as np
 from bigdl_tpu.utils.platform import force_cpu_if_requested
 
 
+def _seed_of(args) -> int:
+    """--seed wins, else the BIGDL_TPU_SEED knob — the CLI trainers
+    thread every PRNGKey from here (TPU-LINT004: no baked-in seeds)."""
+    s = getattr(args, "seed", None)
+    if s is not None:
+        return int(s)
+    from bigdl_tpu.utils import config
+    return int(config.get("SEED"))
+
+
 def _common(p: argparse.ArgumentParser):
     p.add_argument("-f", "--folder", default=None, help="dataset folder")
     p.add_argument("--data", default=None,
@@ -44,6 +54,8 @@ def _common(p: argparse.ArgumentParser):
     p.add_argument("--synthetic-size", type=int, default=512)
     p.add_argument("--optimizer", default=None,
                    help="sgd|adam|rmsprop (model default otherwise)")
+    p.add_argument("--seed", type=int, default=None,
+                   help="init/shuffle RNG seed (default: BIGDL_TPU_SEED)")
     p.add_argument("--slices", type=int, default=None,
                    help="two-tier data parallelism: split the batch "
                         "axis into a ('slice','data') mesh of this many "
@@ -438,7 +450,7 @@ def _train_ptb_pipelined(args, d, xs, ys):
     lm = PipelinedLM(d.vocab_size, d_model=args.hidden, num_heads=4,
                      num_layers=args.layers, n_stages=S,
                      n_microbatches=micro)
-    rng = jax.random.PRNGKey(0)
+    rng = jax.random.PRNGKey(_seed_of(args))
     st = lm.init(rng, mesh)
     holder = {"st": st, "rng": rng}
 
@@ -475,7 +487,7 @@ def _train_ptb_seq_parallel(args, d, xs, ys):
     mesh = create_mesh(jax.devices()[:S], seq=S, drop_trivial_axes=True)
     lm = SeqParallelLM(d.vocab_size, d_model=args.hidden, num_heads=4,
                       num_layers=args.layers)
-    params = lm.init(jax.random.PRNGKey(0))
+    params = lm.init(jax.random.PRNGKey(_seed_of(args)))
     holder = {"p": params}
 
     def step(xb, yb, lr):
@@ -509,7 +521,7 @@ def _train_ptb_moe(args, d, xs, ys):
     mesh = create_mesh(jax.devices()[:E], expert=E, drop_trivial_axes=True)
     lm = MoELM(d.vocab_size, d_model=args.hidden, num_heads=4,
                num_layers=args.layers, n_experts=E)
-    params = lm.init(jax.random.PRNGKey(0))
+    params = lm.init(jax.random.PRNGKey(_seed_of(args)))
     holder = {"p": params}
 
     def step(xb, yb, lr):

@@ -40,6 +40,7 @@ dispatched batch, no per-request syncs — bigdl_tpu/serve/).
 
 from __future__ import annotations
 
+import atexit
 import threading
 from typing import Optional
 
@@ -50,6 +51,7 @@ from bigdl_tpu.observe.metrics import (counter, gauge, histogram, phase,
 from bigdl_tpu.observe.trace import get_tracer, instant, span
 from bigdl_tpu.utils.runtime import (install_log_prefix, process_index,
                                      run_id)
+from bigdl_tpu.utils.threads import make_lock
 
 __all__ = [
     "counter", "gauge", "histogram", "phase", "registry",
@@ -59,9 +61,10 @@ __all__ = [
     "statusz_server",
 ]
 
-_lock = threading.Lock()
+_lock = make_lock("observe.lifecycle")
 _exports = None            # ExportManager when any exporter is configured
 _started = False
+_atexit_registered = False
 _compile_listener = None
 _compile_event_listener = None
 _tls = threading.local()   # per-thread cache-hit marker (see below)
@@ -140,6 +143,13 @@ def ensure_started() -> bool:
     with _lock:
         install_log_prefix()
         _install_jax_compile_listener()
+        # concurrency sanitizer (analysis/sancov.py): the locks mode
+        # arms at lock construction, but the sync guard (device_get
+        # wrapper + phase hook) installs here — the knob set at process
+        # start is enough, no explicit sancov call needed
+        from bigdl_tpu.analysis import sancov
+        if sancov.sanitize_modes():
+            sancov.refresh()
         trace_dir = config.get("TRACE")
         t = get_tracer()
         if trace_dir:
@@ -170,6 +180,14 @@ def ensure_started() -> bool:
         from bigdl_tpu.observe import statusz as _statusz
         sz = _statusz.start()
         _started = True
+        # thread-shutdown audit (docs/concurrency.md): a process that
+        # merely turned the plane on must exit cleanly — join the export
+        # flusher and close the statusz server BEFORE interpreter
+        # teardown starts reclaiming the modules those threads touch
+        global _atexit_registered
+        if not _atexit_registered:
+            atexit.register(shutdown)
+            _atexit_registered = True
         return bool(t.enabled or _exports or sz)
 
 

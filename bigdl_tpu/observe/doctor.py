@@ -36,11 +36,12 @@ import json
 import logging
 import os
 import shutil
-import threading
 import time
 import traceback
 from collections import deque
 from typing import Dict, List, Optional
+
+from bigdl_tpu.utils.threads import make_lock
 
 log = logging.getLogger("bigdl_tpu")
 
@@ -74,7 +75,7 @@ class Watchdog:
                        else window)
         self.sustain = max(1, config.get("WATCHDOG_SUSTAIN")
                            if sustain is None else sustain)
-        self._lock = threading.Lock()
+        self._lock = make_lock("doctor.watchdog")
         self._steps: deque = deque(maxlen=self.window)
         self._phase_prev: Dict[str, float] = {}
         self._phase_base: Dict[str, deque] = {
@@ -208,7 +209,7 @@ class Watchdog:
 
 
 _watchdog: Optional[Watchdog] = None
-_wd_lock = threading.Lock()
+_wd_lock = make_lock("doctor.singleton")
 
 
 def watchdog() -> Watchdog:
@@ -231,6 +232,7 @@ def reset_watchdog() -> None:
 # ------------------------------------------------------------- forensics
 _KEEP_BUNDLES = 8
 _dumped: set = set()            # (reason, id(exc)) dedupe per process
+_dumped_lock = make_lock("doctor.forensics")   # two crashing threads race
 
 
 def forensics_root() -> Optional[str]:
@@ -261,9 +263,10 @@ def dump_forensics(reason: str, exc: Optional[BaseException] = None,
     if root is None:
         return None
     key = (reason, id(exc))
-    if exc is not None and key in _dumped:
-        return None
-    _dumped.add(key)
+    with _dumped_lock:
+        if exc is not None and key in _dumped:
+            return None
+        _dumped.add(key)
     from bigdl_tpu.observe import metrics as _metrics
     from bigdl_tpu.observe import trace as _trace
     from bigdl_tpu.utils.runtime import process_index, run_id
@@ -301,6 +304,12 @@ def dump_forensics(reason: str, exc: Optional[BaseException] = None,
     _write("meta.json", meta)
     _write("metrics.json", _metrics.registry().snapshot())
     _write("spans.json", _trace.get_tracer().chrome_trace())
+    from bigdl_tpu.analysis import sancov
+    san = sancov.report_payload()
+    if san["modes"] or san["reports"]:
+        # concurrency-sanitizer findings ride the same bundle the
+        # post-mortem reads — a deadlock-shaped crash names its locks
+        _write("sanitizer.json", san)
     from bigdl_tpu.utils import config
     _write("config.json", {k.env: k.get() for k in
                            config.knobs().values()})
@@ -333,9 +342,10 @@ def _load_bundle(path: str) -> dict:
     """A forensics bundle dir -> {meta, snapshot, statusz, spans,
     error}; missing pieces load as empty."""
     out = {"meta": {}, "snapshot": {}, "statusz": {}, "spans": {},
-           "error": ""}
+           "sanitizer": {}, "error": ""}
     names = {"meta": "meta.json", "snapshot": "metrics.json",
-             "statusz": "statusz.json", "spans": "spans.json"}
+             "statusz": "statusz.json", "spans": "spans.json",
+             "sanitizer": "sanitizer.json"}
     for key, name in names.items():
         p = os.path.join(path, name)
         if os.path.exists(p):
@@ -370,6 +380,7 @@ def render_doctor(target: str) -> dict:
         snapshot, meta = b["snapshot"], b["meta"]
         spans, error = b["spans"], b["error"]
         alerts = (b["statusz"].get("watchdog", {}) or {}).get("alerts", [])
+        sanitizer = b["sanitizer"]
         kind = "bundle"
     else:
         from bigdl_tpu.observe.report import load_jsonl
@@ -378,6 +389,7 @@ def render_doctor(target: str) -> dict:
         meta = {"run_id": snapshot.get("run_id"),
                 "flushes": len(recs)}
         spans, error, alerts = {}, "", []
+        sanitizer = {}
         kind = "jsonl"
     counters = snapshot.get("counters", {})
     gauges = snapshot.get("gauges", {})
@@ -400,6 +412,7 @@ def render_doctor(target: str) -> dict:
         "serve": serve_slo(snapshot),
         "alerts": alerts,
         "anomalies": {k: v for k, v in anomalies.items() if v},
+        "sanitizer": sanitizer or None,
         "top_spans": _top_spans(spans),
         "last_step": gauges.get("train/neval", 0),
         "last_loss": gauges.get("train/loss"),
@@ -446,6 +459,25 @@ def doctor_main(argv: Optional[List[str]] = None) -> int:
             print(f"  iter {a.get('neval')}: {a.get('slowdown_x')}x "
                   f"slowdown -> {a.get('phase')} "
                   f"({'resolved' if a.get('resolved') else 'ACTIVE'})")
+    san = d.get("sanitizer")
+    if san and san.get("reports"):
+        print("\nconcurrency sanitizer findings "
+              f"(modes: {', '.join(san.get('modes', [])) or 'off'}):")
+        for r in san["reports"]:
+            if r["kind"] == "lock-order-cycle":
+                hops = " -> ".join(e["from"] for e in r.get("edges", []))
+                print(f"  lock-order cycle [{hops}] — potential "
+                      f"deadlock; edges acquired at "
+                      + "; ".join(e["site"] for e in r.get("edges", [])))
+            elif r["kind"] == "unlocked-write":
+                print(f"  unlocked write to {r.get('shared')} at "
+                      f"{r.get('where')} (owner lock {r.get('lock')}, "
+                      f"thread {r.get('thread')})")
+            elif r["kind"] == "hostsync":
+                print(f"  un-sanctioned device->host sync in phase "
+                      f"{r.get('phase')} at {r.get('where')}")
+            else:
+                print(f"  {r['kind']}: {r}")
     if d["serve"]:
         print("\nserve:")
         for m, s in d["serve"]["models"].items():

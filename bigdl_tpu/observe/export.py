@@ -173,9 +173,8 @@ class ExportManager:
 
     def start(self) -> "ExportManager":
         if self.exporters and self._thread is None:
-            self._thread = threading.Thread(
-                target=self._run, name="observe-export", daemon=True)
-            self._thread.start()
+            from bigdl_tpu.utils.threads import spawn
+            self._thread = spawn(self._run, name="observe-export")
         return self
 
     def _run(self) -> None:

@@ -22,11 +22,22 @@ grow forever (optim/local.py).
 from __future__ import annotations
 
 import math
-import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
-_lock = threading.Lock()
+from bigdl_tpu.utils.threads import make_lock
+
+_lock = make_lock("observe.metrics")
+
+# concurrency-sanitizer hook (analysis/sancov.py): when the sync mode is
+# on it installs a fn(name, entering) here so device->host fetches can
+# be attributed to the innermost live phase span; None costs one load
+_phase_hook: Optional[Callable[[str, bool], None]] = None
+
+
+def set_phase_hook(fn: Optional[Callable[[str, bool], None]]) -> None:
+    global _phase_hook
+    _phase_hook = fn
 
 
 class Counter:
@@ -176,6 +187,9 @@ class MetricsRegistry:
         m = self._metrics.get(name)
         if m is None:
             with _lock:
+                from bigdl_tpu.analysis import sancov
+                if sancov.LOCKS_ON:     # lockset seed: registry map
+                    sancov.check_owned(_lock, "metrics.registry")
                 m = self._metrics.get(name)
                 if m is None:
                     m = cls(name, *args)
@@ -216,7 +230,7 @@ class MetricsRegistry:
         accumulating — a flight recorder spans the process)."""
         with _lock:
             self._metrics.clear()
-        _phase_cache.clear()     # else phase() keeps orphaned histograms
+            _phase_cache.clear()  # else phase() keeps orphaned histograms
 
 
 _REGISTRY = MetricsRegistry()
@@ -251,11 +265,15 @@ class _Phase:
         self._hist, self._name, self._cat = hist, name, cat
 
     def __enter__(self):
+        if _phase_hook is not None:
+            _phase_hook(self._name, True)
         self._t0 = time.perf_counter_ns()
         return self
 
     def __exit__(self, *exc):
         dur_ns = time.perf_counter_ns() - self._t0
+        if _phase_hook is not None:
+            _phase_hook(self._name, False)
         self._hist.record(dur_ns * 1e-9)
         from bigdl_tpu.observe import trace
         t = trace._TRACER
@@ -315,7 +333,8 @@ def phase(name: str, cat: str = "train") -> _Phase:
     h = _phase_cache.get(name)
     if h is None:
         h = _REGISTRY.histogram(f"phase/{name}")
-        _phase_cache[name] = h
+        with _lock:              # miss path only; hits stay lock-free
+            _phase_cache[name] = h
     return _Phase(h, name, cat)
 
 

@@ -40,11 +40,13 @@ from __future__ import annotations
 import json
 import logging
 import os
-import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 from urllib.parse import parse_qs, urlparse
+
+from bigdl_tpu.analysis import sancov
+from bigdl_tpu.utils.threads import make_lock, spawn
 
 log = logging.getLogger("bigdl_tpu")
 
@@ -53,7 +55,8 @@ _t0 = time.time()
 # serve engines announce themselves here so /statusz can read their
 # per-model stats() without observe depending on serve at import time
 _engines: List = []
-_engines_lock = threading.Lock()
+_engines_lock = make_lock("statusz.engines")
+sancov.register_shared("statusz.engines", _engines_lock)
 
 
 def register_engine(engine) -> None:
@@ -61,6 +64,8 @@ def register_engine(engine) -> None:
     a shut-down engine reports itself closed and is dropped)."""
     import weakref
     with _engines_lock:
+        if sancov.LOCKS_ON:
+            sancov.check_owned(_engines_lock, "statusz.engines")
         _engines.append(weakref.ref(engine))
 
 
@@ -152,6 +157,11 @@ def status_payload() -> dict:
             "alerts": wd.alerts(),
         },
     }
+    san = sancov.report_payload()
+    if san["modes"]:
+        # concurrency sanitizer live (BIGDL_TPU_SANITIZE): findings
+        # belong on the same pane as everything else
+        payload["sanitizer"] = san
     if "failover/live_slices" in g:
         payload["failover"] = {
             "live_slices": int(g["failover/live_slices"]),
@@ -188,7 +198,7 @@ def tracez_payload(n: int = 100) -> dict:
 
 
 # ------------------------------------------------------------- profiler
-_profile_lock = threading.Lock()
+_profile_lock = make_lock("statusz.profile")
 _profile_until = 0.0
 
 
@@ -228,8 +238,7 @@ def arm_profiler(seconds: float) -> dict:
             _profile_until = 0.0
         log.info("profilez: %.1fs capture -> %s", seconds, out)
 
-    threading.Thread(target=_stop, name="profilez-stop",
-                     daemon=True).start()
+    spawn(_stop, name="profilez-stop")
     from bigdl_tpu.observe.metrics import counter
     counter("statusz/profile_captures").inc()
     return {"ok": True, "seconds": seconds, "dir": out}
@@ -299,10 +308,8 @@ class StatuszServer:
         self.httpd.daemon_threads = True
         self.host = host
         self.port = int(self.httpd.server_address[1])
-        self._thread = threading.Thread(
-            target=self.httpd.serve_forever, name="statusz-http",
-            daemon=True)
-        self._thread.start()
+        self._thread = spawn(self.httpd.serve_forever,
+                             name="statusz-http")
         log.info("statusz: live telemetry plane on http://%s:%d "
                  "(/healthz /metrics /statusz /tracez /profilez)",
                  host, self.port)
@@ -317,7 +324,7 @@ class StatuszServer:
 
 
 _server: Optional[StatuszServer] = None
-_server_lock = threading.Lock()
+_server_lock = make_lock("statusz.server")
 
 
 def start(port: Optional[int] = None,
@@ -354,8 +361,11 @@ def server() -> Optional[StatuszServer]:
 
 
 def stop() -> None:
+    # swap under the lock, join OUTSIDE it: close() waits on the HTTP
+    # thread (hundreds of ms), and holding the lock across that join
+    # is exactly the long-hold the sanitizer flags
     global _server
     with _server_lock:
-        if _server is not None:
-            _server.close()
-            _server = None
+        server, _server = _server, None
+    if server is not None:
+        server.close()

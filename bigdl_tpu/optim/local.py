@@ -1275,7 +1275,9 @@ class Optimizer:
             # the ONE host sync of the loop: blocks until the last
             # dispatched step's losses land — device compute backlog
             # shows up here, which is exactly what the span shows
-            losses = jax.device_get([p[2] for p in pending])
+            from bigdl_tpu.analysis.sancov import sanctioned_sync
+            with sanctioned_sync("flush-cadence loss fetch"):
+                losses = jax.device_get([p[2] for p in pending])
         last_iter, last_lr = pending[-1][0], pending[-1][1]
         st["loss"] = float(losses[-1])
         # non-finite step accounting: the fused path already MASKED each
@@ -1420,7 +1422,9 @@ class Optimizer:
                         self._summary.add_histogram(
                             f"{path}.grad",
                             _np.asarray(jax.device_get(g)), st["neval"])
-        walk(params, grads, "")
+        from bigdl_tpu.analysis.sancov import sanctioned_sync
+        with sanctioned_sync("trigger-gated parameter-histogram fetch"):
+            walk(params, grads, "")
 
     def _maybe_validate(self, params, model_state, st, fired=None):
         # `fired` overrides the trigger check — the fused dispatcher

@@ -319,9 +319,11 @@ class DistriOptimizer(Optimizer):
         t0 = _time.perf_counter()
         with observe.phase("failover/reshard", cat="resilience"):
             with observe.phase("failover/fetch", cat="resilience"):
-                host = jax.device_get(
-                    {"params": params, "model_state": model_state,
-                     "slots": slots})
+                from bigdl_tpu.analysis.sancov import sanctioned_sync
+                with sanctioned_sync("failover host round-trip"):
+                    host = jax.device_get(
+                        {"params": params, "model_state": model_state,
+                         "slots": slots})
             try:
                 new_mesh = (topo.lose(idx) if kind == "lose"
                             else topo.restore())

@@ -55,6 +55,8 @@ import signal
 import threading
 from typing import List, Optional, Tuple
 
+from bigdl_tpu.utils.threads import make_lock
+
 log = logging.getLogger("bigdl_tpu")
 
 CRASH, PREEMPT, IO = "crash", "preempt", "io"
@@ -126,7 +128,7 @@ _preempt = threading.Event()
 _slice_loss: Optional[int] = None
 _slice_gain = False
 _prev_handler = None
-_lock = threading.Lock()
+_lock = make_lock("resilience.faults")
 
 
 def configure(spec: str = None) -> None:

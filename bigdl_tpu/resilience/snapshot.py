@@ -104,9 +104,8 @@ class AsyncCheckpointer:
 
     def _enqueue(self, path, plan, root):
         if self._worker is None or not self._worker.is_alive():
-            self._worker = threading.Thread(
-                target=self._run_worker, name="ckpt-writer", daemon=True)
-            self._worker.start()
+            from bigdl_tpu.utils.threads import spawn
+            self._worker = spawn(self._run_worker, name="ckpt-writer")
         self._queue.put((path, plan, root))
 
     # ------------------------------------------------------------------ api
@@ -169,3 +168,15 @@ class AsyncCheckpointer:
             return None
         except BaseException as e:                 # noqa: BLE001 — drained
             return e
+
+    def close(self) -> Optional[BaseException]:
+        """Drain, then retire the writer thread for good: the daemon
+        flag keeps an abrupt exit from hanging, but a CLEAN shutdown
+        joins the worker so no write can race interpreter teardown
+        (thread-shutdown audit, docs/concurrency.md). Idempotent."""
+        err = self.drain()
+        worker, self._worker = self._worker, None
+        if worker is not None and worker.is_alive():
+            self._queue.put(None)                  # stop sentinel
+            worker.join(timeout=10)
+        return err

@@ -29,7 +29,6 @@ import argparse
 import importlib
 import json
 import sys
-import threading
 from typing import Optional, Sequence
 
 
@@ -77,8 +76,9 @@ def _smoke(engine, name: str, feature_shape, dtype, *, threads: int,
         except Exception as exc:           # noqa: BLE001 — reported in JSON
             errors.append(f"client {ti}: {exc!r}")
 
-    ts = [threading.Thread(target=client, args=(ti,))
-          for ti in range(threads)]
+    from bigdl_tpu.utils.threads import spawn
+    ts = [spawn(client, name=f"serve-smoke-client-{ti}", args=(ti,),
+                start=False) for ti in range(threads)]
     for t in ts:
         t.start()
     for t in ts:

@@ -42,6 +42,8 @@ from typing import Callable, List, Optional, Sequence
 import numpy as np
 
 from bigdl_tpu import observe
+from bigdl_tpu.analysis import sancov
+from bigdl_tpu.utils.threads import make_condition, spawn
 
 log = logging.getLogger("bigdl_tpu")
 
@@ -102,7 +104,8 @@ class ContinuousBatcher:
         self.coalesce = coalesce
         self.name = name
         self._clock = clock
-        self._cv = threading.Condition()
+        self._cv = make_condition(f"serve.cv.{name}")
+        sancov.register_shared(f"serve.pending.{name}", self._cv)
         self._pending: deque = deque()
         self._rows = 0
         self._inflight = 0
@@ -146,6 +149,8 @@ class ContinuousBatcher:
                     f"serving queue for {self.name!r} at bound: "
                     f"{self._rows} rows queued + {req.n} requested > "
                     f"{self.max_queue_rows}")
+            if sancov.LOCKS_ON:    # lockset seed: the request queue
+                sancov.check_owned(self._cv, f"serve.pending.{self.name}")
             self._pending.append(req)
             self._rows += req.n
             self._depth.set(self._rows)
@@ -207,6 +212,8 @@ class ContinuousBatcher:
         group = self._head_group()
         if not self.coalesce and group:
             group = group[:1]
+        if sancov.LOCKS_ON and group:
+            sancov.check_owned(self._cv, f"serve.pending.{self.name}")
         for req in group:
             self._pending.popleft()
             self._rows -= req.n
@@ -264,9 +271,7 @@ class ContinuousBatcher:
         if self._thread is not None:
             return self
         self._stop_check = stop_check
-        self._thread = threading.Thread(
-            target=self._loop, name=f"serve-{self.name}", daemon=True)
-        self._thread.start()
+        self._thread = spawn(self._loop, name=f"serve-{self.name}")
         return self
 
     def _loop(self) -> None:

@@ -30,7 +30,6 @@ to completion, and joins the scheduler threads: no future is ever lost.
 from __future__ import annotations
 
 import logging
-import threading
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -38,6 +37,7 @@ import numpy as np
 from bigdl_tpu import observe
 from bigdl_tpu.serve.batcher import Closed, ContinuousBatcher, Overloaded
 from bigdl_tpu.serve.registry import ModelEntry, ModelRegistry
+from bigdl_tpu.utils.threads import make_lock
 
 log = logging.getLogger("bigdl_tpu")
 
@@ -75,7 +75,7 @@ class ServeEngine:
         _statusz.register_engine(self)
         self.registry = ModelRegistry()
         self._batchers: Dict[str, ContinuousBatcher] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.engine")
         self._closed = False
         self._defaults = {
             "max_batch": config.get("SERVE_MAX_BATCH"),

@@ -33,12 +33,13 @@ engine schedules over:
 from __future__ import annotations
 
 import logging
-import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from bigdl_tpu import observe
+from bigdl_tpu.analysis.sancov import sanctioned_sync
+from bigdl_tpu.utils.threads import make_lock
 
 log = logging.getLogger("bigdl_tpu")
 
@@ -160,7 +161,8 @@ class ModelEntry:
         import jax
         valid = np.zeros((xs.shape[0],), bool)
         valid[:n_valid] = True
-        return jax.device_get(self.forward(xs, valid))
+        with sanctioned_sync("serve dispatch result fetch"):
+            return jax.device_get(self.forward(xs, valid))
 
     # --------------------------------------------------------------- AOT
     def precompile_for(self, feature_shape: Tuple[int, ...],
@@ -184,7 +186,7 @@ class ModelRegistry:
 
     def __init__(self):
         self._entries: Dict[str, ModelEntry] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.registry")
 
     def register(self, name: str, model, params, state, *, mesh=None,
                  max_batch: int = 256,

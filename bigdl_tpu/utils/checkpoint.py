@@ -68,7 +68,9 @@ def _fetch(v) -> np.ndarray:
     if isinstance(v, jax.Array) and not v.is_fully_addressable:
         from jax.experimental import multihost_utils
         return np.asarray(multihost_utils.process_allgather(v, tiled=True))
-    return np.asarray(jax.device_get(v))
+    from bigdl_tpu.analysis.sancov import sanctioned_sync
+    with sanctioned_sync("checkpoint gather"):
+        return np.asarray(jax.device_get(v))
 
 
 def save_checkpoint(path: str, trees: Dict[str, Any],

@@ -286,6 +286,23 @@ _register("FORENSICS", "1", str,
           "traceback). '1' (default) writes next to the trace dir "
           "(or /tmp/bigdl_tpu_forensics without one), a path overrides "
           "the destination root, '0' disables. Newest 8 bundles kept")
+_register("SANITIZE", "", str,
+          "Concurrency sanitizer (analysis/sancov.py): '' (default) = "
+          "off, wrappers never installed, zero cost. '1' enables every "
+          "mode; a comma list picks from 'locks' (instrumented "
+          "Lock/RLock/Condition via utils/threads factories: "
+          "lock-acquisition-order graph with cycle reports, long-hold "
+          "reports, lockset unlocked-write checks on registered shared "
+          "structures) and 'sync' (jax.device_get guard attributing "
+          "un-sanctioned device->host fetches inside phase spans). Set "
+          "at process start — locks constructed before enabling stay "
+          "untracked. Findings surface in /statusz, forensics bundles, "
+          "`observe doctor`, and `python -m bigdl_tpu.analysis threads`")
+_register("SANITIZE_HOLD_MS", 250.0, float,
+          "Long-hold threshold for the locks sanitizer: releasing a "
+          "lock held longer than this many milliseconds files a "
+          "long-hold report (a sleeping/IO-bound lock holder "
+          "serializes every other participant)")
 _register("BENCH_LOCK_FILE", "/tmp/bigdl_tpu_bench.lock", str,
           "Lockfile serializing bench.py against tools/tpu_watch.sh so "
           "the harness cannot pollute the CPU trend series (ADVICE r5 #5)")

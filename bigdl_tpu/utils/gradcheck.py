@@ -75,8 +75,10 @@ def check_module_gradients(module, x, *, params=None, state=None,
     leaf, with sum-of-squares as the scalar objective (smooth, exercises
     the whole output)."""
     if params is None or state is None:
+        # the sampling `seed` doubles as the init seed when no rng is
+        # threaded — deterministic, but caller-controllable (TPU-LINT004)
         params, state = module.init(rng if rng is not None
-                                    else jax.random.PRNGKey(0))
+                                    else jax.random.PRNGKey(seed))
 
     def obj_input(a):
         out, _ = module.apply(params, state, a)

@@ -17,11 +17,12 @@ the TPU tunnel as a side effect):
 from __future__ import annotations
 
 import os
-import threading
 import time
 
+from bigdl_tpu.utils.threads import make_lock
+
 _run_id = None
-_lock = threading.Lock()
+_lock = make_lock("utils.runtime")
 
 
 def process_index() -> int:

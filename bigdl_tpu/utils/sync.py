@@ -29,7 +29,9 @@ def force_completion(tree) -> None:
     computed, by fetching one element of each to the host."""
     leaves = _array_leaves(tree)
     if leaves:
-        jax.device_get([_first_elem(l) for l in leaves])
+        from bigdl_tpu.analysis.sancov import sanctioned_sync
+        with sanctioned_sync("timing-protocol completion fetch"):
+            jax.device_get([_first_elem(l) for l in leaves])
 
 
 def time_steps(step, carry, warmup: int, iters: int):

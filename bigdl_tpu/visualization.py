@@ -255,8 +255,8 @@ class EventWriter:
         self.flush_secs = flush_secs
         self._fh = open(self.path, "ab")
         self._fh.write(frame_record(encode_file_version_event()))
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
+        from bigdl_tpu.utils.threads import spawn
+        self._thread = spawn(self._run, name="tb-event-writer")
 
     def add_scalar(self, tag: str, value: float, step: int):
         self._q.put(encode_scalar_event(tag, float(value), int(step)))

@@ -100,6 +100,61 @@ LINT_CASES = {
         "def make(train_step):\n"
         "    return jax.jit(train_step, donate_argnums=(0, 1))\n",
     ),
+    "TPU-LINT101": (
+        "import threading\n"
+        "def go(fn):\n"
+        "    threading.Thread(target=fn, daemon=True).start()\n",
+        "from bigdl_tpu.utils.threads import spawn\n"
+        "def go(fn):\n"
+        "    spawn(fn, name='worker')\n",
+    ),
+    "TPU-LINT102": (
+        "import threading, time\n"
+        "_lock = threading.Lock()\n"
+        "def poll():\n"
+        "    with _lock:\n"
+        "        time.sleep(0.5)\n",
+        "import threading, time\n"
+        "_lock = threading.Lock()\n"
+        "def poll():\n"
+        "    with _lock:\n"
+        "        pass\n"
+        "    time.sleep(0.5)\n",
+    ),
+    "TPU-LINT103": (
+        "import threading\n"
+        "def go(fn):\n"
+        "    threading.Thread(target=fn).start()\n",
+        "import threading\n"
+        "def go(fn):\n"
+        "    threading.Thread(target=fn, daemon=True).start()\n",
+    ),
+    "TPU-LINT104": (
+        "import threading, os\n"
+        "_lock = threading.Lock()\n"
+        "def publish(tmp, dst):\n"
+        "    with _lock:\n"
+        "        os.replace(tmp, dst)\n",
+        "import threading, os\n"
+        "_lock = threading.Lock()\n"
+        "def publish(tmp, dst):\n"
+        "    os.replace(tmp, dst)\n"
+        "    with _lock:\n"
+        "        pass\n",
+    ),
+    "TPU-LINT105": (
+        "import threading\n"
+        "_lock = threading.Lock()\n"
+        "_cache = {}\n"
+        "def put(k, v):\n"
+        "    _cache[k] = v\n",
+        "import threading\n"
+        "_lock = threading.Lock()\n"
+        "_cache = {}\n"
+        "def put(k, v):\n"
+        "    with _lock:\n"
+        "        _cache[k] = v\n",
+    ),
 }
 
 
@@ -145,6 +200,36 @@ def test_lint_prngkey_exempt_in_tests():
     assert lint.lint_source(src, "tests/test_foo.py") == []
     assert "TPU-LINT004" in rules_of(lint.lint_source(
         src, "bigdl_tpu/optim/foo.py"))
+
+
+def test_lint_thread_rule_scoping():
+    """101 is framework-scoped: the sanctioned wrapper itself and code
+    outside bigdl_tpu/ may construct raw Threads (103's daemon check
+    still applies everywhere)."""
+    src = ("import threading\n"
+           "def go(fn):\n"
+           "    threading.Thread(target=fn).start()\n")
+    assert "TPU-LINT101" not in rules_of(lint.lint_source(
+        src, "bigdl_tpu/utils/threads.py"))
+    outside = rules_of(lint.lint_source(src, "tools/some_tool.py"))
+    assert "TPU-LINT101" not in outside and "TPU-LINT103" in outside
+
+
+def test_lint_global_mutation_needs_module_lock():
+    """105 only fires in modules that DECLARE locked concurrency — a
+    lock-free module's globals are not its business."""
+    src = ("_cache = {}\n"
+           "def put(k, v):\n"
+           "    _cache[k] = v\n")
+    assert lint.lint_source(src, HOT_PATH) == []
+
+
+def test_lint_baseline_is_burned_to_zero():
+    """ISSUE 11 acceptance: the ratchet baseline carries NO debt — new
+    violations fail immediately, everywhere."""
+    baseline = lint.load_baseline(
+        os.path.join(ROOT, "tools", "tpu_lint_baseline.json"))
+    assert baseline == {}, baseline
 
 
 def test_lint_float64_scoped_to_hot_dirs():
