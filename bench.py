@@ -822,7 +822,9 @@ def _bench_overhead(batch_size=32, window=128, iters=1280, k=8):
     mesh = create_mesh(drop_trivial_axes=True)
     _KNOBS = ("BIGDL_TPU_TRACE", "BIGDL_TPU_METRICS_JSONL",
               "BIGDL_TPU_METRICS_PROM", "BIGDL_TPU_METRICS_FLUSH_S",
-              "BIGDL_TPU_STATUSZ_PORT", "BIGDL_TPU_WATCHDOG_PCT")
+              "BIGDL_TPU_STATUSZ_PORT", "BIGDL_TPU_WATCHDOG_PCT",
+              "BIGDL_TPU_FLEET_PEERS", "BIGDL_TPU_FLEET_POLL_S",
+              "BIGDL_TPU_SERVE_WATCHDOG_PCT")
     scrape_counts = []
 
     def run_once(instrumented):
@@ -832,6 +834,7 @@ def _bench_overhead(batch_size=32, window=128, iters=1280, k=8):
         for kk in _KNOBS:
             os.environ.pop(kk, None)
         port = None
+        peer_srv = None
         if instrumented:
             os.environ["BIGDL_TPU_TRACE"] = os.path.join(tmp, "trace")
             os.environ["BIGDL_TPU_METRICS_JSONL"] = \
@@ -845,17 +848,31 @@ def _bench_overhead(batch_size=32, window=128, iters=1280, k=8):
             s.close()
             os.environ["BIGDL_TPU_STATUSZ_PORT"] = str(port)
             os.environ["BIGDL_TPU_WATCHDOG_PCT"] = "50"
+            # FULL fleet plane (ISSUE 12): a second in-process statusz
+            # peer + the aggregator polling both every flush + the
+            # serve-SLO watchdog's background poller live
+            from bigdl_tpu.observe.statusz import StatuszServer
+            peer_srv = StatuszServer(0)
+            os.environ["BIGDL_TPU_FLEET_PEERS"] = \
+                f"127.0.0.1:{port},127.0.0.1:{peer_srv.port}"
+            os.environ["BIGDL_TPU_FLEET_POLL_S"] = "1.0"
+            os.environ["BIGDL_TPU_SERVE_WATCHDOG_PCT"] = "50"
+            obs_doctor.arm_serve_watchdog()
         else:
             os.environ["BIGDL_TPU_WATCHDOG_PCT"] = "0"
         obs_doctor.reset_watchdog()       # re-read the knob per mode
         stop_scraper = threading.Event()
 
         def scraper():
-            # a live Prometheus scraper + an operator polling /statusz,
-            # hammering the plane while the loop is at full rate
+            # a live Prometheus scraper + an operator polling /statusz
+            # AND the merged /fleetz: same ~10 req/s total as the r14
+            # methodology, round-robined so every endpoint (fleet view
+            # included) is exercised under load
             count = 0
+            eps = ("/statusz", "/metrics", "/fleetz")
+            i = 0
             while not stop_scraper.wait(0.2):
-                for ep in ("/statusz", "/metrics"):
+                for ep in (eps[i % 3], eps[(i + 1) % 3]):
                     try:
                         with urllib.request.urlopen(
                                 f"http://127.0.0.1:{port}{ep}",
@@ -864,6 +881,7 @@ def _bench_overhead(batch_size=32, window=128, iters=1280, k=8):
                         count += 1
                     except Exception:      # noqa: BLE001 — server not up yet
                         pass
+                i += 1
             scrape_counts.append(count)
 
         scraper_thread = None
@@ -890,8 +908,11 @@ def _bench_overhead(batch_size=32, window=128, iters=1280, k=8):
             if scraper_thread is not None:
                 scraper_thread.join(timeout=10)
             # tear the global recorder down so the next (off) pass runs
-            # genuinely uninstrumented
+            # genuinely uninstrumented (shutdown also joins the fleet
+            # poller + serve-SLO watchdog)
             observe.shutdown()
+            if peer_srv is not None:
+                peer_srv.close()
             shutil.rmtree(tmp, ignore_errors=True)
             for kk, v in saved.items():
                 if v is None:
@@ -1486,13 +1507,16 @@ def child_main():
             "note": "throughput lost with the FULL telemetry plane on "
                     "vs fully off: span tracing + JSONL + Prometheus "
                     "exporters + statusz HTTP server scraped ~5x/s "
-                    "(/statusz + /metrics) under load + step-time "
-                    "watchdog armed; same small-model "
-                    "DistriOptimizer.optimize() K=8 loop as the "
-                    "dispatch bench, best post-compile window per "
-                    "mode, modes alternated. Scrapes read host-side "
-                    "registry state only (no added host syncs — "
-                    "tests/test_statusz.py). Acceptance bar: <= 2%",
+                    "(/statusz + /metrics + merged /fleetz) under load "
+                    "+ step-time watchdog armed + FLEET aggregator "
+                    "polling a second in-process statusz peer every "
+                    "1s + the serve-SLO watchdog poller live; same "
+                    "small-model DistriOptimizer.optimize() K=8 loop "
+                    "as the dispatch bench, best post-compile window "
+                    "per mode, modes alternated. Scrapes read "
+                    "host-side registry state only (no added host "
+                    "syncs — tests/test_statusz.py). Acceptance "
+                    "bar: <= 2%",
         }))
         return
     if which == "checkpoint":

@@ -18,9 +18,18 @@ Unified observability for the training stack (reference analogues:
                   /metrics (live Prometheus), /statusz, /tracez,
                   /profilez endpoints (BIGDL_TPU_STATUSZ_PORT);
   * **doctor**  — step-time anomaly watchdog riding the flush cadence
-                  (BIGDL_TPU_WATCHDOG_PCT), crash forensics bundles
-                  (BIGDL_TPU_FORENSICS), and the
-                  `python -m bigdl_tpu.observe doctor` post-mortem CLI.
+                  (BIGDL_TPU_WATCHDOG_PCT), the serve-SLO watchdog
+                  (per-model p99, BIGDL_TPU_SERVE_WATCHDOG_PCT), crash
+                  forensics bundles (BIGDL_TPU_FORENSICS, with
+                  capture-on-crash when an incident is live), and the
+                  `python -m bigdl_tpu.observe doctor` post-mortem CLI;
+  * **fleet**   — cross-process aggregation: process 0 polls every
+                  peer's plane and serves merged /fleetz +
+                  peer-labeled /fleetz/metrics (BIGDL_TPU_FLEET /
+                  BIGDL_TPU_FLEET_PEERS);
+  * **alerts**  — incident fan-out to BIGDL_TPU_ALERT_CMD /
+                  BIGDL_TPU_ALERT_WEBHOOK with bounded retry, off the
+                  flush path.
 
 Enable via knobs (utils/config.py): BIGDL_TPU_TRACE=<dir> records and
 dumps a trace per optimize(); BIGDL_TPU_METRICS_JSONL / _PROM / _TB
@@ -179,6 +188,11 @@ def ensure_started() -> bool:
         # knob-gated (BIGDL_TPU_STATUSZ_PORT, 0 = off, process 0 only)
         from bigdl_tpu.observe import statusz as _statusz
         sz = _statusz.start()
+        # fleet brain (observe/fleet.py): process 0 aggregates every
+        # peer's plane into /fleetz when BIGDL_TPU_FLEET /
+        # BIGDL_TPU_FLEET_PEERS arm it — no-op otherwise
+        from bigdl_tpu.observe import fleet as _fleet
+        _fleet.ensure_started()
         _started = True
         # thread-shutdown audit (docs/concurrency.md): a process that
         # merely turned the plane on must exit cleanly — join the export
@@ -214,10 +228,15 @@ def finish() -> Optional[str]:
 
 
 def shutdown() -> None:
-    """Tear down exporters + statusz server + disable tracing (tests /
-    process exit)."""
+    """Tear down fleet poller + serve-SLO watchdog + exporters +
+    statusz server + disable tracing (tests / process exit). Pollers
+    stop before the HTTP server they scrape through."""
     global _exports, _started
     with _lock:
+        from bigdl_tpu.observe import fleet as _fleet
+        _fleet.stop()
+        from bigdl_tpu.observe import doctor as _doctor
+        _doctor.stop_serve_watchdog()
         if _exports is not None:
             _exports.close()
             _exports = None

@@ -1,12 +1,18 @@
 """`python -m bigdl_tpu.observe run.jsonl` — phase report (observe/report.py);
 `python -m bigdl_tpu.observe doctor <bundle|run.jsonl>` — post-mortem
-(observe/doctor.py)."""
+(observe/doctor.py); `python -m bigdl_tpu.observe fleet` — fleet
+aggregation smoke (observe/fleet.py; two in-process planes, merged
+/fleetz asserted, rc 1 on a missing peer)."""
 
 import sys
 
 if len(sys.argv) > 1 and sys.argv[1] == "doctor":
     from bigdl_tpu.observe.doctor import doctor_main
     sys.exit(doctor_main(sys.argv[2:]))
+
+if len(sys.argv) > 1 and sys.argv[1] == "fleet":
+    from bigdl_tpu.observe.fleet import smoke_main
+    sys.exit(smoke_main(sys.argv[2:]))
 
 from bigdl_tpu.observe.report import main
 

@@ -1,0 +1,152 @@
+"""Alert fan-out — page BEFORE users notice.
+
+The watchdogs (step-time and serve-SLO, observe/doctor.py) open
+*incidents*; this module delivers each opened incident to the operator's
+sinks without new dependencies:
+
+  * ``BIGDL_TPU_ALERT_CMD``     — a shell command run with the incident
+    JSON on stdin (``cat >> pages.jsonl``, a Slack-webhook curl, a
+    pager bridge script);
+  * ``BIGDL_TPU_ALERT_WEBHOOK`` — a URL that receives the incident JSON
+    as an HTTP POST (``application/json``).
+
+Delivery contract (the part that matters on a paging path):
+
+  * **never blocks the flush path** — :func:`fanout` spawns one
+    sanctioned background sender thread per incident and returns
+    immediately; the train loop and the serve scheduler never wait on a
+    pager;
+  * **bounded retry** — each sink gets ``1 + BIGDL_TPU_ALERT_RETRIES``
+    attempts with the shared exponential-backoff curve
+    (``resilience/retry.py backoff_delay``, ``BIGDL_TPU_ALERT_BACKOFF_S``
+    initial, 16x cap); exhaustion increments ``alerts/failed`` and logs
+    — an unreachable pager must never raise into telemetry;
+  * **one fire per incident** — the watchdogs call :func:`fanout`
+    exactly once per opened incident (sustained bad windows ride the
+    anomaly counter, not the pager), asserted by tests/test_fleet.py.
+
+``alerts/fired`` / ``alerts/failed`` / ``alerts/retries`` counters make
+the fan-out itself observable. :func:`notify` is the same path for
+non-incident events (the SIGTERM preemption notice in
+resilience/faults.py uses it) — an event dict instead of an incident.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import subprocess
+import time
+from typing import Optional
+
+from bigdl_tpu.utils.threads import spawn
+
+log = logging.getLogger("bigdl_tpu")
+
+_CMD_TIMEOUT_S = 10.0
+_HTTP_TIMEOUT_S = 5.0
+
+
+def targets() -> tuple:
+    """(cmd, webhook) from the knobs — ('', '') means fan-out is off."""
+    from bigdl_tpu.utils import config
+    return (config.get("ALERT_CMD").strip(),
+            config.get("ALERT_WEBHOOK").strip())
+
+
+def enabled() -> bool:
+    cmd, hook = targets()
+    return bool(cmd or hook)
+
+
+def _payload(event: dict) -> str:
+    from bigdl_tpu.utils.runtime import process_index, run_id
+    doc = {
+        "source": "bigdl_tpu",
+        "run_id": run_id(),
+        "process_index": process_index(),
+        "host": socket.gethostname(),
+        "ts": time.time(),
+        **event,
+    }
+    return json.dumps(doc, default=str)
+
+
+def _send_cmd(cmd: str, payload: str) -> None:
+    r = subprocess.run(cmd, shell=True, input=payload.encode(),
+                       capture_output=True, timeout=_CMD_TIMEOUT_S)
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"alert command exited {r.returncode}: "
+            f"{(r.stderr or r.stdout or b'')[-200:].decode(errors='replace')}")
+
+
+def _send_webhook(url: str, payload: str) -> None:
+    import urllib.request
+    req = urllib.request.Request(
+        url, data=payload.encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=_HTTP_TIMEOUT_S) as resp:
+        resp.read()
+
+
+def deliver(event: dict, *, cmd: Optional[str] = None,
+            hook: Optional[str] = None) -> bool:
+    """Synchronous delivery with bounded retry (the sender thread's
+    body; tests call it directly). Returns True when every configured
+    sink accepted the event."""
+    from bigdl_tpu.observe.metrics import counter
+    from bigdl_tpu.resilience.retry import backoff_delay
+    from bigdl_tpu.utils import config
+    if cmd is None and hook is None:
+        cmd, hook = targets()
+    retries = max(0, config.get("ALERT_RETRIES"))
+    backoff = config.get("ALERT_BACKOFF_S")
+    payload = _payload(event)
+    ok = True
+    for kind, target, send in (("cmd", cmd, _send_cmd),
+                               ("webhook", hook, _send_webhook)):
+        if not target:
+            continue
+        delivered = False
+        for attempt in range(1 + retries):
+            try:
+                send(target, payload)
+                delivered = True
+                break
+            except Exception as e:       # noqa: BLE001 — pager path
+                log.warning("alert %s delivery attempt %d/%d failed: %s",
+                            kind, attempt + 1, 1 + retries, e)
+                if attempt < retries:
+                    counter("alerts/retries").inc()
+                    time.sleep(backoff_delay(backoff, attempt))
+        if delivered:
+            counter("alerts/fired").inc()
+        else:
+            ok = False
+            counter("alerts/failed").inc()
+            log.error("ALERT DELIVERY FAILED (%s): incident %s never "
+                      "reached the sink after %d attempts", kind,
+                      event.get("kind", event.get("signal", "?")),
+                      1 + retries)
+    return ok
+
+
+def fanout(incident: dict) -> Optional[object]:
+    """Fire-and-forget delivery of one opened incident: spawn the
+    sender thread when any sink is configured (returns it, mostly for
+    tests to join), else no-op. Safe to call under a watchdog lock —
+    nothing here blocks."""
+    cmd, hook = targets()
+    if not cmd and not hook:
+        return None
+    event = {"kind": incident.get("kind", "incident"), **incident}
+    return spawn(deliver, name="alert-fanout",
+                 args=(event,), kwargs={"cmd": cmd, "hook": hook})
+
+
+def notify(event: dict) -> Optional[object]:
+    """Fan out a non-incident operational event (preemption notice,
+    fleet peer loss) through the same sinks and retry contract."""
+    return fanout(event)

@@ -1,20 +1,38 @@
-"""Step-time anomaly watchdog + crash forensics + `observe doctor`.
+"""Anomaly watchdogs (step-time + serve-SLO) + crash forensics +
+`observe doctor`.
 
 The flight recorder (PR 4) answers "where did the step go" AFTER the
 run; this module answers it DURING and right after a failure:
 
-  * **Watchdog** — a rolling median/MAD baseline over the per-flush mean
-    step time (`train/step_wall_s` is the honest denominator; here the
-    trainer hands us the same window wall + step count it already
-    computed for the throughput log line). A sustained regression past
-    BIGDL_TPU_WATCHDOG_PCT opens an *incident*: one loud log, a
-    `watchdog/incidents` counter, and an `alerts` entry the /statusz
-    endpoint serves live. The slowdown is ATTRIBUTED to a phase
-    (data-wait vs dispatch vs flush vs checkpoint) by comparing each
-    phase's per-step time this window against its own rolling baseline —
-    the MLPerf-style "which part of the step regressed" answer, computed
-    entirely from host-side registry state on the existing flush cadence
-    (no added device syncs; asserted by tests/test_observe.py).
+  * **Watchdog** — a rolling median/MAD baseline over a scalar health
+    signal. The core (`observe_signal`) is signal-agnostic: feed it a
+    value plus a dict of attribution components each poll and a
+    sustained regression past the pct threshold opens an *incident*:
+    one loud log, an incidents counter, an `alerts` entry the /statusz
+    endpoint serves live, and one alert fan-out (observe/alerts.py).
+    The regression is ATTRIBUTED to the component that grew the most
+    over its own rolling baseline, and anomalous windows stay OUT of
+    the baseline so a slowdown can never normalize itself.
+
+    The step-time instance rides `_flush_metrics` (the trainer hands
+    `observe()` the same window wall + step count it already computed
+    for the throughput log line; `train/step_wall_s` is the honest
+    denominator) and attributes to the step-loop phases (data-wait vs
+    dispatch vs flush vs checkpoint) — the MLPerf-style "which part of
+    the step regressed" answer, computed entirely from host-side
+    registry state on the existing flush cadence (no added device
+    syncs; asserted by tests/test_observe.py).
+
+  * **ServeWatchdog** — the same machinery pointed at per-model serve
+    p99 from the serving subsystem's latency histograms
+    (`ServeEngine.stats()` quotes the same numbers): each poll window's
+    p99 is computed from the DELTA of the cumulative log-bucket counts
+    (metrics.histogram_window), and a sustained regression opens ONE
+    incident attributed to queue-wait vs dispatch vs batch-fill deltas
+    (the per-model `serve/<model>/queue_wait_ms` / `dispatch_ms`
+    histograms the batcher records). Armed by the first ServeEngine
+    (BIGDL_TPU_SERVE_WATCHDOG_PCT, 0 = off) on a sanctioned
+    PeriodicWorker riding the fleet/export poll cadence.
 
   * **Forensics** — on NonFiniteLossError, retry exhaustion, or any
     unhandled optimize() exception, `dump_forensics` writes a
@@ -60,29 +78,56 @@ def _median(xs: List[float]) -> float:
     return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
 
 
+# incident history ring: older incidents fall off into the dropped
+# counter, never silently (ISSUE 12 satellite)
+_KEEP_INCIDENTS = 16
+
+
 class Watchdog:
-    """Rolling-baseline step-time regression detector. One process-wide
-    instance rides `_flush_metrics` (optim/local.py); tests build
-    private ones. All inputs are host-side floats the trainer already
-    had — observing costs a registry snapshot and some arithmetic."""
+    """Rolling-baseline regression detector over ONE scalar signal.
+
+    The process-wide step-time instance rides `_flush_metrics`
+    (optim/local.py) through :meth:`observe`; the serve-SLO watchdog
+    builds one per model and feeds :meth:`observe_signal` directly.
+    All inputs are host-side floats the caller already had — observing
+    costs a registry snapshot and some arithmetic."""
 
     def __init__(self, pct: Optional[float] = None,
                  window: Optional[int] = None,
-                 sustain: Optional[int] = None):
+                 sustain: Optional[int] = None, *,
+                 prefix: str = "watchdog",
+                 signal: str = "step_s",
+                 gauge_names: tuple = ("step_s", "baseline_s"),
+                 default_blame: str = "train/dispatch",
+                 extra: Optional[dict] = None):
         from bigdl_tpu.utils import config
         self.pct = config.get("WATCHDOG_PCT") if pct is None else pct
         self.window = (config.get("WATCHDOG_WINDOW") if window is None
                        else window)
         self.sustain = max(1, config.get("WATCHDOG_SUSTAIN")
                            if sustain is None else sustain)
+        self.prefix = prefix
+        self.signal = signal
+        self.default_blame = default_blame
+        self._extra = dict(extra or {})
+        # metric names are composed once here (not literal f-strings at
+        # the call sites) — every emitted name is listed in
+        # docs/observability.md's watchdog table
+        self._g_value = f"{prefix}/{gauge_names[0]}"
+        self._g_base = f"{prefix}/{gauge_names[1]}"
+        self._g_active = f"{prefix}/alert_active"
+        self._c_anomalies = f"{prefix}/anomalies"
+        self._c_incidents = f"{prefix}/incidents"
+        self._c_dropped = f"{prefix}/incidents_dropped"
         self._lock = make_lock("doctor.watchdog")
-        self._steps: deque = deque(maxlen=self.window)
+        self._values: deque = deque(maxlen=self.window)
         self._phase_prev: Dict[str, float] = {}
-        self._phase_base: Dict[str, deque] = {
-            ph: deque(maxlen=self.window) for ph in WATCHED_PHASES}
+        self._comp_base: Dict[str, deque] = {}
         self._bad_run = 0
         self._active: Optional[dict] = None
         self._incidents: List[dict] = []
+        self._total = 0
+        self._dropped = 0
 
     @property
     def enabled(self) -> bool:
@@ -91,7 +136,9 @@ class Watchdog:
     # ------------------------------------------------------------ observe
     def observe(self, neval: int, window_s: float, steps: int,
                 snapshot: Optional[dict] = None) -> Optional[dict]:
-        """Feed one flush window (wall seconds + steps flushed). Returns
+        """Step-time entry point: feed one flush window (wall seconds +
+        steps flushed). Computes the per-phase attribution components
+        from the phase histograms, then runs the generic core. Returns
         the incident dict when THIS call opened one, else None."""
         if not self.enabled or steps <= 0 or window_s <= 0:
             return None
@@ -110,98 +157,143 @@ class Watchdog:
                 prev = self._phase_prev.get(ph, total)
                 deltas[ph] = max(0.0, total - prev) / steps
                 self._phase_prev[ph] = total
-            warm = len(self._steps) >= max(4, self.window // 4)
-            opened = None
-            if warm:
-                base = _median(list(self._steps))
-                mad = _median([abs(x - base) for x in self._steps])
-                threshold = base * (1.0 + self.pct / 100.0)
-                is_bad = (step_s > threshold
-                          and step_s > base + 3.0 * mad)
-            else:
-                base, is_bad = 0.0, False
-            from bigdl_tpu.observe.metrics import counter, gauge
-            gauge("watchdog/step_s").set(step_s)
-            if warm:
-                gauge("watchdog/baseline_s").set(base)
-            if is_bad:
-                self._bad_run += 1
-                counter("watchdog/anomalies").inc()
-                if self._bad_run >= self.sustain and self._active is None:
-                    opened = self._open_incident(neval, step_s, base,
-                                                 deltas)
-            else:
-                self._bad_run = 0
-                if self._active is not None:
-                    self._close_incident(neval, step_s)
-                # only healthy windows feed the baseline — a sustained
-                # slowdown must not normalize itself into the median
-                self._steps.append(step_s)
-                for ph in WATCHED_PHASES:
-                    self._phase_base[ph].append(deltas[ph])
-            gauge("watchdog/alert_active").set(
-                1.0 if self._active is not None else 0.0)
-            return opened
+            return self._observe_locked(neval, step_s, deltas)
 
-    def _attribute(self, deltas: Dict[str, float]) -> str:
-        """The phase whose per-step time grew the most over its own
-        baseline — ties and an all-flat window blame the dispatch
-        (device compute backlog surfaces in the flush/dispatch pair)."""
-        best, best_growth = "train/dispatch", 0.0
-        for ph in WATCHED_PHASES:
-            base = _median(list(self._phase_base[ph]))
-            growth = deltas[ph] - base
+    def observe_signal(self, neval: int, value: float,
+                       components: Dict[str, float],
+                       extra: Optional[dict] = None) -> Optional[dict]:
+        """Generic entry point: one poll window's signal value plus its
+        attribution components (each compared against its own rolling
+        baseline). The serve-SLO watchdog feeds per-model p99 here."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            return self._observe_locked(neval, float(value),
+                                        dict(components), extra)
+
+    def _observe_locked(self, neval, value, components, extra=None):
+        warm = len(self._values) >= max(4, self.window // 4)
+        opened = None
+        if warm:
+            base = _median(list(self._values))
+            mad = _median([abs(x - base) for x in self._values])
+            threshold = base * (1.0 + self.pct / 100.0)
+            is_bad = (value > threshold and value > base + 3.0 * mad)
+        else:
+            base, is_bad = 0.0, False
+        from bigdl_tpu.observe.metrics import counter, gauge
+        gauge(self._g_value).set(value)
+        if warm:
+            gauge(self._g_base).set(base)
+        if is_bad:
+            self._bad_run += 1
+            counter(self._c_anomalies).inc()
+            if self._bad_run >= self.sustain and self._active is None:
+                opened = self._open_incident(neval, value, base,
+                                             components, extra)
+        else:
+            self._bad_run = 0
+            if self._active is not None:
+                self._close_incident(neval, value)
+            # only healthy windows feed the baseline — a sustained
+            # slowdown must not normalize itself into the median
+            self._values.append(value)
+            for name, v in components.items():
+                self._comp_base.setdefault(
+                    name, deque(maxlen=self.window)).append(v)
+        gauge(self._g_active).set(
+            1.0 if self._active is not None else 0.0)
+        return opened
+
+    def _attribute(self, components: Dict[str, float]) -> str:
+        """The component that grew the most over its own baseline —
+        ties and an all-flat window blame the default (for step time:
+        the dispatch, where device compute backlog surfaces)."""
+        best, best_growth = self.default_blame, 0.0
+        for name, v in components.items():
+            base = _median(list(self._comp_base.get(name, ())))
+            growth = v - base
             if growth > best_growth:
-                best, best_growth = ph, growth
+                best, best_growth = name, growth
         return best
 
-    def _open_incident(self, neval, step_s, base, deltas) -> dict:
+    def _open_incident(self, neval, value, base, components,
+                       extra=None) -> dict:
         from bigdl_tpu.observe.metrics import counter
         from bigdl_tpu.observe import trace as _trace
-        phase = self._attribute(deltas)
+        phase = self._attribute(components)
         incident = {
             "opened_at": time.time(),
             "neval": int(neval),
-            "step_s": round(step_s, 6),
-            "baseline_s": round(base, 6),
-            "slowdown_x": round(step_s / base, 2) if base else 0.0,
+            "signal": self.signal,
+            "value": round(value, 6),
+            "baseline": round(base, 6),
+            "slowdown_x": round(value / base, 2) if base else 0.0,
             "phase": phase,
-            "phase_step_s": {ph: round(v, 6) for ph, v in deltas.items()},
+            "deltas": {n: round(v, 6) for n, v in components.items()},
             "resolved": False,
         }
+        incident.update(self._extra)
+        if extra:
+            incident.update(extra)
+        if self.signal == "step_s":
+            # legacy field names the step-time consumers grew up on
+            incident["step_s"] = incident["value"]
+            incident["baseline_s"] = incident["baseline"]
+            incident["phase_step_s"] = incident["deltas"]
         self._active = incident
         self._incidents.append(incident)
-        if len(self._incidents) > 16:
-            del self._incidents[:-16]
-        counter("watchdog/incidents").inc()
-        _trace.instant("watchdog/incident", cat="watchdog",
-                       args={"phase": phase,
+        self._total += 1
+        if len(self._incidents) > _KEEP_INCIDENTS:
+            # history truncation is ACCOUNTED, never silent: a flapping
+            # regression cannot hide how often it fired
+            drop = len(self._incidents) - _KEEP_INCIDENTS
+            del self._incidents[:-_KEEP_INCIDENTS]
+            self._dropped += drop
+            counter(self._c_dropped).inc(drop)
+        counter(self._c_incidents).inc()
+        _trace.instant(self.prefix + "/incident", cat="watchdog",
+                       args={"phase": phase, "signal": self.signal,
                              "slowdown_x": incident["slowdown_x"]})
         # ONE loud line per incident (the per-window anomaly rides the
         # counter, not the log)
         log.warning(
-            "WATCHDOG: step time regressed %.1fx (%.1f ms vs %.1f ms "
-            "baseline) at iteration %d — attributed to %s "
-            "(per-step: %s); alert stays up until a healthy window",
-            incident["slowdown_x"], step_s * 1e3, base * 1e3, neval,
-            phase,
-            ", ".join(f"{ph.split('/')[-1]}={v * 1e3:.1f}ms"
-                      for ph, v in deltas.items()))
+            "WATCHDOG[%s]: %s regressed %.1fx (%.4g vs %.4g baseline) "
+            "at %d — attributed to %s (%s); alert stays up until a "
+            "healthy window",
+            self.prefix, self.signal, incident["slowdown_x"], value,
+            base, neval, phase,
+            ", ".join(f"{n.split('/')[-1]}={v:.4g}"
+                      for n, v in components.items()))
+        # alert fan-out: once per incident OPEN, never per bad window,
+        # never blocking (observe/alerts.py spawns the sender)
+        from bigdl_tpu.observe import alerts as _alerts
+        _alerts.fanout(incident)
         return incident
 
-    def _close_incident(self, neval, step_s) -> None:
+    def _close_incident(self, neval, value) -> None:
         self._active["resolved"] = True
         self._active["resolved_at"] = time.time()
-        log.warning("WATCHDOG: step time recovered (%.1f ms) at "
-                    "iteration %d — incident closed", step_s * 1e3, neval)
+        log.warning("WATCHDOG[%s]: %s recovered (%.4g) at %d — "
+                    "incident closed", self.prefix, self.signal, value,
+                    neval)
         self._active = None
 
     # ------------------------------------------------------------- views
     def alerts(self) -> List[dict]:
         """Incident list for /statusz (newest last; active one has
-        resolved=False)."""
+        resolved=False). Truncated to the newest 16 — totals in
+        :meth:`incident_totals`."""
         with self._lock:
             return [dict(i) for i in self._incidents]
+
+    def incident_totals(self) -> dict:
+        """Total-vs-retained incident accounting for /statusz: the
+        history ring keeps 16, `dropped` counts what fell off."""
+        with self._lock:
+            return {"total": self._total,
+                    "retained": len(self._incidents),
+                    "dropped": self._dropped}
 
     def active_alert(self) -> Optional[dict]:
         with self._lock:
@@ -227,6 +319,206 @@ def reset_watchdog() -> None:
     global _watchdog
     with _wd_lock:
         _watchdog = None
+
+
+# ------------------------------------------------------ serve-SLO watchdog
+class ServeWatchdog:
+    """Per-model serve-p99 regression detector: one generalized
+    :class:`Watchdog` per served model over the windowed p99 of
+    `serve/<model>/latency_ms`.
+
+    Each :meth:`observe_snapshot` poll computes the DELTA of every
+    model's cumulative latency histogram since the previous poll
+    (metrics.histogram_window) — the p99 OF THE WINDOW, not of the
+    whole run, so an old healthy epoch cannot mask a live regression.
+    Attribution components, all in window-milliseconds so growth is
+    comparable:
+
+      * ``queue_wait_ms``   — mean submit→dispatch-start wait (the
+        batcher's per-model `serve/<model>/queue_wait_ms` histogram):
+        grows when the queue backs up or the deadline knob coalesces
+        too long;
+      * ``dispatch_ms``     — mean per-batch forward+fetch (the
+        `serve/<model>/dispatch_ms` histogram): grows when the device
+        got slower or batches got bigger;
+      * ``batch_fill_ms``   — the mean latency share attributable to
+        under-filled buckets: ``(1 - mean fill) * window mean latency``
+        (`serve/batch_fill` deltas): grows when traffic fragments into
+        sparse dispatches.
+
+    No-traffic windows are skipped entirely (they neither alert nor
+    feed the baseline). Same no-self-normalization discipline as the
+    step-time watchdog: anomalous windows stay out of the median."""
+
+    def __init__(self, pct: Optional[float] = None,
+                 window: Optional[int] = None,
+                 sustain: Optional[int] = None):
+        from bigdl_tpu.utils import config
+        self.pct = (config.get("SERVE_WATCHDOG_PCT") if pct is None
+                    else pct)
+        self.window = window
+        self.sustain = sustain
+        self._lock = make_lock("doctor.serve_watchdog")
+        self._dogs: Dict[str, Watchdog] = {}
+        self._prev: Dict[str, dict] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self.pct > 0
+
+    def _dog(self, model: str) -> Watchdog:
+        dog = self._dogs.get(model)
+        if dog is None:
+            dog = Watchdog(self.pct, self.window, self.sustain,
+                           prefix=f"watchdog/serve/{model}",
+                           signal="serve_p99_ms",
+                           gauge_names=("p99_ms", "baseline_ms"),
+                           default_blame="queue_wait_ms",
+                           extra={"model": model})
+            self._dogs[model] = dog
+        return dog
+
+    def observe_snapshot(self, snapshot: Optional[dict] = None
+                         ) -> List[dict]:
+        """One poll over a registry snapshot; returns the incidents
+        opened by THIS poll (the PeriodicWorker drives it on the
+        fleet/export cadence; tests call it directly)."""
+        if not self.enabled:
+            return []
+        from bigdl_tpu.observe import metrics as _metrics
+        if snapshot is None:
+            snapshot = _metrics.registry().snapshot()
+        hists = snapshot.get("histograms", {})
+        opened: List[dict] = []
+        for name, h in sorted(hists.items()):
+            if not (name.startswith("serve/")
+                    and name.endswith("/latency_ms")):
+                continue
+            model = name[len("serve/"):-len("/latency_ms")]
+            if not model:            # the combined serve/latency_ms
+                continue
+            qw = hists.get(f"serve/{model}/queue_wait_ms")
+            disp = hists.get(f"serve/{model}/dispatch_ms")
+            fill = hists.get("serve/batch_fill")
+            with self._lock:
+                prev = self._prev.get(model, {})
+                lat_w = _metrics.histogram_window(prev.get("lat"), h)
+                qw_w = _metrics.histogram_window(prev.get("qw"), qw) \
+                    if qw else None
+                disp_w = _metrics.histogram_window(prev.get("disp"),
+                                                   disp) if disp else None
+                fill_w = _metrics.histogram_window(prev.get("fill"),
+                                                   fill) if fill else None
+                self._prev[model] = {"lat": h, "qw": qw, "disp": disp,
+                                     "fill": fill}
+            if not lat_w or lat_w.get("count", 0) <= 0:
+                continue             # no traffic this window: no signal
+            p99 = _metrics.quantile_from_snapshot(lat_w, 0.99)
+            mean_lat = lat_w["sum"] / lat_w["count"]
+
+            def _mean(w):
+                return (w["sum"] / w["count"]
+                        if w and w.get("count") else 0.0)
+
+            mean_fill = _mean(fill_w)
+            comps = {
+                "queue_wait_ms": round(_mean(qw_w), 6),
+                "dispatch_ms": round(_mean(disp_w), 6),
+                "batch_fill_ms": round(
+                    max(0.0, 1.0 - mean_fill) * mean_lat, 6)
+                if fill_w and fill_w.get("count") else 0.0,
+            }
+            inc = self._dog(model).observe_signal(
+                int(h.get("count", 0)), p99, comps,
+                extra={"requests_in_window": int(lat_w["count"]),
+                       "mean_ms": round(mean_lat, 3)})
+            if inc is not None:
+                opened.append(inc)
+        return opened
+
+    # ------------------------------------------------------------- views
+    def alerts(self) -> List[dict]:
+        with self._lock:
+            dogs = dict(self._dogs)
+        out: List[dict] = []
+        for dog in dogs.values():
+            out.extend(dog.alerts())
+        out.sort(key=lambda i: i.get("opened_at", 0.0))
+        return out
+
+    def active_alerts(self) -> List[dict]:
+        with self._lock:
+            dogs = dict(self._dogs)
+        return [a for d in dogs.values()
+                for a in [d.active_alert()] if a]
+
+    def summary(self) -> Optional[dict]:
+        """Compact /statusz view: None until a model has been watched."""
+        with self._lock:
+            dogs = dict(self._dogs)
+        if not dogs:
+            return None
+        models = {}
+        for model, dog in sorted(dogs.items()):
+            totals = dog.incident_totals()
+            active = dog.active_alert()
+            models[model] = {
+                "alert_active": active is not None,
+                "incidents_total": totals["total"],
+                "incidents_dropped": totals["dropped"],
+            }
+            if active:
+                models[model]["phase"] = active.get("phase")
+                models[model]["slowdown_x"] = active.get("slowdown_x")
+        return {"enabled": self.enabled, "models": models,
+                "alerts": self.alerts()}
+
+
+_serve_watchdog: Optional[ServeWatchdog] = None
+_serve_poller = None
+
+
+def serve_watchdog() -> ServeWatchdog:
+    """The process-wide serve-SLO watchdog (knobs read at first use)."""
+    global _serve_watchdog
+    if _serve_watchdog is None:
+        with _wd_lock:
+            if _serve_watchdog is None:
+                _serve_watchdog = ServeWatchdog()
+    return _serve_watchdog
+
+
+def arm_serve_watchdog() -> bool:
+    """Start the serve-SLO poller (idempotent; the first ServeEngine
+    calls this). Returns True when armed — False when
+    BIGDL_TPU_SERVE_WATCHDOG_PCT is 0. The poller is a sanctioned
+    PeriodicWorker on the fleet/export cadence; `observe.shutdown()`
+    joins it."""
+    global _serve_poller
+    from bigdl_tpu.utils import config
+    from bigdl_tpu.utils.threads import PeriodicWorker
+    wd = serve_watchdog()
+    if not wd.enabled:
+        return False
+    with _wd_lock:
+        if _serve_poller is None:
+            interval = (config.get("FLEET_POLL_S")
+                        or config.get("METRICS_FLUSH_S"))
+            _serve_poller = PeriodicWorker(
+                lambda: serve_watchdog().observe_snapshot(),
+                interval, name="serve-slo-watchdog")
+    return True
+
+
+def stop_serve_watchdog() -> None:
+    """Join the poller and drop the singleton (shutdown path + tests;
+    the next arm re-reads the knobs)."""
+    global _serve_poller, _serve_watchdog
+    with _wd_lock:
+        poller, _serve_poller = _serve_poller, None
+        _serve_watchdog = None
+    if poller is not None:
+        poller.stop()
 
 
 # ------------------------------------------------------------- forensics
@@ -318,12 +610,70 @@ def dump_forensics(reason: str, exc: Optional[BaseException] = None,
         _write("statusz.json", _statusz.status_payload())
     except Exception as e:                     # noqa: BLE001 — forensics
         log.warning("forensics: statusz payload failed: %s", e)
+    try:
+        # capture-on-crash: a crash WHILE a watchdog/serve-SLO incident
+        # is live gets a short device-timeline capture into the bundle —
+        # the /profilez the pager-holder would have asked for, taken
+        # automatically while the evidence is still warm
+        _write("profile.json", _maybe_profile_capture(path))
+    except Exception as e:                     # noqa: BLE001 — forensics
+        log.warning("forensics: profile capture failed: %s", e)
     _metrics.counter("forensics/bundles").inc()
     _rotate_bundles(root)
     log.error("FORENSICS: %s — bundle written to %s "
               "(inspect with `python -m bigdl_tpu.observe doctor %s`)",
               reason, path, path)
     return path
+
+
+def incident_active() -> bool:
+    """Any live incident — step-time or serve-SLO — right now? (The
+    capture-on-crash gate: profiling every crash would be noise, but a
+    crash DURING a regression is exactly when the device timeline is
+    worth its cost.)"""
+    wd = _watchdog
+    if wd is not None and wd.active_alert() is not None:
+        return True
+    swd = _serve_watchdog
+    return bool(swd is not None and swd.active_alerts())
+
+
+def _maybe_profile_capture(bundle_path: str) -> dict:
+    """Arm a short `jax.profiler` capture into `<bundle>/profile/` when
+    an incident is live at crash time (BIGDL_TPU_FORENSICS_PROFILE_S,
+    0 = off). Returns the note written to the bundle's profile.json —
+    every failure mode is a note, never an exception (the original
+    crash must keep propagating)."""
+    from bigdl_tpu.utils import config
+    secs = float(config.get("FORENSICS_PROFILE_S"))
+    if secs <= 0:
+        return {"ok": False, "skipped": "BIGDL_TPU_FORENSICS_PROFILE_S=0"}
+    if not incident_active():
+        return {"ok": False, "skipped": "no live incident at crash time"}
+    try:
+        import jax.profiler as _prof
+    except Exception as e:                     # noqa: BLE001 — optional
+        return {"ok": False, "error": f"jax.profiler unavailable: {e}"}
+    out = os.path.join(bundle_path, "profile")
+    secs = min(secs, 5.0)
+    try:
+        _prof.start_trace(out)
+    except Exception as e:                     # noqa: BLE001 — a
+        # /profilez capture may already be in flight; the bundle notes
+        # it instead of fighting over the profiler singleton
+        return {"ok": False, "error": str(e)}
+    try:
+        time.sleep(secs)
+    finally:
+        try:
+            _prof.stop_trace()
+        except Exception as e:                 # noqa: BLE001 — profiler
+            return {"ok": False, "error": str(e), "dir": out}
+    log.warning("forensics: incident was live at crash time — %.1fs "
+                "profiler capture saved to %s", secs, out)
+    from bigdl_tpu.observe.metrics import counter
+    counter("forensics/profile_captures").inc()
+    return {"ok": True, "seconds": secs, "dir": out}
 
 
 def _rotate_bundles(root: str) -> None:
@@ -427,9 +777,43 @@ def doctor_main(argv: Optional[List[str]] = None) -> int:
         description="Post-mortem: phase attribution + top anomalies "
                     "from a forensics bundle or a JSONL run log")
     ap.add_argument("target", help="forensics-<ts>/ bundle dir or a "
-                                   "run.jsonl")
+                                   "run.jsonl (with --fleet: a /fleetz "
+                                   "snapshot or a dir of per-process "
+                                   ".jsonl logs)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="cross-process post-mortem: per-peer table, "
+                         "step skew, merged phases, incident timeline, "
+                         "per-peer anomaly rollup")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
+    if args.fleet:
+        from bigdl_tpu.observe.report import (fleet_report_json,
+                                              load_fleet_sources,
+                                              render_fleet_report)
+        fl = load_fleet_sources(args.target)
+        if args.json:
+            print(json.dumps(fleet_report_json(fl)))
+            return 0
+        print(render_fleet_report(fl))
+        # the doctor's extra: per-peer anomaly rollup from the raw
+        # snapshots (what the single-target path prints, per peer)
+        rows = []
+        for label, snap in sorted((fl.get("snapshots") or {}).items()):
+            c = snap.get("counters", {})
+            anom = {k: c.get(k, 0) for k in (
+                "train/nonfinite_steps", "watchdog/incidents",
+                "checkpoint/failures", "resilience/retries",
+                "serve/shed")}
+            anom = {k: v for k, v in anom.items() if v}
+            if anom:
+                rows.append(f"  {label}: " + ", ".join(
+                    f"{k.split('/')[-1]}={v:.6g}"
+                    for k, v in sorted(anom.items())))
+        if rows:
+            print("\nper-peer anomalies:")
+            for r in rows:
+                print(r)
+        return 0
     d = render_doctor(args.target)
     if args.json:
         print(json.dumps(d))

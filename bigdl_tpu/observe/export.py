@@ -80,29 +80,36 @@ def _prom_name(name: str) -> str:
     return "bigdl_tpu_" + _PROM_BAD.sub("_", name)
 
 
-def render_prometheus(snapshot: dict) -> str:
+def render_prometheus(snapshot: dict,
+                      labels: Optional[Dict[str, str]] = None) -> str:
     """The whole registry snapshot in Prometheus exposition format:
     counters as `counter`, gauges as `gauge`, histograms as
-    `_bucket{le=...}/_sum/_count`. Shared by the textfile exporter and
-    the statusz server's live /metrics endpoint (observe/statusz.py) —
-    one renderer, so a scraper sees identical series either way."""
+    `_bucket{le=...}/_sum/_count`. Shared by the textfile exporter, the
+    statusz server's live /metrics endpoint (observe/statusz.py), and —
+    with `labels` — the fleet aggregator's peer-labeled /fleetz/metrics
+    (observe/fleet.py renders each peer's snapshot through here with
+    `labels={"peer": i, ...}`). One renderer, so a scraper sees
+    identical series from every surface."""
+    lbl = ",".join(f'{k}="{v}"' for k, v in (labels or {}).items())
+    plain = f"{{{lbl}}}" if lbl else ""
     lines: List[str] = []
     for name, v in snapshot.get("counters", {}).items():
         pn = _prom_name(name)
-        lines += [f"# TYPE {pn} counter", f"{pn} {v!r}"]
+        lines += [f"# TYPE {pn} counter", f"{pn}{plain} {v!r}"]
     for name, v in snapshot.get("gauges", {}).items():
         pn = _prom_name(name)
-        lines += [f"# TYPE {pn} gauge", f"{pn} {v!r}"]
+        lines += [f"# TYPE {pn} gauge", f"{pn}{plain} {v!r}"]
     for name, h in snapshot.get("histograms", {}).items():
         pn = _prom_name(name)
         lines.append(f"# TYPE {pn} histogram")
+        extra = f",{lbl}" if lbl else ""
         cum = 0
         for le, c in zip(h["bounds"], h["counts"]):
             cum += c
-            lines.append(f'{pn}_bucket{{le="{le!r}"}} {cum}')
-        lines.append(f'{pn}_bucket{{le="+Inf"}} {h["count"]}')
-        lines.append(f"{pn}_sum {h['sum']!r}")
-        lines.append(f"{pn}_count {h['count']}")
+            lines.append(f'{pn}_bucket{{le="{le!r}"{extra}}} {cum}')
+        lines.append(f'{pn}_bucket{{le="+Inf"{extra}}} {h["count"]}')
+        lines.append(f"{pn}_sum{plain} {h['sum']!r}")
+        lines.append(f"{pn}_count{plain} {h['count']}")
     return "\n".join(lines) + "\n"
 
 

@@ -322,6 +322,53 @@ def quantile_bounds_from_snapshot(h: dict, lo_clamp: float,
     return (h["max"], h["max"])
 
 
+def histogram_window(prev: Optional[dict], cur: Optional[dict]) -> Optional[dict]:
+    """Snapshot-shaped DELTA between two cumulative histogram snapshots
+    of the same instrument — the poll-window view the serve-SLO
+    watchdog quantiles over (observe/doctor.py): a week of healthy
+    cumulative counts cannot dilute the last window's regression.
+    `prev=None` means "first poll" (the whole cumulative history IS the
+    window). The window's max is approximated by the cumulative max —
+    conservative, and irrelevant to bucket-edge quantiles unless the
+    window crosses the overflow bucket."""
+    if cur is None:
+        return None
+    if prev is None or list(prev.get("bounds", ())) != list(cur["bounds"]):
+        return dict(cur)
+    counts = [max(0, c - p) for c, p in zip(cur["counts"],
+                                            prev["counts"])]
+    return {"count": max(0, cur["count"] - prev["count"]),
+            "sum": cur["sum"] - prev["sum"],
+            "counts": counts, "bounds": list(cur["bounds"]),
+            "min": cur.get("min", 0.0), "max": cur.get("max", 0.0)}
+
+
+def merge_histogram_snapshots(hs: List[dict]) -> Optional[dict]:
+    """Sum histogram snapshots with identical bounds (the fleet report
+    merges per-peer `phase/...` histograms into one table —
+    observe/report.py --fleet). Mismatched grids are skipped rather
+    than misaligned; None when nothing merged."""
+    out: Optional[dict] = None
+    for h in hs:
+        if not h:
+            continue
+        if out is None:
+            out = {"count": h["count"], "sum": h["sum"],
+                   "counts": list(h["counts"]),
+                   "bounds": list(h["bounds"]),
+                   "min": h.get("min", 0.0), "max": h.get("max", 0.0)}
+            continue
+        if list(h["bounds"]) != out["bounds"]:
+            continue
+        out["count"] += h["count"]
+        out["sum"] += h["sum"]
+        out["counts"] = [a + b for a, b in zip(out["counts"],
+                                               h["counts"])]
+        out["min"] = min(out["min"], h.get("min", out["min"]))
+        out["max"] = max(out["max"], h.get("max", out["max"]))
+    return out
+
+
 _phase_cache: Dict[str, Histogram] = {}
 
 

@@ -6,17 +6,29 @@ each second of a training run went, per phase (data-wait, placement,
 dispatch, flush, checkpoint...), plus the counters/gauges of the final
 snapshot. Can also schema-check a recorded Chrome/Perfetto trace
 (`--trace trace.json`).
+
+`--fleet` switches to the cross-process view (`observe doctor` grows
+the same flag): the target is either a saved `/fleetz?full=1` snapshot
+(observe/fleet.py) or a DIRECTORY of per-process JSONL run logs (the
+`.p<i>`-suffixed files a multihost run already writes). Rendered:
+per-peer health table, step skew, a phase table MERGED across peers
+(metrics.merge_histogram_snapshots), and the incident timeline.
 """
 
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
+import re
 import sys
-from typing import List, Optional
+import time
+from typing import Dict, List, Optional
 
-from bigdl_tpu.observe.metrics import (data_wait_fraction, phase_table,
-                                       serve_slo)
+from bigdl_tpu.observe.metrics import (data_wait_fraction,
+                                       merge_histogram_snapshots,
+                                       phase_table, serve_slo)
 
 
 def load_jsonl(path: str) -> List[dict]:
@@ -93,22 +105,189 @@ def render_report(recs: List[dict]) -> str:
     return "\n".join(out)
 
 
+# ------------------------------------------------------------ fleet view
+_P_SUFFIX = re.compile(r"\.jsonl(?:\.p(\d+))?$")
+
+
+def load_fleet_sources(target: str) -> dict:
+    """Normalize a --fleet target into
+    `{"peers": [row...], "alerts": [...], "snapshots": {label: snap}}`.
+
+    * directory → every `*.jsonl` / `*.jsonl.p<i>` inside is one peer
+      (the suffixed-per-process run logs multihost runs write — PR 4);
+      rows derive from each log's final record;
+    * JSON file with a "peers" key → a saved /fleetz payload
+      (`curl .../fleetz?full=1 > fleet.json`); rows/alerts verbatim,
+      snapshots from the full form when present.
+    """
+    if os.path.isdir(target):
+        peers, snapshots = [], {}
+        paths = sorted(glob.glob(os.path.join(target, "*.jsonl")) +
+                       glob.glob(os.path.join(target, "*.jsonl.p*")))
+        for p in paths:
+            m = _P_SUFFIX.search(p)
+            if not m:
+                continue
+            recs = load_jsonl(p)
+            if not recs:
+                continue
+            last = recs[-1]
+            idx = int(m.group(1) or last.get("process_index", 0) or 0)
+            label = f"p{idx}"
+            dw = data_wait_fraction(last)
+            g = last.get("gauges", {})
+            peers.append({
+                "index": idx, "addr": os.path.basename(p), "ok": True,
+                "stale": False, "run_id": last.get("run_id"),
+                "step": int(g.get("train/neval", last.get("step", 0))),
+                "epoch": int(g.get("train/epoch", 0)),
+                "loss": g.get("train/loss"),
+                "throughput_rec_s": g.get("train/throughput"),
+                "data_wait": dw["fraction"] if dw else None,
+                "incidents": last.get("counters", {}).get(
+                    "watchdog/incidents", 0),
+            })
+            snapshots[label] = last
+        peers.sort(key=lambda r: r["index"])
+        return {"kind": "jsonl-dir", "peers": peers, "alerts": [],
+                "snapshots": snapshots, "fleet": None}
+    with open(target) as fh:
+        doc = json.load(fh)
+    if "peers" not in doc:
+        raise ValueError(
+            f"{target}: not a /fleetz snapshot (no 'peers' key) — pass "
+            f"a saved `curl .../fleetz?full=1` document or a directory "
+            f"of per-process .jsonl run logs")
+    return {"kind": "fleetz", "peers": doc["peers"],
+            "alerts": doc.get("alerts", []),
+            "snapshots": doc.get("snapshots", {}),
+            "fleet": doc.get("fleet")}
+
+
+def _merged_phase_snapshot(snapshots: Dict[str, dict]) -> dict:
+    """One registry-snapshot-shaped dict whose `phase/...` histograms
+    are the across-peer merge — `phase_table` renders it unchanged."""
+    names = set()
+    for snap in snapshots.values():
+        names.update(n for n in snap.get("histograms", {})
+                     if n.startswith("phase/"))
+    hists = {}
+    for n in sorted(names):
+        merged = merge_histogram_snapshots(
+            [snap.get("histograms", {}).get(n)
+             for snap in snapshots.values()])
+        if merged and merged["count"]:
+            hists[n] = merged
+    return {"histograms": hists}
+
+
+def render_fleet_report(fl: dict) -> str:
+    peers = fl["peers"]
+    out: List[str] = []
+    live = [p for p in peers if p.get("ok")]
+    stale = [p for p in peers if p.get("stale")]
+    steps = [p["step"] for p in live if p.get("step") is not None]
+    skew = (max(steps) - min(steps)) if steps else None
+    out.append(f"fleet · {len(peers)} peer{'s' if len(peers) != 1 else ''} "
+               f"({len(live)} live, {len(stale)} stale)"
+               + (f" · step skew {skew}" if skew is not None else ""))
+    header = (f"{'peer':<5} {'addr':<24} {'step':>8} {'loss':>9} "
+              f"{'rec/s':>10} {'data-wait':>9}  state")
+    out += ["", header, "-" * len(header)]
+    for p in peers:
+        dw = p.get("data_wait")
+        state = ("STALE" if p.get("stale")
+                 else "live" if p.get("ok") else "unreachable")
+        if p.get("consecutive_failures"):
+            state += f" ({p['consecutive_failures']} misses)"
+        step_s = "-" if p.get("step") is None else str(p["step"])
+        loss_s = ("-" if p.get("loss") is None
+                  else format(p["loss"], ".4f"))
+        tput_s = ("-" if p.get("throughput_rec_s") is None
+                  else format(p["throughput_rec_s"], ".1f"))
+        dw_s = "-" if dw is None else format(dw, ".1%")
+        out.append(
+            f"p{str(p.get('index', '?')):<4} "
+            f"{str(p.get('addr', ''))[:24]:<24} "
+            f"{step_s:>8} {loss_s:>9} {tput_s:>10} {dw_s:>9}  {state}")
+    snaps = fl.get("snapshots") or {}
+    if snaps:
+        rows = phase_table(_merged_phase_snapshot(snaps))
+        out.append("")
+        out.append(f"merged phases ({len(snaps)} peers):")
+        out.append(render_phase_table({"histograms": {}}) if not rows
+                   else _render_rows(rows))
+    alerts = fl.get("alerts") or []
+    if alerts:
+        out.append("")
+        out.append("incident timeline:")
+        for a in alerts:
+            ts = a.get("opened_at")
+            when = (time.strftime("%H:%M:%S", time.localtime(ts))
+                    if ts else "?")
+            out.append(
+                f"  {when} p{a.get('peer', a.get('process_index', '?'))} "
+                f"{a.get('signal', 'step_s')}"
+                + (f"[{a['model']}]" if a.get("model") else "")
+                + f" {a.get('slowdown_x')}x -> {a.get('phase')}"
+                + (" (resolved)" if a.get("resolved") else " (ACTIVE)"))
+    elif fl["kind"] == "jsonl-dir":
+        incs = {f"p{p['index']}": p.get("incidents", 0) for p in peers}
+        if any(incs.values()):
+            out.append("")
+            out.append("watchdog incidents per peer: " + ", ".join(
+                f"{k}={v:.0f}" for k, v in incs.items()))
+    return "\n".join(out)
+
+
+def _render_rows(rows: List[dict]) -> str:
+    header = (f"{'phase':<28} {'count':>8} {'total s':>10} "
+              f"{'avg ms':>9} {'p50 ms':>9} {'max ms':>9} {'share':>7}")
+    lines = [header, "-" * len(header)]
+    for r in rows:
+        lines.append(
+            f"{r['phase']:<28} {r['count']:>8} {r['total_s']:>10.3f} "
+            f"{r['avg_ms']:>9.2f} {r['p50_ms']:>9.2f} {r['max_ms']:>9.2f} "
+            f"{r['share']:>6.1%}")
+    return "\n".join(lines)
+
+
+def fleet_report_json(fl: dict) -> dict:
+    snaps = fl.get("snapshots") or {}
+    return {"kind": fl["kind"], "peers": fl["peers"],
+            "fleet": fl.get("fleet"), "alerts": fl.get("alerts"),
+            "merged_phases": phase_table(_merged_phase_snapshot(snaps))
+            if snaps else []}
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="bigdl_tpu.observe",
         description="Flight-recorder report: phase breakdown from a "
                     "JSONL run log (BIGDL_TPU_METRICS_JSONL)")
     ap.add_argument("run_jsonl", nargs="?",
-                    help="run log written by the JSONL exporter")
+                    help="run log written by the JSONL exporter (with "
+                         "--fleet: a /fleetz snapshot JSON or a "
+                         "directory of per-process .jsonl logs)")
     ap.add_argument("--trace", default=None,
                     help="also validate a recorded Chrome/Perfetto trace "
                          "JSON and summarize its spans")
+    ap.add_argument("--fleet", action="store_true",
+                    help="cross-process view: per-peer table, step "
+                         "skew, merged phase table, incident timeline")
     ap.add_argument("--json", action="store_true",
                     help="emit the report as JSON instead of a table")
     args = ap.parse_args(argv)
     if not args.run_jsonl and not args.trace:
         ap.error("need a run.jsonl and/or --trace")
     rc = 0
+    if args.fleet:
+        if not args.run_jsonl:
+            ap.error("--fleet needs a /fleetz snapshot or a JSONL dir")
+        fl = load_fleet_sources(args.run_jsonl)
+        print(json.dumps(fleet_report_json(fl)) if args.json
+              else render_fleet_report(fl))
+        return 0
     if args.run_jsonl:
         recs = load_jsonl(args.run_jsonl)
         if args.json:

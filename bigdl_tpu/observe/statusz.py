@@ -17,6 +17,12 @@ Endpoints (all GET, all JSON unless noted):
                    data-wait fraction, failover live/lost slices, serve
                    per-model p50/p99/shed/queue-depth, checkpoint
                    in-flight, watchdog alerts, fault-injection state.
+  * `/varz`      — the raw registry snapshot as JSON (the fleet
+                   aggregator's machine-readable scrape).
+  * `/fleetz`    — the MERGED fleet view when this process aggregates
+                   peers (observe/fleet.py; `?full=1` embeds raw peer
+                   snapshots); `/fleetz/metrics` is the peer-labeled
+                   Prometheus form.
   * `/tracez?n=N` — the newest N spans from the tracer ring buffer.
   * `/profilez?seconds=S` — arms a `jax.profiler` capture window on
                    demand; the TensorBoard-loadable capture lands under
@@ -155,6 +161,13 @@ def status_payload() -> dict:
             "anomalies": c.get("watchdog/anomalies", 0),
             "incidents": c.get("watchdog/incidents", 0),
             "alerts": wd.alerts(),
+            # incident-history accounting: the alerts list retains the
+            # newest 16 — total/dropped make a flapping regression's
+            # full history visible even after truncation
+            **{f"incidents_{k}": v
+               for k, v in wd.incident_totals().items()},
+            "serve": (_doctor._serve_watchdog.summary()
+                      if _doctor._serve_watchdog is not None else None),
         },
     }
     san = sancov.report_payload()
@@ -272,8 +285,36 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, render_prometheus(
                     _metrics.registry().snapshot()), ctype="text/plain")
             elif url.path in ("/statusz", "/", "/statusz/"):
-                self._send(200, json.dumps(status_payload(),
-                                           default=str))
+                payload = status_payload()
+                if q.get("varz", ["0"])[0] not in ("0", ""):
+                    # one-round-trip form for the fleet poller: the raw
+                    # registry snapshot rides the same response, so a
+                    # peer scrape costs ONE request, not two
+                    from bigdl_tpu.observe import metrics as _metrics
+                    payload["varz"] = _metrics.registry().snapshot()
+                self._send(200, json.dumps(payload, default=str))
+            elif url.path == "/varz":
+                # raw registry snapshot as JSON — the fleet poller's
+                # machine-readable twin of /metrics (observe/fleet.py)
+                from bigdl_tpu.observe import metrics as _metrics
+                self._send(200, json.dumps(
+                    _metrics.registry().snapshot(), default=str))
+            elif url.path in ("/fleetz", "/fleetz/", "/fleetz/metrics"):
+                from bigdl_tpu.observe import fleet as _fleet
+                agg = _fleet.aggregator()
+                if agg is None:
+                    self._send(404, json.dumps({
+                        "error": "fleet aggregation is off — set "
+                                 "BIGDL_TPU_FLEET=1 or "
+                                 "BIGDL_TPU_FLEET_PEERS (process 0 "
+                                 "aggregates)"}))
+                elif url.path.endswith("/metrics"):
+                    self._send(200, agg.fleet_metrics(),
+                               ctype="text/plain")
+                else:
+                    full = q.get("full", ["0"])[0] not in ("0", "")
+                    self._send(200, json.dumps(
+                        agg.fleet_payload(full=full), default=str))
             elif url.path == "/tracez":
                 n = int(q.get("n", ["100"])[0])
                 self._send(200, json.dumps(tracez_payload(n),
@@ -287,7 +328,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(404, json.dumps({"error": "unknown endpoint",
                                             "endpoints": [
                                                 "/healthz", "/metrics",
-                                                "/statusz", "/tracez",
+                                                "/varz", "/statusz",
+                                                "/fleetz",
+                                                "/fleetz/metrics",
+                                                "/tracez",
                                                 "/profilez"]}))
         except BrokenPipeError:
             pass
@@ -344,9 +388,16 @@ def start(port: Optional[int] = None,
             if not port:
                 return None
             from bigdl_tpu.utils.runtime import process_index
-            if process_index() != 0:
-                log.debug("statusz: not process 0 — skipping")
-                return None
+            idx = process_index()
+            if idx != 0:
+                # fleet mode (observe/fleet.py): every process serves a
+                # plane at STATUSZ_PORT + process_index so process 0's
+                # aggregator can reach it; otherwise process 0 only
+                from bigdl_tpu.observe import fleet as _fleet
+                if not _fleet.enabled():
+                    log.debug("statusz: not process 0 — skipping")
+                    return None
+                port = int(port) + idx
         try:
             _server = StatuszServer(int(port), host)
         except OSError as e:

@@ -275,6 +275,15 @@ def _on_sigterm(signum, frame):
     observe.counter("resilience/preemptions").inc()
     observe.instant("preempt/sigterm", cat="resilience")
     _preempt.set()
+    try:
+        # preemption is an operator-visible fleet event: page through
+        # the same fan-out the watchdog incidents use (no-op when no
+        # ALERT_CMD/ALERT_WEBHOOK sink is configured; the sender runs
+        # on its own thread, never in this signal handler)
+        from bigdl_tpu.observe import alerts as _alerts
+        _alerts.notify({"kind": "preempt", "signal": "SIGTERM"})
+    except Exception:                      # noqa: BLE001 — signal ctx
+        pass
 
 
 def preempt_requested() -> bool:

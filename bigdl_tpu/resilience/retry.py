@@ -19,6 +19,19 @@ from typing import Callable, List, Optional
 log = logging.getLogger("bigdl_tpu")
 
 
+def backoff_delay(backoff_s: float, attempt: int,
+                  cap_mult: float = 16.0) -> float:
+    """The shared exponential-backoff curve: ``backoff_s * 2^attempt``
+    capped at ``backoff_s * cap_mult`` (attempt 0 = first retry). Used
+    by the driver retry loop below and the alert fan-out sender
+    (observe/alerts.py) so every bounded-retry path in the tree backs
+    off the same way. 0/negative backoff means no delay."""
+    if backoff_s <= 0:
+        return 0.0
+    return min(backoff_s * (2 ** max(0, int(attempt))),
+               backoff_s * cap_mult)
+
+
 class RetryPolicy:
     """max_retries failures inside a sliding window_s; sleep
     backoff_s * 2^k between attempts (capped at 16x). None defaults read
@@ -56,8 +69,7 @@ class RetryPolicy:
         """Exponential backoff for the attempt about to start."""
         if not self.backoff_s or not self.failures:
             return 0.0
-        delay = min(self.backoff_s * (2 ** (len(self.failures) - 1)),
-                    self.backoff_s * 16)
+        delay = backoff_delay(self.backoff_s, len(self.failures) - 1)
         time.sleep(delay)
         return delay
 

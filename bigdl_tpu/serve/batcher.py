@@ -117,6 +117,13 @@ class ContinuousBatcher:
                                       LATENCY_MS_BOUNDS)
         self._lat_all = observe.histogram("serve/latency_ms",
                                           LATENCY_MS_BOUNDS)
+        # per-model latency decomposition: submit->dispatch-start wait
+        # and per-batch forward+fetch — the serve-SLO watchdog's
+        # queue-wait vs dispatch attribution inputs (observe/doctor.py)
+        self._qw = observe.histogram(f"serve/{name}/queue_wait_ms",
+                                     LATENCY_MS_BOUNDS)
+        self._disp = observe.histogram(f"serve/{name}/dispatch_ms",
+                                       LATENCY_MS_BOUNDS)
         self._fill = observe.histogram("serve/batch_fill",
                                        BATCH_FILL_BOUNDS)
         self._depth = observe.gauge("serve/queue_depth")
@@ -240,10 +247,14 @@ class ContinuousBatcher:
                 for req in group:
                     xs[i:i + req.n] = req.x
                     i += req.n
+            t_disp0 = self._clock()
+            for req in group:
+                self._qw.record(max(0.0, (t_disp0 - req.t_submit) * 1e3))
             with observe.span("serve/dispatch", cat="serve",
                               args={"model": self.name, "bucket": bucket,
                                     "rows": rows, "requests": len(group)}):
                 out = self._dispatch(xs, rows)
+            self._disp.record(max(0.0, (self._clock() - t_disp0) * 1e3))
         except BaseException as exc:  # noqa: BLE001 — routed to callers
             for req in group:
                 if not req.future.cancelled():

@@ -73,6 +73,13 @@ class ServeEngine:
         # engine vanishes from the payload (observe/statusz.py)
         from bigdl_tpu.observe import statusz as _statusz
         _statusz.register_engine(self)
+        # serve-SLO watchdog (observe/doctor.py): the step-time
+        # watchdog's median/MAD machinery pointed at this engine's
+        # per-model p99 — armed once per process by the first engine
+        # (BIGDL_TPU_SERVE_WATCHDOG_PCT, 0 = off), polled on a
+        # sanctioned background cadence, never on the dispatch path
+        from bigdl_tpu.observe import doctor as _doctor
+        _doctor.arm_serve_watchdog()
         self.registry = ModelRegistry()
         self._batchers: Dict[str, ContinuousBatcher] = {}
         self._lock = make_lock("serve.engine")
@@ -191,10 +198,19 @@ class ServeEngine:
         for name, b in batchers.items():
             lat = reg.histogram(f"serve/{name}/latency_ms",
                                 LATENCY_MS_BOUNDS)
+            qw = reg.histogram(f"serve/{name}/queue_wait_ms",
+                               LATENCY_MS_BOUNDS)
+            disp = reg.histogram(f"serve/{name}/dispatch_ms",
+                                 LATENCY_MS_BOUNDS)
             out[name] = {
                 "requests": lat.count,
                 "p50_ms": round(lat.quantile(0.50), 3),
                 "p99_ms": round(lat.quantile(0.99), 3),
+                # the latency decomposition the serve-SLO watchdog
+                # attributes regressions with (observe/doctor.py)
+                "queue_wait_p99_ms": round(qw.quantile(0.99), 3),
+                "dispatch_mean_ms": round(
+                    disp.sum / disp.count, 3) if disp.count else 0.0,
                 "queued_rows": b.queued_rows,
                 "buckets": list(b.buckets),
             }

@@ -286,6 +286,64 @@ _register("FORENSICS", "1", str,
           "traceback). '1' (default) writes next to the trace dir "
           "(or /tmp/bigdl_tpu_forensics without one), a path overrides "
           "the destination root, '0' disables. Newest 8 bundles kept")
+_register("FLEET", False, _bool,
+          "Fleet telemetry aggregation (observe/fleet.py): process 0 "
+          "polls every peer's /statusz plane and serves the merged "
+          "/fleetz + /fleetz/metrics endpoints; non-zero processes "
+          "serve their own statusz plane at STATUSZ_PORT + "
+          "process_index so the aggregator can reach them. Peer "
+          "addresses derive from the distributed process table "
+          "(utils/runtime.py fleet_peer_candidates) unless "
+          "BIGDL_TPU_FLEET_PEERS names them explicitly (which also "
+          "implies FLEET=1 on the process that carries it)")
+_register("FLEET_PEERS", "", str,
+          "Explicit fleet peer list: comma-separated host:port statusz "
+          "endpoints the aggregator polls (the real-topology override "
+          "of the derived per-process ports). Setting it arms fleet "
+          "aggregation on this process (observe/fleet.py)")
+_register("FLEET_POLL_S", 0.0, float,
+          "Fleet aggregator poll cadence in seconds; 0 (default) rides "
+          "the exporter flush cadence (BIGDL_TPU_METRICS_FLUSH_S) — "
+          "one fleet scrape per export flush")
+_register("FLEET_STALE_POLLS", 3, int,
+          "Consecutive failed polls after which a fleet peer is marked "
+          "STALE in /fleetz (never dropped: its last-known state and "
+          "failure count stay visible; fleet/peer_unreachable counts "
+          "every miss)")
+_register("SERVE_WATCHDOG_PCT", 50.0, float,
+          "Serve-SLO watchdog (observe/doctor.py ServeWatchdog): flag a "
+          "poll window whose per-model serve p99 exceeds the rolling-"
+          "median baseline by this percentage (3xMAD gate on top, same "
+          "machinery as the step-time watchdog). A sustained regression "
+          "opens ONE incident attributed to queue-wait vs dispatch vs "
+          "batch-fill. 0 disables. Armed by the first ServeEngine; "
+          "polls on the FLEET_POLL_S/METRICS_FLUSH_S cadence")
+_register("ALERT_CMD", "", str,
+          "Alert fan-out hook: shell command run once per opened "
+          "incident (watchdog or serve-SLO) with the incident JSON on "
+          "stdin — a pager/Slack bridge without new deps. Runs on a "
+          "background thread with bounded retry "
+          "(ALERT_RETRIES/ALERT_BACKOFF_S); never blocks the flush "
+          "path. '' disables (observe/alerts.py)")
+_register("ALERT_WEBHOOK", "", str,
+          "Alert fan-out hook: URL that receives the incident JSON as "
+          "an HTTP POST (application/json) once per opened incident; "
+          "same bounded-retry, never-blocks contract as ALERT_CMD. "
+          "'' disables")
+_register("ALERT_RETRIES", 2, int,
+          "Bounded re-delivery attempts per alert sink after the first "
+          "failure (exponential backoff from ALERT_BACKOFF_S, the "
+          "resilience/retry.py curve); exhaustion counts "
+          "alerts/failed and is logged, never raised")
+_register("ALERT_BACKOFF_S", 0.5, float,
+          "Initial backoff between alert delivery retries (doubles per "
+          "attempt, 16x cap — resilience/retry.py backoff_delay)")
+_register("FORENSICS_PROFILE_S", 1.0, float,
+          "Capture-on-crash: when a crash lands WHILE a watchdog or "
+          "serve-SLO incident is live, dump_forensics arms a "
+          "/profilez-style jax.profiler capture of this many seconds "
+          "into the bundle's profile/ dir (the device timeline of the "
+          "regression that preceded the crash). 0 disables")
 _register("SANITIZE", "", str,
           "Concurrency sanitizer (analysis/sancov.py): '' (default) = "
           "off, wrappers never installed, zero cost. '1' enables every "

@@ -45,6 +45,38 @@ def process_count() -> int:
         return 1
 
 
+def coordinator_host() -> str:
+    """Host of the distributed coordinator (process 0's machine) from
+    jax's distributed client state, without initializing a backend;
+    loopback when the job is single-process or the state is absent."""
+    try:
+        from jax._src import distributed
+        addr = getattr(distributed.global_state, "coordinator_address",
+                       None)
+        if addr:
+            return str(addr).rsplit(":", 1)[0]
+    except Exception:
+        pass
+    return "127.0.0.1"
+
+
+def fleet_peer_candidates(base_port: int) -> list:
+    """Derived fleet peer addresses — the distributed process table
+    mapped onto the statusz port convention (observe/fleet.py): process
+    i serves its plane at ``base_port + i`` (observe/statusz.py offsets
+    the bind when BIGDL_TPU_FLEET is on), all reached through the
+    coordinator host. One process per host sharing a port layout needs
+    the explicit BIGDL_TPU_FLEET_PEERS list instead; this derivation
+    covers the same-host multi-process shape (dryrun_multichip, the
+    multihost_worker tests, a single TPU VM running several planes)."""
+    n = process_count()
+    base = int(base_port or 0)
+    if n <= 1 or base <= 0:
+        return []
+    host = coordinator_host()
+    return [f"{host}:{base + i}" for i in range(n)]
+
+
 def run_id() -> str:
     """Stable per-process run id (env BIGDL_TPU_RUN_ID wins — set it on
     every host of a multihost job to correlate their logs)."""

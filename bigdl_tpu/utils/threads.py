@@ -35,7 +35,8 @@ import weakref
 from typing import Callable, List, Optional
 
 __all__ = ["spawn", "make_lock", "make_rlock", "make_condition",
-           "thread_inventory", "lock_inventory", "sanitize_modes"]
+           "thread_inventory", "lock_inventory", "sanitize_modes",
+           "PeriodicWorker"]
 
 # raw primitives on purpose: the inventory must never route through the
 # instrumented path it implements
@@ -93,6 +94,59 @@ def spawn(target: Callable, *, name: str, daemon: bool = True,
     if start:
         t.start()
     return t
+
+
+class PeriodicWorker:
+    """A sanctioned periodic background caller: `fn()` every
+    `interval_s` seconds on a named daemon thread until :meth:`stop`.
+
+    This is the shared shape of every telemetry-plane poller (export
+    flush, fleet aggregation, serve-SLO watchdog): an ``Event.wait``
+    cadence (interruptible, never a bare ``sleep``), exceptions logged
+    and swallowed (a poller must not die of one bad poll), and an
+    explicit join on the owner's clean-shutdown path
+    (docs/concurrency.md)."""
+
+    def __init__(self, fn: Callable[[], None], interval_s: float, *,
+                 name: str, start: bool = True):
+        self._fn = fn
+        self.interval_s = max(0.05, float(interval_s))
+        self.name = name
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    def start(self) -> "PeriodicWorker":
+        if self._thread is None:
+            self._thread = spawn(self._run, name=self.name)
+        return self
+
+    def _run(self) -> None:
+        import logging
+        log = logging.getLogger("bigdl_tpu")
+        while not self._stop.wait(self.interval_s):
+            try:
+                self._fn()
+            except Exception as e:       # noqa: BLE001 — poller survives
+                log.warning("%s: periodic poll failed: %s", self.name, e)
+
+    def tick(self) -> None:
+        """Run one poll inline (tests / CLI smokes drive the cadence
+        synchronously instead of waiting on the thread)."""
+        self._fn()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=timeout)
+        self._thread = None
+
+    @property
+    def alive(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
 
 
 def thread_inventory() -> List[dict]:

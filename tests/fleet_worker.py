@@ -1,0 +1,57 @@
+"""Worker process for the 2-process fleet-aggregation test
+(tests/test_fleet.py, the multihost_worker launch pattern).
+
+Each worker stands up its own telemetry plane (statusz HTTP server on
+an assigned port) with a fake-but-live training state; worker 0
+additionally arms the fleet aggregator over BIGDL_TPU_FLEET_PEERS and
+therefore serves the merged /fleetz. The launcher scrapes worker 0's
+/fleetz over HTTP, SIGKILLs worker 1 mid-scrape, and asserts the dead
+peer goes STALE (not dropped) while the aggregator keeps serving.
+
+Protocol: argv = <index> <port> <peers>; prints one READY json line,
+then echoes `ok` per stdin line (each echo refreshes the /healthz
+heartbeat) until stdin closes, then exits 0 through the clean-shutdown
+path (the thread-audit contract of docs/concurrency.md)."""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    idx, port, peers = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ["BIGDL_TPU_RUN_ID"] = "fleettest"
+    os.environ["BIGDL_TPU_STATUSZ_PORT"] = str(port)
+    os.environ["BIGDL_TPU_FLEET_POLL_S"] = "0.2"
+    if idx == 0:
+        os.environ["BIGDL_TPU_FLEET_PEERS"] = peers
+
+    from bigdl_tpu import observe
+    from bigdl_tpu.observe import fleet, statusz
+
+    # a live-looking training state, skewed per worker so the merged
+    # view has something to disagree about
+    observe.gauge("train/neval").set(100 + idx * 5)
+    observe.gauge("train/epoch").set(2)
+    observe.gauge("train/loss").set(0.5 + idx)
+    observe.gauge("train/throughput").set(1000.0 * (idx + 1))
+    observe.gauge("train/last_flush_unix").set(time.time())
+    observe.histogram("phase/train/dispatch").record(0.01 * (idx + 1))
+
+    srv = statusz.start(port=port)
+    agg = fleet.ensure_started() if idx == 0 else None
+    print(json.dumps({"ready": True, "index": idx, "port": srv.port,
+                      "aggregating": agg is not None}), flush=True)
+
+    while True:
+        line = sys.stdin.readline()
+        if not line:
+            break
+        observe.gauge("train/last_flush_unix").set(time.time())
+        print("ok", flush=True)
+    observe.shutdown()
+
+
+if __name__ == "__main__":
+    main()
