@@ -213,6 +213,13 @@ class FleetAggregator:
                     "data_wait": (p.payload.get("data_wait") or {}
                                   ).get("fraction"),
                     "alert_active": wd.get("alert_active"),
+                    # DCN exchange: where the peer sits inside its
+                    # T-window + its per-slice loss spread (statusz
+                    # `exchange` section; None off-mode)
+                    "exchange_pending": (p.payload.get("exchange")
+                                         or {}).get("pending_steps"),
+                    "slice_loss_spread": (p.payload.get("exchange")
+                                          or {}).get("loss_spread"),
                 })
         return rows
 

@@ -175,6 +175,21 @@ def status_payload() -> dict:
         # concurrency sanitizer live (BIGDL_TPU_SANITIZE): findings
         # belong on the same pane as everything else
         payload["sanitizer"] = san
+    if "exchange/window" in g:
+        # DCN-tier exchange (parallel/dcn.py): where this process is
+        # inside its T-window, plus the per-slice loss spread — the
+        # fleet plane mirrors these per peer (observe/fleet.py)
+        payload["exchange"] = {
+            "window": int(g.get("exchange/window", 1)),
+            "pending_steps": int(g.get("exchange/pending_steps", 0)),
+            "count": c.get("exchange/count", 0),
+            "skipped_steps": c.get("exchange/skipped_steps", 0),
+            "wire_bytes": c.get("exchange/wire_bytes", 0),
+            "residual_norm": g.get("exchange/residual_norm"),
+            "loss_spread": g.get("exchange/loss_spread"),
+            "dropped_contributions": c.get(
+                "exchange/dropped_contributions", 0),
+        }
     if "failover/live_slices" in g:
         payload["failover"] = {
             "live_slices": int(g["failover/live_slices"]),

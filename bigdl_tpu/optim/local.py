@@ -498,13 +498,18 @@ class Optimizer:
         Model/criterion/mesh are fixed per instance; the optim method is
         handled by set_optim_method clearing the cache."""
         from bigdl_tpu.kernels import fused_update as _fu
+        dcn = self._dcn_config()
         return (kind, self.steps_per_call, self.accum_steps,
                 str(getattr(self, "compute_dtype", None)),
                 tuple(id(p) for p in self.grad_processors),
                 any(m._frozen for m in self.model.modules()),
                 # env-read at build: a test/process flipping the knob
                 # between optimize() calls must not reuse a stale program
-                _fu.configured_mode())
+                _fu.configured_mode(),
+                # DCN exchange config (parallel/dcn.py): the slice count
+                # changes on failover, so the key re-derives it from the
+                # live mesh and the rebuild compiles for the new S
+                dcn.key if dcn is not None else None)
 
     def _get_built(self, kind: str) -> _StepEntry:
         """Memoized build of the 'step' / 'fused' / 'eval_jit' program.
@@ -517,7 +522,14 @@ class Optimizer:
         if entry is None:
             builder = {"step": self._build_step,
                        "fused": self._build_fused_step,
+                       "dcn_step": getattr(self, "_build_dcn_step", None),
+                       "dcn_fused": getattr(self, "_build_dcn_fused_step",
+                                            None),
                        "eval_jit": self._build_eval_jit}[kind]
+            if builder is None:
+                raise RuntimeError(
+                    f"{kind} program requested on a trainer without the "
+                    f"DCN exchange leg (parallel.DistriOptimizer only)")
             entry = _StepEntry(builder())
             self._built_steps[key] = entry
         return entry
@@ -540,6 +552,72 @@ class Optimizer:
         the local trainer cannot (no mesh); DistriOptimizer can when its
         mesh is two-tier and the driver is single-process."""
         return False
+
+    # ------------------------------------------------- DCN-tier exchange
+    def _dcn_config(self):
+        """Armed accumulate-locally / exchange-every-T configuration
+        (parallel/dcn.py DcnConfig) or None. The local trainer has no
+        slices to exchange across — DistriOptimizer overrides; a set
+        knob on a slice-less trainer warns once and stays off."""
+        from bigdl_tpu.utils import config as _cfg
+        if int(_cfg.get("SLICE_EXCHANGE_EVERY")) > 1 \
+                and not getattr(self, "_warned_dcn_local", False):
+            self._warned_dcn_local = True
+            log.warning(
+                "BIGDL_TPU_SLICE_EXCHANGE_EVERY > 1 needs a two-tier "
+                "('slice', 'data') DistriOptimizer mesh — the local "
+                "trainer exchanges nothing, knob ignored")
+        return None
+
+    def _place_exchange_state(self, state):
+        """Device placement for the DCN exchange state; the distributed
+        trainer lays the per-slice accumulator rows over 'slice'."""
+        return jax.tree.map(jnp.asarray, state)
+
+    def _init_dcn_state(self, cfg):
+        """Host-side exchange state for this run: resumed from the
+        snapshot's `exchange` tree when present and row-compatible
+        (kill-and-resume mid-window is then exact — the accumulator
+        picks the window up at the same pending count), else fresh
+        zeros. A mismatched slice count (snapshot from a different
+        topology) warns loudly and drops the in-window contribution."""
+        import numpy as _np
+        from bigdl_tpu.parallel import dcn as _dcn
+        rt = getattr(self, "_resume_trees", None)
+        if rt is not None and "exchange" in rt:
+            ex = jax.tree.map(lambda a: _np.array(a), rt["exchange"])
+            lead = {leaf.shape[0]
+                    for leaf in jax.tree.leaves(ex.get("acc", {}))}
+            meta_t = self.state.get("exchange_every")
+            if meta_t is not None and int(meta_t) != cfg.every:
+                log.warning(
+                    "resume: snapshot exchange_every=%s but "
+                    "BIGDL_TPU_SLICE_EXCHANGE_EVERY=%d — window "
+                    "boundaries shift; keep T fixed across a "
+                    "kill/resume pair for exactness", meta_t, cfg.every)
+            if lead == {cfg.slices}:
+                has_outer = bool(ex.get("outer")) \
+                    == (cfg.outer == "nesterov")
+                if has_outer:
+                    return ex
+                log.warning(
+                    "resume: snapshot outer-optimizer state does not "
+                    "match BIGDL_TPU_SLICE_OUTER=%r — outer state "
+                    "restarts fresh", cfg.outer)
+                fresh = _dcn.init_exchange_state(
+                    jax.eval_shape(self.model.init,
+                                   jax.random.PRNGKey(0))[0], cfg)  # tpu-lint: disable=004
+                return {**fresh, "acc": ex["acc"],
+                        "residual_norm": ex.get(
+                            "residual_norm", _np.float32(0.0))}
+            log.warning(
+                "resume: snapshot accumulator has %s slice rows but the "
+                "mesh has %d — starting the exchange window fresh (the "
+                "in-window contribution is dropped)",
+                sorted(lead), cfg.slices)
+        params_s, _ = jax.eval_shape(
+            self.model.init, jax.random.PRNGKey(0))  # tpu-lint: disable=004
+        return _dcn.init_exchange_state(params_s, cfg)
 
     def _place_batch(self, x, y):
         with observe.phase("data/placement", cat="data"):
@@ -698,6 +776,15 @@ class Optimizer:
         from bigdl_tpu import compilecache
         from bigdl_tpu.compilecache import (key_sds, log_cost, scalar_sds,
                                             sds_like)
+        if self._dcn_config() is not None:
+            # the DCN step's exchange-state specs are not AOT-pinned —
+            # the program compiles on first dispatch instead (served
+            # warm from the persistent cache like any other program)
+            log.warning("precompile: DCN exchange mode is armed — "
+                        "skipping AOT warmup; the exchange step "
+                        "compiles on first dispatch")
+            self._precompiled = True
+            return {}
         compilecache.ensure_enabled()
         observe.ensure_started()
         use_fused = self.steps_per_call > 1 or self.accum_steps > 1
@@ -941,6 +1028,21 @@ class Optimizer:
                 jax.random.fold_in(rng, 0xBD1))
             slots = self.method.init_slots(params)
         params, model_state, slots = self._place_trees(params, model_state, slots)
+        # DCN-tier exchange (parallel/dcn.py): arm the per-slice
+        # accumulator + outer state when the knobs and mesh call for it;
+        # refreshed after a failover re-shard (_apply_failover)
+        self._dcn_cfg = self._dcn_config()
+        if self._dcn_cfg is not None:
+            from bigdl_tpu.parallel import dcn as _dcn
+            self._dcn_state = self._place_exchange_state(
+                self._init_dcn_state(self._dcn_cfg))
+            self._dcn_wire_bytes = _dcn.wire_bytes_per_exchange(
+                params, self._dcn_cfg.compress)
+            observe.gauge("exchange/window").set(self._dcn_cfg.every)
+            observe.gauge("exchange/pending_steps").set(
+                self.state.get("neval", 0) % self._dcn_cfg.every)
+        else:
+            self._dcn_state = None
         self._step_rng = step_rng
         # steps_per_call == accum_steps == 1 takes the pre-existing
         # per-step dispatch path bit-identically (same step builder, same
@@ -979,8 +1081,11 @@ class Optimizer:
             # a slice failover (resilience/failover.py) invalidates the
             # built-step cache mid-run, and the re-entered pass must
             # pick up the programs compiled for the NEW topology
-            step = None if use_fused else self._get_built("step")
-            fused_step = self._get_built("fused") if use_fused else None
+            dcn = self._dcn_state is not None
+            step = None if use_fused else self._get_built(
+                "dcn_step" if dcn else "step")
+            fused_step = self._get_built(
+                "dcn_fused" if dcn else "fused") if use_fused else None
             self._eval_fn = self._build_eval_fn()
             epoch_start = time.time()
             epoch_records = 0
@@ -1053,9 +1158,19 @@ class Optimizer:
                     # async dispatch latency: the time Python takes to
                     # hand XLA the step, NOT device compute (which the
                     # flush span pays when it fetches the losses)
-                    params, model_state, slots, loss = step(
-                        params, model_state, slots, xd, yd,
-                        jnp.float32(lr), jnp.int32(st["neval"]), sub)
+                    if self._dcn_state is not None:
+                        # accumulator threaded through every call — the
+                        # exchange fires inside the program on window
+                        # boundaries (no extra host syncs)
+                        (params, model_state, slots, self._dcn_state,
+                         loss) = step(
+                            params, model_state, slots, self._dcn_state,
+                            xd, yd, jnp.float32(lr),
+                            jnp.int32(st["neval"]), sub)
+                    else:
+                        params, model_state, slots, loss = step(
+                            params, model_state, slots, xd, yd,
+                            jnp.float32(lr), jnp.int32(st["neval"]), sub)
                 # GLOBAL batch dim (multi-host _place_batch assembles the
                 # global array): records/throughput count the whole job's
                 # progress, the reference's recordsProcessedThisEpoch
@@ -1202,9 +1317,18 @@ class Optimizer:
             with observe.phase("train/dispatch"):
                 # one span covers the whole K-step scan dispatch — divide
                 # by k_valid when comparing against per-step numbers
-                params, model_state, slots, losses = fused_step(
-                    params, model_state, slots, xs, ys, lrs, nevals, rngs,
-                    valid)
+                if self._dcn_state is not None:
+                    # DCN exchange: the accumulator rides the scan carry
+                    # AND the program boundary, so T > K windows span
+                    # calls without extra host syncs (parallel/dcn.py)
+                    (params, model_state, slots, self._dcn_state,
+                     losses) = fused_step(
+                        params, model_state, slots, self._dcn_state,
+                        xs, ys, lrs, nevals, rngs, valid)
+                else:
+                    params, model_state, slots, losses = fused_step(
+                        params, model_state, slots, xs, ys, lrs, nevals,
+                        rngs, valid)
             n = int(xs.shape[1])           # GLOBAL batch rows per step
             start = st["neval"]
             for i in range(k_valid):
@@ -1276,8 +1400,24 @@ class Optimizer:
             # dispatched step's losses land — device compute backlog
             # shows up here, which is exactly what the span shows
             from bigdl_tpu.analysis.sancov import sanctioned_sync
+            items = [p[2] for p in pending]
+            dcn_state = getattr(self, "_dcn_state", None)
+            if dcn_state is not None:
+                # the compression-residual norm rides the same fetch —
+                # DCN telemetry adds no extra host syncs
+                items = items + [dcn_state["residual_norm"]]
             with sanctioned_sync("flush-cadence loss fetch"):
-                losses = jax.device_get([p[2] for p in pending])
+                fetched = jax.device_get(items)
+        import numpy as _np
+        dcn_resid = (float(fetched[-1]) if dcn_state is not None
+                     else None)
+        losses = fetched[:len(pending)]
+        # DCN mode records the PER-SLICE loss vector per step — the
+        # scalar views below use the cross-slice mean, and the last
+        # vector feeds the per-slice loss-spread gauge (/statusz)
+        loss_vecs = [_np.asarray(l) for l in losses]
+        losses = [float(v.mean()) if v.ndim else float(v)
+                  for v in loss_vecs]
         last_iter, last_lr = pending[-1][0], pending[-1][1]
         st["loss"] = float(losses[-1])
         # non-finite step accounting: the fused path already MASKED each
@@ -1285,8 +1425,8 @@ class Optimizer:
         # so a transient NaN batch costs one skipped step; here the bad
         # losses are counted and a consecutive run past the budget
         # aborts loudly instead of training on NaNs. Detection rides the
-        # flush cadence — no extra host syncs.
-        import numpy as _np
+        # flush cadence — no extra host syncs. (A per-slice loss vector
+        # folds in through its mean: any non-finite slice poisons it.)
         bad_run = self._nonfinite_run
         for (it_num, _, _), loss_f in zip(pending, losses):
             if _np.isfinite(loss_f):
@@ -1324,6 +1464,23 @@ class Optimizer:
         # floats only, riding this existing cadence
         from bigdl_tpu.observe import doctor as _doctor
         _doctor.watchdog().observe(last_iter, dt, len(pending))
+        # DCN-exchange telemetry (docs/observability.md `exchange/*`):
+        # boundary counts are host math over the flushed iteration
+        # numbers, the residual norm landed with the loss fetch above
+        cfg = getattr(self, "_dcn_cfg", None)
+        if cfg is not None and dcn_state is not None:
+            T = cfg.every
+            n_ex = sum(1 for (it_num, _, _) in pending if it_num % T == 0)
+            observe.counter("exchange/count").inc(n_ex)
+            observe.counter("exchange/skipped_steps").inc(
+                len(pending) - n_ex)
+            observe.counter("exchange/wire_bytes").inc(
+                n_ex * getattr(self, "_dcn_wire_bytes", 0))
+            observe.gauge("exchange/pending_steps").set(last_iter % T)
+            observe.gauge("exchange/residual_norm").set(dcn_resid)
+            if loss_vecs[-1].ndim:
+                observe.gauge("exchange/loss_spread").set(
+                    float(loss_vecs[-1].max() - loss_vecs[-1].min()))
         log.info("epoch %d iter %d loss %.4f lr %.5f %.1f rec/s",
                  st["epoch"], last_iter, st["loss"], last_lr, rate)
         if self._summary is not None:
@@ -1465,6 +1622,11 @@ class Optimizer:
         meta.update(self._snapshot_extra_meta())
         trees = {"params": params, "model_state": model_state,
                  "slots": slots}
+        if getattr(self, "_dcn_state", None) is not None:
+            # accumulator + outer state ride the snapshot next to the
+            # slots, so a kill-and-resume mid-T-window is exact
+            # (parallel/dcn.py; the clone/persist path is tree-generic)
+            trees["exchange"] = self._dcn_state
         t0 = time.perf_counter()
         from bigdl_tpu.utils import config
         with observe.phase("train/checkpoint"):
@@ -1506,11 +1668,22 @@ class Optimizer:
         epoch + batch cursor + echo counter + the dataset's own state,
         so `resume()` restores the PIPELINE, not just params."""
         from bigdl_tpu.dataset import service as _svc
-        return {"steps_per_call": self.steps_per_call,
+        meta = {"steps_per_call": self.steps_per_call,
                 "accum_steps": self.accum_steps,
                 "data_state": _svc.pipeline_state(
                     self.dataset, self.state.get("batch_in_epoch", 0),
                     getattr(self, "_echo", 1))}
+        cfg = getattr(self, "_dcn_cfg", None)
+        if cfg is not None:
+            # provenance for the exchange tree: resume validates T and
+            # shows where inside the window the snapshot was taken
+            meta.update({
+                "exchange_every": cfg.every,
+                "exchange_pending": self.state.get("neval", 0) % cfg.every,
+                "slice_grad_compress": cfg.compress,
+                "slice_outer": cfg.outer,
+            })
+        return meta
 
     def _finish_checkpoints(self):
         """Join the in-flight background snapshot write (shutdown /

@@ -261,13 +261,305 @@ class DistriOptimizer(Optimizer):
         exchange is labeled — and optionally compressed
         (BIGDL_TPU_SLICE_GRAD_DTYPE) — for DCN-friendly lowering.
         Captured at step-build time, so the failover rebuild rebinds it
-        to the survivor mesh."""
+        to the survivor mesh. The REAL low-frequency lowering of this
+        seam is the DCN exchange leg (_dcn_config / _make_dcn_step),
+        which replaces the per-step seam entirely when armed."""
         from bigdl_tpu.utils import config
         mesh = self.mesh
         name = config.get("SLICE_GRAD_DTYPE")
         dtype = getattr(jnp, name) if name else None
         return lambda grads: cross_slice_exchange(grads, mesh,
                                                   compress_dtype=dtype)
+
+    # ------------------------------------------------- DCN-tier exchange
+    def _dcn_config(self):
+        """Arm the accumulate-locally / exchange-every-T leg
+        (parallel/dcn.py; docs/parallelism.md "DCN-tier exchange") when
+        the knobs and mesh call for it: T > 1, or int8 error-feedback
+        wire compression (which needs the residual accumulator even at
+        T=1). Re-derived per step build, so a failover re-shard picks
+        up the survivor slice count."""
+        from bigdl_tpu.parallel.dcn import DcnConfig, normalize_compress
+        from bigdl_tpu.parallel.mesh import slice_axis_size
+        from bigdl_tpu.utils import config
+        every = max(1, int(config.get("SLICE_EXCHANGE_EVERY")))
+        compress = normalize_compress(config.get("SLICE_GRAD_COMPRESS"))
+        if every <= 1 and compress != "int8":
+            return None
+        if SLICE_AXIS not in self.mesh.axis_names:
+            if not getattr(self, "_warned_dcn_flat", False):
+                self._warned_dcn_flat = True
+                log.warning(
+                    "SLICE_EXCHANGE_EVERY/SLICE_GRAD_COMPRESS need a "
+                    "two-tier mesh (BIGDL_TPU_SLICES > 1) — this mesh "
+                    "has no 'slice' axis, knobs ignored")
+            return None
+        if self.accum_steps > 1 or self.rules.rules:
+            if not getattr(self, "_warned_dcn_combo", False):
+                self._warned_dcn_combo = True
+                log.warning(
+                    "DCN exchange does not compose with accum_steps > 1 "
+                    "or tensor-parallel sharding rules yet — knobs "
+                    "ignored, every-step exchange kept")
+            return None
+        outer = (config.get("SLICE_OUTER") or "").strip().lower()
+        if outer not in ("", "nesterov"):
+            raise ValueError(
+                f"BIGDL_TPU_SLICE_OUTER={outer!r} — expected '' "
+                f"(plain averaging) or 'nesterov'")
+        return DcnConfig(every=every, compress=compress, outer=outer,
+                         slices=slice_axis_size(self.mesh))
+
+    def _place_exchange_state(self, state):
+        """Lay the exchange state out on the mesh: accumulator rows over
+        'slice' (row s lives on slice s's devices), outer state and the
+        residual-norm scalar replicated."""
+        sl = NamedSharding(self.mesh, P(SLICE_AXIS))
+        rep = NamedSharding(self.mesh, P())
+        return {
+            "acc": jax.tree.map(
+                lambda a: jax.device_put(a, sl), state["acc"]),
+            "outer": jax.tree.map(
+                lambda a: jax.device_put(a, rep), state["outer"]),
+            "residual_norm": jax.device_put(
+                jnp.float32(state["residual_norm"]), rep),
+        }
+
+    def _exchange_shardings(self, cfg, params_shape):
+        sl = NamedSharding(self.mesh, P(SLICE_AXIS))
+        rep = NamedSharding(self.mesh, P())
+        outer = ({"m": jax.tree.map(lambda _: rep, params_shape)}
+                 if cfg.outer == "nesterov" else {})
+        return {"acc": jax.tree.map(lambda _: sl, params_shape),
+                "outer": outer, "residual_norm": rep}
+
+    def _make_dcn_step(self, cfg):
+        """Accumulate-locally / exchange-every-T step body
+        (docs/parallelism.md "DCN-tier exchange"). Per step, every slice
+        computes ITS OWN mean gradient — the per-slice batch rows vmap
+        over a leading slice dim, so GSPMD keeps slice s's backward pass
+        and its within-slice ('data') reduction on slice s's devices —
+        and adds it to its accumulator row. On window boundaries
+        ((step+1) % T == 0) the shard_map'd exchange
+        (mesh.cross_slice_accumulated_exchange) psums the accumulators
+        over ('slice',), the outer correction turns the window mean
+        into ONE inner-optimizer update (plain averaging, or DiLoCo
+        Nesterov under SLICE_OUTER), and the compression residual seeds
+        the next window (error feedback). Off-boundary steps touch no
+        cross-slice link and update nothing."""
+        from bigdl_tpu.core.module import cast_floating
+        from bigdl_tpu.parallel.mesh import (
+            cross_slice_accumulated_exchange)
+        compute_dtype = self.compute_dtype
+        model, criterion = self.model, self.criterion
+        processors = list(self.grad_processors)
+        frozen = any(m._frozen for m in model.modules())
+        method_update = self._resolve_update_fn()
+        mesh = self.mesh
+        T, S = cfg.every, cfg.slices
+        compress, outer_kind, mu = cfg.compress, cfg.outer, cfg.momentum
+        slice_sh = NamedSharding(mesh, P(SLICE_AXIS))
+
+        def loss_one(params, ms, xm, ym, r):
+            def loss_fn(p):
+                pc = cast_floating(p, compute_dtype) if compute_dtype \
+                    else p
+                xc = (xm.astype(compute_dtype)
+                      if compute_dtype
+                      and jnp.issubdtype(xm.dtype, jnp.floating)
+                      else xm)
+                out, new_ms = model.apply(pc, ms, xc, training=True,
+                                          rng=r)
+                if compute_dtype:
+                    out = jax.tree.map(
+                        lambda o: o.astype(jnp.float32)
+                        if jnp.issubdtype(o.dtype, jnp.floating) else o,
+                        out)
+                return criterion.forward(out, ym), new_ms
+
+            (loss, new_ms), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            if compute_dtype:
+                grads = cast_floating(grads, jnp.float32)
+            return loss, new_ms, grads
+
+        def apply_update(params, g, slots, lr, upd_step):
+            # accumulators live in fp32; hand the update grads in the
+            # params' own dtype like the every-step path does
+            g = jax.tree.map(
+                lambda gg, pp: gg.astype(pp.dtype)
+                if jnp.issubdtype(pp.dtype, jnp.inexact) else gg,
+                g, params)
+            for proc in processors:
+                g = proc(g, params)
+            if not frozen:
+                return method_update(params, g, slots, lr, upd_step)
+            tm = model.trainable_mask(params)
+            old_params = params
+            new_params, new_slots = method_update(params, g, slots, lr,
+                                                  upd_step)
+            new_params = jax.tree.map(
+                lambda trainable, new, old: new if trainable is True
+                else (old if trainable is False
+                      else jnp.where(trainable, new, old)),
+                tm, new_params, old_params)
+            return new_params, new_slots
+
+        data_ways = (DATA_AXIS if DATA_AXIS in mesh.axis_names
+                     and mesh.shape[DATA_AXIS] > 1 else None)
+
+        def stack_spec(ndim):
+            # (S, per_slice_batch, ...): dim 0 over 'slice', dim 1 over
+            # 'data' — the layout the composed batch sharding reshapes
+            # into locally (no resharding, silences the partitioner's
+            # involuntary-remat fallback)
+            return NamedSharding(
+                mesh, P(SLICE_AXIS, data_ways, *([None] * (ndim - 2))))
+
+        def step(params, model_state, slots, exch, x, y, lr, step_num,
+                 rng):
+            xs = x.reshape((S, x.shape[0] // S) + x.shape[1:])
+            ys = y.reshape((S, y.shape[0] // S) + y.shape[1:])
+            xs = jax.lax.with_sharding_constraint(xs, stack_spec(xs.ndim))
+            ys = jax.lax.with_sharding_constraint(ys, stack_spec(ys.ndim))
+            keys = jax.vmap(
+                lambda i: jax.random.fold_in(rng, i))(jnp.arange(S))
+            losses, ms_stack, gstack = jax.vmap(
+                lambda xm, ym, r: loss_one(params, model_state, xm, ym,
+                                           r))(xs, ys, keys)
+            # pin the per-slice gradient stack's rows onto their slices
+            # — the accumulation below then never crosses the DCN
+            gstack = jax.tree.map(
+                lambda g: jax.lax.with_sharding_constraint(g, slice_sh),
+                gstack)
+            new_ms = jax.tree.map(
+                lambda l: (jnp.mean(l, 0)
+                           if jnp.issubdtype(l.dtype, jnp.inexact)
+                           else l[0]), ms_stack)
+            acc = jax.tree.map(
+                lambda a, g: a + g.astype(a.dtype), exch["acc"], gstack)
+            do_exchange = ((step_num + 1) % T) == 0
+
+            def run_exchange(op):
+                params, slots, acc, outer_st, _ = op
+                mean, resid, rnorm = cross_slice_accumulated_exchange(
+                    acc, mesh, compress=compress)
+                # window mean: the accumulated sum over T steps, divided
+                # by T — one update whose gradient magnitude matches a
+                # single averaged step
+                g = jax.tree.map(lambda m: m / T, mean)
+                if outer_kind == "nesterov":
+                    m_new = jax.tree.map(
+                        lambda m_, g_: mu * m_ + g_.astype(m_.dtype),
+                        outer_st["m"], g)
+                    g = jax.tree.map(
+                        lambda g_, m_: g_ + mu * m_.astype(g_.dtype),
+                        g, m_new)
+                    outer_st = {"m": m_new}
+                # slot/bias-correction time counts OUTER updates — the
+                # exchange ordinal, not the inner step number
+                upd_step = (step_num + 1) // T - 1
+                new_params, new_slots = apply_update(params, g, slots,
+                                                     lr, upd_step)
+                return new_params, new_slots, resid, outer_st, rnorm
+
+            def hold(op):
+                return op
+
+            (new_params, new_slots, new_acc, new_outer,
+             rnorm) = jax.lax.cond(
+                do_exchange, run_exchange, hold,
+                (params, slots, acc, exch["outer"],
+                 exch["residual_norm"]))
+            new_exch = {"acc": new_acc, "outer": new_outer,
+                        "residual_norm": rnorm}
+            return new_params, new_ms, new_slots, new_exch, losses
+
+        step.__name__ = "bigdl_dcn_train_step"
+        step.__qualname__ = "bigdl_dcn_train_step"
+        return step
+
+    def _make_dcn_fused_step(self, cfg):
+        """K-scan over the DCN step body: the exchange state rides the
+        scan carry AND the program boundary, so a T > K window spans
+        jitted calls with no extra host syncs. Same valid-mask shape
+        bucketing and non-finite masking as `_make_fused_step` — a
+        masked or non-finite step leaves params/slots/accumulator
+        untouched."""
+        body_step = self._make_dcn_step(cfg)
+
+        def bigdl_dcn_fused_train_step(params, model_state, slots, exch,
+                                       xs, ys, lrs, step_nums, rngs,
+                                       valid):
+            def body(carry, inp):
+                x, y, lr, n, r, v = inp
+
+                def run(c):
+                    p0, ms0, sl0, ex0 = c
+                    p1, ms1, sl1, ex1, losses = body_step(
+                        p0, ms0, sl0, ex0, x, y, lr, n, r)
+                    ok = jnp.all(jnp.isfinite(losses))
+                    for leaf in jax.tree.leaves(p1):
+                        if jnp.issubdtype(leaf.dtype, jnp.inexact):
+                            ok = jnp.logical_and(
+                                ok, jnp.all(jnp.isfinite(leaf)))
+
+                    def pick(new, old):
+                        return jax.tree.map(
+                            lambda a, b: jnp.where(ok, a, b), new, old)
+
+                    return (pick(p1, p0), pick(ms1, ms0), pick(sl1, sl0),
+                            pick(ex1, ex0)), losses
+
+                def skip(c):
+                    return c, jnp.zeros((cfg.slices,), jnp.float32)
+
+                return jax.lax.cond(v, run, skip, carry)
+
+            (params, model_state, slots, exch), losses = jax.lax.scan(
+                body, (params, model_state, slots, exch),
+                (xs, ys, lrs, step_nums, rngs, valid))
+            return params, model_state, slots, exch, losses
+
+        return bigdl_dcn_fused_train_step
+
+    def _build_dcn_step(self):
+        cfg = self._dcn_config()
+        step = self._make_dcn_step(cfg)
+        params_shape, _ = jax.eval_shape(
+            self.model.init, jax.random.PRNGKey(0))  # tpu-lint: disable=004
+        slots_shape = jax.eval_shape(self.method.init_slots, params_shape)
+        p_sh = self._param_shardings(params_shape)
+        s_sh = self._slot_shardings(slots_shape)
+        ex_sh = self._exchange_shardings(cfg, params_shape)
+        rep = NamedSharding(self.mesh, P())
+        from bigdl_tpu.utils.compat import SUPPORTS_SHARDED_DONATION
+        return jax.jit(
+            step,
+            donate_argnums=((0, 1, 2, 3) if SUPPORTS_SHARDED_DONATION
+                            else ()),
+            in_shardings=(p_sh, None, s_sh, ex_sh, None, None, rep, rep,
+                          rep),
+            out_shardings=(p_sh, None, s_sh, ex_sh, rep))
+
+    def _build_dcn_fused_step(self):
+        cfg = self._dcn_config()
+        fused = self._make_dcn_fused_step(cfg)
+        params_shape, _ = jax.eval_shape(
+            self.model.init, jax.random.PRNGKey(0))  # tpu-lint: disable=004
+        slots_shape = jax.eval_shape(self.method.init_slots, params_shape)
+        p_sh = self._param_shardings(params_shape)
+        s_sh = self._slot_shardings(slots_shape)
+        ex_sh = self._exchange_shardings(cfg, params_shape)
+        rep = NamedSharding(self.mesh, P())
+        from bigdl_tpu.utils.compat import SUPPORTS_SHARDED_DONATION
+        return jax.jit(
+            fused,
+            donate_argnums=((0, 1, 2, 3) if SUPPORTS_SHARDED_DONATION
+                            else ()),
+            in_shardings=(p_sh, None, s_sh, ex_sh, None, None, rep, rep,
+                          rep, rep),
+            out_shardings=(p_sh, None, s_sh, ex_sh, rep))
 
     # --------------------------------------------------------- failover
     def _slice_topology(self):
@@ -316,14 +608,18 @@ class DistriOptimizer(Optimizer):
         kind, idx = self._failover_pending
         self._failover_pending = None
         topo = self._slice_topology()
+        ex_state = getattr(self, "_dcn_state", None)
         t0 = _time.perf_counter()
         with observe.phase("failover/reshard", cat="resilience"):
             with observe.phase("failover/fetch", cat="resilience"):
                 from bigdl_tpu.analysis.sancov import sanctioned_sync
+                fetch = {"params": params, "model_state": model_state,
+                         "slots": slots}
+                if ex_state is not None:
+                    fetch["exchange"] = ex_state
                 with sanctioned_sync("failover host round-trip"):
-                    host = jax.device_get(
-                        {"params": params, "model_state": model_state,
-                         "slots": slots})
+                    host = jax.device_get(fetch)
+            old_live = topo.live_slices()
             try:
                 new_mesh = (topo.lose(idx) if kind == "lose"
                             else topo.restore())
@@ -334,6 +630,16 @@ class DistriOptimizer(Optimizer):
             with observe.phase("failover/replace", cat="resilience"):
                 params, model_state, slots = self._place_trees(
                     host["params"], host["model_state"], host["slots"])
+                if ex_state is not None:
+                    # DCN accumulator semantics across the transition:
+                    # survivor rows preserved, the lost slice's
+                    # in-window contribution explicitly dropped and
+                    # counted, grow-back rows start fresh
+                    # (resilience/failover.py)
+                    ex_host = _fo.remap_accumulator_rows(
+                        host["exchange"], old_live, topo.live_slices())
+                    self._dcn_cfg = self._dcn_config()
+                    self._dcn_state = self._place_exchange_state(ex_host)
         _fo.note_transition(kind, idx, new_mesh, topo, st["neval"],
                             _time.perf_counter() - t0)
         return params, model_state, slots

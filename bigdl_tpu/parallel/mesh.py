@@ -161,6 +161,106 @@ def cross_slice_exchange(grads, mesh, compress_dtype=None):
         return jax.tree.map(one, grads)
 
 
+def _quantize_int8_blocks(x, block: int):
+    """Symmetric per-block int8 for a gradient leaf (the traced mirror of
+    nn/quantized.quantize_weight_blocked's window recipe): flatten, pad
+    to a block multiple, one fp32 scale = max|x|/127 per block. Returns
+    (q (nb, block) int8, scale (nb, 1) fp32)."""
+    import jax.numpy as jnp
+    flat = x.reshape(-1).astype(jnp.float32)
+    pad = (-flat.shape[0]) % block
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    xb = flat.reshape(-1, block)
+    amax = jnp.max(jnp.abs(xb), axis=1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(xb / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _unblock(blocks, shape):
+    """Undo _quantize_int8_blocks' flatten+pad: (nb, block) -> shape."""
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return blocks.reshape(-1)[:n].reshape(shape)
+
+
+def cross_slice_accumulated_exchange(acc, mesh, *, compress: str = "",
+                                     block: int = 256):
+    """The REAL lowering of the `cross_slice_grad_exchange` seam: the
+    exchange-every-T leg of the DCN-tier gradient exchange
+    (parallel/dcn.py; docs/parallelism.md "DCN-tier exchange").
+
+    `acc` is a pytree of per-slice accumulators with leaf shape
+    `(S, *shape)` — row s holds slice s's locally-accumulated gradient
+    contribution, laid out `P('slice', ...)`. A shard_map over the mesh
+    gives each slice its own row; the cross-slice reduction is an
+    EXPLICIT collective over ('slice',) — `psum`/`pmean` uncompressed,
+    or an `all_gather` of the int8 blocks + per-block scales (the actual
+    DCN payload) followed by a local dequantize+mean when compressed.
+
+    Error feedback: the per-slice compression residual
+    `acc_s - dequant(quant(acc_s))` is returned for the caller to seed
+    the NEXT window's accumulator with, so quantization error re-enters
+    the pipeline instead of biasing the outer step (zero when
+    compress='').
+
+    Returns `(mean_tree, residual_tree, residual_norm)`:
+      * mean_tree — cross-slice mean of the (de)compressed accumulators,
+        leaf shape `*shape`, replicated;
+      * residual_tree — per-slice residuals, leaf shape `(S, *shape)`;
+      * residual_norm — scalar: slice-mean L2 norm of the residuals.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from bigdl_tpu.utils.compat import shard_map
+
+    S = slice_axis_size(mesh)
+    in_specs = jax.tree.map(lambda _: P(SLICE_AXIS), acc)
+
+    def body(acc_blk):
+        sq = jnp.float32(0.0)
+        leaves, treedef = jax.tree_util.tree_flatten(acc_blk)
+        means, resids = [], []
+        for a in leaves:
+            x = a[0]                       # this slice's accumulator row
+            if not jnp.issubdtype(a.dtype, jnp.floating):
+                means.append(x)
+                resids.append(jnp.zeros_like(a))
+                continue
+            if compress == "int8":
+                q, scale = _quantize_int8_blocks(x, block)
+                # the wire payload: int8 blocks + fp32 per-block scales
+                allq = jax.lax.all_gather(q, SLICE_AXIS)
+                allsc = jax.lax.all_gather(scale, SLICE_AXIS)
+                deq_all = allq.astype(jnp.float32) * allsc   # (S, nb, B)
+                mean = _unblock(deq_all.mean(0),
+                                x.shape).astype(x.dtype)
+                resid = x - _unblock(q.astype(jnp.float32) * scale,
+                                     x.shape).astype(x.dtype)
+            elif compress in ("bfloat16", "bf16"):
+                deq = x.astype(jnp.bfloat16).astype(x.dtype)
+                mean = jax.lax.pmean(deq, SLICE_AXIS)
+                resid = x - deq
+            else:
+                mean = jax.lax.pmean(x, SLICE_AXIS)
+                resid = jnp.zeros_like(x)
+            sq = sq + jnp.sum(jnp.square(resid).astype(jnp.float32))
+            means.append(mean)
+            resids.append(resid[None])
+        norm = jnp.sqrt(jax.lax.pmean(sq, SLICE_AXIS))
+        return (jax.tree_util.tree_unflatten(treedef, means),
+                jax.tree_util.tree_unflatten(treedef, resids), norm)
+
+    out_specs = (jax.tree.map(lambda _: P(), acc),
+                 jax.tree.map(lambda _: P(SLICE_AXIS), acc), P())
+    with jax.named_scope("cross_slice_grad_exchange"):
+        return shard_map(body, mesh=mesh, in_specs=(in_specs,),
+                         out_specs=out_specs, check_vma=False)(acc)
+
+
 def round_up_to_data_multiple(n: int, mesh) -> int:
     """Smallest multiple of the data-axis size ≥ n — the padding rule
     batch-sharded inference uses so every padded batch shards evenly."""

@@ -126,6 +126,61 @@ class SliceTopology:
         return self.full_mesh
 
 
+def remap_accumulator_rows(ex: dict, old_live: List[int],
+                           new_live: List[int]) -> dict:
+    """DCN-exchange accumulator semantics across a slice transition
+    (parallel/dcn.py; docs/parallelism.md "DCN-tier exchange"): the
+    accumulator's leading dim indexes the LIVE slices in order, so a
+    lose/grow at a K-boundary must re-deal the rows.
+
+      * survivors keep their rows untouched — their in-window gradient
+        contribution is preserved exactly;
+      * a LOST slice's row is dropped — its in-window contribution is
+        explicitly discarded (never silently averaged in), counted in
+        `exchange/dropped_contributions` with its L2 norm on
+        `exchange/last_dropped_norm`;
+      * a slice GROWING back starts a fresh (zero) row — it has nothing
+        accumulated for the current window.
+
+    Host-side numpy on the fetched global arrays (the same place
+    _apply_failover re-deals params); outer state and the residual-norm
+    scalar are replicated and pass through unchanged."""
+    import jax
+    from bigdl_tpu import observe
+    dropped = [s for s in old_live if s not in new_live]
+    grown = [s for s in new_live if s not in old_live]
+    dropped_sq = 0.0
+
+    def remap(a):
+        nonlocal dropped_sq
+        a = np.asarray(a)
+        for s in dropped:
+            row = a[old_live.index(s)]
+            dropped_sq += float(np.sum(np.square(
+                row.astype(np.float64))))
+        rows = []
+        for s in new_live:
+            if s in old_live:
+                rows.append(a[old_live.index(s)])
+            else:
+                rows.append(np.zeros(a.shape[1:], a.dtype))
+        return np.stack(rows)
+
+    acc = jax.tree.map(remap, ex["acc"])
+    if dropped:
+        norm = float(np.sqrt(dropped_sq))
+        observe.counter("exchange/dropped_contributions").inc(len(dropped))
+        observe.gauge("exchange/last_dropped_norm").set(norm)
+        log.warning(
+            "DCN exchange: dropped the in-window accumulator of lost "
+            "slice(s) %s (|contribution| = %.3e) — survivors' windows "
+            "are preserved", dropped, norm)
+    if grown:
+        log.info("DCN exchange: slice(s) %s grew back with a fresh "
+                 "(zero) accumulator window", grown)
+    return {**ex, "acc": acc}
+
+
 def note_transition(kind: str, slice_idx: Optional[int], mesh,
                     topo: SliceTopology, neval: int,
                     reshard_s: float) -> None:

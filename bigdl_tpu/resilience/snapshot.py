@@ -19,6 +19,15 @@ then joins A before queueing B — at most two snapshot buffers ever live.
 `wait()` joins the in-flight write and re-raises its failure; the
 trainers call it before every dependent read (resume, shutdown) and the
 retry loop calls it before trusting `latest_checkpoint`.
+
+The tree dict is open-ended: besides params/model_state/slots the
+trainers add an `exchange` tree when the DCN-tier exchange is armed
+(parallel/dcn.py) — per-slice gradient accumulators, error-feedback
+residual norm, and outer-optimizer state — with `exchange_every` /
+`exchange_pending` provenance in the meta, so a kill-and-resume
+mid-T-window restores the window exactly. The clone/persist path is
+tree-generic (structure-keyed clone fns, per-leaf piece plans), so the
+extra tree rides the same discipline with no special casing.
 """
 
 from __future__ import annotations

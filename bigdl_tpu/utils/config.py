@@ -125,6 +125,37 @@ _register("SLICE_GRAD_DTYPE", "", str,
           "'bfloat16' — floating grads round-trip through this dtype in "
           "the labeled cross-slice scope, halving DCN bytes at a "
           "quantization cost (parallel/mesh.py cross_slice_exchange)")
+_register("SLICE_EXCHANGE_EVERY", 1, int,
+          "DCN-tier gradient exchange period T (parallel/dcn.py): each "
+          "slice accumulates its own gradient contribution locally and "
+          "the cross-slice exchange — an explicit psum over ('slice',) "
+          "in a shard_map'd exchange step — runs every T-th iteration, "
+          "cutting DCN round trips by T (Local SGD / DiLoCo style). "
+          "1 (default) = exchange every step: the pre-DCN path, "
+          "bit-identical to every earlier build. T>1 needs a two-tier "
+          "mesh (BIGDL_TPU_SLICES > 1); params/slots then advance only "
+          "at window boundaries (docs/parallelism.md 'DCN-tier "
+          "exchange')")
+_register("SLICE_GRAD_COMPRESS", "", str,
+          "Wire compression for the T-window cross-slice exchange: '' "
+          "(off, exact), 'bfloat16', or 'int8' (symmetric per-256-"
+          "element-block scales — the nn/quantized window recipe on "
+          "the gradient wire), both with ERROR FEEDBACK: the "
+          "compression residual is carried in the per-slice "
+          "accumulator and re-enters the next window instead of "
+          "biasing the outer step. 'int8' arms the accumulate/"
+          "exchange machinery even at T=1. The legacy per-step "
+          "BIGDL_TPU_SLICE_GRAD_DTYPE round-trip applies only when "
+          "this machinery is off (docs/parallelism.md)")
+_register("SLICE_OUTER", "", str,
+          "Outer update applied at each T-window exchange "
+          "(parallel/dcn.py): '' (default) = plain averaging — ONE "
+          "inner-optimizer update from the cross-slice mean of the "
+          "accumulated window gradient; 'nesterov' = DiLoCo-style "
+          "outer Nesterov momentum (0.9) on the averaged window "
+          "gradient before the inner update. Outer state rides the "
+          "checkpoint next to the accumulator, so kill-and-resume "
+          "mid-window is exact")
 _register("ZERO1_SLICE_LOCAL", False, _bool,
           "ZeRO-1 slot layout on a two-tier mesh: 0 (default) shards "
           "over the composed ('slice','data') axes — bit-identical to "

@@ -102,6 +102,8 @@ def _peer_doc(i, *, step=None, alerts=()):
             "serve": {"m1": {"requests": 3 + i, "p99_ms": 8.0 + i,
                              "queued_rows": i}},
             "failover": {"live_slices": 2 - i, "slice_losses": i},
+            "exchange": {"window": 8, "pending_steps": 3 + i,
+                         "loss_spread": 0.01 * (i + 1)},
             "sanitizer": {"reports": [{"kind": "hostsync"}] * i,
                           "modes": ["locks"]},
         },
@@ -145,6 +147,9 @@ def test_aggregator_merges_and_marks_stale_not_dropped(clean_plane):
     assert p["sanitizer"]["reports"] == 1
     assert p["alerts"][0]["peer"] == 1
     assert p["peers"][1]["data_wait"] == pytest.approx(0.10)
+    # DCN-exchange window position + per-slice loss spread per peer
+    assert p["peers"][1]["exchange_pending"] == 4
+    assert p["peers"][1]["slice_loss_spread"] == pytest.approx(0.02)
     # full form embeds the raw snapshots for the report CLI
     full = agg.fleet_payload(full=True)
     assert full["snapshots"]["0"]["gauges"]["train/neval"] == 100.0

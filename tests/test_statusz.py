@@ -125,6 +125,9 @@ def test_statusz_endpoints_live_during_optimize(tmp_path, monkeypatch,
     assert statusz["run_id"]
     assert statusz["watchdog"]["enabled"] is True
     assert statusz["checkpoint"]["in_flight"] is False
+    # DCN exchange off -> no exchange section (armed form asserted in
+    # tests/test_dcn_exchange.py::test_statusz_exchange_section...)
+    assert "exchange" not in statusz
     tracez = json.loads(res["/tracez?n=50"][1])
     assert tracez["enabled"] is True and tracez["count"] > 0
     assert any(s["name"] == "train/dispatch" for s in tracez["spans"])
