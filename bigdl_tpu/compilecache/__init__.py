@@ -22,12 +22,13 @@ from bigdl_tpu.compilecache.cache import (cache_dir, clear, disable,
                                           enable, enabled, ensure_enabled,
                                           stats, sync)
 from bigdl_tpu.compilecache.warmup import (cost_summary, key_sds, log_cost,
-                                           precompile_buckets, scalar_sds,
+                                           precompile_buckets,
+                                           precompile_fixed, scalar_sds,
                                            sds_like)
 
 __all__ = [
     "enable", "ensure_enabled", "enabled", "disable", "sync",
     "cache_dir", "stats", "clear",
     "cost_summary", "log_cost", "sds_like", "key_sds", "scalar_sds",
-    "precompile_buckets",
+    "precompile_buckets", "precompile_fixed",
 ]

@@ -134,6 +134,24 @@ def precompile_buckets(jitted, params, state, feature_shape, dtype,
     return results, executables
 
 
+def precompile_fixed(jitted, args_specs, *, name: str):
+    """AOT-lower ONE program with an arbitrary (already spec'd) argument
+    tuple — the decode-serving warmup entry point (serve/decode.py):
+    unlike `precompile_buckets` the signature is not the bucket-forward
+    `(params, state, x, valid)`, so the caller supplies the full spec
+    tuple (ShapeDtypeStructs, shardings pinned if meshed). Cost analysis
+    is logged under `compile/<name>/...`; returns (cost_summary,
+    executable)."""
+    import time as _time
+    from bigdl_tpu import compilecache
+    compilecache.ensure_enabled()
+    t0 = _time.perf_counter()
+    compiled = jitted.lower(*args_specs).compile()
+    summary = log_cost(name, compiled, _time.perf_counter() - t0)
+    compilecache.sync()
+    return summary, compiled
+
+
 def log_cost(name: str, compiled, elapsed_s: float) -> Dict:
     """Record a precompiled program's cost analysis into the metrics
     registry (`compile/<name>/...` gauges) and the log."""

@@ -96,6 +96,14 @@ def _beam_generate(lm, params, state, prompt, max_new_tokens, beam_size,
     return full, scores
 
 
+def _restore_inactive(new, old, active):
+    """Keep only ACTIVE rows' cache updates: inactive slots' cache rows
+    come back bit-identical, so stale content can neither change nor
+    leak (serve/decode.py's slot-bucket contract)."""
+    keep = active.reshape((-1, 1, 1, 1))
+    return tuple(jnp.where(keep, n, o) for n, o in zip(new, old))
+
+
 class GPT2LM(Module):
     """GPT-2 rebuilt on this framework's primitives. apply(params, state,
     tokens (B, T) int32) → (B, T, vocab) logits (head tied to the token
@@ -194,6 +202,59 @@ class GPT2LM(Module):
             self, params, state, prompt, max_new_tokens, beam_size,
             eos_id, alpha, kv_cache, kv_shape=(H, self.d_model // H),
             dtype=params["wte"].dtype, n_positions=self.n_positions)
+
+    # ------------------------------------------- iteration-level decoding
+    # The decode-serving contract (serve/decode.py DecodeEntry):
+    # make_slot_caches / prefill / decode_step over a SLOT batch where
+    # each row is an independent sequence at its own absolute positions.
+    # Per-row numerics are bit-identical to _cached_forward with the
+    # matching scalar start (asserted by tests/test_decode.py).
+    def make_slot_caches(self, params, num_slots: int, max_seq_len: int):
+        """Zero per-layer KV caches of (num_slots, max_seq_len, H, hd) —
+        the persistent slot-bucket pytree the decode engine owns."""
+        H = self.children()["h0"].attn.num_heads
+        hd = self.d_model // H
+        dtype = params["wte"].dtype
+        zeros = lambda: jnp.zeros(                         # noqa: E731
+            (num_slots, max_seq_len, H, hd), dtype)
+        return (tuple(zeros() for _ in range(self.num_layers)),
+                tuple(zeros() for _ in range(self.num_layers)))
+
+    def _slot_hidden(self, params, caches, tokens, positions, active):
+        cks, cvs = caches
+        pos = jnp.clip(positions, 0, self.n_positions - 1)
+        x = params["wte"][tokens] + params["wpe"][pos]
+        new_ck, new_cv = [], []
+        for i in range(self.num_layers):
+            x, ck_i, cv_i = self.children()[f"h{i}"].slot_cached_step(
+                params[f"h{i}"], x, cks[i], cvs[i], pos)
+            new_ck.append(ck_i)
+            new_cv.append(cv_i)
+        return x, (_restore_inactive(tuple(new_ck), cks, active),
+                   _restore_inactive(tuple(new_cv), cvs, active))
+
+    def prefill(self, params, caches, tokens, positions, active):
+        """Write one prompt chunk per slot into the KV caches: tokens/
+        positions (S, C) int32 (absolute positions, row-independent),
+        active (S,) bool — inactive rows' caches are untouched. No
+        logits (the LM head is skipped; decode_step produces tokens).
+        Returns the new caches."""
+        return self._slot_hidden(params, caches, tokens, positions,
+                                 active)[1]
+
+    def decode_step(self, params, caches, tokens_last, positions,
+                    active):
+        """One iteration-level greedy decode step over the slot batch:
+        tokens_last/positions (S,) int32, active (S,) bool →
+        (next_tokens (S,) int32, new caches). Inactive rows' caches are
+        bit-preserved and their next_tokens are meaningless (the
+        scheduler masks them)."""
+        x, caches = self._slot_hidden(
+            params, caches, tokens_last[:, None], positions[:, None],
+            active)
+        x, _ = self.children()["ln_f"].apply(params["ln_f"], {}, x)
+        logits = x[:, -1] @ self._head(params).T
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), caches
 
 
 def _gelu_exact(x):
@@ -491,6 +552,41 @@ class LlamaBlock(Module):
         dn, _ = c["down"].apply(params["down"], {}, jax.nn.silu(g) * u)
         return x + dn, ck, cv
 
+    def slot_cached_step(self, params, x, ck, cv, positions):
+        """`cached_step` over a slot batch with PER-ROW positions
+        (N, T) int32 — RoPE angles and the causal-over-cache mask are
+        computed per row, so each slot decodes at its own offset
+        (nn/attention.slot_cached_attend). Bit-identical per row to
+        cached_step with the matching scalar start."""
+        from bigdl_tpu.nn.attention import (rotary_embedding,
+                                            slot_cached_attend)
+        c = self.children()
+        attn = c["attn"]
+        if callable(attn.attn_impl):
+            raise ValueError(
+                "slot_cached_step decodes through the dense attention "
+                "core; this block was built with a custom attn_impl "
+                "whose numerics it cannot reproduce")
+        N, T, d = x.shape
+        H, hd = attn.num_heads, attn.head_dim
+        KV = attn.num_kv_heads or H
+        at = params["attn"]
+        h, _ = c["ln1"].apply(params["ln1"], {}, x)
+        q = (h @ at["wq"]).reshape(N, T, H, hd)
+        k = (h @ at["wk"]).reshape(N, T, KV, hd)
+        v = (h @ at["wv"]).reshape(N, T, KV, hd)
+        q = rotary_embedding(q.transpose(0, 2, 1, 3), attn.rope_theta,
+                             positions)
+        k = rotary_embedding(k.transpose(0, 2, 1, 3), attn.rope_theta,
+                             positions).transpose(0, 2, 1, 3)
+        a, ck, cv = slot_cached_attend(q, k, v, ck, cv, positions)
+        x = x + a @ at["wo"]
+        h, _ = c["ln2"].apply(params["ln2"], {}, x)
+        g, _ = c["gate"].apply(params["gate"], {}, h)
+        u, _ = c["up"].apply(params["up"], {}, h)
+        dn, _ = c["down"].apply(params["down"], {}, jax.nn.silu(g) * u)
+        return x + dn, ck, cv
+
 
 class LlamaLM(Module):
     """LLaMA-architecture causal LM (RMSNorm + RoPE + GQA + SwiGLU) on
@@ -585,6 +681,49 @@ class LlamaLM(Module):
             self, params, state, prompt, max_new_tokens, beam_size,
             eos_id, alpha, kv_cache, kv_shape=(KV, attn0.head_dim),
             dtype=params["embed"].dtype)
+
+    # ------------------------------------------- iteration-level decoding
+    # Same decode-serving contract as GPT2LM (serve/decode.py): grouped
+    # KV caches, per-row RoPE offsets, bit-preserved inactive rows.
+    def make_slot_caches(self, params, num_slots: int, max_seq_len: int):
+        """Zero per-layer grouped-KV caches (num_slots, max_seq_len, KV,
+        hd) — the persistent slot-bucket pytree."""
+        attn0 = self.children()["l0"].children()["attn"]
+        KV = attn0.num_kv_heads or attn0.num_heads
+        dtype = params["embed"].dtype
+        zeros = lambda: jnp.zeros(                         # noqa: E731
+            (num_slots, max_seq_len, KV, attn0.head_dim), dtype)
+        return (tuple(zeros() for _ in range(self.num_layers)),
+                tuple(zeros() for _ in range(self.num_layers)))
+
+    def _slot_hidden(self, params, caches, tokens, positions, active):
+        cks, cvs = caches
+        x = params["embed"][tokens]
+        new_ck, new_cv = [], []
+        for i in range(self.num_layers):
+            x, ck_i, cv_i = self.children()[f"l{i}"].slot_cached_step(
+                params[f"l{i}"], x, cks[i], cvs[i], positions)
+            new_ck.append(ck_i)
+            new_cv.append(cv_i)
+        return x, (_restore_inactive(tuple(new_ck), cks, active),
+                   _restore_inactive(tuple(new_cv), cvs, active))
+
+    def prefill(self, params, caches, tokens, positions, active):
+        """Write one prompt chunk per slot into the grouped-KV caches
+        (see GPT2LM.prefill — same contract). Returns the new caches."""
+        return self._slot_hidden(params, caches, tokens, positions,
+                                 active)[1]
+
+    def decode_step(self, params, caches, tokens_last, positions,
+                    active):
+        """One iteration-level greedy decode step over the slot batch
+        (see GPT2LM.decode_step — same contract)."""
+        x, caches = self._slot_hidden(
+            params, caches, tokens_last[:, None], positions[:, None],
+            active)
+        x, _ = self.children()["norm"].apply(params["norm"], {}, x)
+        logits = x[:, -1] @ self._head(params).T
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), caches
 
 
 def from_llama(hf_model, attn_impl="dense", block_size=512,

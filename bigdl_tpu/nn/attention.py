@@ -140,15 +140,20 @@ def padding_mask(lengths, t: int):
 def rotary_embedding(x, theta: float = 10000.0, positions=None):
     """Rotary position embedding, rotate-half convention (LLaMA/HF
     layout: the head dim splits into two contiguous halves, not
-    interleaved pairs). x: (B, H, T, hd). No reference analogue — RoPE
-    postdates it; standard for modern LMs."""
+    interleaved pairs). x: (B, H, T, hd). `positions` is either a (T,)
+    vector shared by every row or a (B, T) matrix of PER-ROW absolute
+    positions (the slot-decode path, where each KV slot sits at its own
+    sequence offset). No reference analogue — RoPE postdates it;
+    standard for modern LMs."""
     B, H, T, hd = x.shape
     if positions is None:
         positions = jnp.arange(T)
     inv = 1.0 / (theta ** (jnp.arange(0, hd, 2) / hd))       # (hd/2,)
-    ang = positions[:, None] * inv[None, :]                   # (T, hd/2)
-    cos = jnp.concatenate([jnp.cos(ang), jnp.cos(ang)], -1)   # (T, hd)
+    ang = positions[..., :, None] * inv                # (..., T, hd/2)
+    cos = jnp.concatenate([jnp.cos(ang), jnp.cos(ang)], -1)   # (..., T, hd)
     sin = jnp.concatenate([jnp.sin(ang), jnp.sin(ang)], -1)
+    if cos.ndim == 3:          # (B, T, hd) -> broadcast over the head dim
+        cos, sin = cos[:, None], sin[:, None]
     x1, x2 = x[..., : hd // 2], x[..., hd // 2:]
     rotated = jnp.concatenate([-x2, x1], axis=-1)
     return (x * cos + rotated * sin).astype(x.dtype)
@@ -173,6 +178,44 @@ def cached_attend(q_heads, k_chunk, v_chunk, ck, cv, start):
         fv = jnp.repeat(fv, H // Hc, axis=1)
     mask = (jnp.arange(L)[None, :] <=
             (start + jnp.arange(T))[:, None])   # causal + cache tail
+    a = dot_product_attention(q_heads, fk, fv, mask)
+    return a.transpose(0, 2, 1, 3).reshape(N, T, H * hd), ck, cv
+
+
+def slot_cached_attend(q_heads, k_chunk, v_chunk, ck, cv, positions):
+    """`cached_attend` batched over a SLOT dimension with per-row start
+    offsets — the decode-serving core (serve/decode.py): row n of the
+    batch is an independent sequence sitting at its own absolute
+    positions `positions[n]` (N, T) int32, so its chunk is written at
+    `[positions[n, 0], positions[n, 0] + T)` of ITS cache row and
+    attends causally over its own prefix only.
+
+    Per-row numerics are bit-identical to `cached_attend` with the same
+    scalar start (same write, same mask values, same softmax chain) —
+    the iteration-level parity oracle in tests/test_decode.py depends on
+    this. Entries past a row's frontier are masked to NEG_INF *before*
+    the softmax, so stale/poisoned cache content beyond the frontier
+    contributes exactly zero (the PR 5/8 valid-mask discipline applied
+    along the sequence axis). Masking INACTIVE rows entirely is the
+    caller's job (their cache rows are restored post-hoc).
+
+    q_heads (N, H, T, hd); k_chunk/v_chunk (N, T, Hc, hd) with Hc == H
+    or a grouped divisor (GQA). Returns ((N, T, H*hd), new_ck, new_cv).
+    """
+    starts = positions[:, 0]
+    upd = jax.vmap(
+        lambda c, u, s: jax.lax.dynamic_update_slice(c, u, (s, 0, 0)))
+    ck = upd(ck, k_chunk, starts)
+    cv = upd(cv, v_chunk, starts)
+    N, H, T, hd = q_heads.shape
+    L, Hc = ck.shape[1], ck.shape[2]
+    fk = ck.transpose(0, 2, 1, 3)
+    fv = cv.transpose(0, 2, 1, 3)
+    if Hc != H:
+        fk = jnp.repeat(fk, H // Hc, axis=1)
+        fv = jnp.repeat(fv, H // Hc, axis=1)
+    # (N, 1, T, L): per-row causal-over-cache frontier
+    mask = (jnp.arange(L)[None, None, :] <= positions[:, :, None])[:, None]
     a = dot_product_attention(q_heads, fk, fv, mask)
     return a.transpose(0, 2, 1, 3).reshape(N, T, H * hd), ck, cv
 
@@ -380,6 +423,42 @@ class TransformerLayer(Module):
         # one numerical core: the same scale/mask/softmax chain apply()
         # uses ((N, H, T, hd) layout; mask broadcasts over N, H)
         a, ck, cv = cached_attend(q, k, v, ck, cv, start)
+        a = a @ at["wo"]
+        if self.attn.bias:
+            a = a + at["bo"]
+        x = x + a
+        f, _ = self.ffn.apply(params["ffn"], {},
+                              self.ln2.apply(params["ln2"], {}, x)[0])
+        return x + f, ck, cv
+
+    def slot_cached_step(self, params, x, ck, cv, positions):
+        """`cached_step` over a slot batch with PER-ROW positions
+        (N, T) int32 — each row is an independent sequence at its own
+        offset (slot_cached_attend). Per-row numerics are bit-identical
+        to `cached_step` with the matching scalar start. Self-attention
+        blocks only; same custom-attn_impl refusal as cached_step."""
+        if self.cross:
+            raise ValueError("slot_cached_step supports self-attention "
+                             "decoder blocks only")
+        if callable(self.attn.attn_impl):
+            raise ValueError(
+                "slot_cached_step decodes through the dense attention "
+                "core; this layer was built with a custom attn_impl "
+                "whose numerics it cannot reproduce")
+        N, T, d = x.shape
+        H = self.attn.num_heads
+        hd = d // H
+        at = params["attn"]
+        h, _ = self.ln1.apply(params["ln1"], {}, x)
+        q = h @ at["wq"]
+        k = h @ at["wk"]
+        v = h @ at["wv"]
+        if self.attn.bias:
+            q, k, v = q + at["bq"], k + at["bk"], v + at["bv"]
+        q = q.reshape(N, T, H, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(N, T, H, hd)
+        v = v.reshape(N, T, H, hd)
+        a, ck, cv = slot_cached_attend(q, k, v, ck, cv, positions)
         a = a @ at["wo"]
         if self.attn.bias:
             a = a + at["bo"]

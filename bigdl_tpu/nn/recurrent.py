@@ -657,3 +657,33 @@ def cached_beam_generate(fwd, make_caches, prompt, *, max_new_tokens: int,
     full = jnp.concatenate(
         [jnp.repeat(prompt[:, None], beam_size, axis=1), seqs], -1)
     return full, scores
+
+
+def greedy_generate(fwd, make_caches, prompt, *, max_new_tokens: int,
+                    eos_id: int):
+    """Greedy (beam_size=1) KV-cached decode over the same `fwd`/
+    `make_caches` contract as :func:`cached_beam_generate` — prefill the
+    prompt once, then one argmax token per step; finished rows (emitted
+    eos) keep emitting eos, mirroring beam_search's frozen-beam padding.
+    The serving decode engine (serve/decode.py) runs these exact
+    per-step semantics iteration-level over KV slots; this is the
+    single-call form (bench baselines, isolated oracles).
+
+    Returns sequences (B, P + max_new_tokens) int32."""
+    B, P = prompt.shape
+    caches = make_caches()
+    if P > 1:
+        _, caches = fwd(prompt[:, :P - 1], caches, 0)
+
+    def body(carry, _):
+        tokens_last, pos, finished, caches = carry
+        logits, caches = fwd(tokens_last[:, None], caches, pos)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(finished, jnp.int32(eos_id), nxt)
+        finished = finished | (nxt == eos_id)
+        return (nxt, pos + 1, finished, caches), nxt
+
+    carry0 = (prompt[:, -1], jnp.int32(P - 1),
+              jnp.zeros((B,), bool), caches)
+    _, toks = lax.scan(body, carry0, None, length=max_new_tokens)
+    return jnp.concatenate([prompt, toks.T], axis=1)

@@ -397,9 +397,20 @@ class ServeWatchdog:
             model = name[len("serve/"):-len("/latency_ms")]
             if not model:            # the combined serve/latency_ms
                 continue
+            # decode models surface as '<model>/decode' (the decode
+            # engine's serve/<model>/decode/latency_ms): attribution
+            # decomposes into queue-wait vs prefill vs per-token step
+            # instead of dispatch/batch-fill
+            is_decode = model.endswith("/decode")
             qw = hists.get(f"serve/{model}/queue_wait_ms")
             disp = hists.get(f"serve/{model}/dispatch_ms")
-            fill = hists.get("serve/batch_fill")
+            pf = hists.get(f"serve/{model}/prefill_ms")
+            stp = hists.get(f"serve/{model}/step_ms")
+            # bucket fill is read per model (serve/<model>/batch_fill)
+            # with the legacy global histogram as fallback — the global
+            # one misattributes once several models share the process
+            fill = (hists.get(f"serve/{model}/batch_fill")
+                    or hists.get("serve/batch_fill"))
             with self._lock:
                 prev = self._prev.get(model, {})
                 lat_w = _metrics.histogram_window(prev.get("lat"), h)
@@ -409,8 +420,12 @@ class ServeWatchdog:
                                                    disp) if disp else None
                 fill_w = _metrics.histogram_window(prev.get("fill"),
                                                    fill) if fill else None
+                pf_w = _metrics.histogram_window(prev.get("pf"), pf) \
+                    if pf else None
+                stp_w = _metrics.histogram_window(prev.get("stp"), stp) \
+                    if stp else None
                 self._prev[model] = {"lat": h, "qw": qw, "disp": disp,
-                                     "fill": fill}
+                                     "fill": fill, "pf": pf, "stp": stp}
             if not lat_w or lat_w.get("count", 0) <= 0:
                 continue             # no traffic this window: no signal
             p99 = _metrics.quantile_from_snapshot(lat_w, 0.99)
@@ -420,14 +435,21 @@ class ServeWatchdog:
                 return (w["sum"] / w["count"]
                         if w and w.get("count") else 0.0)
 
-            mean_fill = _mean(fill_w)
-            comps = {
-                "queue_wait_ms": round(_mean(qw_w), 6),
-                "dispatch_ms": round(_mean(disp_w), 6),
-                "batch_fill_ms": round(
-                    max(0.0, 1.0 - mean_fill) * mean_lat, 6)
-                if fill_w and fill_w.get("count") else 0.0,
-            }
+            if is_decode:
+                comps = {
+                    "queue_wait_ms": round(_mean(qw_w), 6),
+                    "prefill_ms": round(_mean(pf_w), 6),
+                    "step_ms": round(_mean(stp_w), 6),
+                }
+            else:
+                mean_fill = _mean(fill_w)
+                comps = {
+                    "queue_wait_ms": round(_mean(qw_w), 6),
+                    "dispatch_ms": round(_mean(disp_w), 6),
+                    "batch_fill_ms": round(
+                        max(0.0, 1.0 - mean_fill) * mean_lat, 6)
+                    if fill_w and fill_w.get("count") else 0.0,
+                }
             inc = self._dog(model).observe_signal(
                 int(h.get("count", 0)), p99, comps,
                 extra={"requests_in_window": int(lat_w["count"]),

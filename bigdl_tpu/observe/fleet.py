@@ -220,8 +220,21 @@ class FleetAggregator:
                                          or {}).get("pending_steps"),
                     "slice_loss_spread": (p.payload.get("exchange")
                                           or {}).get("loss_spread"),
+                    # iteration-level decode (statusz `decode` section):
+                    # the peer's aggregate decode rate + live slots
+                    "decode_tokens_per_s": self._peer_decode_rate(
+                        p.payload),
                 })
         return rows
+
+    @staticmethod
+    def _peer_decode_rate(payload: dict) -> Optional[float]:
+        """Sum of a peer's per-model decode tokens/s (None when the
+        peer serves no decode models)."""
+        dec = payload.get("decode") or {}
+        rates = [float(s.get("tokens_per_s", 0) or 0)
+                 for s in dec.values() if isinstance(s, dict)]
+        return round(sum(rates), 2) if rates else None
 
     @staticmethod
     def _spread(vals: List[float]) -> Optional[dict]:
@@ -266,6 +279,26 @@ class FleetAggregator:
                                         float(s.get("p99_ms", 0) or 0))
                 agg["queued_rows"] += int(s.get("queued_rows", 0) or 0)
                 agg["peers"] += 1
+                d = s.get("decode")
+                if isinstance(d, dict):
+                    # per-model decode aggregates: fleet tokens/s is
+                    # additive; slot occupancy averages across peers
+                    dec = agg.setdefault("decode", {
+                        "tokens": 0, "tokens_per_s": 0.0,
+                        "active_slots": 0, "slots": 0,
+                        "_occ_sum": 0.0, "_occ_n": 0, "peers": 0})
+                    dec["tokens"] += int(d.get("tokens", 0) or 0)
+                    dec["tokens_per_s"] = round(
+                        dec["tokens_per_s"]
+                        + float(d.get("tokens_per_s", 0) or 0), 2)
+                    dec["active_slots"] += int(
+                        d.get("active_slots", 0) or 0)
+                    dec["slots"] += int(d.get("slots", 0) or 0)
+                    occ = d.get("slot_occupancy_mean")
+                    if occ is not None:
+                        dec["_occ_sum"] += float(occ)
+                        dec["_occ_n"] += 1
+                    dec["peers"] += 1
             fo = p.payload.get("failover") or {}
             for k in ("slice_losses", "grow_backs", "lost_slices"):
                 if k in fo:
@@ -279,6 +312,13 @@ class FleetAggregator:
             if n:
                 san_reports += n
                 san_by_peer[str(p.index)] = n
+        for agg in serve.values():
+            dec = agg.get("decode")
+            if dec is not None:
+                n = dec.pop("_occ_n")
+                occ_sum = dec.pop("_occ_sum")
+                dec["slot_occupancy_mean"] = (round(occ_sum / n, 4)
+                                              if n else None)
         alerts.sort(key=lambda a: a.get("opened_at", 0.0))
         payload = {
             "run_id": run_id(),

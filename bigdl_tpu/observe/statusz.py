@@ -129,6 +129,12 @@ def status_payload() -> dict:
         slo = _metrics.serve_slo(snap)
         if slo:
             serve = {"_from_registry": slo}
+    # iteration-level decode (serve/decode.py): per-model slot/token
+    # state lifted out of the serve stats into its own pane — the fleet
+    # plane mirrors these per peer (observe/fleet.py)
+    decode = {m: s["decode"] for m, s in serve.items()
+              if isinstance(s, dict) and isinstance(s.get("decode"),
+                                                    dict)}
     wd = _doctor.watchdog()
     payload = {
         **health_payload(),
@@ -154,6 +160,7 @@ def status_payload() -> dict:
             "failures": c.get("checkpoint/failures", 0),
         },
         "serve": serve or None,
+        "decode": decode or None,
         "alerts": wd.alerts(),
         "watchdog": {
             "enabled": wd.enabled,

@@ -22,20 +22,35 @@ handles LIVE traffic:
                    chunking, per-model p50/p99 latency + queue-depth +
                    batch-fill SLO metrics through the observe registry,
                    SIGTERM drain riding the resilience handler;
+  * **decode**   — iteration-level continuous batching for
+                   autoregressive LMs: persistent (slots, max_seq_len)
+                   KV-slot buckets, chunked prompt prefill through
+                   length-bucketed AOT programs, one fused greedy step
+                   per iteration over the ragged active set, requests
+                   joining free slots and retiring (EOS/max_new) EVERY
+                   step — no head-of-line blocking, O(L) per token
+                   (`ServeEngine.register(decode=True)` +
+                   `submit_generate`, serve/decode.py);
   * **CLI**      — `python -m bigdl_tpu.serve <factory> --input SHAPE`
-                   (line-JSON requests on stdin; `--smoke` self-drives).
+                   (line-JSON requests on stdin; `--smoke` self-drives;
+                   `--decode` stands up the autoregressive path).
 
 Knobs: BIGDL_TPU_SERVE_MAX_BATCH / _MAX_WAIT_MS / _MAX_QUEUE_ROWS /
-_INT8 (utils/config.py). Docs: docs/serving.md.
+_INT8 / _DECODE_SLOTS / _PREFILL_CHUNK / _MAX_SEQ_LEN
+(utils/config.py). Docs: docs/serving.md.
 """
 
 from bigdl_tpu.serve.batcher import (Closed, ContinuousBatcher, Overloaded)
+from bigdl_tpu.serve.decode import (DecodeEntry, DecodeScheduler, GenReply,
+                                    decode_demo_model, prefill_buckets)
 from bigdl_tpu.serve.engine import Reply, ServeEngine
 from bigdl_tpu.serve.registry import (ModelEntry, ModelRegistry,
                                       serve_buckets)
 
 __all__ = [
-    "ServeEngine", "Reply",
+    "ServeEngine", "Reply", "GenReply",
     "ContinuousBatcher", "Overloaded", "Closed",
     "ModelRegistry", "ModelEntry", "serve_buckets",
+    "DecodeEntry", "DecodeScheduler", "decode_demo_model",
+    "prefill_buckets",
 ]

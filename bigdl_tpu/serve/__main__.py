@@ -17,10 +17,19 @@ Modes:
     requests, then ONE JSON summary line (requests, batches, mean
     batch fill, p50/p99 ms, shed count) is printed. Exit 0 on a clean
     drain with every request answered — the tier-1 CI probe.
+  * --decode — the iteration-level autoregressive path
+    (serve/decode.py): the factory's model must carry the slot-decode
+    contract (GPT2LM/LlamaLM; no factory = a tiny built-in demo LM).
+    stdin lines are `{"prompt": [ids...], "max_new_tokens": N}` (or a
+    bare JSON array of ids, decoded with --max-new); `--decode --smoke`
+    self-drives T threads of concurrent mixed-length generates and
+    prints one JSON summary (tokens, tokens/s, ttft p50/p99, slot
+    occupancy) — the decode tier-1 CI probe.
 
 `--precompile` AOT-compiles every shape bucket before traffic (warm
-compile cache => zero fresh programs). `--int8` serves the quantized
-forward. Knob defaults: BIGDL_TPU_SERVE_* (docs/configuration.md).
+compile cache => zero fresh programs; decode registrations always
+precompile). `--int8` serves the quantized forward. Knob defaults:
+BIGDL_TPU_SERVE_* (docs/configuration.md).
 """
 
 from __future__ import annotations
@@ -108,6 +117,73 @@ def _rand(r, feature_shape, dtype, n: int):
     return r.randn(n, *feature_shape).astype(dtype)
 
 
+def _decode_smoke(engine, name: str, *, threads: int, requests: int,
+                  max_new: int, seed: int) -> dict:
+    """Self-drive the decode path: concurrent mixed-length generates,
+    each checked for a non-empty, budget-respecting reply."""
+    import numpy as np
+    entry = engine.registry.get(name)
+    vocab = entry.decode.vocab_size
+    cap = max(2, entry.decode.max_seq_len - max_new)
+    r = np.random.RandomState(seed)
+    prompts = [[r.randint(2, vocab, int(r.randint(1, min(cap, 24) + 1)))
+                for _ in range(requests)] for _ in range(threads)]
+    errors: list = []
+    ok = [0]
+
+    def client(ti):
+        try:
+            for p in prompts[ti]:
+                out = engine.generate(name, p, max_new, timeout=120)
+                assert 1 <= out.shape[0] <= max_new, out.shape
+                ok[0] += 1
+        except Exception as exc:           # noqa: BLE001 — in the JSON
+            errors.append(f"client {ti}: {exc!r}")
+
+    from bigdl_tpu.utils.threads import spawn
+    ts = [spawn(client, name=f"serve-decode-smoke-{ti}", args=(ti,),
+                start=False) for ti in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    st = engine.stats()[name]["decode"]
+    return {
+        "mode": "decode-smoke",
+        "model": name,
+        "clients": threads,
+        "requests_sent": threads * requests,
+        "requests_ok": ok[0],
+        "errors": errors[:5],
+        "slots": st["slots"],
+        "retired": st["retired"],
+        "tokens": st["tokens"],
+        "tokens_per_s": st["tokens_per_s"],
+        "slot_occupancy_mean": st["slot_occupancy_mean"],
+        "ttft_p50_ms": st["ttft_p50_ms"],
+        "ttft_p99_ms": st["ttft_p99_ms"],
+        "step_p50_ms": st["step_p50_ms"],
+    }
+
+
+def _decode_stdin_loop(engine, name: str, max_new: int) -> int:
+    import numpy as np
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        req = json.loads(line)
+        if isinstance(req, dict):
+            prompt = np.asarray(req["prompt"], np.int32)
+            n = int(req.get("max_new_tokens", max_new))
+        else:
+            prompt, n = np.asarray(req, np.int32), max_new
+        out = engine.generate(name, prompt, n, timeout=120)
+        print(json.dumps(np.asarray(out).tolist()))
+        sys.stdout.flush()
+    return 0
+
+
 def _stdin_loop(engine, name: str, dtype) -> int:
     import numpy as np
     for line in sys.stdin:
@@ -126,9 +202,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         prog="python -m bigdl_tpu.serve",
         description="Online inference engine around a model factory "
                     "(docs/serving.md)")
-    ap.add_argument("factory", help="model factory as 'pkg.module:callable'")
-    ap.add_argument("--input", required=True, metavar="SHAPE[:DTYPE]",
-                    help="per-row feature shape, e.g. 28,28,1 or 16:int32")
+    ap.add_argument("factory", nargs="?", default=None,
+                    help="model factory as 'pkg.module:callable' "
+                         "(optional with --decode: defaults to the "
+                         "built-in demo LM)")
+    ap.add_argument("--input", default=None, metavar="SHAPE[:DTYPE]",
+                    help="per-row feature shape, e.g. 28,28,1 or 16:int32 "
+                         "(required unless --decode)")
+    ap.add_argument("--decode", action="store_true",
+                    help="iteration-level autoregressive decode serving "
+                         "(GPT2LM/LlamaLM-style models; serve/decode.py)")
+    ap.add_argument("--slots", type=int, default=None,
+                    help="decode: concurrent KV slots "
+                         "(BIGDL_TPU_SERVE_DECODE_SLOTS)")
+    ap.add_argument("--max-seq-len", type=int, default=None,
+                    help="decode: slot cache length "
+                         "(BIGDL_TPU_SERVE_MAX_SEQ_LEN)")
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="decode: largest prompt-prefill chunk "
+                         "(BIGDL_TPU_SERVE_PREFILL_CHUNK)")
+    ap.add_argument("--max-new", type=int, default=16,
+                    help="decode: default max_new_tokens per request")
+    ap.add_argument("--eos", type=int, default=None,
+                    help="decode: stop-token id override")
     ap.add_argument("--name", default="default", help="registry model name")
     ap.add_argument("--max-batch", type=int, default=None)
     ap.add_argument("--max-wait-ms", type=float, default=None)
@@ -154,14 +250,44 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     import jax
     from bigdl_tpu.serve.engine import ServeEngine
 
-    feature_shape, dtype = _parse_input(args.input)
-    model = _load_factory(args.factory)
-    params, state = model.init(
-        jax.random.PRNGKey(args.seed))  # tpu-lint: disable=004
     mesh = None
     if args.mesh:
         from bigdl_tpu.parallel.mesh import create_mesh
         mesh = create_mesh(drop_trivial_axes=True)
+
+    if args.decode:
+        if args.factory is None:
+            from bigdl_tpu.serve.decode import decode_demo_model
+            model, params, state = decode_demo_model(seed=args.seed)
+        else:
+            model = _load_factory(args.factory)
+            params, state = model.init(
+                jax.random.PRNGKey(args.seed))  # tpu-lint: disable=004
+        engine = ServeEngine(install_sigterm=not args.smoke)
+        try:
+            engine.register(
+                args.name, model, params, state, mesh=mesh, decode=True,
+                num_slots=args.slots, max_seq_len=args.max_seq_len,
+                prefill_chunk=args.prefill_chunk, eos_id=args.eos)
+            if args.smoke:
+                rec = _decode_smoke(
+                    engine, args.name, threads=args.smoke_threads,
+                    requests=args.smoke_requests, max_new=args.max_new,
+                    seed=args.seed)
+                print(json.dumps(rec))
+                return 1 if rec["errors"] else 0
+            return _decode_stdin_loop(engine, args.name, args.max_new)
+        finally:
+            engine.shutdown()
+
+    if args.input is None:
+        raise SystemExit("--input is required (unless --decode)")
+    if args.factory is None:
+        raise SystemExit("a model factory is required (unless --decode)")
+    feature_shape, dtype = _parse_input(args.input)
+    model = _load_factory(args.factory)
+    params, state = model.init(
+        jax.random.PRNGKey(args.seed))  # tpu-lint: disable=004
 
     engine = ServeEngine(install_sigterm=not args.smoke)
     try:

@@ -124,8 +124,16 @@ class ContinuousBatcher:
                                      LATENCY_MS_BOUNDS)
         self._disp = observe.histogram(f"serve/{name}/dispatch_ms",
                                        LATENCY_MS_BOUNDS)
+        # bucket-fill is recorded per MODEL as well as globally: once a
+        # decode model shares the process, the global histogram mixes
+        # whole-request bucket fill with unrelated traffic — the
+        # watchdog's batch-fill attribution and stats() read the
+        # per-model form (decode slot occupancy is its OWN histogram,
+        # serve/<model>/decode/slot_occupancy, never mixed in here)
         self._fill = observe.histogram("serve/batch_fill",
                                        BATCH_FILL_BOUNDS)
+        self._fill_model = observe.histogram(f"serve/{name}/batch_fill",
+                                             BATCH_FILL_BOUNDS)
         self._depth = observe.gauge("serve/queue_depth")
         if start:
             self.start()
@@ -262,6 +270,7 @@ class ContinuousBatcher:
             return
         observe.counter("serve/batches").inc()
         self._fill.record(rows / bucket)
+        self._fill_model.record(rows / bucket)
         now = self._clock()
         i = 0
         for req in group:

@@ -1,0 +1,728 @@
+"""Iteration-level continuous batching for autoregressive decode.
+
+PR 8's `ContinuousBatcher` packs *whole stateless requests* — for an
+autoregressive LM that recomputes the entire prefix every token and
+holds the batch fixed until the slowest sequence finishes (head-of-line
+blocking). This module is the decode-native path (Orca-style
+iteration-level scheduling + vLLM-style slot KV management, scaled to
+this codebase's discipline):
+
+  * **KV-slot bucket** — per-layer `(S, L, H, hd)` cache arrays
+    (`model.make_slot_caches`), allocated ONCE per model and donated
+    across steps (TPU: the step writes in place; CPU: donation is a
+    no-op). Each of the S slots is an independent sequence at its own
+    absolute offset.
+  * **fused decode step** — ONE AOT-precompiled program
+    `(params, caches, tokens_last, positions, active) ->
+    (next_tokens, caches)` over the ragged active set: the valid-mask
+    trick along both the slot axis (inactive rows' caches are restored
+    bit-identically — pad-poison can never leak, PR 5/8) and the
+    sequence axis (entries past a row's frontier are masked to NEG_INF
+    pre-softmax, so stale cache content contributes exactly zero).
+  * **chunked prefill** — prompts stream into their slot's cache
+    through power-of-two length-bucketed AOT prefill programs
+    (`BIGDL_TPU_SERVE_PREFILL_CHUNK` caps the chunk), so a long prompt
+    stalls concurrent decode for at most one chunk and the program
+    count stays O(log chunk).
+  * **iteration-level scheduler** — clock-injectable (the batcher.py
+    fake-clock testing discipline): every decode step first admits
+    queued requests into free slots (prefill), then runs one fused step
+    over whatever is active; finished sequences (EOS or
+    max_new_tokens) retire IMMEDIATELY and free their slot. O(L) per
+    token per sequence instead of O(L²), no head-of-line blocking.
+
+The model contract is duck-typed: `make_slot_caches(params, S, L)`,
+`prefill(params, caches, tokens, positions, active)`,
+`decode_step(params, caches, tokens_last, positions, active)`,
+plus `vocab_size` and (default) `eos_id` — provided by the HF bridge's
+GPT2LM and LlamaLM (interop/huggingface.py).
+
+Decode greedy semantics mirror `model.generate(kv_cache=True,
+beam_size=1)` exactly: prefill the first P-1 prompt tokens, feed the
+last prompt token as the first decode input, argmax per step, stop at
+EOS — concurrent decode with staggered joins/leaves is BIT-IDENTICAL
+to each sequence run alone (tests/test_decode.py parity oracle).
+
+Observability: `serve/<model>/decode/{tokens_per_s, slot_occupancy,
+prefill_ms, step_ms, queue_wait_ms, latency_ms, ttft_ms}` + counters,
+a `decode` section in /statusz, per-peer decode rows in /fleetz, and
+the ServeWatchdog pointed at decode latency p99 with
+queue-vs-prefill-vs-step attribution (observe/doctor.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue as _queue
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu import observe
+from bigdl_tpu.serve.batcher import (BATCH_FILL_BOUNDS, LATENCY_MS_BOUNDS,
+                                     Closed, Overloaded)
+from bigdl_tpu.utils.threads import make_condition, spawn
+
+log = logging.getLogger("bigdl_tpu")
+
+_DECODE_CONTRACT = ("make_slot_caches", "prefill", "decode_step")
+
+
+def prefill_buckets(chunk: int) -> Tuple[int, ...]:
+    """Power-of-two prompt-chunk ladder: 1, 2, 4, ... up to `chunk` —
+    O(log chunk) prefill programs total."""
+    if chunk < 1:
+        raise ValueError(f"prefill chunk must be >= 1, got {chunk}")
+    out: List[int] = []
+    b = 1
+    while b < chunk:
+        out.append(b)
+        b *= 2
+    out.append(chunk)
+    return tuple(sorted(set(out)))
+
+
+class DecodeEntry:
+    """One decode-served model: the (num_slots, max_seq_len) KV-slot
+    bucket, AOT prefill + decode executables (mesh shardings pinned),
+    and the placed params the programs close over.
+
+    Built by `ModelEntry` under `decode=True` registration
+    (serve/registry.py); the scheduler (`DecodeScheduler`) drives it."""
+
+    def __init__(self, name: str, model, params, *, mesh=None,
+                 num_slots: Optional[int] = None,
+                 max_seq_len: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 eos_id: Optional[int] = None):
+        from bigdl_tpu.utils import config
+        missing = [m for m in _DECODE_CONTRACT if not hasattr(model, m)]
+        if missing:
+            raise TypeError(
+                f"decode=True needs a model implementing the slot-decode "
+                f"contract {_DECODE_CONTRACT}; {type(model).__name__} "
+                f"lacks {missing} (GPT2LM/LlamaLM from "
+                f"interop/huggingface.py provide it)")
+        self.name = name
+        self.model = model
+        self.params = params
+        self.mesh = mesh
+        self.num_slots = int(num_slots if num_slots is not None
+                             else config.get("SERVE_DECODE_SLOTS"))
+        self.max_seq_len = int(max_seq_len if max_seq_len is not None
+                               else config.get("SERVE_MAX_SEQ_LEN"))
+        self.prefill_chunk = int(
+            prefill_chunk if prefill_chunk is not None
+            else config.get("SERVE_PREFILL_CHUNK"))
+        if self.num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got "
+                             f"{self.num_slots}")
+        n_pos = getattr(model, "n_positions", None)
+        if n_pos is not None and self.max_seq_len > n_pos:
+            raise ValueError(
+                f"max_seq_len {self.max_seq_len} > the model's "
+                f"n_positions {n_pos} (slot caches cannot outrun the "
+                f"position table)")
+        self.prefill_chunk = min(self.prefill_chunk, self.max_seq_len)
+        self.buckets = prefill_buckets(self.prefill_chunk)
+        self.eos_id = (eos_id if eos_id is not None
+                       else getattr(model, "eos_id", None))
+        if self.eos_id is None:
+            raise ValueError(
+                f"decode model {name!r} carries no eos_id — pass "
+                f"eos_id= at registration")
+        self.vocab_size = int(model.vocab_size)
+        self._jit_decode = None
+        self._jit_prefill = None
+        self._aot_decode = None
+        self._aot_prefill: Dict[int, object] = {}
+        self._placed = None          # (params, caches) device-resident
+        self._shardings = None
+        self._build()
+
+    # ------------------------------------------------------------- build
+    def _build(self):
+        import jax
+        model = self.model
+        donate = (jax.default_backend() != "cpu")
+        kw = {"donate_argnums": (1,)} if donate else {}
+        sh_in = None
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            rep = NamedSharding(self.mesh, P())
+            # the cache pytree's shardings are pinned REPLICATED: decode
+            # steps are tiny and latency-bound, so the mesh buys program
+            # portability (one registration path for meshed servers),
+            # not FLOPs — a slot-sharded layout is a later optimization
+            sh_in = rep
+            kw["in_shardings"] = rep
+            kw["out_shardings"] = rep
+        self._rep_sharding = sh_in
+        self._jit_decode = jax.jit(
+            lambda p, c, t, pos, a: model.decode_step(p, c, t, pos, a),
+            **kw)
+        self._jit_prefill = jax.jit(
+            lambda p, c, t, pos, a: model.prefill(p, c, t, pos, a), **kw)
+
+    def _place(self, a):
+        import jax
+        if self._rep_sharding is None:
+            return jax.numpy.asarray(a)
+        return jax.device_put(np.asarray(a), self._rep_sharding)
+
+    def placed_params(self):
+        if self._placed is None:
+            import jax
+            self._placed = jax.tree.map(self._place, self.params)
+        return self._placed
+
+    def make_caches(self):
+        """The persistent slot-bucket cache pytree (zeros, placed)."""
+        caches = self.model.make_slot_caches(
+            self.params, self.num_slots, self.max_seq_len)
+        if self._rep_sharding is not None:
+            import jax
+            caches = jax.tree.map(
+                lambda a: jax.device_put(a, self._rep_sharding), caches)
+        return caches
+
+    # --------------------------------------------------------------- AOT
+    def precompile(self) -> Dict[str, Dict]:
+        """AOT-compile the fused decode step plus every prefill-chunk
+        bucket before traffic (compilecache.precompile_fixed) — with the
+        persistent compile cache warm, a restarted decode server
+        compiles ZERO fresh programs (counter-asserted in
+        tests/test_decode.py). Cost analyses land under
+        `compile/serve/<model>/decode/...`."""
+        import jax
+        from bigdl_tpu.compilecache import precompile_fixed
+
+        def spec(shape, dtype):
+            kw = ({"sharding": self._rep_sharding}
+                  if self._rep_sharding is not None else {})
+            return jax.ShapeDtypeStruct(shape, dtype, **kw)
+
+        p_s = jax.tree.map(lambda a: spec(tuple(a.shape), a.dtype),
+                           self.params)
+        c_s = jax.tree.map(lambda a: spec(tuple(a.shape), a.dtype),
+                           self.model.make_slot_caches(
+                               self.params, self.num_slots,
+                               self.max_seq_len))
+        S = self.num_slots
+        i32 = np.dtype(np.int32)
+        vec = spec((S,), i32)
+        act = spec((S,), np.dtype(np.bool_))
+        results: Dict[str, Dict] = {}
+        cost, self._aot_decode = precompile_fixed(
+            self._jit_decode, (p_s, c_s, vec, vec, act),
+            name=f"serve/{self.name}/decode/step")
+        results["decode_step"] = cost
+        for b in self.buckets:
+            chunk = spec((S, b), i32)
+            cost, exe = precompile_fixed(
+                self._jit_prefill, (p_s, c_s, chunk, chunk, act),
+                name=f"serve/{self.name}/decode/prefill{b}")
+            self._aot_prefill[b] = exe
+            results[f"prefill{b}"] = cost
+        return results
+
+    # ------------------------------------------------------------ device
+    def run_prefill(self, caches, tokens: np.ndarray,
+                    positions: np.ndarray, active: np.ndarray):
+        """One chunk-prefill program call; returns the new caches (the
+        input cache buffers are donated on TPU)."""
+        C = tokens.shape[1]
+        args = (self.placed_params(), caches, self._place(tokens),
+                self._place(positions), self._place(active))
+        exe = self._aot_prefill.get(C)
+        if exe is not None:
+            try:
+                return exe(*args)
+            except Exception:  # noqa: BLE001 — one-shot fallback
+                log.warning("serve[%s]: decode prefill%d AOT executable "
+                            "rejected live inputs; falling back to jit",
+                            self.name, C)
+                self._aot_prefill.pop(C, None)
+        return self._jit_prefill(*args)
+
+    def run_decode(self, caches, tokens_last: np.ndarray,
+                   positions: np.ndarray, active: np.ndarray):
+        """One fused decode step; returns (next_tokens device array,
+        new caches). The caller fetches next_tokens (the iteration's
+        single host sync)."""
+        args = (self.placed_params(), caches, self._place(tokens_last),
+                self._place(positions), self._place(active))
+        if self._aot_decode is not None:
+            try:
+                return self._aot_decode(*args)
+            except Exception:  # noqa: BLE001 — one-shot fallback
+                log.warning("serve[%s]: decode-step AOT executable "
+                            "rejected live inputs; falling back to jit",
+                            self.name)
+                self._aot_decode = None
+        return self._jit_decode(*args)
+
+
+class GenReply:
+    """Streaming-capable handle for one generate request.
+
+    `result(timeout)` blocks for the full generation (np.int32 array of
+    generated tokens, EOS included when emitted); `stream(timeout)`
+    yields token ids AS THEY DECODE — tokens are pushed at every
+    iteration-level step, so a consumer sees the first token at
+    time-to-first-token, not at completion."""
+
+    _SENTINEL = object()
+
+    def __init__(self):
+        self._tokens: _queue.Queue = _queue.Queue()
+        self._done = threading.Event()
+        self._result: Optional[np.ndarray] = None
+        self._exc: Optional[BaseException] = None
+
+    # -------------------------------------------------- producer side
+    def _push(self, token: int) -> None:
+        self._tokens.put(int(token))
+
+    def _finish(self, tokens: List[int]) -> None:
+        self._result = np.asarray(tokens, np.int32)
+        self._tokens.put(self._SENTINEL)
+        self._done.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._tokens.put(self._SENTINEL)
+        self._done.set()
+
+    # -------------------------------------------------- consumer side
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._done.wait(timeout):
+            raise TimeoutError("generate request still decoding")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    def stream(self, timeout: Optional[float] = None):
+        """Iterate generated token ids as they arrive; raises the
+        request's failure (if any) after the stream drains."""
+        while True:
+            tok = self._tokens.get(timeout=timeout)
+            if tok is self._SENTINEL:
+                break
+            yield tok
+        if self._exc is not None:
+            raise self._exc
+
+
+class _GenRequest:
+    __slots__ = ("prompt", "max_new", "eos_id", "reply", "t_submit",
+                 "t_admit", "t_first", "fed", "generated", "slot")
+
+    def __init__(self, prompt: np.ndarray, max_new: int, eos_id: int,
+                 t_submit: float):
+        self.prompt = prompt
+        self.max_new = int(max_new)
+        self.eos_id = int(eos_id)
+        self.reply = GenReply()
+        self.t_submit = t_submit
+        self.t_admit: Optional[float] = None
+        self.t_first: Optional[float] = None
+        self.fed = 0                       # prompt tokens prefilled so far
+        self.generated: List[int] = []
+        self.slot: Optional[int] = None
+
+    @property
+    def prefill_target(self) -> int:
+        # mirror generate(kv_cache=True): prefill P-1 tokens, the last
+        # prompt token is the first decode input
+        return self.prompt.shape[0] - 1
+
+    def next_input(self) -> Tuple[int, int]:
+        """(token, position) the next decode step consumes."""
+        n = len(self.generated)
+        if n == 0:
+            return int(self.prompt[-1]), self.prompt.shape[0] - 1
+        return self.generated[-1], self.prompt.shape[0] - 1 + n
+
+
+class DecodeScheduler:
+    """One decode model's request queue + iteration-level scheduler.
+
+    Every iteration (`step_once`, the clock-injectable synchronous core
+    the thread loop composes — batcher.py's testing discipline):
+
+      1. **admit**: pop queued requests into free slots (any number, any
+         step — requests join the running batch mid-flight);
+      2. **prefill**: slots still streaming their prompt advance by one
+         length-bucketed chunk (grouped by bucket so one program call
+         serves every slot on the same chunk size);
+      3. **decode**: one fused step over all prompt-complete slots;
+         EOS/max_new retirements complete their reply and free the slot
+         IMMEDIATELY — the next iteration admits into it.
+
+    Admission control: `submit` sheds with the typed `Overloaded` past
+    `max_queue` waiting requests (the batcher's door discipline), and
+    validates prompt + max_new against the slot cache length up front.
+    """
+
+    def __init__(self, entry: DecodeEntry, *,
+                 max_queue: int = 256,
+                 name: Optional[str] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 start: bool = True):
+        from bigdl_tpu.analysis import sancov
+        self.entry = entry
+        self.name = name or entry.name
+        self.max_queue = int(max_queue)
+        self._clock = clock
+        self._cv = make_condition(f"serve.decode.cv.{self.name}")
+        sancov.register_shared(f"serve.decode.queue.{self.name}",
+                               self._cv)
+        self._queue: List[_GenRequest] = []
+        self._slots: List[Optional[_GenRequest]] = \
+            [None] * entry.num_slots
+        self._caches = entry.make_caches()
+        self._closed = False
+        self._draining = False
+        self._thread: Optional[threading.Thread] = None
+        self._stop_check: Optional[Callable[[], bool]] = None
+        # --------------------------------------------------- telemetry
+        n = self.name
+        self._m_tokens = observe.counter(f"serve/{n}/decode/tokens")
+        self._m_requests = observe.counter(f"serve/{n}/decode/requests")
+        self._m_retired = observe.counter(f"serve/{n}/decode/retired")
+        self._m_steps = observe.counter(f"serve/{n}/decode/steps")
+        self._m_tps = observe.gauge(f"serve/{n}/decode/tokens_per_s")
+        self._m_active = observe.gauge(f"serve/{n}/decode/active_slots")
+        self._m_queued = observe.gauge(f"serve/{n}/decode/queued")
+        self._h_occ = observe.histogram(
+            f"serve/{n}/decode/slot_occupancy", BATCH_FILL_BOUNDS)
+        self._h_prefill = observe.histogram(
+            f"serve/{n}/decode/prefill_ms", LATENCY_MS_BOUNDS)
+        self._h_step = observe.histogram(
+            f"serve/{n}/decode/step_ms", LATENCY_MS_BOUNDS)
+        self._h_qw = observe.histogram(
+            f"serve/{n}/decode/queue_wait_ms", LATENCY_MS_BOUNDS)
+        self._h_lat = observe.histogram(
+            f"serve/{n}/decode/latency_ms", LATENCY_MS_BOUNDS)
+        self._h_ttft = observe.histogram(
+            f"serve/{n}/decode/ttft_ms", LATENCY_MS_BOUNDS)
+        self._win_t0 = self._clock()
+        self._win_tokens = 0
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------ admission
+    def submit(self, prompt_ids, max_new_tokens: int,
+               eos_id: Optional[int] = None) -> GenReply:
+        """Queue one generate request; returns its `GenReply`. Raises
+        ValueError (bad prompt / budget over the slot cache length),
+        `Overloaded` (queue at bound), or `Closed` (shut down)."""
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("generate request needs a non-empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = prompt.size - 1 + int(max_new_tokens)
+        if total > self.entry.max_seq_len:
+            raise ValueError(
+                f"prompt({prompt.size}) - 1 + max_new({max_new_tokens}) "
+                f"= {total} exceeds the slot cache length "
+                f"{self.entry.max_seq_len} (BIGDL_TPU_SERVE_MAX_SEQ_LEN"
+                f" / register(max_seq_len=...))")
+        eos = self.entry.eos_id if eos_id is None else int(eos_id)
+        req = _GenRequest(prompt, max_new_tokens, eos, self._clock())
+        with self._cv:
+            if self._closed or self._draining:
+                raise Closed(f"decode scheduler {self.name!r} is shut "
+                             f"down")
+            if len(self._queue) >= self.max_queue:
+                observe.counter("serve/shed").inc()
+                observe.instant("serve/shed", cat="serve",
+                                args={"model": self.name,
+                                      "decode": True})
+                raise Overloaded(
+                    f"decode queue for {self.name!r} at bound "
+                    f"({self.max_queue} requests waiting)")
+            self._queue.append(req)
+            self._m_requests.inc()
+            self._m_queued.set(len(self._queue))
+            self._cv.notify()
+        return req.reply
+
+    @property
+    def active_slots(self) -> int:
+        return sum(1 for r in self._slots if r is not None)
+
+    @property
+    def queued(self) -> int:
+        return len(self._queue)
+
+    # --------------------------------------------------- iteration core
+    def _admit(self) -> int:
+        """Move queued requests into free slots (holding the lock)."""
+        admitted = 0
+        with self._cv:
+            for s, occ in enumerate(self._slots):
+                if occ is not None or not self._queue:
+                    continue
+                req = self._queue.pop(0)
+                req.slot = s
+                req.t_admit = self._clock()
+                self._h_qw.record(
+                    max(0.0, (req.t_admit - req.t_submit) * 1e3))
+                self._slots[s] = req
+                admitted += 1
+            self._m_queued.set(len(self._queue))
+        return admitted
+
+    def _chunk_for(self, req: _GenRequest) -> int:
+        """The prefill bucket this request's next chunk uses: smallest
+        bucket covering the remaining prompt (capped by the chunk knob),
+        shrunk so the padded write never runs past the slot cache."""
+        remaining = req.prefill_target - req.fed
+        want = min(remaining, self.entry.prefill_chunk)
+        room = self.entry.max_seq_len - req.fed
+        c = self.entry.buckets[0]
+        for b in self.entry.buckets:
+            if b <= room:
+                c = b
+            if b >= want and b <= room:
+                return b
+        return c
+
+    def _prefill_pass(self) -> int:
+        """Advance every prompt-streaming slot by one chunk, grouped by
+        bucket size (one program call per distinct bucket)."""
+        pending = [r for r in self._slots
+                   if r is not None and r.fed < r.prefill_target]
+        if not pending:
+            return 0
+        by_bucket: Dict[int, List[_GenRequest]] = {}
+        for req in pending:
+            by_bucket.setdefault(self._chunk_for(req), []).append(req)
+        S = self.entry.num_slots
+        done = 0
+        for C, reqs in sorted(by_bucket.items()):
+            tokens = np.zeros((S, C), np.int32)
+            positions = np.zeros((S, C), np.int32)
+            active = np.zeros((S,), bool)
+            for req in reqs:
+                n = min(req.prefill_target - req.fed, C)
+                tokens[req.slot, :n] = req.prompt[req.fed:req.fed + n]
+                positions[req.slot] = req.fed + np.arange(C)
+                active[req.slot] = True
+            t0 = self._clock()
+            with observe.span("serve/decode/prefill", cat="serve",
+                              args={"model": self.name, "chunk": C,
+                                    "slots": len(reqs)}):
+                self._caches = self.entry.run_prefill(
+                    self._caches, tokens, positions, active)
+            self._h_prefill.record(
+                max(0.0, (self._clock() - t0) * 1e3))
+            for req in reqs:
+                req.fed += min(req.prefill_target - req.fed, C)
+                done += 1
+        return done
+
+    def _decode_pass(self) -> int:
+        """One fused decode step over every prompt-complete slot; retire
+        finished sequences and free their slots."""
+        ready = [r for r in self._slots
+                 if r is not None and r.fed >= r.prefill_target]
+        if not ready:
+            return 0
+        S = self.entry.num_slots
+        tokens = np.zeros((S,), np.int32)
+        positions = np.zeros((S,), np.int32)
+        active = np.zeros((S,), bool)
+        for req in ready:
+            tok, pos = req.next_input()
+            tokens[req.slot] = tok
+            positions[req.slot] = pos
+            active[req.slot] = True
+        t0 = self._clock()
+        with observe.span("serve/decode/step", cat="serve",
+                          args={"model": self.name,
+                                "active": len(ready)}):
+            nxt, self._caches = self.entry.run_decode(
+                self._caches, tokens, positions, active)
+            from bigdl_tpu.analysis.sancov import sanctioned_sync
+            import jax
+            with sanctioned_sync("decode next-token fetch"):
+                nxt = np.asarray(jax.device_get(nxt))
+        now = self._clock()
+        self._h_step.record(max(0.0, (now - t0) * 1e3))
+        self._h_occ.record(len(ready) / S)
+        self._m_steps.inc()
+        self._m_tokens.inc(len(ready))
+        self._win_tokens += len(ready)
+        if now - self._win_t0 >= 0.5:
+            self._m_tps.set(self._win_tokens / (now - self._win_t0))
+            self._win_t0, self._win_tokens = now, 0
+        for req in ready:
+            tok = int(nxt[req.slot])
+            req.generated.append(tok)
+            req.reply._push(tok)
+            if req.t_first is None:
+                req.t_first = now
+                self._h_ttft.record(
+                    max(0.0, (now - req.t_submit) * 1e3))
+            if tok == req.eos_id or len(req.generated) >= req.max_new:
+                self._retire(req, now)
+        self._m_active.set(self.active_slots)
+        return len(ready)
+
+    def _retire(self, req: _GenRequest, now: float) -> None:
+        self._slots[req.slot] = None
+        self._m_retired.inc()
+        self._h_lat.record(max(0.0, (now - req.t_submit) * 1e3))
+        observe.instant("serve/decode/retire", cat="serve",
+                        args={"model": self.name,
+                              "tokens": len(req.generated)})
+        req.reply._finish(req.generated)
+
+    def step_once(self) -> bool:
+        """One scheduler iteration: admit → prefill → decode. Returns
+        True when any work happened (the thread loop sleeps otherwise);
+        tests drive this synchronously with a fake clock."""
+        worked = self._admit() > 0
+        worked = self._prefill_pass() > 0 or worked
+        worked = self._decode_pass() > 0 or worked
+        return worked
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self, stop_check: Optional[Callable[[], bool]] = None
+              ) -> "DecodeScheduler":
+        """Launch the scheduler thread (`stop_check` = the engine's
+        SIGTERM drain probe, as in ContinuousBatcher.start)."""
+        if self._thread is not None:
+            return self
+        self._stop_check = stop_check
+        self._thread = spawn(self._loop, name=f"serve-decode-{self.name}")
+        return self
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                if self._stop_check is not None and not self._draining \
+                        and not self._closed and self._stop_check():
+                    log.warning("serve[%s]: stop requested — draining "
+                                "%d queued + %d active generates",
+                                self.name, len(self._queue),
+                                self.active_slots)
+                    observe.instant("serve/drain", cat="serve",
+                                    args={"model": self.name,
+                                          "decode": True})
+                    self._draining = True
+                idle = (not self._queue and self.active_slots == 0)
+                if idle:
+                    if self._closed or self._draining:
+                        self._closed = True
+                        return
+                    self._cv.wait(timeout=0.05)
+                    continue
+            self.step_once()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Stop admission and wait for every queued + active generate
+        to complete. Returns False on timeout."""
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while True:
+            with self._cv:
+                if not self._queue and self.active_slots == 0:
+                    return True
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(0.002)
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = 30.0) -> None:
+        """Shut down; `drain=False` fails every incomplete reply with
+        `Closed` — no reply is ever left pending."""
+        if drain:
+            self.drain(timeout=timeout)
+        with self._cv:
+            self._draining = True
+            self._closed = True
+            dropped = list(self._queue)
+            self._queue.clear()
+            dropped += [r for r in self._slots if r is not None]
+            self._slots = [None] * self.entry.num_slots
+            self._m_queued.set(0)
+            self._m_active.set(0)
+            self._cv.notify_all()
+        for req in dropped:
+            if not req.reply.done():
+                req.reply._fail(Closed(
+                    f"decode scheduler {self.name!r} closed before "
+                    f"completion"))
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)
+        self._thread = None
+
+    # ------------------------------------------------------------- stats
+    def stats(self) -> Dict:
+        """The per-model decode SLO view (engine.stats()[model]
+        ['decode'], mirrored into /statusz and /fleetz)."""
+        reg = observe.registry()
+        n = self.name
+        lat = reg.histogram(f"serve/{n}/decode/latency_ms",
+                            LATENCY_MS_BOUNDS)
+        ttft = reg.histogram(f"serve/{n}/decode/ttft_ms",
+                             LATENCY_MS_BOUNDS)
+        step = reg.histogram(f"serve/{n}/decode/step_ms",
+                             LATENCY_MS_BOUNDS)
+        occ = reg.histogram(f"serve/{n}/decode/slot_occupancy",
+                            BATCH_FILL_BOUNDS)
+        qw = reg.histogram(f"serve/{n}/decode/queue_wait_ms",
+                           LATENCY_MS_BOUNDS)
+        rate = float(self._m_tps.value or 0.0)
+        if not rate and self._win_tokens:
+            # short-lived schedulers never close a 0.5 s rate window —
+            # report the live partial-window estimate instead of 0
+            rate = self._win_tokens / max(self._clock() - self._win_t0,
+                                          1e-9)
+        return {
+            "slots": self.entry.num_slots,
+            "max_seq_len": self.entry.max_seq_len,
+            "active_slots": self.active_slots,
+            "queued": self.queued,
+            "requests": int(self._m_requests.value),
+            "retired": int(self._m_retired.value),
+            "tokens": int(self._m_tokens.value),
+            "tokens_per_s": round(rate, 2),
+            "slot_occupancy_mean": round(occ.sum / occ.count, 4)
+            if occ.count else 0.0,
+            "ttft_p50_ms": round(ttft.quantile(0.50), 3),
+            "ttft_p99_ms": round(ttft.quantile(0.99), 3),
+            "step_p50_ms": round(step.quantile(0.50), 3),
+            "step_p99_ms": round(step.quantile(0.99), 3),
+            "p99_ms": round(lat.quantile(0.99), 3),
+            "queue_wait_p99_ms": round(qw.quantile(0.99), 3),
+        }
+
+
+def decode_demo_model(vocab_size: int = 64, n_positions: int = 256,
+                      d_model: int = 32, num_heads: int = 4,
+                      num_layers: int = 2, eos_id: int = 1, seed: int = 0):
+    """Tiny randomly-initialized GPT2LM + params — the default model the
+    `python -m bigdl_tpu.serve --decode` CLI stands up when no factory
+    is given (smoke tests, demos)."""
+    import jax
+    from bigdl_tpu.interop.huggingface import GPT2LM
+    model = GPT2LM(vocab_size, n_positions, d_model, num_heads,
+                   num_layers, eos_id=eos_id)
+    params, state = model.init(
+        jax.random.PRNGKey(seed))  # tpu-lint: disable=004
+    return model, params, state

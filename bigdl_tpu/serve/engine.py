@@ -36,12 +36,13 @@ import numpy as np
 
 from bigdl_tpu import observe
 from bigdl_tpu.serve.batcher import Closed, ContinuousBatcher, Overloaded
+from bigdl_tpu.serve.decode import DecodeScheduler, GenReply
 from bigdl_tpu.serve.registry import ModelEntry, ModelRegistry
 from bigdl_tpu.utils.threads import make_lock
 
 log = logging.getLogger("bigdl_tpu")
 
-__all__ = ["ServeEngine", "Reply", "Overloaded", "Closed"]
+__all__ = ["ServeEngine", "Reply", "GenReply", "Overloaded", "Closed"]
 
 
 class Reply:
@@ -82,6 +83,7 @@ class ServeEngine:
         _doctor.arm_serve_watchdog()
         self.registry = ModelRegistry()
         self._batchers: Dict[str, ContinuousBatcher] = {}
+        self._decoders: Dict[str, DecodeScheduler] = {}
         self._lock = make_lock("serve.engine")
         self._closed = False
         self._defaults = {
@@ -103,20 +105,50 @@ class ServeEngine:
                  max_queue_rows: Optional[int] = None,
                  int8: Optional[bool] = None,
                  coalesce: bool = True,
-                 precompile_input=None) -> ModelEntry:
+                 precompile_input=None,
+                 decode: bool = False,
+                 num_slots: Optional[int] = None,
+                 max_seq_len: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 eos_id: Optional[int] = None,
+                 max_queue: int = 256,
+                 precompile_decode: bool = True) -> ModelEntry:
         """Register a model and start its scheduler. `precompile_input`
-        = (feature_shape, dtype) AOT-compiles every bucket up front."""
+        = (feature_shape, dtype) AOT-compiles every bucket up front.
+
+        `decode=True` registers the iteration-level autoregressive path
+        instead (serve/decode.py): the model must carry the slot-decode
+        contract (GPT2LM/LlamaLM), requests enter through
+        `submit_generate`, and `precompile_decode` (default on)
+        AOT-compiles the fused step + every prefill bucket so warm
+        serving compiles zero fresh programs. num_slots / max_seq_len /
+        prefill_chunk default to the BIGDL_TPU_SERVE_DECODE_* knobs."""
         if self._closed:
             raise Closed("engine is shut down")
         d = self._defaults
         entry = self.registry.register(
             name, model, params, state, mesh=mesh,
             max_batch=max_batch if max_batch is not None
-            else d["max_batch"], int8=int8)
+            else d["max_batch"], int8=int8, decode=decode,
+            num_slots=num_slots, max_seq_len=max_seq_len,
+            prefill_chunk=prefill_chunk, eos_id=eos_id)
+        from bigdl_tpu.resilience import faults
+        if decode:
+            if precompile_decode:
+                entry.precompile_decode()
+            sched = DecodeScheduler(entry.decode, name=name,
+                                    max_queue=max_queue, start=False)
+            sched.start(stop_check=faults.preempt_requested)
+            with self._lock:
+                self._decoders[name] = sched
+            log.info("serve: decode model %r registered (slots=%d, "
+                     "max_seq_len=%d, prefill buckets %s)", name,
+                     entry.decode.num_slots, entry.decode.max_seq_len,
+                     entry.decode.buckets)
+            return entry
         if precompile_input is not None:
             shape, dtype = precompile_input
             entry.precompile_for(tuple(shape), dtype)
-        from bigdl_tpu.resilience import faults
         batcher = ContinuousBatcher(
             entry.dispatch, entry.buckets, name=name, coalesce=coalesce,
             max_wait_ms=max_wait_ms if max_wait_ms is not None
@@ -134,8 +166,11 @@ class ServeEngine:
     def unregister(self, name: str, drain: bool = True) -> None:
         with self._lock:
             batcher = self._batchers.pop(name, None)
+            decoder = self._decoders.pop(name, None)
         if batcher is not None:
             batcher.close(drain=drain)
+        if decoder is not None:
+            decoder.close(drain=drain)
         self.registry.unregister(name)
 
     def models(self) -> List[str]:
@@ -184,17 +219,47 @@ class ServeEngine:
         """Synchronous request: submit + wait + reassemble."""
         return self.submit(name, x).result(timeout)
 
+    # ----------------------------------------------- autoregressive decode
+    def submit_generate(self, name: str, prompt_ids,
+                        max_new_tokens: int,
+                        eos_id: Optional[int] = None) -> GenReply:
+        """Queue one generate request against a `decode=True` model;
+        returns a streaming-capable `GenReply` (`.result()` blocks for
+        the full generation, `.stream()` yields token ids as they
+        decode). Raises KeyError (not a decode model), ValueError
+        (empty prompt / budget over the slot cache length),
+        `Overloaded`, or `Closed`."""
+        with self._lock:
+            sched = self._decoders.get(name)
+        if sched is None:
+            raise KeyError(
+                f"no decode model {name!r} registered (register with "
+                f"decode=True; have: "
+                f"{sorted(self._decoders) or 'none'})")
+        return sched.submit(prompt_ids, max_new_tokens, eos_id=eos_id)
+
+    def generate(self, name: str, prompt_ids, max_new_tokens: int,
+                 eos_id: Optional[int] = None,
+                 timeout: Optional[float] = None) -> np.ndarray:
+        """Synchronous generate: submit + wait; returns the generated
+        token ids (np.int32, EOS included when emitted)."""
+        return self.submit_generate(
+            name, prompt_ids, max_new_tokens,
+            eos_id=eos_id).result(timeout)
+
     # ---------------------------------------------------------------- SLO
     def stats(self) -> Dict[str, Dict]:
         """Per-model SLO snapshot: p50/p99 latency (ms), request/batch
         counts, mean batch fill, queued rows — read from the observe
         registry (the same numbers the exporters flush)."""
-        from bigdl_tpu.serve.batcher import LATENCY_MS_BOUNDS
+        from bigdl_tpu.serve.batcher import (BATCH_FILL_BOUNDS,
+                                             LATENCY_MS_BOUNDS)
         reg = observe.registry()
         out: Dict[str, Dict] = {}
         fill = reg.histogram("serve/batch_fill")
         with self._lock:
             batchers = dict(self._batchers)
+            decoders = dict(self._decoders)
         for name, b in batchers.items():
             lat = reg.histogram(f"serve/{name}/latency_ms",
                                 LATENCY_MS_BOUNDS)
@@ -202,6 +267,8 @@ class ServeEngine:
                                LATENCY_MS_BOUNDS)
             disp = reg.histogram(f"serve/{name}/dispatch_ms",
                                  LATENCY_MS_BOUNDS)
+            mfill = reg.histogram(f"serve/{name}/batch_fill",
+                                  BATCH_FILL_BOUNDS)
             out[name] = {
                 "requests": lat.count,
                 "p50_ms": round(lat.quantile(0.50), 3),
@@ -211,9 +278,16 @@ class ServeEngine:
                 "queue_wait_p99_ms": round(qw.quantile(0.99), 3),
                 "dispatch_mean_ms": round(
                     disp.sum / disp.count, 3) if disp.count else 0.0,
+                # per-model bucket fill: the global serve/batch_fill
+                # would misreport once a decode model shares the
+                # process (decode slot occupancy is its own histogram)
+                "mean_batch_fill": round(mfill.sum / mfill.count, 4)
+                if mfill.count else 0.0,
                 "queued_rows": b.queued_rows,
                 "buckets": list(b.buckets),
             }
+        for name, sched in decoders.items():
+            out.setdefault(name, {})["decode"] = sched.stats()
         out["_totals"] = {
             "requests": reg.counter("serve/requests").value,
             "rows": reg.counter("serve/rows").value,
@@ -235,12 +309,18 @@ class ServeEngine:
                 return
             self._closed = True
             batchers = dict(self._batchers)
+            decoders = dict(self._decoders)
         for name, b in batchers.items():
             with observe.span("serve/drain", cat="serve",
                               args={"model": name}):
                 b.close(drain=drain, timeout=timeout)
+        for name, sched in decoders.items():
+            with observe.span("serve/drain", cat="serve",
+                              args={"model": name, "decode": True}):
+                sched.close(drain=drain, timeout=timeout)
+        n = len(batchers) + len(decoders)
         log.info("serve: engine shut down (%d model%s drained)",
-                 len(batchers), "s" if len(batchers) != 1 else "")
+                 n, "s" if n != 1 else "")
 
     def __enter__(self) -> "ServeEngine":
         return self

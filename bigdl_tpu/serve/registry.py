@@ -93,13 +93,23 @@ class ModelEntry:
 
     def __init__(self, name: str, model, params, state, *,
                  mesh=None, max_batch: int = 256,
-                 int8: Optional[bool] = None):
+                 int8: Optional[bool] = None,
+                 decode: bool = False,
+                 num_slots: Optional[int] = None,
+                 max_seq_len: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 eos_id: Optional[int] = None):
         from bigdl_tpu.utils import config
         self.name = name
         self.mesh = mesh
         if int8 is None:
             int8 = config.get("SERVE_INT8")
         self.int8 = bool(int8)
+        if self.int8 and decode:
+            raise ValueError(
+                f"serve[{name}]: decode=True is incompatible with the "
+                f"int8 registration path (the quantized module does not "
+                f"carry the slot-decode contract)")
         if self.int8:
             from bigdl_tpu.nn.quantized import quantize
             model, params = quantize(model, params)
@@ -112,6 +122,25 @@ class ModelEntry:
         self._jitted = _serve_forward(model, mesh)
         self._aot: Dict[int, object] = {}
         self._placed_params = None     # mesh: replicate params/state once
+        # decode=True: the iteration-level autoregressive path — KV-slot
+        # bucket + AOT prefill/decode programs (serve/decode.py); the
+        # engine drives it through a DecodeScheduler instead of a
+        # ContinuousBatcher
+        self.decode = None
+        if decode:
+            from bigdl_tpu.serve.decode import DecodeEntry
+            self.decode = DecodeEntry(
+                name, model, params, mesh=mesh, num_slots=num_slots,
+                max_seq_len=max_seq_len, prefill_chunk=prefill_chunk,
+                eos_id=eos_id)
+
+    def precompile_decode(self) -> Dict[str, Dict]:
+        """AOT-compile the decode step + every prefill-chunk bucket
+        (decode registrations only; see DecodeEntry.precompile)."""
+        if self.decode is None:
+            raise ValueError(f"model {self.name!r} was not registered "
+                             f"with decode=True")
+        return self.decode.precompile()
 
     # ------------------------------------------------------------ forward
     def _trees(self):
@@ -190,9 +219,16 @@ class ModelRegistry:
 
     def register(self, name: str, model, params, state, *, mesh=None,
                  max_batch: int = 256,
-                 int8: Optional[bool] = None) -> ModelEntry:
+                 int8: Optional[bool] = None,
+                 decode: bool = False,
+                 num_slots: Optional[int] = None,
+                 max_seq_len: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 eos_id: Optional[int] = None) -> ModelEntry:
         entry = ModelEntry(name, model, params, state, mesh=mesh,
-                           max_batch=max_batch, int8=int8)
+                           max_batch=max_batch, int8=int8, decode=decode,
+                           num_slots=num_slots, max_seq_len=max_seq_len,
+                           prefill_chunk=prefill_chunk, eos_id=eos_id)
         with self._lock:
             if name in self._entries:
                 raise ValueError(f"model {name!r} already registered")

@@ -254,6 +254,26 @@ _register("SERVE_INT8", False, _bool,
           "QuantizedLinear routes through the fused Pallas "
           "kernels/quantized_matmul.py). Per-model override: "
           "ServeEngine.register(int8=...)")
+_register("SERVE_DECODE_SLOTS", 8, int,
+          "Autoregressive decode serving: KV slots per model — the "
+          "number of sequences decoded concurrently by one fused "
+          "iteration-level step. Requests join free slots every decode "
+          "step and retire the moment they finish (serve/decode.py). "
+          "Per-model override: ServeEngine.register(num_slots=...)")
+_register("SERVE_PREFILL_CHUNK", 64, int,
+          "Autoregressive decode serving: largest prompt-prefill chunk "
+          "(tokens). Prompts stream into their slot's KV cache through "
+          "power-of-two length-bucketed AOT prefill programs capped "
+          "here — O(log chunk) programs total, and a long prompt "
+          "cannot stall concurrent decode for more than one chunk "
+          "(serve/decode.py)")
+_register("SERVE_MAX_SEQ_LEN", 1024, int,
+          "Autoregressive decode serving: KV-slot cache length — the "
+          "hard cap on prompt + generated tokens per sequence. The "
+          "per-layer (slots, max_seq_len, heads, head_dim) cache "
+          "arrays are allocated once per model and donated across "
+          "steps (serve/decode.py). Per-model override: "
+          "ServeEngine.register(max_seq_len=...)")
 _register("DATA_SERVICE", True, _bool,
           "Streaming input service (dataset/service.py): trainers feed "
           "through the staged host pipeline — background read-ahead, "

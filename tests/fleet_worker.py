@@ -39,6 +39,23 @@ def main():
     observe.gauge("train/last_flush_unix").set(time.time())
     observe.histogram("phase/train/dispatch").record(0.01 * (idx + 1))
 
+    # a live-looking decode-serving engine: statusz reads stats() from
+    # registered engines, so the merged /fleetz per-model serve table
+    # must carry these decode aggregates (ISSUE 14 satellite)
+    class _DecodeStatsEngine:
+        def stats(self):
+            return {"lm": {"requests": 2 + idx, "p50_ms": 1.0,
+                           "p99_ms": 4.0 + idx, "queued_rows": 0,
+                           "buckets": [1],
+                           "decode": {"slots": 4, "active_slots": idx,
+                                      "tokens": 100 * (idx + 1),
+                                      "tokens_per_s": 50.0 * (idx + 1),
+                                      "slot_occupancy_mean":
+                                          0.25 * (idx + 1)}}}
+
+    engine = _DecodeStatsEngine()       # kept alive: weakly registered
+    statusz.register_engine(engine)
+
     srv = statusz.start(port=port)
     agg = fleet.ensure_started() if idx == 0 else None
     print(json.dumps({"ready": True, "index": idx, "port": srv.port,

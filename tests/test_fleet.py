@@ -100,7 +100,14 @@ def _peer_doc(i, *, step=None, alerts=()):
             "watchdog": {"alert_active": bool(alerts),
                          "alerts": list(alerts)},
             "serve": {"m1": {"requests": 3 + i, "p99_ms": 8.0 + i,
-                             "queued_rows": i}},
+                             "queued_rows": i,
+                             "decode": {
+                                 "tokens": 100 * (i + 1),
+                                 "tokens_per_s": 50.0 * (i + 1),
+                                 "active_slots": i, "slots": 4,
+                                 "slot_occupancy_mean": 0.25 * (i + 1),
+                             }}},
+            "decode": {"m1": {"tokens_per_s": 50.0 * (i + 1)}},
             "failover": {"live_slices": 2 - i, "slice_losses": i},
             "exchange": {"window": 8, "pending_steps": 3 + i,
                          "loss_spread": 0.01 * (i + 1)},
@@ -142,6 +149,14 @@ def test_aggregator_merges_and_marks_stale_not_dropped(clean_plane):
     assert f["alerts_active"] == 1
     assert p["serve"]["m1"]["requests"] == 7
     assert p["serve"]["m1"]["p99_ms_max"] == 9.0
+    # per-model decode aggregates: tokens/s additive, occupancy averaged
+    dec = p["serve"]["m1"]["decode"]
+    assert dec["tokens"] == 300
+    assert dec["tokens_per_s"] == pytest.approx(150.0)
+    assert dec["slots"] == 8 and dec["active_slots"] == 1
+    assert dec["slot_occupancy_mean"] == pytest.approx(0.375)
+    assert dec["peers"] == 2
+    assert p["peers"][1]["decode_tokens_per_s"] == pytest.approx(100.0)
     assert p["failover"]["slice_losses"] == 1
     assert p["failover"]["min_live_slices"] == 1
     assert p["sanitizer"]["reports"] == 1
@@ -289,6 +304,13 @@ def test_two_process_fleet_survives_sigkilled_peer(tmp_path):
         assert [p["step"] for p in doc["peers"]] == [100, 105]
         assert doc["fleet"]["step"]["skew"] == 5
         assert doc["peers"][1]["loss"] == pytest.approx(1.5)
+        # per-model decode aggregates ride the merged serve table
+        dec = doc["serve"]["lm"]["decode"]
+        assert dec["tokens"] == 300
+        assert dec["tokens_per_s"] == pytest.approx(150.0)
+        assert dec["slot_occupancy_mean"] == pytest.approx(0.375)
+        assert doc["peers"][1]["decode_tokens_per_s"] == pytest.approx(
+            100.0)
         _, text = _get(ports[0], "/fleetz/metrics")
         assert 'bigdl_tpu_train_neval{peer="1"} 105.0' in text
         # SIGKILL peer 1 mid-scrape: stale, not a crash
