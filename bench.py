@@ -826,7 +826,9 @@ def _bench_overhead(batch_size=32, window=128, iters=1280, k=8):
               "BIGDL_TPU_METRICS_PROM", "BIGDL_TPU_METRICS_FLUSH_S",
               "BIGDL_TPU_STATUSZ_PORT", "BIGDL_TPU_WATCHDOG_PCT",
               "BIGDL_TPU_FLEET_PEERS", "BIGDL_TPU_FLEET_POLL_S",
-              "BIGDL_TPU_SERVE_WATCHDOG_PCT")
+              "BIGDL_TPU_SERVE_WATCHDOG_PCT",
+              "BIGDL_TPU_MEM_WATCHDOG_PCT", "BIGDL_TPU_MEM_LIMIT_BYTES",
+              "BIGDL_TPU_MEM_LEDGER")
     scrape_counts = []
 
     def run_once(instrumented):
@@ -860,21 +862,35 @@ def _bench_overhead(batch_size=32, window=128, iters=1280, k=8):
             os.environ["BIGDL_TPU_FLEET_POLL_S"] = "1.0"
             os.environ["BIGDL_TPU_SERVE_WATCHDOG_PCT"] = "50"
             obs_doctor.arm_serve_watchdog()
+            # memory plane fully armed (ISSUE 15): the buffer ledger is
+            # on by default; a capacity limit arms the memory-watchdog
+            # poller (1 GiB >> this loop's footprint, so it never
+            # fires), and /memz joins the scrape mix below
+            os.environ["BIGDL_TPU_MEM_WATCHDOG_PCT"] = "85"
+            os.environ["BIGDL_TPU_MEM_LIMIT_BYTES"] = str(1 << 30)
         else:
             os.environ["BIGDL_TPU_WATCHDOG_PCT"] = "0"
+            # the OFF mode disables the buffer ledger too, so the
+            # headline covers the WHOLE memory plane's cost (register
+            # calls become no-op handles)
+            os.environ["BIGDL_TPU_MEM_LEDGER"] = "0"
         obs_doctor.reset_watchdog()       # re-read the knob per mode
+        from bigdl_tpu.observe import memz as _memz_mod
+        _memz_mod.reset()                 # fresh ledger + watchdog per mode
+        if instrumented:
+            assert _memz_mod.arm_memory_watchdog()
         stop_scraper = threading.Event()
 
         def scraper():
-            # a live Prometheus scraper + an operator polling /statusz
-            # AND the merged /fleetz: same ~10 req/s total as the r14
-            # methodology, round-robined so every endpoint (fleet view
-            # included) is exercised under load
+            # a live Prometheus scraper + an operator polling /statusz,
+            # the merged /fleetz AND the /memz memory plane: same
+            # ~10 req/s total as the r14 methodology, round-robined so
+            # every endpoint is exercised under load
             count = 0
-            eps = ("/statusz", "/metrics", "/fleetz")
+            eps = ("/statusz", "/metrics", "/fleetz", "/memz")
             i = 0
             while not stop_scraper.wait(0.2):
-                for ep in (eps[i % 3], eps[(i + 1) % 3]):
+                for ep in (eps[i % 4], eps[(i + 1) % 4]):
                     try:
                         with urllib.request.urlopen(
                                 f"http://127.0.0.1:{port}{ep}",
@@ -1917,10 +1933,14 @@ def child_main():
             "note": "throughput lost with the FULL telemetry plane on "
                     "vs fully off: span tracing + JSONL + Prometheus "
                     "exporters + statusz HTTP server scraped ~5x/s "
-                    "(/statusz + /metrics + merged /fleetz) under load "
-                    "+ step-time watchdog armed + FLEET aggregator "
-                    "polling a second in-process statusz peer every "
-                    "1s + the serve-SLO watchdog poller live; same "
+                    "(/statusz + /metrics + merged /fleetz + the /memz "
+                    "device-memory plane) under load + step-time "
+                    "watchdog armed + FLEET aggregator polling a "
+                    "second in-process statusz peer every 1s + the "
+                    "serve-SLO watchdog poller live + the memory "
+                    "plane fully armed (buffer ledger accounting every "
+                    "trainer tree + staging batch, memory-watchdog "
+                    "poller live against a 1 GiB limit); same "
                     "small-model DistriOptimizer.optimize() K=8 loop "
                     "as the dispatch bench, best post-compile window "
                     "per mode, modes alternated. Scrapes read "

@@ -64,12 +64,27 @@ def prefetch_to_device(it: Iterable, size: Optional[int] = None,
         return False
 
     depth = observe.gauge("data/prefetch_depth")
+    # buffer ledger (observe/memz.py): the queued placed batches ARE
+    # device memory the trainer has not consumed yet — tracked as byte
+    # deltas under the shared `data/staging` owner (add on place,
+    # subtract on hand-off/abandonment), so /memz shows the
+    # double-buffer's live footprint and high-water mark
+    from bigdl_tpu.observe import memz as _memz
+    stage = _memz.ledger().tracker(
+        "data/staging", kind="staging",
+        note="double-buffered H2D placement queue")
 
     def worker():
         try:
             for batch in it:
-                if stop.is_set() or not _put(place(batch)):
+                if stop.is_set():
                     return                  # consumer abandoned the epoch
+                placed = place(batch)
+                nb = _memz.tree_nbytes(placed)
+                stage.add_bytes(nb)
+                if not _put((placed, nb)):
+                    stage.add_bytes(-nb)
+                    return
                 # in-flight batches ready for the trainer: a depth pinned
                 # at 0 means the host pipeline is the bottleneck, pinned
                 # at `size` means the device is
@@ -81,6 +96,11 @@ def prefetch_to_device(it: Iterable, size: Optional[int] = None,
 
     from bigdl_tpu.utils.threads import spawn
     t = spawn(worker, name="bigdl-data-prefetch")
+    # the batch the CONSUMER currently holds is still device memory in
+    # flight — it stays accounted until the next hand-off (mirrors the
+    # synchronous path in dataset/service.double_buffer), so the clean
+    # path's unattributed drift is genuinely ~0
+    consumer_nb = 0
     try:
         while True:
             item = q.get()
@@ -88,7 +108,10 @@ def prefetch_to_device(it: Iterable, size: Optional[int] = None,
                 if err:
                     raise err[0]
                 return
-            yield item
+            placed, nb = item
+            stage.add_bytes(-consumer_nb)
+            consumer_nb = nb
+            yield placed
     finally:
         # a trainer breaking mid-epoch (max_iteration, early stop, retry
         # after a failure, slice failover) must not leave a placement
@@ -97,16 +120,29 @@ def prefetch_to_device(it: Iterable, size: Optional[int] = None,
         # dead chip must not hang the trainer's control path; the thread
         # is daemonic)
         stop.set()
+        stage.add_bytes(-consumer_nb)
         # drop queued batches NOW rather than at GC time: they hold
         # device buffers placed for the OLD topology, and a slice
         # failover wants that memory back before re-sharding the trees
         # (the re-entered epoch re-places its batches from the cursor)
         try:
             while True:
-                q.get_nowait()
+                item = q.get_nowait()
+                if item is not _END:
+                    stage.add_bytes(-item[1])
         except queue.Empty:
             pass
         t.join(timeout=2.0)
+        # a put that squeezed in between the drain above and the worker
+        # observing `stop` still holds staging bytes — sweep once more
+        # now that the worker is (normally) done
+        try:
+            while True:
+                item = q.get_nowait()
+                if item is not _END:
+                    stage.add_bytes(-item[1])
+        except queue.Empty:
+            pass
         if t.is_alive():
             import logging
             logging.getLogger("bigdl_tpu").warning(

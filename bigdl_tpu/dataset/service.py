@@ -257,7 +257,26 @@ def double_buffer(batches: Iterable, place_fn: Callable,
         from bigdl_tpu.utils import config
         depth = config.get("DATA_DOUBLE_BUFFER")
     if not depth or depth <= 0:
-        return (place_fn(b) for b in batches)
+        # synchronous placement still accounts the one in-flight placed
+        # batch under the shared `data/staging` ledger owner
+        # (observe/memz.py) — the buffered path does the same through
+        # prefetch_to_device's queue deltas
+        def _sync():
+            from bigdl_tpu.observe import memz as _memz
+            stage = _memz.ledger().tracker(
+                "data/staging", kind="staging",
+                note="synchronous H2D placement")
+            nb = 0
+            try:
+                for b in batches:
+                    placed = place_fn(b)
+                    stage.add_bytes(-nb)
+                    nb = _memz.tree_nbytes(placed)
+                    stage.add_bytes(nb)
+                    yield placed
+            finally:
+                stage.add_bytes(-nb)
+        return _sync()
     from bigdl_tpu.dataset.prefetch import prefetch_to_device
     return prefetch_to_device(batches, depth, place_fn=place_fn)
 

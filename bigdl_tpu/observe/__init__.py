@@ -23,6 +23,14 @@ Unified observability for the training stack (reference analogues:
                   forensics bundles (BIGDL_TPU_FORENSICS, with
                   capture-on-crash when an incident is live), and the
                   `python -m bigdl_tpu.observe doctor` post-mortem CLI;
+  * **memz**    — device-memory observability: the HBM buffer ledger
+                  (every long-lived device tree registered under a
+                  named owner, `mem/<owner>/bytes` gauges,
+                  backend cross-check + unattributed drift), the /memz
+                  live plane, the memory watchdog
+                  (BIGDL_TPU_MEM_WATCHDOG_PCT), serve admission
+                  checks, and OOM forensics (memory.json +
+                  memory.prof in every crash bundle);
   * **fleet**   — cross-process aggregation: process 0 polls every
                   peer's plane and serves merged /fleetz +
                   peer-labeled /fleetz/metrics (BIGDL_TPU_FLEET /
@@ -193,6 +201,11 @@ def ensure_started() -> bool:
         # BIGDL_TPU_FLEET_PEERS arm it — no-op otherwise
         from bigdl_tpu.observe import fleet as _fleet
         _fleet.ensure_started()
+        # device-memory plane (observe/memz.py): capture the drift
+        # baseline and arm the memory watchdog when a capacity limit is
+        # known (backend bytes_limit or BIGDL_TPU_MEM_LIMIT_BYTES)
+        from bigdl_tpu.observe import memz as _memz
+        _memz.ensure_started()
         _started = True
         # thread-shutdown audit (docs/concurrency.md): a process that
         # merely turned the plane on must exit cleanly — join the export
@@ -237,6 +250,8 @@ def shutdown() -> None:
         _fleet.stop()
         from bigdl_tpu.observe import doctor as _doctor
         _doctor.stop_serve_watchdog()
+        from bigdl_tpu.observe import memz as _memz
+        _memz.stop_memory_watchdog()
         if _exports is not None:
             _exports.close()
             _exports = None

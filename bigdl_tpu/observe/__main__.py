@@ -2,13 +2,20 @@
 `python -m bigdl_tpu.observe doctor <bundle|run.jsonl>` — post-mortem
 (observe/doctor.py); `python -m bigdl_tpu.observe fleet` — fleet
 aggregation smoke (observe/fleet.py; two in-process planes, merged
-/fleetz asserted, rc 1 on a missing peer)."""
+/fleetz asserted, rc 1 on a missing peer);
+`python -m bigdl_tpu.observe memz` — device-memory ledger table
+(observe/memz.py; --json, --smoke, rc 1 on unattributed drift above
+BIGDL_TPU_MEM_DRIFT_PCT)."""
 
 import sys
 
 if len(sys.argv) > 1 and sys.argv[1] == "doctor":
     from bigdl_tpu.observe.doctor import doctor_main
     sys.exit(doctor_main(sys.argv[2:]))
+
+if len(sys.argv) > 1 and sys.argv[1] == "memz":
+    from bigdl_tpu.observe.memz import memz_main
+    sys.exit(memz_main(sys.argv[2:]))
 
 if len(sys.argv) > 1 and sys.argv[1] == "fleet":
     from bigdl_tpu.observe.fleet import smoke_main

@@ -34,13 +34,21 @@ run; this module answers it DURING and right after a failure:
     (BIGDL_TPU_SERVE_WATCHDOG_PCT, 0 = off) on a sanctioned
     PeriodicWorker riding the fleet/export poll cadence.
 
+  * **MemoryWatchdog** — the same `observe_signal` core in absolute-
+    threshold mode, fed device-memory utilization with per-owner ledger
+    bytes as attribution components (observe/memz.py): sustained
+    utilization above BIGDL_TPU_MEM_WATCHDOG_PCT opens ONE incident
+    naming the fastest-growing owner.
+
   * **Forensics** — on NonFiniteLossError, retry exhaustion, or any
     unhandled optimize() exception, `dump_forensics` writes a
     self-contained `forensics-<ts>/` bundle next to the trace dir
     (knob BIGDL_TPU_FORENSICS): ring-buffer spans as Chrome trace JSON,
     a metrics snapshot, the live /statusz payload, every config knob's
-    effective value, the trainer state + resume/data_state, and the
-    traceback. The newest 8 bundles are kept.
+    effective value, the trainer state + resume/data_state, the
+    traceback, and the device-memory ledger (`memory.json`; a
+    RESOURCE_EXHAUSTED crash adds the pprof `memory.prof` — OOM
+    forensics, observe/memz.py). The newest 8 bundles are kept.
 
   * **Doctor CLI** — `python -m bigdl_tpu.observe doctor <bundle|jsonl>`
     parses a bundle (or a JSONL run log) and prints the phase
@@ -99,9 +107,16 @@ class Watchdog:
                  signal: str = "step_s",
                  gauge_names: tuple = ("step_s", "baseline_s"),
                  default_blame: str = "train/dispatch",
+                 absolute: bool = False,
                  extra: Optional[dict] = None):
         from bigdl_tpu.utils import config
         self.pct = config.get("WATCHDOG_PCT") if pct is None else pct
+        # absolute mode (the memory watchdog, observe/memz.py): `pct` is
+        # a LEVEL the signal must not sustain above (utilization %), not
+        # a relative growth over the rolling baseline — no warm-up
+        # needed, attribution components still use their own baselines
+        # so the fastest-GROWING component takes the blame
+        self.absolute = absolute
         self.window = (config.get("WATCHDOG_WINDOW") if window is None
                        else window)
         self.sustain = max(1, config.get("WATCHDOG_SUSTAIN")
@@ -172,15 +187,21 @@ class Watchdog:
                                         dict(components), extra)
 
     def _observe_locked(self, neval, value, components, extra=None):
-        warm = len(self._values) >= max(4, self.window // 4)
         opened = None
-        if warm:
+        if self.absolute:
+            # level trigger: the threshold IS pct (e.g. 85% utilization);
+            # the baseline is informational (median of healthy windows)
+            warm = True
+            base = _median(list(self._values)) if self._values else 0.0
+            is_bad = value > self.pct
+        elif len(self._values) >= max(4, self.window // 4):
+            warm = True
             base = _median(list(self._values))
             mad = _median([abs(x - base) for x in self._values])
             threshold = base * (1.0 + self.pct / 100.0)
             is_bad = (value > threshold and value > base + 3.0 * mad)
         else:
-            base, is_bad = 0.0, False
+            warm, base, is_bad = False, 0.0, False
         from bigdl_tpu.observe.metrics import counter, gauge
         gauge(self._g_value).set(value)
         if warm:
@@ -633,6 +654,18 @@ def dump_forensics(reason: str, exc: Optional[BaseException] = None,
     except Exception as e:                     # noqa: BLE001 — forensics
         log.warning("forensics: statusz payload failed: %s", e)
     try:
+        # OOM forensics (observe/memz.py): every bundle carries the
+        # device-memory ledger (memory.json names the top owner); a
+        # RESOURCE_EXHAUSTED crash additionally saves the pprof device
+        # memory profile (memory.prof) — the "who ate the HBM" answer
+        # captured while the allocator state is still warm
+        from bigdl_tpu.observe import memz as _memz
+        _write("memory.json", _memz.oom_report())
+        if _memz.is_oom(exc):
+            _memz.save_memory_profile(os.path.join(path, "memory.prof"))
+    except Exception as e:                     # noqa: BLE001 — forensics
+        log.warning("forensics: memory ledger dump failed: %s", e)
+    try:
         # capture-on-crash: a crash WHILE a watchdog/serve-SLO incident
         # is live gets a short device-timeline capture into the bundle —
         # the /profilez the pager-holder would have asked for, taken
@@ -656,6 +689,12 @@ def incident_active() -> bool:
     wd = _watchdog
     if wd is not None and wd.active_alert() is not None:
         return True
+    try:
+        from bigdl_tpu.observe import memz as _memz
+        if _memz.watchdog_active():
+            return True
+    except Exception:                          # noqa: BLE001 — telemetry
+        pass
     swd = _serve_watchdog
     return bool(swd is not None and swd.active_alerts())
 
@@ -714,10 +753,10 @@ def _load_bundle(path: str) -> dict:
     """A forensics bundle dir -> {meta, snapshot, statusz, spans,
     error}; missing pieces load as empty."""
     out = {"meta": {}, "snapshot": {}, "statusz": {}, "spans": {},
-           "sanitizer": {}, "error": ""}
+           "sanitizer": {}, "memory": {}, "error": ""}
     names = {"meta": "meta.json", "snapshot": "metrics.json",
              "statusz": "statusz.json", "spans": "spans.json",
-             "sanitizer": "sanitizer.json"}
+             "sanitizer": "sanitizer.json", "memory": "memory.json"}
     for key, name in names.items():
         p = os.path.join(path, name)
         if os.path.exists(p):
@@ -753,6 +792,7 @@ def render_doctor(target: str) -> dict:
         spans, error = b["spans"], b["error"]
         alerts = (b["statusz"].get("watchdog", {}) or {}).get("alerts", [])
         sanitizer = b["sanitizer"]
+        memory = b["memory"]
         kind = "bundle"
     else:
         from bigdl_tpu.observe.report import load_jsonl
@@ -762,6 +802,7 @@ def render_doctor(target: str) -> dict:
                 "flushes": len(recs)}
         spans, error, alerts = {}, "", []
         sanitizer = {}
+        memory = {}
         kind = "jsonl"
     counters = snapshot.get("counters", {})
     gauges = snapshot.get("gauges", {})
@@ -773,6 +814,8 @@ def render_doctor(target: str) -> dict:
         "retries": counters.get("resilience/retries", 0),
         "faults_injected": counters.get("resilience/faults_injected", 0),
         "shed_requests": counters.get("serve/shed", 0),
+        "memory_incidents": counters.get("watchdog/memory/incidents", 0),
+        "mem_admission_refused": counters.get("mem/admission_refused", 0),
     }
     return {
         "kind": kind,
@@ -785,6 +828,7 @@ def render_doctor(target: str) -> dict:
         "alerts": alerts,
         "anomalies": {k: v for k, v in anomalies.items() if v},
         "sanitizer": sanitizer or None,
+        "memory": memory or None,
         "top_spans": _top_spans(spans),
         "last_step": gauges.get("train/neval", 0),
         "last_loss": gauges.get("train/loss"),
@@ -884,6 +928,21 @@ def doctor_main(argv: Optional[List[str]] = None) -> int:
                       f"{r.get('phase')} at {r.get('where')}")
             else:
                 print(f"  {r['kind']}: {r}")
+    mem = d.get("memory")
+    if mem and mem.get("utilization"):
+        # the OOM post-mortem headline: who held the device memory
+        print("\ndevice memory at crash time:")
+        if mem.get("headline"):
+            print(f"  {mem['headline']}")
+        from bigdl_tpu.observe import memz as _memz
+        for name, o in sorted(
+                (mem.get("owners") or {}).items(),
+                key=lambda kv: -kv[1].get("bytes", 0))[:8]:
+            print(f"  {name:<36} {_memz._fmt_bytes(o.get('bytes')):>12}"
+                  f"  {o.get('kind') or ''}")
+        u = mem["utilization"]
+        print(f"  unattributed {_memz._fmt_bytes(u.get('unattributed_bytes'))}"
+              f" ({u.get('unattributed_pct')}% of in-use)")
     if d["serve"]:
         print("\nserve:")
         for m, s in d["serve"]["models"].items():

@@ -224,6 +224,18 @@ class FleetAggregator:
                     # the peer's aggregate decode rate + live slots
                     "decode_tokens_per_s": self._peer_decode_rate(
                         p.payload),
+                    # device-memory plane (statusz `memory` section,
+                    # observe/memz.py): utilization, headroom, and the
+                    # biggest ledger owner per peer — STALE peers keep
+                    # their last-known rows like every other signal
+                    "mem_utilization_pct": (p.payload.get("memory")
+                                            or {}).get("utilization_pct"),
+                    "mem_headroom_bytes": (p.payload.get("memory")
+                                           or {}).get("headroom_bytes"),
+                    "mem_ledger_bytes": (p.payload.get("memory")
+                                         or {}).get("ledger_bytes"),
+                    "mem_top_owner": (p.payload.get("memory")
+                                      or {}).get("top_owner"),
                 })
         return rows
 
@@ -341,6 +353,17 @@ class FleetAggregator:
                 "data_wait_max": max(
                     [r["data_wait"] for r in live
                      if r["data_wait"] is not None], default=None),
+                # fleet memory headline: the hottest peer's device
+                # utilization + the tightest headroom (capacity
+                # questions are answered by the WORST peer)
+                "mem_utilization_max": max(
+                    [r["mem_utilization_pct"] for r in live
+                     if r["mem_utilization_pct"] is not None],
+                    default=None),
+                "mem_headroom_min_bytes": min(
+                    [r["mem_headroom_bytes"] for r in live
+                     if r["mem_headroom_bytes"] is not None],
+                    default=None),
                 "alerts_active": sum(1 for r in rows
                                      if r.get("alert_active")),
             },

@@ -23,6 +23,11 @@ Endpoints (all GET, all JSON unless noted):
                    peers (observe/fleet.py; `?full=1` embeds raw peer
                    snapshots); `/fleetz/metrics` is the peer-labeled
                    Prometheus form.
+  * `/memz`      — the device-memory plane (observe/memz.py): buffer
+                   ledger per-owner table, per-device utilization +
+                   high-water marks, top buffers, unattributed drift,
+                   headroom estimates. Bytes come from shapes/dtypes
+                   and local allocator stats — zero device syncs.
   * `/tracez?n=N` — the newest N spans from the tracer ring buffer.
   * `/profilez?seconds=S` — arms a `jax.profiler` capture window on
                    demand; the TensorBoard-loadable capture lands under
@@ -177,6 +182,13 @@ def status_payload() -> dict:
                       if _doctor._serve_watchdog is not None else None),
         },
     }
+    try:
+        # device-memory headline (observe/memz.py): the compact per-peer
+        # rows /fleetz merges; the full table lives on /memz
+        from bigdl_tpu.observe import memz as _memz
+        payload["memory"] = _memz.ledger().status_section()
+    except Exception:                    # noqa: BLE001 — telemetry
+        pass
     san = sancov.report_payload()
     if san["modes"]:
         # concurrency sanitizer live (BIGDL_TPU_SANITIZE): findings
@@ -337,6 +349,10 @@ class _Handler(BaseHTTPRequestHandler):
                     full = q.get("full", ["0"])[0] not in ("0", "")
                     self._send(200, json.dumps(
                         agg.fleet_payload(full=full), default=str))
+            elif url.path == "/memz":
+                from bigdl_tpu.observe import memz as _memz
+                self._send(200, json.dumps(_memz.ledger().payload(),
+                                           default=str))
             elif url.path == "/tracez":
                 n = int(q.get("n", ["100"])[0])
                 self._send(200, json.dumps(tracez_payload(n),
@@ -351,7 +367,7 @@ class _Handler(BaseHTTPRequestHandler):
                                             "endpoints": [
                                                 "/healthz", "/metrics",
                                                 "/varz", "/statusz",
-                                                "/fleetz",
+                                                "/memz", "/fleetz",
                                                 "/fleetz/metrics",
                                                 "/tracez",
                                                 "/profilez"]}))
@@ -377,7 +393,7 @@ class StatuszServer:
         self._thread = spawn(self.httpd.serve_forever,
                              name="statusz-http")
         log.info("statusz: live telemetry plane on http://%s:%d "
-                 "(/healthz /metrics /statusz /tracez /profilez)",
+                 "(/healthz /metrics /statusz /memz /tracez /profilez)",
                  host, self.port)
 
     def close(self) -> None:

@@ -538,7 +538,24 @@ class Optimizer:
     # Overridden by parallel.DistriOptimizer to lay trees/batches out on the
     # mesh; the local trainer leaves placement to jit's defaults.
     def _place_trees(self, params, model_state, slots):
+        self._ledger_register_trees(params, model_state, slots)
         return params, model_state, slots
+
+    def _ledger_register_trees(self, params, model_state, slots):
+        """Account the trainer's long-lived device trees in the memory
+        ledger (observe/memz.py): `trainer/{params,slots,model_state}`
+        owners, weakref-finalized against this trainer so the bytes are
+        released with it. Called from `_place_trees` (both trainers), so
+        a failover re-shard re-measures through the same seam. Bytes
+        come from shapes host-side — no device syncs."""
+        from bigdl_tpu.observe import memz as _memz
+        led = _memz.ledger()
+        led.register("trainer/params", params, anchor=self,
+                     kind="params", note=type(self).__name__)
+        led.register("trainer/slots", slots, anchor=self,
+                     kind="optim_slots", note=type(self.method).__name__)
+        led.register("trainer/model_state", model_state, anchor=self,
+                     kind="state")
 
     def _grad_exchange_fn(self):
         """Seam for the cross-slice gradient exchange, captured at step
@@ -967,15 +984,23 @@ class Optimizer:
             raise
         except Exception as e:
             from bigdl_tpu.observe import doctor as _doctor
+            from bigdl_tpu.observe import memz as _memz
             extra = {"trainer": type(self).__name__}
             try:
                 extra.update(self._snapshot_extra_meta())
             except Exception:          # noqa: BLE001 — forensics is best-effort
                 pass
+            # a device allocation failure gets its own reason so the
+            # bundle's memory.json + memory.prof (OOM forensics,
+            # observe/memz.py) lead the post-mortem
+            if isinstance(e, NonFiniteLossError):
+                reason = "nonfinite-loss"
+            elif _memz.is_oom(e):
+                reason = "resource-exhausted"
+            else:
+                reason = "optimize-exception"
             _doctor.dump_forensics(
-                "nonfinite-loss" if isinstance(e, NonFiniteLossError)
-                else "optimize-exception",
-                exc=e, state=dict(self.state), extra=extra)
+                reason, exc=e, state=dict(self.state), extra=extra)
             raise
 
     def _optimize_impl(self) -> Tuple[Dict, Dict]:

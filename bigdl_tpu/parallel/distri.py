@@ -148,6 +148,11 @@ class DistriOptimizer(Optimizer):
             model_state)
         slots = jax.tree.map(jax.device_put, slots,
                              self._slot_shardings(slots))
+        # memory ledger (observe/memz.py): the placed trees are THE
+        # long-lived device residents of a training process — account
+        # them after every placement, failover re-shards included
+        # (bytes are global logical sizes, matching the census)
+        self._ledger_register_trees(params, model_state, slots)
         return params, model_state, slots
 
     def _batch_sharding(self, arr):

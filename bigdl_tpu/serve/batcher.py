@@ -264,6 +264,20 @@ class ContinuousBatcher:
                 out = self._dispatch(xs, rows)
             self._disp.record(max(0.0, (self._clock() - t_disp0) * 1e3))
         except BaseException as exc:  # noqa: BLE001 — routed to callers
+            # OOM forensics (observe/memz.py): a RESOURCE_EXHAUSTED
+            # dispatch dumps the device-memory ledger + profile into a
+            # forensics bundle (deduped per exception) before the error
+            # fans out to the callers
+            try:
+                from bigdl_tpu.observe import memz as _memz
+                if _memz.is_oom(exc):
+                    from bigdl_tpu.observe import doctor as _doctor
+                    _doctor.dump_forensics(
+                        "serve-resource-exhausted", exc=exc,
+                        extra={"model": self.name, "bucket": bucket,
+                               "rows": rows})
+            except Exception:         # noqa: BLE001 — forensics only
+                pass
             for req in group:
                 if not req.future.cancelled():
                     req.future.set_exception(exc)

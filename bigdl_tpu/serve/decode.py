@@ -134,6 +134,23 @@ class DecodeEntry:
                 f"decode model {name!r} carries no eos_id — pass "
                 f"eos_id= at registration")
         self.vocab_size = int(model.vocab_size)
+        # memory plane (observe/memz.py): the KV-slot bucket is the
+        # decode path's dominant resident — size it in CLOSED FORM from
+        # eval_shape (num_slots x max_seq_len x layers x heads x hd x
+        # dtype, zero allocation) and refuse the registration up front
+        # when params + bucket exceed the remaining headroom, instead
+        # of OOMing on the first decode step
+        import jax
+        from bigdl_tpu.observe import memz as _memz
+        cache_specs = jax.eval_shape(
+            lambda p: model.make_slot_caches(p, self.num_slots,
+                                             self.max_seq_len), params)
+        self.kv_cache_bytes = _memz.tree_nbytes(cache_specs)
+        _memz.admission_check(
+            self.kv_cache_bytes + _memz.tree_nbytes(params),
+            f"decode model {name!r} ({self.num_slots} slots x "
+            f"{self.max_seq_len} tokens KV bucket = "
+            f"{self.kv_cache_bytes:,} bytes + params)")
         self._jit_decode = None
         self._jit_prefill = None
         self._aot_decode = None
@@ -387,6 +404,16 @@ class DecodeScheduler:
         self._slots: List[Optional[_GenRequest]] = \
             [None] * entry.num_slots
         self._caches = entry.make_caches()
+        # buffer ledger (observe/memz.py): the persistent KV-slot bucket
+        # under `serve/<model>/kv_cache` — the bytes stay constant across
+        # donated steps, and close()/GC releases the accounting; the
+        # slots meta feeds the /memz "one more slot" headroom estimate
+        from bigdl_tpu.observe import memz as _memz
+        self._mem_handle = _memz.ledger().register(
+            f"serve/{self.name}/kv_cache", self._caches, anchor=self,
+            kind="kv_cache",
+            meta={"slots": entry.num_slots,
+                  "max_seq_len": entry.max_seq_len})
         self._closed = False
         self._draining = False
         self._thread: Optional[threading.Thread] = None
@@ -627,7 +654,33 @@ class DecodeScheduler:
                         return
                     self._cv.wait(timeout=0.05)
                     continue
-            self.step_once()
+            try:
+                self.step_once()
+            except Exception as exc:     # noqa: BLE001 — routed to callers
+                # a failed iteration must not strand replies forever on
+                # a dead scheduler thread; RESOURCE_EXHAUSTED
+                # additionally dumps the OOM forensics bundle (ledger +
+                # device memory profile — observe/memz.py)
+                from bigdl_tpu.observe import memz as _memz
+                if _memz.is_oom(exc):
+                    from bigdl_tpu.observe import doctor as _doctor
+                    _doctor.dump_forensics(
+                        "serve-resource-exhausted", exc=exc,
+                        extra={"model": self.name, "decode": True,
+                               "kv_cache_bytes":
+                                   self.entry.kv_cache_bytes})
+                log.error("serve[%s]: decode iteration failed (%s: %s) "
+                          "— failing %d active + %d queued generates",
+                          self.name, type(exc).__name__, exc,
+                          self.active_slots, len(self._queue))
+                with self._cv:
+                    pending = ([r for r in self._slots if r is not None]
+                               + list(self._queue))
+                for req in pending:      # fail with the REAL error
+                    if not req.reply.done():
+                        req.reply._fail(exc)
+                self.close(drain=False, timeout=0.0)
+                return
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Stop admission and wait for every queued + active generate
@@ -670,6 +723,10 @@ class DecodeScheduler:
         if t is not None and t is not threading.current_thread():
             t.join(timeout=5.0)
         self._thread = None
+        # the KV bucket itself is freed when the scheduler drops its
+        # cache reference; release the ledger accounting with it
+        self._caches = None
+        self._mem_handle.close()
 
     # ------------------------------------------------------------- stats
     def stats(self) -> Dict:

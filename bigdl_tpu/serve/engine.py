@@ -122,7 +122,15 @@ class ServeEngine:
         `submit_generate`, and `precompile_decode` (default on)
         AOT-compiles the fused step + every prefill bucket so warm
         serving compiles zero fresh programs. num_slots / max_seq_len /
-        prefill_chunk default to the BIGDL_TPU_SERVE_DECODE_* knobs."""
+        prefill_chunk default to the BIGDL_TPU_SERVE_DECODE_* knobs.
+
+        Admission is memory-checked (observe/memz.py): params+state —
+        and for decode the closed-form KV bucket, BEFORE allocation —
+        must fit the remaining device headroom, else a `CapacityError`
+        with the per-owner capacity report is raised and nothing is
+        registered (no model entry, no scheduler thread). Registered
+        trees are accounted in the buffer ledger (`serve/<name>/params`,
+        `serve/<name>/kv_cache` — the /memz plane)."""
         if self._closed:
             raise Closed("engine is shut down")
         d = self._defaults

@@ -117,6 +117,20 @@ class ModelEntry:
         self.model = model
         self.params = params
         self.state = state
+        # memory plane (observe/memz.py): refuse a registration that
+        # cannot fit the remaining headroom (a loud CapacityError with
+        # the per-owner report beats an OOM mid-traffic), then account
+        # the model's resident trees under `serve/<name>/params` —
+        # weakref-finalized so a dropped entry releases its bytes
+        from bigdl_tpu.observe import memz as _memz
+        need = _memz.tree_nbytes(params) + _memz.tree_nbytes(state)
+        if not decode:
+            # the decode path admission-checks params + the KV bucket
+            # together (DecodeEntry, closed form, before any allocation)
+            _memz.admission_check(need, f"serve model {name!r}")
+        self._mem_handle = _memz.ledger().register(
+            f"serve/{name}/params", anchor=self, nbytes=need,
+            kind="params", note=type(model).__name__)
         self.buckets = serve_buckets(max_batch, mesh)
         self.max_batch = self.buckets[-1]
         self._jitted = _serve_forward(model, mesh)
@@ -247,7 +261,13 @@ class ModelRegistry:
 
     def unregister(self, name: str) -> None:
         with self._lock:
-            self._entries.pop(name, None)
+            entry = self._entries.pop(name, None)
+        if entry is not None:
+            # release the ledger accounting NOW (the weakref finalizer
+            # is the backstop for entries dropped without unregister)
+            handle = getattr(entry, "_mem_handle", None)
+            if handle is not None:
+                handle.close()
         observe.gauge("serve/models").set(len(self._entries))
 
     def names(self) -> List[str]:

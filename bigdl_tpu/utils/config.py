@@ -395,6 +395,36 @@ _register("FORENSICS_PROFILE_S", 1.0, float,
           "/profilez-style jax.profiler capture of this many seconds "
           "into the bundle's profile/ dir (the device timeline of the "
           "regression that preceded the crash). 0 disables")
+_register("MEM_LEDGER", True, _bool,
+          "Device-memory buffer ledger (observe/memz.py): subsystems "
+          "that pin long-lived device memory (trainer param/slot trees, "
+          "serve model params, decode KV-slot buckets, data-service "
+          "staging) register their trees under named owners — "
+          "mem/<owner>/bytes gauges, the /memz endpoint, headroom "
+          "estimates, and OOM forensics attribution all read from it. "
+          "Bytes are computed from shapes host-side (never a device "
+          "sync). 0 disables every registration (no-op handles)")
+_register("MEM_WATCHDOG_PCT", 85.0, float,
+          "Memory watchdog (observe/memz.py MemoryWatchdog): open ONE "
+          "incident — attributed to the fastest-growing ledger owner, "
+          "riding the alert fan-out — when device-memory utilization "
+          "stays above this percent of the capacity limit for "
+          "WATCHDOG_SUSTAIN polls. Armed by observe.ensure_started() "
+          "ONLY when a limit is known (backend bytes_limit or "
+          "BIGDL_TPU_MEM_LIMIT_BYTES); polls on the FLEET_POLL_S/"
+          "METRICS_FLUSH_S cadence. 0 disables")
+_register("MEM_LIMIT_BYTES", 0, int,
+          "Device-memory capacity override in bytes (observe/memz.py): "
+          "0 (default) trusts the backend's bytes_limit (TPU/GPU report "
+          "one; the CPU test mesh does not). Setting it arms the memory "
+          "watchdog + serve admission checks on limit-less backends and "
+          "caps utilization/headroom math everywhere")
+_register("MEM_DRIFT_PCT", 5.0, float,
+          "Ledger-vs-backend drift tolerance: `python -m "
+          "bigdl_tpu.observe memz` exits 1 when |unattributed bytes| "
+          "exceeds this percent of backend in-use (unattributed = "
+          "in_use - baseline - ledger total: XLA workspace + anything "
+          "that skipped registration — observe/memz.py)")
 _register("SANITIZE", "", str,
           "Concurrency sanitizer (analysis/sancov.py): '' (default) = "
           "off, wrappers never installed, zero cost. '1' enables every "

@@ -112,22 +112,24 @@ from bigdl_tpu.observe.metrics import IterationMetrics  # noqa: E402,F401
 def device_memory_summary(device=None):
     """Per-device memory stats dict (bytes_in_use, peak_bytes_in_use,
     bytes_limit when the backend reports them — TPU/GPU do; host CPU
-    returns {}). The analogue of the reference's per-phase memory
-    accounting in Metrics (optim/Metrics.scala); pair with
-    `jax.profiler.save_device_memory_profile` for a full breakdown."""
-    import jax
-    dev = device or jax.devices()[0]
-    stats = getattr(dev, "memory_stats", lambda: None)()
-    if not stats:
-        return {}
-    keep = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
-            "largest_alloc_size", "num_allocs")
-    return {k: int(v) for k, v in stats.items() if k in keep}
+    returns {}). Historically this was the tree's ONLY memory reader;
+    the device-memory plane absorbed it (observe/memz.py — the buffer
+    ledger, /memz, watchdog, and OOM forensics all read the same
+    backend probe), and this name stays as a thin shim for the
+    pre-existing call sites."""
+    from bigdl_tpu.observe import memz
+    return memz.device_memory_summary(device)
 
 
 def memory_profile(path: str) -> str:
     """Write a pprof-format device-memory profile (open with `pprof` or
-    xprof). Returns the path."""
-    import jax
-    jax.profiler.save_device_memory_profile(path)
-    return path
+    xprof). Returns the path. Routed through the memory plane's
+    best-effort saver (observe/memz.py — the same writer OOM forensics
+    uses for `memory.prof`); raises when the profiler cannot write."""
+    from bigdl_tpu.observe import memz
+    out = memz.save_memory_profile(path)
+    if out is None:
+        raise RuntimeError(
+            f"jax.profiler.save_device_memory_profile({path!r}) failed "
+            f"(see the bigdl_tpu log for the cause)")
+    return out

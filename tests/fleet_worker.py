@@ -56,6 +56,16 @@ def main():
     engine = _DecodeStatsEngine()       # kept alive: weakly registered
     statusz.register_engine(engine)
 
+    # a live decode KV bucket in the memory ledger (ISSUE 15 satellite):
+    # the merged /fleetz per-peer memory rows must carry nonzero KV
+    # bytes, so each worker registers a real slot-bucket-shaped tree
+    import numpy as np
+    from bigdl_tpu.observe import memz
+    kv = tuple(np.zeros((4, 64, 2, 8), np.float32) for _ in range(2))
+    memz.ledger().register("serve/lm/kv_cache", kv, kind="kv_cache",
+                           meta={"slots": 4, "max_seq_len": 64})
+    globals()["_kv_keepalive"] = kv
+
     srv = statusz.start(port=port)
     agg = fleet.ensure_started() if idx == 0 else None
     print(json.dumps({"ready": True, "index": idx, "port": srv.port,

@@ -111,6 +111,12 @@ def _peer_doc(i, *, step=None, alerts=()):
             "failover": {"live_slices": 2 - i, "slice_losses": i},
             "exchange": {"window": 8, "pending_steps": 3 + i,
                          "loss_spread": 0.01 * (i + 1)},
+            "memory": {"ledger_bytes": 1000 * (i + 1),
+                       "utilization_pct": 10.0 * (i + 1),
+                       "headroom_bytes": 9000 - 1000 * i,
+                       "unattributed_bytes": 8,
+                       "top_owner": "serve/lm/kv_cache",
+                       "top_owner_bytes": 800 * (i + 1)},
             "sanitizer": {"reports": [{"kind": "hostsync"}] * i,
                           "modes": ["locks"]},
         },
@@ -165,6 +171,15 @@ def test_aggregator_merges_and_marks_stale_not_dropped(clean_plane):
     # DCN-exchange window position + per-slice loss spread per peer
     assert p["peers"][1]["exchange_pending"] == 4
     assert p["peers"][1]["slice_loss_spread"] == pytest.approx(0.02)
+    # device-memory rows (statusz `memory` section, observe/memz.py):
+    # per-peer utilization/headroom/top-owner + the fleet worst-case
+    # rollup (max utilization, min headroom)
+    assert p["peers"][1]["mem_utilization_pct"] == pytest.approx(20.0)
+    assert p["peers"][1]["mem_ledger_bytes"] == 2000
+    assert p["peers"][1]["mem_headroom_bytes"] == 8000
+    assert p["peers"][1]["mem_top_owner"] == "serve/lm/kv_cache"
+    assert f["mem_utilization_max"] == pytest.approx(20.0)
+    assert f["mem_headroom_min_bytes"] == 8000
     # full form embeds the raw snapshots for the report CLI
     full = agg.fleet_payload(full=True)
     assert full["snapshots"]["0"]["gauges"]["train/neval"] == 100.0
@@ -180,6 +195,8 @@ def test_aggregator_merges_and_marks_stale_not_dropped(clean_plane):
     assert len(p["peers"]) == 2                   # kept, not dropped
     assert p["peers"][1]["stale"] is True
     assert p["peers"][1]["step"] == 105           # last-known state
+    # memory rows ride the same STALE-not-dropped contract
+    assert p["peers"][1]["mem_ledger_bytes"] == 2000
     assert p["fleet"]["peers_live"] == 1
     assert p["fleet"]["peers_stale"] == 1
     assert p["fleet"]["unreachable_polls"] == 2
@@ -311,8 +328,17 @@ def test_two_process_fleet_survives_sigkilled_peer(tmp_path):
         assert dec["slot_occupancy_mean"] == pytest.approx(0.375)
         assert doc["peers"][1]["decode_tokens_per_s"] == pytest.approx(
             100.0)
+        # per-peer memory rows (ISSUE 15 satellite): each worker grew a
+        # registered decode KV bucket, so peer KV/ledger bytes are
+        # NONZERO in the merged view — 2 layers x (4, 64, 2, 8) fp32
+        kv_bytes = 2 * 4 * 64 * 2 * 8 * 4
+        for row in doc["peers"]:
+            assert row["mem_ledger_bytes"] >= kv_bytes
+            assert row["mem_top_owner"] == "serve/lm/kv_cache"
         _, text = _get(ports[0], "/fleetz/metrics")
         assert 'bigdl_tpu_train_neval{peer="1"} 105.0' in text
+        assert 'bigdl_tpu_mem_serve_lm_kv_cache_bytes{peer="1"} ' \
+               f'{float(kv_bytes)}' in text
         # SIGKILL peer 1 mid-scrape: stale, not a crash
         procs[1].send_signal(signal.SIGKILL)
         procs[1].wait(timeout=10)
