@@ -52,11 +52,11 @@ import json
 import logging
 import os
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 from urllib.parse import parse_qs, urlparse
 
 from bigdl_tpu.analysis import sancov
+from bigdl_tpu.utils.httpd import HTTPServerThread, JSONHandler, ServerSlot
 from bigdl_tpu.utils.threads import make_lock, spawn
 
 log = logging.getLogger("bigdl_tpu")
@@ -292,20 +292,11 @@ def arm_profiler(seconds: float) -> dict:
 
 
 # --------------------------------------------------------------- server
-class _Handler(BaseHTTPRequestHandler):
+class _Handler(JSONHandler):
+    # server core (bind/threading/shutdown discipline) lives in
+    # utils/httpd.py, shared with the serving network front
     server_version = "bigdl-tpu-statusz/1"
-
-    def log_message(self, fmt, *args):   # route to our logger, DEBUG
-        log.debug("statusz: " + fmt, *args)
-
-    def _send(self, code: int, body: str,
-              ctype: str = "application/json") -> None:
-        data = body.encode()
-        self.send_response(code)
-        self.send_header("Content-Type", ctype + "; charset=utf-8")
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
+    log_prefix = "statusz"
 
     def do_GET(self):                    # noqa: N802 — http.server API
         url = urlparse(self.path)
@@ -381,32 +372,18 @@ class _Handler(BaseHTTPRequestHandler):
                 pass
 
 
-class StatuszServer:
-    """The HTTP thread. `port=0` binds an ephemeral port (tests); the
-    knob path never passes 0 (0 = off)."""
+class StatuszServer(HTTPServerThread):
+    """The HTTP thread (utils/httpd.py core). `port=0` binds an
+    ephemeral port (tests); the knob path never passes 0 (0 = off)."""
 
     def __init__(self, port: int, host: str = "127.0.0.1"):
-        self.httpd = ThreadingHTTPServer((host, port), _Handler)
-        self.httpd.daemon_threads = True
-        self.host = host
-        self.port = int(self.httpd.server_address[1])
-        self._thread = spawn(self.httpd.serve_forever,
-                             name="statusz-http")
+        super().__init__(_Handler, port, host, thread_name="statusz-http")
         log.info("statusz: live telemetry plane on http://%s:%d "
                  "(/healthz /metrics /statusz /memz /tracez /profilez)",
                  host, self.port)
 
-    def close(self) -> None:
-        try:
-            self.httpd.shutdown()
-            self.httpd.server_close()
-        except Exception:                # noqa: BLE001 — shutdown
-            pass
-        self._thread.join(timeout=5)
 
-
-_server: Optional[StatuszServer] = None
-_server_lock = make_lock("statusz.server")
+_slot = ServerSlot("statusz.server")
 
 
 def start(port: Optional[int] = None,
@@ -414,16 +391,15 @@ def start(port: Optional[int] = None,
     """Start (or return) the process-wide server. With `port=None` the
     knobs decide: BIGDL_TPU_STATUSZ_PORT=0 -> None (off), and only
     process 0 serves. An explicit `port` (0 = ephemeral) always starts."""
-    global _server
     from bigdl_tpu.utils import config
-    with _server_lock:
-        if _server is not None:
-            return _server
-        if host is None:
-            host = config.get("STATUSZ_HOST")
-        if port is None:
-            port = config.get("STATUSZ_PORT")
-            if not port:
+
+    def _factory() -> Optional[StatuszServer]:
+        h, p = host, port
+        if h is None:
+            h = config.get("STATUSZ_HOST")
+        if p is None:
+            p = config.get("STATUSZ_PORT")
+            if not p:
                 return None
             from bigdl_tpu.utils.runtime import process_index
             idx = process_index()
@@ -435,26 +411,23 @@ def start(port: Optional[int] = None,
                 if not _fleet.enabled():
                     log.debug("statusz: not process 0 — skipping")
                     return None
-                port = int(port) + idx
+                p = int(p) + idx
         try:
-            _server = StatuszServer(int(port), host)
+            return StatuszServer(int(p), h)
         except OSError as e:
             log.warning("statusz: cannot bind %s:%s (%s) — telemetry "
-                        "plane disabled", host, port, e)
+                        "plane disabled", h, p, e)
             return None
-        return _server
+
+    return _slot.start(_factory)
 
 
 def server() -> Optional[StatuszServer]:
-    return _server
+    return _slot.get()
 
 
 def stop() -> None:
-    # swap under the lock, join OUTSIDE it: close() waits on the HTTP
-    # thread (hundreds of ms), and holding the lock across that join
-    # is exactly the long-hold the sanitizer flags
-    global _server
-    with _server_lock:
-        server, _server = _server, None
-    if server is not None:
-        server.close()
+    # ServerSlot swaps under its lock and joins OUTSIDE it: close()
+    # waits on the HTTP thread (hundreds of ms), and holding the lock
+    # across that join is exactly the long-hold the sanitizer flags
+    _slot.stop()

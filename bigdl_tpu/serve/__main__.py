@@ -25,6 +25,17 @@ Modes:
     self-drives T threads of concurrent mixed-length generates and
     prints one JSON summary (tokens, tokens/s, ttft p50/p99, slot
     occupancy) — the decode tier-1 CI probe.
+  * --http — the network front (serve/net.py): /v1/predict and
+    /v1/generate over a real socket instead of stdin. Prints ONE
+    READY json line `{"ready": true, "port": ...}` then blocks until
+    stdin closes (the multihost_worker subprocess protocol — replica
+    launchers read the port from it). `--http-port 0` (default) binds
+    an ephemeral port. `--replicas N` (N>1) spawns N single-engine
+    replica processes of THIS command line and fronts them with the
+    headroom-aware ReplicaRouter (serve/router.py). `--http --smoke`
+    self-drives through the real socket (for decode models: half the
+    generates streamed over SSE) and prints one JSON summary — the
+    network-front tier-1 CI probe.
 
 `--precompile` AOT-compiles every shape bucket before traffic (warm
 compile cache => zero fresh programs; decode registrations always
@@ -197,6 +208,216 @@ def _stdin_loop(engine, name: str, dtype) -> int:
     return 0
 
 
+# ------------------------------------------------------ network front
+def _post_json(url: str, body: dict, timeout: float = 60.0) -> dict:
+    import urllib.request
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _sse_tokens(url: str, body: dict, timeout: float = 120.0):
+    """POST a streamed /v1/generate and collect its SSE tokens,
+    counting the distinct socket arrivals (reads) — incremental
+    delivery shows many arrivals, a buffered-to-EOS stream one."""
+    import urllib.request
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    tokens, reads = [], 0
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        for raw in resp:
+            line = raw.decode().strip()
+            if line:
+                reads += 1
+            if line.startswith("data:") and '"token"' in line:
+                tokens.append(json.loads(line.split(":", 1)[1])["token"])
+            elif line.startswith("event: done"):
+                break
+    return tokens, reads
+
+
+def _http_smoke(base_url: str, name: str, *, decode: bool,
+                feature_shape=None, dtype=None, threads: int = 4,
+                requests: int = 8, max_new: int = 16,
+                seed: int = 0, max_batch: int = 16) -> dict:
+    """Self-drive the network front through REAL sockets: T client
+    threads POST R requests each; decode models stream every second
+    generate over SSE and assert the stream matches its non-streamed
+    twin (bit-identical greedy decode)."""
+    import urllib.request
+
+    import numpy as np
+    errors: list = []
+    ok = [0]
+    streamed = [0]
+
+    def predict_client(ti):
+        rr = np.random.RandomState(seed + ti)
+        try:
+            for _ in range(requests):
+                n = int(rr.randint(1, max_batch + 1))
+                x = _rand(rr, feature_shape, dtype, n)
+                out = _post_json(base_url + "/v1/predict",
+                                 {"model": name, "inputs": x.tolist(),
+                                  "dtype": str(dtype),
+                                  "client": f"smoke-{ti}"})
+                assert out["rows"] == n, (out["rows"], n)
+                ok[0] += 1
+        except Exception as exc:         # noqa: BLE001 — in the JSON
+            errors.append(f"client {ti}: {exc!r}")
+
+    def decode_client(ti):
+        rr = np.random.RandomState(seed + ti)
+        try:
+            for k in range(requests):
+                plen = int(rr.randint(1, 12))
+                prompt = [int(t) for t in rr.randint(2, 48, plen)]
+                body = {"model": name, "prompt": prompt,
+                        "max_new_tokens": max_new,
+                        "client": f"smoke-{ti}"}
+                if k % 2 == 0:
+                    out = _post_json(base_url + "/v1/generate", body)
+                    assert 1 <= out["count"] <= max_new, out
+                else:
+                    toks, _ = _sse_tokens(base_url + "/v1/generate",
+                                          {**body, "stream": True})
+                    assert 1 <= len(toks) <= max_new, len(toks)
+                    ref = _post_json(base_url + "/v1/generate", body)
+                    assert toks == ref["tokens"], (
+                        "stream/non-stream mismatch")
+                    streamed[0] += 1
+                ok[0] += 1
+        except Exception as exc:         # noqa: BLE001 — in the JSON
+            errors.append(f"client {ti}: {exc!r}")
+
+    from bigdl_tpu.utils.threads import spawn
+    client = decode_client if decode else predict_client
+    ts = [spawn(client, name=f"serve-http-smoke-{ti}", args=(ti,),
+                start=False) for ti in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    health = json.loads(urllib.request.urlopen(
+        base_url + "/healthz", timeout=10).read())
+    from bigdl_tpu import observe
+    from bigdl_tpu.serve.batcher import LATENCY_MS_BOUNDS
+    h = observe.histogram("serve/net/http_ms", LATENCY_MS_BOUNDS)
+    return {
+        "mode": "http-smoke",
+        "model": name,
+        "decode": decode,
+        "url": base_url,
+        "clients": threads,
+        "requests_sent": threads * requests,
+        "requests_ok": ok[0],
+        "sse_streams": streamed[0],
+        "errors": errors[:5],
+        "healthz_ok": bool(health.get("ok")),
+        "http_p50_ms": round(h.quantile(0.5), 3) if h.count else None,
+        "http_p99_ms": round(h.quantile(0.99), 3) if h.count else None,
+    }
+
+
+def _http_serve_loop(front, extra: dict) -> int:
+    """READY line + block until stdin closes (the subprocess replica
+    protocol: the launcher reads the port, closing our stdin is the
+    graceful-shutdown signal)."""
+    print(json.dumps({"ready": True, "port": front.port,
+                      "url": front.url, **extra}), flush=True)
+    for _ in sys.stdin:                  # pragma: no branch — blocks
+        pass
+    return 0
+
+
+def _child_cli_args(args) -> list:
+    """Reconstruct the per-replica command line from our own flags
+    (everything model-shaped; the launcher adds --http --http-port 0)."""
+    out = []
+    if args.factory:
+        out.append(args.factory)
+    if args.input:
+        out += ["--input", args.input]
+    if args.decode:
+        out.append("--decode")
+    for flag, val in (("--slots", args.slots),
+                      ("--max-seq-len", args.max_seq_len),
+                      ("--prefill-chunk", args.prefill_chunk),
+                      ("--eos", args.eos),
+                      ("--max-batch", args.max_batch),
+                      ("--max-wait-ms", args.max_wait_ms),
+                      ("--max-queue-rows", args.max_queue_rows)):
+        if val is not None:
+            out += [flag, str(val)]
+    out += ["--max-new", str(args.max_new), "--name", args.name,
+            "--seed", str(args.seed)]
+    if args.int8:
+        out.append("--int8")
+    if args.precompile:
+        out.append("--precompile")
+    return out
+
+
+def _router_main(args, replicas: int) -> int:
+    """--http --replicas N: N replica processes + router + front."""
+    from bigdl_tpu.serve import net as _net
+    from bigdl_tpu.serve import router as _router
+    procs, urls = _router.launch_replicas(
+        replicas, _child_cli_args(args))
+    front = None
+    try:
+        backend = _router.ReplicaRouter(urls)
+        front = _net.ServeFront(
+            backend, port=args.http_port if args.http_port is not None
+            else 0)
+        if args.smoke:
+            feature = (_parse_input(args.input)
+                       if args.input else (None, None))
+            rec = _http_smoke(
+                front.url, args.name, decode=args.decode,
+                feature_shape=feature[0], dtype=feature[1],
+                threads=args.smoke_threads,
+                requests=args.smoke_requests, max_new=args.max_new,
+                seed=args.seed,
+                max_batch=min(args.max_batch or 16, 16))
+            rec["replicas"] = replicas
+            print(json.dumps(rec))
+            return 1 if rec["errors"] else 0
+        return _http_serve_loop(front, {"replicas": replicas,
+                                        "replica_urls": urls})
+    finally:
+        if front is not None:
+            front.close()
+        _router.stop_replicas(procs)
+
+
+def _http_main(engine, args, *, decode: bool, feature=(None, None)
+               ) -> int:
+    """--http over the in-process engine: front + smoke or READY loop."""
+    from bigdl_tpu.serve import net as _net
+    front = _net.ServeFront(
+        _net.LocalBackend(engine),
+        port=args.http_port if args.http_port is not None else 0)
+    try:
+        if args.smoke:
+            rec = _http_smoke(
+                front.url, args.name, decode=decode,
+                feature_shape=feature[0], dtype=feature[1],
+                threads=args.smoke_threads,
+                requests=args.smoke_requests, max_new=args.max_new,
+                seed=args.seed,
+                max_batch=min(args.max_batch or 16, 16))
+            print(json.dumps(rec))
+            return 1 if rec["errors"] else 0
+        return _http_serve_loop(front, {"decode": decode,
+                                        "model": args.name})
+    finally:
+        front.close()
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m bigdl_tpu.serve",
@@ -236,6 +457,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "(sharded batch inference)")
     ap.add_argument("--precompile", action="store_true",
                     help="AOT-compile every shape bucket before traffic")
+    ap.add_argument("--http", action="store_true",
+                    help="serve over the HTTP/SSE network front "
+                         "(serve/net.py) instead of stdin")
+    ap.add_argument("--http-port", type=int, default=None,
+                    help="network-front port (0/default = ephemeral, "
+                         "printed in the READY line; knob: "
+                         "BIGDL_TPU_SERVE_HTTP_PORT)")
+    ap.add_argument("--replicas", type=int, default=None,
+                    help="with --http: spawn N replica processes and "
+                         "front them with the ReplicaRouter "
+                         "(BIGDL_TPU_SERVE_REPLICAS)")
     ap.add_argument("--smoke", action="store_true",
                     help="self-drive concurrent clients, print one JSON "
                          "summary, exit (CI probe)")
@@ -244,6 +476,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="requests per smoke client thread")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
+
+    if args.http:
+        from bigdl_tpu.utils import config
+        replicas = (args.replicas if args.replicas is not None
+                    else int(config.get("SERVE_REPLICAS")))
+        if replicas > 1:
+            # The parent is transport-only: no model, no engine, no
+            # jax — each replica subprocess owns a full engine.
+            return _router_main(args, replicas)
 
     from bigdl_tpu.utils.platform import force_cpu_if_requested
     force_cpu_if_requested()
@@ -269,6 +510,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 args.name, model, params, state, mesh=mesh, decode=True,
                 num_slots=args.slots, max_seq_len=args.max_seq_len,
                 prefill_chunk=args.prefill_chunk, eos_id=args.eos)
+            if args.http:
+                return _http_main(engine, args, decode=True)
             if args.smoke:
                 rec = _decode_smoke(
                     engine, args.name, threads=args.smoke_threads,
@@ -298,6 +541,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             int8=True if args.int8 else None,
             precompile_input=((feature_shape, dtype)
                               if args.precompile else None))
+        if args.http:
+            return _http_main(engine, args, decode=False,
+                              feature=(feature_shape, dtype))
         if args.smoke:
             rec = _smoke(engine, args.name, feature_shape, dtype,
                          threads=args.smoke_threads,

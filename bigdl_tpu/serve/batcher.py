@@ -135,6 +135,10 @@ class ContinuousBatcher:
         self._fill_model = observe.histogram(f"serve/{name}/batch_fill",
                                              BATCH_FILL_BOUNDS)
         self._depth = observe.gauge("serve/queue_depth")
+        # sheds are counted per model AND globally: one hot model at its
+        # bound must be tellable apart from fleet-wide overload
+        # (docs/serving.md "admission control")
+        self._shed_model = observe.counter(f"serve/{name}/shed")
         if start:
             self.start()
 
@@ -157,6 +161,7 @@ class ContinuousBatcher:
                 raise Closed(f"batcher {self.name!r} is shut down")
             if self._rows + req.n > self.max_queue_rows:
                 observe.counter("serve/shed").inc()
+                self._shed_model.inc()
                 observe.instant("serve/shed", cat="serve",
                                 args={"model": self.name,
                                       "queued_rows": self._rows})

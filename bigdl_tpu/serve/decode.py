@@ -296,6 +296,7 @@ class GenReply:
     def __init__(self):
         self._tokens: _queue.Queue = _queue.Queue()
         self._done = threading.Event()
+        self._cancelled = threading.Event()
         self._result: Optional[np.ndarray] = None
         self._exc: Optional[BaseException] = None
 
@@ -314,6 +315,17 @@ class GenReply:
         self._done.set()
 
     # -------------------------------------------------- consumer side
+    def cancel(self) -> None:
+        """Abandon the request: the scheduler frees its decode slot at
+        the next iteration instead of generating tokens nobody reads
+        (the network front calls this when an SSE client disconnects
+        mid-stream — serve/net.py). Safe from any thread; a no-op once
+        the request completed."""
+        self._cancelled.set()
+
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
     def done(self) -> bool:
         return self._done.is_set()
 
@@ -439,6 +451,9 @@ class DecodeScheduler:
             f"serve/{n}/decode/latency_ms", LATENCY_MS_BOUNDS)
         self._h_ttft = observe.histogram(
             f"serve/{n}/decode/ttft_ms", LATENCY_MS_BOUNDS)
+        self._m_shed = observe.counter(f"serve/{n}/shed")
+        self._m_cancelled = observe.counter(
+            f"serve/{n}/decode/cancelled")
         self._win_t0 = self._clock()
         self._win_tokens = 0
         if start:
@@ -470,6 +485,7 @@ class DecodeScheduler:
                              f"down")
             if len(self._queue) >= self.max_queue:
                 observe.counter("serve/shed").inc()
+                self._m_shed.inc()
                 observe.instant("serve/shed", cat="serve",
                                 args={"model": self.name,
                                       "decode": True})
@@ -614,11 +630,44 @@ class DecodeScheduler:
                               "tokens": len(req.generated)})
         req.reply._finish(req.generated)
 
+    def _sweep_cancelled(self) -> int:
+        """Free slots (and queue positions) whose client abandoned the
+        request (`GenReply.cancel()` — e.g. an SSE consumer hung up
+        mid-stream): the slot returns to the pool THIS iteration instead
+        of decoding `max_new` tokens nobody reads. The reply completes
+        with whatever was generated so a racing `.result()` caller is
+        never stranded."""
+        freed = 0
+        with self._cv:
+            keep = []
+            for req in self._queue:
+                if req.reply.cancelled():
+                    self._m_cancelled.inc()
+                    req.reply._finish(req.generated)
+                    freed += 1
+                else:
+                    keep.append(req)
+            self._queue[:] = keep
+            self._m_queued.set(len(self._queue))
+        for s, req in enumerate(self._slots):
+            if req is not None and req.reply.cancelled():
+                self._slots[s] = None
+                self._m_cancelled.inc()
+                req.reply._finish(req.generated)
+                freed += 1
+        if freed:
+            self._m_active.set(self.active_slots)
+            observe.instant("serve/decode/cancel", cat="serve",
+                            args={"model": self.name, "freed": freed})
+        return freed
+
     def step_once(self) -> bool:
-        """One scheduler iteration: admit → prefill → decode. Returns
-        True when any work happened (the thread loop sleeps otherwise);
-        tests drive this synchronously with a fake clock."""
-        worked = self._admit() > 0
+        """One scheduler iteration: sweep cancels → admit → prefill →
+        decode. Returns True when any work happened (the thread loop
+        sleeps otherwise); tests drive this synchronously with a fake
+        clock."""
+        worked = self._sweep_cancelled() > 0
+        worked = self._admit() > 0 or worked
         worked = self._prefill_pass() > 0 or worked
         worked = self._decode_pass() > 0 or worked
         return worked
@@ -767,6 +816,7 @@ class DecodeScheduler:
             "step_p99_ms": round(step.quantile(0.99), 3),
             "p99_ms": round(lat.quantile(0.99), 3),
             "queue_wait_p99_ms": round(qw.quantile(0.99), 3),
+            "cancelled": int(self._m_cancelled.value),
         }
 
 

@@ -42,7 +42,38 @@ from bigdl_tpu.utils.threads import make_lock
 
 log = logging.getLogger("bigdl_tpu")
 
-__all__ = ["ServeEngine", "Reply", "GenReply", "Overloaded", "Closed"]
+__all__ = ["ServeEngine", "Reply", "GenReply", "Overloaded", "Closed",
+           "parse_model_queue_rows"]
+
+
+def parse_model_queue_rows(raw: str) -> Dict[str, int]:
+    """Parse BIGDL_TPU_SERVE_MODEL_QUEUE_ROWS: '' -> {} (every model
+    takes the SERVE_MAX_QUEUE_ROWS default), a bare int ('512') -> a
+    '*' wildcard entry applying to every model, 'm1=512,m2=256' ->
+    per-model entries (a bare int may ride the same list as the
+    default for unnamed models). Raises ValueError on garbage — a
+    typo'd admission bound must not silently become the default."""
+    out: Dict[str, int] = {}
+    for part in (raw or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part:
+            model, _, rows = part.partition("=")
+            model = model.strip()
+            if not model:
+                raise ValueError(
+                    f"SERVE_MODEL_QUEUE_ROWS entry {part!r}: empty "
+                    f"model name")
+            out[model] = int(rows)
+        else:
+            out["*"] = int(part)
+    for model, rows in out.items():
+        if rows < 1:
+            raise ValueError(
+                f"SERVE_MODEL_QUEUE_ROWS for {model!r} must be >= 1, "
+                f"got {rows}")
+    return out
 
 
 class Reply:
@@ -89,7 +120,13 @@ class ServeEngine:
         self._defaults = {
             "max_batch": config.get("SERVE_MAX_BATCH"),
             "max_wait_ms": config.get("SERVE_MAX_WAIT_MS"),
+            # the global bound is the FLEET-WIDE cap (total queued rows
+            # across every model of this engine); per-model bounds come
+            # from SERVE_MODEL_QUEUE_ROWS / register(max_queue_rows=)
+            # and default to the same value (docs/serving.md)
             "max_queue_rows": config.get("SERVE_MAX_QUEUE_ROWS"),
+            "model_queue_rows": parse_model_queue_rows(
+                config.get("SERVE_MODEL_QUEUE_ROWS")),
         }
         if install_sigterm:
             # the trainers' preemption path doubles as the server's
@@ -157,12 +194,17 @@ class ServeEngine:
         if precompile_input is not None:
             shape, dtype = precompile_input
             entry.precompile_for(tuple(shape), dtype)
+        if max_queue_rows is None:
+            # per-model admission bound: explicit arg > per-model env
+            # entry > bare-int env wildcard > the global default
+            mq = d["model_queue_rows"]
+            max_queue_rows = mq.get(name, mq.get("*",
+                                                 d["max_queue_rows"]))
         batcher = ContinuousBatcher(
             entry.dispatch, entry.buckets, name=name, coalesce=coalesce,
             max_wait_ms=max_wait_ms if max_wait_ms is not None
             else d["max_wait_ms"],
-            max_queue_rows=max_queue_rows if max_queue_rows is not None
-            else d["max_queue_rows"],
+            max_queue_rows=max_queue_rows,
             start=False)
         batcher.start(stop_check=faults.preempt_requested)
         with self._lock:
@@ -199,8 +241,28 @@ class ServeEngine:
                              "carry at least one row")
         with self._lock:
             batcher = self._batchers.get(name)
+            total_rows = sum(b.queued_rows
+                             for b in self._batchers.values())
         if batcher is None:
             raise KeyError(f"no model {name!r} registered")
+        # fleet-wide cap: the global SERVE_MAX_QUEUE_ROWS bounds TOTAL
+        # queued rows across every model of this engine — per-model
+        # bounds shape one model's queue, this one protects the host
+        # (the check is advisory-at-admission: concurrent submits may
+        # overshoot by one request, which is the same race the
+        # per-model bound already tolerates between lock scopes)
+        fleet_cap = self._defaults["max_queue_rows"]
+        if total_rows + x.shape[0] > fleet_cap:
+            observe.counter("serve/shed").inc()
+            observe.counter(f"serve/{name}/shed").inc()
+            observe.instant("serve/shed", cat="serve",
+                            args={"model": name, "fleet": True,
+                                  "queued_rows": total_rows})
+            raise Overloaded(
+                f"fleet-wide queue at bound: {total_rows} rows queued "
+                f"across {len(self._batchers)} model(s) + "
+                f"{x.shape[0]} requested > {fleet_cap} "
+                f"(BIGDL_TPU_SERVE_MAX_QUEUE_ROWS)")
         cap = batcher.buckets[-1]
         if x.shape[0] <= cap:
             return Reply([batcher.submit(x)])
@@ -209,6 +271,7 @@ class ServeEngine:
         # adjacent so they pack into full buckets
         if x.shape[0] > batcher.max_queue_rows:
             observe.counter("serve/shed").inc()
+            observe.counter(f"serve/{name}/shed").inc()
             raise Overloaded(
                 f"request of {x.shape[0]} rows exceeds the queue bound "
                 f"{batcher.max_queue_rows} for model {name!r}")
@@ -292,6 +355,8 @@ class ServeEngine:
                 "mean_batch_fill": round(mfill.sum / mfill.count, 4)
                 if mfill.count else 0.0,
                 "queued_rows": b.queued_rows,
+                "max_queue_rows": b.max_queue_rows,
+                "shed": int(reg.counter(f"serve/{name}/shed").value),
                 "buckets": list(b.buckets),
             }
         for name, sched in decoders.items():
@@ -304,6 +369,33 @@ class ServeEngine:
             "mean_batch_fill": round(fill.sum / fill.count, 4)
             if fill.count else 0.0,
         }
+        return out
+
+    def queue_state(self) -> Dict[str, Dict]:
+        """Lightweight admission view — per-model queue occupancy vs
+        bound, decode slot availability — read by the network front's
+        priority quota and /healthz (serve/net.py) without the
+        histogram walks stats() pays."""
+        with self._lock:
+            batchers = dict(self._batchers)
+            decoders = dict(self._decoders)
+        out: Dict[str, Dict] = {}
+        for name, b in batchers.items():
+            bound = b.max_queue_rows
+            out[name] = {"decode": False,
+                         "queued_rows": b.queued_rows,
+                         "max_queue_rows": bound,
+                         "utilization": (b.queued_rows / bound)
+                         if bound else 0.0}
+        for name, s in decoders.items():
+            out[name] = {"decode": True,
+                         "queued": s.queued,
+                         "max_queue": s.max_queue,
+                         "active_slots": s.active_slots,
+                         "free_slots": (s.entry.num_slots
+                                        - s.active_slots),
+                         "utilization": (s.queued / s.max_queue)
+                         if s.max_queue else 0.0}
         return out
 
     # ----------------------------------------------------------- shutdown

@@ -274,6 +274,45 @@ _register("SERVE_MAX_SEQ_LEN", 1024, int,
           "arrays are allocated once per model and donated across "
           "steps (serve/decode.py). Per-model override: "
           "ServeEngine.register(max_seq_len=...)")
+_register("SERVE_MODEL_QUEUE_ROWS", "", str,
+          "Per-model admission bounds for the serve queues "
+          "(serve/engine.py): '' = every model takes the "
+          "SERVE_MAX_QUEUE_ROWS default; a bare int applies to every "
+          "model; 'm1=512,m2=256' sets named models (a bare int may "
+          "ride the same list as the default for the rest). "
+          "register(max_queue_rows=...) still wins. The global "
+          "SERVE_MAX_QUEUE_ROWS stays the FLEET-WIDE cap on total "
+          "queued rows across all models of one engine")
+_register("SERVE_HTTP_PORT", 0, int,
+          "Serving network front (serve/net.py): HTTP port for the "
+          "/v1/predict /v1/generate /v1/models /healthz request plane "
+          "over this process's ServeEngine. 0 (default) = off; the "
+          "CLI (`python -m bigdl_tpu.serve --http`) passes its own "
+          "port (0 there binds an ephemeral one and prints it)")
+_register("SERVE_HTTP_HOST", "127.0.0.1", str,
+          "Bind address for the serving network front. Loopback by "
+          "default — widening the bind to real traffic is a "
+          "deliberate operator choice (docs/serving.md runbook)")
+_register("SERVE_REPLICAS", 1, int,
+          "`python -m bigdl_tpu.serve --http` replica count: N > 1 "
+          "spawns N single-engine replica processes and fronts them "
+          "with the headroom-aware ReplicaRouter (serve/router.py) "
+          "instead of serving one in-process engine")
+_register("SERVE_BATCH_QUOTA_PCT", 50.0, float,
+          "Priority admission quota (serve/net.py): requests in the "
+          "'batch' priority class are shed with 429 once a model's "
+          "queue is fuller than this percent of its bound, reserving "
+          "the rest for 'interactive' traffic. 100 disables the "
+          "distinction; 0 rejects all batch traffic")
+_register("SERVE_ROUTER_RETRIES", 2, int,
+          "ReplicaRouter (serve/router.py): attempts on OTHER replicas "
+          "after a replica death/connection failure before the request "
+          "fails (predict is idempotent; a resumed stream skips "
+          "already-delivered tokens). 0 = no failover")
+_register("SERVE_ROUTER_HEALTH_TTL_S", 0.5, float,
+          "ReplicaRouter placement-state cache: seconds a replica's "
+          "/healthz headroom+queue snapshot stays fresh before the "
+          "next placement re-scrapes it (0 = scrape every request)")
 _register("DATA_SERVICE", True, _bool,
           "Streaming input service (dataset/service.py): trainers feed "
           "through the staged host pipeline — background read-ahead, "

@@ -1,0 +1,161 @@
+"""Shared loopback-HTTP server core — one threading discipline for
+every in-process HTTP plane.
+
+`observe/statusz.py` (PR 8) proved the stdlib shape for an HTTP server
+living inside a training/serving process: `ThreadingHTTPServer` with
+daemon handler threads, the accept loop on a named `utils.threads`
+thread, an ephemeral-port path for tests, and a shutdown that swaps
+state under a lock but joins OUTSIDE it (the sanitizer's long-hold
+rule — a join waits hundreds of ms on the HTTP thread). The serving
+network front (serve/net.py) needs the identical discipline, so the
+core is extracted here rather than duplicated:
+
+  * :class:`JSONHandler` — request-handler base: JSON `_send`,
+    body decode via `_read_json`, access logs routed to the
+    `bigdl_tpu` logger at DEBUG (an HTTP server inside a trainer must
+    never write to stderr per request).
+  * :class:`HTTPServerThread` — owns the `ThreadingHTTPServer` and its
+    accept thread; `port` is the RESOLVED port (bind with 0 to get an
+    ephemeral one); `close()` is idempotent and joins the thread.
+  * :class:`ServerSlot` — the process-wide start-once/stop pattern:
+    `start()` races are serialized by a named lock, `stop()` swaps the
+    slot to None under the lock and closes outside it.
+
+Binds loopback by default everywhere — widening a bind is a deliberate
+operator choice made per-plane via its host knob.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from bigdl_tpu.utils.threads import make_lock, spawn
+
+__all__ = ["JSONHandler", "HTTPServerThread", "ServerSlot"]
+
+log = logging.getLogger("bigdl_tpu")
+
+
+class JSONHandler(BaseHTTPRequestHandler):
+    """Handler base with the repo's JSON/logging conventions.
+
+    Subclasses set `server_version` and implement do_GET/do_POST using
+    `_send` / `_send_json` / `_read_json`. `protocol_version` stays
+    HTTP/1.1 so keep-alive and chunked transfer encoding (the SSE
+    streaming leg) work; `_send` always sets Content-Length, which
+    keep-alive requires.
+    """
+
+    protocol_version = "HTTP/1.1"
+    log_prefix = "httpd"
+    # TCP_NODELAY: without it, a keep-alive client that writes headers
+    # and body in separate segments trips Nagle against the peer's
+    # delayed ACK — ~40ms stalls per request on loopback. An RPC plane
+    # sends small latency-critical writes; never batch them in the
+    # kernel.
+    disable_nagle_algorithm = True
+
+    def log_message(self, fmt, *args):   # noqa: N802 — http.server API
+        log.debug(self.log_prefix + ": " + fmt, *args)
+
+    def _send(self, code: int, body: str,
+              ctype: str = "application/json",
+              headers: Optional[dict] = None) -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype + "; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_json(self, code: int, obj,
+                   headers: Optional[dict] = None) -> None:
+        self._send(code, json.dumps(obj, default=str), headers=headers)
+
+    def _read_json(self, max_bytes: int = 64 * 1024 * 1024):
+        """Decode the request body as JSON. Raises ValueError on a
+        missing/oversized/undecodable body — callers map that to 400."""
+        try:
+            n = int(self.headers.get("Content-Length") or 0)
+        except (TypeError, ValueError):
+            raise ValueError("bad Content-Length header")
+        if n <= 0:
+            raise ValueError("empty request body (JSON expected)")
+        if n > max_bytes:
+            raise ValueError(f"request body too large ({n} bytes)")
+        raw = self.rfile.read(n)
+        try:
+            return json.loads(raw.decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise ValueError(f"request body is not JSON: {e}")
+
+
+class _Server(ThreadingHTTPServer):
+    # stdlib default listen backlog is 5 — a burst of concurrent
+    # clients overflows it and eats SYN-retransmit stalls; an RPC
+    # plane needs a real accept queue
+    request_queue_size = 128
+
+
+class HTTPServerThread:
+    """A `ThreadingHTTPServer` plus its accept thread, owned together.
+
+    `port=0` binds an ephemeral port; `self.port` is always the
+    resolved one. Handler threads are daemonic (an abrupt interpreter
+    exit never hangs on a slow client); the accept thread is spawned
+    through `utils.threads` under `thread_name` and joined by
+    :meth:`close` — the daemon-plus-explicit-join contract of
+    docs/concurrency.md.
+    """
+
+    def __init__(self, handler_cls, port: int, host: str = "127.0.0.1",
+                 *, thread_name: str = "httpd"):
+        self.httpd = _Server((host, port), handler_cls)
+        self.httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self.httpd.server_address[1])
+        self._thread = spawn(self.httpd.serve_forever, name=thread_name)
+
+    def close(self, timeout: float = 5.0) -> None:
+        try:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+        except Exception:                # noqa: BLE001 — shutdown path
+            pass
+        self._thread.join(timeout=timeout)
+
+
+class ServerSlot:
+    """Process-wide start-once holder for one HTTP plane.
+
+    `start(factory)` returns the live server or builds one via
+    `factory()` (which may return None — e.g. the knob says off, or
+    the bind failed); concurrent starters are serialized. `stop()`
+    swaps the slot empty under the lock and calls `close()` OUTSIDE
+    it, because close joins the accept thread.
+    """
+
+    def __init__(self, name: str):
+        self._lock = make_lock(name)
+        self._server = None
+
+    def start(self, factory: Callable[[], Optional[HTTPServerThread]]):
+        with self._lock:
+            if self._server is not None:
+                return self._server
+            self._server = factory()
+            return self._server
+
+    def get(self):
+        return self._server
+
+    def stop(self) -> None:
+        with self._lock:
+            server, self._server = self._server, None
+        if server is not None:
+            server.close()
