@@ -242,6 +242,21 @@ class GPT2LM(Module):
         return self._slot_hidden(params, caches, tokens, positions,
                                  active)[1]
 
+    def _finish_logits(self, params, x):
+        x, _ = self.children()["ln_f"].apply(params["ln_f"], {}, x)
+        return x[:, -1] @ self._head(params).T
+
+    def decode_logits(self, params, caches, tokens_last, positions,
+                      active):
+        """decode_step stopped before the token choice: returns
+        (last-position logits (S, V), new caches) so the serving layer
+        can compose its own sampler (nn/sampling.py) into the fused
+        step."""
+        x, caches = self._slot_hidden(
+            params, caches, tokens_last[:, None], positions[:, None],
+            active)
+        return self._finish_logits(params, x), caches
+
     def decode_step(self, params, caches, tokens_last, positions,
                     active):
         """One iteration-level greedy decode step over the slot batch:
@@ -249,11 +264,70 @@ class GPT2LM(Module):
         (next_tokens (S,) int32, new caches). Inactive rows' caches are
         bit-preserved and their next_tokens are meaningless (the
         scheduler masks them)."""
-        x, caches = self._slot_hidden(
+        logits, caches = self.decode_logits(
+            params, caches, tokens_last, positions, active)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), caches
+
+    # -------------------------------------------------- paged KV decoding
+    # The PAGED decode-serving contract (serve/decode.py BlockPool):
+    # same slot-batch semantics, but K/V live in a shared pool of
+    # fixed-size blocks addressed through a per-slot block table
+    # (nn/attention.paged_slot_cached_attend). Per-row numerics stay
+    # bit-identical to the dense slot path (the paged-vs-dense oracle in
+    # tests/test_decode.py). Inactive rows and padded prefill tails
+    # scatter with mode='drop' instead of _restore_inactive — they never
+    # touch the pool.
+    def make_paged_slot_caches(self, params, num_blocks: int, block: int):
+        """Zero per-layer KV pools of (num_blocks, block, H, hd) — the
+        shared block pool the decode engine's BlockPool allocates out
+        of."""
+        H = self.children()["h0"].attn.num_heads
+        hd = self.d_model // H
+        dtype = params["wte"].dtype
+        zeros = lambda: jnp.zeros(                         # noqa: E731
+            (num_blocks, block, H, hd), dtype)
+        return (tuple(zeros() for _ in range(self.num_layers)),
+                tuple(zeros() for _ in range(self.num_layers)))
+
+    def _paged_slot_hidden(self, params, caches, tokens, positions,
+                           block_table, lengths):
+        cks, cvs = caches
+        pos = jnp.clip(positions, 0, self.n_positions - 1)
+        x = params["wte"][tokens] + params["wpe"][pos]
+        new_ck, new_cv = [], []
+        for i in range(self.num_layers):
+            x, ck_i, cv_i = \
+                self.children()[f"h{i}"].paged_slot_cached_step(
+                    params[f"h{i}"], x, cks[i], cvs[i], pos,
+                    block_table, lengths)
+            new_ck.append(ck_i)
+            new_cv.append(cv_i)
+        return x, (tuple(new_ck), tuple(new_cv))
+
+    def paged_prefill(self, params, caches, tokens, positions,
+                      block_table, lengths):
+        """`prefill` against the paged pool: tokens/positions (S, C)
+        int32, block_table (S, M) int32 (-1 = unacquired), lengths (S,)
+        int32 = VALID leading tokens per row (0 = inactive; padded tail
+        tokens of a rounded-up bucket are dropped, not written).
+        Returns the new pool caches."""
+        return self._paged_slot_hidden(params, caches, tokens, positions,
+                                       block_table, lengths)[1]
+
+    def paged_decode_logits(self, params, caches, tokens_last, positions,
+                            active, block_table):
+        """`decode_logits` against the paged pool."""
+        x, caches = self._paged_slot_hidden(
             params, caches, tokens_last[:, None], positions[:, None],
-            active)
-        x, _ = self.children()["ln_f"].apply(params["ln_f"], {}, x)
-        logits = x[:, -1] @ self._head(params).T
+            block_table, active.astype(jnp.int32))
+        return self._finish_logits(params, x), caches
+
+    def paged_decode_step(self, params, caches, tokens_last, positions,
+                          active, block_table):
+        """`decode_step` against the paged pool: one fused greedy step,
+        writes at each row's position through its block table."""
+        logits, caches = self.paged_decode_logits(
+            params, caches, tokens_last, positions, active, block_table)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), caches
 
 
@@ -587,6 +661,43 @@ class LlamaBlock(Module):
         dn, _ = c["down"].apply(params["down"], {}, jax.nn.silu(g) * u)
         return x + dn, ck, cv
 
+    def paged_slot_cached_step(self, params, x, ck_pool, cv_pool,
+                               positions, block_table, lengths):
+        """`slot_cached_step` against a PAGED grouped-KV pool
+        (nn/attention.paged_slot_cached_attend) — per-row RoPE as in the
+        dense slot path, K/V scattered into pool blocks through the
+        slot's block table. Bit-identical per row to slot_cached_step
+        with a dense cache row."""
+        from bigdl_tpu.nn.attention import (rotary_embedding,
+                                            paged_slot_cached_attend)
+        c = self.children()
+        attn = c["attn"]
+        if callable(attn.attn_impl):
+            raise ValueError(
+                "paged_slot_cached_step decodes through the dense "
+                "attention core; this block was built with a custom "
+                "attn_impl whose numerics it cannot reproduce")
+        N, T, d = x.shape
+        H, hd = attn.num_heads, attn.head_dim
+        KV = attn.num_kv_heads or H
+        at = params["attn"]
+        h, _ = c["ln1"].apply(params["ln1"], {}, x)
+        q = (h @ at["wq"]).reshape(N, T, H, hd)
+        k = (h @ at["wk"]).reshape(N, T, KV, hd)
+        v = (h @ at["wv"]).reshape(N, T, KV, hd)
+        q = rotary_embedding(q.transpose(0, 2, 1, 3), attn.rope_theta,
+                             positions)
+        k = rotary_embedding(k.transpose(0, 2, 1, 3), attn.rope_theta,
+                             positions).transpose(0, 2, 1, 3)
+        a, ck_pool, cv_pool = paged_slot_cached_attend(
+            q, k, v, ck_pool, cv_pool, positions, block_table, lengths)
+        x = x + a @ at["wo"]
+        h, _ = c["ln2"].apply(params["ln2"], {}, x)
+        g, _ = c["gate"].apply(params["gate"], {}, h)
+        u, _ = c["up"].apply(params["up"], {}, h)
+        dn, _ = c["down"].apply(params["down"], {}, jax.nn.silu(g) * u)
+        return x + dn, ck_pool, cv_pool
+
 
 class LlamaLM(Module):
     """LLaMA-architecture causal LM (RMSNorm + RoPE + GQA + SwiGLU) on
@@ -714,15 +825,76 @@ class LlamaLM(Module):
         return self._slot_hidden(params, caches, tokens, positions,
                                  active)[1]
 
+    def _finish_logits(self, params, x):
+        x, _ = self.children()["norm"].apply(params["norm"], {}, x)
+        return x[:, -1] @ self._head(params).T
+
+    def decode_logits(self, params, caches, tokens_last, positions,
+                      active):
+        """(last-position logits (S, V), new caches) — see
+        GPT2LM.decode_logits; the serving layer's sampler hook."""
+        x, caches = self._slot_hidden(
+            params, caches, tokens_last[:, None], positions[:, None],
+            active)
+        return self._finish_logits(params, x), caches
+
     def decode_step(self, params, caches, tokens_last, positions,
                     active):
         """One iteration-level greedy decode step over the slot batch
         (see GPT2LM.decode_step — same contract)."""
-        x, caches = self._slot_hidden(
+        logits, caches = self.decode_logits(
+            params, caches, tokens_last, positions, active)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), caches
+
+    # -------------------------------------------------- paged KV decoding
+    # Same paged contract as GPT2LM (serve/decode.py BlockPool): grouped
+    # KV pools, per-row RoPE offsets, scatter-drop for inactive rows and
+    # padded tails.
+    def make_paged_slot_caches(self, params, num_blocks: int, block: int):
+        """Zero per-layer grouped-KV pools (num_blocks, block, KV, hd)."""
+        attn0 = self.children()["l0"].children()["attn"]
+        KV = attn0.num_kv_heads or attn0.num_heads
+        dtype = params["embed"].dtype
+        zeros = lambda: jnp.zeros(                         # noqa: E731
+            (num_blocks, block, KV, attn0.head_dim), dtype)
+        return (tuple(zeros() for _ in range(self.num_layers)),
+                tuple(zeros() for _ in range(self.num_layers)))
+
+    def _paged_slot_hidden(self, params, caches, tokens, positions,
+                           block_table, lengths):
+        cks, cvs = caches
+        x = params["embed"][tokens]
+        new_ck, new_cv = [], []
+        for i in range(self.num_layers):
+            x, ck_i, cv_i = \
+                self.children()[f"l{i}"].paged_slot_cached_step(
+                    params[f"l{i}"], x, cks[i], cvs[i], positions,
+                    block_table, lengths)
+            new_ck.append(ck_i)
+            new_cv.append(cv_i)
+        return x, (tuple(new_ck), tuple(new_cv))
+
+    def paged_prefill(self, params, caches, tokens, positions,
+                      block_table, lengths):
+        """Paged prompt-chunk prefill (see GPT2LM.paged_prefill — same
+        contract). Returns the new pool caches."""
+        return self._paged_slot_hidden(params, caches, tokens, positions,
+                                       block_table, lengths)[1]
+
+    def paged_decode_logits(self, params, caches, tokens_last, positions,
+                            active, block_table):
+        """`decode_logits` against the paged grouped-KV pool."""
+        x, caches = self._paged_slot_hidden(
             params, caches, tokens_last[:, None], positions[:, None],
-            active)
-        x, _ = self.children()["norm"].apply(params["norm"], {}, x)
-        logits = x[:, -1] @ self._head(params).T
+            block_table, active.astype(jnp.int32))
+        return self._finish_logits(params, x), caches
+
+    def paged_decode_step(self, params, caches, tokens_last, positions,
+                          active, block_table):
+        """One fused greedy decode step against the paged pool (see
+        GPT2LM.paged_decode_step — same contract)."""
+        logits, caches = self.paged_decode_logits(
+            params, caches, tokens_last, positions, active, block_table)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), caches
 
 

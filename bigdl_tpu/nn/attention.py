@@ -220,6 +220,63 @@ def slot_cached_attend(q_heads, k_chunk, v_chunk, ck, cv, positions):
     return a.transpose(0, 2, 1, 3).reshape(N, T, H * hd), ck, cv
 
 
+def paged_slot_cached_attend(q_heads, k_chunk, v_chunk, ck_pool, cv_pool,
+                             positions, block_table, lengths):
+    """`slot_cached_attend` over a PAGED KV pool (vLLM's PagedAttention
+    discipline): instead of one dense (N, L, Hc, hd) cache row per slot,
+    K/V live in a shared pool of fixed-size blocks (P, B, Hc, hd) and
+    each slot owns an int32 `block_table` row (N, M) mapping its m-th
+    logical block to a pool block (-1 = not acquired). Lane m*B+b of the
+    gathered sequence is absolute position m*B+b of the slot — the same
+    logical layout as the dense row, so the same NEG_INF frontier mask
+    applies and per-row numerics stay bit-identical to the dense path
+    (the paged-vs-dense oracle in tests/test_decode.py): lanes past the
+    frontier — including whole unacquired blocks — are masked before the
+    softmax and their exp underflows to exactly 0.0, so stale pool pages
+    contribute nothing.
+
+    `lengths` (N,) int32 is the count of VALID leading tokens in this
+    chunk per row (0 for inactive rows): padded tail tokens of a
+    rounded-up prefill bucket and inactive rows scatter with mode='drop'
+    instead of landing in the pool — the paged analogue of the dense
+    path's tolerated-garbage + `_restore_inactive` discipline, required
+    here because a padded write could land past the slot's reserved
+    blocks.
+
+    q_heads (N, H, T, hd); k_chunk/v_chunk (N, T, Hc, hd), Hc == H or a
+    grouped divisor (GQA). Returns ((N, T, H*hd), new_ck_pool,
+    new_cv_pool)."""
+    N, H, T, hd = q_heads.shape
+    P, B, Hc, _ = ck_pool.shape
+    M = block_table.shape[1]
+    L = M * B
+    # -- scatter this chunk's K/V into the slots' pages ---------------
+    valid = jnp.arange(T)[None, :] < lengths[:, None]           # (N, T)
+    tok_block = jnp.clip(positions // B, 0, M - 1)
+    blk = jnp.take_along_axis(block_table, tok_block, axis=1)   # (N, T)
+    flat = blk * B + positions % B
+    # invalid lanes (padding, inactive rows, unacquired blocks) are
+    # pointed out of range so mode='drop' discards them
+    flat = jnp.where(valid & (blk >= 0), flat, P * B).reshape(-1)
+    ck_pool = ck_pool.reshape(P * B, Hc, hd).at[flat].set(
+        k_chunk.reshape(N * T, Hc, hd), mode="drop").reshape(ck_pool.shape)
+    cv_pool = cv_pool.reshape(P * B, Hc, hd).at[flat].set(
+        v_chunk.reshape(N * T, Hc, hd), mode="drop").reshape(cv_pool.shape)
+    # -- gather each slot's pages into its logical sequence -----------
+    safe = jnp.clip(block_table, 0, P - 1)      # -1 rows: masked anyway
+    fk = ck_pool[safe].reshape(N, L, Hc, hd).transpose(0, 2, 1, 3)
+    fv = cv_pool[safe].reshape(N, L, Hc, hd).transpose(0, 2, 1, 3)
+    if Hc != H:
+        fk = jnp.repeat(fk, H // Hc, axis=1)
+        fv = jnp.repeat(fv, H // Hc, axis=1)
+    # (N, 1, T, L): per-row causal-over-cache frontier, as in the dense
+    # slot path — L here is M*B >= max_seq_len; the extra tail lanes are
+    # always masked
+    mask = (jnp.arange(L)[None, None, :] <= positions[:, :, None])[:, None]
+    a = dot_product_attention(q_heads, fk, fv, mask)
+    return a.transpose(0, 2, 1, 3).reshape(N, T, H * hd), ck_pool, cv_pool
+
+
 class MultiHeadAttention(Module):
     """Multi-head attention (reference: nn/Attention.scala). Packed QKV
     projections; inputs (B, T, d_model). `attn_impl` picks the kernel:
@@ -466,6 +523,45 @@ class TransformerLayer(Module):
         f, _ = self.ffn.apply(params["ffn"], {},
                               self.ln2.apply(params["ln2"], {}, x)[0])
         return x + f, ck, cv
+
+    def paged_slot_cached_step(self, params, x, ck_pool, cv_pool,
+                               positions, block_table, lengths):
+        """`slot_cached_step` against a PAGED KV pool: same hand-rolled
+        projection chain, but K/V scatter into / gather from pool blocks
+        through the slot's block table (paged_slot_cached_attend).
+        Per-row numerics are bit-identical to `slot_cached_step` with a
+        dense cache row. Self-attention blocks only; same custom-
+        attn_impl refusal as cached_step."""
+        if self.cross:
+            raise ValueError("paged_slot_cached_step supports self-"
+                             "attention decoder blocks only")
+        if callable(self.attn.attn_impl):
+            raise ValueError(
+                "paged_slot_cached_step decodes through the dense "
+                "attention core; this layer was built with a custom "
+                "attn_impl whose numerics it cannot reproduce")
+        N, T, d = x.shape
+        H = self.attn.num_heads
+        hd = d // H
+        at = params["attn"]
+        h, _ = self.ln1.apply(params["ln1"], {}, x)
+        q = h @ at["wq"]
+        k = h @ at["wk"]
+        v = h @ at["wv"]
+        if self.attn.bias:
+            q, k, v = q + at["bq"], k + at["bk"], v + at["bv"]
+        q = q.reshape(N, T, H, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(N, T, H, hd)
+        v = v.reshape(N, T, H, hd)
+        a, ck_pool, cv_pool = paged_slot_cached_attend(
+            q, k, v, ck_pool, cv_pool, positions, block_table, lengths)
+        a = a @ at["wo"]
+        if self.attn.bias:
+            a = a + at["bo"]
+        x = x + a
+        f, _ = self.ffn.apply(params["ffn"], {},
+                              self.ln2.apply(params["ln2"], {}, x)[0])
+        return x + f, ck_pool, cv_pool
 
     def _apply(self, params, state, x, memory=None, *, mask=None,
                memory_mask=None, causal=False, training=False, rng=None):

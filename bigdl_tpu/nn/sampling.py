@@ -1,0 +1,68 @@
+"""Token sampling for the fused decode step (serve/decode.py) —
+temperature / top-k / top-p beyond the greedy argmax, with STATELESS
+per-slot rng so sampling stays deterministic under resume and replica
+failover.
+
+The rng discipline is the trainers' fold_in recipe (optim/local.py
+per-step keys): each slot's key for the token at absolute position p is
+
+    fold_in(fold_in(PRNGKey(0), seed), p)
+
+computed INSIDE the jitted program from the per-slot (seed, position)
+vectors the scheduler already threads. No rng state is carried between
+steps, so a request replayed from its prompt on another replica — or a
+request decoded solo vs packed into a busy batch — emits the identical
+token stream for the same seed. Greedy rows (temperature <= 0) take the
+raw-logits argmax, bit-identical to the greedy decode step: the parity
+oracle keeps covering them even when the sampling program is compiled
+in.
+
+No reference analogue — the reference's SequenceBeamSearch is
+beam-only; nucleus/top-k sampling postdates it and is table stakes for
+LLM serving.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.attention import NEG_INF
+
+
+def _sample_row(logits, temperature, top_k, top_p, seed, position):
+    """One slot's token choice. logits (V,); the rest scalars."""
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+    # top-k: drop everything below the k-th largest logit (k <= 0 or
+    # k >= V disables; ties at the threshold are all kept)
+    desc = jnp.sort(scaled)[::-1]
+    k = jnp.clip(jnp.where(top_k <= 0, V, top_k), 1, V)
+    scaled = jnp.where(scaled >= desc[k - 1], scaled, NEG_INF)
+    # top-p (nucleus): keep the smallest prefix of the descending-prob
+    # order whose mass reaches p; the top-1 token is always kept, so
+    # p <= 0 degrades to sampling from the single best token
+    probs = jax.nn.softmax(scaled)
+    sp = jnp.sort(probs)[::-1]
+    keep = (jnp.cumsum(sp) - sp) < top_p          # mass BEFORE this rank
+    min_keep = jnp.min(jnp.where(keep, sp, jnp.inf))
+    scaled = jnp.where(probs >= min_keep, scaled, NEG_INF)
+    key = jax.random.fold_in(
+        jax.random.fold_in(jax.random.PRNGKey(0), seed),  # tpu-lint: disable=004
+        position)
+    sampled = jax.random.categorical(key, scaled).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+def sample_tokens(logits, temperature, top_k, top_p, seeds, positions):
+    """Per-slot sampling over a decode batch.
+
+    logits (N, V); temperature/top_p (N,) float32; top_k/seeds/positions
+    (N,) int32. Rows with temperature <= 0 return the raw-logits argmax
+    (the greedy path, bit-identical to the non-sampling decode step);
+    others sample categorically after temperature scaling and top-k /
+    top-p filtering, keyed by fold_in(fold_in(PRNGKey(0), seed), pos).
+    Returns (N,) int32."""
+    return jax.vmap(_sample_row)(logits, temperature, top_k, top_p,
+                                 seeds, positions)

@@ -204,6 +204,13 @@ class LedgerHandle:
         if not self.closed:
             self._ledger._add_owner_bytes(self.owner, int(delta))
 
+    def update_meta(self, **meta) -> None:
+        """Merge keys into the owner's meta dict — live capacity facts
+        (a paged KV pool's free-block count) ride this without
+        re-measuring the tree."""
+        if not self.closed:
+            self._ledger._update_owner_meta(self.owner, meta)
+
     def close(self) -> None:
         if not self.closed:
             self.closed = True
@@ -226,6 +233,9 @@ class _NoopHandle(LedgerHandle):
         pass
 
     def add_bytes(self, delta: int) -> None:
+        pass
+
+    def update_meta(self, **meta) -> None:
         pass
 
     def close(self) -> None:
@@ -352,6 +362,12 @@ class BufferLedger:
         gauge(f"mem/{owner}/bytes").set(float(nbytes))
         self._refresh_totals()
 
+    def _update_owner_meta(self, owner: str, meta: dict) -> None:
+        with self._lock:
+            o = self._owners.get(owner)
+            if o is not None:
+                o.meta.update(meta)
+
     def _add_owner_bytes(self, owner: str, delta: int) -> None:
         from bigdl_tpu.observe.metrics import gauge
         with self._lock:
@@ -458,6 +474,7 @@ class BufferLedger:
         limit = util["bytes_limit"]
         free = (limit - util["bytes_in_use"]) if limit else None
         decode_slots: Dict[str, dict] = {}
+        kv_pools: Dict[str, dict] = {}
         largest_model = None
         with self._lock:
             for name, o in self._owners.items():
@@ -470,10 +487,21 @@ class BufferLedger:
                                              if free is not None and per_slot
                                              else None),
                     }
+                if o.kind == "kv_pool":
+                    # paged decode pools: headroom is the pool's own LIVE
+                    # free-block count (serve/decode.py keeps the meta
+                    # current), not a closed-form byte estimate
+                    kv_pools[name] = {
+                        "blocks": o.meta.get("blocks"),
+                        "blocks_free": o.meta.get("blocks_free"),
+                        "block_tokens": o.meta.get("block"),
+                        "bytes_per_block": o.meta.get("bytes_per_block"),
+                    }
                 if o.kind == "params" and name.startswith("serve/"):
                     if largest_model is None or o.bytes > largest_model[1]:
                         largest_model = (name, o.bytes)
-        out = {"free_bytes": free, "decode_slots": decode_slots or None}
+        out = {"free_bytes": free, "decode_slots": decode_slots or None,
+               "kv_pools": kv_pools or None}
         if largest_model is not None:
             out["one_more_model"] = {
                 "model": largest_model[0], "bytes": largest_model[1],

@@ -37,6 +37,26 @@ The model contract is duck-typed: `make_slot_caches(params, S, L)`,
 plus `vocab_size` and (default) `eos_id` — provided by the HF bridge's
 GPT2LM and LlamaLM (interop/huggingface.py).
 
+**Paged KV (default)**: models carrying the paged contract
+(`make_paged_slot_caches` / `paged_prefill` / `paged_decode_step`)
+allocate the KV cache as a shared pool of fixed-size blocks
+(`BIGDL_TPU_SERVE_KV_BLOCK` tokens each) plus per-slot int32 block
+tables (vLLM's PagedAttention discipline, threaded through
+nn/attention.paged_slot_cached_attend): HBM cost follows LIVE
+sequences, not the (num_slots x max_seq_len) worst case; slots acquire
+blocks lazily as their frontier crosses a block boundary and retire
+returns them to the free list; admission refuses with a block-level
+`CapacityError` capacity report when a request can never fit the pool.
+On top of the block table sits the **prefix cache**: whole prompt
+blocks finished by prefill are published under a chained token-hash
+key (stage-at-admit / commit-as-the-frontier-passes — compilecache's
+staging discipline applied to KV), so N requests sharing a system
+prompt pay its prefill once; entries are refcounted, copy-on-write
+never triggers (matching is block-granular, the divergence block is
+always private), and unreferenced entries are retained up to a cap,
+evicted LRU on demand and swept wholesale under memory-watchdog
+pressure.
+
 Decode greedy semantics mirror `model.generate(kv_cache=True,
 beam_size=1)` exactly: prefill the first P-1 prompt tokens, feed the
 last prompt token as the first decode input, argmax per step, stop at
@@ -68,6 +88,218 @@ from bigdl_tpu.utils.threads import make_condition, spawn
 log = logging.getLogger("bigdl_tpu")
 
 _DECODE_CONTRACT = ("make_slot_caches", "prefill", "decode_step")
+_PAGED_CONTRACT = ("make_paged_slot_caches", "paged_prefill",
+                   "paged_decode_step")
+
+
+class BlockPool:
+    """Host-side free-list allocator over the device KV block pool.
+
+    Pure bookkeeping (the device arrays never move): `total` blocks
+    split into free-list blocks, LIVE blocks (acquired by running
+    requests, or prefix-cache entries with refs > 0), and CACHED blocks
+    (prefix-cache entries with refs == 0 — evictable on demand, so they
+    count as reservable). `reserve()` promises capacity at admission;
+    `acquire_reserved()` turns one promise into a concrete block id,
+    evicting an LRU cached entry when the free list runs dry.
+
+    NOT thread-safe — the scheduler serializes every call under its
+    condition lock (the utils/threads discipline)."""
+
+    def __init__(self, total: int):
+        if total < 1:
+            raise ValueError(f"KV pool needs >= 1 block, got {total}")
+        self.total = int(total)
+        self._free: List[int] = list(range(self.total - 1, -1, -1))
+        self.reserved = 0
+        self.live = 0
+        # wired by PrefixCache when prefix caching is on
+        self.cached_count: Callable[[], int] = lambda: 0
+        self.evict_one: Callable[[], Optional[int]] = lambda: None
+
+    @property
+    def free(self) -> int:
+        return len(self._free)
+
+    def available(self) -> int:
+        """Blocks reservable right now: free + evictable-cached minus
+        outstanding reservations."""
+        return self.free + self.cached_count() - self.reserved
+
+    def reserve(self, n: int) -> bool:
+        if n > self.available():
+            return False
+        self.reserved += n
+        return True
+
+    def unreserve(self, n: int) -> None:
+        self.reserved = max(0, self.reserved - n)
+
+    def acquire_reserved(self) -> int:
+        """One reserved block -> concrete block id (free list first,
+        then LRU prefix-cache eviction — reserve() guaranteed one of
+        the two exists)."""
+        if not self._free:
+            b = self.evict_one()
+            if b is None:
+                raise RuntimeError(
+                    "KV pool reservation accounting violated: no free "
+                    "or evictable block for an admitted request")
+            self._free.append(b)
+        self.reserved -= 1
+        self.live += 1
+        return self._free.pop()
+
+    def release(self, block: int) -> None:
+        """Return one live private block to the free list."""
+        self.live -= 1
+        self._free.append(block)
+
+
+class _PrefixEntry:
+    __slots__ = ("key", "block", "refs", "tick")
+
+    def __init__(self, key: bytes, block: int, tick: int):
+        self.key = key
+        self.block = block
+        self.refs = 1
+        self.tick = tick
+
+
+class PrefixCache:
+    """Refcounted shared-prefix KV blocks over a :class:`BlockPool`.
+
+    Keys are a CHAINED blake2b hash over whole prompt blocks
+    (`h_j = H(h_{j-1} || tokens[j*B:(j+1)*B])`), so holding key j
+    implies the entire j-block prefix matches — matching is a simple
+    walk until the first miss. Only blocks fully inside the PREFILL
+    region (the first P-1 prompt tokens) are ever keyed; matching is
+    block-granular, so the divergence block is always private and
+    copy-on-write never has to copy.
+
+    Lifecycle (the compilecache staging/commit discipline): a request
+    STAGES its chain keys at admission; as its prefill frontier passes
+    the end of block j the block is COMMITTED — published with refs=1
+    (the committer's own reference). Later requests `take()` committed
+    runs (incref). Retire decrefs; at refs==0 the entry stays CACHED
+    (evictable) up to `cap` unreferenced blocks — beyond it, and
+    whenever the pool needs a block, the LRU entry is evicted; a
+    memory-watchdog alert sweeps every unreferenced entry.
+
+    Same lock discipline as BlockPool: the scheduler serializes."""
+
+    def __init__(self, pool: BlockPool, cap: int):
+        self.pool = pool
+        self.cap = int(cap)
+        self._entries: Dict[bytes, _PrefixEntry] = {}
+        self._ref0 = 0
+        self._tick = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.committed = 0
+        pool.cached_count = self.cached_count
+        pool.evict_one = self._evict_lru
+
+    @staticmethod
+    def chain_keys(prompt: np.ndarray, block: int,
+                   prefill_target: int) -> List[bytes]:
+        """The chained hash key of every whole prompt block inside the
+        prefill region (tokens [0, prefill_target))."""
+        import hashlib
+        keys: List[bytes] = []
+        h = b""
+        for j in range(prefill_target // block):
+            h = hashlib.blake2b(
+                h + np.ascontiguousarray(
+                    prompt[j * block:(j + 1) * block]).tobytes(),
+                digest_size=16).digest()
+            keys.append(h)
+        return keys
+
+    def cached_count(self) -> int:
+        return self._ref0
+
+    def peek(self, keys: List[bytes]) -> int:
+        """Longest committed-prefix run length — no refcount change
+        (admission sizes its reservation with this before taking)."""
+        m = 0
+        for k in keys:
+            if k not in self._entries:
+                break
+            m += 1
+        return m
+
+    def take(self, keys: List[bytes], m: int) -> List[int]:
+        """Incref the first `m` entries and return their block ids;
+        records m hits and len(keys)-m misses."""
+        blocks: List[int] = []
+        for k in keys[:m]:
+            e = self._entries[k]
+            if e.refs == 0:          # cached -> live again
+                self._ref0 -= 1
+                self.pool.live += 1
+            e.refs += 1
+            self._tick += 1
+            e.tick = self._tick
+            blocks.append(e.block)
+        self.hits += m
+        self.misses += len(keys) - m
+        return blocks
+
+    def commit(self, key: bytes, block: int) -> bool:
+        """Publish a live private block under its chain key (refs=1 —
+        the committer keeps holding it). False when the key is already
+        present (a concurrent request with the same prefix committed
+        first; the caller's copy stays private)."""
+        if key in self._entries:
+            return False
+        self._tick += 1
+        self._entries[key] = _PrefixEntry(key, int(block), self._tick)
+        self.committed += 1
+        return True
+
+    def decref(self, key: bytes) -> None:
+        e = self._entries.get(key)
+        if e is None:
+            return
+        e.refs -= 1
+        if e.refs == 0:
+            self._ref0 += 1
+            self.pool.live -= 1
+            self._tick += 1
+            e.tick = self._tick
+            while self._ref0 > self.cap:
+                b = self._evict_lru()
+                if b is None:
+                    break
+                self.pool._free.append(b)
+
+    def _evict_lru(self) -> Optional[int]:
+        """Drop the least-recently-used UNREFERENCED entry; returns its
+        block id (the caller owns it now) or None when nothing is
+        evictable."""
+        victim = None
+        for e in self._entries.values():
+            if e.refs == 0 and (victim is None or e.tick < victim.tick):
+                victim = e
+        if victim is None:
+            return None
+        del self._entries[victim.key]
+        self._ref0 -= 1
+        self.evictions += 1
+        return victim.block
+
+    def sweep(self) -> int:
+        """Evict EVERY unreferenced entry (memory-watchdog pressure) —
+        their blocks go back to the pool's free list."""
+        n = 0
+        while True:
+            b = self._evict_lru()
+            if b is None:
+                return n
+            self.pool._free.append(b)
+            n += 1
 
 
 def prefill_buckets(chunk: int) -> Tuple[int, ...]:
@@ -96,7 +328,14 @@ class DecodeEntry:
                  num_slots: Optional[int] = None,
                  max_seq_len: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
-                 eos_id: Optional[int] = None):
+                 eos_id: Optional[int] = None,
+                 paged: Optional[bool] = None,
+                 kv_block: Optional[int] = None,
+                 kv_pool_blocks: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
+                 prefix_cache_blocks: Optional[int] = None,
+                 sampling: Optional[bool] = None,
+                 kv_shard: Optional[bool] = None):
         from bigdl_tpu.utils import config
         missing = [m for m in _DECODE_CONTRACT if not hasattr(model, m)]
         if missing:
@@ -134,23 +373,85 @@ class DecodeEntry:
                 f"decode model {name!r} carries no eos_id — pass "
                 f"eos_id= at registration")
         self.vocab_size = int(model.vocab_size)
-        # memory plane (observe/memz.py): the KV-slot bucket is the
-        # decode path's dominant resident — size it in CLOSED FORM from
-        # eval_shape (num_slots x max_seq_len x layers x heads x hd x
-        # dtype, zero allocation) and refuse the registration up front
-        # when params + bucket exceed the remaining headroom, instead
-        # of OOMing on the first decode step
+        # ---------------------------------------------- paged resolution
+        has_paged = all(hasattr(model, m) for m in _PAGED_CONTRACT)
+        if paged and not has_paged:
+            raise TypeError(
+                f"paged=True needs a model implementing the paged "
+                f"slot-decode contract {_PAGED_CONTRACT}; "
+                f"{type(model).__name__} lacks "
+                f"{[m for m in _PAGED_CONTRACT if not hasattr(model, m)]}")
+        want_paged = (bool(config.get("SERVE_KV_PAGED")) if paged is None
+                      else bool(paged))
+        self.paged = want_paged and has_paged
+        self.kv_block = int(kv_block if kv_block is not None
+                            else config.get("SERVE_KV_BLOCK"))
+        if self.kv_block < 1:
+            raise ValueError(f"kv_block must be >= 1, got "
+                             f"{self.kv_block}")
+        self.blocks_per_slot = -(-self.max_seq_len // self.kv_block)
+        dense_equiv = self.num_slots * self.blocks_per_slot
+        pool = int(kv_pool_blocks if kv_pool_blocks is not None
+                   else config.get("SERVE_KV_POOL_BLOCKS"))
+        self.pool_blocks = pool if pool > 0 else dense_equiv
+        self.sampling = (bool(config.get("SERVE_SAMPLING"))
+                         if sampling is None else bool(sampling))
+        logits_fn = ("paged_decode_logits" if self.paged
+                     else "decode_logits")
+        if self.sampling and not hasattr(model, logits_fn):
+            raise TypeError(
+                f"sampling=True needs a model exposing {logits_fn} "
+                f"(the decode_step stopped before the token choice); "
+                f"{type(model).__name__} lacks it")
+        self.prefix_cache = self.paged and (
+            bool(config.get("SERVE_PREFIX_CACHE"))
+            if prefix_cache is None else bool(prefix_cache))
+        cap = int(prefix_cache_blocks if prefix_cache_blocks is not None
+                  else config.get("SERVE_PREFIX_CACHE_BLOCKS"))
+        self.prefix_cache_cap = cap if cap > 0 else self.pool_blocks // 2
+        self.kv_shard = (bool(config.get("SERVE_KV_SHARD"))
+                         if kv_shard is None else bool(kv_shard))
+        self._shard_axis = None
+        if self.kv_shard:
+            if not self.paged:
+                raise ValueError("kv_shard=True needs the paged KV pool "
+                                 "(paged=True)")
+            if mesh is None:
+                raise ValueError("kv_shard=True needs a mesh at "
+                                 "registration (parallel.create_mesh)")
+            from bigdl_tpu.parallel.mesh import DATA_AXIS
+            axis = (DATA_AXIS if DATA_AXIS in mesh.axis_names
+                    else mesh.axis_names[0])
+            self._shard_axis = axis
+            n = int(mesh.shape[axis])
+            # round the pool up to axis divisibility — every device
+            # holds an equal shard of the block dimension
+            self.pool_blocks = -(-self.pool_blocks // n) * n
+        # memory plane (observe/memz.py): the KV residency is the decode
+        # path's dominant resident — size it in CLOSED FORM from
+        # eval_shape (zero allocation) and refuse the registration up
+        # front when params + pool exceed the remaining headroom,
+        # instead of OOMing on the first decode step. Paged pools size
+        # to pool_blocks x kv_block tokens, not slots x max_seq_len.
         import jax
         from bigdl_tpu.observe import memz as _memz
-        cache_specs = jax.eval_shape(
-            lambda p: model.make_slot_caches(p, self.num_slots,
-                                             self.max_seq_len), params)
+        if self.paged:
+            cache_specs = jax.eval_shape(
+                lambda p: model.make_paged_slot_caches(
+                    p, self.pool_blocks, self.kv_block), params)
+            what = (f"decode model {name!r} ({self.pool_blocks} KV "
+                    f"blocks x {self.kv_block} tokens paged pool")
+        else:
+            cache_specs = jax.eval_shape(
+                lambda p: model.make_slot_caches(p, self.num_slots,
+                                                 self.max_seq_len),
+                params)
+            what = (f"decode model {name!r} ({self.num_slots} slots x "
+                    f"{self.max_seq_len} tokens KV bucket")
         self.kv_cache_bytes = _memz.tree_nbytes(cache_specs)
         _memz.admission_check(
             self.kv_cache_bytes + _memz.tree_nbytes(params),
-            f"decode model {name!r} ({self.num_slots} slots x "
-            f"{self.max_seq_len} tokens KV bucket = "
-            f"{self.kv_cache_bytes:,} bytes + params)")
+            f"{what} = {self.kv_cache_bytes:,} bytes + params)")
         self._jit_decode = None
         self._jit_prefill = None
         self._aot_decode = None
@@ -164,24 +465,76 @@ class DecodeEntry:
         import jax
         model = self.model
         donate = (jax.default_backend() != "cpu")
-        kw = {"donate_argnums": (1,)} if donate else {}
+        kw_d = {"donate_argnums": (1,)} if donate else {}
+        kw_p = dict(kw_d)
         sh_in = None
+        self._pool_sharding = None
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             rep = NamedSharding(self.mesh, P())
-            # the cache pytree's shardings are pinned REPLICATED: decode
+            # the non-cache shardings are pinned REPLICATED: decode
             # steps are tiny and latency-bound, so the mesh buys program
             # portability (one registration path for meshed servers),
-            # not FLOPs — a slot-sharded layout is a later optimization
+            # not FLOPs. kv_shard=True additionally shards the paged
+            # pool's BLOCK dimension over the data axis (the slot-dim
+            # layout of the dense bucket, applied to its paged
+            # replacement) — the pool is the one decode resident worth
+            # splitting at real-chip scale.
             sh_in = rep
-            kw["in_shardings"] = rep
-            kw["out_shardings"] = rep
+            if self.kv_shard:
+                self._pool_sharding = NamedSharding(
+                    self.mesh, P(self._shard_axis))
+                cache_sh = self._pool_sharding
+            else:
+                cache_sh = rep
+            # in_shardings as a per-argument prefix pytree: the cache
+            # subtree takes the pool sharding, everything else is
+            # replicated. Argument layouts (see the lambdas below):
+            #   decode:  (params, caches, tokens, positions, active,
+            #             [table,] [temps, top_ks, top_ps, seeds])
+            #   prefill: (params, caches, tokens, positions,
+            #             table, lengths | active)
+            n_extra_d = (1 if self.paged else 0) + \
+                (4 if self.sampling else 0)
+            kw_d["in_shardings"] = (rep, cache_sh) + (rep,) * (3 + n_extra_d)
+            kw_d["out_shardings"] = (rep, cache_sh)
+            n_extra_p = 2 if self.paged else 1
+            kw_p["in_shardings"] = (rep, cache_sh) + (rep,) * (2 + n_extra_p)
+            kw_p["out_shardings"] = cache_sh
         self._rep_sharding = sh_in
-        self._jit_decode = jax.jit(
-            lambda p, c, t, pos, a: model.decode_step(p, c, t, pos, a),
-            **kw)
-        self._jit_prefill = jax.jit(
-            lambda p, c, t, pos, a: model.prefill(p, c, t, pos, a), **kw)
+        if self.paged:
+            if self.sampling:
+                from bigdl_tpu.nn.sampling import sample_tokens
+
+                def _step(p, c, t, pos, a, bt, temps, tks, tps, seeds):
+                    logits, c = model.paged_decode_logits(
+                        p, c, t, pos, a, bt)
+                    return sample_tokens(logits, temps, tks, tps,
+                                         seeds, pos), c
+                self._jit_decode = jax.jit(_step, **kw_d)
+            else:
+                self._jit_decode = jax.jit(
+                    lambda p, c, t, pos, a, bt:
+                    model.paged_decode_step(p, c, t, pos, a, bt), **kw_d)
+            self._jit_prefill = jax.jit(
+                lambda p, c, t, pos, bt, ln:
+                model.paged_prefill(p, c, t, pos, bt, ln), **kw_p)
+        else:
+            if self.sampling:
+                from bigdl_tpu.nn.sampling import sample_tokens
+
+                def _step(p, c, t, pos, a, temps, tks, tps, seeds):
+                    logits, c = model.decode_logits(p, c, t, pos, a)
+                    return sample_tokens(logits, temps, tks, tps,
+                                         seeds, pos), c
+                self._jit_decode = jax.jit(_step, **kw_d)
+            else:
+                self._jit_decode = jax.jit(
+                    lambda p, c, t, pos, a:
+                    model.decode_step(p, c, t, pos, a), **kw_d)
+            self._jit_prefill = jax.jit(
+                lambda p, c, t, pos, a: model.prefill(p, c, t, pos, a),
+                **kw_p)
 
     def _place(self, a):
         import jax
@@ -196,13 +549,19 @@ class DecodeEntry:
         return self._placed
 
     def make_caches(self):
-        """The persistent slot-bucket cache pytree (zeros, placed)."""
-        caches = self.model.make_slot_caches(
-            self.params, self.num_slots, self.max_seq_len)
-        if self._rep_sharding is not None:
+        """The persistent KV pytree (zeros, placed): the paged block
+        pool, or the dense slot bucket."""
+        if self.paged:
+            caches = self.model.make_paged_slot_caches(
+                self.params, self.pool_blocks, self.kv_block)
+        else:
+            caches = self.model.make_slot_caches(
+                self.params, self.num_slots, self.max_seq_len)
+        sh = self._pool_sharding or self._rep_sharding
+        if sh is not None:
             import jax
             caches = jax.tree.map(
-                lambda a: jax.device_put(a, self._rep_sharding), caches)
+                lambda a: jax.device_put(a, sh), caches)
         return caches
 
     # --------------------------------------------------------------- AOT
@@ -216,43 +575,81 @@ class DecodeEntry:
         import jax
         from bigdl_tpu.compilecache import precompile_fixed
 
-        def spec(shape, dtype):
-            kw = ({"sharding": self._rep_sharding}
-                  if self._rep_sharding is not None else {})
+        def spec(shape, dtype, sharding=None):
+            sh = sharding or self._rep_sharding
+            kw = {"sharding": sh} if sh is not None else {}
             return jax.ShapeDtypeStruct(shape, dtype, **kw)
 
         p_s = jax.tree.map(lambda a: spec(tuple(a.shape), a.dtype),
                            self.params)
-        c_s = jax.tree.map(lambda a: spec(tuple(a.shape), a.dtype),
-                           self.model.make_slot_caches(
-                               self.params, self.num_slots,
-                               self.max_seq_len))
+        if self.paged:
+            raw_caches = self.model.make_paged_slot_caches(
+                self.params, self.pool_blocks, self.kv_block)
+        else:
+            raw_caches = self.model.make_slot_caches(
+                self.params, self.num_slots, self.max_seq_len)
+        c_s = jax.tree.map(
+            lambda a: spec(tuple(a.shape), a.dtype,
+                           sharding=self._pool_sharding), raw_caches)
+        del raw_caches
         S = self.num_slots
         i32 = np.dtype(np.int32)
+        f32 = np.dtype(np.float32)
         vec = spec((S,), i32)
         act = spec((S,), np.dtype(np.bool_))
+        table = spec((S, self.blocks_per_slot), i32)
+        samp = ((spec((S,), f32), vec, spec((S,), f32), vec)
+                if self.sampling else ())
+        if self.paged:
+            d_args = (p_s, c_s, vec, vec, act, table) + samp
+        else:
+            d_args = (p_s, c_s, vec, vec, act) + samp
         results: Dict[str, Dict] = {}
         cost, self._aot_decode = precompile_fixed(
-            self._jit_decode, (p_s, c_s, vec, vec, act),
+            self._jit_decode, d_args,
             name=f"serve/{self.name}/decode/step")
+        self._assert_pool_sharding(self._aot_decode)
         results["decode_step"] = cost
         for b in self.buckets:
             chunk = spec((S, b), i32)
+            if self.paged:
+                pf_args = (p_s, c_s, chunk, chunk, table, vec)
+            else:
+                pf_args = (p_s, c_s, chunk, chunk, act)
             cost, exe = precompile_fixed(
-                self._jit_prefill, (p_s, c_s, chunk, chunk, act),
+                self._jit_prefill, pf_args,
                 name=f"serve/{self.name}/decode/prefill{b}")
+            self._assert_pool_sharding(exe)
             self._aot_prefill[b] = exe
             results[f"prefill{b}"] = cost
         return results
 
+    def _assert_pool_sharding(self, exe) -> None:
+        """kv_shard=True: assert the compiled executable actually
+        carries the block-dim NamedSharding spec on its pool inputs —
+        a silently-replicated pool would 1/N the capacity win."""
+        if self._pool_sharding is None or exe is None:
+            return
+        import jax
+        want = self._pool_sharding.spec
+        flat = jax.tree.leaves(exe.input_shardings[0])
+        got = [s for s in flat
+               if getattr(s, "spec", None) == want]
+        if not got:
+            raise RuntimeError(
+                f"serve[{self.name}]: kv_shard pool sharding {want} "
+                f"absent from the AOT executable's input shardings — "
+                f"GSPMD dropped the block-dim partition")
+
     # ------------------------------------------------------------ device
-    def run_prefill(self, caches, tokens: np.ndarray,
-                    positions: np.ndarray, active: np.ndarray):
+    def run_prefill(self, caches, tokens: np.ndarray, *rest):
         """One chunk-prefill program call; returns the new caches (the
-        input cache buffers are donated on TPU)."""
+        input cache buffers are donated on TPU). `rest` is the layout's
+        trailing host args (positions, then active — or block_table +
+        lengths when paged)."""
         C = tokens.shape[1]
-        args = (self.placed_params(), caches, self._place(tokens),
-                self._place(positions), self._place(active))
+        args = (self.placed_params(), caches, self._place(tokens)) + \
+            tuple(self._place(a) for a in rest)
         exe = self._aot_prefill.get(C)
         if exe is not None:
             try:
@@ -264,13 +661,15 @@ class DecodeEntry:
                 self._aot_prefill.pop(C, None)
         return self._jit_prefill(*args)
 
-    def run_decode(self, caches, tokens_last: np.ndarray,
-                   positions: np.ndarray, active: np.ndarray):
+    def run_decode(self, caches, tokens_last: np.ndarray, *rest):
         """One fused decode step; returns (next_tokens device array,
         new caches). The caller fetches next_tokens (the iteration's
-        single host sync)."""
-        args = (self.placed_params(), caches, self._place(tokens_last),
-                self._place(positions), self._place(active))
+        single host sync). `rest` is the layout's trailing host args
+        (positions, active[, block_table][, temps, top_ks, top_ps,
+        seeds])."""
+        args = (self.placed_params(), caches,
+                self._place(tokens_last)) + \
+            tuple(self._place(a) for a in rest)
         if self._aot_decode is not None:
             try:
                 return self._aot_decode(*args)
@@ -350,10 +749,14 @@ class GenReply:
 
 class _GenRequest:
     __slots__ = ("prompt", "max_new", "eos_id", "reply", "t_submit",
-                 "t_admit", "t_first", "fed", "generated", "slot")
+                 "t_admit", "t_first", "fed", "generated", "slot",
+                 "temperature", "top_k", "top_p", "seed",
+                 "need_blocks", "reserved", "shared", "keys",
+                 "committed", "commit_upto")
 
     def __init__(self, prompt: np.ndarray, max_new: int, eos_id: int,
-                 t_submit: float):
+                 t_submit: float, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 1.0, seed: int = 0):
         self.prompt = prompt
         self.max_new = int(max_new)
         self.eos_id = int(eos_id)
@@ -364,6 +767,19 @@ class _GenRequest:
         self.fed = 0                       # prompt tokens prefilled so far
         self.generated: List[int] = []
         self.slot: Optional[int] = None
+        # sampling (greedy unless temperature > 0; nn/sampling.py)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = int(seed)
+        # paged-pool bookkeeping (scheduler-owned, under its lock)
+        self.need_blocks = 0      # ceil(total tokens / kv_block)
+        self.reserved = 0         # reserved, not yet acquired
+        self.shared = 0           # leading block-table entries matched
+                                  # from the prefix cache (refcounted)
+        self.keys: List[bytes] = []        # chain keys (prefill region)
+        self.committed: List[int] = []     # key idxs THIS req committed
+        self.commit_upto = 0               # next key idx to consider
 
     @property
     def prefill_target(self) -> int:
@@ -416,16 +832,45 @@ class DecodeScheduler:
         self._slots: List[Optional[_GenRequest]] = \
             [None] * entry.num_slots
         self._caches = entry.make_caches()
-        # buffer ledger (observe/memz.py): the persistent KV-slot bucket
-        # under `serve/<model>/kv_cache` — the bytes stay constant across
-        # donated steps, and close()/GC releases the accounting; the
-        # slots meta feeds the /memz "one more slot" headroom estimate
         from bigdl_tpu.observe import memz as _memz
-        self._mem_handle = _memz.ledger().register(
-            f"serve/{self.name}/kv_cache", self._caches, anchor=self,
-            kind="kv_cache",
-            meta={"slots": entry.num_slots,
-                  "max_seq_len": entry.max_seq_len})
+        if entry.paged:
+            # paged-pool bookkeeping: free-list allocator + per-slot
+            # block tables (+ the prefix cache when enabled). All
+            # mutation happens under self._cv.
+            self._pool = BlockPool(entry.pool_blocks)
+            self._prefix = (PrefixCache(self._pool,
+                                        entry.prefix_cache_cap)
+                            if entry.prefix_cache else None)
+            self._tables = np.full(
+                (entry.num_slots, entry.blocks_per_slot), -1, np.int32)
+            # buffer ledger (observe/memz.py): the pool under
+            # `serve/<model>/kv_pool`, kind="kv_pool" — bytes stay
+            # constant across donated steps while the meta carries the
+            # LIVE block accounting (headroom = free blocks)
+            self._mem_handle = _memz.ledger().register(
+                f"serve/{self.name}/kv_pool", self._caches, anchor=self,
+                kind="kv_pool",
+                meta={"blocks": entry.pool_blocks,
+                      "block": entry.kv_block,
+                      "bytes_per_block":
+                          entry.kv_cache_bytes // entry.pool_blocks,
+                      "blocks_free": entry.pool_blocks,
+                      "slots": entry.num_slots,
+                      "max_seq_len": entry.max_seq_len})
+        else:
+            self._pool = None
+            self._prefix = None
+            self._tables = None
+            # buffer ledger: the persistent KV-slot bucket under
+            # `serve/<model>/kv_cache` — the bytes stay constant across
+            # donated steps, and close()/GC releases the accounting; the
+            # slots meta feeds the /memz "one more slot" headroom
+            # estimate
+            self._mem_handle = _memz.ledger().register(
+                f"serve/{self.name}/kv_cache", self._caches, anchor=self,
+                kind="kv_cache",
+                meta={"slots": entry.num_slots,
+                      "max_seq_len": entry.max_seq_len})
         self._closed = False
         self._draining = False
         self._thread: Optional[threading.Thread] = None
@@ -454,6 +899,25 @@ class DecodeScheduler:
         self._m_shed = observe.counter(f"serve/{n}/shed")
         self._m_cancelled = observe.counter(
             f"serve/{n}/decode/cancelled")
+        # paged-pool + prefix-cache planes (gauges track the live
+        # accounting; counters mirror the PrefixCache tallies)
+        self._m_blocks_free = observe.gauge(
+            f"serve/{n}/decode/kv_blocks_free")
+        self._m_blocks_live = observe.gauge(
+            f"serve/{n}/decode/kv_blocks_live")
+        self._m_blocks_cached = observe.gauge(
+            f"serve/{n}/decode/kv_blocks_cached")
+        self._m_pool_util = observe.gauge(
+            f"serve/{n}/decode/kv_pool_util")
+        self._m_prefix_hits = observe.counter(
+            f"serve/{n}/decode/prefix_hits")
+        self._m_prefix_misses = observe.counter(
+            f"serve/{n}/decode/prefix_misses")
+        self._m_prefix_evictions = observe.counter(
+            f"serve/{n}/decode/prefix_evictions")
+        self._m_prefix_hit_rate = observe.gauge(
+            f"serve/{n}/decode/prefix_hit_rate")
+        self._prefix_synced = (0, 0, 0)    # (hits, misses, evictions)
         self._win_t0 = self._clock()
         self._win_tokens = 0
         if start:
@@ -461,15 +925,30 @@ class DecodeScheduler:
 
     # ------------------------------------------------------------ admission
     def submit(self, prompt_ids, max_new_tokens: int,
-               eos_id: Optional[int] = None) -> GenReply:
+               eos_id: Optional[int] = None, *,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0, seed: int = 0) -> GenReply:
         """Queue one generate request; returns its `GenReply`. Raises
-        ValueError (bad prompt / budget over the slot cache length),
-        `Overloaded` (queue at bound), or `Closed` (shut down)."""
+        ValueError (bad prompt / budget over the slot cache length /
+        sampling params on a greedy registration), `CapacityError`
+        (paged: the request needs more KV blocks than the whole pool —
+        it can NEVER be scheduled; the error carries the live
+        block-level capacity report and leaves no partial state),
+        `Overloaded` (queue at bound), or `Closed` (shut down).
+
+        `temperature > 0` samples (top_k/top_p filtered, per-slot
+        stateless rng keyed by `seed` — deterministic per (seed,
+        position)); 0 is greedy, the parity-oracle path."""
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("generate request needs a non-empty prompt")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if temperature > 0.0 and not self.entry.sampling:
+            raise ValueError(
+                f"model {self.name!r} was registered without the "
+                f"sampling decode step — register(sampling=True) or "
+                f"BIGDL_TPU_SERVE_SAMPLING=1 to serve temperature > 0")
         total = prompt.size - 1 + int(max_new_tokens)
         if total > self.entry.max_seq_len:
             raise ValueError(
@@ -478,7 +957,33 @@ class DecodeScheduler:
                 f"{self.entry.max_seq_len} (BIGDL_TPU_SERVE_MAX_SEQ_LEN"
                 f" / register(max_seq_len=...))")
         eos = self.entry.eos_id if eos_id is None else int(eos_id)
-        req = _GenRequest(prompt, max_new_tokens, eos, self._clock())
+        req = _GenRequest(prompt, max_new_tokens, eos, self._clock(),
+                          temperature=temperature, top_k=top_k,
+                          top_p=top_p, seed=seed)
+        if self.entry.paged:
+            req.need_blocks = -(-total // self.entry.kv_block)
+            if req.need_blocks > self._pool.total:
+                # refuse, don't queue: no retirement can ever free
+                # enough blocks. Live block-level capacity report; the
+                # submit leaves NO partial state, so a resized retry
+                # (or a bigger pool) goes through cleanly.
+                from bigdl_tpu.observe.memz import CapacityError
+                with self._cv:
+                    p = self._pool
+                    cached = p.cached_count()
+                    report = (f"{p.total} blocks total = {p.live} live "
+                              f"+ {cached} cached + {p.free} free "
+                              f"({p.reserved} reserved)")
+                observe.instant("serve/decode/refuse", cat="serve",
+                                args={"model": self.name,
+                                      "need_blocks": req.need_blocks})
+                raise CapacityError(
+                    f"decode request needs {req.need_blocks} KV blocks "
+                    f"({total} tokens @ {self.entry.kv_block}/block) "
+                    f"but the {self.name!r} pool holds {report} — "
+                    f"shrink the request or grow "
+                    f"BIGDL_TPU_SERVE_KV_POOL_BLOCKS / "
+                    f"register(kv_pool_blocks=...)")
         with self._cv:
             if self._closed or self._draining:
                 raise Closed(f"decode scheduler {self.name!r} is shut "
@@ -508,13 +1013,24 @@ class DecodeScheduler:
 
     # --------------------------------------------------- iteration core
     def _admit(self) -> int:
-        """Move queued requests into free slots (holding the lock)."""
+        """Move queued requests into free slots (holding the lock).
+        Paged: admission additionally reserves the request's KV blocks
+        against the LIVE pool (matching any committed shared prefix
+        first — matched blocks are refcounted into the slot's table and
+        their prefill is skipped); when the head request's blocks don't
+        fit, admission stops — FIFO, no overtaking — and retries next
+        iteration after retirements return blocks."""
         admitted = 0
         with self._cv:
-            for s, occ in enumerate(self._slots):
-                if occ is not None or not self._queue:
-                    continue
-                req = self._queue.pop(0)
+            free_slots = [s for s, occ in enumerate(self._slots)
+                          if occ is None]
+            while free_slots and self._queue:
+                req = self._queue[0]
+                s = free_slots[0]
+                if self.entry.paged and not self._admit_blocks(req, s):
+                    break
+                self._queue.pop(0)
+                free_slots.pop(0)
                 req.slot = s
                 req.t_admit = self._clock()
                 self._h_qw.record(
@@ -523,6 +1039,89 @@ class DecodeScheduler:
                 admitted += 1
             self._m_queued.set(len(self._queue))
         return admitted
+
+    def _admit_blocks(self, req: _GenRequest, s: int) -> bool:
+        """Reserve `req`'s KV blocks (lock held). Prefix-cache hits
+        shrink the reservation AND the prefill: matched blocks land in
+        the slot's table refcounted and `req.fed` jumps past them."""
+        B = self.entry.kv_block
+        if self._prefix is not None:
+            req.keys = PrefixCache.chain_keys(req.prompt, B,
+                                              req.prefill_target)
+            m = self._prefix.peek(req.keys)
+        else:
+            req.keys, m = [], 0
+        if not self._pool.reserve(req.need_blocks - m):
+            return False
+        req.reserved = req.need_blocks - m
+        if self._prefix is not None:
+            blocks = self._prefix.take(req.keys, m)
+            if m:
+                self._tables[s, :m] = blocks
+                req.shared = m
+                req.commit_upto = m
+                req.fed = m * B       # shared prefill is already paid
+                observe.instant("serve/decode/prefix_hit", cat="serve",
+                                args={"model": self.name, "blocks": m})
+        return True
+
+    def _ensure_blocks(self, req: _GenRequest, last_pos: int) -> None:
+        """Acquire the slot's private blocks through the one covering
+        `last_pos` (lock held) — the lazy frontier-crossing acquisition;
+        the admission reservation guarantees success."""
+        row = self._tables[req.slot]
+        for j in range(last_pos // self.entry.kv_block + 1):
+            if row[j] < 0:
+                row[j] = self._pool.acquire_reserved()
+                req.reserved -= 1
+
+    def _release_blocks(self, req: _GenRequest) -> None:
+        """Return a leaving request's blocks (takes the lock): shared /
+        committed entries decref in the prefix cache (refs==0 entries
+        stay CACHED for future hits), private blocks go back to the
+        free list, unacquired reservations are dropped."""
+        if not self.entry.paged or req.slot is None:
+            return
+        with self._cv:
+            row = self._tables[req.slot]
+            refd = set(range(req.shared)) | set(req.committed)
+            for j in range(row.shape[0]):
+                b = int(row[j])
+                if b < 0:
+                    continue
+                if j in refd:
+                    self._prefix.decref(req.keys[j])
+                else:
+                    self._pool.release(b)
+            row[:] = -1
+            if req.reserved:
+                self._pool.unreserve(req.reserved)
+                req.reserved = 0
+        self._refresh_pool_stats()
+
+    def _refresh_pool_stats(self) -> None:
+        """Mirror the live pool/prefix accounting into the gauges,
+        counters, and the ledger owner's meta (headroom = free
+        blocks)."""
+        pool = self._pool
+        if pool is None:
+            return
+        cached = pool.cached_count()
+        self._m_blocks_free.set(float(pool.free))
+        self._m_blocks_live.set(float(pool.live))
+        self._m_blocks_cached.set(float(cached))
+        self._m_pool_util.set(pool.live / pool.total)
+        if self._prefix is not None:
+            pf = self._prefix
+            h0, m0, e0 = self._prefix_synced
+            self._m_prefix_hits.inc(pf.hits - h0)
+            self._m_prefix_misses.inc(pf.misses - m0)
+            self._m_prefix_evictions.inc(pf.evictions - e0)
+            self._prefix_synced = (pf.hits, pf.misses, pf.evictions)
+            seen = pf.hits + pf.misses
+            self._m_prefix_hit_rate.set(
+                pf.hits / seen if seen else 0.0)
+        self._mem_handle.update_meta(blocks_free=pool.free)
 
     def _chunk_for(self, req: _GenRequest) -> int:
         """The prefill bucket this request's next chunk uses: smallest
@@ -550,28 +1149,62 @@ class DecodeScheduler:
         for req in pending:
             by_bucket.setdefault(self._chunk_for(req), []).append(req)
         S = self.entry.num_slots
+        paged = self.entry.paged
         done = 0
         for C, reqs in sorted(by_bucket.items()):
             tokens = np.zeros((S, C), np.int32)
             positions = np.zeros((S, C), np.int32)
             active = np.zeros((S,), bool)
+            lengths = np.zeros((S,), np.int32)
             for req in reqs:
                 n = min(req.prefill_target - req.fed, C)
                 tokens[req.slot, :n] = req.prompt[req.fed:req.fed + n]
                 positions[req.slot] = req.fed + np.arange(C)
                 active[req.slot] = True
+                lengths[req.slot] = n
+            if paged:
+                with self._cv:
+                    for req in reqs:
+                        n = int(lengths[req.slot])
+                        self._ensure_blocks(req, req.fed + n - 1)
+                    table = self._tables.copy()
             t0 = self._clock()
             with observe.span("serve/decode/prefill", cat="serve",
                               args={"model": self.name, "chunk": C,
                                     "slots": len(reqs)}):
-                self._caches = self.entry.run_prefill(
-                    self._caches, tokens, positions, active)
+                if paged:
+                    # lengths masks the rounded-up bucket's padded tail
+                    # (and inactive rows) out of the pool scatter —
+                    # active is implied by lengths > 0
+                    self._caches = self.entry.run_prefill(
+                        self._caches, tokens, positions, table, lengths)
+                else:
+                    self._caches = self.entry.run_prefill(
+                        self._caches, tokens, positions, active)
             self._h_prefill.record(
                 max(0.0, (self._clock() - t0) * 1e3))
             for req in reqs:
                 req.fed += min(req.prefill_target - req.fed, C)
                 done += 1
+                if self._prefix is not None:
+                    self._commit_prefix(req)
         return done
+
+    def _commit_prefix(self, req: _GenRequest) -> None:
+        """Publish the whole prompt blocks `req`'s prefill frontier has
+        passed (the commit half of the stage/commit discipline): later
+        admissions with the same prefix chain take them refcounted. A
+        concurrent identical prefix may have committed a key first —
+        this request's copy then simply stays private."""
+        with self._cv:
+            B = self.entry.kv_block
+            j = req.commit_upto
+            while j < len(req.keys) and (j + 1) * B <= req.fed:
+                blk = int(self._tables[req.slot, j])
+                if blk >= 0 and self._prefix.commit(req.keys[j], blk):
+                    req.committed.append(j)
+                j += 1
+            req.commit_upto = j
 
     def _decode_pass(self) -> int:
         """One fused decode step over every prompt-complete slot; retire
@@ -589,12 +1222,29 @@ class DecodeScheduler:
             tokens[req.slot] = tok
             positions[req.slot] = pos
             active[req.slot] = True
+        extra = []
+        if self.entry.paged:
+            with self._cv:
+                for req in ready:
+                    self._ensure_blocks(req, int(positions[req.slot]))
+                extra.append(self._tables.copy())
+        if self.entry.sampling:
+            temps = np.zeros((S,), np.float32)
+            tks = np.zeros((S,), np.int32)
+            tps = np.ones((S,), np.float32)
+            seeds = np.zeros((S,), np.int32)
+            for req in ready:
+                temps[req.slot] = req.temperature
+                tks[req.slot] = req.top_k
+                tps[req.slot] = req.top_p
+                seeds[req.slot] = req.seed
+            extra += [temps, tks, tps, seeds]
         t0 = self._clock()
         with observe.span("serve/decode/step", cat="serve",
                           args={"model": self.name,
                                 "active": len(ready)}):
             nxt, self._caches = self.entry.run_decode(
-                self._caches, tokens, positions, active)
+                self._caches, tokens, positions, active, *extra)
             from bigdl_tpu.analysis.sancov import sanctioned_sync
             import jax
             with sanctioned_sync("decode next-token fetch"):
@@ -623,6 +1273,8 @@ class DecodeScheduler:
 
     def _retire(self, req: _GenRequest, now: float) -> None:
         self._slots[req.slot] = None
+        if self.entry.paged:
+            self._release_blocks(req)
         self._m_retired.inc()
         self._h_lat.record(max(0.0, (now - req.t_submit) * 1e3))
         observe.instant("serve/decode/retire", cat="serve",
@@ -652,6 +1304,8 @@ class DecodeScheduler:
         for s, req in enumerate(self._slots):
             if req is not None and req.reply.cancelled():
                 self._slots[s] = None
+                if self.entry.paged:
+                    self._release_blocks(req)
                 self._m_cancelled.inc()
                 req.reply._finish(req.generated)
                 freed += 1
@@ -667,9 +1321,21 @@ class DecodeScheduler:
         sleeps otherwise); tests drive this synchronously with a fake
         clock."""
         worked = self._sweep_cancelled() > 0
+        if self._prefix is not None:
+            from bigdl_tpu.observe import memz as _memz
+            if _memz.watchdog_active():
+                with self._cv:
+                    swept = self._prefix.sweep()
+                if swept:
+                    observe.instant("serve/decode/prefix_sweep",
+                                    cat="serve",
+                                    args={"model": self.name,
+                                          "blocks": swept})
         worked = self._admit() > 0 or worked
         worked = self._prefill_pass() > 0 or worked
         worked = self._decode_pass() > 0 or worked
+        if self.entry.paged:
+            self._refresh_pool_stats()
         return worked
 
     # ----------------------------------------------------------- lifecycle
@@ -763,6 +1429,9 @@ class DecodeScheduler:
             self._m_queued.set(0)
             self._m_active.set(0)
             self._cv.notify_all()
+        if self.entry.paged:
+            for req in dropped:
+                self._release_blocks(req)
         for req in dropped:
             if not req.reply.done():
                 req.reply._fail(Closed(
@@ -799,7 +1468,7 @@ class DecodeScheduler:
             # report the live partial-window estimate instead of 0
             rate = self._win_tokens / max(self._clock() - self._win_t0,
                                           1e-9)
-        return {
+        out = {
             "slots": self.entry.num_slots,
             "max_seq_len": self.entry.max_seq_len,
             "active_slots": self.active_slots,
@@ -818,6 +1487,31 @@ class DecodeScheduler:
             "queue_wait_p99_ms": round(qw.quantile(0.99), 3),
             "cancelled": int(self._m_cancelled.value),
         }
+        out["paged"] = bool(self.entry.paged)
+        if self.entry.paged and self._pool is not None:
+            pool = self._pool
+            cached = pool.cached_count()
+            out.update({
+                "kv_block": self.entry.kv_block,
+                "kv_blocks_total": pool.total,
+                "kv_blocks_free": pool.free,
+                "kv_blocks_live": pool.live,
+                "kv_blocks_cached": cached,
+                "kv_blocks_reserved": pool.reserved,
+                "kv_pool_util": round(pool.live / pool.total, 4),
+            })
+            if self._prefix is not None:
+                pf = self._prefix
+                seen = pf.hits + pf.misses
+                out.update({
+                    "prefix_hits": pf.hits,
+                    "prefix_misses": pf.misses,
+                    "prefix_evictions": pf.evictions,
+                    "prefix_cached_blocks": cached,
+                    "prefix_hit_rate": round(pf.hits / seen, 4)
+                    if seen else 0.0,
+                })
+        return out
 
 
 def decode_demo_model(vocab_size: int = 64, n_positions: int = 256,

@@ -149,7 +149,14 @@ class ServeEngine:
                  prefill_chunk: Optional[int] = None,
                  eos_id: Optional[int] = None,
                  max_queue: int = 256,
-                 precompile_decode: bool = True) -> ModelEntry:
+                 precompile_decode: bool = True,
+                 paged: Optional[bool] = None,
+                 kv_block: Optional[int] = None,
+                 kv_pool_blocks: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
+                 prefix_cache_blocks: Optional[int] = None,
+                 sampling: Optional[bool] = None,
+                 kv_shard: Optional[bool] = None) -> ModelEntry:
         """Register a model and start its scheduler. `precompile_input`
         = (feature_shape, dtype) AOT-compiles every bucket up front.
 
@@ -159,7 +166,11 @@ class ServeEngine:
         `submit_generate`, and `precompile_decode` (default on)
         AOT-compiles the fused step + every prefill bucket so warm
         serving compiles zero fresh programs. num_slots / max_seq_len /
-        prefill_chunk default to the BIGDL_TPU_SERVE_DECODE_* knobs.
+        prefill_chunk default to the BIGDL_TPU_SERVE_DECODE_* knobs;
+        paged / kv_block / kv_pool_blocks / prefix_cache / sampling /
+        kv_shard override the BIGDL_TPU_SERVE_KV_* and
+        BIGDL_TPU_SERVE_{PREFIX_CACHE,SAMPLING} knobs (paged KV block
+        pool + shared-prefix reuse — docs/serving.md).
 
         Admission is memory-checked (observe/memz.py): params+state —
         and for decode the closed-form KV bucket, BEFORE allocation —
@@ -176,7 +187,11 @@ class ServeEngine:
             max_batch=max_batch if max_batch is not None
             else d["max_batch"], int8=int8, decode=decode,
             num_slots=num_slots, max_seq_len=max_seq_len,
-            prefill_chunk=prefill_chunk, eos_id=eos_id)
+            prefill_chunk=prefill_chunk, eos_id=eos_id, paged=paged,
+            kv_block=kv_block, kv_pool_blocks=kv_pool_blocks,
+            prefix_cache=prefix_cache,
+            prefix_cache_blocks=prefix_cache_blocks, sampling=sampling,
+            kv_shard=kv_shard)
         from bigdl_tpu.resilience import faults
         if decode:
             if precompile_decode:
@@ -293,12 +308,17 @@ class ServeEngine:
     # ----------------------------------------------- autoregressive decode
     def submit_generate(self, name: str, prompt_ids,
                         max_new_tokens: int,
-                        eos_id: Optional[int] = None) -> GenReply:
+                        eos_id: Optional[int] = None,
+                        temperature: float = 0.0, top_k: int = 0,
+                        top_p: float = 1.0, seed: int = 0) -> GenReply:
         """Queue one generate request against a `decode=True` model;
         returns a streaming-capable `GenReply` (`.result()` blocks for
         the full generation, `.stream()` yields token ids as they
-        decode). Raises KeyError (not a decode model), ValueError
-        (empty prompt / budget over the slot cache length),
+        decode). `temperature > 0` samples (top_k/top_p filtered,
+        deterministic per seed — model must be registered with
+        `sampling=True`); the default is greedy argmax. Raises KeyError
+        (not a decode model), ValueError (empty prompt / budget over
+        the slot cache length / sampling not compiled in),
         `Overloaded`, or `Closed`."""
         with self._lock:
             sched = self._decoders.get(name)
@@ -307,16 +327,21 @@ class ServeEngine:
                 f"no decode model {name!r} registered (register with "
                 f"decode=True; have: "
                 f"{sorted(self._decoders) or 'none'})")
-        return sched.submit(prompt_ids, max_new_tokens, eos_id=eos_id)
+        return sched.submit(prompt_ids, max_new_tokens, eos_id=eos_id,
+                            temperature=temperature, top_k=top_k,
+                            top_p=top_p, seed=seed)
 
     def generate(self, name: str, prompt_ids, max_new_tokens: int,
                  eos_id: Optional[int] = None,
-                 timeout: Optional[float] = None) -> np.ndarray:
+                 timeout: Optional[float] = None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, seed: int = 0) -> np.ndarray:
         """Synchronous generate: submit + wait; returns the generated
         token ids (np.int32, EOS included when emitted)."""
         return self.submit_generate(
-            name, prompt_ids, max_new_tokens,
-            eos_id=eos_id).result(timeout)
+            name, prompt_ids, max_new_tokens, eos_id=eos_id,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            seed=seed).result(timeout)
 
     # ---------------------------------------------------------------- SLO
     def stats(self) -> Dict[str, Dict]:

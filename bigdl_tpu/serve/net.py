@@ -166,17 +166,27 @@ class LocalBackend:
     def generate(self, model: str, prompt, max_new: int,
                  eos_id: Optional[int] = None, *,
                  priority: str = "interactive",
-                 client: str = "anon") -> List[int]:
+                 client: str = "anon",
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, seed: int = 0) -> List[int]:
         out = self.engine.generate(model, prompt, max_new,
-                                   eos_id=eos_id)
+                                   eos_id=eos_id,
+                                   temperature=temperature, top_k=top_k,
+                                   top_p=top_p, seed=seed)
         return [int(t) for t in out]
 
     def stream_generate(self, model: str, prompt, max_new: int,
                         eos_id: Optional[int] = None, *,
                         priority: str = "interactive",
-                        client: str = "anon") -> _LocalStream:
+                        client: str = "anon",
+                        temperature: float = 0.0, top_k: int = 0,
+                        top_p: float = 1.0, seed: int = 0
+                        ) -> _LocalStream:
         reply = self.engine.submit_generate(model, prompt, max_new,
-                                            eos_id=eos_id)
+                                            eos_id=eos_id,
+                                            temperature=temperature,
+                                            top_k=top_k, top_p=top_p,
+                                            seed=seed)
         return _LocalStream(reply)
 
     def queue_state(self) -> Dict[str, Dict]:
@@ -298,10 +308,17 @@ class _FrontHandler(JSONHandler):
         max_new = int(body.get("max_new_tokens", 32))
         eos_id = body.get("eos_id")
         eos_id = None if eos_id is None else int(eos_id)
+        # sampling controls (greedy when temperature omitted / <= 0;
+        # the model must be registered with sampling=True to honor
+        # temperature > 0 — ValueError otherwise, surfaced as a 400)
+        samp = dict(temperature=float(body.get("temperature", 0.0)),
+                    top_k=int(body.get("top_k", 0)),
+                    top_p=float(body.get("top_p", 1.0)),
+                    seed=int(body.get("seed", 0)))
         if not body.get("stream"):
             tokens = f.backend.generate(model, prompt, max_new, eos_id,
                                         priority=priority,
-                                        client=client)
+                                        client=client, **samp)
             observe.counter(
                 f"serve/client/{client}/tokens").inc(len(tokens))
             self._send_json(200, {"model": model, "tokens": tokens,
@@ -311,7 +328,7 @@ class _FrontHandler(JSONHandler):
         start = int(body.get("start", 0))
         stream = f.backend.stream_generate(model, prompt, max_new,
                                            eos_id, priority=priority,
-                                           client=client)
+                                           client=client, **samp)
         f.m_streams.inc()
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")

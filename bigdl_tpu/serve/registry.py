@@ -98,7 +98,14 @@ class ModelEntry:
                  num_slots: Optional[int] = None,
                  max_seq_len: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
-                 eos_id: Optional[int] = None):
+                 eos_id: Optional[int] = None,
+                 paged: Optional[bool] = None,
+                 kv_block: Optional[int] = None,
+                 kv_pool_blocks: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
+                 prefix_cache_blocks: Optional[int] = None,
+                 sampling: Optional[bool] = None,
+                 kv_shard: Optional[bool] = None):
         from bigdl_tpu.utils import config
         self.name = name
         self.mesh = mesh
@@ -146,7 +153,10 @@ class ModelEntry:
             self.decode = DecodeEntry(
                 name, model, params, mesh=mesh, num_slots=num_slots,
                 max_seq_len=max_seq_len, prefill_chunk=prefill_chunk,
-                eos_id=eos_id)
+                eos_id=eos_id, paged=paged, kv_block=kv_block,
+                kv_pool_blocks=kv_pool_blocks, prefix_cache=prefix_cache,
+                prefix_cache_blocks=prefix_cache_blocks,
+                sampling=sampling, kv_shard=kv_shard)
 
     def precompile_decode(self) -> Dict[str, Dict]:
         """AOT-compile the decode step + every prefill-chunk bucket
@@ -238,11 +248,13 @@ class ModelRegistry:
                  num_slots: Optional[int] = None,
                  max_seq_len: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
-                 eos_id: Optional[int] = None) -> ModelEntry:
+                 eos_id: Optional[int] = None,
+                 **decode_opts) -> ModelEntry:
         entry = ModelEntry(name, model, params, state, mesh=mesh,
                            max_batch=max_batch, int8=int8, decode=decode,
                            num_slots=num_slots, max_seq_len=max_seq_len,
-                           prefill_chunk=prefill_chunk, eos_id=eos_id)
+                           prefill_chunk=prefill_chunk, eos_id=eos_id,
+                           **decode_opts)
         with self._lock:
             if name in self._entries:
                 raise ValueError(f"model {name!r} already registered")

@@ -250,10 +250,14 @@ class ReplicaRouter:
     def generate(self, model: str, prompt, max_new: int,
                  eos_id: Optional[int] = None, *,
                  priority: str = "interactive",
-                 client: str = "anon") -> List[int]:
+                 client: str = "anon",
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, seed: int = 0) -> List[int]:
         body = {"model": model, "prompt": [int(t) for t in prompt],
                 "max_new_tokens": int(max_new), "priority": priority,
-                "client": client}
+                "client": client, "temperature": float(temperature),
+                "top_k": int(top_k), "top_p": float(top_p),
+                "seed": int(seed)}
         if eos_id is not None:
             body["eos_id"] = int(eos_id)
         out = self._with_failover(model, lambda rep: _http_json(
@@ -263,10 +267,15 @@ class ReplicaRouter:
     def stream_generate(self, model: str, prompt, max_new: int,
                         eos_id: Optional[int] = None, *,
                         priority: str = "interactive",
-                        client: str = "anon") -> "_RouterStream":
+                        client: str = "anon",
+                        temperature: float = 0.0, top_k: int = 0,
+                        top_p: float = 1.0, seed: int = 0
+                        ) -> "_RouterStream":
         body = {"model": model, "prompt": [int(t) for t in prompt],
                 "max_new_tokens": int(max_new), "stream": True,
-                "priority": priority, "client": client}
+                "priority": priority, "client": client,
+                "temperature": float(temperature), "top_k": int(top_k),
+                "top_p": float(top_p), "seed": int(seed)}
         if eos_id is not None:
             body["eos_id"] = int(eos_id)
         return _RouterStream(self, model, body)

@@ -274,6 +274,55 @@ _register("SERVE_MAX_SEQ_LEN", 1024, int,
           "arrays are allocated once per model and donated across "
           "steps (serve/decode.py). Per-model override: "
           "ServeEngine.register(max_seq_len=...)")
+_register("SERVE_KV_PAGED", True, _bool,
+          "Autoregressive decode serving: allocate the KV cache as a "
+          "PAGED block pool (fixed-size blocks + per-slot block "
+          "tables, serve/decode.py BlockPool) instead of one dense "
+          "(slots, max_seq_len) bucket — HBM cost follows live "
+          "sequences, admission is live block accounting, and shared "
+          "prompt prefixes are reusable. Models lacking the paged "
+          "slot-decode contract fall back to the dense bucket. "
+          "Per-model override: ServeEngine.register(paged=...)")
+_register("SERVE_KV_BLOCK", 16, int,
+          "Paged KV cache: tokens per block. Smaller blocks waste "
+          "less tail capacity per sequence but grow the block table; "
+          "16 is the PagedAttention sweet spot. Per-model override: "
+          "ServeEngine.register(kv_block=...)")
+_register("SERVE_KV_POOL_BLOCKS", 0, int,
+          "Paged KV cache: total blocks in the per-model pool. "
+          "0 (default) = dense-equivalent sizing "
+          "(slots x ceil(max_seq_len/block) — identical capacity, "
+          "zero-risk default); size it BELOW that to spend less HBM "
+          "than the worst case and let live block accounting admit "
+          "against real usage (docs/serving.md sizing runbook). "
+          "Per-model override: ServeEngine.register(kv_pool_blocks=...)")
+_register("SERVE_PREFIX_CACHE", True, _bool,
+          "Paged KV cache: retain finished sequences' full prompt-"
+          "prefix blocks as refcounted read-only cache entries keyed "
+          "by token-prefix hash, so requests sharing a prompt prefix "
+          "(system prompts) skip its prefill entirely. Paged "
+          "registrations only. Per-model override: "
+          "ServeEngine.register(prefix_cache=...)")
+_register("SERVE_PREFIX_CACHE_BLOCKS", 0, int,
+          "Prefix cache retention cap: max UNREFERENCED cached blocks "
+          "kept for future reuse (beyond it the LRU entry is evicted "
+          "on release). 0 (default) = half the pool. Referenced "
+          "(live-shared) blocks are never counted against the cap")
+_register("SERVE_SAMPLING", False, _bool,
+          "Autoregressive decode serving: compile the fused decode "
+          "step with temperature/top-k/top-p sampling + per-slot "
+          "stateless rng (nn/sampling.py). Greedy stays the default "
+          "per request (temperature=0 rows take the argmax path "
+          "bit-identically); off (default) compiles the pure greedy "
+          "step — the parity-oracle path. Per-model override: "
+          "ServeEngine.register(sampling=...)")
+_register("SERVE_KV_SHARD", False, _bool,
+          "Paged KV cache: shard the block pool's block dimension "
+          "over the mesh's 'data' axis via NamedSharding (pool "
+          "blocks rounded up to a multiple of the axis size; specs "
+          "pinned and asserted on the AOT executables) — readies the "
+          "pool for real-chip scale. Requires a mesh at registration; "
+          "replicated (default) otherwise")
 _register("SERVE_MODEL_QUEUE_ROWS", "", str,
           "Per-model admission bounds for the serve queues "
           "(serve/engine.py): '' = every model takes the "

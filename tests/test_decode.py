@@ -160,9 +160,14 @@ def _staggered_run(entry, submits, poison=False):
                 replies[i] = sched.submit(prompt, max_new, eos_id=eos)
         worked = sched.step_once()
         if poison:
-            # poison every FREE slot's cache rows: stale content from
+            # poison every FREE cache region (paged: unallocated pool
+            # blocks; dense: free slots' rows): stale content from
             # retired sequences can never leak into live ones
-            free = [s for s, r in enumerate(sched._slots) if r is None]
+            if entry.paged:
+                free = list(sched._pool._free)
+            else:
+                free = [s for s, r in enumerate(sched._slots)
+                        if r is None]
             if free:
                 idx = jnp.asarray(free)
                 sched._caches = jax.tree.map(
@@ -268,6 +273,218 @@ def test_decode_step_is_one_host_sync(entry, monkeypatch):
     assert sched._decode_pass() == 3
     monkeypatch.setattr(jax, "device_get", real_get)
     assert syncs["n"] == 1
+    sched.close(drain=False)
+
+
+# ------------------------------------ paged KV pool & prefix cache (r21)
+def _paged_entry(lm, name="pg", **kw):
+    model, params, _ = lm
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("max_seq_len", 32)
+    kw.setdefault("prefill_chunk", 8)
+    return DecodeEntry(name, model, params, paged=True, **kw)
+
+
+@pytest.fixture(scope="module")
+def dense_outs(lm):
+    """The staggered schedule decoded through a DENSE (per-slot bucket)
+    entry — the reference stream every paged variant must bit-match."""
+    model, params, _ = lm
+    e = DecodeEntry("dn", model, params, num_slots=4, max_seq_len=32,
+                    prefill_chunk=8, paged=False)
+    assert not e.paged
+    return _staggered_run(e, _staggered_submits(lm))
+
+
+@pytest.mark.parametrize("block", [1, 7, 16])
+def test_paged_vs_dense_bit_parity(lm, dense_outs, block):
+    """ISSUE 20 acceptance: the paged block pool — staggered joins,
+    mid-batch EOS retirement, slot reuse — is BIT-IDENTICAL to the
+    dense per-slot bucket at block sizes 1, odd, and the default 16
+    (frontier-masked stale pages contribute exactly zero)."""
+    paged = _paged_entry(lm, name=f"pg{block}", kv_block=block)
+    assert paged.paged
+    outs = _staggered_run(paged, _staggered_submits(lm))
+    for a, b in zip(dense_outs, outs):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_prefix_cache_hit_cow_and_refcounts(lm):
+    """Shared-prefix reuse: a repeat prompt takes its whole prefill
+    region from cached blocks (hits == full block count, prefill
+    skipped ahead), a prompt diverging INSIDE block 2 takes only the
+    two genuinely-shared blocks (block-granular COW — the divergence
+    block stays private), and both decode bit-identically to the
+    isolated oracle. Retired requests decref; refs==0 blocks stay
+    cached and the pool invariant free + live + cached == total
+    holds."""
+    entry = _paged_entry(lm, name="pfx", kv_block=4, kv_pool_blocks=24)
+    assert entry.prefix_cache
+    sched = DecodeScheduler(entry, name="pfx", start=False)
+    r = np.random.RandomState(11)
+    shared = r.randint(2, VOCAB, 13).astype(np.int32)  # 3 whole blocks
+
+    def run(prompt):
+        rep = sched.submit(prompt, 6)
+        steps = 0
+        while not rep.done():
+            sched.step_once()
+            steps += 1
+            assert steps < 200
+        return rep.result(timeout=1)
+
+    a = run(shared)
+    check_vs_oracle(lm, shared, a, 6)
+    assert sched._prefix.hits == 0          # cold: all misses
+    assert sched._pool.cached_count() >= 3  # committed + retired
+    b = run(shared)                          # identical prompt
+    assert sched._prefix.hits == 3           # whole prefill region hit
+    np.testing.assert_array_equal(a, b)
+    div = shared.copy()
+    div[9] = 2 if div[9] != 2 else 3         # diverge inside block 2
+    h0 = sched._prefix.hits
+    c = run(div)
+    assert sched._prefix.hits - h0 == 2      # blocks 0,1 shared only
+    check_vs_oracle(lm, div, c, 6)
+    p = sched._pool
+    assert p.live == 0 and p.reserved == 0   # all retired -> only cache
+    assert p.free + p.cached_count() == p.total
+    sched.close(drain=False)
+
+
+def test_prefix_cache_cap_evicts_lru(lm):
+    """Distinct prompts overflow the cached-block cap: LRU refs==0
+    entries are evicted back to the free list, the eviction counter
+    moves, and the accounting invariant survives."""
+    entry = _paged_entry(lm, name="evc", kv_block=4, kv_pool_blocks=16,
+                         prefix_cache_blocks=4)
+    sched = DecodeScheduler(entry, name="evc", start=False)
+    r = np.random.RandomState(13)
+    for _ in range(4):                       # 4 prompts x 2 blocks > cap
+        rep = sched.submit(r.randint(2, VOCAB, 9).astype(np.int32), 4)
+        while not rep.done():
+            sched.step_once()
+    pf, p = sched._prefix, sched._pool
+    assert pf.evictions >= 1
+    assert p.cached_count() <= 4             # cap enforced
+    assert p.free + p.cached_count() == p.total
+    sched.close(drain=False)
+
+
+def test_pool_exhaustion_refusal_and_clean_retry(lm):
+    """A request that can NEVER fit the pool is refused at submit with
+    a block-level CapacityError and leaves no partial state; fitting
+    requests queue and complete — including two that must serialize
+    through the 2-block pool."""
+    from bigdl_tpu.observe.memz import CapacityError
+    entry = _paged_entry(lm, name="cap", kv_block=4, kv_pool_blocks=2,
+                         prefix_cache=False)
+    sched = DecodeScheduler(entry, name="cap", start=False)
+    with pytest.raises(CapacityError) as ei:
+        sched.submit(np.arange(2, 8, dtype=np.int32), 8)   # 4 blocks
+    assert "block" in str(ei.value)
+    assert sched._pool.free == 2 and sched._pool.reserved == 0
+    r1 = sched.submit([2, 3, 4], 4)                        # 2 blocks
+    r2 = sched.submit([2, 3, 4], 4)   # queues: pool holds one at a time
+    steps = 0
+    while not (r1.done() and r2.done()):
+        sched.step_once()
+        steps += 1
+        assert steps < 200
+    np.testing.assert_array_equal(r1.result(timeout=1),
+                                  r2.result(timeout=1))
+    assert sched._pool.free == 2
+    sched.close(drain=False)
+
+
+def test_sampling_deterministic_and_greedy_parity(lm, entry):
+    """temperature=0 through the sampling program == the greedy oracle
+    bit-for-bit; a fixed seed reproduces the identical stream whether
+    decoded solo or packed in a batch (position-keyed stateless rng);
+    hot temperatures actually move tokens off the argmax path. A model
+    compiled WITHOUT sampling refuses temperature > 0 at submit."""
+    smp = _paged_entry(lm, name="smp", sampling=True)
+    sched = DecodeScheduler(smp, name="smp", start=False)
+    prompt = np.asarray([2, 5, 9, 4], np.int32)
+
+    def run(batch):
+        reps = [sched.submit(prompt, 12, **kw) for kw in batch]
+        steps = 0
+        while not all(r.done() for r in reps):
+            sched.step_once()
+            steps += 1
+            assert steps < 300
+        return [r.result(timeout=1) for r in reps]
+
+    greedy, = run([dict(temperature=0.0)])
+    check_vs_oracle(lm, prompt, greedy, 12)
+    hot = dict(temperature=2.0, top_k=16, top_p=0.95, seed=42)
+    solo, = run([hot])
+    packed = run([hot, hot, dict(temperature=0.0)])
+    np.testing.assert_array_equal(solo, packed[0])   # solo == batched
+    np.testing.assert_array_equal(solo, packed[1])   # slot-independent
+    np.testing.assert_array_equal(greedy, packed[2])
+    others = run([dict(temperature=2.0, seed=s) for s in (1, 2, 3)])
+    assert any(o.shape != solo.shape or not np.array_equal(o, solo)
+               for o in others)
+    sched.close(drain=False)
+    plain = DecodeScheduler(entry, name="nosmp", start=False)
+    with pytest.raises(ValueError):
+        plain.submit(prompt, 4, temperature=0.7)
+    plain.close(drain=False)
+
+
+def test_kv_shard_pool_sharding_asserted(lm):
+    """kv_shard=True: the pool's block dim is sharded over the mesh
+    (NamedSharding asserted on the AOT executables' input shardings,
+    pool size rounded up to axis divisibility) and decode stays
+    bit-identical to the isolated oracle."""
+    from bigdl_tpu.parallel.mesh import create_mesh
+    from jax.sharding import PartitionSpec
+    mesh = create_mesh(drop_trivial_axes=True)
+    if mesh is None or len(mesh.devices.flat) < 2:
+        pytest.skip("needs a multi-device mesh")
+    model, params, _ = lm
+    e = DecodeEntry("shrd", model, params, mesh=mesh, num_slots=4,
+                    max_seq_len=32, prefill_chunk=8, paged=True,
+                    kv_shard=True)
+    e.precompile()                    # runs _assert_pool_sharding
+    assert e._pool_sharding is not None
+    assert e._pool_sharding.spec == PartitionSpec(e._shard_axis)
+    assert e.pool_blocks % mesh.shape[e._shard_axis] == 0
+    sched = DecodeScheduler(e, name="shrd", start=False)
+    prompt = np.asarray([2, 3, 4, 5], np.int32)
+    rep = sched.submit(prompt, 6)
+    steps = 0
+    while not rep.done():
+        sched.step_once()
+        steps += 1
+        assert steps < 200
+    check_vs_oracle(lm, prompt, rep.result(timeout=1), 6)
+    sched.close(drain=False)
+
+
+def test_paged_stats_and_ledger_surface(lm):
+    """stats() carries the block-pool economics (totals, free, cached,
+    utilization, prefix hit rate) and the ledger owns
+    serve/<m>/kv_pool with live blocks_free meta (the /memz + headroom
+    surface)."""
+    from bigdl_tpu.observe import memz
+    entry = _paged_entry(lm, name="stt", kv_block=4, kv_pool_blocks=16)
+    sched = DecodeScheduler(entry, name="stt", start=False)
+    rep = sched.submit(np.asarray([2, 3, 4, 5, 6], np.int32), 4)
+    while not rep.done():
+        sched.step_once()
+    st = sched.stats()
+    assert st["paged"] and st["kv_block"] == 4
+    assert st["kv_blocks_total"] == 16
+    assert (st["kv_blocks_free"] + st["kv_blocks_live"]
+            + st["kv_blocks_cached"] == 16)
+    assert "prefix_hit_rate" in st
+    row = memz.ledger().owners().get("serve/stt/kv_pool")
+    assert row is not None and row["kind"] == "kv_pool"
+    assert row["meta"]["blocks"] == 16
+    assert row["meta"]["blocks_free"] == sched._pool.free
     sched.close(drain=False)
 
 
@@ -425,10 +642,15 @@ def test_decode_knobs_registered():
     from bigdl_tpu.utils import config
     knobs = config.knobs()
     for name in ("SERVE_DECODE_SLOTS", "SERVE_PREFILL_CHUNK",
-                 "SERVE_MAX_SEQ_LEN"):
+                 "SERVE_MAX_SEQ_LEN", "SERVE_KV_PAGED",
+                 "SERVE_KV_BLOCK", "SERVE_KV_POOL_BLOCKS",
+                 "SERVE_PREFIX_CACHE", "SERVE_PREFIX_CACHE_BLOCKS",
+                 "SERVE_SAMPLING", "SERVE_KV_SHARD"):
         assert name in knobs and knobs[name].doc
     assert config.get("SERVE_DECODE_SLOTS") >= 1
     assert config.get("SERVE_MAX_SEQ_LEN") >= 1
+    assert config.get("SERVE_KV_BLOCK") >= 1
+    assert config.get("SERVE_KV_PAGED") in (True, False)
 
 
 # ----------------------------------------------------------------- CLI

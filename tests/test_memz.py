@@ -139,9 +139,10 @@ def test_decode_kv_bucket_accounted_exactly_closed_form(clean_mem):
         num_layers=layers, d_model=d_model, num_heads=heads)
     eng = ServeEngine()
     entry = eng.register("lm", model, params, state, decode=True,
-                         num_slots=slots, max_seq_len=seq,
+                         num_slots=slots, max_seq_len=seq, paged=False,
                          precompile_decode=False)
-    # num_slots x max_seq_len x layers x heads x hd x dtype, K and V
+    # dense mode: num_slots x max_seq_len x layers x heads x hd x dtype,
+    # K and V (the paged pool's ledger surface lives in test_decode.py)
     hd = d_model // heads
     want = slots * seq * layers * heads * hd * 4 * 2
     owners = memz.ledger().owners()
@@ -419,11 +420,15 @@ def test_decode_admission_refused_with_capacity_report(
                      num_slots=8, max_seq_len=256,
                      precompile_decode=False)
     msg = str(ei.value)
-    assert "KV bucket" in msg and "bytes" in msg and "/memz" in msg
+    # paged (default) sizes a block pool; dense mode keeps "KV bucket"
+    assert ("paged pool" in msg or "KV bucket" in msg)
+    assert "bytes" in msg and "/memz" in msg
     assert observe.counter("mem/admission_refused").value == 1
     # nothing was registered (no half-registered model, no scheduler)
     assert eng.models() == []
-    assert "serve/lm/kv_cache" not in memz.ledger().owners()
+    owners = memz.ledger().owners()
+    assert "serve/lm/kv_cache" not in owners
+    assert "serve/lm/kv_pool" not in owners
     # with the limit lifted the same registration succeeds
     monkeypatch.delenv("BIGDL_TPU_MEM_LIMIT_BYTES")
     eng.register("lm", model, params, state, decode=True, num_slots=4,

@@ -251,11 +251,13 @@ class _FakeBackend:
         return np.asarray(inputs)
 
     def generate(self, model, prompt, max_new, eos_id=None, *,
-                 priority, client):
+                 priority, client, temperature=0.0, top_k=0, top_p=1.0,
+                 seed=0):
         return [1, 2, 3]
 
     def stream_generate(self, model, prompt, max_new, eos_id=None, *,
-                        priority, client):
+                        priority, client, temperature=0.0, top_k=0,
+                        top_p=1.0, seed=0):
         return self.stream
 
     def close(self):
